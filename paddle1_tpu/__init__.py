@@ -1,0 +1,55 @@
+"""paddle1_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference ≈ v2.0), built on JAX/XLA/Pallas.
+
+Eager mode = tape autograd over jax ops (the dygraph analog); compiled mode =
+whole-graph jit/pjit (the static-graph analog); distribution = named mesh
+axes + XLA collectives (the fleet analog). See SURVEY.md at the repo root for
+the full mapping to the reference.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (CPUPlace, Place, TPUPlace, Tensor, bfloat16, bool_,
+                   complex128, complex64, device_count, device_guard,
+                   errors, flags, float16, float32, float64,
+                   get_default_dtype, get_device, get_flags, int16, int32,
+                   int64, int8, is_compiled_with_tpu, promote_types, seed,
+                   set_default_dtype, set_device, set_flags, to_tensor,
+                   uint8)
+from .core.dtype import dtype
+from .core.generator import get_rng_state, set_rng_state
+from .core.tensor import Parameter
+from .autograd import grad, no_grad, enable_grad, set_grad_enabled, \
+    is_grad_enabled
+from .ops import *  # noqa: F401,F403 — tensor op namespace (also patches Tensor)
+from .ops import linalg
+from . import autograd
+
+# Subsystems are imported lazily-but-eagerly as they land; keep this list in
+# sync with the build plan (SURVEY.md §7).
+from . import nn
+from . import optimizer
+from . import amp
+from . import io
+from . import metric
+from . import jit
+from . import static
+from . import distributed
+from . import vision
+from . import text
+from . import hapi
+from . import incubate
+from . import metric as metrics  # compat alias
+from .framework import save, load
+from .jit import to_static
+from .hapi.model import Model
+from .hapi.model_summary import summary, flops
+
+# paddle-compat aliases
+def disable_static(place=None):
+    return None  # eager is the default mode
+
+
+def enable_static():
+    from .static import enable_static_mode
+    enable_static_mode()

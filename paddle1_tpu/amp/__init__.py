@@ -1,0 +1,192 @@
+"""Automatic mixed precision.
+
+Analog of /root/reference/python/paddle/amp/ (auto_cast → fluid/dygraph/amp/
+auto_cast.py:91 amp_guard with WHITE_LIST/BLACK_LIST, GradScaler →
+loss_scaler.py:27 AmpScaler) plus the C++ cast insertion in
+imperative/amp_auto_cast.cc.
+
+TPU-native: the preferred low-precision dtype is bfloat16 (MXU-native,
+exponent range of f32), so overflow-driven loss scaling is usually a no-op —
+but the full GradScaler protocol (scale, unscale, inf/nan check,
+update_loss_scaling) is implemented for float16 parity and for the
+``check_finite`` safety net, mirroring amp/check_finite_and_unscale_op.cu and
+amp/update_loss_scaling_op.cu.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "WHITE_LIST", "BLACK_LIST", "amp_state"]
+
+# Op categories (reference fluid/dygraph/amp/auto_cast.py:27,36): white =
+# always low precision (MXU ops), black = keep f32 (numerically sensitive).
+WHITE_LIST = {"matmul", "bmm", "conv1d", "conv2d", "conv3d", "linear",
+              "einsum", "addmm", "mv"}
+BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "norm",
+              "batch_norm_train", "batch_norm_infer", "cosine_similarity",
+              "reduce_sum", "pow", "square", "softmax_with_cross_entropy"}
+
+_tls = threading.local()
+
+
+class _AmpState:
+    def __init__(self, enabled, dtype, level, custom_white, custom_black):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = (WHITE_LIST | set(custom_white or ())) - \
+            set(custom_black or ())
+        self.black = (BLACK_LIST | set(custom_black or ())) - \
+            set(custom_white or ())
+
+
+def amp_state() -> Optional[_AmpState]:
+    return getattr(_tls, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """AMP context (reference amp/auto_cast.py:20). Inside, eager ops on the
+    white list run in ``dtype``; black-list ops run f32; others follow their
+    inputs ('gray' behavior)."""
+    if level not in ("O0", "O1", "O2"):
+        raise InvalidArgumentError("level must be O0/O1/O2")
+    prev = amp_state()
+    _tls.amp = _AmpState(enable and level != "O0",
+                         dtypes.convert_dtype(dtype), level,
+                         custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Pure-low-precision decorate (reference mixed_precision/decorator.py:
+    O2 casts parameters)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Loss scaler (reference amp/grad_scaler.py:20 → AmpScaler
+    loss_scaler.py:27). Dynamic scaling: double every
+    ``incr_every_n_steps`` good steps, halve on inf/nan, skip the step."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops import math_ops
+        return math_ops.multiply(var, to_tensor(self._scale,
+                                                dtype=var.dtype))
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale (reference
+        amp/check_finite_and_unscale_op.cu): divide grads by scale, flag
+        non-finite."""
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        found = False
+        inv = 1.0 / self._scale
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad.data.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+            p.grad._data = g.astype(p.grad.data.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        # reference AmpScaler.minimize: backward already called on the
+        # scaled loss by user; unscale, conditional step, update.
+        self.step(optimizer)
+
+    def update(self):
+        """update_loss_scaling op logic (reference
+        amp/update_loss_scaling_op.cu)."""
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps, "enable": self._enable,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

@@ -1,0 +1,11 @@
+"""Autograd: eager tape engine, paddle.grad, PyLayer, no_grad.
+
+Analog of /root/reference/paddle/fluid/imperative/ (BasicEngine,
+PartialGradEngine, hooks) + python/paddle/autograd/.
+"""
+
+from .engine import (apply, enable_grad, grad, is_grad_enabled, no_grad,
+                     run_backward, set_grad_enabled)
+from .py_layer import PyLayer, PyLayerContext
+
+backward = run_backward

@@ -1,0 +1,103 @@
+"""User-defined differentiable functions.
+
+Analog of the reference's ``paddle.autograd.PyLayer``
+(/root/reference/python/paddle/autograd/py_layer.py), used by recompute
+(distributed/fleet/utils/recompute.py:63). The forward runs with the tape
+disabled; a custom GradNode is installed whose vjp calls the user backward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.errors import PreconditionNotMetError
+from . import engine
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    """ctx object handed to forward/backward for residual stashing."""
+
+    def __init__(self):
+        self._saved: Tuple[Tensor, ...] = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def saved_tensors(self):
+        return list(self._saved)
+
+
+class PyLayer:
+    """Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    staticmethods; call via ``MyLayer.apply(*args)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with engine.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        out_tensors = []
+        for o in out_list:
+            if isinstance(o, Tensor):
+                t = Tensor(o.data, stop_gradient=not needs_grad)
+            else:
+                t = o  # non-tensor passthrough (kept out of the grad graph)
+            out_tensors.append(t)
+
+        if needs_grad:
+            diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+            grad_outputs_mask = [isinstance(t, Tensor) for t in out_tensors]
+
+            def vjp_fn(cotangents):
+                if not isinstance(cotangents, (tuple, list)):
+                    cotangents = (cotangents,)
+                gts = []
+                ci = 0
+                for keep in grad_outputs_mask:
+                    if keep:
+                        gts.append(Tensor(cotangents[ci], stop_gradient=True))
+                    ci += 1
+                with engine.no_grad():
+                    gins = cls.backward(ctx, *gts)
+                if not isinstance(gins, (tuple, list)):
+                    gins = (gins,)
+                if len(gins) != len(diff_inputs):
+                    raise PreconditionNotMetError(
+                        f"{cls.__name__}.backward returned {len(gins)} grads "
+                        f"for {len(diff_inputs)} differentiable inputs")
+                return tuple(None if g is None else
+                             (g.data if isinstance(g, Tensor) else g)
+                             for g in gins)
+
+            tensor_outs = [t for t in out_tensors if isinstance(t, Tensor)]
+            in_edges = [(t._node, t._output_index, t) for t in diff_inputs]
+            node = engine.GradNode(cls.__name__, vjp_fn, in_edges, tensor_outs)
+            for j, ot in enumerate(tensor_outs):
+                ot._node = node
+                ot._output_index = j
+
+        return out_tensors[0] if single else tuple(out_tensors)

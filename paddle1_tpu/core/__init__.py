@@ -1,0 +1,17 @@
+"""Core runtime: dtype, place, flags, errors, RNG, Tensor."""
+
+from . import errors, flags
+# NOTE: do NOT bind the name `dtype` here — it would shadow the core.dtype
+# submodule for every `from ..core import dtype as dtypes` import site.
+from .dtype import (bfloat16, bool_, complex128, complex64, convert_dtype,
+                    float16, float32, float64, get_default_dtype,
+                    int16, int32, int64, int8, promote_types,
+                    set_default_dtype, uint8)
+from .errors import *  # noqa: F401,F403
+from .flags import flags_guard, get_flags, set_flags
+from .generator import (Generator, default_generator, get_rng_state,
+                        get_rng_tracker, next_key, rng_scope, seed,
+                        set_rng_state)
+from .place import (CPUPlace, Place, TPUPlace, device_count, device_guard,
+                    get_device, is_compiled_with_tpu, set_device)
+from .tensor import Parameter, Tensor, to_tensor

@@ -1,0 +1,98 @@
+"""Dtype system.
+
+Analog of the reference's proto::VarType dtype enum + transfer logic
+(/root/reference/paddle/fluid/framework/framework.proto, data_type.h).
+On TPU the canonical compute dtypes are float32 and bfloat16 (MXU-native);
+float16 is supported for API parity but bfloat16 is preferred.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # ships with jax
+
+from .errors import InvalidArgumentError
+
+__all__ = [
+    "dtype", "convert_dtype", "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64", "uint8", "bool_", "complex64",
+    "complex128", "is_floating", "is_integer", "promote_types",
+    "set_default_dtype", "get_default_dtype",
+]
+
+# Canonical dtype objects are numpy dtypes (what jax uses internally).
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+dtype = np.dtype  # user-facing alias: paddle1_tpu.dtype("float32")
+
+_ALIASES = {
+    "float": float32, "double": float64, "half": float16, "bf16": bfloat16,
+    "bfloat16": bfloat16, "float32": float32, "float64": float64,
+    "float16": float16, "int8": int8, "int16": int16, "int32": int32,
+    "int64": int64, "uint8": uint8, "bool": bool_, "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    d = convert_dtype(d)
+    if not is_floating(d):
+        raise InvalidArgumentError(
+            f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize str/np.dtype/jnp dtype/python type to a numpy dtype."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        if d in _ALIASES:
+            return _ALIASES[d]
+        try:
+            return np.dtype(d)
+        except TypeError:
+            raise InvalidArgumentError(f"Unknown dtype: {d!r}") from None
+    if d is float:
+        return _default_dtype
+    if d is int:
+        return int64
+    if d is bool:
+        return bool_
+    try:
+        return np.dtype(d)
+    except TypeError:
+        raise InvalidArgumentError(f"Unknown dtype: {d!r}") from None
+
+
+def is_floating(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))

@@ -1,0 +1,86 @@
+"""Typed error hierarchy + enforce macros.
+
+TPU-native analog of the reference's PADDLE_ENFORCE machinery
+(/root/reference/paddle/fluid/platform/enforce.h, errors at
+platform/errors.h). Python tracebacks replace the C++ demangled stack dumps;
+the typed hierarchy is preserved so user code can catch specific categories.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+    "ResourceExhaustedError", "PreconditionNotMetError", "UnimplementedError",
+    "UnavailableError", "FatalError", "ExecutionTimeoutError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_not_none",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base for all framework-raised errors (reference enforce.h:EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond, msg="", error_cls=PreconditionNotMetError):
+    if not cond:
+        raise error_cls(msg if msg else "Enforce condition failed")
+
+
+def enforce_eq(a, b, msg="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_gt(a, b, msg="", error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(f"{msg} (expected {a!r} > {b!r})")
+
+
+def enforce_not_none(x, msg="", error_cls=NotFoundError):
+    if x is None:
+        raise error_cls(msg if msg else "Expected a non-None value")
+    return x

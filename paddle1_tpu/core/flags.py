@@ -1,0 +1,152 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's gflags-based registry
+(/root/reference/paddle/fluid/platform/flags.cc:33-461 and the Python bridge
+python/paddle/fluid/framework.py:6083 set_flags / :6106 get_flags).
+
+Design: a single process-wide registry of typed flags. Flags can be set
+programmatically (``set_flags``) or via environment variables named
+``FLAGS_<name>`` (checked at definition time, mirroring gflags env binding).
+Unlike the reference there is no C++/Python split: the registry is the single
+source of truth and is consulted by the runtime (allocator hints, determinism,
+nan/inf checking, collective timeouts, ...).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .errors import InvalidArgumentError
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flags_guard"]
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    help: str
+    type: type
+    value: Any
+    validator: Optional[Callable[[Any], bool]] = None
+
+
+_registry: Dict[str, _FlagDef] = {}
+_lock = threading.RLock()
+
+
+def _coerce(raw: Any, ty: type) -> Any:
+    if ty is bool:
+        if isinstance(raw, bool):
+            return raw
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                validator: Optional[Callable[[Any], bool]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the
+    default at definition time (gflags-compatible behavior)."""
+    with _lock:
+        ty = type(default)
+        value = default
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            value = _coerce(env, ty)
+        if validator is not None and not validator(value):
+            raise InvalidArgumentError(
+                f"Invalid value {value!r} for flag {name}")
+        _registry[name] = _FlagDef(name, default, help, ty, value, validator)
+
+
+def flag(name: str) -> Any:
+    """Fast single-flag read used by runtime internals."""
+    try:
+        return _registry[name].value
+    except KeyError:
+        raise InvalidArgumentError(
+            f"Flag '{name}' has not been defined. Known flags: "
+            f"{sorted(_registry)[:20]} ...") from None
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """Query flag values. ``flags`` may be a name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        out[name] = flag(name)
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flag values from a dict, with type coercion and validation."""
+    if not isinstance(flags, dict):
+        raise InvalidArgumentError("set_flags expects a dict of {name: value}")
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise InvalidArgumentError(f"Flag '{name}' is not defined")
+            d = _registry[name]
+            value = _coerce(value, d.type)
+            if d.validator is not None and not d.validator(value):
+                raise InvalidArgumentError(
+                    f"Invalid value {value!r} for flag {name}")
+            d.value = value
+
+
+class flags_guard:
+    """Context manager that temporarily overrides flags (test helper)."""
+
+    def __init__(self, overrides: Dict[str, Any]):
+        self._overrides = overrides
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self):
+        self._saved = get_flags(list(self._overrides))
+        set_flags(self._overrides)
+        return self
+
+    def __exit__(self, *exc):
+        set_flags(self._saved)
+        return False
+
+
+def _define_builtin_flags() -> None:
+    # Numerics / debugging (reference: platform/flags.cc check_nan_inf,
+    # cudnn_deterministic).
+    define_flag("check_nan_inf", False,
+                "Sweep op outputs for NaN/Inf after every eager op.")
+    define_flag("deterministic", False,
+                "Prefer deterministic XLA lowerings where available.")
+    # Eager engine
+    define_flag("eager_max_tape_len", 1_000_000,
+                "Safety valve on autograd tape length.")
+    define_flag("retain_grad_for_all", False,
+                "Retain .grad for non-leaf tensors (debugging).")
+    # Memory (analog of allocator strategy / gpu mem fraction flags)
+    define_flag("allocator_strategy", "xla_default",
+                "Informational: TPU memory is managed by XLA/PJRT.",
+                validator=lambda v: v in ("xla_default",))
+    define_flag("fraction_of_gpu_memory_to_use", 1.0,
+                "Compat no-op: XLA preallocation is controlled by "
+                "XLA_PYTHON_CLIENT_MEM_FRACTION.")
+    # Collectives
+    define_flag("collective_timeout_s", 1800.0,
+                "Informational timeout for distributed rendezvous.")
+    define_flag("hierarchical_allreduce", False,
+                "Prefer ICI-then-DCN hierarchical collectives on multislice.")
+    # Profiler
+    define_flag("profiler_trace_dir", "/tmp/ptpu_trace",
+                "Directory for jax.profiler traces.")
+    # JIT
+    define_flag("jit_donate_params", True,
+                "Donate parameter buffers in compiled training steps.")
+
+
+_define_builtin_flags()

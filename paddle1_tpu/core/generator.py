@@ -1,0 +1,167 @@
+"""Random number generation.
+
+Analog of the reference's per-device Generator
+(/root/reference/paddle/fluid/framework/generator.h:118-126) and the dygraph
+tensor-parallel RNG tracker
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:23).
+
+Design: TPU randomness is counter-based (threefry). A ``Generator`` owns a
+root key + a monotone offset; every eager random op folds the offset in and
+bumps it — so eager mode is reproducible under ``seed(n)`` just like the
+reference's ``manual_seed``. Under ``jax.jit`` tracing, random ops must be
+functional: the jit path threads an explicit key via ``rng_scope`` so that the
+compiled program is deterministic in its key argument (no hidden state baked
+into the trace).
+
+``RNGStatesTracker`` reproduces the reference's model-parallel dropout
+semantics: some random ops must agree across the tensor-parallel axis
+(weight init), others must differ per rank (dropout on sharded activations);
+tracked named states provide both.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from .errors import AlreadyExistsError, NotFoundError
+
+__all__ = [
+    "Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+    "next_key", "rng_scope", "RNGStatesTracker", "get_rng_tracker",
+]
+
+
+class Generator:
+    """Stateful key source for eager mode."""
+
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int) -> "Generator":
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed_) & 0xFFFFFFFFFFFFFFFF
+            self._offset = 0
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state) -> None:
+        self._seed = int(state["seed"])
+        self._offset = int(state["offset"])
+
+    def next_key(self) -> jax.Array:
+        """Hand out a fresh key; bumps the offset (eager hot path)."""
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        # fold_in is cheap and gives an independent stream per offset.
+        return jax.random.fold_in(jax.random.key(self._seed), off)
+
+    def random(self) -> int:
+        """A fresh python int (for seeding subprocess workers)."""
+        k = self.next_key()
+        return int(jax.random.bits(k, shape=(), dtype=np.uint32))
+
+
+default_generator = Generator(0)
+
+
+def seed(seed_: int) -> Generator:
+    """Global manual seed (reference paddle.seed / manual_seed)."""
+    get_rng_tracker().reset(seed_)
+    return default_generator.manual_seed(seed_)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    default_generator.set_state(state)
+
+
+# --- functional key threading for the jit path ------------------------------
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def rng_scope(key: jax.Array):
+    """Inside this scope, ``next_key()`` splits from ``key`` functionally
+    instead of consuming global state — required under jit tracing."""
+    prev = getattr(_tls, "scope", None)
+    _tls.scope = [key, 0]
+    try:
+        yield
+    finally:
+        _tls.scope = prev
+
+
+def next_key() -> jax.Array:
+    """The one entry point random ops use to obtain a key."""
+    scope = getattr(_tls, "scope", None)
+    if scope is not None:
+        key, n = scope
+        scope[1] = n + 1
+        return jax.random.fold_in(key, n)
+    return default_generator.next_key()
+
+
+def in_rng_scope() -> bool:
+    return getattr(_tls, "scope", None) is not None
+
+
+# --- tensor-parallel RNG state tracker --------------------------------------
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    """Named generator states for tensor parallelism.
+
+    ``add(name, seed)`` registers a stream; ``rng_state(name)`` temporarily
+    swaps the default generator to it (reference random.py:23 semantics:
+    dropout inside ColumnParallelLinear uses a per-rank stream; everything
+    else uses the replicated global stream)."""
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+
+    def reset(self, base_seed: Optional[int] = None) -> None:
+        self._states.clear()
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self._states:
+            raise AlreadyExistsError(f"RNG state {name!r} already exists")
+        self._states[name] = Generator(seed_)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self._states:
+            raise NotFoundError(
+                f"RNG state {name!r} not registered; call add() first")
+        global default_generator
+        prev = default_generator
+        default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _tracker

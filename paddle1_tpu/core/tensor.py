@@ -1,0 +1,266 @@
+"""Eager Tensor.
+
+Analog of the reference's dygraph ``VarBase``
+(/root/reference/paddle/fluid/imperative/layer.h:66) + the Python method
+patches (python/paddle/fluid/dygraph/math_op_patch.py,
+varbase_patch_methods.py). A Tensor wraps a ``jax.Array`` plus autograd
+metadata; every computation flows through ``paddle1_tpu.autograd.engine.apply``
+which both executes the jax op and records a grad node (the reference's
+``Tracer::TraceOp`` tracer.cc:133,207 collapses into that single function
+because XLA owns kernel dispatch).
+
+Paddle semantics preserved: ``stop_gradient`` defaults to True for plain
+tensors and False for ``Parameter``; ``.backward()`` runs the tape engine;
+``.grad`` is populated on leaves; hooks fire on gradient flow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .errors import InvalidArgumentError, PreconditionNotMetError
+from .place import Place, get_device
+
+__all__ = ["Tensor", "to_tensor", "Parameter"]
+
+
+def _as_array(data, dtype=None) -> jax.Array:
+    if isinstance(data, Tensor):
+        data = data.data
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        arr = data
+        if dtype is not None:
+            arr = arr.astype(dtypes.convert_dtype(dtype))
+        return arr
+    if isinstance(data, np.ndarray):
+        if dtype is None and data.dtype == np.float64:
+            dtype = dtypes.get_default_dtype()  # numpy float64 → default f32
+        return jnp.asarray(data, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+    if isinstance(data, (bool, int, float, complex)):
+        if dtype is None:
+            if isinstance(data, bool):
+                dtype = dtypes.bool_
+            elif isinstance(data, int):
+                dtype = dtypes.int64
+            else:
+                dtype = dtypes.get_default_dtype()
+        return jnp.asarray(data, dtype=dtypes.convert_dtype(dtype))
+    if isinstance(data, (list, tuple)):
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            dtype = dtypes.get_default_dtype()
+        return jnp.asarray(arr, dtype=dtypes.convert_dtype(dtype) if dtype else None)
+    raise InvalidArgumentError(
+        f"Cannot convert {type(data).__name__} to Tensor")
+
+
+class Tensor:
+    """Eager tensor with autograd metadata."""
+
+    # Keep instances lightweight: these are created once per eager op output.
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_output_index",
+                 "_hooks", "_retain_grad", "name", "persistable",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        self._data = _as_array(data, dtype)
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._node = None            # GradNode that produced this tensor
+        self._output_index = 0       # which output of that node
+        self._hooks: List = []
+        self._retain_grad = False
+        self.name = name
+        self.persistable = False
+
+    # -- raw array access ---------------------------------------------------
+
+    @property
+    def data(self) -> jax.Array:
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = _as_array(value)
+
+    # -- metadata -----------------------------------------------------------
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.ndim else 1
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        return get_device()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    # -- conversion ---------------------------------------------------------
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        if self.size != 1:
+            raise InvalidArgumentError(
+                f"item() requires a single-element tensor, got shape {self.shape}")
+        return self._data.reshape(()).item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__()
+
+    # -- autograd -----------------------------------------------------------
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False) -> None:
+        """Reverse-mode from this tensor (reference
+        varbase_patch_methods.py:167 → BasicEngine)."""
+        from ..autograd import engine
+        engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Hook on this tensor's gradient during backward. Returns a handle
+        with ``remove()`` (reference imperative/hooks.h semantics)."""
+        if self.stop_gradient:
+            raise PreconditionNotMetError(
+                "Cannot register hook on a tensor with stop_gradient=True")
+        entry = [hook]
+        self._hooks.append(entry)
+
+        class _Handle:
+            def remove(_self):
+                entry[0] = None
+        return _Handle()
+
+    def retain_grads(self) -> None:
+        self._retain_grad = True
+
+    def clear_grad(self) -> None:
+        self._grad = None
+
+    def clear_gradient(self) -> None:  # legacy alias
+        self._grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from ..autograd.engine import apply
+        return apply("clone", lambda x: x + jnp.zeros((), x.dtype), (self,))
+
+    def _replace_impl(self, other: "Tensor") -> None:
+        """In-place value replacement preserving identity (used by setitem
+        and optimizer in-place updates)."""
+        self._data = other._data
+        self._node = other._node
+        self._output_index = other._output_index
+
+    # -- python protocol ----------------------------------------------------
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self) -> str:
+        try:
+            vals = np.array2string(self.numpy(), precision=6, threshold=40)
+        except Exception:
+            vals = "<traced>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n       {vals})")
+
+    def __bool__(self) -> bool:
+        if self.size != 1:
+            raise InvalidArgumentError(
+                "The truth value of a multi-element Tensor is ambiguous")
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # Arithmetic/indexing methods are patched in by paddle1_tpu.ops.patch
+    # (mirrors the reference's math_op_patch.py monkey-patching approach so
+    # the op layer and tensor type stay decoupled).
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable, with a trainable
+    flag (reference framework.py:5557 Parameter / :5663 ParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor equivalent (reference fluid/dygraph/base.py:597
+    to_variable + 2.0 creation API)."""
+    if isinstance(data, Tensor):
+        if dtype is not None and dtypes.convert_dtype(dtype) != data.dtype:
+            data = Tensor(data.data, dtype=dtype, stop_gradient=stop_gradient)
+            return data
+        t = Tensor(data.data, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,9 @@
+"""paddle1_tpu.distributed — fleet-style distributed training over device
+meshes (reference python/paddle/distributed analog).
+
+Collective API, fleet facade, launchers, and hybrid-parallel layers land in
+build stage 5-6 (SURVEY §7); env/rank plumbing is live now.
+"""
+
+from . import env
+from .env import get_rank, get_world_size

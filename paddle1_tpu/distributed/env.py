@@ -1,0 +1,50 @@
+"""Distributed environment state shared across the distributed package.
+
+Tracks (a) process-level env (rank/world size, reference
+PADDLE_TRAINER_ID env protocol), and (b) the *SPMD trace context*: when a
+training step is being traced under shard_map/pjit over a mesh, collective-
+aware layers (SyncBatchNorm, parallel layers) must know which named mesh axis
+corresponds to which logical parallelism group. This replaces the reference's
+(ring_id → ncclComm_t) registry (platform/collective_helper.h:53) with
+(logical axis name → mesh axis name).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+_tls = threading.local()
+
+
+def get_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("RANK", "0")))
+
+
+def get_world_size() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM",
+                              os.environ.get("WORLD_SIZE", "1")))
+
+
+@contextlib.contextmanager
+def spmd_axes(**mapping: str):
+    """Declare logical→mesh axis bindings for the enclosed trace, e.g.
+    ``with spmd_axes(dp="data", mp="model"): ...``"""
+    prev = getattr(_tls, "axes", None)
+    merged = dict(prev or {})
+    merged.update(mapping)
+    _tls.axes = merged
+    try:
+        yield
+    finally:
+        _tls.axes = prev
+
+
+def current_spmd_axis(logical: str) -> Optional[str]:
+    axes = getattr(_tls, "axes", None)
+    if axes is None:
+        return None
+    return axes.get(logical)

@@ -1,0 +1,23 @@
+"""Framework-level utilities: save/load, ParamAttr, random seeding.
+
+Analog of python/paddle/framework/ in the reference (io.py:494 save /
+:688 load).
+"""
+
+from .param_attr import ParamAttr
+from .io import save, load
+from ..core.generator import seed as _seed
+
+
+class random:
+    """paddle.framework.random compat namespace."""
+
+    @staticmethod
+    def get_rng_state():
+        from ..core.generator import get_rng_state
+        return get_rng_state()
+
+    @staticmethod
+    def set_rng_state(state):
+        from ..core.generator import set_rng_state
+        set_rng_state(state)

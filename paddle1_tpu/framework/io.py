@@ -1,0 +1,82 @@
+"""Object checkpointing: paddle.save / paddle.load.
+
+Analog of /root/reference/python/paddle/framework/io.py (save:494,
+load:688): pickled nested containers of tensors. TPU-native format: tensors
+are serialized as numpy arrays inside the pickle (bfloat16 via ml_dtypes
+round-trips natively); everything else passes through pickle unchanged, so
+``state_dict`` + optimizer state + arbitrary user objects all round-trip
+exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Pickle surrogate for Tensor; keeps dtype (incl. bfloat16) exactly."""
+
+    def __init__(self, array: np.ndarray, is_parameter: bool, name,
+                 stop_gradient: bool):
+        self.array = array
+        self.is_parameter = is_parameter
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def restore(self):
+        if self.is_parameter:
+            t = Parameter(self.array, name=self.name)
+        else:
+            t = Tensor(self.array, stop_gradient=self.stop_gradient,
+                       name=self.name)
+        return t
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.data),
+                              isinstance(obj, Parameter), obj.name,
+                              obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else obj.restore()
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs) -> None:
+    """Save a nested object (state_dicts, tensors, python objects)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,5 @@
+"""paddle1_tpu.hapi — high-level Model API (reference python/paddle/hapi)."""
+
+from . import callbacks
+from .model import Model
+from .model_summary import flops, summary

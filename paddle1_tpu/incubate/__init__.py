@@ -1,0 +1,5 @@
+"""Incubating features (reference python/paddle/incubate +
+fluid/incubate): auto-checkpoint, functional higher-order autodiff bridge.
+"""
+
+from . import functional

@@ -1,0 +1,54 @@
+"""Functional (jax-transform) bridge for power users.
+
+No direct reference analog; this is the TPU-native escape hatch: take a
+Layer + loss closure and get back pure jax functions (value_and_grad over a
+params pytree) for custom training loops, higher-order autodiff, or manual
+pjit work.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+
+from ..autograd import engine
+from ..core.generator import rng_scope
+from ..core.tensor import Tensor
+
+__all__ = ["functional_call", "value_and_grad"]
+
+
+def functional_call(layer, params: Dict[str, jax.Array], *args,
+                    training: bool = False, rng_key=None):
+    """Run ``layer.forward`` with ``params`` swapped in functionally.
+    Traceable under jit/grad/vmap/shard_map."""
+    key = rng_key if rng_key is not None else jax.random.key(0)
+    was = layer.training
+    layer.training = training
+    try:
+        with engine.no_grad(), rng_scope(key), \
+                layer.load_functional_state(params):
+            t_args = [Tensor(a, stop_gradient=True)
+                      if not isinstance(a, Tensor) else a for a in args]
+            out = layer.forward(*t_args)
+            if isinstance(out, Tensor):
+                return out.data
+            if isinstance(out, (tuple, list)):
+                return type(out)(o.data if isinstance(o, Tensor) else o
+                                 for o in out)
+            return out
+    finally:
+        layer.training = was
+
+
+def value_and_grad(layer, loss_fn: Callable, has_aux: bool = False):
+    """Build ``(params, batch, key) -> (loss, grads)`` for a Layer and a
+    loss closure taking (outputs, batch)."""
+
+    def compute(params, batch, key):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        out = functional_call(layer, params, x, training=True, rng_key=key)
+        return loss_fn(out, batch)
+
+    return jax.value_and_grad(compute, has_aux=has_aux)

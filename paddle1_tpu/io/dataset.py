@@ -1,0 +1,264 @@
+"""Datasets and samplers.
+
+Analog of /root/reference/python/paddle/fluid/dataloader/ (dataset.py,
+batch_sampler.py, sampler.py): Dataset/IterableDataset/TensorDataset/
+ComposeDataset/ChainDataset/Subset/random_split, Sampler family and
+BatchSampler/DistributedBatchSampler.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..core.generator import default_generator
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "Subset", "random_split", "Sampler",
+           "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+           "BatchSampler", "DistributedBatchSampler"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        from ..core.tensor import Tensor
+        if not tensors:
+            raise InvalidArgumentError("TensorDataset needs >=1 tensor")
+        n = tensors[0].shape[0]
+        for t in tensors:
+            if t.shape[0] != n:
+                raise InvalidArgumentError(
+                    "All tensors must share dim-0 length")
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets: sample = concatenation of each dataset's fields."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise InvalidArgumentError("ComposeDataset needs >=1 dataset")
+        n = len(self.datasets[0])
+        for d in self.datasets:
+            if len(d) != n:
+                raise InvalidArgumentError("Datasets must be equal length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        sample = []
+        for d in self.datasets:
+            item = d[idx]
+            sample.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(sample)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if sum(lengths) != len(dataset):
+        # fraction support
+        if all(0 < l < 1 for l in lengths):
+            n = len(dataset)
+            lengths = [int(math.floor(n * l)) for l in lengths]
+            lengths[-1] += n - sum(lengths)
+        else:
+            raise InvalidArgumentError(
+                "sum(lengths) must equal dataset length")
+    gen = generator or default_generator
+    perm = np.random.RandomState(gen.random() % (2 ** 31)).permutation(
+        len(dataset))
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l].tolist()))
+        offset += l
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        gen = self.generator or default_generator
+        rng = np.random.RandomState(gen.random() % (2 ** 31))
+        if self.replacement:
+            yield from rng.randint(0, n, self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[:self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = np.random.RandomState(default_generator.random() % (2 ** 31))
+        idx = rng.choice(len(self.weights), self.num_samples,
+                         replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Groups sampler indices into batches (reference batch_sampler.py)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        if (dataset is None) == (sampler is None):
+            raise InvalidArgumentError(
+                "Exactly one of dataset / sampler must be given")
+        if sampler is not None:
+            self.sampler = sampler
+        else:
+            self.sampler = (RandomSampler(dataset) if shuffle
+                            else SequenceSampler(dataset))
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank shard of the index space (reference
+    distributed/fleet/dataset?  python/paddle/io DistributedBatchSampler):
+    pads to equal length so every rank sees the same number of batches —
+    required for lockstep SPMD on TPU."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import env
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.nranks = num_replicas if num_replicas is not None \
+            else env.get_world_size()
+        self.local_rank = rank if rank is not None else env.get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n)
+        # pad to make divisible
+        pad = self.total_size - n
+        if pad > 0:
+            indices = np.concatenate([indices, indices[:pad]])
+        local = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in local.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch

@@ -1,0 +1,321 @@
+"""Compiled ("static") execution: to_static / jit.save / jit.load.
+
+Analog of the reference's dygraph→static stack
+(/root/reference/python/paddle/fluid/dygraph/jit.py:161 declarative,
+dygraph_to_static/program_translator.py:58 ConcreteProgram cache,
+jit.py:508 save → TranslatedLayer).
+
+The architectural inversion (SURVEY §7): the reference AST-rewrites Python
+into a ProgramDesc interpreted op-by-op; on TPU we *trace* the same eager code
+under jax.jit into one XLA program. Python control flow is resolved at trace
+time (the supported subset matches what the reference's AST transformer
+handled for non-tensor-dependent control flow); tensor-dependent control flow
+should use lax.cond/scan via paddle1_tpu.static.nn.cond/while_loop.
+
+``StaticFunction.__call__`` stays differentiable in eager mode: the whole
+compiled program is recorded on the tape as ONE op whose vjp is the XLA-
+compiled backward — so "static" training composes with eager autograd the
+way run_program_op does in the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..autograd import engine
+from ..core import dtype as dtypes
+from ..core.generator import next_key, rng_scope
+from ..core.tensor import Parameter, Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+from ..nn.layer_base import Layer
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
+           "save", "load", "TranslatedLayer", "ignore_module"]
+
+
+class InputSpec:
+    """Shape/dtype signature (reference static/input.py InputSpec).
+    A None dim means polymorphic (one recompile per concrete value)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+def _tree_map_tensors(obj, fn):
+    if isinstance(obj, Tensor):
+        return fn(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_map_tensors(o, fn) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_map_tensors(v, fn) for k, v in obj.items()}
+    return obj
+
+
+def _collect_tensors(obj, out: list):
+    if isinstance(obj, Tensor):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _collect_tensors(o, out)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_tensors(v, out)
+
+
+class StaticFunction:
+    """The traced-and-compiled callable (ConcreteProgram analog; jax.jit
+    owns the per-signature cache the reference kept in
+    program_translator.py:133)."""
+
+    def __init__(self, fn: Callable, input_spec=None, layer: Optional[Layer]
+                 = None, donate_params: bool = False):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn,
+                                 assigned=("__name__", "__doc__"),
+                                 updated=())
+        self._jitted = jax.jit(self._pure, static_argnames=("training",))
+
+    # pure(params_dict, key, *input_arrays) -> output arrays
+    def _pure(self, params, key, args, kwargs, training=False):
+        layer = self._layer
+
+        def run():
+            with engine.no_grad(), rng_scope(key):
+                a = _tree_map_tensors(
+                    args, lambda arr: arr)  # already arrays→wrapped below
+                wrapped_args = _tree_map_tensors(
+                    args, lambda x: x)
+                t_args = _rewrap(args)
+                t_kwargs = _rewrap(kwargs)
+                out = self._fn(*t_args, **t_kwargs)
+                return _tree_map_tensors(out, lambda t: t.data)
+        if layer is not None:
+            was_training = layer.training
+            layer.training = training
+            try:
+                with layer.load_functional_state(params):
+                    return run()
+            finally:
+                layer.training = was_training
+        return run()
+
+    def __call__(self, *args, **kwargs):
+        params = self._layer.functional_state() if self._layer is not None \
+            else {}
+        key = next_key()
+        arr_args = _tree_map_tensors(args, lambda t: t.data)
+        arr_kwargs = _tree_map_tensors(kwargs, lambda t: t.data)
+        training = self._layer.training if self._layer is not None else False
+
+        param_tensors = (list(self._layer.state_dict().values())
+                         if self._layer is not None else [])
+        needs_grad = engine.is_grad_enabled() and any(
+            not p.stop_gradient for p in param_tensors)
+
+        input_tensors = []
+        _collect_tensors(args, input_tensors)
+        _collect_tensors(kwargs, input_tensors)
+        needs_grad = needs_grad or (engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in input_tensors))
+
+        names = list(params.keys())
+
+        def op_fn(*flat):
+            p = dict(zip(names, flat[:len(names)]))
+            in_flat = flat[len(names):]
+            rebuilt_args = _rebuild(arr_args, list(in_flat[:_count(arr_args)]))
+            rebuilt_kwargs = _rebuild(
+                arr_kwargs, list(in_flat[_count(arr_args):]))
+            return self._jitted(p, key, rebuilt_args, rebuilt_kwargs,
+                                training=training)
+
+        flat_inputs = (param_tensors +
+                       input_tensors)
+        out = engine.apply(f"static:{getattr(self._fn, '__name__', 'fn')}",
+                           op_fn, tuple(flat_inputs))
+        return out
+
+    @property
+    def concrete_program(self):
+        return self._jitted
+
+    def lower(self, *args, **kwargs):
+        params = self._layer.functional_state() if self._layer else {}
+        key = jax.random.key(0)
+        arr_args = _tree_map_tensors(args, lambda t: t.data)
+        return self._jitted.lower(params, key, arr_args, {}, training=False)
+
+
+def _count(tree) -> int:
+    out = []
+    _collect_arrays(tree, out)
+    return len(out)
+
+
+def _collect_arrays(obj, out):
+    if isinstance(obj, (jax.Array, np.ndarray)) or hasattr(obj, "dtype"):
+        out.append(obj)
+    elif isinstance(obj, (list, tuple)):
+        for o in obj:
+            _collect_arrays(o, out)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_arrays(v, out)
+
+
+def _rebuild(template, flat: list):
+    if isinstance(template, (jax.Array, np.ndarray)) or (
+            hasattr(template, "dtype") and hasattr(template, "shape")):
+        return flat.pop(0)
+    if isinstance(template, tuple):
+        return tuple(_rebuild(t, flat) for t in template)
+    if isinstance(template, list):
+        return [_rebuild(t, flat) for t in template]
+    if isinstance(template, dict):
+        return {k: _rebuild(v, flat) for k, v in template.items()}
+    return template
+
+
+def _rewrap(obj):
+    """arrays → Tensors so the traced eager code sees Tensor inputs."""
+    if isinstance(obj, (jax.Array, np.ndarray)) or (
+            hasattr(obj, "dtype") and hasattr(obj, "shape") and
+            not isinstance(obj, Tensor)):
+        return Tensor(obj, stop_gradient=True)
+    if isinstance(obj, tuple):
+        return tuple(_rewrap(o) for o in obj)
+    if isinstance(obj, list):
+        return [_rewrap(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _rewrap(v) for k, v in obj.items()}
+    return obj
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@to_static decorator / converter (reference jit.py:161)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = sf
+            return fn
+        # plain function (may be a bound Layer.forward)
+        layer = getattr(fn, "__self__", None)
+        if isinstance(layer, Layer):
+            return StaticFunction(fn, input_spec, layer=layer)
+        return StaticFunction(fn, input_spec, layer=None)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+# ---------------------------------------------------------------------------
+# jit.save / jit.load — deployable program+params artifact
+# ---------------------------------------------------------------------------
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Persist a Layer as {path}.pdmodel (serialized StableHLO via
+    jax.export) + {path}.pdiparams (reference jit.py:508 saves ProgramDesc +
+    params). The exported artifact runs without the Python model class —
+    the TranslatedLayer analog."""
+    from jax import export as jexport
+    if isinstance(layer, StaticFunction):
+        sf = layer
+        base_layer = sf._layer
+    elif isinstance(layer, Layer):
+        fwd = layer.forward
+        sf = fwd if isinstance(fwd, StaticFunction) else StaticFunction(
+            fwd if not isinstance(fwd, StaticFunction) else fwd._fn,
+            input_spec, layer=layer)
+        base_layer = layer
+    else:
+        raise InvalidArgumentError("jit.save expects a Layer or "
+                                   "StaticFunction")
+    if input_spec is None:
+        raise InvalidArgumentError(
+            "jit.save requires input_spec on TPU (shapes must be known "
+            "to export StableHLO)")
+    params = base_layer.functional_state() if base_layer is not None else {}
+
+    key = jax.random.key(0)
+    specs = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+             for s in input_spec]
+
+    def infer_fn(params, *inputs):
+        return sf._pure(params, key, tuple(inputs), {}, training=False)
+
+    param_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in params.items()}
+    exported = jexport.export(jax.jit(infer_fn))(param_specs, *specs)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    from ..framework.io import save as fsave
+    fsave({k: to_tensor(np.asarray(v)) for k, v in params.items()},
+          path + ".pdiparams")
+
+
+class TranslatedLayer(Layer):
+    """Deserialized inference program (reference TranslatedLayer:
+    jit.py:844 load). Parameters are restored so state_dict works; forward
+    invokes the deserialized XLA program."""
+
+    def __init__(self, exported, params: Dict[str, Any]):
+        super().__init__()
+        self._exported = exported
+        self._params_arrays = params
+        for k, v in params.items():
+            safe = k.replace(".", "__")
+            self.add_parameter(safe, Parameter(v, name=k))
+
+    def forward(self, *inputs):
+        arrs = [i.data if isinstance(i, Tensor) else np.asarray(i)
+                for i in inputs]
+        params = {p.name: p.data for p in self.parameters()}
+        out = self._exported.call(params, *arrs)
+        return _tree_map_tensors_from_arrays(out)
+
+
+def _tree_map_tensors_from_arrays(obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_map_tensors_from_arrays(o) for o in obj)
+    return to_tensor(obj)
+
+
+def load(path, **configs) -> TranslatedLayer:
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    from ..framework.io import load as fload
+    params = fload(path + ".pdiparams", return_numpy=True)
+    return TranslatedLayer(exported, params)

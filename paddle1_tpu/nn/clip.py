@@ -1,0 +1,110 @@
+"""Gradient clipping.
+
+Analog of /root/reference/python/paddle/fluid/clip.py (ClipGradByValue:152,
+ClipGradByNorm:243, ClipGradByGlobalNorm:345). Clips operate on
+(param, grad) lists and are attached to optimizers via ``grad_clip=``,
+matching the reference's optimizer protocol.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, to_tensor(jnp.clip(g.data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.data)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, to_tensor(g.data * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        sq = 0.0
+        any_clip = False
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            any_clip = True
+            sq = sq + jnp.sum(jnp.square(g.data.astype(jnp.float32)))
+        if not any_clip:
+            return params_grads
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, to_tensor(g.data * scale.astype(g.data.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return to_tensor(0.0)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.max(jnp.abs(g.data))
+                                     for g in grads]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(g.data) ** norm_type) for g in grads])) ** \
+            (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = p.grad.data * scale.astype(p.grad.data.dtype)
+    return to_tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad.data, -clip_value, clip_value)

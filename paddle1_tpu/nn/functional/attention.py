@@ -1,0 +1,80 @@
+"""Attention functionals.
+
+No direct reference analog (the reference's MultiHeadAttention is composed of
+matmul/softmax ops in python/paddle/nn/layer/transformer.py:109); on TPU the
+fused path matters, so this module is the single entry point that routes to
+the Pallas flash-attention kernel when eligible (jit, TPU, aligned shapes)
+and to the plain XLA composition otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["scaled_dot_product_attention", "attention_ref"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def attention_ref(q, k, v, mask=None, dropout_p=0.0, scale=None,
+                  is_causal=False, dropout_key=None):
+    """Pure-jax reference attention. q,k,v: [B, N, H, D] (paddle layout:
+    batch, seq, heads, head_dim)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    # -> [B, H, N, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if is_causal:
+        nq, nk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((nq, nk), bool), nk - nq)
+        logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None,
+                                 use_flash=True):
+    """Fused attention entry. Uses the Pallas flash kernel on TPU when
+    shapes are tile-aligned, else the XLA composition (which XLA still fuses
+    well)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    drop = dropout_p if training else 0.0
+    dropout_key = None
+    if drop > 0.0:
+        from ...core.generator import next_key
+        dropout_key = next_key()
+
+    from ...ops.pallas import flash_attention as fa
+    args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
+
+    def f(q, k, v, *m):
+        mask = m[0] if m else None
+        if (use_flash and mask is None and drop == 0.0
+                and fa.supported(q.shape, k.shape)):
+            return fa.flash_attention(q, k, v, causal=is_causal)
+        return attention_ref(q, k, v, mask=mask, dropout_p=drop,
+                             is_causal=is_causal, dropout_key=dropout_key)
+    return apply("scaled_dot_product_attention", f,
+                 tuple(a if isinstance(a, Tensor) else _t(a) for a in args))

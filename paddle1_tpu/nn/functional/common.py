@@ -1,0 +1,362 @@
+"""Functional common ops: linear, dropout, embedding, padding, one_hot,
+interpolate, pixel_shuffle, cosine_similarity, label_smooth, npair utils.
+
+Analog of python/paddle/nn/functional/common.py + the corresponding reference
+C++ ops (dropout_op.cc, lookup_table_v2_op.cc, pad3d_op.cc, interpolate_v2,
+pixel_shuffle_op, one_hot_v2). Dropout draws from the global generator
+(eager) or the functional rng_scope (under jit) — core/generator.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.engine import apply
+from ...core.generator import next_key
+from ...core.tensor import Tensor, to_tensor
+from ...core.errors import InvalidArgumentError
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "pad", "zeropad2d", "cosine_similarity",
+           "label_smooth", "pixel_shuffle", "pixel_unshuffle",
+           "channel_shuffle", "interpolate", "upsample", "bilinear",
+           "affine_grid", "grid_sample", "fold_", "temporal_shift"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout (reference
+    fc/matmul_v2; maps straight onto the MXU)."""
+    if bias is not None:
+        return apply("linear", lambda x, w, b: jnp.matmul(x, w) + b,
+                     (_t(x), _t(weight), _t(bias)))
+    return apply("linear", lambda x, w: jnp.matmul(x, w),
+                 (_t(x), _t(weight)))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout_infer", lambda x: x * (1.0 - p), (x,))
+        return x
+    if p == 1.0:
+        return apply("dropout_all", lambda x: jnp.zeros_like(x), (x,))
+    key = next_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+
+    def f(x):
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+        return jnp.where(keep, x, 0.0).astype(x.dtype)
+    return apply("dropout", f, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+
+    def f(x):
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+    return apply("alpha_dropout", f, (x,))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup-table (reference lookup_table_v2_op). ``sparse`` is accepted
+    for API parity; on TPU gradients densify under jit (SURVEY §7 hard part
+    (e)) and use IndexedSlices-style scatter-add in eager."""
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids != padding_idx).astype(w.dtype)[..., None]
+            out = out * mask
+        return out
+    return apply("embedding", f, (_t(x), _t(weight)))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot",
+                 lambda x: jax.nn.one_hot(x.astype(jnp.int32), num_classes),
+                 (_t(x),))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in pad.numpy().reshape(-1)]
+    pad = [int(p) for p in pad]
+
+    def build_padspec(nd):
+        cfg = [(0, 0)] * nd
+        if len(pad) == 2 * nd:
+            # full spec, paddle order = [dim0_lo, dim0_hi, ...]? The
+            # reference uses per-dim pairs starting from the first dim.
+            for i in range(nd):
+                cfg[i] = (pad[2 * i], pad[2 * i + 1])
+            return cfg
+        # partial spec applies to trailing spatial dims, reversed pair order
+        # (paddle pad convention: last-dim pairs first)
+        n_spatial = len(pad) // 2
+        if data_format.startswith("NC"):
+            spatial_axes = list(range(nd - n_spatial, nd))
+        else:
+            spatial_axes = list(range(1, 1 + n_spatial))
+            spatial_axes = list(range(nd - 1 - n_spatial, nd - 1))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            cfg[ax] = (pad[2 * i], pad[2 * i + 1])
+        return cfg
+
+    cfg = build_padspec(x.ndim)
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(x):
+        if jmode == "constant":
+            return jnp.pad(x, cfg, mode="constant", constant_values=value)
+        return jnp.pad(x, cfg, mode=jmode)
+    return apply("pad", f, (x,))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", f, (_t(x1), _t(x2)))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y, *pd):
+        n = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / n
+    args = (_t(label),) + ((_t(prior_dist),) if prior_dist is not None else ())
+    return apply("label_smooth", f, args)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            y = x.reshape(n, c // (r * r), r, r, h, w)
+            y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+            return y.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = x.shape
+        y = x.reshape(n, h, w, r, r, c // (r * r))
+        y = jnp.transpose(y, (0, 1, 3, 2, 4, 5))
+        return y.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", f, (_t(x),))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(x):
+        n, c, h, w = x.shape
+        y = x.reshape(n, c, h // r, r, w // r, r)
+        y = jnp.transpose(y, (0, 1, 3, 5, 2, 4))
+        return y.reshape(n, c * r * r, h // r, w // r)
+    return apply("pixel_unshuffle", f, (_t(x),))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(x):
+        n, c, h, w = x.shape
+        y = x.reshape(n, groups, c // groups, h, w)
+        y = jnp.swapaxes(y, 1, 2)
+        return y.reshape(n, c, h, w)
+    return apply("channel_shuffle", f, (_t(x),))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Resize (reference interpolate_v2 op family) via jax.image.resize."""
+    x = _t(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    spatial_ndim = x.ndim - 2
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy().reshape(-1)]
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial_ndim
+        cur = (x.shape[1:-1] if channel_last else x.shape[2:])
+        size = [int(c * s) for c, s in zip(cur, scale_factor)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(x):
+        if channel_last:
+            new_shape = (x.shape[0], *size, x.shape[-1])
+        else:
+            new_shape = (x.shape[0], x.shape[1], *size)
+        if jmode == "nearest":
+            return jax.image.resize(x, new_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via linear
+            # interpolation on an aligned grid.
+            return _resize_align_corners(x, new_shape, channel_last, jmode)
+        return jax.image.resize(x, new_shape, method=jmode)
+    return apply("interpolate", f, (x,))
+
+
+def _resize_align_corners(x, new_shape, channel_last, method):
+    spatial_in = x.shape[1:-1] if channel_last else x.shape[2:]
+    spatial_out = new_shape[1:-1] if channel_last else new_shape[2:]
+    y = x
+    axis0 = 1 if channel_last else 2
+    for i, (n_in, n_out) in enumerate(zip(spatial_in, spatial_out)):
+        ax = axis0 + i
+        if n_in == n_out:
+            continue
+        pos = jnp.linspace(0.0, n_in - 1, n_out)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, n_in - 1)
+        w = (pos - lo).astype(x.dtype)
+        shape = [1] * y.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        y = jnp.take(y, lo, axis=ax) * (1 - w) + jnp.take(y, hi, axis=ax) * w
+    return y
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    args = (_t(x1), _t(x2), _t(weight)) + \
+        ((_t(bias),) if bias is not None else ())
+    return apply("bilinear", f, args)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy().reshape(-1)]
+
+    def f(theta):
+        n, _, h, w = out_shape[0], out_shape[1], out_shape[2], out_shape[3]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)
+        grid = jnp.einsum("nij,pj->npi", theta.astype(jnp.float32), base)
+        return grid.reshape(n, h, w, 2)
+    return apply("affine_grid", f, (_t(theta),))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(x, grid):
+        n, c, h, w = x.shape
+        gx = grid[..., 0]
+        gy = grid[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample_one(img, fx, fy):
+            # img: [C,H,W]
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = fx - x0
+            wy = fy - y0
+
+            def at(yy, xx):
+                valid = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+                xx = jnp.clip(xx, 0, w - 1)
+                yy = jnp.clip(yy, 0, h - 1)
+                v = img[:, yy, xx]
+                if padding_mode == "zeros":
+                    v = jnp.where(valid[None], v, 0.0)
+                return v
+            if mode == "nearest":
+                return at(jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+            return (at(y0, x0) * (1 - wx) * (1 - wy) +
+                    at(y0, x1) * wx * (1 - wy) +
+                    at(y1, x0) * (1 - wx) * wy +
+                    at(y1, x1) * wx * wy)
+        return jax.vmap(sample_one)(x, fx, fy)
+    return apply("grid_sample", f, (_t(x), _t(grid)))
+
+
+def fold_(*args, **kwargs):
+    from .conv import fold
+    return fold(*args, **kwargs)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def f(x):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        y = x.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([y[:, 1:, :fold_c],
+                                jnp.zeros_like(y[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(y[:, :1, fold_c:2 * fold_c]),
+                                 y[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = y[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply("temporal_shift", f, (_t(x),))

@@ -1,0 +1,328 @@
+"""Functional losses.
+
+Analog of /root/reference/paddle/fluid/operators/{cross_entropy_op,
+softmax_with_cross_entropy_op,bce_loss_op,huber_loss_op,kldiv_loss_op,
+margin_rank_loss_op,nll_loss_op,...}.cc and
+python/paddle/nn/functional/loss.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply
+from ...core.tensor import Tensor, to_tensor
+from ...core.errors import InvalidArgumentError
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "ctc_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "triplet_margin_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "npair_loss", "dice_loss",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise InvalidArgumentError(f"Unknown reduction {reduction!r}")
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """The reference's softmax_with_cross_entropy + 2.0 cross_entropy in one
+    (softmax fused by XLA; numerically stable log-softmax form)."""
+    input, label = _t(input), _t(label)
+
+    def f(x, y, *w):
+        logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(x, 1e-15, 1.0))
+        if soft_label or (y.dtype == x.dtype and y.shape == x.shape):
+            soft = y
+            if label_smoothing > 0:
+                n = x.shape[axis]
+                soft = soft * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            yi = y.astype(jnp.int32)
+            if yi.ndim == x.ndim:
+                yi = jnp.squeeze(yi, axis=axis)
+            oh = jax.nn.one_hot(yi, x.shape[axis], axis=axis, dtype=logp.dtype)
+            if label_smoothing > 0:
+                n = x.shape[axis]
+                oh = oh * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(oh * logp, axis=axis)
+            valid = (yi != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                cw = jnp.take(w[0], jnp.clip(yi, 0, None), axis=0)
+                loss = loss * jnp.where(valid, cw, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            elif reduction == "mean":
+                denom = jnp.sum(valid.astype(loss.dtype))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((_t(weight),) if weight is not None else ())
+    return apply("cross_entropy", f, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as softmax_fn
+    loss = apply("unsqueeze_loss",
+                 lambda l: jnp.expand_dims(l, axis), (loss,))
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(x, y, *w):
+        loss = -(y * jnp.log(jnp.clip(x, 1e-12, 1.0)) +
+                 (1 - y) * jnp.log(jnp.clip(1 - x, 1e-12, 1.0)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (_t(input), _t(label)) + ((_t(weight),) if weight is not None else ())
+    return apply("binary_cross_entropy", f, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(x, y, *extra):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        neg_abs = -jnp.abs(x)
+        # stable: max(x,0) - x*y + log(1+exp(-|x|)); pos_weight scales the
+        # positive term like the reference kernel.
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_w * (jnp.log1p(jnp.exp(neg_abs)) +
+                                          jnp.maximum(-x, 0.0))
+        else:
+            loss = jnp.maximum(x, 0.0) - x * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply("bce_with_logits", f, tuple(args))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, y, *w):
+        yi = y.astype(jnp.int32)
+        gathered = jnp.take_along_axis(
+            logp, yi[:, None] if logp.ndim == 2 else yi[..., None],
+            axis=1 if logp.ndim == 2 else -1)
+        loss = -jnp.squeeze(gathered, axis=1 if logp.ndim == 2 else -1)
+        valid = yi != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            cw = jnp.take(w[0], jnp.clip(yi, 0, None))
+            loss = loss * jnp.where(valid, cw, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+        elif reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = (_t(input), _t(label)) + ((_t(weight),) if weight is not None else ())
+    return apply("nll_loss", f, args)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda x, y: _reduce(jnp.abs(x - y), reduction),
+                 (_t(input), _t(label)))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda x, y: _reduce(jnp.square(x - y), reduction),
+                 (_t(input), _t(label)))
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda x, y: jnp.square(x - y),
+                 (_t(input), _t(label)))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply("smooth_l1_loss", f, (_t(input), _t(label)))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", f, (_t(input), _t(label)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(x, y):
+        return -(y * jnp.log(x + epsilon) +
+                 (1 - y) * jnp.log(1 - x + epsilon))
+    return apply("log_loss", f, (_t(input), _t(label)))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return apply("margin_ranking_loss", f, (_t(input), _t(other), _t(label)))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", f, (_t(input), _t(label)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", f,
+                 (_t(input1), _t(input2), _t(label)))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply("soft_margin_loss", f, (_t(input), _t(label)))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, axis=-1)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (_t(input), _t(label)) + ((_t(weight),) if weight is not None else ())
+    return apply("multi_label_soft_margin_loss", f, args)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p + epsilon, -1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return apply("triplet_margin_loss", f,
+                 (_t(input), _t(positive), _t(negative)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(x, y, *n):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0.0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = (_t(logit), _t(label)) + \
+        ((_t(normalizer),) if normalizer is not None else ())
+    return apply("sigmoid_focal_loss", f, args)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(sim.dtype)
+        target = eq / jnp.sum(eq, axis=-1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -jnp.mean(jnp.sum(target * logp, axis=-1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) +
+                        jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+    return apply("npair_loss", f, (_t(anchor), _t(positive), _t(labels)))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(x, y):
+        yoh = jax.nn.one_hot(y.astype(jnp.int32).squeeze(-1), x.shape[-1],
+                             dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * yoh, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(yoh, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply("dice_loss", f, (_t(input), _t(label)))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via jax's optax-style forward algorithm (reference
+    warpctc_op.cc). log_probs: [T, N, C] or [N, T, C] paddle uses [T,N,C]
+    for fluid; 2.0 uses (logits [B, T, C])."""
+    def f(lp, y, ilen, llen):
+        # normalize to [B, T, C]
+        probs = lp
+        if probs.ndim == 3 and probs.shape[0] != y.shape[0]:
+            probs = jnp.moveaxis(probs, 0, 1)
+        logp = jax.nn.log_softmax(probs, axis=-1)
+        import optax
+        lpad = (y != blank).astype(jnp.int32) * 0 + \
+            (jnp.arange(y.shape[1])[None, :] >= llen[:, None]).astype(jnp.int32)
+        lmask = (jnp.arange(probs.shape[1])[None, :] >= ilen[:, None]
+                 ).astype(logp.dtype)
+        loss = optax.ctc_loss(logp, lmask, y.astype(jnp.int32),
+                              lpad.astype(logp.dtype), blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return apply("ctc_loss", f, (_t(log_probs), _t(labels),
+                                 _t(input_lengths), _t(label_lengths)))

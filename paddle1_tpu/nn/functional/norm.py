@@ -1,0 +1,169 @@
+"""Functional normalization.
+
+Analog of /root/reference/paddle/fluid/operators/{batch_norm_op,layer_norm_op,
+group_norm_op,instance_norm_op}.cc and python/paddle/nn/functional/norm.py.
+LayerNorm is the transformer hot path: the fused Pallas kernel in
+ops/pallas/layer_norm.py is used under jit when shapes allow; this reference
+implementation is the fallback and the numeric ground truth.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Batch norm with running-stat update (reference batch_norm_op.cc).
+    Running stats are updated in-place on the passed tensors, mirroring the
+    reference's mutable mean/variance variables."""
+    x = _t(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    def bshape(v, nd):
+        shape = [1] * nd
+        shape[ch_axis] = -1
+        return v.reshape(shape)
+
+    if use_stats:
+        def f(x, m, v, *wb):
+            y = (x - bshape(m, x.ndim)) * jax.lax.rsqrt(
+                bshape(v, x.ndim) + epsilon)
+            if wb:
+                y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
+            return y
+        args = (x, _t(running_mean), _t(running_var))
+        if weight is not None:
+            args = args + (_t(weight), _t(bias))
+        return apply("batch_norm_infer", f, args)
+
+    # training: compute batch stats, update running stats in place
+    def f(x, *wb):
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        y = (x - bshape(mean, x.ndim)) * jax.lax.rsqrt(
+            bshape(var, x.ndim) + epsilon)
+        if wb:
+            y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
+        return y, mean, var
+
+    args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
+    y, mean, var = apply("batch_norm_train", f, args, n_outputs=3)
+    if running_mean is not None:
+        rm = _t(running_mean)
+        rv = _t(running_var)
+        rm._data = momentum * rm.data + (1 - momentum) * mean.data
+        rv._data = momentum * rv.data + (1 - momentum) * var.data
+    return y
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def f(x, *wb):
+        xf = x.astype(jnp.float32)  # stats in f32 even under bf16 AMP
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+        y = y.astype(x.dtype)
+        if wb:
+            w = wb[0].reshape((1,) * (x.ndim - n_axes) + wb[0].shape)
+            b = wb[1].reshape((1,) * (x.ndim - n_axes) + wb[1].shape)
+            y = y * w + b
+        return y
+
+    args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
+    return apply("layer_norm", f, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-05, data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))  # per-sample, per-channel spatial stats
+
+    def f(x, *wb):
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            y = y * wb[0].reshape(shape) + wb[1].reshape(shape)
+        return y
+    args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
+    return apply("instance_norm", f, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(x, *wb):
+        if channel_last:
+            xm = jnp.moveaxis(x, -1, 1)
+        else:
+            xm = x
+        n, c = xm.shape[0], xm.shape[1]
+        g = num_groups
+        grouped = xm.reshape(n, g, c // g, *xm.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        y = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(xm.shape)
+        if wb:
+            shape = (1, -1) + (1,) * (xm.ndim - 2)
+            y = y * wb[0].reshape(shape) + wb[1].reshape(shape)
+        if channel_last:
+            y = jnp.moveaxis(y, 1, -1)
+        return y
+    args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
+    return apply("group_norm", f, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(x):
+        sq = jnp.square(x)
+        half = size // 2
+        pads = [(0, 0)] * x.ndim
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        pads[ch_axis] = (half, size - half - 1)
+        window = [1] * x.ndim
+        window[ch_axis] = size
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                       (1,) * x.ndim, pads)
+        return x / (k + alpha * summed) ** beta
+    return apply("local_response_norm", f, (_t(x),))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(x):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return x / jnp.maximum(n, epsilon)
+    return apply("normalize", f, (_t(x),))

@@ -1,0 +1,205 @@
+"""Weight initializers.
+
+Analog of /root/reference/python/paddle/nn/initializer/ and
+python/paddle/fluid/initializer.py (ConstantInitializer, UniformInitializer,
+NormalInitializer, TruncatedNormalInitializer, XavierInitializer,
+MSRAInitializer a.k.a. Kaiming, BilinearInitializer, NumpyArrayInitializer).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from
+the global generator — on TPU, initialization is just a traced random op, so
+initializers are pure functions rather than graph-op emitters.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.generator import next_key
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Bilinear", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def _fans(shape: Sequence[int]):
+    shape = list(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight layout NCHW-filter: [out_c, in_c, *spatial]
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "selu": 3.0 / 4.0}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtypes.convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        return jax.random.uniform(next_key(), tuple(shape),
+                                  dtypes.convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = dtypes.convert_dtype(dtype)
+        return self.mean + self.std * jax.random.normal(
+            next_key(), tuple(shape), dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = dtypes.convert_dtype(dtype)
+        return self.mean + self.std * jax.random.truncated_normal(
+            next_key(), -2.0, 2.0, tuple(shape), dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), tuple(shape),
+                                  dtypes.convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(next_key(), tuple(shape),
+                                       dtypes.convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 name=None):
+        self._fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = self.gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), tuple(shape),
+                                  dtypes.convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 name=None):
+        self._fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = self.gain / math.sqrt(fi)
+        return std * jax.random.normal(next_key(), tuple(shape),
+                                       dtypes.convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        arr = np.asarray(self.value)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return jnp.asarray(arr, dtypes.convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """For transposed-conv upsampling kernels (reference
+    BilinearInitializer)."""
+
+    def __call__(self, shape, dtype=None):
+        weight = np.zeros(shape, dtype=np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        f = math.ceil(shape[3] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape[2:])):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[:, :, y, x] = v
+        return jnp.asarray(weight, dtypes.convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            next_key(), tuple(shape), dtypes.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        w = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        mins = min(oc // self.groups, ic)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                w[(g * (oc // self.groups) + i, i, *centers)] = 1.0
+        return jnp.asarray(w, dtypes.convert_dtype(dtype))

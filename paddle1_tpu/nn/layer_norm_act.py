@@ -1,0 +1,503 @@
+"""Normalization + activation layers + containers.
+
+Analog of python/paddle/nn/layer/norm.py (LayerNorm:438, GroupNorm:319,
+BatchNorm2D:769, SyncBatchNorm:961, convert_sync_batchnorm:1123),
+activation.py, and container.py in the reference.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+from .initializer import Constant
+from .layer_base import Layer
+from . import functional as F
+
+__all__ = [
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+    "InstanceNorm3D", "LocalResponseNorm", "SpectralNorm", "RMSNorm",
+    "ReLU", "ReLU6", "LeakyReLU", "PReLU", "RReLU", "ELU", "SELU", "CELU",
+    "GELU", "Sigmoid", "LogSigmoid", "Hardshrink", "Hardsigmoid", "Hardswish",
+    "Hardtanh", "Softshrink", "Softsign", "Softplus", "Softmax", "LogSoftmax",
+    "Tanh", "Tanhshrink", "ThresholdedReLU", "Silu", "Swish", "Mish", "GLU",
+    "Maxout", "Sequential", "LayerList", "ParameterList", "LayerDict",
+]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_features], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+        self.register_buffer("_mean", to_tensor(
+            np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance", to_tensor(
+            np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return (f"num_features={self._num_features}, "
+                f"momentum={self._momentum}, epsilon={self._epsilon}")
+
+
+class BatchNorm(_BatchNormBase):
+    """Legacy fluid-style BatchNorm (acts on any rank)."""
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm (reference nn/layer/norm.py:961 backed by
+    sync_batch_norm_op.cu.h with in-kernel ncclAllReduce).
+
+    TPU-native: inside pjit/shard_map, stats are psum'd over the data-
+    parallel mesh axis; in plain eager single-chip mode it degrades to local
+    BN (matching the reference when world_size == 1)."""
+
+    def forward(self, x):
+        from ..distributed import env as dist_env
+        axis = dist_env.current_spmd_axis("dp")
+        if axis is None or not self.training:
+            return super().forward(x)
+        import jax
+        from ..autograd.engine import apply
+        ch_axis = 1 if self._data_format == "NCHW" else x.ndim - 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        eps, mom = self._epsilon, self._momentum
+
+        def f(x, w, b):
+            local_sum = jnp.sum(x, axis=reduce_axes)
+            local_sqsum = jnp.sum(x * x, axis=reduce_axes)
+            count = np.prod([x.shape[i] for i in reduce_axes])
+            g_sum = jax.lax.psum(local_sum, axis)
+            g_sqsum = jax.lax.psum(local_sqsum, axis)
+            g_count = jax.lax.psum(jnp.asarray(count, x.dtype), axis)
+            mean = g_sum / g_count
+            var = g_sqsum / g_count - mean * mean
+            shape = [1] * x.ndim
+            shape[ch_axis] = -1
+            y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + eps)
+            return y * w.reshape(shape) + b.reshape(shape), mean, var
+        y, mean, var = apply("sync_batch_norm", f,
+                             (x, self.weight, self.bias), n_outputs=3)
+        self._mean._data = mom * self._mean.data + (1 - mom) * mean.data
+        self._variance._data = mom * self._variance.data + \
+            (1 - mom) * var.data
+        return y
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        """Recursively convert BatchNorm* sublayers to SyncBatchNorm
+        (reference norm.py:1123)."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon,
+                                data_format=layer._data_format)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._buffers["_mean"] = layer._mean
+            new._buffers["_variance"] = layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=weight_attr,
+                default_initializer=Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                self._normalized_shape, attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm — no reference analog (post-2021 technique);
+    provided for modern LLM blocks."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None,
+                 name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=weight_attr,
+            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        from ..autograd.engine import apply
+        import jax
+        eps = self._epsilon
+
+        def f(x, w):
+            xf = x.astype(jnp.float32)
+            ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+            return (xf * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+        return apply("rms_norm", f, (x, self.weight))
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=Constant(1.0))
+            self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.weight = self.bias = None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               epsilon=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference spectral_norm_op.cc)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from .initializer import Normal
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..autograd.engine import apply
+        import jax
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def f(w, u, v):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w / sigma
+        return apply("spectral_norm", f,
+                     (weight, self.weight_u, self.weight_v))
+
+
+# ---------------------------------------------------------------------------
+# Activation layers
+# ---------------------------------------------------------------------------
+
+
+def _act_layer(name, fn_name, **defaults):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        merged = dict(defaults)
+        keys = list(defaults)
+        for i, a in enumerate(args):
+            merged[keys[i]] = a
+        merged.update({k: v for k, v in kwargs.items() if k in merged})
+        self._kwargs = merged
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+Softsign = _act_layer("Softsign", "softsign")
+Silu = _act_layer("Silu", "silu")
+Swish = _act_layer("Swish", "swish")
+Mish = _act_layer("Mish", "mish")
+ELU = _act_layer("ELU", "elu", alpha=1.0)
+CELU = _act_layer("CELU", "celu", alpha=1.0)
+SELU = _act_layer("SELU", "selu",
+                  scale=1.0507009873554805, alpha=1.6732632423543772)
+GELU = _act_layer("GELU", "gelu", approximate=False)
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu", negative_slope=0.01)
+Hardshrink = _act_layer("Hardshrink", "hardshrink", threshold=0.5)
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardtanh = _act_layer("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Softshrink = _act_layer("Softshrink", "softshrink", threshold=0.5)
+Softplus = _act_layer("Softplus", "softplus", beta=1.0, threshold=20.0)
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu",
+                             threshold=1.0)
+Softmax = _act_layer("Softmax", "softmax", axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax", axis=-1)
+GLU = _act_layer("GLU", "glu", axis=-1)
+Maxout = _act_layer("Maxout", "maxout", groups=2, axis=1)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1. / 8., upper=1. / 3., name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+# ---------------------------------------------------------------------------
+# Containers (reference python/paddle/nn/layer/container.py)
+# ---------------------------------------------------------------------------
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], OrderedDict):
+            for name, l in layers[0].items():
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                if isinstance(l, tuple):
+                    self.add_sublayer(l[0], l[1])
+                else:
+                    self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, (dict, OrderedDict)) \
+            else sublayers
+        for k, v in items:
+            self.add_sublayer(k, v)

@@ -1,0 +1,13 @@
+"""Eager op library (the reference's operators/ + python/paddle/tensor analog).
+
+Importing this package installs Tensor method/operator patches.
+"""
+
+from .math_ops import *  # noqa: F401,F403
+from .manip_ops import *  # noqa: F401,F403
+from . import linalg_ops as linalg
+from .linalg_ops import (cholesky, det, dist, eig, eigh, inv, inverse,
+                         lstsq, lu, matrix_power, matrix_rank, multi_dot,
+                         norm, pinv, qr, slogdet, solve, svd,
+                         triangular_solve)
+from . import patch as _patch  # noqa: F401  (installs Tensor methods)

@@ -1,0 +1,201 @@
+"""Eager op surface: linear algebra.
+
+Analog of /root/reference/paddle/fluid/operators/{matmul_v2,cholesky,svd,
+inverse,...}_op.cc and python/paddle/tensor/linalg.py. Dense decompositions
+lower to XLA's native LAPACK-style custom calls (QR/Cholesky/SVD all have
+TPU lowerings via jax.numpy.linalg).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "cholesky", "inv", "pinv", "svd", "qr", "lu", "matrix_power", "det",
+    "slogdet", "solve", "triangular_solve", "cholesky_solve", "lstsq",
+    "eig", "eigh", "eigvals", "eigvalsh", "norm", "dist", "cond",
+    "matrix_rank", "multi_dot", "cov", "corrcoef", "householder_product",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(x):
+        l = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply("cholesky", f, (_t(x),))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, (_t(x),))
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda x: jnp.linalg.pinv(x, rtol=rcond,
+                                                   hermitian=hermitian),
+                 (_t(x),))
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd",
+                 lambda x: jnp.linalg.svd(x, full_matrices=full_matrices),
+                 (_t(x),), n_outputs=3)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply("qr", lambda x: jnp.linalg.qr(x, mode=mode), (_t(x),),
+                 n_outputs=2)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(x):
+        lu_, piv = jax.scipy.linalg.lu_factor(x)
+        return lu_, piv.astype(jnp.int32)
+    outs = apply("lu", f, (_t(x),), n_outputs=2)
+    if get_infos:
+        info = to_tensor(np.zeros(_t(x).shape[:-2], np.int32))
+        return (*outs, info)
+    return outs
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power",
+                 lambda x: jnp.linalg.matrix_power(x, n), (_t(x),))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, (_t(x),))
+
+
+def slogdet(x, name=None):
+    def f(x):
+        sign, logdet = jnp.linalg.slogdet(x)
+        return jnp.stack([sign, logdet], axis=0)
+    return apply("slogdet", f, (_t(x),))
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return apply("solve", f, (_t(x), _t(y)))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", f, (_t(x), _t(y)))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply("cholesky_solve", f, (_t(x), _t(y)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+    return apply("lstsq", f, (_t(x), _t(y)), n_outputs=4)
+
+
+def eig(x, name=None):
+    # General (non-symmetric) eig has no TPU lowering; run on host like the
+    # reference runs LAPACK on CPU for the same op.
+    arr = _t(x).numpy()
+    w, v = np.linalg.eig(arr)
+    return to_tensor(w), to_tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda x: jnp.linalg.eigh(x, UPLO=UPLO), (_t(x),),
+                 n_outputs=2)
+
+
+def eigvals(x, name=None):
+    arr = _t(x).numpy()
+    return to_tensor(np.linalg.eigvals(arr))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO),
+                 (_t(x),))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(x):
+        if p in (None, "fro") and axis is None:
+            return jnp.sqrt(jnp.sum(x * x))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p in (None, "fro"):
+            return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply("norm", f, (_t(x),))
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_t(x) - _t(y), p=p)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda x: jnp.linalg.cond(x, p=p), (_t(x),))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def f(x):
+        return jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int64)
+    return apply("matrix_rank", f, (_t(x),))
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs),
+                 tuple(_t(e) for e in x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(x):
+        return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+    return apply("cov", f, (_t(x),))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda x: jnp.corrcoef(x, rowvar=rowvar),
+                 (_t(x),))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, tau):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, (*a.shape[:-2], m, m)).copy() \
+            if a.ndim > 2 else q
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype),
+                                 a[..., i + 1:, i]], axis=-1)
+            h = jnp.eye(m, dtype=a.dtype) - tau[..., i, None, None] * \
+                (v[..., :, None] * v[..., None, :])
+            q = q @ h
+        return q[..., :, :n]
+    return apply("householder_product", f, (_t(x), _t(tau)))

@@ -1,0 +1,630 @@
+"""Eager op surface: creation / manipulation / casting ops.
+
+Analog of the reference's tensor-manipulation operators
+(/root/reference/paddle/fluid/operators/{reshape_op.cc,transpose_op.cc,
+concat_op.cc,split_op.cc,gather_op.cc,scatter_op.cc,...}) and
+python/paddle/tensor/{creation.py,manipulation.py}.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core import dtype as dtypes
+from ..core.generator import next_key
+from ..core.tensor import Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+
+__all__ = []  # populated at bottom
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().reshape(-1)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+# -- creation -----------------------------------------------------------------
+
+def zeros(shape, dtype=None, name=None):
+    return to_tensor(jnp.zeros(_shape_list(shape),
+                               dtypes.convert_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return to_tensor(jnp.ones(_shape_list(shape), dtypes.convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return to_tensor(jnp.full(_shape_list(shape), fill_value,
+                              dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return to_tensor(jnp.zeros_like(_t(x).data,
+                                    dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    return to_tensor(jnp.ones_like(_t(x).data,
+                                   dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return to_tensor(jnp.full_like(_t(x).data, fill_value,
+                                   dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if builtins_all_int(start, end, step)
+                 else dtypes.get_default_dtype())
+    return to_tensor(jnp.arange(start, end, step, dtypes.convert_dtype(dtype)))
+
+
+def builtins_all_int(*vals):
+    return all(isinstance(v, (int, np.integer)) for v in vals)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return to_tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                  dtype=dtypes.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return to_tensor(jnp.logspace(start, stop, int(num), base=base,
+                                  dtype=dtypes.convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return to_tensor(jnp.eye(num_rows, num_columns,
+                             dtype=dtypes.convert_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(x):
+        if x.ndim == 1:
+            out = jnp.diag(x, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset,
+                               dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(x, offset=offset)
+    return apply("diag", f, (_t(x),))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda x: jnp.diagflat(x, k=offset), (_t(x),))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = apply("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                 tuple(_t(x) for x in ts), n_outputs=len(ts))
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda x: jnp.tril(x, k=diagonal), (_t(x),))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda x: jnp.triu(x, k=diagonal), (_t(x),))
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def assign(x, output=None):
+    val = _t(x)
+    out = apply("assign", lambda x: x + jnp.zeros((), x.dtype), (val,))
+    if output is not None:
+        output._replace_impl(out)
+        return output
+    return out
+
+
+# -- random creation ----------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    return to_tensor(jax.random.normal(next_key(), _shape_list(shape), dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = _t(mean) if isinstance(mean, Tensor) else mean
+        s = _t(std) if isinstance(std, Tensor) else std
+        base_shape = (m.shape if isinstance(m, Tensor) else s.shape)
+        noise = jax.random.normal(next_key(), tuple(base_shape),
+                                  dtypes.get_default_dtype())
+        m_ = m.data if isinstance(m, Tensor) else m
+        s_ = s.data if isinstance(s, Tensor) else s
+        return to_tensor(m_ + s_ * noise)
+    dt = dtypes.get_default_dtype()
+    return to_tensor(mean + std * jax.random.normal(
+        next_key(), _shape_list(shape if shape is not None else [1]), dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = dtypes.convert_dtype(dtype)
+    key = jax.random.fold_in(jax.random.key(seed), 0) if seed else next_key()
+    return to_tensor(jax.random.uniform(key, _shape_list(shape), dt,
+                                        minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return to_tensor(jax.random.randint(next_key(), _shape_list(shape),
+                                        low, high,
+                                        dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = _t(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return to_tensor(jax.random.permutation(next_key(), n)
+                     .astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    x = _t(x)
+    return to_tensor(jax.random.bernoulli(next_key(), x.data)
+                     .astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = _t(x)
+    logits = jnp.log(jnp.clip(x.data, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(*x.data.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for without-replacement sampling.
+        g = jax.random.gumbel(next_key(), x.data.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return to_tensor(out.astype(jnp.int64))
+
+
+# -- manipulation -------------------------------------------------------------
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    return apply("cast", lambda x: x.astype(dt), (_t(x),))
+
+
+def reshape(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply("reshape", lambda x: jnp.reshape(x, s), (_t(x),))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace_impl(out)
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", lambda x: jnp.transpose(x, perm), (_t(x),))
+
+
+def t(x, name=None):
+    x = _t(x)
+    if x.ndim < 2:
+        return x
+    return apply("t", lambda x: jnp.swapaxes(x, -1, -2), (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis",
+                 lambda x: jnp.moveaxis(x, source, destination), (_t(x),))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda x: jnp.swapaxes(x, axis0, axis1), (_t(x),))
+
+
+transpose_ = transpose
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def f(x):
+        shape = x.shape
+        new = shape[:sa] + (-1,) + shape[ea + 1:]
+        return jnp.reshape(x, new)
+    return apply("flatten", f, (x,))
+
+
+def squeeze(x, axis=None, name=None):
+    ax = None
+    if axis is not None:
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        x_ = _t(x)
+        ax = tuple(a for a in ax if x_.shape[a % x_.ndim] == 1)
+    return apply("squeeze", lambda x: jnp.squeeze(x, axis=ax), (_t(x),))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply("unsqueeze", lambda x: jnp.expand_dims(x, ax), (_t(x),))
+
+
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("concat", lambda *xs: jnp.concatenate(xs, axis=axis),
+                 tuple(_t(e) for e in x))
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda *xs: jnp.stack(xs, axis=axis),
+                 tuple(_t(e) for e in x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = dim - builtins_sum(s for s in sizes if s >= 0)
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(x):
+        return tuple(jax.lax.slice_in_dim(x, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    outs = apply("split", f, (x,), n_outputs=len(sizes))
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0):
+    x = _t(input)
+    n = x.shape[axis]
+
+    def f(x):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(x, n, axis=axis))
+    outs = apply("unbind", f, (x,), n_outputs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_list(repeat_times)
+    return apply("tile", lambda x: jnp.tile(x, reps), (_t(x),))
+
+
+def expand(x, shape, name=None):
+    s = _shape_list(shape)
+    x = _t(x)
+
+    def f(x):
+        target = list(s)
+        # -1 entries keep original size (paddle semantics)
+        offset = len(target) - x.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = x.shape[i - offset]
+        return jnp.broadcast_to(x, target)
+    return apply("expand", f, (x,))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, _t(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply("broadcast_to", lambda x: jnp.broadcast_to(x, s), (_t(x),))
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(_t(i).shape) for i in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(i, list(out_shape)) for i in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda x: jnp.flip(x, axis=ax), (_t(x),))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda x: jnp.rot90(x, k=k, axes=tuple(axes)),
+                 (_t(x),))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda x: jnp.roll(x, shifts, axis=axis), (_t(x),))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(x, i):
+        return jnp.take(x, i.reshape(-1) if i.ndim > 1 else i, axis=axis)
+    return apply("gather", f, (_t(x), _t(index)))
+
+
+def gather_nd(x, index, name=None):
+    def f(x, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return x[flat_idx]
+    return apply("gather_nd", f, (_t(x), _t(index)))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply("take_along_axis",
+                 lambda x, i: jnp.take_along_axis(x, i, axis=axis),
+                 (_t(arr), _t(indices)))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    def f(x, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(x.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(x, i, v, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+        dn = jax.lax.ScatterDimensionNumbers  # fall back to take/segment ops
+        # jnp lacks reduce modes for put_along_axis; emulate with at[] scatter.
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1
+                                      for k in range(x.ndim)])
+               for d, s in enumerate(i.shape)]
+        idx[axis] = i
+        if mode == "add":
+            return x.at[tuple(idx)].add(v)
+        return x.at[tuple(idx)].multiply(v)
+    return apply("put_along_axis", f, (_t(arr), _t(indices), _t(values)))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(x, i, u):
+        if overwrite:
+            return x.at[i].set(u)
+        # paddle semantics for overwrite=False: zero the rows then add
+        z = x.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply("scatter", f, (_t(x), _t(index), _t(updates)))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._replace_impl(out)
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(x, idx, u):
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return x.at[flat_idx].add(u)
+    return apply("scatter_nd_add", f, (_t(x), _t(index), _t(updates)))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    u = _t(updates)
+    return scatter_nd_add(zeros(shape, u.dtype), index, updates)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(x, i, v):
+        xm = jnp.moveaxis(x, axis, 0)
+        out = xm.at[i].add(jnp.moveaxis(v, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", f, (_t(x), _t(index), _t(value)))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(x, v, *idx):
+        if accumulate:
+            return x.at[tuple(idx)].add(v)
+        return x.at[tuple(idx)].set(v)
+    return apply("index_put", f,
+                 (_t(x), _t(value), *[_t(i) for i in indices]))
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return apply("masked_fill",
+                     lambda x, m, v: jnp.where(m, v.astype(x.dtype), x),
+                     (_t(x), _t(mask), value))
+    return apply("masked_fill", lambda x, m: jnp.where(m, value, x),
+                 (_t(x), _t(mask)))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def f(x):
+        n = builtins_min(x.shape[-2], x.shape[-1])
+        i = jnp.arange(n)
+        return x.at[..., i, i].set(value)
+    return apply("fill_diagonal", f, (_t(x),))
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats.numpy()
+        arr = _t(x).numpy()
+        return to_tensor(np.repeat(arr, reps, axis=axis))
+    return apply("repeat_interleave",
+                 lambda x: jnp.repeat(x, repeats, axis=axis), (_t(x),))
+
+
+def slice(input, axes, starts, ends):
+    def _v(vs):
+        return [int(v.item()) if isinstance(v, Tensor) else int(v) for v in vs]
+    axes, starts, ends = list(axes), _v(starts), _v(ends)
+    x = _t(input)
+
+    def f(x):
+        idx = [builtins_slice(None)] * x.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return x[tuple(idx)]
+    return apply("slice", f, (x,))
+
+
+import builtins as _builtins  # noqa: E402
+builtins_slice = _builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+
+    def f(x):
+        idx = [builtins_slice(None)] * x.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(s, e, st)
+        return x[tuple(idx)]
+    return apply("strided_slice", f, (x,))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shape = _shape_list(shape)
+    offsets = _shape_list(offsets) if offsets is not None else [0] * x.ndim
+
+    def f(x):
+        sizes = [sh if sh != -1 else x.shape[d] - off
+                 for d, (sh, off) in enumerate(zip(shape, offsets))]
+        return jax.lax.dynamic_slice(x, offsets, sizes)
+    return apply("crop", f, (x,))
+
+
+def numel(x, name=None):
+    return to_tensor(int(np.prod(_t(x).shape)) if _t(x).ndim else 1)
+
+
+def shape(input):
+    return to_tensor(np.asarray(_t(input).shape, dtype=np.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+
+    def f(x):
+        shard = x // size
+        local = jnp.where(shard == shard_id, x % size, ignore_value)
+        return local
+    return apply("shard_index", f, (_t(input),))
+
+
+def as_complex(x, name=None):
+    return apply("as_complex",
+                 lambda x: jax.lax.complex(x[..., 0], x[..., 1]), (_t(x),))
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1),
+                 (_t(x),))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply("view", lambda x: x.view(dtypes.convert_dtype(shape_or_dtype)),
+                 (_t(x),))
+
+
+def atleast_1d(*inputs):
+    outs = [apply("atleast_1d", jnp.atleast_1d, (_t(x),)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply("atleast_2d", jnp.atleast_2d, (_t(x),)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply("atleast_3d", jnp.atleast_3d, (_t(x),)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+__all__ = sorted(
+    k for k, v in list(globals().items())
+    if callable(v) and not k.startswith("_") and
+    getattr(v, "__module__", "") == __name__ and
+    not k.startswith("builtins"))

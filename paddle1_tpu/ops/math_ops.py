@@ -1,0 +1,503 @@
+"""Eager op surface: math / reduction / comparison ops.
+
+TPU-native analog of the reference operator library's user-visible math ops
+(/root/reference/paddle/fluid/operators/elementwise/, reduce_ops/,
+activation_op.cc, matmul_v2_op.cc, ...) and the Python wrappers in
+python/paddle/tensor/math.py. Each op is one pure jnp function routed through
+``autograd.engine.apply``, which supplies the backward rule via jax.vjp — the
+554-op C++ registry with hand-written grad kernels collapses into this table.
+"""
+
+from __future__ import annotations
+
+import math as _pymath
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor
+from ..core.errors import InvalidArgumentError
+
+__all__ = []  # populated at bottom
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _unary(opname, jfn):
+    def op(x, name=None):
+        return apply(opname, jfn, (_t(x),))
+    op.__name__ = opname
+    return op
+
+
+def _binary(opname, jfn):
+    def op(x, y, name=None):
+        if isinstance(y, Tensor) and not isinstance(x, Tensor):
+            x = to_tensor(x, dtype=y.dtype)
+        x = _t(x)
+        return apply(opname, jfn, (x, y))
+    op.__name__ = opname
+    return op
+
+
+# -- elementwise binary -------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", lambda x, y: x * (2.0 ** y))
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", lambda x, y: jnp.outer(x, y))
+kron = _binary("kron", jnp.kron)
+cross = _binary("cross", jnp.cross)
+dot = _binary("dot", lambda x, y: (x * y).sum(-1) if x.ndim > 1 else jnp.dot(x, y))
+mv = _binary("mv", jnp.matmul)
+
+# -- elementwise unary --------------------------------------------------------
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+i1 = _unary("i1", jax.scipy.special.i1)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isfinite = _unary("isfinite", jnp.isfinite)
+isinf = _unary("isinf", jnp.isinf)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def logit(x, eps=None, name=None):
+    def f(x):
+        xx = jnp.clip(x, eps, 1 - eps) if eps else x
+        return jnp.log(xx / (1 - xx))
+    return apply("logit", f, (_t(x),))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a),
+                     (_t(x), _t(y), weight))
+    return apply("lerp", lambda a, b: a + weight * (b - a), (_t(x), _t(y)))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply("clip", lambda x: jnp.clip(x, lo, hi), (_t(x),))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(x):
+        y = x * scale + bias if bias_after_scale else (x + bias) * scale
+        return y
+    out = apply("scale", f, (_t(x),))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda x: scale_b * jnp.tanh(scale_a * x), (_t(x),))
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+            axis=0)[0]
+    return apply("multiplex", f, (_t(index).astype("int32"),
+                                  *[_t(x) for x in inputs]))
+
+
+# -- matmul family ------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", f, (_t(x), _t(y)))
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, (_t(x), _t(y)))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm",
+                 lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 (_t(input), _t(x), _t(y)))
+
+
+def einsum(equation, *operands):
+    return apply("einsum", lambda *xs: jnp.einsum(equation, *xs),
+                 tuple(_t(o) for o in operands))
+
+
+def matmul_int8(x, y, name=None):  # quantized matmul entry point
+    return apply("matmul_int8",
+                 lambda a, b: jax.lax.dot_general(
+                     a, b, (((a.ndim - 1,), (0,)), ((), ())),
+                     preferred_element_type=jnp.int32),
+                 (_t(x), _t(y)))
+
+
+# -- reductions ---------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def _reduce(opname, jfn, dtype_cast=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+
+        def f(x):
+            y = jfn(x, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                y = y.astype(dtypes.convert_dtype(dtype))
+            return y
+        return apply(opname, f, (_t(x),))
+    op.__name__ = opname
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+logsumexp = _reduce("logsumexp",
+                    lambda x, axis, keepdims:
+                    jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply("std", lambda x: jnp.std(x, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), (_t(x),))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply("var", lambda x: jnp.var(x, axis=ax, ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), (_t(x),))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply("median",
+                 lambda x: jnp.median(x, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    ax = _norm_axis(axis)
+    return apply("quantile",
+                 lambda x: jnp.quantile(x, jnp.asarray(q), axis=ax,
+                                        keepdims=keepdim), (_t(x),))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(x):
+        y = jnp.cumsum(x.reshape(-1) if axis is None else x,
+                       axis=0 if axis is None else axis)
+        return y.astype(dtypes.convert_dtype(dtype)) if dtype else y
+    return apply("cumsum", f, (_t(x),))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(x):
+        y = jnp.cumprod(x.reshape(-1) if dim is None else x,
+                        axis=0 if dim is None else dim)
+        return y.astype(dtypes.convert_dtype(dtype)) if dtype else y
+    return apply("cumprod", f, (_t(x),))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else axis
+    xt = _t(x) if axis is not None else reshape(_t(x), [-1])
+    v = apply("cummax", lambda x: jax.lax.associative_scan(
+        jnp.maximum, x, axis=ax), (xt,))
+    return v
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def f(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return apply("add_n", f, tuple(_t(x) for x in inputs))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda x: jnp.trace(x, offset=offset, axis1=axis1,
+                                              axis2=axis2), (_t(x),))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal",
+                 lambda x: jnp.diagonal(x, offset=offset, axis1=axis1,
+                                        axis2=axis2), (_t(x),))
+
+
+# -- comparison / logical -----------------------------------------------------
+
+equal = _binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binary("not_equal", jnp.not_equal)
+greater_than = _binary("greater_than", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+less_than = _binary("less_than", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+logical_not = _unary("logical_not", jnp.logical_not)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda x, y: jnp.array_equal(x, y),
+                 (_t(x), _t(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose",
+                 lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 (_t(x), _t(y)))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose",
+                 lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 (_t(x), _t(y)))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda c, x, y: jnp.where(c, x, y),
+                 (_t(condition), _t(x), _t(y)))
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic output shape: eager-only (document as such, like the
+    # reference's LoD-producing ops which were CPU-bound too).
+    arr = np.asarray(_t(x).numpy())
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(to_tensor(i.astype(np.int64)) for i in idx)
+    return to_tensor(np.stack(idx, axis=1).astype(np.int64))
+
+
+# -- search / sort ------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(x):
+        y = jnp.argmax(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else axis,
+                       keepdims=keepdim if axis is not None else False)
+        return y.astype(dtypes.convert_dtype(dtype))
+    return apply("argmax", f, (_t(x),))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(x):
+        y = jnp.argmin(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else axis,
+                       keepdims=keepdim if axis is not None else False)
+        return y.astype(dtypes.convert_dtype(dtype))
+    return apply("argmin", f, (_t(x),))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(x):
+        idx = jnp.argsort(x, axis=axis, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply("argsort", f, (_t(x),))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply("sort",
+                 lambda x: jnp.sort(x, axis=axis, descending=descending),
+                 (_t(x),))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(x):
+        xs = jnp.moveaxis(x, axis, -1)
+        if largest:
+            v, i = jax.lax.top_k(xs, k)
+        else:
+            v, i = jax.lax.top_k(-xs, k)
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis).astype(jnp.int64)
+    return apply("topk", f, (_t(x),), n_outputs=2)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply("searchsorted", f, (_t(sorted_sequence), _t(values)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = _t(x).numpy()
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return to_tensor(res)
+    outs = [to_tensor(res[0])]
+    for extra in res[1:]:
+        outs.append(to_tensor(extra.astype(np.int64)))
+    return tuple(outs)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = _t(x).numpy()
+    w = weights.numpy() if isinstance(weights, Tensor) else weights
+    return to_tensor(np.bincount(arr, weights=w, minlength=minlength))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    arr = _t(input).numpy()
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return to_tensor(hist.astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    arr = _t(x).numpy()
+    m = _t(mask).numpy().astype(bool)
+    return to_tensor(arr[m])
+
+
+def index_sample(x, index):
+    return apply("index_sample",
+                 lambda x, i: jnp.take_along_axis(x, i, axis=1),
+                 (_t(x), _t(index)))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select",
+                 lambda x, i: jnp.take(x, i, axis=axis), (_t(x), _t(index)))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(x):
+        xs = jnp.sort(jnp.moveaxis(x, axis, -1), axis=-1)
+        n = xs.shape[-1]
+        eq = (xs[..., None, :] == xs[..., :, None]).sum(-1)
+        best = jnp.argmax(eq, axis=-1)
+        vals = jnp.take_along_axis(xs, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(jnp.moveaxis(x, axis, -1) == vals[..., None], axis=-1)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply("mode", f, (_t(x),), n_outputs=2)
+
+
+# -- reexport helpers used above ---------------------------------------------
+from .manip_ops import reshape  # noqa: E402  (circular-safe: late import)
+
+__all__ = [k for k, v in list(globals().items())
+           if callable(v) and not k.startswith("_") and
+           getattr(v, "__module__", "").endswith(("math_ops",))]
+__all__ += ["matmul", "einsum", "where", "clip", "topk", "sort", "argsort"]
+__all__ = sorted(set(__all__) - {"Tensor", "to_tensor", "apply", "reshape"})

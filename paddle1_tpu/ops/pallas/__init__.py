@@ -1,0 +1,7 @@
+"""Pallas TPU kernels (the analog of the reference's hand-fused CUDA kernels
+in /root/reference/paddle/fluid/operators/fused/): flash attention, fused
+layer_norm, fused softmax, fused adam, ring attention.
+
+Each kernel module exposes ``supported(...)`` gates so callers fall back to
+plain XLA compositions on CPU/interpret mode or unaligned shapes.
+"""

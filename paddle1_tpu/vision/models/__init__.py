@@ -1,0 +1,3 @@
+"""Vision models (reference python/paddle/vision/models/)."""
+
+from .lenet import LeNet

@@ -1,0 +1,270 @@
+"""nn layer correctness: shapes, gradients, state_dict, hooks."""
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu import nn
+from op_test import OpTest
+
+F = nn.functional
+
+
+class TestLinearConv(OpTest):
+    def test_linear_matches_manual(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        x = np.random.randn(2, 4).astype(np.float32)
+        out = lin(paddle.to_tensor(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_conv2d_matches_torch_semantics(self):
+        # reference semantics: NCHW, weight [out,in,kh,kw]
+        import jax
+        paddle.seed(0)
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [2, 8, 8, 8]
+        out.sum().backward()
+        assert conv.weight.grad is not None
+        assert conv.bias.grad is not None
+
+    def test_conv_grad_numeric(self):
+        w = np.random.randn(2, 1, 3, 3).astype(np.float32) * 0.5
+        x = np.random.randn(1, 1, 6, 6).astype(np.float32)
+        self.check_grad(
+            lambda xi, wi: F.conv2d(xi, wi, padding=1),
+            [x, w], grad_input_idx=(0, 1), delta=1e-2, rtol=5e-2, atol=5e-3)
+
+    def test_conv2d_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        x = paddle.to_tensor(np.random.randn(1, 4, 8, 8).astype(np.float32))
+        out = deconv(x)
+        assert out.shape == [1, 2, 15, 15], out.shape
+
+    def test_depthwise_groups(self):
+        conv = nn.Conv2D(4, 4, 3, groups=4, padding=1)
+        x = paddle.to_tensor(np.random.randn(1, 4, 5, 5).astype(np.float32))
+        assert conv(x).shape == [1, 4, 5, 5]
+
+
+class TestNorms(OpTest):
+    def test_layer_norm_stats(self):
+        ln = nn.LayerNorm(16)
+        x = np.random.randn(4, 16).astype(np.float32) * 3 + 1
+        out = ln(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+    def test_batch_norm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 5
+        bn.train()
+        y = bn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y.mean((0, 2, 3)), np.zeros(3), atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        y2 = bn(paddle.to_tensor(x))
+        assert y2.shape == [8, 3, 4, 4]
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.to_tensor(np.random.randn(2, 4, 5, 5).astype(np.float32))
+        assert gn(x).shape == [2, 4, 5, 5]
+
+    def test_rms_norm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.to_tensor(np.random.randn(3, 8).astype(np.float32))
+        out = rn(x)
+        assert out.shape == [3, 8]
+
+
+class TestActivationsPooling(OpTest):
+    def test_activations(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(paddle.to_tensor(x.reshape(1, -1))).numpy().sum(),
+            1.0, rtol=1e-5)
+        self.check_grad(F.gelu, [np.random.randn(5).astype(np.float32)])
+
+    def test_pools(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        np.testing.assert_array_equal(out.numpy().reshape(2, 2),
+                                      [[5, 7], [13, 15]])
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy().reshape(2, 2),
+                                   [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_pool(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 7, 7).astype(np.float32))
+        out = F.adaptive_avg_pool2d(x, 3)
+        assert out.shape == [1, 2, 3, 3]
+
+
+class TestEmbeddingDropout(OpTest):
+    def test_embedding_lookup_and_grad(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        out.sum().backward()
+        g = emb.weight.grad.numpy()
+        # row 1 used twice
+        np.testing.assert_allclose(g[1], 2 * np.ones(4))
+        np.testing.assert_allclose(g[5], np.zeros(4))
+
+    def test_dropout_modes(self):
+        paddle.seed(7)
+        x = paddle.to_tensor(np.ones((1000,), np.float32))
+        out = F.dropout(x, p=0.5, training=True)
+        kept = out.numpy()
+        frac = (kept != 0).mean()
+        assert 0.4 < frac < 0.6
+        np.testing.assert_allclose(kept[kept != 0], 2.0, rtol=1e-6)
+        out_eval = F.dropout(x, p=0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), 1.0)
+
+
+class TestRNN(OpTest):
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.to_tensor(np.random.randn(4, 5, 8).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 5, 16]
+        assert h.shape == [2, 4, 16]
+        assert c.shape == [2, 4, 16]
+        out.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_bidirectional_gru(self):
+        gru = nn.GRU(4, 6, direction="bidirect")
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype(np.float32))
+        out, h = gru(x)
+        assert out.shape == [2, 3, 12]
+        assert h.shape == [2, 2, 6]
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        out, (h, c) = cell(x)
+        assert out.shape == [2, 8]
+
+
+class TestTransformer(OpTest):
+    def test_mha_forward_backward(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_encoder_layer(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.to_tensor(np.random.randn(2, 4, 16).astype(np.float32))
+        tgt = paddle.to_tensor(np.random.randn(2, 3, 16).astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestLayerProtocol(OpTest):
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        for (k1, v1), (k2, v2) in zip(sorted(net.state_dict().items()),
+                                      sorted(net2.state_dict().items())):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.to_tensor(np.zeros((1, 2), np.float32)))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.to_tensor(np.zeros((1, 2), np.float32)))
+        assert calls == [1]
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_named_parameters_unique(self):
+        shared = nn.Linear(3, 3)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = shared
+                self.b = shared
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert len(names) == 2  # shared params counted once
+
+
+class TestLosses(OpTest):
+    def test_cross_entropy_matches_manual(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4], np.int64)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels))
+        # manual
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(out.item(), ref, rtol=1e-5)
+
+    def test_mse_and_l1(self):
+        a = np.random.randn(6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits_stable(self):
+        x = np.array([100.0, -100.0, 0.0], np.float32)
+        y = np.array([1.0, 0.0, 1.0], np.float32)
+        out = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(y))
+        assert np.isfinite(out.item())
+
+    def test_ignore_index(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        labels = np.array([1, -100, 2], np.int64)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels), ignore_index=-100)
+        mask = labels != -100
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(3), np.clip(labels, 0, None)])[mask].mean()
+        np.testing.assert_allclose(out.item(), ref, rtol=1e-4)

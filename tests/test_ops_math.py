@@ -1,0 +1,195 @@
+"""Math-op correctness + gradient checks (OpTest-style, SURVEY §4)."""
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from op_test import OpTest
+
+
+class TestElementwise(OpTest):
+    def test_add_broadcast(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        self.check_output(paddle.add, np.add, [a, b])
+        self.check_grad(paddle.add, [a, b], grad_input_idx=(0, 1))
+
+    def test_mul_div(self):
+        a = np.random.rand(2, 3).astype(np.float32) + 0.5
+        b = np.random.rand(2, 3).astype(np.float32) + 0.5
+        self.check_output(paddle.multiply, np.multiply, [a, b])
+        self.check_output(paddle.divide, np.divide, [a, b])
+        self.check_grad(paddle.divide, [a, b], grad_input_idx=(0, 1))
+
+    def test_scalar_ops(self):
+        a = np.random.randn(5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose((t + 2.0).numpy(), a + 2.0, rtol=1e-6)
+        np.testing.assert_allclose((2.0 * t).numpy(), 2.0 * a, rtol=1e-6)
+        np.testing.assert_allclose((1.0 / (t + 10)).numpy(), 1.0 / (a + 10),
+                                   rtol=1e-6)
+        np.testing.assert_allclose((t ** 2).numpy(), a ** 2, rtol=1e-6)
+
+    def test_unary(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 0.1
+        self.check_output(paddle.exp, np.exp, [a])
+        self.check_output(paddle.log, np.log, [a], rtol=5e-4, atol=1e-5)
+        self.check_output(paddle.sqrt, np.sqrt, [a])
+        self.check_output(paddle.tanh, np.tanh, [a])
+        self.check_grad(paddle.tanh, [a])
+        self.check_grad(paddle.exp, [a])
+
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        self.check_output(paddle.matmul, np.matmul, [a, b], rtol=1e-4)
+        self.check_grad(paddle.matmul, [a, b], grad_input_idx=(0, 1))
+
+    def test_matmul_transpose(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(5, 4).astype(np.float32)
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True, transpose_y=True)
+        np.testing.assert_allclose(got.numpy(), a.T @ b.T, rtol=1e-4)
+
+
+class TestReduce(OpTest):
+    def test_sum_mean(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        self.check_output(paddle.sum, lambda x: x.sum(), [a], rtol=1e-4)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(),
+                                   a.sum(1), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.mean(t, axis=[0, 2], keepdim=True).numpy(),
+            a.mean((0, 2), keepdims=True), rtol=1e-4)
+
+    def test_max_min_grad(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        self.check_grad(paddle.max, [a])
+        self.check_output(paddle.min, lambda x: x.min(), [a])
+
+    def test_logsumexp(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as np_lse
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            paddle.logsumexp(t, axis=1).numpy(), np_lse(a, axis=1),
+            rtol=1e-5)
+
+
+class TestCompareLogic(OpTest):
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((ta < tb).numpy(), a < b)
+        np.testing.assert_array_equal((ta >= tb).numpy(), a >= b)
+        np.testing.assert_array_equal(
+            paddle.equal_all(ta, ta).numpy(), True)
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a = np.ones(3, np.float32)
+        b = np.zeros(3, np.float32)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_array_equal(out.numpy(), np.where(c, a, b))
+
+
+class TestSearchSort(OpTest):
+    def test_argmax_topk(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(),
+                                      a.argmax(1))
+        vals, idx = paddle.topk(t, k=3, axis=1)
+        ref = np.sort(a, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_sort(self):
+        a = np.random.randn(5, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sort(t, axis=0).numpy(),
+                                   np.sort(a, 0), rtol=1e-6)
+
+
+class TestLinalg(OpTest):
+    def test_cholesky_inv(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        t = paddle.to_tensor(spd)
+        np.testing.assert_allclose(paddle.linalg.cholesky(t).numpy(),
+                                   np.linalg.cholesky(spd), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(t).numpy(),
+                                   np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+    def test_norm(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.norm(t).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+
+
+class TestHooksAndEngine(OpTest):
+    def test_hook_scales_grad(self):
+        w = paddle.Parameter(np.ones(3, np.float32))
+        w.register_hook(lambda g: g * 2.0)
+        (w.sum() * 3.0).backward()
+        np.testing.assert_allclose(w.grad.numpy(), np.full(3, 6.0),
+                                   rtol=1e-6)
+
+    def test_grad_accumulation(self):
+        w = paddle.Parameter(np.ones(2, np.float32))
+        (w.sum()).backward()
+        (w.sum() * 2).backward()
+        np.testing.assert_allclose(w.grad.numpy(), np.full(2, 3.0))
+
+    def test_no_grad(self):
+        w = paddle.Parameter(np.ones(2, np.float32))
+        with paddle.no_grad():
+            y = w * 5
+        assert y.stop_gradient
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)
+        assert x.grad is None  # PartialGradEngine must not touch .grad
+
+    def test_detach_breaks_graph(self):
+        w = paddle.Parameter(np.ones(2, np.float32))
+        y = (w * 2).detach()
+        z = y * 3
+        assert z.stop_gradient
+
+    def test_multi_output_op_grad(self):
+        a = np.random.randn(6).astype(np.float32)
+        t = paddle.to_tensor(a, stop_gradient=False)
+        parts = paddle.split(t, 2)
+        (parts[0].sum() + 2 * parts[1].sum()).backward()
+        expect = np.concatenate([np.ones(3), 2 * np.ones(3)])
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
+
+class TestPyLayer(OpTest):
+    def test_custom_vjp(self):
+        from paddle1_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = Double.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])

@@ -1,0 +1,141 @@
+"""End-to-end training tests: capability config 1 (MNIST-shaped LeNet,
+eager, single chip) with DataLoader, optimizer, checkpoint — the "one model
+milestone" of SURVEY §7 stage 3."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu import nn
+from paddle1_tpu.io import DataLoader, Dataset
+
+
+class SyntheticMNIST(Dataset):
+    """Deterministic separable 28x28 problem (stands in for MNIST; the image
+    has no network egress)."""
+
+    def __init__(self, n=256, num_classes=10, seed=0):
+        rng = np.random.RandomState(seed)
+        self.labels = rng.randint(0, num_classes, n).astype(np.int64)
+        base = rng.randn(num_classes, 1, 28, 28).astype(np.float32)
+        self.images = (base[self.labels] +
+                       0.3 * rng.randn(n, 1, 28, 28).astype(np.float32))
+
+    def __getitem__(self, i):
+        return self.images[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+def test_lenet_learns():
+    paddle.seed(0)
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-3)
+    loader = DataLoader(SyntheticMNIST(128), batch_size=32, shuffle=True)
+    loss_fn = nn.CrossEntropyLoss()
+    first = last = None
+    for epoch in range(3):
+        for img, label in loader:
+            logits = net(img)
+            loss = loss_fn(logits, label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = loss.item()
+            last = loss.item()
+    assert last < first * 0.7, (first, last)
+
+
+def test_sgd_momentum_converges_quadratic():
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=[w])
+    for _ in range(100):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((w * w).sum().item()) < 1e-3
+
+
+def test_checkpoint_roundtrip():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    net(x).sum().backward()
+    opt.step()
+    with tempfile.TemporaryDirectory() as d:
+        paddle.save(net.state_dict(), os.path.join(d, "model.pdparams"))
+        paddle.save(opt.state_dict(), os.path.join(d, "opt.pdopt"))
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+        net2.set_state_dict(paddle.load(os.path.join(d, "model.pdparams")))
+        opt2.set_state_dict(paddle.load(os.path.join(d, "opt.pdopt")))
+        y1 = net(x).numpy()
+        y2 = net2(x).numpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+    assert opt2._step_count == opt._step_count
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    w = paddle.Parameter(np.ones(1, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    lrs = []
+    for i in range(6):
+        (w.sum()).backward()
+        opt.step()
+        opt.clear_grad()
+        lrs.append(opt.get_lr())
+        sched.step()
+    assert lrs[0] == 0.1 and abs(lrs[2] - 0.05) < 1e-9, lrs
+
+
+def test_grad_clip_global_norm():
+    w = paddle.Parameter(np.array([10.0, 0.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                               grad_clip=clip)
+    (w * w).sum().backward()   # grad = [20, 0], norm 20
+    opt.step()
+    # update should be clipped to norm 1 → w ≈ [10-1, 0]
+    np.testing.assert_allclose(w.numpy(), [9.0, 0.0], atol=1e-4)
+
+
+def test_amp_autocast_and_scaler():
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        out = net(x)
+        assert str(out.dtype) == "bfloat16"
+        loss = out.astype("float32").mean()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    assert net.weight.grad is not None
+
+
+def test_hapi_model_fit():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(784, 10))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    ds = SyntheticMNIST(64)
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "acc" in res
