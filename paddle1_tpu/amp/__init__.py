@@ -142,6 +142,7 @@ class GradScaler:
                 found = True
             p.grad._data = g.astype(p.grad.data.dtype)
         self._found_inf = found
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:

@@ -238,7 +238,7 @@ class Parameter(Tensor):
     flag (reference framework.py:5557 Parameter / :5663 ParamBase)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "is_distributed")
+                 "is_distributed", "sharding_axes", "pp_stage")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable,
@@ -249,6 +249,12 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        # Per-dim mesh-axis names for pjit parameter sharding, e.g.
+        # (None, "mp") shards dim 1 over the model-parallel axis. Consumed
+        # by distributed.sharding_specs.collect_param_specs.
+        self.sharding_axes = None
+        # Pipeline stage this parameter belongs to (set by PipelineLayer).
+        self.pp_stage = None
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -1,9 +1,42 @@
 """paddle1_tpu.distributed — fleet-style distributed training over device
 meshes (reference python/paddle/distributed analog).
 
-Collective API, fleet facade, launchers, and hybrid-parallel layers land in
-build stage 5-6 (SURVEY §7); env/rank plumbing is live now.
+TPU-native architecture: one nd ``jax.sharding.Mesh`` with named axes
+(pp, dp, sharding, mp, sp) replaces the reference's NCCL ring registry;
+collectives are named-axis ops lowered by XLA to ICI; process bootstrap is
+the JAX coordination service instead of raw-TCP ncclUniqueId broadcast.
 """
 
 from . import env
-from .env import get_rank, get_world_size
+from .env import get_rank, get_world_size, spmd_axes, current_spmd_axis
+from .collective import (ReduceOp, Group, all_gather, all_gather_object,
+                         all_reduce, alltoall, all_to_all, barrier,
+                         broadcast, destroy_process_group, get_group,
+                         irecv, is_initialized, isend, new_group, recv,
+                         reduce, reduce_scatter, scatter, send, split, wait)
+from .parallel import (DataParallel, ParallelEnv, init_parallel_env)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       build_mesh, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import sharding_specs
+from .spawn import spawn
+
+
+def __getattr__(name):
+    # `launch` resolves lazily so `python -m paddle1_tpu.distributed.launch`
+    # doesn't trip runpy's already-imported warning.
+    if name == "launch":
+        from . import launch as _launch_mod
+        return _launch_mod.launch
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
+           "current_spmd_axis", "ReduceOp", "Group", "all_gather",
+           "all_gather_object", "all_reduce", "alltoall", "all_to_all",
+           "barrier", "broadcast", "destroy_process_group", "get_group",
+           "irecv", "is_initialized", "isend", "new_group", "recv",
+           "reduce", "reduce_scatter", "scatter", "send", "split", "wait",
+           "DataParallel", "ParallelEnv", "init_parallel_env",
+           "CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+           "sharding_specs", "spawn", "launch"]

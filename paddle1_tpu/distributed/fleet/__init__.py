@@ -1,0 +1,49 @@
+"""paddle1_tpu.distributed.fleet — the distributed-training façade
+(reference python/paddle/distributed/fleet/).
+
+Usage matches the reference:
+
+    import paddle1_tpu.distributed.fleet as fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+"""
+
+from .strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker, Role
+from .fleet_base import Fleet, fleet
+from ..meta_parallel import (ColumnParallelLinear, RowParallelLinear,
+                             VocabParallelEmbedding, ParallelCrossEntropy,
+                             LayerDesc, SharedLayerDesc, PipelineLayer,
+                             SegmentLayers)
+from .utils import recompute, fleet_util
+
+# module-level delegation to the singleton (the reference exposes
+# fleet.init etc. as module functions)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+distributed_scaler = fleet.distributed_scaler
+minimize = fleet.minimize
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+worker_endpoints = fleet.worker_endpoints
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+__all__ = ["DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "Role", "Fleet", "fleet", "init",
+           "distributed_model", "distributed_optimizer", "minimize",
+           "recompute", "fleet_util", "ColumnParallelLinear",
+           "RowParallelLinear", "VocabParallelEmbedding",
+           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc",
+           "PipelineLayer", "SegmentLayers"]

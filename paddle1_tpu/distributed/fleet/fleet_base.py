@@ -1,0 +1,199 @@
+"""Fleet façade — the user-facing distributed API.
+
+Analog of the reference's ``Fleet`` singleton
+(python/paddle/distributed/fleet/base/fleet_base.py:71 init, :712
+distributed_optimizer, :765 distributed_model, :1212 minimize). The
+reference's ``minimize`` chains *meta-optimizers* that each rewrite the
+ProgramDesc (insert AMP casts, recompute segments, c_allreduce ops,
+pipeline sections…). The TPU architecture replaces program rewriting with
+**sharding-rule composition**: ``fleet.init`` builds one nd device mesh from
+``hybrid_configs``; ``distributed_model``/``distributed_optimizer`` attach
+the right axis semantics (dp grad-sync, mp layer axes, sharded optimizer
+states); the XLA compiler then emits the collectives the reference's
+rewritten programs carried explicitly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...core.errors import PreconditionNotMetError
+from ...nn.layer_base import Layer
+from .. import env
+from ..parallel import DataParallel, init_parallel_env
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy import DistributedStrategy
+
+__all__ = ["Fleet", "fleet"]
+
+
+class Fleet:
+    """Singleton (reference fleet_base.py:71)."""
+
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._user_defined_strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = True,
+             strategy: Optional[DistributedStrategy] = None) -> "Fleet":
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._user_defined_strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+
+        hc = self._user_defined_strategy.hybrid_configs
+        import jax
+        n_dev = len(jax.devices())
+        degrees = {"dp": hc.get("dp_degree", 1), "mp": hc.get("mp_degree", 1),
+                   "pp": hc.get("pp_degree", 1),
+                   "sharding": hc.get("sharding_degree", 1),
+                   "sp": hc.get("sep_degree", 1)}
+        total = 1
+        for v in degrees.values():
+            total *= max(1, v)
+        if total == 1 and n_dev > 1:
+            degrees["dp"] = n_dev  # default: pure DP over every chip
+            total = n_dev
+        if total <= n_dev:
+            topo = CommunicateTopology(
+                ["pp", "dp", "sharding", "mp", "sp"],
+                [degrees["pp"], degrees["dp"], degrees["sharding"],
+                 degrees["mp"], degrees["sp"]])
+            from ..topology import build_mesh
+            mesh = build_mesh(dp=degrees["dp"], mp=degrees["mp"],
+                              pp=degrees["pp"],
+                              sharding=degrees["sharding"],
+                              sp=degrees["sp"],
+                              devices=jax.devices()[:total])
+            self._hcg = HybridCommunicateGroup(topo, mesh=mesh)
+            set_hybrid_communicate_group(self._hcg)
+        else:
+            raise PreconditionNotMetError(
+                f"hybrid_configs {degrees} need {total} devices, "
+                f"have {n_dev}")
+        self._is_initialized = True
+        return self
+
+    # -- role queries (reference fleet_base.py:340-510) ---------------------
+
+    def _ensure_init(self):
+        if not self._is_initialized:
+            raise PreconditionNotMetError(
+                "fleet.init() must be called first")
+
+    def is_first_worker(self) -> bool:
+        self._ensure_init()
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self) -> int:
+        self._ensure_init()
+        return self._role_maker.worker_index()
+
+    def worker_num(self) -> int:
+        self._ensure_init()
+        return self._role_maker.worker_num()
+
+    def is_worker(self) -> bool:
+        self._ensure_init()
+        return self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        self._ensure_init()
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self, to_string: bool = False):
+        self._ensure_init()
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        self._ensure_init()
+        self._role_maker._barrier()
+
+    # PS-mode entry points: accepted for API parity; the brpc parameter
+    # server has no ICI analog (SURVEY §7 hard part f)
+    def init_worker(self):
+        self._ensure_init()
+
+    def init_server(self, *args, **kwargs):
+        self._ensure_init()
+
+    def run_server(self):
+        raise PreconditionNotMetError(
+            "Parameter-server mode is not available in the TPU build; "
+            "use collective (is_collective=True) training")
+
+    def stop_worker(self):
+        pass
+
+    # -- the distributed wrappers ------------------------------------------
+
+    @property
+    def _strategy(self) -> DistributedStrategy:
+        return self._user_defined_strategy or DistributedStrategy()
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        self._ensure_init()
+        return self._hcg
+
+    def distributed_model(self, model: Layer):
+        """Wrap for the active parallelism mix (reference fleet_base.py:765:
+        dygraph → DataParallel; hybrid → meta_parallel wrappers)."""
+        self._ensure_init()
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            from ..meta_parallel.pipeline_parallel import PipelineParallel
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            from ..meta_parallel.model_parallel import ModelParallel
+            return ModelParallel(model, hcg, self._strategy)
+        return DataParallel(model,
+                            group=hcg.get_data_parallel_group())
+
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None):
+        """Reference fleet_base.py:712. Returns a HybridParallelOptimizer
+        bound to the mesh (grad sync over the right axes, optional ZeRO
+        sharding of optimizer states)."""
+        self._ensure_init()
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    def distributed_scaler(self, scaler):
+        """Wrap GradScaler so found_inf is any-reduced across the mp group
+        and all ranks skip the same steps (reference
+        dygraph_optimizer/hybrid_parallel_gradscaler.py)."""
+        self._ensure_init()
+        from ..meta_parallel.hybrid_optimizer import \
+            HybridParallelGradScaler
+        return HybridParallelGradScaler(scaler, self._hcg)
+
+    def minimize(self, optimizer, loss=None, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        """Static-mode minimize (reference fleet_base.py:1212). In the TPU
+        build strategy composition happens in the optimizer/model wrappers;
+        minimize just delegates."""
+        self._ensure_init()
+        if loss is not None and hasattr(optimizer, "minimize"):
+            return optimizer.minimize(loss)
+        return None
+
+    # misc
+    @property
+    def util(self):
+        from .utils import fleet_util
+        return fleet_util
+
+
+fleet = Fleet()

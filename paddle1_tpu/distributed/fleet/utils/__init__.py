@@ -1,0 +1,27 @@
+"""fleet.utils (reference python/paddle/distributed/fleet/utils/)."""
+
+from . import recompute as _recompute_mod
+from .recompute import recompute, recompute_sequential
+
+
+class _FleetUtil:
+    """fleet.util facade (reference fleet/base/util_factory.py): barrier /
+    all-reduce helpers over the coordination service."""
+
+    def barrier(self, comm_world: str = "worker"):
+        from ...collective import barrier
+        barrier()
+
+    def all_reduce(self, input, mode: str = "sum", comm_world: str = "worker"):
+        return input  # single-controller: reduction over hosts is in-graph
+
+    def get_file_shard(self, files):
+        from ... import env
+        n = env.get_world_size()
+        i = env.get_rank()
+        return files[i::n]
+
+
+fleet_util = _FleetUtil()
+
+__all__ = ["recompute", "recompute_sequential", "fleet_util"]

@@ -1,0 +1,108 @@
+"""Activation recomputation (gradient checkpointing).
+
+Analog of the reference's ``RecomputeFunction``
+(python/paddle/distributed/fleet/utils/recompute.py:63): a PyLayer that
+drops intermediate activations in forward and re-runs the segment (with the
+saved RNG state) inside backward.
+
+TPU-native: ``jax.checkpoint`` is exactly this transform, with XLA doing the
+re-forward inside the compiled backward, so the implementation collapses to
+wrapping the segment's pure function. RNG parity (reference saves/restores
+CUDA seeds, recompute.py:88-114) comes for free: the segment's dropout keys
+are explicit inputs, so the re-forward reuses identical keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ....autograd.engine import apply
+from ....core.generator import next_key, rng_scope
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Run ``function(*args)`` without keeping its internal activations;
+    backward re-executes it (reference recompute.py:162 recompute())."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if kwargs:
+        raise TypeError(f"recompute got unexpected kwargs {list(kwargs)}")
+
+    layer = function if isinstance(function, Layer) else None
+    key = next_key()
+
+    # split args into traced tensors and static (non-tensor) values,
+    # preserving positions so the segment sees the original signature
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_pos]
+
+    def _rebuild_args(arrays):
+        full = list(args)
+        for pos, arr in zip(tensor_pos, arrays):
+            full[pos] = Tensor(arr, stop_gradient=True)
+        return full
+
+    fwd_callable = layer.forward if layer is not None else function
+
+    if layer is not None:
+        names = list(layer.functional_state().keys())
+        params = [layer.state_dict()[n] for n in names]
+
+        @jax.checkpoint
+        def seg(key, param_arrays, *input_arrays):
+            with rng_scope(key):
+                with layer.load_functional_state(
+                        dict(zip(names, param_arrays))):
+                    out = fwd_callable(*_rebuild_args(input_arrays))
+                    return (tuple(t.data for t in out)
+                            if isinstance(out, (tuple, list))
+                            else out.data)
+
+        def op(*flat):
+            p = list(flat[:len(params)])
+            x = flat[len(params):]
+            return seg(key, p, *x)
+
+        return apply("recompute", op, tuple(params + tensor_args))
+
+    # plain function of tensors
+    @jax.checkpoint
+    def seg_fn(key, *input_arrays):
+        with rng_scope(key):
+            out = fwd_callable(*_rebuild_args(input_arrays))
+            return (tuple(t.data for t in out)
+                    if isinstance(out, (tuple, list)) else out.data)
+
+    return apply("recompute", lambda *flat: seg_fn(key, *flat),
+                 tuple(tensor_args))
+
+
+def recompute_sequential(ctx: dict, functions, *args):
+    """Recompute over a Sequential in ``segments`` chunks (reference
+    recompute_sequential / recompute_hybrid)."""
+    segments = ctx.get("segments", 1)
+    layers = list(functions)
+    per = max(1, len(layers) // segments)
+    x = args[0] if len(args) == 1 else args
+    for i in range(0, len(layers), per):
+        chunk = layers[i:i + per]
+
+        class _Seg(Layer):
+            def __init__(self, ls):
+                super().__init__()
+                from ....nn.layer_norm_act import LayerList
+                self.ls = LayerList(ls)
+
+            def forward(self, x):
+                for l in self.ls:
+                    x = l(x)
+                return x
+
+        x = recompute(_Seg(chunk), x)
+    return x

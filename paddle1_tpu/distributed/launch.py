@@ -1,0 +1,131 @@
+"""Process launcher — ``python -m paddle1_tpu.distributed.launch train.py``.
+
+Analog of the reference launcher (python/paddle/distributed/fleet/launch.py:
+217 launch_collective, :364 launch; launch_utils.py:452 start_local_trainers
+sets PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT/... per subprocess, :559
+watch_local_trainers kills the pod on any death).
+
+TPU-native: one process per *host* (not per chip) — XLA drives every local
+chip from a single process, so on a single host the launcher mostly execs
+the script directly. Multi-host TPU pods get one process per host with the
+JAX coordination-service env; the watch loop keeps the reference's
+fail-fast-and-kill-all semantics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle1_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of hosts")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", "127.0.0.1:6170"),
+                   help="coordinator host:port")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 per host is TPU-idiomatic; "
+                        ">1 only for CPU-simulated multi-rank testing)")
+    p.add_argument("--ips", type=str, default=None,
+                   help="comma-separated host list (reference flag)")
+    p.add_argument("--gpus", "--devices", dest="devices", type=str,
+                   default=None, help="accepted for compat; TPU chips are "
+                   "managed by XLA, not per-process pinning")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn_one(rank: int, world: int, endpoints: List[str], args,
+               extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "FLAGS_selected_tpus": str(rank),
+    })
+    if extra_env:
+        env.update(extra_env)
+    stdout = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    return subprocess.Popen(cmd, env=env, stdout=stdout,
+                            stderr=subprocess.STDOUT if stdout else None)
+
+
+def _watch(procs):
+    """Reference launch_utils.py:559: any death kills the pod, exit
+    nonzero."""
+    try:
+        while True:
+            alive = []
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive.append(p)
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    sys.exit(ret)
+            if not alive:
+                return
+            time.sleep(1)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise
+
+
+def launch(argv: Optional[List[str]] = None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    nproc = args.nproc_per_node
+    host, port = (args.master.split(":") + ["6170"])[:2]
+    if args.nnodes <= 1 and nproc <= 1:
+        # single host, single process: exec in place (XLA owns all chips)
+        env = dict(os.environ)
+        env.setdefault("PADDLE_TRAINER_ID", "0")
+        env.setdefault("PADDLE_TRAINERS_NUM", "1")
+        os.execve(sys.executable,
+                  [sys.executable, "-u", args.training_script] +
+                  args.training_script_args, env)
+    world = args.nnodes * nproc
+    endpoints = []
+    for node in range(args.nnodes):
+        h = host if args.ips is None else args.ips.split(",")[node]
+        for i in range(nproc):
+            endpoints.append(f"{h}:{int(port) + i}")
+    procs = [
+        _spawn_one(args.node_rank * nproc + i, world, endpoints, args)
+        for i in range(nproc)
+    ]
+    _watch(procs)
+
+
+def main():
+    launch()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Hybrid-parallel building blocks (reference
+python/paddle/distributed/fleet/meta_parallel/)."""
+
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
+                        SharedLayerDesc)
+from .model_parallel import ModelParallel
+from .pipeline_parallel import PipelineParallel
+from .hybrid_optimizer import (HybridParallelGradScaler,
+                               HybridParallelOptimizer)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "SegmentLayers",
+           "ModelParallel", "PipelineParallel", "HybridParallelOptimizer",
+           "HybridParallelGradScaler"]

@@ -1,0 +1,108 @@
+"""HybridParallelOptimizer.
+
+Analog of the reference's dygraph hybrid optimizer
+(python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py): wraps the inner optimizer so gradients are
+synchronized over the correct groups before the update (dp grads allreduced;
+mp-duplicated grads allreduced over mp for non-distributed params; sharded
+params updated locally).
+
+TPU-native: under pjit the grad psum is already in the compiled graph (the
+DataParallel hook / GSPMD derivation), so step() is mostly a passthrough;
+the wrapper's real work is (a) eager-mode fallback sync, (b) ZeRO state
+sharding metadata for the train-step builder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.tensor import Tensor
+from .. import env
+from ..collective import all_reduce, ReduceOp
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelGradScaler"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding_enabled = bool(
+            strategy is not None and
+            (strategy.sharding or
+             hcg.get_sharding_parallel_world_size() > 1))
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        # dp grad sync for eager multi-rank runs happens in DataParallel
+        # hooks; mp-replicated (non-distributed) params need an mp-axis
+        # grad sync so replicas stay identical (reference
+        # hybrid_parallel_optimizer.py _dygraph_clip + fused_allreduce_gradients)
+        axis = env.current_spmd_axis("mp")
+        if axis is not None:
+            for p in getattr(self._inner_opt, "_parameter_list", []) or []:
+                if p.grad is not None and not getattr(
+                        p, "is_distributed", False):
+                    all_reduce(p.grad, op=ReduceOp.AVG,
+                               group=self._hcg.get_model_parallel_group())
+        return self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        return self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+
+class HybridParallelGradScaler:
+    """Wraps amp.GradScaler: found_inf must be any-reduced across the model
+    parallel group so every rank makes the same skip/update decision
+    (reference hybrid_parallel_gradscaler.py)."""
+
+    def __init__(self, scaler, hcg):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def _sync_found_inf(self):
+        # under pjit the finite-check runs on replicated grads so ranks
+        # already agree; the any-reduce matters on the explicit-SPMD path
+        axis = env.current_spmd_axis("mp")
+        if axis is not None:
+            from ...core.tensor import to_tensor
+            flag = to_tensor(1.0 if self._scaler._found_inf else 0.0)
+            all_reduce(flag, op=ReduceOp.MAX,
+                       group=self._hcg.get_model_parallel_group())
+            self._scaler._found_inf = bool(float(flag.numpy()) > 0)
+
+    def unscale_(self, optimizer):
+        out = self._scaler.unscale_(optimizer)
+        self._sync_found_inf()
+        return out
+
+    def step(self, optimizer):
+        # GradScaler.step unscales internally; re-sync before the skip
+        # decision by unscaling first ourselves
+        self._scaler.unscale_(optimizer)
+        self._sync_found_inf()
+        return self._scaler.step(optimizer)
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

@@ -1,0 +1,23 @@
+"""ModelParallel wrapper (reference
+python/paddle/distributed/fleet/meta_parallel/model_parallel.py:25: wraps a
+dygraph model for TP — broadcasts params/inputs within the mp group at init).
+
+On TPU, replication-vs-sharding of each parameter is a compile-time sharding
+spec, so the init-time broadcast disappears; the wrapper's remaining job is
+dp-grad sync (inherited DataParallel semantics across the dp axis) while mp
+collectives live inside the mp_layers themselves.
+"""
+
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ..parallel import DataParallel
+
+__all__ = ["ModelParallel"]
+
+
+class ModelParallel(DataParallel):
+    def __init__(self, layers: Layer, hcg, strategy=None, **kwargs):
+        super().__init__(layers,
+                         group=hcg.get_data_parallel_group())
+        self._hcg = hcg

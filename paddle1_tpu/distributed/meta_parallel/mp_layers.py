@@ -1,0 +1,182 @@
+"""Tensor-(model-)parallel layers.
+
+Analog of the reference's Megatron-style layers
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py:
+VocabParallelEmbedding:29, ColumnParallelLinear:85, RowParallelLinear:143).
+
+TPU-native dual path, one code base:
+
+* **pjit/GSPMD path (primary).** The layer holds the FULL parameter tagged
+  with per-dim mesh-axis names (``Parameter.sharding_axes``); when the train
+  step is jitted over the mesh (see distributed.sharding_specs), XLA shards
+  the weight over the ``mp`` axis and inserts exactly the f/g collectives
+  Megatron prescribes. The forward below is the plain dense math.
+
+* **shard_map path (explicit SPMD, reference semantics).** Under
+  ``shard_map`` with ``spmd_axes(mp=...)`` bound, parameters arrive as local
+  shards and the ``_c_identity``/``_mp_allreduce``/``_c_concat`` calls below
+  become real axis collectives — bit-for-bit the reference's comm pattern.
+  Outside any SPMD trace these helpers are identity, so the same layers run
+  unchanged on one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...autograd.engine import apply
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform
+from ...nn.layer_base import Layer
+from .. import env
+from ..collective import _c_concat, _c_identity, _c_split, _mp_allreduce
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_degree() -> int:
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim split over mp (reference mp_layers.py:29).
+    Out-of-range ids on each shard contribute zeros; psum combines."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.sharding_axes = ("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        axis = env.current_spmd_axis("mp")
+
+        def f(ids, w):
+            if axis is not None and isinstance(w, jax.core.Tracer):
+                # explicit-SPMD: w is the local vocab shard
+                n = lax.axis_size(axis)
+                per = w.shape[0]
+                start = lax.axis_index(axis) * per
+                local = ids - start
+                ok = (local >= 0) & (local < per)
+                safe = jnp.clip(local, 0, per - 1)
+                out = jnp.where(ok[..., None], w[safe], 0.0)
+                return lax.psum(out, axis)
+            return w[ids]
+
+        return apply("vocab_parallel_embedding", f,
+                     (x, self.weight))
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim split over mp (reference mp_layers.py:85).
+    fwd: identity(x) @ W_col [+ gather]; bwd: psum(dx)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = True, mp_group=None,
+                 fuse_matmul_bias: bool = False, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.sharding_axes = (None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.sharding_axes = ("mp",)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = _c_identity(x)
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _c_concat(y)
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim split over mp (reference mp_layers.py:143).
+    fwd: x_shard @ W_row → psum; bwd: identity(dx)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = False, mp_group=None,
+                 fuse_matmul_bias: bool = False, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.sharding_axes = ("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            # bias is replicated; added once after the reduce
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = _c_split(x)
+        y = F.linear(x, self.weight, None)
+        y = _mp_allreduce(y)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over mp-sharded logits (reference
+    parallel_cross_entropy; vocab-parallel loss). Under explicit SPMD the
+    max/sum reductions psum over mp; on the pjit path XLA derives the same
+    from the logits sharding."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, logits, label):
+        axis = env.current_spmd_axis("mp")
+
+        def f(lg, lb):
+            if axis is not None and isinstance(lg, jax.core.Tracer):
+                per = lg.shape[-1]
+                start = lax.axis_index(axis) * per
+                m = lax.pmax(jnp.max(lg, -1, keepdims=True), axis)
+                e = jnp.exp(lg - m)
+                denom = lax.psum(jnp.sum(e, -1, keepdims=True), axis)
+                logp = lg - m - jnp.log(denom)
+                local = lb - start
+                ok = (local >= 0) & (local < per)
+                safe = jnp.clip(local, 0, per - 1)
+                picked = jnp.take_along_axis(
+                    logp, safe[..., None], axis=-1)[..., 0]
+                nll = -jnp.where(ok, picked, 0.0)
+                return lax.psum(nll, axis)[..., None]
+            m = jnp.max(lg, -1, keepdims=True)
+            logp = lg - m - jnp.log(jnp.sum(jnp.exp(lg - m), -1,
+                                            keepdims=True))
+            picked = jnp.take_along_axis(logp, lb[..., None], axis=-1)
+            return -picked
+
+        return apply("parallel_cross_entropy", f, (logits, label))

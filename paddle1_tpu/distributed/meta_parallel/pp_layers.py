@@ -1,0 +1,183 @@
+"""Pipeline-parallel model description & stage partition.
+
+Analog of the reference's ``PipelineLayer``/``LayerDesc``/``SegmentLayers``
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:
+61, SegmentLayers:22): the model is declared as a flat list of layer
+descriptors, partitioned into contiguous stages balanced by parameter count,
+and each rank builds only its stage.
+
+TPU-native: under single-controller SPMD every process sees all stages; the
+partition drives (a) which ``pp``-mesh-axis coordinate each stage's params
+are pinned to (stage_sharding tags consumed by the in-graph 1F1B schedule in
+distributed.pipeline) and (b) per-stage sub-Layer construction for the
+eager/debug path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.errors import InvalidArgumentError
+from ...nn.layer_base import Layer
+from ...nn.layer_norm_act import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not (callable(layer_func)):
+            raise InvalidArgumentError("LayerDesc needs a Layer class")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages, e.g. tied embeddings (reference
+    pp_layers.py SharedLayerDesc): grads for the shared weight are
+    all-reduced across the owning stages."""
+
+    def __init__(self, key: str, layer_func: Callable, forward_func=None,
+                 shared_weight_attr: str = "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Balanced contiguous partition (reference pp_layers.py:22). Method
+    'uniform' splits by count; 'parameters' balances by parameter volume."""
+
+    def __init__(self, layers_desc: Sequence, num_parts: int,
+                 method: str = "uniform"):
+        self.descs = list(layers_desc)
+        self.num_parts = num_parts
+        self.method = method
+        if len(self.descs) < num_parts:
+            raise InvalidArgumentError(
+                f"{len(self.descs)} layers cannot fill {num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method == "uniform":
+            base = n // self.num_parts
+            rem = n % self.num_parts
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+            return bounds
+        if self.method.startswith("layer:"):
+            # place boundaries at layers whose class name matches
+            target = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if getattr(getattr(d, "layer_func", d), "__name__", "")
+                     == target]
+            if not marks:
+                raise InvalidArgumentError(
+                    f"segment method 'layer:{target}' matched no layers")
+            if len(marks) % self.num_parts != 0:
+                raise InvalidArgumentError(
+                    f"'layer:{target}' matched {len(marks)} layers, not "
+                    f"divisible into {self.num_parts} stages (the "
+                    f"reference SegmentLayers asserts the same)")
+            per = len(marks) // self.num_parts
+            bounds = [0]
+            for i in range(1, self.num_parts):
+                bounds.append(marks[i * per])
+            bounds.append(n)
+            return bounds
+        raise InvalidArgumentError(f"Unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """The stage-partitioned model (reference pp_layers.py:61).
+
+    ``forward`` runs ALL stages sequentially (correct math everywhere; on a
+    pod the in-graph 1F1B schedule in distributed.pipeline consumes
+    ``stage_descs()`` instead). Parameters of stage s are tagged with
+    ``pp_stage = s`` so the pipeline runner can pin them to the pp-axis
+    coordinate.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        from ..topology import get_hybrid_communicate_group
+        self._loss_fn = loss_fn
+        hcg = get_hybrid_communicate_group()
+        self._num_stages = (num_stages if num_stages is not None else
+                            (hcg.get_pipe_parallel_world_size() if hcg
+                             else 1))
+        self._descs = list(layers)
+        seg = SegmentLayers(self._descs, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        self.recompute_interval = recompute_interval
+
+        self._shared_layers = {}
+        built: List[Layer] = []
+        self._stage_of_layer: List[int] = []
+        for stage in range(self._num_stages):
+            lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+            for i in range(lo, hi):
+                d = self._descs[i]
+                if isinstance(d, SharedLayerDesc):
+                    if d.layer_name not in self._shared_layers:
+                        self._shared_layers[d.layer_name] = d.build_layer()
+                    lyr = self._shared_layers[d.layer_name]
+                elif isinstance(d, LayerDesc):
+                    lyr = d.build_layer()
+                elif isinstance(d, Layer):
+                    lyr = d
+                elif callable(d):
+                    lyr = _FnLayer(d)
+                else:
+                    raise InvalidArgumentError(f"Bad pipeline desc: {d!r}")
+                built.append(lyr)
+                self._stage_of_layer.append(stage)
+                for p in lyr.parameters():
+                    p.pp_stage = stage
+        self.run_function = LayerList(built)
+
+    def get_stage_from_index(self, idx: int) -> int:
+        return self._stage_of_layer[idx]
+
+    def stage_layers(self, stage: int) -> List[Layer]:
+        return [l for l, s in zip(self.run_function, self._stage_of_layer)
+                if s == stage]
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def forward(self, x):
+        from ..fleet.utils.recompute import recompute
+        for i, lyr in enumerate(self.run_function):
+            if (self.recompute_interval > 0 and
+                    i % self.recompute_interval == 0 and self.training):
+                x = recompute(lyr, *x) if isinstance(x, tuple) \
+                    else recompute(lyr, x)
+            else:
+                x = lyr(*x) if isinstance(x, tuple) else lyr(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)

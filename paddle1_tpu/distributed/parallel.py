@@ -1,0 +1,195 @@
+"""Process/parallel environment bootstrap + dygraph DataParallel.
+
+Analog of the reference's ``python/paddle/distributed/parallel.py:60``
+(init_parallel_env: Gloo rendezvous + NCCLParallelContext comm-ring init) and
+``python/paddle/fluid/dygraph/parallel.py:380`` (DataParallel + C++ Reducer
+gradient bucketing, imperative/reducer.cc).
+
+TPU-native design: there are no per-rank NCCL rings to bootstrap. A single
+process drives all local TPU chips through XLA; multi-host jobs call
+``jax.distributed.initialize`` (the PJRT coordination service replaces the
+reference's raw-TCP ncclUniqueId broadcast, gen_comm_id_helper.cc). Gradient
+synchronization is not a bucketed background Reducer — under jit the grads
+are averaged with one ``psum`` per (fused) gradient tree and XLA's
+latency-hiding scheduler overlaps the collective with remaining backward
+compute, which is exactly what the Reducer's bucket-overlap machinery was
+hand-building.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..core.errors import PreconditionNotMetError
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import env
+from .collective import all_reduce, ReduceOp
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "DataParallel"]
+
+
+_initialized = [False]
+
+
+def init_parallel_env(strategy=None):
+    """Bootstrap distributed state (reference parallel.py:60). On TPU:
+    initialize the JAX coordination service when launched multi-process
+    (env `PADDLE_TRAINER_ENDPOINTS`/standard JAX envs), else no-op."""
+    if _initialized[0]:
+        return ParallelEnv()
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    nranks = env.get_world_size()
+    # NOTE: do not touch jax.devices()/process_count() before initialize —
+    # instantiating the backend first makes initialize() unusable.
+    if nranks > 1 and endpoints:
+        coordinator = endpoints.split(",")[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=nranks,
+                process_id=env.get_rank())
+        except RuntimeError as e:
+            # "already initialized" is fine (launcher or user did it);
+            # anything else means the multi-host bootstrap FAILED and
+            # training would silently fork into independent worlds.
+            if "already" not in str(e).lower():
+                raise PreconditionNotMetError(
+                    f"jax.distributed.initialize failed for a "
+                    f"{nranks}-process job (coordinator {coordinator}): "
+                    f"{e}. Refusing to continue single-process.") from e
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return env.get_rank()
+
+
+def get_world_size() -> int:
+    return env.get_world_size()
+
+
+class ParallelEnv:
+    """Reference fluid/dygraph/parallel.py ParallelEnv: rank/world-size/
+    endpoint view of the launch env."""
+
+    @property
+    def rank(self) -> int:
+        return env.get_rank()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", str(env.get_rank())))
+
+    @property
+    def world_size(self) -> int:
+        return env.get_world_size()
+
+    @property
+    def nranks(self) -> int:
+        return env.get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]
+
+
+class DataParallel(Layer):
+    """Data-parallel model wrapper (reference dygraph/parallel.py:380).
+
+    The reference attaches a C++ Reducer that buckets grads and all-reduces
+    each bucket as backward marks it ready. Here the wrapper (a) marks
+    parameters as distributed, (b) under an SPMD trace averages gradients
+    over the dp axis via a psum hook on each parameter, and (c) in eager
+    single-process mode is a transparent passthrough. Loss scaling follows
+    scale_loss (parallel.py:586): identity, since psum-mean already divides.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int
+                 = 25, last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._group = group
+        self._grad_sync_enabled = True
+        for p in layers.parameters():
+            p.is_distributed = True
+        # grad-sync hooks: fire during backward, psum-mean over dp axis when
+        # tracing SPMD; no-op otherwise (world size 1 eager)
+        self._hook_handles = []
+        for p in layers.parameters():
+            if not p.stop_gradient:
+                self._hook_handles.append(
+                    p.register_hook(self._make_grad_sync_hook()))
+
+    def _make_grad_sync_hook(self):
+        def hook(grad):
+            if not self._grad_sync_enabled:
+                return grad
+            axis = env.current_spmd_axis("dp")
+            if axis is None:
+                return grad
+            from jax import lax
+            import jax.core as jcore
+            from ..autograd.engine import apply as _apply
+
+            def f(g):
+                if isinstance(g, jcore.Tracer):
+                    return lax.pmean(g, axis)
+                return g
+            return _apply("dp_grad_sync", f, (grad,))
+        return hook
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss: Tensor) -> Tensor:
+        """Reference parallel.py:586 — divide by nranks before backward so
+        summed grads average. With pmean-based sync this is identity."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Reference parallel.py:595 manual grad allreduce (used with
+        no_sync). Eagerly all-reduces each param grad over dp."""
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG)
+
+    def no_sync(self):
+        """Suppress grad sync inside the context (reference parallel.py
+        no_sync — used for gradient accumulation); call
+        apply_collective_grads() after the last micro-batch."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._grad_sync_enabled
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = prev
+        return ctx()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    set_dict = set_state_dict

@@ -1,0 +1,76 @@
+"""Parameter/activation sharding-spec collection for pjit.
+
+This is the TPU replacement for the reference's program-rewriting
+meta-optimizers (SURVEY §2.3): instead of inserting c_allreduce/c_broadcast
+ops into a ProgramDesc, we collect ``PartitionSpec``s from layer metadata
+(``Parameter.sharding_axes`` written by the meta_parallel layers) plus the
+ZeRO policy, hand them to ``jax.jit(..., in_shardings=...)`` over the hybrid
+mesh, and let GSPMD emit the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer_base import Layer
+
+__all__ = ["param_partition_specs", "named_shardings", "zero_shard_spec",
+           "data_partition_spec"]
+
+
+def param_partition_specs(layer: Layer,
+                          zero_stage: int = 0,
+                          zero_axis: str = "sharding") -> Dict[str, P]:
+    """{param_name: PartitionSpec}. TP axes come from the layer metadata;
+    ZeRO stage-3 additionally shards the largest unsharded dim over the
+    sharding axis (stages 1/2 shard only optimizer state / grads — see
+    zero_shard_spec)."""
+    specs: Dict[str, P] = {}
+    for name, p in layer.state_dict().items():
+        axes = list(getattr(p, "sharding_axes", None) or
+                    [None] * len(p.shape))
+        while len(axes) < len(p.shape):
+            axes.append(None)
+        if zero_stage >= 3 and zero_axis not in axes and p.shape:
+            # shard the largest free dim over the sharding axis
+            free = [i for i, a in enumerate(axes) if a is None]
+            if free:
+                big = max(free, key=lambda i: p.shape[i])
+                axes[big] = zero_axis
+        specs[name] = P(*axes)
+    return specs
+
+
+def zero_shard_spec(param_spec: P, shape, zero_axis: str = "sharding") -> P:
+    """Spec for optimizer slot variables under ZeRO stage>=1: slots shard
+    over the sharding axis on the largest dim not already sharded (the
+    reference's sharding_optimizer assigns whole params to owner ranks;
+    GSPMD's per-dim sharding is strictly more uniform)."""
+    axes = list(param_spec) if param_spec else []
+    while len(axes) < len(shape):
+        axes.append(None)
+    if zero_axis in axes or not shape:
+        return P(*axes)
+    free = [i for i, a in enumerate(axes) if a is None]
+    if not free:
+        return P(*axes)
+    big = max(free, key=lambda i: shape[i])
+    axes[big] = zero_axis
+    return P(*axes)
+
+
+def data_partition_spec(batch_axes=("dp", "sharding"),
+                        seq_axis: Optional[str] = None) -> P:
+    """Batch tensors: batch dim over dp (and the sharding axis, which in
+    hybrid-ZeRO also carries data), optional sequence dim over sp."""
+    if seq_axis:
+        return P(tuple(batch_axes), seq_axis)
+    return P(tuple(batch_axes))
+
+
+def named_shardings(mesh: Mesh, specs: Dict[str, P]
+                    ) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s) for k, s in specs.items()}

@@ -1,0 +1,45 @@
+"""paddle.distributed.spawn analog (reference
+python/paddle/distributed/spawn.py:321): multiprocessing alternative to the
+launcher for single-host multi-process runs. On TPU, multi-process per host
+is only meaningful for CPU-simulated rank testing — real chips are driven by
+one process — so spawn runs the function in subprocesses with the launcher's
+env protocol.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Optional, Tuple
+
+__all__ = ["spawn"]
+
+
+def _worker(fn, rank: int, nprocs: int, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    if nprocs <= 1:
+        _worker(func, 0, 1, args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawned rank exited with code {p.exitcode}")
+    return procs

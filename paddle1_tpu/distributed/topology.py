@@ -1,0 +1,241 @@
+"""Hybrid-parallel topology → one nd ``jax.sharding.Mesh`` with named axes.
+
+Analog of the reference's ``CommunicateTopology``/``HybridCommunicateGroup``
+(/root/reference/python/paddle/distributed/fleet/base/topology.py:35,111),
+which builds a cartesian rank mesh over (dp, pp, sharding, mp) and creates an
+NCCL comm group per axis. On TPU the whole abstraction collapses onto
+``jax.sharding.Mesh``: one global device mesh whose *named axes* are the
+parallelism dimensions; XLA lowers per-axis collectives onto ICI rings for
+that axis automatically — there is no comm-group object to manage, only axis
+names. We keep the reference's class/API shape so fleet code ports over.
+
+Axis order convention (outermost→innermost, matching the reference's
+hybrid_group order pp→dp→sharding→mp→sp): outer axes ride DCN on multi-slice,
+inner axes ride ICI — model parallel (mp) and sequence parallel (sp) want the
+fastest links, so they are innermost.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+
+# Canonical axis order. pp outermost (stages talk rarely, point-to-point),
+# then dp, sharding, mp, sp innermost (tightest collectives).
+_AXIS_ORDER = ("pp", "dp", "sharding", "mp", "sp")
+
+
+def build_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
+               sp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Create the global hybrid mesh. Degrees of 1 keep their axis (size-1
+    axes are free in XLA and make sharding specs uniform)."""
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "mp": mp, "sp": sp}
+    total = int(np.prod(list(degrees.values())))
+    if total != len(devices):
+        raise InvalidArgumentError(
+            f"Mesh degrees {degrees} require {total} devices, "
+            f"have {len(devices)}")
+    shape = tuple(degrees[a] for a in _AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, _AXIS_ORDER)
+
+
+class CommunicateTopology:
+    """Rank-coordinate bookkeeping over the hybrid axes (reference
+    topology.py:35). Pure arithmetic — no comm objects."""
+
+    def __init__(self, hybrid_group_names: Sequence[str],
+                 dims: Sequence[int]):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims)) if self._dims else 1
+        coords = np.indices(self._dims).reshape(len(self._dims), -1).T
+        self._coord_to_rank = {tuple(c): r for r, c in enumerate(coords)}
+        self._rank_to_coord = {r: tuple(c) for r, c in enumerate(coords)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **axis_coords) -> int:
+        coord = tuple(axis_coords[name] for name in self._parallel_names)
+        return self._coord_to_rank[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank_to_coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord_to_rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis_name`` (reference
+        topology.py get_comm_list): one group per combination of the other
+        axes' coordinates."""
+        axis = self._parallel_names.index(axis_name)
+        groups: Dict[Tuple[int, ...], List[int]] = {}
+        for coord, rank in sorted(self._coord_to_rank.items(),
+                                  key=lambda kv: kv[1]):
+            others = coord[:axis] + coord[axis + 1:]
+            groups.setdefault(others, []).append(rank)
+        return [sorted(g) for _, g in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """The fleet hybrid-parallel context (reference topology.py:111).
+
+    Holds the global Mesh plus this process's logical coordinates. On TPU
+    under SPMD there is one process per host controlling many devices, so
+    "my rank" questions are answered per-device by XLA; the per-axis group
+    objects the reference returns become axis-name handles consumed by
+    shard_map/pjit.
+    """
+
+    def __init__(self, topology: CommunicateTopology,
+                 mesh: Optional[Mesh] = None, rank: Optional[int] = None):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        degrees = {n: topology.get_dim(n) for n in names}
+        self._mesh = mesh if mesh is not None else build_mesh(
+            dp=degrees.get("data", degrees.get("dp", 1)),
+            mp=degrees.get("model", degrees.get("mp", 1)),
+            pp=degrees.get("pipe", degrees.get("pp", 1)),
+            sharding=degrees.get("sharding", 1),
+            sp=degrees.get("sep", degrees.get("sp", 1)))
+        from . import env
+        self._rank = rank if rank is not None else env.get_rank()
+        self._coord = topology.get_coord(self._rank % topology.world_size())
+        self._names = names
+
+    # -- mesh / axis handles (TPU-native surface) ---------------------------
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def axis_name(self, logical: str) -> str:
+        aliases = {"data": "dp", "model": "mp", "pipe": "pp",
+                   "sharding": "sharding", "sep": "sp"}
+        return aliases.get(logical, logical)
+
+    # -- reference-compatible queries ---------------------------------------
+
+    def _dim(self, *names) -> int:
+        for n in names:
+            if n in self._names:
+                return self._topo.get_dim(n)
+        return 1
+
+    def _coord_of(self, *names) -> int:
+        for n in names:
+            if n in self._names:
+                return self._coord[self._names.index(n)]
+        return 0
+
+    def get_global_rank(self) -> int:
+        return self._rank
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dim("data", "dp")
+
+    def get_data_parallel_rank(self) -> int:
+        return self._coord_of("data", "dp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._dim("model", "mp")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._coord_of("model", "mp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._dim("pipe", "pp")
+
+    def get_stage_id(self) -> int:
+        return self._coord_of("pipe", "pp")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._dim("sharding")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._coord_of("sharding")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._dim("sep", "sp")
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self.get_pipe_parallel_world_size() - 1
+
+    # group handles: on TPU these are just (mesh, axis) pairs
+    def get_data_parallel_group(self):
+        return _AxisGroup(self._mesh, "dp")
+
+    def get_model_parallel_group(self):
+        return _AxisGroup(self._mesh, "mp")
+
+    def get_pipe_parallel_group(self):
+        return _AxisGroup(self._mesh, "pp")
+
+    def get_sharding_parallel_group(self):
+        return _AxisGroup(self._mesh, "sharding")
+
+    def get_sep_parallel_group(self):
+        return _AxisGroup(self._mesh, "sp")
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+
+class _AxisGroup:
+    """A (mesh, axis-name) handle standing in for the reference's
+    ProcessGroup. ``nranks``/``rank`` answer locally; collective calls made
+    with this group under a shard_map trace resolve to the axis name."""
+
+    def __init__(self, mesh: Mesh, axis: str):
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def nranks(self) -> int:
+        return int(self.mesh.shape[self.axis]) if self.axis in \
+            self.mesh.shape else 1
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def __repr__(self):
+        return f"_AxisGroup(axis={self.axis!r}, nranks={self.nranks})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -341,6 +341,18 @@ def split(x, num_or_sections, axis=0, name=None):
     return list(outs) if isinstance(outs, tuple) else [outs]
 
 
+def unstack(x, axis=0, num=None, name=None):
+    """Unpack along ``axis`` into a list (reference paddle.unstack)."""
+    x = _t(x)
+    n = num if num is not None else x.shape[axis]
+
+    def f(x):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(x, n, axis=axis))
+    outs = apply("unstack", f, (x,), n_outputs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
 def builtins_sum(it):
     tot = 0
     for v in it:

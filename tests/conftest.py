@@ -4,7 +4,9 @@ no simulated-topology backend — we make one a first-class test fixture)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Under axon the JAX_PLATFORMS env var is pinned to the tunnel TPU; the
+# config knob below still wins, so set both.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,4 +20,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # sim; pin f32 so finite-difference gradient checks are meaningful.
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")

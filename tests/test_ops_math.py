@@ -34,7 +34,8 @@ class TestElementwise(OpTest):
         self.check_output(paddle.exp, np.exp, [a])
         self.check_output(paddle.log, np.log, [a], rtol=5e-4, atol=1e-5)
         self.check_output(paddle.sqrt, np.sqrt, [a])
-        self.check_output(paddle.tanh, np.tanh, [a])
+        # XLA's f32 tanh is a rational approximation ~3e-5 off np.tanh
+        self.check_output(paddle.tanh, np.tanh, [a], rtol=2e-4, atol=1e-4)
         self.check_grad(paddle.tanh, [a])
         self.check_grad(paddle.exp, [a])
 
