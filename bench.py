@@ -1,0 +1,88 @@
+"""Headline benchmark: BERT-base pretraining samples/sec/chip (BASELINE.md
+config 3). Prints ONE JSON line. ``vs_baseline`` = achieved MFU / 0.40 (the
+north-star MFU target; the reference publishes no numeric baseline —
+BASELINE.md)."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    # bf16 peak per chip
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default (CPU runs report nominal MFU)
+
+
+def main():
+    import jax
+
+    import paddle1_tpu as paddle
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.text.models import (BertForPretraining,
+                                         BertPretrainingCriterion, bert_base)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    batch, seq = (32, 128) if on_tpu else (4, 64)
+
+    model = BertForPretraining(bert_base(
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    crit = BertPretrainingCriterion(model.bert.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        from paddle1_tpu.core.tensor import Tensor
+        scores, rel = m(Tensor(b["ids"]))
+        return crit(scores, rel, Tensor(b["mlm"]), Tensor(b["nsp"]))
+
+    mesh = build_mesh(dp=1, devices=[dev])
+    engine = ParallelEngine(model, opt, loss_fn, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    v = model.bert.vocab_size
+    b = {"ids": rng.integers(1, v, (batch, seq)).astype(np.int32),
+         "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
+         "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)}
+
+    # warmup (compile)
+    engine.step(b)
+    jax.block_until_ready(engine.params)
+
+    n_steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = engine.step(b)
+    jax.block_until_ready((loss.data if hasattr(loss, "data") else loss,
+                           engine.params))
+    dt = time.perf_counter() - t0
+
+    sps = batch * n_steps / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_step = 6 * n_params * batch * seq  # fwd+bwd dense FLOPs
+    mfu = (flops_per_step * n_steps / dt) / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {"batch": batch, "seq_len": seq, "steps": n_steps,
+                   "params": n_params, "mfu": round(mfu, 4),
+                   "device": getattr(dev, "device_kind", dev.platform),
+                   "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,15 +19,18 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        build_mesh, get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 from . import sharding_specs
+from .parallel_engine import ParallelEngine, make_train_step
 from .spawn import spawn
 
 
 def __getattr__(name):
     # `launch` resolves lazily so `python -m paddle1_tpu.distributed.launch`
-    # doesn't trip runpy's already-imported warning.
+    # doesn't trip runpy's already-imported warning. Return the MODULE (the
+    # reference's paddle.distributed.launch is a module too) so the binding
+    # is identical whether resolved here or by a direct submodule import.
     if name == "launch":
         from . import launch as _launch_mod
-        return _launch_mod.launch
+        return _launch_mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
@@ -39,4 +42,5 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "DataParallel", "ParallelEnv", "init_parallel_env",
            "CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
            "get_hybrid_communicate_group", "set_hybrid_communicate_group",
-           "sharding_specs", "spawn", "launch"]
+           "sharding_specs", "spawn", "launch", "ParallelEngine",
+           "make_train_step"]

@@ -52,10 +52,23 @@ class ReduceOp:
     AVG = 4
 
 
+def _pprod(x, axis):
+    """Product over a mesh axis via log-magnitude psum + sign/zero tracking
+    (XLA has no native product collective; exp∘psum∘log alone NaNs on
+    negatives and -infs on zeros)."""
+    mag = jnp.exp(lax.psum(jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))),
+                           axis))
+    neg = lax.psum((x < 0).astype(jnp.int32), axis)
+    has_zero = lax.psum((x == 0).astype(jnp.int32), axis) > 0
+    sign = jnp.where(neg % 2 == 0, 1.0, -1.0)
+    return jnp.where(has_zero, 0.0, sign * mag).astype(x.dtype)
+
+
 _REDUCERS = {
     ReduceOp.SUM: lax.psum,
     ReduceOp.MAX: lax.pmax,
     ReduceOp.MIN: lax.pmin,
+    ReduceOp.PROD: _pprod,
 }
 
 
@@ -198,8 +211,6 @@ def all_reduce(tensor: Tensor, op: int = ReduceOp.SUM,
         if axis is not None and _in_trace(x):
             if op == ReduceOp.AVG:
                 return lax.pmean(x, axis)
-            if op == ReduceOp.PROD:
-                return jnp.exp(lax.psum(jnp.log(x), axis))
             return _REDUCERS[op](x, axis)
         return x  # world-size-1 eager: identity
 
@@ -216,8 +227,6 @@ def reduce(tensor: Tensor, dst: int = 0, op: int = ReduceOp.SUM,
         if axis is not None and _in_trace(x):
             if op == ReduceOp.AVG:
                 red = lax.pmean(x, axis)
-            elif op == ReduceOp.PROD:
-                red = jnp.exp(lax.psum(jnp.log(x), axis))
             else:
                 red = _REDUCERS[op](x, axis)
             idx = lax.axis_index(axis)
@@ -486,8 +495,13 @@ def _c_split(tensor: Tensor, group: Optional[Group] = None) -> Tensor:
 
     def f(x):
         if axis is not None and _in_trace(x):
+            n_ranks = lax.axis_size(axis)
+            if x.shape[-1] % n_ranks != 0:
+                raise InvalidArgumentError(
+                    f"c_split: last dim {x.shape[-1]} not divisible by "
+                    f"axis '{axis}' size {n_ranks}")
             idx = lax.axis_index(axis)
-            chunk = x.shape[-1] // lax.axis_size(axis)
+            chunk = x.shape[-1] // n_ranks
             return lax.dynamic_slice_in_dim(x, idx * chunk, chunk,
                                             axis=x.ndim - 1)
         return x

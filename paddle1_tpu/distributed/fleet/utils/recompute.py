@@ -35,6 +35,9 @@ def recompute(function: Callable, *args, **kwargs):
         raise TypeError(f"recompute got unexpected kwargs {list(kwargs)}")
 
     layer = function if isinstance(function, Layer) else None
+    if layer is None and isinstance(getattr(function, "__self__", None),
+                                    Layer):
+        layer = function.__self__  # bound Layer.forward
     key = next_key()
 
     # split args into traced tensors and static (non-tensor) values,
@@ -71,16 +74,17 @@ def recompute(function: Callable, *args, **kwargs):
 
         return apply("recompute", op, tuple(params + tensor_args))
 
-    # plain function of tensors
-    @jax.checkpoint
-    def seg_fn(key, *input_arrays):
-        with rng_scope(key):
-            out = fwd_callable(*_rebuild_args(input_arrays))
-            return (tuple(t.data for t in out)
-                    if isinstance(out, (tuple, list)) else out.data)
-
-    return apply("recompute", lambda *flat: seg_fn(key, *flat),
-                 tuple(tensor_args))
+    # Opaque callable: parameters it closes over cannot be threaded into
+    # jax.checkpoint as differentiable inputs, and capturing them as trace
+    # constants would SILENTLY drop their gradients. Run the segment on the
+    # normal tape instead — correct grads, no memory saving — and say so.
+    import warnings
+    warnings.warn(
+        "recompute() got an opaque callable; cannot prove it uses no layer "
+        "parameters, so activations are NOT discarded (gradients stay "
+        "correct). Pass the Layer itself (or its bound .forward) to get "
+        "actual recomputation.", stacklevel=2)
+    return function(*args)
 
 
 def recompute_sequential(ctx: dict, functions, *args):

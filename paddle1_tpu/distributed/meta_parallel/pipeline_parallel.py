@@ -76,8 +76,8 @@ class PipelineParallel(DataParallel):
                 scaled.backward()
             total_loss = loss if total_loss is None else total_loss + loss
         if scaler is not None:
+            # GradScaler.step() already advances the loss-scale state.
             scaler.step(optimizer)
-            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

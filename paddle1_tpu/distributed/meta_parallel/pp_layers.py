@@ -78,6 +78,23 @@ class SegmentLayers:
             for i in range(self.num_parts):
                 bounds.append(bounds[-1] + base + (1 if i < rem else 0))
             return bounds
+        if self.method == "parameters":
+            # Balance stages by parameter volume: greedy boundary placement
+            # over the prefix-sum of per-layer parameter counts.
+            weights = [self._param_count(d) for d in self.descs]
+            total = sum(weights) or 1
+            target = total / self.num_parts
+            bounds, acc = [0], 0.0
+            for i, w in enumerate(weights):
+                acc += w
+                if (len(bounds) < self.num_parts and
+                        acc >= target * len(bounds) and
+                        n - (i + 1) >= self.num_parts - len(bounds)):
+                    bounds.append(i + 1)
+            while len(bounds) < self.num_parts:
+                bounds.append(bounds[-1] + 1)
+            bounds.append(n)
+            return bounds
         if self.method.startswith("layer:"):
             # place boundaries at layers whose class name matches
             target = self.method.split(":", 1)[1]
@@ -99,6 +116,21 @@ class SegmentLayers:
             bounds.append(n)
             return bounds
         raise InvalidArgumentError(f"Unknown segment method {self.method}")
+
+    @staticmethod
+    def _param_count(desc) -> int:
+        if isinstance(desc, Layer):
+            return sum(int(np.prod(p.shape)) for p in desc.parameters()) or 1
+        if isinstance(desc, LayerDesc):
+            # Build once to measure (tiny next to training cost; the
+            # reference instead re-declares sizes in the desc).
+            try:
+                built = desc.build_layer()
+                return sum(int(np.prod(p.shape))
+                           for p in built.parameters()) or 1
+            except Exception:
+                return 1
+        return 1
 
 
 class PipelineLayer(Layer):

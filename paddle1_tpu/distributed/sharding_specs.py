@@ -23,11 +23,13 @@ __all__ = ["param_partition_specs", "named_shardings", "zero_shard_spec",
 
 def param_partition_specs(layer: Layer,
                           zero_stage: int = 0,
-                          zero_axis: str = "sharding") -> Dict[str, P]:
+                          zero_axis: str = "sharding",
+                          zero_axis_size: int = 1) -> Dict[str, P]:
     """{param_name: PartitionSpec}. TP axes come from the layer metadata;
     ZeRO stage-3 additionally shards the largest unsharded dim over the
     sharding axis (stages 1/2 shard only optimizer state / grads — see
-    zero_shard_spec)."""
+    zero_shard_spec). Dims not divisible by ``zero_axis_size`` stay
+    replicated (small biases etc.)."""
     specs: Dict[str, P] = {}
     for name, p in layer.state_dict().items():
         axes = list(getattr(p, "sharding_axes", None) or
@@ -35,8 +37,8 @@ def param_partition_specs(layer: Layer,
         while len(axes) < len(p.shape):
             axes.append(None)
         if zero_stage >= 3 and zero_axis not in axes and p.shape:
-            # shard the largest free dim over the sharding axis
-            free = [i for i, a in enumerate(axes) if a is None]
+            free = [i for i, a in enumerate(axes)
+                    if a is None and p.shape[i] % max(zero_axis_size, 1) == 0]
             if free:
                 big = max(free, key=lambda i: p.shape[i])
                 axes[big] = zero_axis
@@ -44,17 +46,20 @@ def param_partition_specs(layer: Layer,
     return specs
 
 
-def zero_shard_spec(param_spec: P, shape, zero_axis: str = "sharding") -> P:
+def zero_shard_spec(param_spec: P, shape, zero_axis: str = "sharding",
+                    zero_axis_size: int = 1) -> P:
     """Spec for optimizer slot variables under ZeRO stage>=1: slots shard
     over the sharding axis on the largest dim not already sharded (the
     reference's sharding_optimizer assigns whole params to owner ranks;
-    GSPMD's per-dim sharding is strictly more uniform)."""
+    GSPMD's per-dim sharding is strictly more uniform). Non-divisible dims
+    stay replicated."""
     axes = list(param_spec) if param_spec else []
     while len(axes) < len(shape):
         axes.append(None)
     if zero_axis in axes or not shape:
         return P(*axes)
-    free = [i for i, a in enumerate(axes) if a is None]
+    free = [i for i, a in enumerate(axes)
+            if a is None and shape[i] % max(zero_axis_size, 1) == 0]
     if not free:
         return P(*axes)
     big = max(free, key=lambda i: shape[i])

@@ -1,6 +1,6 @@
-"""paddle1_tpu.text (reference python/paddle/text analog).
+"""paddle1_tpu.text (reference python/paddle/text analog) plus the BERT/
+ERNIE model zoo (BASELINE.md configs 3/4)."""
 
-NLP datasets/building blocks land with the BERT config (stage 6).
-"""
+from . import models
 
-__all__ = []
+__all__ = ["models"]

@@ -1,0 +1,248 @@
+"""BERT / ERNIE model family — the flagship transformer configs.
+
+The reference snapshot keeps BERT in the PaddleNLP companion repo built on
+``paddle.nn.TransformerEncoder`` (python/paddle/nn/layer/transformer.py:607);
+this module provides the same architecture natively so the framework's
+headline benchmark (BERT-base pretraining, BASELINE.md config 3) is
+self-contained.
+
+TPU-native notes:
+* One dense code path; tensor-parallel execution comes from tagging
+  ``Parameter.sharding_axes`` (consumed by distributed.sharding_specs →
+  pjit/GSPMD) via :func:`apply_megatron_sharding` — no parallel layer
+  classes needed for the GSPMD path.
+* Attention rides ``F.scaled_dot_product_attention`` (flash/Pallas path).
+* Everything is static-shape; masks are additive f32 tensors computed from
+  int token masks outside the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+from ...nn import functional as F
+from ...nn.initializer import Normal
+from ...nn.layer_base import Layer
+from ...nn.layer_common import Dropout, Embedding, Linear
+from ...nn.layer_norm_act import LayerNorm
+from ...nn.layer_transformer import TransformerEncoder, TransformerEncoderLayer
+
+__all__ = ["BertModel", "BertForPretraining", "BertPretrainingCriterion",
+           "BertForSequenceClassification", "ErnieModel",
+           "ErnieForPretraining", "apply_megatron_sharding", "bert_base",
+           "bert_large"]
+
+
+class BertEmbeddings(Layer):
+    """word + position + token_type embeddings → LayerNorm → dropout."""
+
+    def __init__(self, vocab_size, hidden_size, hidden_dropout_prob,
+                 max_position_embeddings, type_vocab_size,
+                 initializer_range=0.02):
+        super().__init__()
+        init = Normal(std=initializer_range)
+        from ...framework.param_attr import ParamAttr
+        attr = ParamAttr(initializer=init)
+        self.word_embeddings = Embedding(vocab_size, hidden_size,
+                                         weight_attr=attr)
+        self.position_embeddings = Embedding(max_position_embeddings,
+                                             hidden_size, weight_attr=attr)
+        self.token_type_embeddings = Embedding(type_vocab_size, hidden_size,
+                                               weight_attr=attr)
+        self.layer_norm = LayerNorm(hidden_size)
+        self.dropout = Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ...ops import manip_ops
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = manip_ops.arange(0, seq_len, 1, "int32")
+            position_ids = manip_ops.unsqueeze(position_ids, 0)
+        if token_type_ids is None:
+            token_type_ids = manip_ops.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        from ...ops import manip_ops
+        first = manip_ops.slice(hidden_states, [1], [0], [1])
+        first = manip_ops.squeeze(first, [1])
+        return F.tanh(self.dense(first))
+
+
+class BertModel(Layer):
+    """BERT encoder (paddlenlp-compatible constructor signature)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.pad_token_id = pad_token_id
+        self.initializer_range = initializer_range
+        self.embeddings = BertEmbeddings(
+            vocab_size, hidden_size, hidden_dropout_prob,
+            max_position_embeddings, type_vocab_size, initializer_range)
+        encoder_layer = TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob,
+            act_dropout=0.0)
+        self.encoder = TransformerEncoder(encoder_layer, num_hidden_layers)
+        self.pooler = BertPooler(hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        from ...autograd.engine import apply
+        if attention_mask is None:
+            import jax.numpy as jnp
+
+            def make_mask(ids):
+                pad = jnp.asarray(self.pad_token_id, ids.dtype)
+                keep = (ids != pad)
+                return jnp.where(keep, 0.0, -1e9).astype(
+                    jnp.float32)[:, None, None, :]
+            attention_mask = apply("bert_mask", make_mask, (input_ids,))
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        encoded = self.encoder(emb, attention_mask)
+        pooled = self.pooler(encoded)
+        return encoded, pooled
+
+
+class BertLMPredictionHead(Layer):
+    """MLM head: transform + LayerNorm + decoder tied to word embeddings."""
+
+    def __init__(self, hidden_size, vocab_size, activation="gelu",
+                 embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(hidden_size, hidden_size)
+        self.activation = getattr(F, activation)
+        self.layer_norm = LayerNorm(hidden_size)
+        # Tied decoder: reuse the word-embedding matrix [vocab, hidden].
+        self.decoder_weight = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            [vocab_size], is_bias=True)
+
+    def forward(self, hidden_states, masked_positions=None):
+        from ...ops import manip_ops, math_ops
+        if masked_positions is not None:
+            # gather the masked token positions: [B, S, H] → [B*M, H]
+            b, s, h = hidden_states.shape
+            flat = manip_ops.reshape(hidden_states, [b * s, h])
+            hidden_states = manip_ops.gather(flat, masked_positions)
+        x = self.layer_norm(self.activation(self.transform(hidden_states)))
+        logits = math_ops.matmul(x, self.decoder_weight, transpose_y=True)
+        return logits + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP pretraining heads over BertModel."""
+
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        self.cls = BertLMPredictionHead(
+            bert.hidden_size, bert.vocab_size,
+            embedding_weights=bert.embeddings.word_embeddings.weight)
+        self.seq_relationship = Linear(bert.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, masked_positions=None):
+        encoded, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                    attention_mask)
+        prediction_scores = self.cls(encoded, masked_positions)
+        seq_relationship_score = self.seq_relationship(pooled)
+        return prediction_scores, seq_relationship_score
+
+
+class BertPretrainingCriterion(Layer):
+    """MLM + NSP loss (softmax_with_cross_entropy over both heads)."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels,
+                masked_lm_scale=1.0):
+        mlm = F.cross_entropy(prediction_scores, masked_lm_labels,
+                              reduction="none", ignore_index=-1)
+        from ...ops import math_ops
+        mlm = math_ops.mean(math_ops.divide(
+            mlm, to_tensor(float(masked_lm_scale))))
+        nsp = F.cross_entropy(seq_relationship_score, next_sentence_labels,
+                              reduction="mean")
+        return mlm + nsp
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, bert: BertModel, num_classes=2, dropout=None):
+        super().__init__()
+        self.bert = bert
+        self.dropout = Dropout(dropout if dropout is not None else 0.1)
+        self.classifier = Linear(bert.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE shares the BERT architecture at this scale (ERNIE 1.0/2.0/3.0-base
+# differ in pretraining data/objectives, not the encoder).
+ErnieModel = BertModel
+ErnieForPretraining = BertForPretraining
+
+
+def bert_base(**kw) -> BertModel:
+    return BertModel(hidden_size=768, num_hidden_layers=12,
+                     num_attention_heads=12, intermediate_size=3072, **kw)
+
+
+def bert_large(**kw) -> BertModel:
+    return BertModel(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096, **kw)
+
+
+def apply_megatron_sharding(model: Layer, mp_axis: str = "mp") -> Layer:
+    """Tag transformer parameters with Megatron-style TP axes for GSPMD.
+
+    Column-parallel (shard output dim): q/k/v projections, FFN up-proj.
+    Row-parallel (shard input dim): attention out_proj, FFN down-proj.
+    Vocab-parallel: embedding + tied MLM decoder shard the vocab dim.
+    The reference expresses this with dedicated layer classes
+    (fleet/meta_parallel/parallel_layers/mp_layers.py:29,85,143); under
+    GSPMD the same partitioning is pure metadata on dense layers.
+    """
+    for name, p in model.named_parameters():
+        axes = [None] * len(p.shape)
+        if "word_embeddings" in name and len(p.shape) == 2:
+            axes[0] = mp_axis
+        elif any(k in name for k in ("q_proj", "k_proj", "v_proj",
+                                     "linear1")):
+            axes[-1] = mp_axis          # [in, out] → shard out
+        elif any(k in name for k in ("out_proj", "linear2")):
+            if len(p.shape) == 2:
+                axes[0] = mp_axis       # [in, out] → shard in
+        p.sharding_axes = tuple(axes) if any(a is not None
+                                             for a in axes) else None
+    return model

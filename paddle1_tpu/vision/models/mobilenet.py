@@ -1,0 +1,155 @@
+"""MobileNetV1/V2 (reference python/paddle/vision/models/
+{mobilenetv1,mobilenetv2}.py). Depthwise convs (groups == channels) lower to
+XLA grouped convolutions."""
+
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layer_common import Dropout, Linear
+from ...nn.layer_conv_pool import AdaptiveAvgPool2D, Conv2D
+from ...nn.layer_norm_act import BatchNorm2D, ReLU, ReLU6, Sequential
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, groups=1, act=ReLU):
+        super().__init__()
+        self.conv = Conv2D(in_channels, out_channels, kernel_size,
+                           stride=stride, padding=padding, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_channels)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, in_channels, out_channels1, out_channels2, stride,
+                 scale):
+        super().__init__()
+        c1 = int(out_channels1 * scale)
+        c2 = int(out_channels2 * scale)
+        self.depthwise = ConvBNLayer(in_channels, c1, 3, stride=stride,
+                                     padding=1, groups=in_channels)
+        self.pointwise = ConvBNLayer(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)
+        self.conv1 = ConvBNLayer(3, s(32), 3, stride=2, padding=1)
+        cfg = [  # in, out1, out2, stride
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2), (s(128), 128, 128, 1),
+            (s(128), 128, 256, 2), (s(256), 256, 256, 1),
+            (s(256), 256, 512, 2)] + [(s(512), 512, 512, 1)] * 5 + [
+            (s(512), 512, 1024, 2), (s(1024), 1024, 1024, 1)]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(i, o1, o2, st, scale) for i, o1, o2, st in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops import manip_ops
+            x = self.fc(manip_ops.flatten(x, 1))
+        return x
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden_dim = int(round(inp * expand_ratio))
+        self.use_res_connect = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(inp, hidden_dim, 1, act=ReLU6))
+        layers += [
+            ConvBNLayer(hidden_dim, hidden_dim, 3, stride=stride, padding=1,
+                        groups=hidden_dim, act=ReLU6),
+            ConvBNLayer(hidden_dim, oup, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res_connect else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = _make_divisible(32 * scale)
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        features = [ConvBNLayer(3, input_channel, 3, stride=2, padding=1,
+                                act=ReLU6)]
+        for t, c, n, s in cfg:
+            output_channel = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    input_channel, output_channel, s if i == 0 else 1, t))
+                input_channel = output_channel
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNLayer(input_channel, self.last_channel, 1,
+                                    act=ReLU6))
+        self.features = Sequential(*features)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_channel,
+                                                num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops import manip_ops
+            x = self.classifier(manip_ops.flatten(x, 1))
+        return x
+
+
+def _check_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no network egress); "
+            "load a checkpoint explicitly with paddle.load + set_state_dict")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _check_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _check_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)

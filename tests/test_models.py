@@ -1,0 +1,110 @@
+"""Model-zoo smoke + training tests (reference test strategy §4:
+test_imperative_resnet.py, book/ e2e tests)."""
+
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+
+
+class TestVisionModels(unittest.TestCase):
+    def _fwd(self, model, size=32):
+        model.eval()
+        x = paddle.to_tensor(
+            np.random.randn(2, 3, size, size).astype(np.float32))
+        return model(x)
+
+    def test_resnet18_forward(self):
+        from paddle1_tpu.vision.models import resnet18
+        y = self._fwd(resnet18(num_classes=10), 64)
+        self.assertEqual(y.shape, [2, 10])
+
+    def test_resnet50_forward(self):
+        from paddle1_tpu.vision.models import resnet50
+        y = self._fwd(resnet50(num_classes=10), 64)
+        self.assertEqual(y.shape, [2, 10])
+
+    def test_mobilenets(self):
+        from paddle1_tpu.vision.models import mobilenet_v1, mobilenet_v2
+        self.assertEqual(self._fwd(mobilenet_v1(num_classes=7), 64).shape,
+                         [2, 7])
+        self.assertEqual(self._fwd(mobilenet_v2(num_classes=7), 64).shape,
+                         [2, 7])
+
+    def test_resnet_train_step(self):
+        from paddle1_tpu.vision.models import resnet18
+        m = resnet18(num_classes=4)
+        m.train()
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.randn(2, 3, 32, 32).astype(np.float32))
+        label = paddle.to_tensor(np.array([1, 3], np.int64))
+        out = m(x)
+        loss = paddle.nn.functional.cross_entropy(out, label)
+        loss.backward()
+        g = m.conv1.weight.grad
+        self.assertIsNotNone(g)
+        self.assertGreater(float(np.abs(g.numpy()).sum()), 0.0)
+        opt.step()
+
+
+class TestBert(unittest.TestCase):
+    def _tiny(self):
+        from paddle1_tpu.text.models import (BertForPretraining, BertModel,
+                                             BertPretrainingCriterion)
+        model = BertForPretraining(BertModel(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=16))
+        return model, BertPretrainingCriterion(99)
+
+    def test_pretrain_forward_backward(self):
+        model, crit = self._tiny()
+        ids = paddle.to_tensor(
+            np.random.randint(1, 99, (2, 8)).astype(np.int32))
+        mlm = paddle.to_tensor(
+            np.random.randint(0, 99, (2, 8)).astype(np.int32))
+        nsp = paddle.to_tensor(np.random.randint(0, 2, (2,)).astype(np.int32))
+        scores, rel = model(ids)
+        self.assertEqual(scores.shape, [2, 8, 99])
+        self.assertEqual(rel.shape, [2, 2])
+        loss = crit(scores, rel, mlm, nsp)
+        loss.backward()
+        g = model.bert.embeddings.word_embeddings.weight.grad
+        self.assertIsNotNone(g)
+
+    def test_tied_decoder_gets_both_grads(self):
+        """MLM decoder is tied to the word embedding: its grad must include
+        both the lookup path and the output-projection path."""
+        model, crit = self._tiny()
+        w = model.bert.embeddings.word_embeddings.weight
+        self.assertIs(model.cls.decoder_weight, w)
+
+    def test_sequence_classification(self):
+        from paddle1_tpu.text.models import (BertForSequenceClassification,
+                                             BertModel)
+        m = BertForSequenceClassification(BertModel(
+            vocab_size=50, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=16), num_classes=3)
+        m.eval()
+        out = m(paddle.to_tensor(
+            np.random.randint(1, 50, (2, 8)).astype(np.int32)))
+        self.assertEqual(out.shape, [2, 3])
+
+    def test_megatron_sharding_tags(self):
+        from paddle1_tpu.text.models import apply_megatron_sharding
+        model, _ = self._tiny()
+        apply_megatron_sharding(model)
+        params = dict(model.named_parameters())
+        self.assertEqual(
+            params["bert.encoder.layers.0.self_attn.q_proj.weight"]
+            .sharding_axes, (None, "mp"))
+        self.assertEqual(
+            params["bert.encoder.layers.0.self_attn.out_proj.weight"]
+            .sharding_axes, ("mp", None))
+        self.assertEqual(
+            params["bert.embeddings.word_embeddings.weight"].sharding_axes,
+            ("mp", None))
