@@ -29,6 +29,7 @@ from . import autograd
 # sync with the build plan (SURVEY.md §7).
 from . import nn
 from . import optimizer
+from . import profiler
 from . import amp
 from . import io
 from . import metric

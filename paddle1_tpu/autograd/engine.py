@@ -120,6 +120,16 @@ def apply(name: str, pure_fn: Callable, tensor_inputs: Sequence[Tensor],
     Tensors are passed through as-is (static arguments). This is the single
     choke-point all eager ops go through — the TraceOp analog.
     """
+    from .. import profiler as _prof
+    if not _prof._enabled:
+        return _apply_impl(name, pure_fn, tensor_inputs, n_outputs, **attrs)
+    with _prof.RecordEvent(name):
+        return _apply_impl(name, pure_fn, tensor_inputs, n_outputs, **attrs)
+
+
+def _apply_impl(name: str, pure_fn: Callable,
+                tensor_inputs: Sequence[Tensor],
+                n_outputs: Optional[int] = None, **attrs) -> Any:
     arrays = [t.data if isinstance(t, Tensor) else t for t in tensor_inputs]
 
     # AMP auto-cast (reference imperative/amp_auto_cast.cc): white-list ops

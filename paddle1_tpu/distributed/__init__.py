@@ -19,6 +19,8 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        build_mesh, get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 from . import sharding_specs
+from . import sequence_parallel
+from .sequence_parallel import ring_attention, ulysses_attention
 from .parallel_engine import ParallelEngine, make_train_step
 from .spawn import spawn
 
@@ -43,4 +45,5 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
            "get_hybrid_communicate_group", "set_hybrid_communicate_group",
            "sharding_specs", "spawn", "launch", "ParallelEngine",
-           "make_train_step"]
+           "make_train_step", "sequence_parallel", "ring_attention",
+           "ulysses_attention"]

@@ -35,9 +35,11 @@ def recompute(function: Callable, *args, **kwargs):
         raise TypeError(f"recompute got unexpected kwargs {list(kwargs)}")
 
     layer = function if isinstance(function, Layer) else None
-    if layer is None and isinstance(getattr(function, "__self__", None),
-                                    Layer):
-        layer = function.__self__  # bound Layer.forward
+    bound_self = getattr(function, "__self__", None)
+    bound_method = None
+    if layer is None and isinstance(bound_self, Layer):
+        layer = bound_self      # bound method of a Layer: params threadable
+        bound_method = function  # may be forward or any other method
     key = next_key()
 
     # split args into traced tensors and static (non-tensor) values,
@@ -51,7 +53,8 @@ def recompute(function: Callable, *args, **kwargs):
             full[pos] = Tensor(arr, stop_gradient=True)
         return full
 
-    fwd_callable = layer.forward if layer is not None else function
+    fwd_callable = (bound_method if bound_method is not None else
+                    layer.forward if layer is not None else function)
 
     if layer is not None:
         names = list(layer.functional_state().keys())

@@ -117,19 +117,26 @@ class SegmentLayers:
             return bounds
         raise InvalidArgumentError(f"Unknown segment method {self.method}")
 
-    @staticmethod
-    def _param_count(desc) -> int:
+    _count_cache: dict = {}
+
+    @classmethod
+    def _param_count(cls, desc) -> int:
         if isinstance(desc, Layer):
             return sum(int(np.prod(p.shape)) for p in desc.parameters()) or 1
         if isinstance(desc, LayerDesc):
-            # Build once to measure (tiny next to training cost; the
-            # reference instead re-declares sizes in the desc).
-            try:
-                built = desc.build_layer()
-                return sum(int(np.prod(p.shape))
-                           for p in built.parameters()) or 1
-            except Exception:
-                return 1
+            # Measuring requires building; cache per constructor signature
+            # so homogeneous stacks (N identical blocks) build ONE sample
+            # layer, not N — the built sample is dropped immediately.
+            key = (desc.layer_func, repr(desc.inputs), repr(desc.kwargs))
+            if key not in cls._count_cache:
+                try:
+                    built = desc.build_layer()
+                    cls._count_cache[key] = sum(
+                        int(np.prod(p.shape))
+                        for p in built.parameters()) or 1
+                except Exception:
+                    cls._count_cache[key] = 1
+            return cls._count_cache[key]
         return 1
 
 

@@ -135,13 +135,10 @@ class ParallelEngine:
         # arguments (donation requires it) and receive ONE update combining
         # both gradient paths.
         sd = model.state_dict()
-        self._aliases: Dict[str, str] = {}
         seen: Dict[int, str] = {}
         self.params = {}
         for k, t in sd.items():
-            if id(t) in seen:
-                self._aliases[k] = seen[id(t)]
-            else:
+            if id(t) not in seen:  # aliases write back via shared Tensor
                 seen[id(t)] = k
                 self.params[k] = t.data
         shard_n = int(self.mesh.shape.get("sharding", 1))
@@ -210,7 +207,7 @@ class ParallelEngine:
         def place(a):
             s = spec if spec is not None else data_partition_spec(
                 tuple(ax for ax in ("dp", "sharding")
-                      if self.mesh.shape.get(ax, 1) >= 1))
+                      if ax in self.mesh.shape))
             axes = list(s)
             if self.grad_accum > 1:
                 axes = [None] + axes  # leading dim = accumulation steps

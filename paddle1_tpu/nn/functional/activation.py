@@ -27,10 +27,11 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _un(name, fn):
+def _un(opname, fn):
+    # the paddle-API `name=None` kwarg must not shadow the op name
     def op(x, name=None):
-        return apply(name, fn, (_t(x),))
-    op.__name__ = name
+        return apply(opname, fn, (_t(x),))
+    op.__name__ = opname
     return op
 
 

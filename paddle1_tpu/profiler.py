@@ -1,0 +1,160 @@
+"""Profiler (reference paddle/fluid/platform/profiler.* + python
+fluid/profiler.py:190-314 + tools/timeline.py).
+
+Two layers, mirroring the reference's host+device design:
+
+* **Host spans** — ``RecordEvent`` RAII/context spans with nesting, a global
+  registry, and min/max/avg aggregation tables printed by ``stop_profiler``
+  (the reference's EnableProfiler/DisableProfiler tables).
+* **Device timeline** — delegated to ``jax.profiler`` (XPlane/TensorBoard),
+  which captures XLA execution on TPU the way CUPTI captured CUDA kernels;
+  ``profiler(..., tracer_option)`` context manager starts/stops a trace dir
+  viewable in TensorBoard or Perfetto.
+
+Chrome-trace export: host spans serialize to the chrome://tracing JSON
+format directly (the reference needed tools/timeline.py:115 to convert its
+proto; we emit the final format)."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
+           "reset_profiler", "export_chrome_tracing"]
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []          # completed spans
+_tls = threading.local()
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+class RecordEvent:
+    """Named host span (reference platform/profiler.h:127 RecordEvent).
+    Usable as context manager or begin()/end() pair."""
+
+    def __init__(self, name: str, event_type: str = "Operator"):
+        self.name = str(name) if name is not None else "<unnamed>"
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        if not _enabled:
+            return self
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._begin = _now_us()
+        stack.append(self)
+        return self
+
+    def end(self):
+        if not _enabled or self._begin is None:
+            return
+        stack = getattr(_tls, "stack", [])
+        if stack and stack[-1] is self:
+            stack.pop()
+        ev = {"name": self.name, "type": self.event_type,
+              "ts": self._begin, "dur": _now_us() - self._begin,
+              "tid": threading.get_ident(),
+              "depth": len(stack)}
+        with _lock:
+            _events.append(ev)
+        self._begin = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def reset_profiler():
+    global _events
+    with _lock:
+        _events = []
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   log_dir: Optional[str] = None):
+    """Enable host-span recording; with a log_dir also start the device
+    (XLA) trace (reference profiler.py:190 start_profiler)."""
+    global _enabled
+    reset_profiler()
+    _enabled = True
+    if log_dir:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        _tls.trace_dir = log_dir
+
+
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: Optional[str] = None):
+    """Stop, aggregate, print the event table; optionally write chrome
+    trace JSON (reference profiler.py:260 stop_profiler)."""
+    global _enabled
+    _enabled = False
+    if getattr(_tls, "trace_dir", None):
+        import jax
+        jax.profiler.stop_trace()
+        _tls.trace_dir = None
+    with _lock:
+        events = list(_events)
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        agg[ev["name"]].append(ev["dur"])
+    rows = []
+    for name, durs in agg.items():
+        rows.append((name, len(durs), sum(durs), sum(durs) / len(durs),
+                     min(durs), max(durs)))
+    key_idx = {"total": 2, "calls": 1, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    if rows:
+        print(f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Ave(us)':>12}"
+              f"{'Min(us)':>12}{'Max(us)':>12}")
+        for r in rows:
+            print(f"{r[0]:<40}{r[1]:>8}{r[2]:>14.1f}{r[3]:>12.1f}"
+                  f"{r[4]:>12.1f}{r[5]:>12.1f}")
+    if profile_path:
+        export_chrome_tracing(profile_path, events)
+    return rows
+
+
+def export_chrome_tracing(path: str, events: Optional[List[dict]] = None):
+    """Write chrome://tracing JSON (the reference's timeline.py output)."""
+    if events is None:
+        with _lock:
+            events = list(_events)
+    trace = {"traceEvents": [
+        {"name": ev["name"], "cat": ev["type"], "ph": "X",
+         "ts": ev["ts"], "dur": ev["dur"], "pid": os.getpid(),
+         "tid": ev["tid"]}
+        for ev in events]}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None,
+             tracer_option: str = "Default",
+             log_dir: Optional[str] = None):
+    """Context manager (reference fluid/profiler.py:314 profiler)."""
+    start_profiler(state, tracer_option, log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,3 +1,7 @@
 """paddle1_tpu.vision (reference python/paddle/vision analog)."""
 
+from . import datasets
 from . import models
+from . import transforms
+
+__all__ = ["datasets", "models", "transforms"]

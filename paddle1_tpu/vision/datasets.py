@@ -1,0 +1,139 @@
+"""Builtin vision datasets (reference python/paddle/vision/datasets/:
+MNIST, FashionMNIST, Cifar10/100, Flowers). This environment has no network
+egress, so ``download=True`` raises with instructions; parsers read the
+standard archive formats from ``data_file``/``image_path`` like the
+reference. ``FakeData`` provides deterministic synthetic images so examples
+and tests run hermetically (the simulated-data analog of SURVEY §4's
+simulated-mesh backend)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress). "
+        "Place the official archive locally and pass its path "
+        "(image_path/label_path or data_file).")
+
+
+class MNIST(Dataset):
+    """IDX-format parser (reference vision/datasets/mnist.py)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            _no_download(self.NAME)
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _parse_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _parse_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([label], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """cifar-10-python.tar.gz parser (reference vision/datasets/cifar.py)."""
+
+    NAME = "Cifar10"
+    _SUB = {"train": "data_batch", "test": "test_batch"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            _no_download(self.NAME)
+        self.data = []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [n for n in tf.getnames()
+                     if self._SUB[self.mode] in n]
+            for name in sorted(names):
+                batch = pickle.load(tf.extractfile(name), encoding="bytes")
+                images = batch[b"data"].reshape(-1, 3, 32, 32)
+                labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                for img, lab in zip(images, labels):
+                    self.data.append((img, lab))
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        img = img.transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([label], np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    NAME = "Cifar100"
+    _SUB = {"train": "train", "test": "test"}
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (hermetic tests)."""
+
+    def __init__(self, num_samples=256, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._images = self._rng.integers(
+            0, 256, (num_samples,) + self.image_shape[1:] +
+            (self.image_shape[0],), dtype=np.uint8)
+        self._labels = self._rng.integers(0, num_classes, num_samples)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self._labels[idx]], np.int64)
+
+    def __len__(self):
+        return self.num_samples

@@ -1,0 +1,16 @@
+"""paddle.vision.transforms analog."""
+
+from . import functional
+from .transforms import (BaseTransform, BrightnessTransform, CenterCrop,
+                         ColorJitter, Compose, ContrastTransform, Grayscale,
+                         HueTransform, Normalize, Pad, RandomCrop,
+                         RandomHorizontalFlip, RandomResizedCrop,
+                         RandomRotation, RandomVerticalFlip, Resize,
+                         SaturationTransform, ToTensor, Transpose)
+
+__all__ = ["functional", "BaseTransform", "Compose", "ToTensor", "Normalize",
+           "Resize", "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomResizedCrop", "RandomRotation",
+           "ColorJitter", "Grayscale", "Pad", "Transpose",
+           "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+           "HueTransform"]

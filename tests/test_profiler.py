@@ -1,0 +1,54 @@
+"""Profiler: host spans, aggregation table, chrome trace export
+(reference fluid/tests test_profiler.py)."""
+
+import json
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu import profiler as prof
+
+
+class TestProfiler(unittest.TestCase):
+    def test_spans_and_export(self):
+        prof.start_profiler()
+        with prof.RecordEvent("outer"):
+            x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+            y = paddle.matmul(x, x)
+            _ = y.numpy()
+        rows = prof.stop_profiler()
+        names = [r[0] for r in rows]
+        self.assertIn("outer", names)
+        self.assertIn("matmul", names)  # eager dispatch auto-instrumented
+
+    def test_chrome_trace_format(self):
+        prof.start_profiler()
+        with prof.RecordEvent("evt"):
+            pass
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.json")
+            prof.stop_profiler(profile_path=path)
+            with open(path) as f:
+                trace = json.load(f)
+            self.assertIn("traceEvents", trace)
+            evts = [e for e in trace["traceEvents"] if e["name"] == "evt"]
+            self.assertEqual(len(evts), 1)
+            self.assertEqual(evts[0]["ph"], "X")
+
+    def test_disabled_is_noop(self):
+        prof.reset_profiler()
+        with prof.RecordEvent("nope"):
+            pass
+        rows = prof.stop_profiler()
+        self.assertEqual(rows, [])
+
+    def test_context_manager(self):
+        with prof.profiler():
+            x = paddle.to_tensor(np.ones((4, 4), np.float32))
+            (x + x).numpy()
+        # re-entrant: second use works
+        with prof.profiler():
+            pass

@@ -1,0 +1,104 @@
+"""vision.transforms + datasets + DataLoader integration (reference
+test strategy: test_transforms.py, test_datasets.py)."""
+
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu.vision import transforms as T
+from paddle1_tpu.vision.datasets import FakeData
+
+
+class TestTransforms(unittest.TestCase):
+    def setUp(self):
+        self.img = np.random.randint(0, 256, (40, 60, 3), np.uint8)
+
+    def test_to_tensor_chw_scale(self):
+        t = T.functional.to_tensor(self.img)
+        self.assertEqual(t.shape, [3, 40, 60])
+        self.assertLessEqual(float(t.numpy().max()), 1.0)
+
+    def test_resize_shapes(self):
+        self.assertEqual(T.functional.resize(self.img, (20, 30)).shape,
+                         (20, 30, 3))
+        # int size resizes the short side
+        out = T.functional.resize(self.img, 20)
+        self.assertEqual(out.shape[0], 20)
+
+    def test_resize_identity(self):
+        out = T.functional.resize(self.img, (40, 60))
+        np.testing.assert_array_equal(out, self.img)
+
+    def test_crop_flip_pad(self):
+        self.assertEqual(T.functional.center_crop(self.img, 24).shape,
+                         (24, 24, 3))
+        np.testing.assert_array_equal(T.functional.hflip(self.img),
+                                      self.img[:, ::-1])
+        self.assertEqual(T.functional.pad(self.img, 2).shape, (44, 64, 3))
+
+    def test_normalize(self):
+        t = T.functional.to_tensor(self.img)
+        out = T.functional.normalize(t, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        self.assertAlmostEqual(
+            float(out.numpy().mean()),
+            float((t.numpy() - 0.5).mean() / 0.5), places=5)
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([
+            T.Resize(32), T.RandomCrop(28), T.RandomHorizontalFlip(),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(self.img)
+        self.assertEqual(out.shape, [3, 28, 28])
+
+    def test_color_ops_preserve_dtype(self):
+        for fn in (lambda i: T.functional.adjust_brightness(i, 1.2),
+                   lambda i: T.functional.adjust_contrast(i, 0.8),
+                   lambda i: T.functional.adjust_saturation(i, 1.5),
+                   lambda i: T.functional.adjust_hue(i, 0.1)):
+            out = fn(self.img)
+            self.assertEqual(out.dtype, np.uint8)
+            self.assertEqual(out.shape, self.img.shape)
+
+    def test_hue_identity(self):
+        out = T.functional.adjust_hue(self.img, 0.0)
+        self.assertLessEqual(
+            np.abs(out.astype(int) - self.img.astype(int)).max(), 2)
+
+
+class TestDatasets(unittest.TestCase):
+    def test_fake_data_loader(self):
+        ds = FakeData(num_samples=32, image_shape=(3, 16, 16), num_classes=4,
+                      transform=T.Compose([T.ToTensor()]))
+        loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True)
+        batches = list(loader)
+        self.assertEqual(len(batches), 4)
+        x, y = batches[0]
+        self.assertEqual(list(x.shape), [8, 3, 16, 16])
+        self.assertEqual(list(y.shape), [8, 1])
+
+    def test_download_raises(self):
+        from paddle1_tpu.vision.datasets import MNIST
+        with self.assertRaises(RuntimeError):
+            MNIST()
+
+    def test_mnist_parser(self):
+        """Round-trip the IDX format through a generated file."""
+        import gzip, struct, tempfile, os
+        imgs = np.random.randint(0, 256, (10, 28, 28), np.uint8)
+        labels = np.random.randint(0, 10, 10).astype(np.uint8)
+        with tempfile.TemporaryDirectory() as d:
+            ip = os.path.join(d, "img.gz")
+            lp = os.path.join(d, "lab.gz")
+            with gzip.open(ip, "wb") as f:
+                f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+                f.write(imgs.tobytes())
+            with gzip.open(lp, "wb") as f:
+                f.write(struct.pack(">II", 2049, 10))
+                f.write(labels.tobytes())
+            from paddle1_tpu.vision.datasets import MNIST
+            ds = MNIST(image_path=ip, label_path=lp)
+            self.assertEqual(len(ds), 10)
+            img, lab = ds[3]
+            np.testing.assert_array_equal(img, imgs[3])
+            self.assertEqual(int(lab[0]), int(labels[3]))
