@@ -30,6 +30,9 @@ from . import autograd
 from . import nn
 from . import optimizer
 from . import profiler
+from . import distribution
+from . import sysconfig
+from . import onnx
 from . import amp
 from . import io
 from . import metric

@@ -1,0 +1,272 @@
+// paddle1_tpu native runtime — C++ host-side components.
+//
+// TPU-native analogs of the reference's C++ runtime pieces that XLA does
+// NOT subsume (SURVEY §2.1):
+//   * BoundedQueue  — the BufferedReader/blocking-queue substrate
+//     (reference paddle/fluid/operators/reader/buffered_reader.h:36,
+//     reader/blocking_queue.h): producer threads stage ready host batches
+//     while the accelerator consumes, without holding the Python GIL.
+//   * ShmArena      — multiprocess DataLoader shared memory
+//     (reference paddle/fluid/memory/allocation/mmap_allocator.cc): POSIX
+//     shm slabs with a bump/free-list allocator and cross-process
+//     refcounts, so worker → parent tensor transfer is zero-copy.
+//   * StatRegistry  — named global gauges
+//     (reference paddle/fluid/platform/monitor.h:77 StatRegistry/STAT_ADD).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// BoundedQueue: MPMC blocking queue of opaque byte buffers.
+// ---------------------------------------------------------------------------
+
+struct Buffer {
+  std::vector<uint8_t> data;
+};
+
+struct BoundedQueue {
+  explicit BoundedQueue(size_t cap) : capacity(cap) {}
+  size_t capacity;
+  std::deque<Buffer*> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool closed = false;
+};
+
+void* pq_create(size_t capacity) { return new BoundedQueue(capacity); }
+
+void pq_destroy(void* q) {
+  auto* bq = static_cast<BoundedQueue*>(q);
+  std::lock_guard<std::mutex> g(bq->mu);
+  for (auto* b : bq->items) delete b;
+  bq->items.clear();
+  // note: destruction with blocked waiters is a caller bug; close first.
+  delete bq;
+}
+
+// Returns 0 on success, -1 if closed. Blocks while full.
+int pq_put(void* q, const uint8_t* data, size_t len, int64_t timeout_ms) {
+  auto* bq = static_cast<BoundedQueue*>(q);
+  std::unique_lock<std::mutex> lk(bq->mu);
+  auto pred = [&] { return bq->closed || bq->items.size() < bq->capacity; };
+  if (timeout_ms < 0) {
+    bq->not_full.wait(lk, pred);
+  } else if (!bq->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -2;  // timeout
+  }
+  if (bq->closed) return -1;
+  auto* buf = new Buffer();
+  buf->data.assign(data, data + len);
+  bq->items.push_back(buf);
+  bq->not_empty.notify_one();
+  return 0;
+}
+
+// Blocks while empty. Returns buffer handle or nullptr if closed+drained.
+void* pq_get(void* q, int64_t timeout_ms) {
+  auto* bq = static_cast<BoundedQueue*>(q);
+  std::unique_lock<std::mutex> lk(bq->mu);
+  auto pred = [&] { return bq->closed || !bq->items.empty(); };
+  if (timeout_ms < 0) {
+    bq->not_empty.wait(lk, pred);
+  } else if (!bq->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                     pred)) {
+    return nullptr;
+  }
+  if (bq->items.empty()) return nullptr;  // closed & drained
+  auto* buf = bq->items.front();
+  bq->items.pop_front();
+  bq->not_full.notify_one();
+  return buf;
+}
+
+size_t pq_size(void* q) {
+  auto* bq = static_cast<BoundedQueue*>(q);
+  std::lock_guard<std::mutex> g(bq->mu);
+  return bq->items.size();
+}
+
+void pq_close(void* q) {
+  auto* bq = static_cast<BoundedQueue*>(q);
+  std::lock_guard<std::mutex> g(bq->mu);
+  bq->closed = true;
+  bq->not_empty.notify_all();
+  bq->not_full.notify_all();
+}
+
+const uint8_t* buf_data(void* b) {
+  return static_cast<Buffer*>(b)->data.data();
+}
+size_t buf_len(void* b) { return static_cast<Buffer*>(b)->data.size(); }
+void buf_free(void* b) { delete static_cast<Buffer*>(b); }
+
+// ---------------------------------------------------------------------------
+// ShmArena: POSIX shared-memory slab with block allocator + refcounts.
+// Layout: [ArenaHeader][BlockHeader data...]*
+// ---------------------------------------------------------------------------
+
+struct ArenaHeader {
+  uint64_t magic;           // 0x50311A7E
+  uint64_t size;            // total bytes
+  std::atomic<uint64_t> bump;  // offset of next free byte
+};
+
+struct BlockHeader {
+  uint64_t len;             // payload bytes
+  std::atomic<int64_t> refs;
+};
+
+static const uint64_t kMagic = 0x50311A7EULL;
+
+// Create (or attach to) a named shm arena; returns mapped base or null.
+void* shm_arena_create(const char* name, uint64_t size) {
+  int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<ArenaHeader*>(base);
+  if (hdr->magic != kMagic) {
+    hdr->magic = kMagic;
+    hdr->size = size;
+    hdr->bump.store(sizeof(ArenaHeader));
+  }
+  return base;
+}
+
+void* shm_arena_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  return base == MAP_FAILED ? nullptr : base;
+}
+
+void shm_arena_detach(void* base) {
+  auto* hdr = static_cast<ArenaHeader*>(base);
+  munmap(base, hdr->size);
+}
+
+uint64_t shm_arena_size(void* base) {
+  return static_cast<ArenaHeader*>(base)->size;
+}
+
+void shm_arena_unlink(const char* name) { shm_unlink(name); }
+
+// Allocate a refcounted block; returns offset of the payload (0 on failure).
+uint64_t shm_alloc(void* base, uint64_t len) {
+  auto* hdr = static_cast<ArenaHeader*>(base);
+  uint64_t need = sizeof(BlockHeader) + ((len + 63) & ~63ULL);
+  uint64_t off = hdr->bump.fetch_add(need);
+  if (off + need > hdr->size) {
+    hdr->bump.fetch_sub(need);  // roll back; arena full
+    return 0;
+  }
+  auto* blk = reinterpret_cast<BlockHeader*>(static_cast<char*>(base) + off);
+  blk->len = len;
+  blk->refs.store(1);
+  return off + sizeof(BlockHeader);
+}
+
+uint8_t* shm_ptr(void* base, uint64_t payload_off) {
+  return reinterpret_cast<uint8_t*>(base) + payload_off;
+}
+
+static BlockHeader* blk_of(void* base, uint64_t payload_off) {
+  return reinterpret_cast<BlockHeader*>(static_cast<char*>(base) +
+                                        payload_off - sizeof(BlockHeader));
+}
+
+void shm_incref(void* base, uint64_t payload_off) {
+  blk_of(base, payload_off)->refs.fetch_add(1);
+}
+
+// Returns refcount after decrement (block memory reclaimed only on reset).
+int64_t shm_decref(void* base, uint64_t payload_off) {
+  return blk_of(base, payload_off)->refs.fetch_sub(1) - 1;
+}
+
+// Reset the bump pointer (all blocks must be released; epoch-style reuse,
+// which is the DataLoader pattern: arena per epoch/prefetch window).
+void shm_arena_reset(void* base) {
+  auto* hdr = static_cast<ArenaHeader*>(base);
+  hdr->bump.store(sizeof(ArenaHeader));
+}
+
+uint64_t shm_arena_used(void* base) {
+  return static_cast<ArenaHeader*>(base)->bump.load();
+}
+
+// ---------------------------------------------------------------------------
+// StatRegistry: named int64 gauges (monitor.h STAT_ADD analog).
+// ---------------------------------------------------------------------------
+
+static std::mutex g_stats_mu;
+static std::map<std::string, int64_t>& stats() {
+  static std::map<std::string, int64_t> s;
+  return s;
+}
+
+void stat_add(const char* name, int64_t v) {
+  std::lock_guard<std::mutex> g(g_stats_mu);
+  stats()[name] += v;
+}
+
+void stat_set(const char* name, int64_t v) {
+  std::lock_guard<std::mutex> g(g_stats_mu);
+  stats()[name] = v;
+}
+
+int64_t stat_get(const char* name) {
+  std::lock_guard<std::mutex> g(g_stats_mu);
+  auto it = stats().find(name);
+  return it == stats().end() ? 0 : it->second;
+}
+
+// Fill up to cap entries; returns count. Names joined by '\n' into out_names.
+int64_t stat_dump(char* out_names, int64_t cap_bytes, int64_t* out_vals,
+                  int64_t cap_vals) {
+  std::lock_guard<std::mutex> g(g_stats_mu);
+  std::string joined;
+  int64_t n = 0;
+  for (auto& kv : stats()) {
+    if (n >= cap_vals) break;
+    if ((int64_t)(joined.size() + kv.first.size() + 1) > cap_bytes) break;
+    joined += kv.first;
+    joined += '\n';
+    out_vals[n++] = kv.second;
+  }
+  std::memcpy(out_names, joined.data(), joined.size());
+  if ((int64_t)joined.size() < cap_bytes) out_names[joined.size()] = 0;
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,155 @@
+"""Probability distributions (reference python/paddle/distribution.py:
+Distribution, Uniform, Normal, Categorical — the v2.0 snapshot's surface).
+
+Sampling draws from the framework PRNG (core.generator), so seeds behave
+like the rest of the library; all math is eager-op based and therefore
+differentiable and jit-traceable."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd.engine import apply
+from .core.errors import InvalidArgumentError
+from .core.generator import next_key
+from .core.tensor import Tensor, to_tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _t(x, dtype="float32"):
+    return x if isinstance(x, Tensor) else to_tensor(
+        np.asarray(x, np.float32) if not isinstance(x, Tensor) else x,
+        dtype=dtype)
+
+
+class Distribution:
+    """Abstract base (reference distribution.py Distribution)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from .ops import math_ops
+        return math_ops.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(low, high):
+            bshape = shape + tuple(np.broadcast_shapes(low.shape, high.shape))
+            u = jax.random.uniform(key, bshape, jnp.float32)
+            return low + u * (high - low)
+        return apply("uniform_sample", f, (self.low, self.high))
+
+    def log_prob(self, value):
+        def f(v, low, high):
+            inside = (v >= low) & (v < high)
+            lp = -jnp.log(high - low)
+            return jnp.where(inside, lp, -jnp.inf)
+        return apply("uniform_log_prob", f, (_t(value), self.low, self.high))
+
+    def entropy(self):
+        def f(low, high):
+            return jnp.log(high - low)
+        return apply("uniform_entropy", f, (self.low, self.high))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(loc, scale):
+            bshape = shape + tuple(np.broadcast_shapes(loc.shape,
+                                                       scale.shape))
+            z = jax.random.normal(key, bshape, jnp.float32)
+            return loc + z * scale
+        return apply("normal_sample", f, (self.loc, self.scale))
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var) - jnp.log(scale) -
+                    0.5 * math.log(2 * math.pi))
+        return apply("normal_log_prob", f, (_t(value), self.loc, self.scale))
+
+    def entropy(self):
+        def f(loc, scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+                scale * jnp.ones_like(loc))
+        return apply("normal_entropy", f, (self.loc, self.scale))
+
+    def kl_divergence(self, other: "Normal"):
+        if not isinstance(other, Normal):
+            raise InvalidArgumentError("kl_divergence expects Normal")
+
+        def f(l0, s0, l1, s1):
+            var0, var1 = s0 * s0, s1 * s1
+            return (0.5 * (var0 / var1 + (l1 - l0) ** 2 / var1 - 1.0) +
+                    jnp.log(s1 / s0))
+        return apply("normal_kl", f, (self.loc, self.scale, other.loc,
+                                      other.scale))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(logits):
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=shape + logits.shape[:-1])
+        return apply("categorical_sample", f, (self.logits,))
+
+    def log_prob(self, value):
+        def f(logits, v):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            idx = v.astype(jnp.int32)
+            # broadcast category axis against the value batch shape
+            logp = jnp.broadcast_to(logp, idx.shape + logp.shape[-1:])
+            return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+        return apply("categorical_log_prob", f, (self.logits, _t(value,
+                                                                 "int64")))
+
+    def entropy(self):
+        def f(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            p = jnp.exp(logp)
+            return -jnp.sum(p * logp, axis=-1)
+        return apply("categorical_entropy", f, (self.logits,))
+
+    def kl_divergence(self, other: "Categorical"):
+        def f(a, b):
+            pa = jax.nn.log_softmax(a, axis=-1)
+            pb = jax.nn.log_softmax(b, axis=-1)
+            return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
+        return apply("categorical_kl", f, (self.logits, other.logits))

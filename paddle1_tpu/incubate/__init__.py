@@ -2,4 +2,8 @@
 fluid/incubate): auto-checkpoint, functional higher-order autodiff bridge.
 """
 
+from . import auto_checkpoint
 from . import functional
+from .auto_checkpoint import train_epoch_range
+
+__all__ = ["auto_checkpoint", "functional", "train_epoch_range"]

@@ -1,0 +1,128 @@
+"""Auto-checkpoint / elastic resume.
+
+Analog of the reference's auto-checkpoint
+(python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71
+train_epoch_range, :598) and the hapi ModelCheckpoint: wrap the epoch loop;
+each epoch end snapshots registered state (model + optimizer + RNG + epoch
+counter) atomically to the checkpoint dir; on restart the loop resumes at
+the saved epoch. The reference keyed snapshots on a program hash and wrote
+to HDFS — here the key is a user name/hash and the sink is a directory
+(works for local disk or a mounted DFS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Iterator, Optional
+
+__all__ = ["train_epoch_range", "ExeTrainStatus"]
+
+_CKPT_ENV = "PADDLE_CHECKPOINT_DIR"
+
+
+class ExeTrainStatus:
+    """Resume bookkeeping (reference auto_checkpoint.py ExeTrainStatus)."""
+
+    def __init__(self, name: str, max_epoch: int, save_dir: str):
+        self.name = name
+        self.max_epoch = max_epoch
+        self.save_dir = save_dir
+        self._layers = []
+        self._optimizers = []
+        self.epoch = -1
+        self._last_saved: Optional[str] = None
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, *objs):
+        """Register Layers/Optimizers whose state belongs in the snapshot."""
+        for o in objs:
+            if hasattr(o, "state_dict") and hasattr(o, "set_state_dict"):
+                if hasattr(o, "parameters") and not hasattr(o, "_update"):
+                    self._layers.append(o)
+                else:
+                    self._optimizers.append(o)
+        return self
+
+    # -- snapshot I/O -------------------------------------------------------
+
+    def _meta_path(self):
+        return os.path.join(self.save_dir, f"{self.name}.meta.json")
+
+    def _state_path(self, epoch):
+        return os.path.join(self.save_dir, f"{self.name}.e{epoch}.pdckpt")
+
+    def save(self, epoch: int):
+        from ..framework.io import save as fsave
+        from ..core.generator import get_rng_state
+        os.makedirs(self.save_dir, exist_ok=True)
+        state = {
+            "layers": [l.state_dict() for l in self._layers],
+            "optimizers": [o.state_dict() for o in self._optimizers],
+            "rng": get_rng_state(),
+            "epoch": epoch,
+        }
+        path = self._state_path(epoch)
+        tmp = path + f".tmp{os.getpid()}"
+        fsave(state, tmp)
+        os.replace(tmp, path)                      # atomic publish
+        meta = {"epoch": epoch, "path": path, "ts": time.time(),
+                "name": self.name, "max_epoch": self.max_epoch}
+        mtmp = self._meta_path() + f".tmp{os.getpid()}"
+        with open(mtmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(mtmp, self._meta_path())
+        # keep only the latest snapshot (reference keeps max_no = 3 on fs)
+        if self._last_saved and self._last_saved != path and \
+                os.path.exists(self._last_saved):
+            os.remove(self._last_saved)
+        self._last_saved = path
+
+    def try_restore(self) -> int:
+        """Returns the next epoch to run (0 if no snapshot)."""
+        from ..framework.io import load as fload
+        if not os.path.exists(self._meta_path()):
+            return 0
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        path = meta.get("path")
+        if not path or not os.path.exists(path):
+            return 0
+        state = fload(path)
+        for l, sd in zip(self._layers, state["layers"]):
+            l.set_state_dict(sd)
+        for o, sd in zip(self._optimizers, state["optimizers"]):
+            o.set_state_dict(sd)
+        try:
+            from ..core.generator import set_rng_state
+            set_rng_state(state["rng"])
+        except Exception:
+            pass
+        self.epoch = state["epoch"]
+        self._last_saved = path
+        return self.epoch + 1
+
+
+def train_epoch_range(max_epoch_num: int, *objs, name: str = "auto_ckpt",
+                      save_checkpoint_inter: int = 1,
+                      checkpoint_dir: Optional[str] = None
+                      ) -> Iterator[int]:
+    """for epoch in train_epoch_range(N, model, opt): ...  (reference
+    auto_checkpoint.py:71). Yields epoch indices, resuming after restart;
+    snapshots every ``save_checkpoint_inter`` epochs when a checkpoint dir
+    is configured (arg or $PADDLE_CHECKPOINT_DIR)."""
+    ckpt_dir = checkpoint_dir or os.environ.get(_CKPT_ENV)
+    if not ckpt_dir:
+        yield from range(max_epoch_num)
+        return
+    status = ExeTrainStatus(name, max_epoch_num, ckpt_dir).register(*objs)
+    start = status.try_restore()
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        if (epoch + 1) % save_checkpoint_inter == 0 or \
+                epoch == max_epoch_num - 1:
+            status.save(epoch)

@@ -146,6 +146,203 @@ class _SingleProcessIter:
         self.shutdown()
 
 
+def _mp_worker_loop(dataset, task_q, result_q, arena_name, collate_fn,
+                    worker_id, worker_init_fn, consumed_val):
+    """Worker process body (reference dataloader/worker.py:171
+    _worker_loop). Batches go to the parent as shm-arena descriptors —
+    zero-copy apart from the final parent-side read."""
+    import pickle
+    import time
+
+    import numpy as np
+
+    from ..core.native import ShmArena
+    arena = ShmArena(arena_name, create=False)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    produced = 0
+
+    def to_arr(leaf):
+        return np.asarray(leaf.numpy() if hasattr(leaf, "numpy") else leaf)
+
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                break
+            seq, indices = task
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples)
+            if isinstance(batch, dict):
+                keys = list(batch.keys())
+                leaves = [to_arr(batch[k]) for k in keys]
+            elif isinstance(batch, (tuple, list)):
+                keys = None
+                leaves = [to_arr(b) for b in batch]
+            else:
+                keys = None
+                leaves = [to_arr(batch)]
+            if any(l.dtype == object for l in leaves):
+                # non-numeric payloads can't ride shared memory; pickle the
+                # whole batch through the result pipe instead
+                result_q.put((seq, pickle.dumps(
+                    {"pickled": batch, "keys": None})))
+                produced += 1
+                continue
+            # Arena recycling with backpressure: when the arena is 3/4
+            # full, WAIT until the parent has drained everything produced
+            # so far, then reset the bump pointer. Reset only BETWEEN
+            # batches (a mid-batch reset could let later leaves overwrite
+            # earlier ones). Progress is guaranteed: the parent keeps
+            # consuming queued results while we wait.
+            if arena.used() > 3 * arena.size // 4:
+                while consumed_val.value < produced:
+                    time.sleep(0.001)
+                arena.reset()
+            descs = [arena.put_array(arr) for arr in leaves]
+            result_q.put((seq, pickle.dumps({"descs": descs, "keys": keys})))
+            produced += 1
+    except KeyboardInterrupt:
+        pass
+    except BaseException as e:
+        result_q.put((-1, pickle.dumps(repr(e))))
+    finally:
+        arena.close()
+
+
+class _MultiProcessIter:
+    """num_workers>0 path: real worker PROCESSES over a shared-memory arena
+    (reference dataloader_iter.py:251 _DataLoaderIterMultiProcess +
+    mmap_allocator.cc). One arena per worker, epoch-reset recycling."""
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+        import os
+        import pickle
+        self._pickle = pickle
+        self._loader = loader
+        # fork is the fast default (and what the reference/torch use), but
+        # JAX's threads make fork formally unsafe — PADDLE1_MP_START=spawn
+        # opts into the safe-but-slower start method (dataset must pickle).
+        self._ctx = mp.get_context(os.environ.get("PADDLE1_MP_START",
+                                                  "fork"))
+        nw = loader.num_workers
+        self._nw = nw
+        from ..core.native import ShmArena
+        arena_mb = int(os.environ.get("FLAGS_dataloader_shm_mb", "256"))
+        self._arena_names = [f"/p1t_{os.getpid()}_{id(self)}_{w}"
+                             for w in range(nw)]
+        self._arenas = [ShmArena(n, size=arena_mb << 20)
+                        for n in self._arena_names]
+        self._task_qs = [self._ctx.Queue() for _ in range(nw)]
+        self._result_q = self._ctx.Queue()
+        self._consumed = [self._ctx.Value("l", 0) for _ in range(nw)]
+        self._workers = []
+        for w in range(nw):
+            p = self._ctx.Process(
+                target=_mp_worker_loop,
+                args=(loader.dataset, self._task_qs[w], self._result_q,
+                      self._arena_names[w], loader.collate_fn, w,
+                      loader.worker_init_fn, self._consumed[w]),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+        self._batch_iter = iter(loader.batch_sampler)
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._reorder = {}
+        self._exhausted = False
+        # prime the pipeline
+        for _ in range(loader.prefetch_factor * nw):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._exhausted:
+            return
+        try:
+            indices = next(self._batch_iter)
+        except StopIteration:
+            self._exhausted = True
+            return
+        w = self._send_seq % self._nw
+        self._task_qs[w].put((self._send_seq, indices))
+        self._send_seq += 1
+
+    def __next__(self):
+        import queue as pyqueue
+        if self._recv_seq >= self._send_seq and self._exhausted:
+            self.shutdown()
+            raise StopIteration
+        while self._recv_seq not in self._reorder:
+            owner = self._workers[self._recv_seq % self._nw]
+            try:
+                seq, payload = self._result_q.get(timeout=1.0)
+            except pyqueue.Empty:
+                # a worker killed by signal/OOM never posts an error record
+                if not owner.is_alive():
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker for batch {self._recv_seq} "
+                        f"died (exitcode {owner.exitcode})")
+                continue
+            if seq == -1:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker failed: {self._pickle.loads(payload)}")
+            self._reorder[seq] = payload
+        payload = self._reorder.pop(self._recv_seq)
+        w = self._recv_seq % self._nw
+        rec = self._pickle.loads(payload)
+        from ..core.tensor import to_tensor
+        if "pickled" in rec:
+            batch = rec["pickled"]
+        else:
+            arrays = [self._arenas[w].get_array(d) for d in rec["descs"]]
+            if rec["keys"] is not None:
+                batch = {k: to_tensor(a) for k, a in zip(rec["keys"],
+                                                         arrays)}
+            else:
+                out = [to_tensor(a) for a in arrays]
+                batch = out[0] if len(out) == 1 else tuple(out)
+        with self._consumed[w].get_lock():
+            self._consumed[w].value += 1
+        self._recv_seq += 1
+        self._dispatch()
+        if self._loader.device is not None:
+            batch = _to_device(batch, self._loader.device)
+        if not self._loader.return_list and isinstance(batch, tuple):
+            return list(batch)
+        return batch
+
+    def __iter__(self):
+        return self
+
+    def shutdown(self):
+        for q in getattr(self, "_task_qs", []):
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in getattr(self, "_workers", []):
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        for a, n in zip(getattr(self, "_arenas", []),
+                        getattr(self, "_arena_names", [])):
+            try:
+                a.close(unlink=True)
+            except Exception:
+                pass
+        self._workers = []
+        self._arenas = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """paddle.io.DataLoader equivalent.
 
@@ -184,6 +381,8 @@ class DataLoader:
                                               shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.device = None
         if use_buffer_reader:
             try:
@@ -192,6 +391,13 @@ class DataLoader:
                 self.device = None
 
     def __iter__(self):
+        # Real worker processes need: workers requested, shared memory
+        # allowed, the native arena available, and an indexable dataset.
+        if (self.num_workers > 0 and self.use_shared_memory and
+                self.batch_sampler is not None):
+            from ..core import native
+            if native.available():
+                return _MultiProcessIter(self)
         return _SingleProcessIter(self)
 
     def __len__(self):

@@ -73,7 +73,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         mask = m[0] if m else None
         if (use_flash and mask is None and drop == 0.0
                 and jax.default_backend() == "tpu"
-                and fa.supported(q.shape, k.shape)):
+                and fa.supported(q.shape, k.shape, causal=is_causal)):
             return fa.flash_attention(q, k, v, causal=is_causal)
         return attention_ref(q, k, v, mask=mask, dropout_p=drop,
                              is_causal=is_causal, dropout_key=dropout_key)

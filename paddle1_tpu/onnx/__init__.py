@@ -1,0 +1,21 @@
+"""ONNX export shim (reference python/paddle/onnx/export.py delegates to
+the external paddle2onnx package). The TPU-native deployment format is the
+serialized StableHLO program written by ``paddle1_tpu.jit.save`` — StableHLO
+is the portable interchange here, playing ONNX's role. ``export`` therefore
+saves the jit artifact and raises a clear error if a literal ``.onnx``
+protobuf is demanded (no converter is bundled in this environment)."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    if path.endswith(".onnx"):
+        raise NotImplementedError(
+            "Literal ONNX protobuf export requires an external converter "
+            "(the reference shells out to paddle2onnx). Use "
+            "paddle1_tpu.jit.save for the portable StableHLO artifact.")
+    from ..jit import save as jit_save
+    jit_save(layer, path, input_spec=input_spec)
+    return path

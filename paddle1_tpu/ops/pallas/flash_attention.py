@@ -29,13 +29,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def supported(q_shape, k_shape) -> bool:
+def supported(q_shape, k_shape, causal: bool = False) -> bool:
     """Tile-aligned shapes only; everything else uses attention_ref."""
     if len(q_shape) != 4 or len(k_shape) != 4:
         return False
     _, nq, _, d = q_shape
     _, nk, _, _ = k_shape
     if nq % BLOCK_Q or nk % BLOCK_K:
+        return False
+    if causal and nq > nk:
+        # bottom-right causal leaves leading queries with ZERO visible
+        # keys; the zero-sumexp sentinel would poison the vjp — let
+        # attention_ref handle this degenerate alignment
         return False
     if d % 8 or d > 256:
         return False

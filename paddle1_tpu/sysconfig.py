@@ -1,0 +1,19 @@
+"""Build/system configuration introspection (reference
+python/paddle/sysconfig.py: get_include/get_lib)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of C headers for extension building (the native runtime's
+    source tree; the reference returns its bundled fluid headers)."""
+    return os.path.join(_PKG, "core", "native", "src")
+
+
+def get_lib() -> str:
+    """Directory containing the native shared library."""
+    return os.path.join(_PKG, "core", "native")

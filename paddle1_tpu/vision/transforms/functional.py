@@ -76,8 +76,9 @@ def _interp_resize(arr: np.ndarray, h: int, w: int) -> np.ndarray:
     top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
     bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
     out = top * (1 - wy) + bot * wy
-    return out.astype(arr.dtype) if arr.dtype == np.float32 else \
-        np.clip(out + 0.5, 0, 255).astype(arr.dtype)
+    if np.issubdtype(arr.dtype, np.floating):
+        return out.astype(arr.dtype)
+    return np.clip(out + 0.5, 0, 255).astype(arr.dtype)
 
 
 def resize(img, size, interpolation="bilinear"):

@@ -1,0 +1,90 @@
+"""distribution / auto-checkpoint / sysconfig / onnx-shim coverage."""
+
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+
+
+class TestDistribution(unittest.TestCase):
+    def test_normal(self):
+        d = paddle.distribution.Normal(0.0, 1.0)
+        s = d.sample([2000])
+        self.assertLess(abs(float(s.numpy().mean())), 0.15)
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        self.assertAlmostEqual(float(lp), -0.9189385, places=5)
+        ent = d.entropy()
+        self.assertAlmostEqual(float(ent), 1.4189385, places=5)
+        kl = d.kl_divergence(paddle.distribution.Normal(0.0, 2.0))
+        self.assertGreater(float(kl), 0.0)
+
+    def test_uniform(self):
+        d = paddle.distribution.Uniform(1.0, 3.0)
+        s = d.sample([1000]).numpy()
+        self.assertTrue((s >= 1.0).all() and (s < 3.0).all())
+        self.assertAlmostEqual(float(d.entropy()), np.log(2.0), places=5)
+        self.assertAlmostEqual(float(d.log_prob(paddle.to_tensor(2.0))),
+                               -np.log(2.0), places=5)
+        self.assertEqual(float(d.log_prob(paddle.to_tensor(5.0))),
+                         -np.inf)
+
+    def test_categorical(self):
+        logits = paddle.to_tensor(np.log(np.array([0.7, 0.2, 0.1],
+                                                  np.float32)))
+        d = paddle.distribution.Categorical(logits)
+        s = d.sample([4000]).numpy()
+        self.assertAlmostEqual((s == 0).mean(), 0.7, delta=0.06)
+        lp = d.log_prob(paddle.to_tensor(np.array([0], np.int64)))
+        self.assertAlmostEqual(float(lp), np.log(0.7), places=4)
+        ent = float(d.entropy())
+        self.assertAlmostEqual(ent, -(0.7 * np.log(0.7) + 0.2 * np.log(0.2)
+                                      + 0.1 * np.log(0.1)), places=4)
+
+
+class TestAutoCheckpoint(unittest.TestCase):
+    def test_resume_cycle(self):
+        from paddle1_tpu.incubate import train_epoch_range
+        from paddle1_tpu.vision.models import LeNet
+        with tempfile.TemporaryDirectory() as d:
+            m = LeNet()
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=m.parameters())
+            seen = []
+            for epoch in train_epoch_range(5, m, opt, name="t",
+                                           checkpoint_dir=d):
+                seen.append(epoch)
+                if epoch == 2:
+                    # simulated crash DURING epoch 2 (before its snapshot):
+                    # epochs 0-1 are durable, epoch 2 must re-run
+                    break
+            self.assertEqual(seen, [0, 1, 2])
+            # "restart": fresh objects, same dir → resumes at epoch 2
+            m2 = LeNet()
+            opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                        parameters=m2.parameters())
+            seen2 = list(train_epoch_range(5, m2, opt2, name="t",
+                                           checkpoint_dir=d))
+            self.assertEqual(seen2, [2, 3, 4])
+            # weights restored from snapshot
+            a = m.state_dict()["features.0.weight"].numpy()
+            b = m2.state_dict()["features.0.weight"].numpy()
+            np.testing.assert_array_equal(a, b)
+
+    def test_no_dir_passthrough(self):
+        from paddle1_tpu.incubate import train_epoch_range
+        os.environ.pop("PADDLE_CHECKPOINT_DIR", None)
+        self.assertEqual(list(train_epoch_range(3)), [0, 1, 2])
+
+
+class TestMisc(unittest.TestCase):
+    def test_sysconfig(self):
+        self.assertTrue(os.path.isdir(paddle.sysconfig.get_include()))
+        self.assertTrue(os.path.isdir(paddle.sysconfig.get_lib()))
+
+    def test_onnx_export_raises_for_onnx_suffix(self):
+        from paddle1_tpu.vision.models import LeNet
+        with self.assertRaises(NotImplementedError):
+            paddle.onnx.export(LeNet(), "/tmp/x.onnx")

@@ -1,0 +1,149 @@
+"""Native (C++) runtime: bounded queue, shm arena, stats, multiprocess
+DataLoader (reference buffered_reader / mmap_allocator / monitor tests)."""
+
+import pickle
+import threading
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core import native
+
+
+class TestNative(unittest.TestCase):
+    def test_available(self):
+        self.assertTrue(native.available())
+
+    def test_queue_fifo_and_close(self):
+        q = native.BoundedQueue(4)
+        for i in range(4):
+            q.put(pickle.dumps(i))
+        got = [pickle.loads(q.get()) for _ in range(4)]
+        self.assertEqual(got, [0, 1, 2, 3])
+        q.close()
+        self.assertIsNone(q.get(timeout_ms=100))
+
+    def test_queue_blocks_when_full(self):
+        q = native.BoundedQueue(1)
+        q.put(b"a")
+        self.assertEqual(q.put(b"b", timeout_ms=50), False)  # timeout
+
+    def test_queue_threaded(self):
+        q = native.BoundedQueue(2)
+        out = []
+
+        def prod():
+            for i in range(20):
+                q.put(pickle.dumps(i))
+            q.close()
+
+        t = threading.Thread(target=prod)
+        t.start()
+        while True:
+            b = q.get(timeout_ms=2000)
+            if b is None:
+                break
+            out.append(pickle.loads(b))
+        t.join()
+        self.assertEqual(out, list(range(20)))
+
+    def test_shm_roundtrip(self):
+        a = native.ShmArena("/p1t_ut", 1 << 20)
+        try:
+            x = np.random.randn(17, 5).astype(np.float32)
+            d = a.put_array(x)
+            np.testing.assert_array_equal(a.get_array(d), x)
+            used = a.used()
+            self.assertGreater(used, x.nbytes)
+            a.reset()
+            self.assertLess(a.used(), used)
+        finally:
+            a.close(unlink=True)
+
+    def test_shm_full_raises(self):
+        a = native.ShmArena("/p1t_ut2", 1 << 12)
+        try:
+            with self.assertRaises(MemoryError):
+                for _ in range(10):
+                    a.put_array(np.zeros(1024, np.float32))
+        finally:
+            a.close(unlink=True)
+
+    def test_stats(self):
+        native.stat_set("ut_gauge", 7)
+        native.stat_add("ut_gauge", 3)
+        self.assertEqual(native.stat_get("ut_gauge"), 10)
+        self.assertIn("ut_gauge", native.stat_dump())
+
+
+class TestMultiProcessLoader(unittest.TestCase):
+    def test_order_and_parity(self):
+        from paddle1_tpu.vision import transforms as T
+        from paddle1_tpu.vision.datasets import FakeData
+        ds = FakeData(num_samples=48, image_shape=(3, 8, 8), num_classes=3,
+                      transform=T.Compose([T.ToTensor()]))
+        sp = [b[0].numpy() for b in paddle.io.DataLoader(
+            ds, batch_size=8, shuffle=False, num_workers=0)]
+        mp_batches = [b[0].numpy() for b in paddle.io.DataLoader(
+            ds, batch_size=8, shuffle=False, num_workers=2)]
+        self.assertEqual(len(sp), len(mp_batches))
+        for a, b in zip(sp, mp_batches):
+            np.testing.assert_array_equal(a, b)
+
+    def test_dict_batches(self):
+        class DictDs:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return {"x": np.full(4, i, np.float32),
+                        "y": np.array([i], np.int64)}
+
+        loader = paddle.io.DataLoader(DictDs(), batch_size=4, shuffle=False,
+                                      num_workers=2)
+        batches = list(loader)
+        self.assertEqual(len(batches), 4)
+        b0 = batches[0]
+        self.assertIsInstance(b0, dict)
+        np.testing.assert_array_equal(b0["y"].numpy().ravel(),
+                                      [0, 1, 2, 3])
+
+    def test_arena_recycles_small_arena(self):
+        """Total epoch bytes exceed the arena: backpressure + reset must
+        keep the pipeline alive instead of raising MemoryError."""
+        import os
+        os.environ["FLAGS_dataloader_shm_mb"] = "1"
+        try:
+            class Big:
+                def __len__(self):
+                    return 64
+
+                def __getitem__(self, i):
+                    return (np.full((64, 64), i, np.float32),
+                            np.array([i], np.int64))
+
+            loader = paddle.io.DataLoader(Big(), batch_size=4,
+                                          shuffle=False, num_workers=1)
+            n = 0
+            for x, y in loader:
+                self.assertEqual(float(x.numpy()[0, 0, 0]), float(n * 4))
+                n += 1
+            self.assertEqual(n, 16)
+        finally:
+            os.environ.pop("FLAGS_dataloader_shm_mb", None)
+
+    def test_worker_exception_propagates(self):
+        class Bad:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.zeros(4, np.float32), np.array([0], np.int64)
+
+        loader = paddle.io.DataLoader(Bad(), batch_size=4, shuffle=False,
+                                      num_workers=1)
+        with self.assertRaises(RuntimeError):
+            list(loader)
