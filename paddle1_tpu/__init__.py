@@ -33,6 +33,7 @@ from . import profiler
 from . import distribution
 from . import sysconfig
 from . import onnx
+from . import quantization
 from . import amp
 from . import io
 from . import metric

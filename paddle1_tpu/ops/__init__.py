@@ -10,4 +10,6 @@ from .linalg_ops import (cholesky, det, dist, eig, eigh, inv, inverse,
                          lstsq, lu, matrix_power, matrix_rank, multi_dot,
                          norm, pinv, qr, slogdet, solve, svd,
                          triangular_solve)
+from . import sequence_ops
+from .sequence_ops import *  # noqa: F401,F403
 from . import patch as _patch  # noqa: F401  (installs Tensor methods)

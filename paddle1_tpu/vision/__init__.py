@@ -2,6 +2,7 @@
 
 from . import datasets
 from . import models
+from . import ops
 from . import transforms
 
-__all__ = ["datasets", "models", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms"]

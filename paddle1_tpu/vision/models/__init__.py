@@ -5,8 +5,10 @@ from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, wide_resnet50_2, wide_resnet101_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .yolo import DarkNet53, YOLOv3, yolov3, yolov3_loss
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2",
            "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1",
-           "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+           "MobileNetV2", "mobilenet_v1", "mobilenet_v2", "DarkNet53",
+           "YOLOv3", "yolov3", "yolov3_loss"]

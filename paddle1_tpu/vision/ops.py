@@ -1,0 +1,402 @@
+"""Detection ops (reference paddle/fluid/operators/detection/, 18.2k LoC
+CUDA/C++, surfaced as paddle.vision.ops + fluid.layers.detection).
+
+TPU-native design: every op is a fixed-shape masked dense computation —
+NMS keeps a static ``keep`` mask instead of compacting (XLA-friendly; the
+caller slices by the returned count), yolo_box decodes the whole grid in
+one vectorized pass, roi_align is a gather+bilinear composition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["iou", "box_coder", "yolo_box", "nms", "multiclass_nms",
+           "matrix_nms", "roi_align", "roi_pool", "prior_box",
+           "generate_anchors", "distribute_fpn_proposals"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _pairwise_iou(a, b):
+    """a: [N,4], b: [M,4] xyxy → [N,M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def iou(boxes1, boxes2, name=None):
+    """Pairwise IoU, xyxy (reference iou_similarity_op)."""
+    return apply("iou", _pairwise_iou, (_t(boxes1), _t(boxes2)))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder_op)."""
+
+    def f(prior, var, target):
+        pw = prior[:, 2] - prior[:, 0] + (0 if box_normalized else 1)
+        ph = prior[:, 3] - prior[:, 1] + (0 if box_normalized else 1)
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + (0 if box_normalized else 1)
+            th = target[:, 3] - target[:, 1] + (0 if box_normalized else 1)
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / var if var is not None else out
+        # decode_center_size
+        t = target * var if var is not None else target
+        cx = t[..., 0] * pw + pcx
+        cy = t[..., 1] * ph + pcy
+        w = jnp.exp(t[..., 2]) * pw
+        h = jnp.exp(t[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                         axis=-1)
+    if prior_box_var is None:
+        return apply("box_coder", lambda p, t: f(p, None, t),
+                     (_t(prior_box), _t(target_box)))
+    return apply("box_coder", f,
+                 (_t(prior_box), _t(prior_box_var), _t(target_box)))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode one YOLO head feature map into boxes+scores (reference
+    yolo_box_op). x: [B, na*(5+C), H, W]; returns (boxes [B, na*H*W, 4],
+    scores [B, na*H*W, C])."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = anchors.shape[0]
+
+    def f(x, img_size):
+        b, _, h, w = x.shape
+        pred = x.reshape(b, na, 5 + class_num + (1 if iou_aware else 0),
+                         h, w)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(pred[:, :, -1])
+            pred = pred[:, :, :-1]
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        cx = (sx + gx) / w
+        cy = (sy + gy) / h
+        aw = anchors[:, 0][None, :, None, None]
+        ah = anchors[:, 1][None, :, None, None]
+        bw = jnp.exp(pred[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(pred[:, :, 3]) * ah / (h * downsample_ratio)
+        obj = jax.nn.sigmoid(pred[:, :, 4])
+        if iou_aware:
+            obj = obj ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+        cls = jax.nn.sigmoid(pred[:, :, 5:])           # [B,na,C,H,W]
+        scores = jnp.where(obj[:, :, None] > conf_thresh,
+                           obj[:, :, None] * cls, 0.0)
+        imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (cx - bw / 2) * imw
+        y0 = (cy - bh / 2) * imh
+        x1 = (cx + bw / 2) * imw
+        y1 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1)   # [B,na,H,W,4]
+        boxes = boxes.reshape(b, na * h * w, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            b, na * h * w, class_num)
+        return boxes, scores
+    return apply("yolo_box", f, (_t(x), _t(img_size)))
+
+
+def _nms_mask(boxes, scores, iou_threshold, top_k):
+    """Greedy hard-NMS as a fixed-iteration masked loop (XLA-friendly:
+    no dynamic shapes). Returns keep mask [N] bool."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    ious = _pairwise_iou(boxes_s, boxes_s)
+
+    def body(i, keep):
+        # suppress j>i overlapping an already-kept i; the loop must cover
+        # ALL ranks (top_k is applied at selection time by the caller, not
+        # by truncating suppression)
+        sup = (ious[i] > iou_threshold) & keep[i] & \
+            (jnp.arange(n) > i)
+        return keep & ~sup
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference nms_op / paddle.vision.ops.nms). Returns kept
+    indices sorted by score (eager: exact compaction)."""
+    b = _t(boxes)
+    s = _t(scores) if scores is not None else to_tensor(
+        np.arange(b.shape[0], 0, -1).astype(np.float32))
+
+    def f(boxes, scores, *cat):
+        if cat:
+            # category-aware: offset boxes per category so cross-category
+            # pairs never overlap (the standard batched-NMS trick)
+            c = cat[0].astype(jnp.float32)
+            off = c[:, None] * (jnp.max(boxes) + 1.0)
+            keep = _nms_mask(boxes + off, scores, iou_threshold,
+                             top_k or 0)
+        else:
+            keep = _nms_mask(boxes, scores, iou_threshold, top_k or 0)
+        return keep
+    cat_args = (_t(category_idxs),) if category_idxs is not None else ()
+    keep = apply("nms", f, (b, s) + cat_args)
+    keep_np = np.asarray(keep.numpy())
+    scores_np = np.asarray(s.numpy())
+    idx = np.nonzero(keep_np)[0]
+    idx = idx[np.argsort(-scores_np[idx])]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return to_tensor(idx.astype(np.int64))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.45, normalized=True,
+                   background_label=-1, name=None):
+    """Per-class NMS + global top-k (reference multiclass_nms_op).
+    bboxes: [N, 4]; scores: [C, N] (single image) → [M, 6]
+    (label, score, x0, y0, x1, y1)."""
+    b = np.asarray(_t(bboxes).numpy())
+    s = np.asarray(_t(scores).numpy())
+    out = []
+    for c in range(s.shape[0]):
+        if c == background_label:
+            continue
+        cs = s[c]
+        sel = cs > score_threshold
+        if not sel.any():
+            continue
+        idx = np.nonzero(sel)[0]
+        idx = idx[np.argsort(-cs[idx])][:nms_top_k]
+        keep_rel = np.asarray(nms(to_tensor(b[idx]), nms_threshold,
+                                  to_tensor(cs[idx])).numpy())
+        for i in keep_rel:
+            gi = idx[i]
+            out.append([float(c), float(cs[gi])] + b[gi].tolist())
+    if not out:
+        return to_tensor(np.zeros((0, 6), np.float32))
+    out = np.asarray(out, np.float32)
+    out = out[np.argsort(-out[:, 1])][:keep_top_k]
+    return to_tensor(out)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=-1, normalized=True,
+               name=None):
+    """Matrix NMS (reference matrix_nms_op — SOLOv2/PP-YOLOE): fully
+    parallel score decay, no sequential suppression. Single image:
+    bboxes [N,4], scores [C,N] → [M,6]."""
+
+    def f(boxes, scores):
+        c, n = scores.shape
+        flat = scores.reshape(-1)
+        k = min(nms_top_k, flat.shape[0])
+        top_scores, top_idx = jax.lax.top_k(flat, k)
+        cls = (top_idx // n).astype(jnp.int32)
+        box_i = top_idx % n
+        bx = boxes[box_i]
+        ious = _pairwise_iou(bx, bx)
+        same = (cls[:, None] == cls[None, :])
+        upper = jnp.triu(jnp.ones((k, k), bool), 1)
+        decay_iou = jnp.where(same & upper.T, ious, 0.0)  # j<i kept pairs
+        max_iou = jnp.max(decay_iou, axis=1)
+        if use_gaussian:
+            decay = jnp.min(jnp.where(
+                same & upper.T,
+                jnp.exp(-(ious ** 2 - max_iou[None, :] ** 2) /
+                        gaussian_sigma), 1.0), axis=1)
+        else:
+            comp = jnp.where(same & upper.T,
+                             (1 - ious) / jnp.maximum(1 - max_iou[None, :],
+                                                      1e-10), 1.0)
+            decay = jnp.min(comp, axis=1)
+        dec_scores = top_scores * decay
+        valid = (top_scores > score_threshold) & \
+            (dec_scores > post_threshold)
+        dec_scores = jnp.where(valid, dec_scores, -1.0)
+        return dec_scores, cls, bx
+    dec, cls, bx = apply("matrix_nms", f, (_t(bboxes), _t(scores)))
+    d = np.asarray(dec.numpy())
+    order = np.argsort(-d)[:keep_top_k]
+    order = order[d[order] > 0]
+    rows = np.concatenate([
+        np.asarray(cls.numpy())[order, None].astype(np.float32),
+        d[order, None],
+        np.asarray(bx.numpy())[order]], axis=1)
+    return to_tensor(rows.astype(np.float32))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None, _reduce="mean"):
+    """RoIAlign (reference roi_align_op). x: [B,C,H,W]; boxes: [R,4] xyxy
+    in input-image coords; boxes_num: rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    if boxes_num is None:
+        nums = None            # all RoIs belong to image 0
+    else:
+        nums = np.asarray(boxes_num.numpy()
+                          if isinstance(boxes_num, Tensor) else boxes_num)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    reduce_max = _reduce == "max"
+
+    def f(feat, rois):
+        b, ch, H, W = feat.shape
+        if nums is None:
+            img_of_roi = np.zeros(rois.shape[0], np.int32)
+        else:
+            img_of_roi = np.repeat(np.arange(len(nums)), nums)
+        off = 0.5 if aligned else 0.0
+        x0 = rois[:, 0] * spatial_scale - off
+        y0 = rois[:, 1] * spatial_scale - off
+        x1 = rois[:, 2] * spatial_scale - off
+        y1 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x1 - x0, 1e-3)
+        rh = jnp.maximum(y1 - y0, 1e-3)
+        # sample grid: oh*sr x ow*sr points per roi
+        py = (jnp.arange(oh * sr) + 0.5) / (oh * sr)
+        px = (jnp.arange(ow * sr) + 0.5) / (ow * sr)
+        sy = y0[:, None] + rh[:, None] * py[None, :]     # [R, oh*sr]
+        sx = x0[:, None] + rw[:, None] * px[None, :]     # [R, ow*sr]
+
+        def bilinear(img, ys, xs):
+            # img [C,H,W]; ys [hs], xs [ws] → [C,hs,ws]
+            y0i = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+            x0i = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0i + 1, 0, H - 1)
+            x1i = jnp.clip(x0i + 1, 0, W - 1)
+            wy = jnp.clip(ys - y0i, 0, 1)[None, :, None]
+            wx = jnp.clip(xs - x0i, 0, 1)[None, None, :]
+            a = img[:, y0i][:, :, x0i]
+            bq = img[:, y0i][:, :, x1i]
+            cq = img[:, y1i][:, :, x0i]
+            dq = img[:, y1i][:, :, x1i]
+            top = a * (1 - wx) + bq * wx
+            bot = cq * (1 - wx) + dq * wx
+            return top * (1 - wy) + bot * wy
+
+        outs = []
+        for r in range(rois.shape[0]):
+            img = feat[int(img_of_roi[r])]
+            samp = bilinear(img, sy[r], sx[r])           # [C, oh*sr, ow*sr]
+            samp = samp.reshape(ch, oh, sr, ow, sr)
+            outs.append(samp.max(axis=(2, 4)) if reduce_max
+                        else samp.mean(axis=(2, 4)))
+        return jnp.stack(outs) if outs else jnp.zeros((0, ch, oh, ow),
+                                                      feat.dtype)
+    return apply("roi_align", f, (_t(x), _t(boxes)))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+             name=None):
+    """Max RoI pooling (reference roi_pool_op): bilinear sample grid with
+    MAX reduction per output bin (roi_align's sampling replaces the
+    legacy hard quantization; the reduction stays max)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=2, aligned=False, _reduce="max")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """SSD prior boxes for one feature map (reference prior_box_op).
+    Returns (boxes [H,W,P,4], variances [H,W,P,4])."""
+    inp, img = _t(input), _t(image)
+    fh, fw = inp.shape[2], inp.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        if max_sizes:
+            for xs in max_sizes:
+                boxes.append((float(np.sqrt(ms * xs)),) * 2)
+        for a in ars:
+            if a == 1.0:
+                continue
+            boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+    P = len(boxes)
+    wh = np.asarray(boxes, np.float32)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, P, 4), np.float32)
+    out[..., 0] = (cxg[..., None] - wh[None, None, :, 0] / 2) / iw
+    out[..., 1] = (cyg[..., None] - wh[None, None, :, 1] / 2) / ih
+    out[..., 2] = (cxg[..., None] + wh[None, None, :, 0] / 2) / iw
+    out[..., 3] = (cyg[..., None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return to_tensor(out), to_tensor(var)
+
+
+def generate_anchors(stride, sizes=(32,), aspect_ratios=(0.5, 1.0, 2.0)):
+    """Base anchors for one FPN level (anchor_generator_op analog)."""
+    anchors = []
+    for s in sizes:
+        area = float(s) ** 2
+        for ar in aspect_ratios:
+            w = np.sqrt(area / ar)
+            h = w * ar
+            anchors.append([-w / 2, -h / 2, w / 2, h / 2])
+    return to_tensor(np.asarray(anchors, np.float32))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals_op). Returns (rois_per_level list,
+    restore_index)."""
+    rois = np.asarray(_t(fpn_rois).numpy())
+    w = np.maximum(rois[:, 2] - rois[:, 0], 0)
+    h = np.maximum(rois[:, 3] - rois[:, 1], 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, order = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        order.append(idx)
+        outs.append(to_tensor(rois[idx]))
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, to_tensor(restore.astype(np.int64))

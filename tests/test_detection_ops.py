@@ -1,0 +1,154 @@
+"""Detection + sequence op correctness (reference detection op tests /
+sequence_ops tests; numpy references inline)."""
+
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu.vision import ops as V
+
+
+def _iou_np(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa[:, None] + ab[None, :] - inter, 1e-10)
+
+
+class TestDetectionOps(unittest.TestCase):
+    def test_iou(self):
+        a = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        b = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+        got = V.iou(a, b).numpy()
+        np.testing.assert_allclose(got, _iou_np(a, b), rtol=1e-5)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = V.nms(boxes, 0.5, scores).numpy()
+        self.assertEqual(sorted(keep.tolist()), [0, 2])
+
+    def test_nms_category_aware(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        keep = V.nms(boxes, 0.5, scores, category_idxs=cats,
+                     categories=[0, 1]).numpy()
+        self.assertEqual(sorted(keep.tolist()), [0, 1])  # different classes
+
+    def test_multiclass_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [40, 40, 60, 60]],
+                         np.float32)
+        scores = np.array([[0.9, 0.85, 0.1],    # class 0
+                           [0.1, 0.2, 0.95]],   # class 1
+                          np.float32)
+        out = V.multiclass_nms(boxes, scores, score_threshold=0.3,
+                               nms_threshold=0.5).numpy()
+        labels = out[:, 0].astype(int).tolist()
+        self.assertEqual(sorted(labels), [0, 1])
+
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([[0.9, 0.85, 0.8]], np.float32)
+        out = V.matrix_nms(boxes, scores, score_threshold=0.1).numpy()
+        self.assertEqual(out.shape[1], 6)
+        # overlapping second box decayed below its raw score
+        row_b = out[np.argmin(np.abs(out[:, 2] - 1.0))]
+        self.assertLess(row_b[1], 0.85)
+
+    def test_yolo_box_shapes_and_range(self):
+        B, na, C, H, W = 2, 3, 4, 5, 5
+        x = np.random.randn(B, na * (5 + C), H, W).astype(np.float32)
+        img = np.array([[320, 320], [416, 416]], np.int32)
+        boxes, scores = V.yolo_box(x, img, [10, 13, 16, 30, 33, 23], C,
+                                   0.01, 32)
+        self.assertEqual(list(boxes.shape), [B, na * H * W, 4])
+        self.assertEqual(list(scores.shape), [B, na * H * W, C])
+        bn = boxes.numpy()
+        self.assertTrue((bn[0, :, 2] <= 320).all())
+        self.assertTrue((bn >= 0).all())
+
+    def test_roi_align_identity_box(self):
+        # a RoI covering exactly one constant region pools to its value
+        feat = np.zeros((1, 1, 8, 8), np.float32)
+        feat[0, 0, :4, :4] = 1.0
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        out = V.roi_align(feat, rois, np.array([1]), output_size=2,
+                          spatial_scale=1.0)
+        np.testing.assert_allclose(out.numpy()[0, 0], np.ones((2, 2)),
+                                   atol=0.3)
+
+    def test_prior_box(self):
+        inp = np.zeros((1, 8, 4, 4), np.float32)
+        img = np.zeros((1, 3, 64, 64), np.float32)
+        boxes, var = V.prior_box(inp, img, min_sizes=[16],
+                                 aspect_ratios=[1.0, 2.0])
+        self.assertEqual(boxes.shape[:2], [4, 4])
+        self.assertEqual(boxes.shape[3], 4)
+        self.assertEqual(var.shape, boxes.shape)
+
+    def test_distribute_fpn(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 200, 200]], np.float32)
+        outs, restore = V.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        sizes = [o.shape[0] for o in outs]
+        self.assertEqual(sum(sizes), 2)
+        self.assertEqual(sorted(restore.numpy().tolist()), [0, 1])
+
+
+class TestSequenceOps(unittest.TestCase):
+    def test_mask_pad_unpad_roundtrip(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        lengths = np.array([3, 1, 2], np.int64)
+        flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+        padded, lens = S.sequence_pad(flat, 0.0, lengths)
+        self.assertEqual(list(padded.shape), [3, 3, 2])
+        self.assertEqual(float(padded.numpy()[1, 1, 0]), 0.0)
+        back = S.sequence_unpad(padded, lens)
+        np.testing.assert_array_equal(back.numpy(), flat)
+        mask = S.sequence_mask(lengths).numpy()
+        np.testing.assert_array_equal(mask,
+                                      [[1, 1, 1], [1, 0, 0], [1, 1, 0]])
+
+    def test_pool_variants(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        x = np.array([[[1.], [2.], [3.]],
+                      [[4.], [5.], [6.]]], np.float32)
+        lens = np.array([2, 3], np.int64)
+        self.assertEqual(S.sequence_pool(x, lens, "sum").numpy().tolist(),
+                         [[3.0], [15.0]])
+        self.assertEqual(S.sequence_pool(x, lens, "mean").numpy().tolist(),
+                         [[1.5], [5.0]])
+        self.assertEqual(S.sequence_pool(x, lens, "max").numpy().tolist(),
+                         [[2.0], [6.0]])
+        self.assertEqual(S.sequence_last_step(x, lens).numpy().tolist(),
+                         [[2.0], [6.0]])
+
+    def test_softmax_masked(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        x = np.zeros((1, 3, 1), np.float32)
+        lens = np.array([2], np.int64)
+        out = S.sequence_softmax(x, lens).numpy()
+        np.testing.assert_allclose(out[0, :, 0], [0.5, 0.5, 0.0], atol=1e-6)
+
+    def test_reverse(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        x = np.array([[[1.], [2.], [3.]]], np.float32)
+        lens = np.array([2], np.int64)
+        out = S.sequence_reverse(x, lens).numpy()
+        np.testing.assert_array_equal(out[0, :, 0], [2.0, 1.0, 3.0])
+
+    def test_grad_through_pool(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        x = paddle.to_tensor(np.ones((2, 3, 1), np.float32),
+                             stop_gradient=False)
+        lens = np.array([2, 3], np.int64)
+        out = S.sequence_pool(x, lens, "sum")
+        out.sum().backward()
+        np.testing.assert_array_equal(
+            x.grad.numpy()[:, :, 0], [[1, 1, 0], [1, 1, 1]])

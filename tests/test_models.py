@@ -50,6 +50,30 @@ class TestVisionModels(unittest.TestCase):
         opt.step()
 
 
+class TestYolo(unittest.TestCase):
+    def test_forward_postprocess_loss_grad(self):
+        from paddle1_tpu.vision.models import YOLOv3, yolov3_loss
+        m = YOLOv3(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.randn(1, 3, 64, 64).astype(np.float32))
+        outs = m(x)
+        self.assertEqual([list(o.shape) for o in outs],
+                         [[1, 27, 2, 2], [1, 27, 4, 4], [1, 27, 8, 8]])
+        res = m.postprocess(outs, paddle.to_tensor(
+            np.array([[64, 64]], np.int32)), conf_thresh=0.05)
+        self.assertEqual(res[0].shape[1], 6)
+        m.train()
+        gtb = np.array([[[0.5, 0.5, 0.4, 0.4], [0, 0, 0, 0]]], np.float32)
+        gtl = np.array([[1, -1]], np.int64)
+        loss = yolov3_loss(m(x), gtb, gtl, num_classes=4)
+        self.assertTrue(np.isfinite(float(loss)))
+        loss.backward()
+        g = m.backbone.stem.conv.weight.grad
+        self.assertIsNotNone(g)
+        self.assertGreater(float(np.abs(g.numpy()).sum()), 0.0)
+
+
 class TestBert(unittest.TestCase):
     def _tiny(self):
         from paddle1_tpu.text.models import (BertForPretraining, BertModel,

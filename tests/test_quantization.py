@@ -1,0 +1,60 @@
+"""QAT/PTQ (reference slim quantization tests)."""
+
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+from paddle1_tpu.quantization import QAT, PTQ, fake_quant
+
+
+class TestQuant(unittest.TestCase):
+    def test_fake_quant_levels(self):
+        x = np.linspace(-1, 1, 11).astype(np.float32)
+        out = fake_quant(paddle.to_tensor(x), 1.0, bits=3).numpy()
+        # 3 bits → qmax=3 → values on k/3 grid
+        np.testing.assert_allclose(out * 3, np.round(out * 3), atol=1e-6)
+
+    def test_fake_quant_ste_grad(self):
+        x = paddle.to_tensor(np.array([0.3, 2.0], np.float32),
+                             stop_gradient=False)
+        out = fake_quant(x, 1.0, bits=8)
+        out.sum().backward()
+        # inside range → grad 1; clipped (|x|>scale) → grad 0
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0], atol=1e-6)
+
+    def test_qat_swaps_and_trains(self):
+        from paddle1_tpu.vision.models import LeNet
+        m = LeNet()
+        QAT().quantize(m)
+        names = [type(l).__name__ for l in m.sublayers()]
+        self.assertIn("QuantizedConv2D", names)
+        self.assertIn("QuantizedLinear", names)
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.randn(4, 1, 28, 28).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        losses = []
+        for _ in range(5):
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        self.assertLess(losses[-1], losses[0])
+
+    def test_ptq_calibrates(self):
+        from paddle1_tpu.vision.models import LeNet
+        from paddle1_tpu.quantization import FakeQuantMovingAverageAbsMax
+        m = LeNet()
+        data = [(paddle.to_tensor(
+            np.random.randn(2, 1, 28, 28).astype(np.float32)),)
+            for _ in range(3)]
+        PTQ().quantize(m, data, num_batches=3)
+        obs = [l for l in m.sublayers()
+               if isinstance(l, FakeQuantMovingAverageAbsMax)]
+        self.assertTrue(obs)
+        self.assertTrue(all(int(o.inited.numpy()) == 1 for o in obs))
+        self.assertFalse(m.training)
