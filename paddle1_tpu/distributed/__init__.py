@@ -18,6 +18,8 @@ from .parallel import (DataParallel, ParallelEnv, init_parallel_env)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        build_mesh, get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
 from . import sharding_specs
 from . import sequence_parallel
 from .sequence_parallel import ring_attention, ulysses_attention
@@ -46,4 +48,5 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "get_hybrid_communicate_group", "set_hybrid_communicate_group",
            "sharding_specs", "spawn", "launch", "ParallelEngine",
            "make_train_step", "sequence_parallel", "ring_attention",
-           "ulysses_attention"]
+           "ulysses_attention", "pipeline", "pipeline_apply",
+           "stack_stage_params"]

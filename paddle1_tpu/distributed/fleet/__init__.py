@@ -14,6 +14,8 @@ Usage matches the reference:
 from .strategy import DistributedStrategy
 from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker, Role
 from .fleet_base import Fleet, fleet
+from .meta_optimizers import (DGCMomentumOptimizer, LocalSGDOptimizer,
+                              compile_strategy)
 from ..meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                              VocabParallelEmbedding, ParallelCrossEntropy,
                              LayerDesc, SharedLayerDesc, PipelineLayer,
@@ -23,6 +25,7 @@ from .utils import recompute, fleet_util
 # module-level delegation to the singleton (the reference exposes
 # fleet.init etc. as module functions)
 init = fleet.init
+parallel_engine = fleet.parallel_engine
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 distributed_scaler = fleet.distributed_scaler

@@ -179,6 +179,21 @@ class Fleet:
             HybridParallelGradScaler
         return HybridParallelGradScaler(scaler, self._hcg)
 
+    def parallel_engine(self, model: Layer, optimizer, loss_fn,
+                        mesh=None, **overrides):
+        """Compile the active DistributedStrategy into a ParallelEngine —
+        the TPU-native fleet.minimize (reference fleet_base.py:1212 →
+        StrategyCompiler → chained meta-optimizer rewrites; here: one
+        strategy→engine-config mapping, one jit)."""
+        self._ensure_init()
+        from .meta_optimizers import compile_strategy
+        cfg = compile_strategy(self._strategy)
+        cfg.update(overrides)
+        if mesh is not None:
+            cfg.pop("degrees", None)
+        from ..parallel_engine import ParallelEngine
+        return ParallelEngine(model, optimizer, loss_fn, mesh=mesh, **cfg)
+
     def minimize(self, optimizer, loss=None, startup_program=None,
                  parameter_list=None, no_grad_set=None):
         """Static-mode minimize (reference fleet_base.py:1212). In the TPU

@@ -53,7 +53,9 @@ def _as_arrays(batch):
 
 def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                     grad_accum: int = 1,
-                    clip_global_norm: Optional[float] = None):
+                    clip_global_norm: Optional[float] = None,
+                    amp_dtype: Optional[str] = None,
+                    recompute: bool = False):
     """Build the pure train-step: (params, opt_state, batch, key, lr) →
     (loss, params, opt_state).
 
@@ -65,10 +67,36 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
     """
 
     def pure_loss(params, batch, key):
+        if amp_dtype is not None:
+            # bf16 autocast: compute params in bf16, masters stay f32 in
+            # the optimizer (reference pure-fp16 mode, fp16_utils.py:322)
+            cdt = jnp.dtype(amp_dtype)
+            params = {k: (v.astype(cdt)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
         with autograd_engine.no_grad(), rng_scope(key):
             with layer.load_functional_state(params):
                 out = loss_fn(layer, batch)
-        return out.data if isinstance(out, Tensor) else out
+        out = out.data if isinstance(out, Tensor) else out
+        return out.astype(jnp.float32)
+
+    if recompute:
+        # Rematerialisation must be per-BLOCK to cut peak memory
+        # (checkpointing the whole loss would re-run the forward without
+        # reducing the residual set). Flip the recompute switch on every
+        # block-structured sublayer that supports it.
+        from ..nn.layer_transformer import TransformerEncoder
+        flipped = 0
+        for sub in layer.sublayers(include_self=True):
+            if isinstance(sub, TransformerEncoder):
+                sub.enable_recompute = True
+                flipped += 1
+        if not flipped:
+            import warnings
+            warnings.warn(
+                "recompute=True: no recompute-capable blocks found "
+                "(TransformerEncoder); wrap your own blocks with "
+                "fleet.utils.recompute for per-segment remat")
 
     def train_step(params, opt_state, batch, key, lr):
         if grad_accum > 1:
@@ -123,7 +151,9 @@ class ParallelEngine:
                  zero_stage: int = 0, grad_accum: int = 1,
                  clip_global_norm: Optional[float] = None,
                  batch_spec: Optional[Any] = None,
-                 donate: bool = True):
+                 donate: bool = True,
+                 amp_dtype: Optional[str] = None,
+                 recompute: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -176,7 +206,9 @@ class ParallelEngine:
         self.grad_accum = grad_accum
         self._step_fn = make_train_step(model, optimizer, loss_fn,
                                         grad_accum=grad_accum,
-                                        clip_global_norm=clip_global_norm)
+                                        clip_global_norm=clip_global_norm,
+                                        amp_dtype=amp_dtype,
+                                        recompute=recompute)
 
         ns = lambda spec: NamedSharding(self.mesh, spec)
         param_sh = {k: ns(s) for k, s in self.param_specs.items()}

@@ -188,9 +188,19 @@ class TransformerEncoder(Layer):
     def forward(self, src, src_mask=None, cache=None):
         output = src
         new_caches = []
+        # enable_recompute: per-block activation rematerialisation
+        # (reference RecomputeOptimizer segments; paddlenlp sets the same
+        # attribute) — real peak-memory reduction, unlike checkpointing
+        # the whole loss.
+        remat = getattr(self, "enable_recompute", False) and self.training
         for i, mod in enumerate(self.layers):
             if cache is None:
-                output = mod(output, src_mask)
+                if remat:
+                    from ..distributed.fleet.utils.recompute import \
+                        recompute
+                    output = recompute(mod, output, src_mask)
+                else:
+                    output = mod(output, src_mask)
             else:
                 output, new_cache = mod(output, src_mask, cache[i])
                 new_caches.append(new_cache)

@@ -1,0 +1,166 @@
+"""Strategy compiler + meta-optimizer wrappers (reference
+test_fleet_*_meta_optimizer.py pattern: configure strategy, assert on the
+compiled result)."""
+
+import unittest
+
+import numpy as np
+
+import paddle1_tpu as paddle
+import paddle1_tpu.distributed.fleet as fleet
+from paddle1_tpu.distributed.fleet import (DGCMomentumOptimizer,
+                                           DistributedStrategy,
+                                           LocalSGDOptimizer,
+                                           compile_strategy)
+
+
+class TestStrategyCompiler(unittest.TestCase):
+    def test_default_all_dp(self):
+        cfg = compile_strategy(DistributedStrategy(), n_devices=8)
+        self.assertEqual(cfg["degrees"], {"dp": 8, "mp": 1, "pp": 1,
+                                          "sharding": 1})
+        self.assertEqual(cfg["zero_stage"], 0)
+
+    def test_sharding_absorbs_devices(self):
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 2}
+        cfg = compile_strategy(s, n_devices=8)
+        self.assertEqual(cfg["zero_stage"], 2)
+        self.assertEqual(cfg["degrees"]["sharding"], 8)
+        self.assertEqual(cfg["degrees"]["dp"], 1)
+
+    def test_sharding_respects_explicit_dp(self):
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 2}
+        s.hybrid_configs = {"dp_degree": 2}
+        cfg = compile_strategy(s, n_devices=8)
+        self.assertEqual(cfg["degrees"]["dp"], 2)
+        self.assertEqual(cfg["degrees"]["sharding"], 4)
+
+    def test_indivisible_raises(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        s = DistributedStrategy()
+        s.hybrid_configs = {"mp_degree": 3}
+        with self.assertRaises(InvalidArgumentError):
+            compile_strategy(s, n_devices=8)
+
+    def test_recompute_flag_flips_encoder(self):
+        from paddle1_tpu.text.models import BertModel
+        from paddle1_tpu.distributed import ParallelEngine, build_mesh
+        import jax
+        m = BertModel(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                      num_attention_heads=2, intermediate_size=32,
+                      max_position_embeddings=8)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        eng = ParallelEngine(
+            m, opt, lambda mm, b: mm(paddle.to_tensor(b["ids"]))[1].sum(),
+            mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+            recompute=True)
+        self.assertTrue(getattr(m.encoder, "enable_recompute", False))
+        m.train()
+        l = eng.step({"ids": np.random.randint(
+            1, 32, (2, 8)).astype(np.int32)})
+        self.assertTrue(np.isfinite(float(l)))
+
+    def test_hybrid_tp_dp(self):
+        s = DistributedStrategy()
+        s.hybrid_configs = {"mp_degree": 2}
+        cfg = compile_strategy(s, n_devices=8)
+        self.assertEqual(cfg["degrees"]["mp"], 2)
+        self.assertEqual(cfg["degrees"]["dp"], 4)
+
+    def test_gradient_merge_and_amp(self):
+        s = DistributedStrategy()
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 4}
+        s.amp = True
+        cfg = compile_strategy(s, n_devices=1)
+        self.assertEqual(cfg["grad_accum"], 4)
+        self.assertEqual(cfg["amp_dtype"], "bfloat16")
+
+    def test_fleet_parallel_engine_end_to_end(self):
+        from paddle1_tpu.text.models import (BertForPretraining, BertModel,
+                                             BertPretrainingCriterion,
+                                             apply_megatron_sharding)
+        s = DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs = {"stage": 2, "sharding_degree": 2}
+        s.hybrid_configs = {"mp_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        model = BertForPretraining(BertModel(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=16, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        apply_megatron_sharding(model)
+        crit = BertPretrainingCriterion(64)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        def loss_fn(m, b):
+            sc, rel = m(paddle.to_tensor(b["ids"]))
+            return crit(sc, rel, paddle.to_tensor(b["mlm"]),
+                        paddle.to_tensor(b["nsp"]))
+
+        eng = fleet.parallel_engine(model, opt, loss_fn)
+        self.assertEqual(dict(eng.mesh.shape)["mp"], 2)
+        self.assertEqual(dict(eng.mesh.shape)["sharding"], 2)
+        rng = np.random.default_rng(0)
+        batch = {"ids": rng.integers(1, 64, (8, 16)).astype(np.int32),
+                 "mlm": rng.integers(0, 64, (8, 16)).astype(np.int32),
+                 "nsp": rng.integers(0, 2, (8,)).astype(np.int32)}
+        l0 = float(eng.step(batch))
+        l1 = float(eng.step(batch))
+        self.assertTrue(np.isfinite(l0) and np.isfinite(l1))
+        self.assertLess(l1, l0)
+
+
+class TestMetaOptimizers(unittest.TestCase):
+    def _model_opt(self):
+        m = paddle.nn.Linear(4, 2)
+        opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                        parameters=m.parameters())
+        return m, opt
+
+    def test_localsgd_counts_steps(self):
+        m, opt = self._model_opt()
+        lopt = LocalSGDOptimizer(opt, k_steps=2)
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(4, 2).astype(np.float32))
+        for i in range(4):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            lopt.step()
+            lopt.clear_grad()
+        self.assertEqual(lopt._step_count, 4)
+
+    def test_dgc_sparsifies_grads(self):
+        m, opt = self._model_opt()
+        dopt = DGCMomentumOptimizer(opt, sparsity=0.25)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32))
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        dopt.step()
+        g = m.weight.grad.numpy()
+        nz = (np.abs(g) > 0).sum()
+        self.assertLessEqual(nz, max(1, int(g.size * 0.25)) + 1)
+        # residual kept for error feedback
+        self.assertTrue(any(np.abs(v).sum() > 0
+                            for v in dopt._v.values()))
+
+    def test_dgc_training_converges(self):
+        m, opt = self._model_opt()
+        dopt = DGCMomentumOptimizer(opt, sparsity=0.5)
+        x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+        y = paddle.to_tensor(
+            (np.random.randn(16, 2) * 0.1).astype(np.float32))
+        losses = []
+        for _ in range(30):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            dopt.step()
+            dopt.clear_grad()
+            losses.append(float(loss))
+        self.assertLess(losses[-1], losses[0])
