@@ -1,0 +1,136 @@
+"""Text datasets (reference python/paddle/text/datasets/: Imdb, Conll05,
+Movielens, UCIHousing, WMT14/16...). No network egress: parsers read the
+official archive formats from a local path; ``FakeTextDataset`` generates
+deterministic synthetic corpora so pipelines run hermetically."""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "FakeTextDataset", "mlm_masking"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download unavailable (no network egress); "
+        "pass data_file= pointing at the official archive.")
+
+
+class Imdb(Dataset):
+    """aclImdb sentiment archive parser (reference text/datasets/imdb.py).
+    Yields (ids, label); tokenization via a caller-provided tokenizer or
+    whitespace fallback."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 tokenizer=None):
+        if data_file is None:
+            _no_download("Imdb")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        self._docs, self._labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                match = pat.match(m.name)
+                if not match:
+                    continue
+                text = tf.extractfile(m).read().decode("utf-8",
+                                                       "ignore").lower()
+                self._docs.append(text)
+                self._labels.append(0 if match.group(1) == "neg" else 1)
+        if tokenizer is None:
+            from .tokenizer import BasicTokenizer, build_vocab
+            basic = BasicTokenizer()
+            self._vocab = build_vocab(self._docs, max_size=cutoff * 100)
+            self._tok = lambda t: [self._vocab.get(w, 1)
+                                   for w in basic.tokenize(t)]
+        else:
+            self._tok = lambda t: tokenizer.convert_tokens_to_ids(
+                tokenizer.tokenize(t))
+
+    def __getitem__(self, idx):
+        ids = np.asarray(self._tok(self._docs[idx]), np.int64)
+        return ids, np.array([self._labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self._docs)
+
+
+class UCIHousing(Dataset):
+    """housing.data whitespace table (reference text/datasets/
+    uci_housing.py): 13 features, 1 target, feature-normalized."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            _no_download("UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mean, std = feats.mean(0), feats.std(0) + 1e-8
+        feats = (feats - mean) / std
+        n_train = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:n_train], target[:n_train]
+        else:
+            self.x, self.y = feats[n_train:], target[n_train:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class FakeTextDataset(Dataset):
+    """Deterministic synthetic token sequences for LM/classification
+    pipelines (the hermetic-test analog of FakeData)."""
+
+    def __init__(self, num_samples=256, seq_len=64, vocab_size=1000,
+                 num_classes=2, task="classify", seed=0,
+                 mask_token_id=4, pad_token_id=0):
+        rng = np.random.default_rng(seed)
+        self.task = task
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.mask_token_id = mask_token_id
+        self._ids = rng.integers(5, vocab_size, (num_samples, seq_len)
+                                 ).astype(np.int32)
+        self._labels = rng.integers(0, num_classes,
+                                    num_samples).astype(np.int64)
+        self._rng_seed = seed
+
+    def __getitem__(self, idx):
+        ids = self._ids[idx]
+        if self.task == "classify":
+            return ids, np.array([self._labels[idx]], np.int64)
+        # mlm: mask 15% and return (masked_ids, labels with -1 off-mask)
+        masked, labels = mlm_masking(ids, self.vocab_size,
+                                     mask_token_id=self.mask_token_id,
+                                     seed=self._rng_seed + idx)
+        return masked, labels
+
+    def __len__(self):
+        return len(self._ids)
+
+
+def mlm_masking(ids, vocab_size, mask_prob=0.15, mask_token_id=4,
+                seed=0):
+    """BERT masking recipe: of the selected 15%, 80% → [MASK], 10% →
+    random token, 10% kept; labels are -1 everywhere else."""
+    rng = np.random.default_rng(seed)
+    ids = np.asarray(ids)
+    sel = rng.random(ids.shape) < mask_prob
+    labels = np.where(sel, ids, -1).astype(np.int32)
+    r = rng.random(ids.shape)
+    masked = ids.copy()
+    masked[sel & (r < 0.8)] = mask_token_id
+    rand_sel = sel & (r >= 0.8) & (r < 0.9)
+    masked[rand_sel] = rng.integers(5, vocab_size,
+                                    rand_sel.sum()).astype(ids.dtype)
+    return masked, labels

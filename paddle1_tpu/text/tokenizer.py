@@ -1,0 +1,183 @@
+"""Tokenization for the BERT/ERNIE family.
+
+The reference keeps tokenizers in the PaddleNLP companion repo
+(BasicTokenizer/WordpieceTokenizer/BertTokenizer); the framework needs them
+in-tree for the pretraining configs to be runnable end-to-end. Pure-Python
+host-side code (tokenization never belongs on the accelerator).
+"""
+
+from __future__ import annotations
+
+import collections
+import unicodedata
+from typing import Dict, List, Optional
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
+           "build_vocab"]
+
+
+def _is_whitespace(ch):
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or \
+            (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting + lowercasing."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        buf = []
+        for ch in text:
+            if _is_control(ch):
+                continue
+            if _is_whitespace(ch):
+                if buf:
+                    out.append("".join(buf))
+                    buf = []
+                continue
+            cp = ord(ch)
+            if (0x4E00 <= cp <= 0x9FFF) or _is_punctuation(ch):
+                if buf:
+                    out.append("".join(buf))
+                    buf = []
+                out.append(ch)
+                continue
+            buf.append(ch)
+        if buf:
+            out.append("".join(buf))
+        if self.do_lower_case:
+            out = [unicodedata.normalize("NFD", t.lower()) for t in out]
+            out = ["".join(c for c in t
+                           if unicodedata.category(c) != "Mn")
+                   for t in out]
+        return [t for t in out if t]
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword splitting."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, token: str) -> List[str]:
+        if len(token) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        out = []
+        start = 0
+        while start < len(token):
+            end = len(token)
+            cur = None
+            while start < end:
+                piece = token[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            out.append(cur)
+            start = end
+        return out
+
+
+class BertTokenizer:
+    """vocab-file tokenizer with the paddlenlp surface: tokenize,
+    convert_tokens_to_ids, __call__ producing input_ids/token_type_ids."""
+
+    def __init__(self, vocab_file=None, vocab: Optional[Dict[str, int]]
+                 = None, do_lower_case: bool = True, unk_token="[UNK]",
+                 pad_token="[PAD]", cls_token="[CLS]", sep_token="[SEP]",
+                 mask_token="[MASK]"):
+        if vocab is None:
+            if vocab_file is None:
+                raise ValueError("need vocab_file or vocab dict")
+            vocab = {}
+            with open(vocab_file, encoding="utf-8") as f:
+                for i, line in enumerate(f):
+                    vocab[line.rstrip("\n")] = i
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(vocab, unk_token)
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.mask_token = mask_token
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for tok in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(tok))
+        return out
+
+    def convert_tokens_to_ids(self, tokens) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids) -> List[str]:
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def __call__(self, text, text_pair=None, max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = False):
+        a = self.tokenize(text)
+        b = self.tokenize(text_pair) if text_pair is not None else None
+        # truncate to fit specials
+        budget = max_seq_len - 2 - (1 if b is not None else 0)
+        if b is not None:
+            while len(a) + len(b) > budget:
+                (a if len(a) >= len(b) else b).pop()
+        else:
+            a = a[:budget]
+        tokens = [self.cls_token] + a + [self.sep_token]
+        type_ids = [0] * len(tokens)
+        if b is not None:
+            tokens += b + [self.sep_token]
+            type_ids += [1] * (len(b) + 1)
+        ids = self.convert_tokens_to_ids(tokens)
+        if pad_to_max_seq_len and len(ids) < max_seq_len:
+            pad_id = self.vocab.get(self.pad_token, 0)
+            pad = max_seq_len - len(ids)
+            ids += [pad_id] * pad
+            type_ids += [0] * pad
+        return {"input_ids": ids, "token_type_ids": type_ids}
+
+
+def build_vocab(texts, max_size: int = 30000, do_lower_case: bool = True,
+                specials=("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")):
+    """Frequency-sorted whole-word vocab from an iterable of texts (for
+    hermetic tests / small corpora)."""
+    basic = BasicTokenizer(do_lower_case)
+    counter = collections.Counter()
+    for t in texts:
+        counter.update(basic.tokenize(t))
+    vocab = {s: i for i, s in enumerate(specials)}
+    for tok, _ in counter.most_common(max_size - len(specials)):
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+    return vocab
