@@ -16,6 +16,7 @@
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -137,18 +138,35 @@ struct BlockHeader {
 static const uint64_t kMagic = 0x50311A7EULL;
 
 // Create (or attach to) a named shm arena; returns mapped base or null.
+// ftruncate runs ONLY on fresh O_EXCL creation — resizing an arena another
+// process already mapped would shear its mapping (ADVICE r1 finding).
 void* shm_arena_create(const char* name, uint64_t size) {
-  int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+  bool created = true;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0 && errno == EEXIST) {
+    created = false;
+    fd = shm_open(name, O_RDWR, 0600);
+  }
   if (fd < 0) return nullptr;
-  if (ftruncate(fd, (off_t)size) != 0) {
-    close(fd);
-    return nullptr;
+  if (created) {
+    if (ftruncate(fd, (off_t)size) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < size) {
+      close(fd);
+      return nullptr;  // existing arena too small; caller picks a new name
+    }
+    size = (uint64_t)st.st_size;
   }
   void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (base == MAP_FAILED) return nullptr;
   auto* hdr = static_cast<ArenaHeader*>(base);
-  if (hdr->magic != kMagic) {
+  if (created || hdr->magic != kMagic) {
     hdr->magic = kMagic;
     hdr->size = size;
     hdr->bump.store(sizeof(ArenaHeader));
@@ -185,11 +203,15 @@ void shm_arena_unlink(const char* name) { shm_unlink(name); }
 uint64_t shm_alloc(void* base, uint64_t len) {
   auto* hdr = static_cast<ArenaHeader*>(base);
   uint64_t need = sizeof(BlockHeader) + ((len + 63) & ~63ULL);
-  uint64_t off = hdr->bump.fetch_add(need);
-  if (off + need > hdr->size) {
-    hdr->bump.fetch_sub(need);  // roll back; arena full
-    return 0;
-  }
+  // CAS loop instead of fetch_add + rollback: a failed add followed by a
+  // fetch_sub can momentarily overlap a concurrent winner's range
+  // (ADVICE r1 finding).
+  uint64_t off = hdr->bump.load(std::memory_order_relaxed);
+  do {
+    if (off + need > hdr->size) return 0;  // arena full
+  } while (!hdr->bump.compare_exchange_weak(off, off + need,
+                                            std::memory_order_acq_rel,
+                                            std::memory_order_relaxed));
   auto* blk = reinterpret_cast<BlockHeader*>(static_cast<char*>(base) + off);
   blk->len = len;
   blk->refs.store(1);

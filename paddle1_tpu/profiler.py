@@ -32,6 +32,7 @@ _lock = threading.Lock()
 _enabled = False
 _events: List[dict] = []          # completed spans
 _tls = threading.local()
+_trace_dir: Optional[str] = None  # process-wide device-trace state
 
 
 def _now_us() -> float:
@@ -95,19 +96,22 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
     if log_dir:
         import jax
         jax.profiler.start_trace(log_dir)
-        _tls.trace_dir = log_dir
+        # module-global, NOT thread-local: jax's trace is process-wide and
+        # stop may legitimately run on another thread (ADVICE r1 finding)
+        global _trace_dir
+        _trace_dir = log_dir
 
 
 def stop_profiler(sorted_key: str = "total",
                   profile_path: Optional[str] = None):
     """Stop, aggregate, print the event table; optionally write chrome
     trace JSON (reference profiler.py:260 stop_profiler)."""
-    global _enabled
+    global _enabled, _trace_dir
     _enabled = False
-    if getattr(_tls, "trace_dir", None):
+    if _trace_dir is not None:
         import jax
         jax.profiler.stop_trace()
-        _tls.trace_dir = None
+        _trace_dir = None
     with _lock:
         events = list(_events)
     agg: Dict[str, List[float]] = defaultdict(list)

@@ -95,13 +95,16 @@ class FakeQuantMovingAverageAbsMax(Layer):
                 else:
                     self.scale._data = (self.momentum * self.scale.data +
                                         (1 - self.momentum) * cur)
-        inited = self.inited.data
-        if not isinstance(inited, jax.core.Tracer) and \
-                int(np.asarray(inited)) == 0:
-            # no calibrated range yet (eval before any training forward):
-            # pass through rather than clamp everything to ~0
-            return x
-        return fake_quant(x, self.scale, self.bits)
+        # No calibrated range yet (eval before any training forward, or a
+        # jitted/functionalized forward where the EMA update above cannot
+        # run): pass through rather than clamp everything to ~0. The guard
+        # must be graph-safe — under jit ``inited`` is a tracer, and an
+        # eager-only early return would silently quantize with scale=0,
+        # collapsing every activation (ADVICE r1 finding).
+        q = fake_quant(x, self.scale, self.bits)
+        return apply("qat_inited_select",
+                     lambda qa, xa, i: jnp.where(i > 0, qa, xa),
+                     (q, x, self.inited))
 
 
 class QuantizedLinear(Layer):

@@ -50,24 +50,49 @@ def box_coder(prior_box, prior_box_var, target_box,
     """Encode/decode boxes against priors (reference box_coder_op)."""
 
     def f(prior, var, target):
-        pw = prior[:, 2] - prior[:, 0] + (0 if box_normalized else 1)
-        ph = prior[:, 3] - prior[:, 1] + (0 if box_normalized else 1)
+        n1 = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + n1          # [M]
+        ph = prior[:, 3] - prior[:, 1] + n1
         pcx = prior[:, 0] + pw * 0.5
         pcy = prior[:, 1] + ph * 0.5
         if code_type == "encode_center_size":
-            tw = target[:, 2] - target[:, 0] + (0 if box_normalized else 1)
-            th = target[:, 3] - target[:, 1] + (0 if box_normalized else 1)
+            # reference semantics: every target encoded against every
+            # prior → [N, M, 4]
+            tw = target[:, 2] - target[:, 0] + n1    # [N]
+            th = target[:, 3] - target[:, 1] + n1
             tcx = target[:, 0] + tw * 0.5
             tcy = target[:, 1] + th * 0.5
-            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
-                             jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
-            return out / var if var is not None else out
-        # decode_center_size
-        t = target * var if var is not None else target
-        cx = t[..., 0] * pw + pcx
-        cy = t[..., 1] * ph + pcy
-        w = jnp.exp(t[..., 2]) * pw
-        h = jnp.exp(t[..., 3]) * ph
+            out = jnp.stack(
+                [(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                 (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                 jnp.log(tw[:, None] / pw[None, :]),
+                 jnp.log(th[:, None] / ph[None, :])], axis=2)
+            if var is not None:
+                vb = var[None, :, :] if var.ndim == 2 else \
+                    var.reshape(1, 1, 4)
+                out = out / vb
+            return out
+        # decode_center_size: target [N, M, 4]; `axis` names the target
+        # axis the [*, 4] prior broadcasts ALONG (reference box_coder_op:
+        # axis=0 → prior aligns with dim 1, axis=1 → with dim 0)
+        if target.ndim == 3:
+            exp = (lambda a: a[None, :]) if axis == 0 else \
+                (lambda a: a[:, None])
+        else:
+            exp = lambda a: a
+        if var is not None:
+            if var.ndim == 2:
+                vb = (var[None, :, :] if axis == 0 else var[:, None, :]) \
+                    if target.ndim == 3 else var
+            else:
+                vb = var.reshape((1,) * (target.ndim - 1) + (4,))
+            t = target * vb
+        else:
+            t = target
+        cx = t[..., 0] * exp(pw) + exp(pcx)
+        cy = t[..., 1] * exp(ph) + exp(pcy)
+        w = jnp.exp(t[..., 2]) * exp(pw)
+        h = jnp.exp(t[..., 3]) * exp(ph)
         return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
                          axis=-1)
     if prior_box_var is None:
@@ -161,7 +186,11 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
             # category-aware: offset boxes per category so cross-category
             # pairs never overlap (the standard batched-NMS trick)
             c = cat[0].astype(jnp.float32)
-            off = c[:, None] * (jnp.max(boxes) + 1.0)
+            # offset by the full coordinate SPAN so categories land in
+            # disjoint bands even when coordinates are negative (a plain
+            # max+1 offset fails to separate then — ADVICE r1 finding)
+            span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+            off = c[:, None] * span
             keep = _nms_mask(boxes + off, scores, iou_threshold,
                              top_k or 0)
         else:
