@@ -147,6 +147,18 @@ def _define_builtin_flags() -> None:
     # JIT
     define_flag("jit_donate_params", True,
                 "Donate parameter buffers in compiled training steps.")
+    # Fused kernels (reference operators/fused/ role)
+    define_flag("flash_attention", "auto",
+                "Pallas flash attention: auto (TPU only), always "
+                "(interpret-mode on CPU, for tests), never.",
+                validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("fused_layer_norm", "auto",
+                "Pallas fused LayerNorm: auto (TPU only), always, never.",
+                validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("fused_adam", "auto",
+                "Pallas fused Adam/AdamW update: auto (TPU only), always, "
+                "never.",
+                validator=lambda v: v in ("auto", "always", "never"))
 
 
 _define_builtin_flags()

@@ -69,12 +69,41 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops.pallas import flash_attention as fa
     args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
 
+    def _as_padding_mask(mask, nk):
+        """[B,1,1,Nk] bool/additive mask → [B, Nk] keep-mask, or None if
+        not provably a pure padding mask (the flash kernel drops keys; it
+        cannot represent finite soft biases)."""
+        if mask is None or mask.ndim != 4 or mask.shape[-1] != nk:
+            return None
+        if mask.shape[1] != 1 or mask.shape[2] != 1:
+            return None
+        flat = mask[:, 0, 0, :]
+        if mask.dtype == jnp.bool_:
+            return flat.astype(jnp.float32)      # exact, trace-safe
+        if isinstance(mask, jax.core.Tracer):
+            # traced additive values are opaque — a finite bias would be
+            # silently discarded; let attention_ref apply it instead
+            return None
+        import numpy as np
+        fl = np.asarray(flat)
+        if not bool(np.all((np.abs(fl) <= 1e-6) | (fl <= -1e4))):
+            return None                          # soft bias → ref path
+        return jnp.asarray(fl > -1e4, jnp.float32)
+
     def f(q, k, v, *m):
+        from ...core.flags import flag
+        mode = flag("flash_attention")
+        flash_ok = (mode == "always" or
+                    (mode == "auto" and jax.default_backend() == "tpu"))
         mask = m[0] if m else None
-        if (use_flash and mask is None and drop == 0.0
-                and jax.default_backend() == "tpu"
+        if (use_flash and drop == 0.0 and flash_ok
                 and fa.supported(q.shape, k.shape, causal=is_causal)):
-            return fa.flash_attention(q, k, v, causal=is_causal)
+            if mask is None:
+                return fa.flash_attention(q, k, v, causal=is_causal)
+            pm = _as_padding_mask(mask, k.shape[1])
+            if pm is not None:
+                return fa.flash_attention(q, k, v, causal=is_causal,
+                                          padding_mask=pm)
         return attention_ref(q, k, v, mask=mask, dropout_p=drop,
                              is_causal=is_causal, dropout_key=dropout_key)
     return apply("scaled_dot_product_attention", f,

@@ -83,6 +83,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     axes = tuple(range(x.ndim - n_axes, x.ndim))
 
     def f(x, *wb):
+        if wb:
+            from ...core.flags import flag
+            from ...ops.pallas import layer_norm as pln
+            mode = flag("fused_layer_norm")
+            fused_ok = (mode == "always" or
+                        (mode == "auto" and jax.default_backend() == "tpu"))
+            if fused_ok and pln.supported(x.shape, n_axes):
+                return pln.fused_layer_norm(x, wb[0], wb[1], epsilon)
         xf = x.astype(jnp.float32)  # stats in f32 even under bf16 AMP
         mean = jnp.mean(xf, axis=axes, keepdims=True)
         var = jnp.var(xf, axis=axes, keepdims=True)

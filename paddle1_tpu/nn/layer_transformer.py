@@ -108,7 +108,20 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
         q, k, v, cache = self._prepare_qkv(query, key, value, cache)
-        mask = _convert_attention_mask(attn_mask, q.dtype)
+        from ..core import dtype as dtypes
+        if attn_mask is not None and (
+                attn_mask.dtype == dtypes.bool_ or
+                str(attn_mask.dtype).startswith("int")):
+            # keep the boolean form: sdpa consumes it exactly (and can
+            # route the fused flash kernel under trace); the additive
+            # conversion below stays for float masks / reference parity
+            from ..autograd.engine import apply as _apply
+            import jax.numpy as _jnp
+            mask = attn_mask if attn_mask.dtype == dtypes.bool_ else \
+                _apply("mask_to_bool", lambda m: m.astype(_jnp.bool_),
+                       (attn_mask,))
+        else:
+            mask = _convert_attention_mask(attn_mask, q.dtype)
         if mask is not None:
             mask_arr = mask  # [B,H,Nq,Nk]-broadcastable additive mask
             out = F.scaled_dot_product_attention(

@@ -51,8 +51,9 @@ def supported(q_shape, k_shape, causal: bool = False) -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k):
+                block_k, mask_ref=None):
     # q_ref: [BLOCK_Q, D]; k_ref/v_ref: [N_k, D]; o_ref: [BLOCK_Q, D]
+    # mask_ref (optional): [1, N_k] f32, 1.0 = attend / 0.0 = padding.
     q_blk = pl.program_id(1)
     nk = k_ref.shape[0]
     nq = pl.num_programs(1) * BLOCK_Q
@@ -66,6 +67,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [BQ, BK]
+        if mask_ref is not None:
+            mk = mask_ref[0, pl.ds(i * block_k, block_k)]  # [BK]
+            s = jnp.where(mk[None, :] > 0.5, s, _NEG_INF)
         if causal:
             # bottom-right alignment (query i attends keys j <= i + nk-nq),
             # matching attention_ref's tril(..., nk - nq)
@@ -97,12 +101,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m, l, acc = jax.lax.fori_loop(0, n_blocks_eff, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    # Rows with zero visible keys (fully-padded batch entry): m is still the
+    # sentinel and p degenerated to exp(0)=1 per key inside the loop. Gate
+    # those rows to zero output and sentinel LSE so the backward (which
+    # keys p off the LSE) produces exact zero gradients for them.
+    visible = m > _NEG_INF * 0.5
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    out = jnp.where(visible[:, None], acc / l_safe[:, None], 0.0)
+    o_ref[:] = out.astype(o_ref.dtype)
+    lse_ref[:] = jnp.where(visible, m + jnp.log(l_safe),
+                           _NEG_INF).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, scale, causal):
+def _flash_fwd(q, k, v, scale, causal, padding_mask=None):
     b, nq, h, d = q.shape
     nk = k.shape[1]
     # [B, N, H, D] → [B*H, N, D]
@@ -112,14 +123,27 @@ def _flash_fwd(q, k, v, scale, causal):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=BLOCK_K)
+    in_specs = [
+        pl.BlockSpec((None, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((None, nk, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((None, nk, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    args = (qh, kh, vh)
+    if padding_mask is not None:
+        # [B, Nk] keep-mask as f32; each (batch, head) program reads its
+        # batch row (index map folds bh → b).
+        mk = padding_mask.astype(jnp.float32).reshape(b, 1, nk)
+        in_specs.append(
+            pl.BlockSpec((None, 1, nk), lambda bh, i: (bh // h, 0, 0)))
+        args = args + (mk,)
+
+        def kernel(q_r, k_r, v_r, m_r, o_r, l_r):
+            _fwd_kernel(q_r, k_r, v_r, o_r, l_r, scale=scale, causal=causal,
+                        block_k=BLOCK_K, mask_ref=m_r)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq // BLOCK_Q),
-        in_specs=[
-            pl.BlockSpec((None, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, nk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, nk, d), lambda bh, i: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((None, BLOCK_Q), lambda bh, i: (bh, i)),
@@ -129,10 +153,43 @@ def _flash_fwd(q, k, v, scale, causal):
             jax.ShapeDtypeStruct((b * h, nq), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qh, kh, vh)
+    )(*args)
     out = out.reshape(b, h, nq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, nq)
     return out, lse
+
+
+def _bwd_xla(q, k, v, out, lse, dout, scale, causal, padding_mask=None):
+    """Flash-style backward in XLA: recompute P per (b,h) from the saved
+    LSE; XLA blocks/fuses the einsums onto the MXU. (A hand-written Pallas
+    backward kernel is a later-round optimization.)"""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B,H,Nq,D]
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    doh = jnp.swapaxes(dout, 1, 2).astype(jnp.float32)
+    oh = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if padding_mask is not None:
+        s = jnp.where(padding_mask[:, None, None, :] > 0.5, s, _NEG_INF)
+    if causal:
+        nq, nk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((nq, nk), bool), nk - nq)
+        s = jnp.where(mask, s, _NEG_INF)
+    # fully-masked rows carry the sentinel LSE from the forward: exp(s-lse)
+    # would be exp(0)=1 per key there — gate p to zero instead so such rows
+    # contribute no gradient (matching their zeroed forward output)
+    lse = jnp.where(lse > _NEG_INF * 0.1, lse, jnp.inf)
+    p = jnp.exp(s - lse[..., None])                   # [B,H,Nq,Nk]
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
+    delta = jnp.sum(doh * oh, axis=-1, keepdims=True)  # [B,H,Nq,1]
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
+    to = lambda x: jnp.swapaxes(x, 1, 2)
+    return (to(dq).astype(q.dtype), to(dk).astype(k.dtype),
+            to(dv).astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -147,38 +204,47 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
 
 
 def _flash_vjp_bwd(scale, causal, res, dout):
-    """Flash-style backward in XLA: recompute P per (b,h) from the saved
-    LSE; XLA blocks/fuses the einsums onto the MXU. (A hand-written Pallas
-    backward kernel is a later-round optimization.)"""
     q, k, v, out, lse = res
-    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B,H,Nq,D]
-    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
-    doh = jnp.swapaxes(dout, 1, 2).astype(jnp.float32)
-    oh = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
-
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-    if causal:
-        nq, nk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((nq, nk), bool), nk - nq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])                   # [B,H,Nq,Nk]
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
-    delta = jnp.sum(doh * oh, axis=-1, keepdims=True)  # [B,H,Nq,1]
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
-    to = lambda x: jnp.swapaxes(x, 1, 2)
-    return (to(dq).astype(q.dtype), to(dk).astype(k.dtype),
-            to(dv).astype(v.dtype))
+    return _bwd_xla(q, k, v, out, lse, dout, scale, causal)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_masked(q, k, v, padding_mask, scale, causal):
+    out, _ = _flash_fwd(q, k, v, scale, causal, padding_mask=padding_mask)
+    return out
+
+
+def _flash_masked_vjp_fwd(q, k, v, padding_mask, scale, causal):
+    out, lse = _flash_fwd(q, k, v, scale, causal, padding_mask=padding_mask)
+    return out, (q, k, v, padding_mask, out, lse)
+
+
+def _flash_masked_vjp_bwd(scale, causal, res, dout):
+    q, k, v, padding_mask, out, lse = res
+    dq, dk, dv = _bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                          padding_mask=padding_mask)
+    # mask enters as f32 0/1 (see flash_attention), so a plain zero
+    # cotangent is the right "non-differentiable" answer
+    return dq, dk, dv, jnp.zeros_like(padding_mask)
+
+
+_flash_masked.defvjp(_flash_masked_vjp_fwd, _flash_masked_vjp_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, padding_mask=None):
+    """Fused attention. ``padding_mask``: optional [B, Nk] keep-mask
+    (bool/0-1); padded key positions are excluded from the softmax —
+    the Pallas analog of the reference's additive attention-mask input
+    (nn/layer/transformer.py MultiHeadAttention attn_mask)."""
     d = q.shape[-1]
     s = float(scale) if scale is not None else float(1.0 / (d ** 0.5))
-    return _flash(q, k, v, s, causal)
+    if padding_mask is None:
+        return _flash(q, k, v, s, causal)
+    pm = jnp.asarray(padding_mask)
+    if pm.dtype == jnp.bool_:
+        pm = pm.astype(jnp.float32)
+    return _flash_masked(q, k, v, pm, s, causal)

@@ -1,0 +1,293 @@
+"""Fused Pallas kernel tests (flash padding mask, fused LayerNorm, fused
+Adam) — run in interpreter mode on the CPU sim, exercising the same kernel
+code the TPU executes. Mirrors the reference's fused-op unit tests
+(test_fused_* over operators/fused/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle1_tpu.core.flags import flags_guard
+
+
+class TestFlashPaddingMask:
+    def _qkv(self, b=2, n=128, h=2, d=32, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((b, n, h, d)).astype(np.float32) * 0.5)
+        return mk(), mk(), mk()
+
+    def test_masked_matches_ref(self):
+        from paddle1_tpu.nn.functional.attention import attention_ref
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        q, k, v = self._qkv()
+        b, n = q.shape[0], k.shape[1]
+        rng = np.random.default_rng(1)
+        keep = np.ones((b, n), np.float32)
+        keep[:, n // 2:] = 0.0  # second half = padding
+        out = fa.flash_attention(q, k, v, padding_mask=jnp.asarray(keep))
+        add = jnp.where(jnp.asarray(keep)[:, None, None, :] > 0, 0.0,
+                        -1e9).astype(jnp.float32)
+        ref = attention_ref(q, k, v, mask=add)
+        # only non-padded query rows are meaningful downstream
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_masked_grads_finite_and_match(self):
+        from paddle1_tpu.nn.functional.attention import attention_ref
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        q, k, v = self._qkv(b=1, n=128, h=1, d=16)
+        keep = np.ones((1, 128), np.float32)
+        keep[:, 100:] = 0.0
+        keepj = jnp.asarray(keep)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(fa.flash_attention(
+                q, k, v, padding_mask=keepj) ** 2)
+
+        def loss_ref(q, k, v):
+            add = jnp.where(keepj[:, None, None, :] > 0, 0.0, -1e9)
+            return jnp.sum(attention_ref(q, k, v, mask=add) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert np.isfinite(np.asarray(a)).all()
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4)
+
+    def test_fully_padded_row_zero_output_and_grads(self):
+        """Review finding: an all-padding batch entry must produce zero
+        output and exactly zero gradients, not exp(0)=1 garbage."""
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        q, k, v = self._qkv(b=2, n=128, h=1, d=16)
+        keep = np.ones((2, 128), np.float32)
+        keep[1, :] = 0.0  # batch entry 1 fully padded
+        keepj = jnp.asarray(keep)
+
+        out = fa.flash_attention(q, k, v, padding_mask=keepj)
+        np.testing.assert_allclose(np.asarray(out)[1], 0.0)
+
+        def loss(q, k, v):
+            return jnp.sum(fa.flash_attention(
+                q, k, v, padding_mask=keepj) ** 2)
+
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for g in (gq, gk, gv):
+            ga = np.asarray(g)
+            assert np.isfinite(ga).all()
+            np.testing.assert_allclose(ga[1], 0.0)
+
+    def test_soft_bias_mask_falls_back_to_ref(self):
+        """Review finding: a finite additive bias (not a padding mask) must
+        NOT route to the flash kernel, which would drop it."""
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        q = np.random.default_rng(3).standard_normal(
+            (2, 128, 2, 32)).astype(np.float32)
+        bias = np.full((2, 1, 1, 128), -5.0, np.float32)  # soft penalty
+        called = {}
+        orig = fa.flash_attention
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return orig(*a, **kw)
+
+        fa.flash_attention = spy
+        try:
+            with flags_guard({"flash_attention": "always"}):
+                out = F.scaled_dot_product_attention(
+                    to_tensor(q), to_tensor(q), to_tensor(q),
+                    attn_mask=to_tensor(bias), dropout_p=0.0)
+        finally:
+            fa.flash_attention = orig
+        assert "yes" not in called, "soft bias was dropped by flash routing"
+        # and the bias genuinely shifted nothing (uniform): output finite
+        assert np.isfinite(np.asarray(out.data)).all()
+
+    def test_bool_mask_routes_flash_under_trace(self):
+        """BERT's bool keep-mask must stay flash-routable inside jit."""
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        q = np.random.default_rng(4).standard_normal(
+            (2, 128, 2, 32)).astype(np.float32)
+        keep = np.ones((2, 1, 1, 128), bool)
+        keep[:, :, :, 100:] = False
+        called = {}
+        orig = fa.flash_attention
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return orig(*a, **kw)
+
+        fa.flash_attention = spy
+        try:
+            with flags_guard({"flash_attention": "always"}):
+                def fwd(qa):
+                    return F.scaled_dot_product_attention(
+                        to_tensor(qa), to_tensor(qa), to_tensor(qa),
+                        attn_mask=to_tensor(jnp.asarray(keep)),
+                        dropout_p=0.0).data
+                out = jax.jit(fwd)(jnp.asarray(q))
+        finally:
+            fa.flash_attention = orig
+        assert called.get("yes"), "bool mask fell off the flash path in jit"
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_bert_routes_flash_for_bench_shapes(self):
+        """The flagship-path regression VERDICT r2 flagged: BERT's padding
+        mask must not knock attention off the flash path."""
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        q = np.random.default_rng(0).standard_normal(
+            (2, 128, 2, 32)).astype(np.float32)
+        mask = np.zeros((2, 1, 1, 128), np.float32)  # additive, no padding
+        mask[:, :, :, 120:] = -1e9
+        called = {}
+        orig = fa.flash_attention
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return orig(*a, **kw)
+
+        fa.flash_attention = spy
+        try:
+            with flags_guard({"flash_attention": "always"}):
+                out = F.scaled_dot_product_attention(
+                    to_tensor(q), to_tensor(q), to_tensor(q),
+                    attn_mask=to_tensor(mask), dropout_p=0.0)
+        finally:
+            fa.flash_attention = orig
+        assert called.get("yes"), (
+            "padding-shaped mask did not route to the flash kernel")
+        assert np.isfinite(np.asarray(out.data)).all()
+
+
+class TestFusedLayerNorm:
+    @pytest.mark.parametrize("shape", [(16, 128), (4, 32, 256)])
+    def test_matches_plain(self, shape):
+        from paddle1_tpu.ops.pallas import layer_norm as pln
+        rng = np.random.default_rng(0)
+        h = shape[-1]
+        x = jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 3 + 1)
+        w = jnp.asarray(rng.standard_normal((h,)).astype(np.float32))
+        b = jnp.asarray(rng.standard_normal((h,)).astype(np.float32))
+        assert pln.supported(shape, 1)
+        y = pln.fused_layer_norm(x, w, b, 1e-5)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        ref = (x - mean) * jax.lax.rsqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_plain(self):
+        from paddle1_tpu.ops.pallas import layer_norm as pln
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((8, 128)).astype(np.float32))
+        w = jnp.asarray(rng.standard_normal((128,)).astype(np.float32))
+        b = jnp.asarray(rng.standard_normal((128,)).astype(np.float32))
+
+        def plain(x, w, b):
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return jnp.sum(((x - mean) * jax.lax.rsqrt(var + 1e-5) * w + b)
+                           ** 2)
+
+        def fused(x, w, b):
+            return jnp.sum(pln.fused_layer_norm(x, w, b, 1e-5) ** 2)
+
+        gp = jax.grad(plain, argnums=(0, 1, 2))(x, w, b)
+        gf = jax.grad(fused, argnums=(0, 1, 2))(x, w, b)
+        for a, bb in zip(gf, gp):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_functional_routes_fused(self):
+        from paddle1_tpu.ops.pallas import layer_norm as pln
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.random.default_rng(2).standard_normal(
+            (16, 128)).astype(np.float32)
+        w = np.ones(128, np.float32)
+        b = np.zeros(128, np.float32)
+        called = {}
+        orig = pln.fused_layer_norm
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return orig(*a, **kw)
+
+        pln.fused_layer_norm = spy
+        try:
+            with flags_guard({"fused_layer_norm": "always"}):
+                y = F.layer_norm(to_tensor(x), 128, to_tensor(w),
+                                 to_tensor(b))
+        finally:
+            pln.fused_layer_norm = orig
+        assert called.get("yes")
+        np.testing.assert_allclose(np.asarray(y.data).mean(), 0.0, atol=1e-5)
+
+
+class TestFusedAdam:
+    def test_matches_plain_adamw(self):
+        from paddle1_tpu.ops.pallas import fused_adam as fadam
+        rng = np.random.default_rng(0)
+        n = fadam._CHUNK + 123  # force padding path
+        p = jnp.asarray(rng.standard_normal((n,)).astype(np.float32))
+        g = jnp.asarray(rng.standard_normal((n,)).astype(np.float32))
+        m1 = jnp.asarray(rng.standard_normal((n,)).astype(np.float32) * 0.01)
+        m2 = jnp.abs(jnp.asarray(
+            rng.standard_normal((n,)).astype(np.float32) * 0.01))
+        beta1, beta2, eps, decay, lr = 0.9, 0.999, 1e-8, 0.01, 1e-3
+        step = jnp.asarray(3, jnp.int32)
+
+        np_, nm1, nm2 = fadam.fused_adam_update(
+            p, g, m1, m2, lr, step, beta1, beta2, eps, decay)
+
+        em1 = beta1 * m1 + (1 - beta1) * g
+        em2 = beta2 * m2 + (1 - beta2) * g * g
+        bc1 = 1 - beta1 ** 3
+        bc2 = 1 - beta2 ** 3
+        upd = (em1 / bc1) / (jnp.sqrt(em2 / bc2) + eps)
+        ep = p * (1 - lr * decay) - lr * upd
+        np.testing.assert_allclose(np.asarray(np_), np.asarray(ep),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(nm1), np.asarray(em1),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(nm2), np.asarray(em2),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_optimizer_fused_equals_unfused(self):
+        """AdamW.functional_update with the flag on vs off is bit-close."""
+        import paddle1_tpu as paddle
+        from paddle1_tpu.ops.pallas import fused_adam as fadam
+        from paddle1_tpu.nn.layer_common import Linear
+        rng = np.random.default_rng(3)
+        lin = Linear(128, 128)  # 16k params >= _CHUNK? ensure threshold
+        n = int(np.prod(lin.weight.shape))
+        params = {k: t.data for k, t in lin.state_dict().items()}
+        grads = {k: jnp.asarray(
+            rng.standard_normal(v.shape).astype(np.float32) * 0.01)
+            for k, v in params.items()}
+
+        def run(flag_val):
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=lin.parameters())
+            state = opt.functional_init(params)
+            with flags_guard({"fused_adam": flag_val}):
+                newp, _ = opt.functional_update(params, grads, state,
+                                                jnp.float32(1e-3))
+            return newp
+
+        p_plain = run("never")
+        p_fused = run("always")
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_fused[k]),
+                                       np.asarray(p_plain[k]),
+                                       rtol=1e-6, atol=1e-7)
+        assert n >= fadam._CHUNK  # the weight actually took the fused path
