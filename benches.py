@@ -1,0 +1,240 @@
+"""Benchmark configs 1/2/4/5 from BASELINE.md, invoked by
+``bench.py --config <name>``; config 3 (bert_base, the driver default)
+lives in bench.py itself.
+
+Every config follows bench.py's honesty contract: per-step
+``block_until_ready`` timing, median step time, and ``mfu <= 1.0``
+asserts wherever an MFU is computed. The reference publishes no numeric
+baselines (BASELINE.md), so ``vs_baseline`` is MFU/0.40 where an MFU
+target applies and 1.0 (self-referential) for the throughput-only
+configs.
+
+Analog of the reference's config-driven op benchmark harness
+(/root/reference/paddle/fluid/operators/benchmark/op_tester.cc — there a
+config file picks the op; here --config picks the model-level workload).
+"""
+
+import numpy as np
+
+from bench import _assert_sane_mfu, _emit, _peak_flops, _timed_steps
+
+CONFIGS = {}
+
+
+def config(name):
+    def deco(fn):
+        CONFIGS[name] = fn
+        return fn
+    return deco
+
+
+def run_config(name: str, on_tpu: bool) -> None:
+    if name not in CONFIGS:
+        raise SystemExit(
+            f"unknown bench config {name!r}; available: "
+            f"{['bert_base'] + sorted(CONFIGS)}")
+    CONFIGS[name](on_tpu)
+
+
+@config("mnist_lenet")
+def bench_mnist_lenet(on_tpu):
+    """BASELINE config 1: eager dygraph LeNet training — exercises the
+    tape engine, nn, optimizer end-to-end (throughput, no MFU target)."""
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core.tensor import to_tensor
+    from paddle1_tpu.vision.models.lenet import LeNet
+
+    batch = 64 if on_tpu else 16
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,))
+
+    def step():
+        out = model(to_tensor(x))
+        loss = paddle.nn.functional.cross_entropy(
+            out, to_tensor(y.astype(np.int64)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step()  # warmup
+    times, loss = _timed_steps(step, 20 if on_tpu else 3)
+    import statistics
+    dt = statistics.median(times)
+    _emit("mnist_lenet_eager_samples_per_sec", batch / dt, "samples/s", 1.0,
+          {"batch": batch, "steps": len(times),
+           "step_ms_median": round(dt * 1e3, 2),
+           "loss": float(loss.numpy()), "mode": "eager"})
+
+
+@config("resnet50_dp")
+def bench_resnet50_dp(on_tpu):
+    """BASELINE config 2: ResNet-50 data-parallel over all local devices
+    (compiled engine; GSPMD inserts the grad all-reduce over ICI)."""
+    import jax
+    import statistics
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.vision.models.resnet import resnet50
+
+    devs = jax.devices()
+    img = 224 if on_tpu else 32
+    per_dev = 32 if on_tpu else 2
+    batch = per_dev * len(devs)
+
+    model = resnet50()
+    # lr kept small: the bench replays ONE batch, where the ImageNet lr
+    # schedule diverges; the timing is lr-independent
+    opt = paddle.optimizer.Momentum(learning_rate=1e-3, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(m, b):
+        out = m(Tensor(b["x"]))
+        return paddle.nn.functional.cross_entropy(out, Tensor(b["y"]))
+
+    mesh = build_mesh(dp=len(devs), devices=devs)
+    engine = ParallelEngine(model, opt, loss_fn, mesh=mesh,
+                            amp_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.default_rng(0)
+    b = {"x": rng.standard_normal((batch, 3, img, img)).astype(np.float32),
+         "y": rng.integers(0, 1000, (batch,)).astype(np.int64)}
+
+    engine.step(b)  # compile
+    jax.block_until_ready(engine.params)
+    times, loss = _timed_steps(lambda: engine.step(b), 10 if on_tpu else 3)
+    dt = statistics.median(times)
+
+    # ResNet-50 @224 fwd ≈ 4.1e9 FLOPs/sample (2×MACs); bwd ≈ 2× fwd
+    flops_sample = 4.1e9 * (img / 224.0) ** 2 * 3.0
+    mfu = (flops_sample * batch / dt) / (_peak_flops(devs[0]) * len(devs))
+    detail = {"batch": batch, "img": img, "devices": len(devs),
+              "step_ms_median": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+              "amp": "bfloat16" if on_tpu else "none",
+              "loss": float(loss)}
+    _assert_sane_mfu(mfu, detail)
+    _emit("resnet50_dp_samples_per_sec", batch / dt, "samples/s",
+          mfu / 0.40, detail)
+
+
+@config("ernie_sharded")
+def bench_ernie_sharded(on_tpu):
+    """BASELINE config 4: ERNIE-1.5B-class training with ZeRO-2 sharding
+    (reduce-scatter over ICI). A single chip cannot hold 1.63B params +
+    f32 Adam moments, so on one device this measures a depth-proxy
+    (6 of 24 layers, same width — the per-layer compute the full model
+    replicates 4×); with >= 4 devices the full depth runs sharded. The
+    full-scale sharded compile path is validated on the virtual 8-device
+    mesh by tests/test_parallel_engine.py and __graft_entry__.py."""
+    import jax
+    import statistics
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.text.models import (BertForPretraining,
+                                         BertPretrainingCriterion,
+                                         apply_megatron_sharding,
+                                         ernie_1p5b)
+
+    devs = jax.devices()
+    n = len(devs)
+    layers = 24 if n >= 4 else 6
+    seq = 512 if on_tpu else 64
+    per_dev = 4 if on_tpu else 1
+    batch = per_dev * n
+
+    enc = ernie_1p5b(num_hidden_layers=layers,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0,
+                     **({} if on_tpu else
+                        {"hidden_size": 256, "num_attention_heads": 4,
+                         "intermediate_size": 1024, "vocab_size": 1024}))
+    model = BertForPretraining(enc)
+    crit = BertPretrainingCriterion(enc.vocab_size)
+    if n > 1:
+        apply_megatron_sharding(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        scores, rel = m(Tensor(b["ids"]))
+        return crit(scores, rel, Tensor(b["mlm"]), Tensor(b["nsp"]))
+
+    mesh = build_mesh(dp=1, sharding=n, devices=devs)
+    engine = ParallelEngine(model, opt, loss_fn, mesh=mesh, zero_stage=2,
+                            amp_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.default_rng(0)
+    v = enc.vocab_size
+    b = {"ids": rng.integers(1, v, (batch, seq)).astype(np.int32),
+         "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
+         "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)}
+
+    engine.step(b)
+    jax.block_until_ready(engine.params)
+    times, loss = _timed_steps(lambda: engine.step(b), 10 if on_tpu else 2)
+    dt = statistics.median(times)
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    attn = 12 * layers * batch * seq * seq * enc.hidden_size
+    flops_step = 6 * n_params * batch * seq + attn
+    mfu = (flops_step / dt) / (_peak_flops(devs[0]) * n)
+    detail = {"batch": batch, "seq": seq, "layers": layers,
+              "params": n_params, "devices": n, "zero_stage": 2,
+              "step_ms_median": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+              "proxy": layers != 24, "loss": float(loss)}
+    _assert_sane_mfu(mfu, detail)
+    _emit("ernie_1p5b_zero2_samples_per_sec", batch / dt, "samples/s",
+          mfu / 0.40, detail)
+
+
+@config("yolov3_infer")
+def bench_yolov3_infer(on_tpu):
+    """BASELINE config 5: PP-YOLO-class detection inference — conv stack
+    jitted on device; box decode + NMS measured separately (they run
+    host-side at deploy time, matching the reference's split)."""
+    import jax
+    import statistics
+    import time
+    import paddle1_tpu as paddle
+    from paddle1_tpu.autograd import engine as ag
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.vision.models.yolo import yolov3
+
+    batch = 8 if on_tpu else 1
+    img = 416 if on_tpu else 128
+    model = yolov3(num_classes=80)
+    model.eval()
+    params = model.functional_state()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 3, img, img)).astype(np.float32)
+
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fwd(params, x):
+        with ag.no_grad(), model.load_functional_state(params):
+            return [o.data for o in model(Tensor(x))]
+
+    outs = fwd(params, jnp.asarray(x))
+    jax.block_until_ready(outs)
+    times, _ = _timed_steps(lambda: fwd(params, jnp.asarray(x)),
+                            20 if on_tpu else 3)
+    dt = statistics.median(times)
+
+    img_size = np.tile([[img, img]], (batch, 1)).astype(np.int32)  # [B,2]
+    t0 = time.perf_counter()
+    with ag.no_grad():
+        results = model.postprocess([Tensor(o) for o in outs],
+                                    Tensor(img_size))
+    post_ms = (time.perf_counter() - t0) * 1e3
+
+    _emit("yolov3_infer_images_per_sec", batch / dt, "images/s", 1.0,
+          {"batch": batch, "img": img,
+           "step_ms_median": round(dt * 1e3, 2),
+           "postprocess_ms_per_batch": round(post_ms, 2),
+           "detections_img0": int(np.asarray(
+               results[0][0].numpy()).shape[0]) if results else 0})

@@ -2,9 +2,10 @@
 
 from .bert import (BertForPretraining, BertForSequenceClassification,
                    BertModel, BertPretrainingCriterion, ErnieForPretraining,
-                   ErnieModel, apply_megatron_sharding, bert_base, bert_large)
+                   ErnieModel, apply_megatron_sharding, bert_base, bert_large,
+                   ernie_1p5b)
 
 __all__ = ["BertModel", "BertForPretraining", "BertPretrainingCriterion",
            "BertForSequenceClassification", "ErnieModel",
            "ErnieForPretraining", "apply_megatron_sharding", "bert_base",
-           "bert_large"]
+           "bert_large", "ernie_1p5b"]

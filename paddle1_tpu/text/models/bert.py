@@ -116,10 +116,11 @@ class BertModel(Layer):
             import jax.numpy as jnp
 
             def make_mask(ids):
+                # boolean keep-mask: exact semantics survive tracing, so
+                # attention can prove it padding-shaped and stay on the
+                # fused flash path (additive floats are opaque under jit)
                 pad = jnp.asarray(self.pad_token_id, ids.dtype)
-                keep = (ids != pad)
-                return jnp.where(keep, 0.0, -1e9).astype(
-                    jnp.float32)[:, None, None, :]
+                return (ids != pad)[:, None, None, :]
             attention_mask = apply("bert_mask", make_mask, (input_ids,))
         emb = self.embeddings(input_ids, token_type_ids, position_ids)
         encoded = self.encoder(emb, attention_mask)
@@ -221,6 +222,21 @@ def bert_base(**kw) -> BertModel:
 def bert_large(**kw) -> BertModel:
     return BertModel(hidden_size=1024, num_hidden_layers=24,
                      num_attention_heads=16, intermediate_size=4096, **kw)
+
+
+def ernie_1p5b(**kw) -> BertModel:
+    """ERNIE-3.0 1.5B-class encoder (BASELINE config 4): hidden 2304,
+    24 layers × (21.2M attn + 42.5M ffn) + 103M embeddings ≈ 1.63B params.
+    The architecture is the shared BERT encoder (see ErnieModel note);
+    this factory pins the 1.5B-scale hyperparameters the sharding bench
+    trains with ZeRO-2 over the mesh."""
+    kw.setdefault("vocab_size", 40000)
+    kw.setdefault("max_position_embeddings", 2048)
+    kw.setdefault("hidden_size", 2304)
+    kw.setdefault("num_hidden_layers", 24)
+    kw.setdefault("num_attention_heads", 18)
+    kw.setdefault("intermediate_size", 9216)
+    return ErnieModel(**kw)
 
 
 def apply_megatron_sharding(model: Layer, mp_axis: str = "mp") -> Layer:
