@@ -30,8 +30,8 @@ from ..core import flags
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from ..core.tensor import Tensor
 
-__all__ = ["apply", "run_backward", "grad", "no_grad", "enable_grad",
-           "is_grad_enabled", "set_grad_enabled", "GradNode"]
+__all__ = ["apply", "apply_custom_vjp", "run_backward", "grad", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled", "GradNode"]
 
 _tls = threading.local()
 
@@ -192,6 +192,51 @@ def _apply_impl(name: str, pure_fn: Callable,
     return out_tensors[0] if single else tuple(out_tensors)
 
 
+def apply_custom_vjp(name: str, fwd_fn: Callable, bwd_fn: Callable,
+                     tensor_inputs: Sequence[Tensor], **attrs) -> Any:
+    """Execute an op with a *caller-supplied* backward rule.
+
+    The extension point for ops whose cotangents are not plain arrays
+    (e.g. embedding's IndexedSlices gradient) or whose backward should not
+    be jax.vjp of the forward. ``fwd_fn(*arrays, **attrs)`` returns
+    ``(outputs, residuals)``; ``bwd_fn(residuals, cotangents)`` returns one
+    gradient per ``tensor_inputs`` entry (None / array / IndexedSlices) —
+    the engine keeps only the ones that require grad. This is the analog of
+    the reference's custom-operator registration
+    (fluid/framework/custom_operator.cc) at the tape level.
+    """
+    arrays = [t.data if isinstance(t, Tensor) else t for t in tensor_inputs]
+    outs, residuals = fwd_fn(*arrays, **attrs)
+
+    diff_idx = []
+    if is_grad_enabled():
+        for i, t in enumerate(tensor_inputs):
+            if isinstance(t, Tensor) and not t.stop_gradient and \
+                    _is_float(t.data):
+                diff_idx.append(i)
+    if not diff_idx:
+        return _wrap_outputs(name, outs, stop_gradient=True)
+
+    out_list, single = _normalize_outputs(outs)
+    out_tensors = [Tensor(o, stop_gradient=False) for o in out_list]
+
+    def vjp_fn(cotangents):
+        all_grads = bwd_fn(residuals, cotangents)
+        if not isinstance(all_grads, (tuple, list)):
+            all_grads = (all_grads,)
+        return tuple(all_grads[i] for i in diff_idx)
+
+    in_edges = []
+    for i in diff_idx:
+        t = tensor_inputs[i]
+        in_edges.append((t._node, t._output_index, t))
+    node = GradNode(name, vjp_fn, in_edges, out_tensors)
+    for j, ot in enumerate(out_tensors):
+        ot._node = node
+        ot._output_index = j
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
 def _normalize_outputs(outs):
     if isinstance(outs, (tuple, list)):
         return list(outs), False
@@ -223,11 +268,24 @@ def _fire_hooks(tensor_ref, g):
     return g
 
 
+def _gadd(a, b):
+    """Gradient accumulation that understands IndexedSlices fan-in
+    (reference GradientAccumulator: SelectedRows+SelectedRows concatenates,
+    SelectedRows+dense scatters — gradient_accumulator.cc MergeAdd)."""
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(a, IndexedSlices):
+        return a + b if isinstance(b, IndexedSlices) else a.add_to_dense(b)
+    if isinstance(b, IndexedSlices):
+        return b.add_to_dense(a)
+    return a + b
+
+
 def _accumulate(tensor: Tensor, g) -> None:
     if tensor._grad is None:
         tensor._grad = Tensor(g, stop_gradient=True)
     else:
-        tensor._grad = Tensor(tensor._grad.data + g, stop_gradient=True)
+        tensor._grad = Tensor(_gadd(tensor._grad.data, g),
+                              stop_gradient=True)
 
 
 def run_backward(tensors: Sequence[Tensor],
@@ -285,7 +343,7 @@ def run_backward(tensors: Sequence[Tensor],
     root_ids = set()
     for n, oi, g in roots:
         buf = pending[id(n)]
-        buf[oi] = g if buf[oi] is None else buf[oi] + g
+        buf[oi] = g if buf[oi] is None else _gadd(buf[oi], g)
         root_ids.add(id(n))
     for nid in root_ids:
         if deps.get(nid, 0) == 0:
@@ -321,7 +379,7 @@ def run_backward(tensors: Sequence[Tensor],
                     _accumulate(ot, g)
                 if ot is not None and id(ot) in collect_ids:
                     prev = collect.get(id(ot))
-                    collect[id(ot)] = g if prev is None else prev + g
+                    collect[id(ot)] = g if prev is None else _gadd(prev, g)
             cotangents.append(g)
         outs = cotangents[0] if node.n_outputs == 1 else tuple(cotangents)
         # jax.vjp returned a tuple-cotangent function over the tuple output
@@ -341,13 +399,13 @@ def run_backward(tensors: Sequence[Tensor],
                 ig = _fire_hooks(t, ig)
                 if id(t) in collect_ids:
                     prev = collect.get(id(t))
-                    collect[id(t)] = ig if prev is None else prev + ig
+                    collect[id(t)] = ig if prev is None else _gadd(prev, ig)
                 if accumulate_leaves:
                     _accumulate(t, ig)
             else:
                 pid = id(pn)
                 buf = pending[pid]
-                buf[pout] = ig if buf[pout] is None else buf[pout] + ig
+                buf[pout] = ig if buf[pout] is None else _gadd(buf[pout], ig)
                 deps[pid] -= 1
                 if deps[pid] == 0:
                     ready.append(pid)
@@ -359,7 +417,7 @@ def run_backward(tensors: Sequence[Tensor],
         g = _fire_hooks(t, g)
         if id(t) in collect_ids:
             prev = collect.get(id(t))
-            collect[id(t)] = g if prev is None else prev + g
+            collect[id(t)] = g if prev is None else _gadd(prev, g)
         if accumulate_leaves:
             _accumulate(t, g)
 

@@ -14,4 +14,5 @@ from .generator import (Generator, default_generator, get_rng_state,
                         set_rng_state)
 from .place import (CPUPlace, Place, TPUPlace, device_count, device_guard,
                     get_device, is_compiled_with_tpu, set_device)
+from .indexed_slices import IndexedSlices
 from .tensor import Parameter, Tensor, to_tensor

@@ -1,0 +1,111 @@
+"""IndexedSlices — the sparse-gradient representation.
+
+TPU-native analog of the reference's ``SelectedRows``
+(/root/reference/paddle/fluid/framework/selected_rows.h:34 — a {rows,
+value, height} triple produced by lookup_table_grad and consumed by the
+sparse optimizer kernels, e.g. adam_op.h's SelectedRows branch).
+
+Design (SURVEY §7 hard part (e)): in **eager** mode an embedding backward
+emits ``IndexedSlices(rows, values, dense_shape)`` whose memory is
+O(touched_rows × dim) — independent of the vocabulary size. Gradient
+accumulation concatenates slices lazily (the reference's
+GradientAccumulator + MergeAdd protocol); optimizers either apply
+row-sparse updates directly (``lazy_mode``) or densify. Under ``jit`` the
+whole step is a fused XLA program where scatter-add *is* the efficient
+lowering, so the functional path densifies by design — documented, not
+accidental.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["IndexedSlices"]
+
+
+class IndexedSlices:
+    """A row-sparse tensor: ``values[i]`` is the slice for row ``rows[i]``
+    of a dense tensor of shape ``dense_shape``. Duplicate rows are allowed
+    (sum semantics) until :meth:`merge` coalesces them."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape: Sequence[int]):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        values = jnp.asarray(values)
+        self.values = values.reshape((self.rows.shape[0],) +
+                                     tuple(dense_shape[1:]))
+        self.dense_shape: Tuple[int, ...] = tuple(int(s) for s in dense_shape)
+
+    # -- metadata (mirrors the dense Tensor surface used by the engine) ----
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self.dense_shape)
+
+    @property
+    def n_rows(self) -> int:
+        """Number of stored (possibly duplicate) row slices."""
+        return int(self.rows.shape[0])
+
+    def astype(self, dtype) -> "IndexedSlices":
+        return IndexedSlices(self.rows, self.values.astype(dtype),
+                             self.dense_shape)
+
+    # -- algebra -----------------------------------------------------------
+
+    def __add__(self, other):
+        if isinstance(other, IndexedSlices):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError(
+                    f"IndexedSlices shape mismatch: {self.dense_shape} vs "
+                    f"{other.dense_shape}")
+            return IndexedSlices(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        # dense + sparse → dense (the accumulation fallback)
+        return self.add_to_dense(jnp.asarray(other))
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        return IndexedSlices(self.rows, self.values * scalar,
+                             self.dense_shape)
+
+    __rmul__ = __mul__
+
+    def merge(self) -> "IndexedSlices":
+        """Coalesce duplicate rows by summation (reference
+        operators/math/selected_rows_functor.h MergeAdd). Host-side unique:
+        merge runs on the eager path where rows are concrete."""
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, jnp.asarray(inv),
+                                     num_segments=int(uniq.shape[0]))
+        return IndexedSlices(jnp.asarray(uniq, jnp.int32), summed,
+                             self.dense_shape)
+
+    def to_dense(self) -> jax.Array:
+        return self.add_to_dense(
+            jnp.zeros(self.dense_shape, self.values.dtype))
+
+    def add_to_dense(self, dense: jax.Array) -> jax.Array:
+        return dense.at[self.rows].add(
+            self.values.astype(dense.dtype))
+
+    def __repr__(self):
+        return (f"IndexedSlices(n_rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape}, dtype={self.dtype})")

@@ -32,6 +32,12 @@ __all__ = ["Tensor", "to_tensor", "Parameter"]
 def _as_array(data, dtype=None) -> jax.Array:
     if isinstance(data, Tensor):
         data = data.data
+    from .indexed_slices import IndexedSlices
+    if isinstance(data, IndexedSlices):
+        # a Tensor may carry a row-sparse gradient (SelectedRows-typed
+        # variable in the reference); consumers branch on isinstance
+        return data if dtype is None else data.astype(
+            dtypes.convert_dtype(dtype))
     if isinstance(data, (jax.Array, jax.core.Tracer)):
         arr = data
         if dtype is not None:

@@ -25,6 +25,8 @@ from . import sequence_parallel
 from .sequence_parallel import ring_attention, ulysses_attention
 from .parallel_engine import ParallelEngine, make_train_step
 from .spawn import spawn
+from . import ps
+from .ps import DistributedEmbedding, EmbeddingService, SparseTable
 
 
 def __getattr__(name):

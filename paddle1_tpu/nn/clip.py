@@ -18,6 +18,31 @@ __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
            "clip_grad_norm_", "clip_grad_value_"]
 
 
+
+def _merged(g):
+    """IndexedSlices-aware view for norm computation: duplicate rows must be
+    coalesced first, else sum-of-squares over-counts fan-in."""
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(g, IndexedSlices):
+        return g.merge()
+    return g
+
+
+def _sq_sum(g):
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(g, IndexedSlices):
+        return jnp.sum(jnp.square(g.values.astype(jnp.float32)))
+    return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+
+def _scaled(g, scale):
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(g, IndexedSlices):
+        return g * float(scale) if not hasattr(scale, "dtype") else \
+            g * scale.astype(g.values.dtype)
+    return g * scale.astype(g.dtype)
+
+
 class ClipGradBase:
     def __call__(self, params_grads):
         return self._clip(params_grads)
@@ -34,7 +59,15 @@ class ClipGradByValue(ClipGradBase):
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
-            out.append((p, to_tensor(jnp.clip(g.data, self.min, self.max))))
+            from ..core.indexed_slices import IndexedSlices
+            ga = _merged(g.data)
+            if isinstance(ga, IndexedSlices):
+                ga = IndexedSlices(ga.rows,
+                                   jnp.clip(ga.values, self.min, self.max),
+                                   ga.dense_shape)
+                out.append((p, to_tensor(ga)))
+            else:
+                out.append((p, to_tensor(jnp.clip(ga, self.min, self.max))))
         return out
 
 
@@ -48,10 +81,11 @@ class ClipGradByNorm(ClipGradBase):
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g.data)))
+            ga = _merged(g.data)
+            norm = jnp.sqrt(_sq_sum(ga))
             scale = jnp.where(norm > self.clip_norm,
                               self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, to_tensor(g.data * scale)))
+            out.append((p, to_tensor(_scaled(ga, scale))))
         return out
 
 
@@ -63,23 +97,23 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     def _clip(self, params_grads):
         sq = 0.0
-        any_clip = False
-        for p, g in params_grads:
+        merged = {}  # merge sparse grads once; reused in the scale pass
+        for i, (p, g) in enumerate(params_grads):
             if g is None or not getattr(p, "need_clip", True):
                 continue
-            any_clip = True
-            sq = sq + jnp.sum(jnp.square(g.data.astype(jnp.float32)))
-        if not any_clip:
+            merged[i] = _merged(g.data)
+            sq = sq + _sq_sum(merged[i])
+        if not merged:
             return params_grads
         global_norm = jnp.sqrt(sq)
         scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
                             1.0)
         out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
+        for i, (p, g) in enumerate(params_grads):
+            if i not in merged:
                 out.append((p, g))
             else:
-                out.append((p, to_tensor(g.data * scale.astype(g.data.dtype))))
+                out.append((p, to_tensor(_scaled(merged[i], scale))))
         return out
 
 

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...autograd.engine import apply
+from ...autograd.engine import apply, apply_custom_vjp
 from ...core.generator import next_key
 from ...core.tensor import Tensor, to_tensor
 from ...core.errors import InvalidArgumentError
@@ -92,16 +92,44 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Lookup-table (reference lookup_table_v2_op). ``sparse`` is accepted
-    for API parity; on TPU gradients densify under jit (SURVEY §7 hard part
-    (e)) and use IndexedSlices-style scatter-add in eager."""
+    """Lookup-table (reference lookup_table_v2_op).
+
+    ``sparse=True`` in eager mode emits the weight gradient as
+    :class:`~paddle1_tpu.core.indexed_slices.IndexedSlices` — O(touched
+    rows) memory, independent of vocab size, the SelectedRows analog
+    (reference lookup_table_v2_op.h grad kernel with is_sparse). Under jit
+    the step is one fused XLA program and scatter-add is the efficient
+    lowering, so the functional path densifies by design (SURVEY §7 (e))."""
+    ids_t, w_t = _t(x), _t(weight)
+
     def f(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None and padding_idx >= 0:
             mask = (ids != padding_idx).astype(w.dtype)[..., None]
             out = out * mask
         return out
-    return apply("embedding", f, (_t(x), _t(weight)))
+
+    # sparse path needs (a) eager mode and (b) a LEAF weight: a non-leaf's
+    # producer node expects an array cotangent from jax.vjp, which cannot
+    # consume IndexedSlices — densify there instead
+    if not sparse or isinstance(w_t.data, jax.core.Tracer) or \
+            w_t._node is not None:
+        return apply("embedding", f, (ids_t, w_t))
+
+    from ...core.indexed_slices import IndexedSlices
+
+    def fwd(ids, w):
+        return f(ids, w), (ids, w.shape, w.dtype)
+
+    def bwd(res, g):
+        ids, w_shape, w_dtype = res
+        rows = ids.astype(jnp.int32).reshape(-1)
+        vals = g.reshape(-1, g.shape[-1]).astype(w_dtype)
+        if padding_idx is not None and padding_idx >= 0:
+            vals = vals * (rows != padding_idx).astype(vals.dtype)[:, None]
+        return (None, IndexedSlices(rows, vals, w_shape))
+
+    return apply_custom_vjp("embedding_sparse", fwd, bwd, (ids_t, w_t))
 
 
 def one_hot(x, num_classes, name=None):

@@ -27,6 +27,23 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
            "Adamax", "AdaDelta", "RMSProp", "Lamb", "Lars"]
 
 
+def _fused_adam_path(param, g, slots, lr, step, beta1, beta2, eps, decay):
+    """Route large tensors through the Pallas fused-Adam kernel when the
+    ``fused_adam`` flag allows; returns None to fall back to plain jnp."""
+    from ..core.flags import flag
+    from ..ops.pallas import fused_adam as fadam
+    mode = flag("fused_adam")
+    if mode == "never" or (mode == "auto"
+                           and jax.default_backend() != "tpu"):
+        return None
+    if not fadam.supported(int(np.prod(param.shape))):
+        return None
+    new_p, m1, m2 = fadam.fused_adam_update(
+        param, g, slots["moment1"], slots["moment2"], lr, step,
+        beta1, beta2, eps, decay)
+    return new_p, {"moment1": m1, "moment2": m2}
+
+
 class Optimizer:
     """Base optimizer (reference python/paddle/optimizer/optimizer.py).
 
@@ -106,6 +123,13 @@ class Optimizer:
     def _update(self, param, grad, slots, lr, step):
         raise NotImplementedError
 
+    def _update_sparse(self, param, grad, slots, lr, step):
+        """Row-sparse update for an IndexedSlices grad (rows pre-merged).
+        Return (new_param, new_slots), or None to densify instead —
+        the reference's SelectedRows optimizer-kernel dispatch
+        (adam_op.h SparseAdamFunctor, sgd_op.h SelectedRows branch)."""
+        return None
+
     # -- eager step ---------------------------------------------------------
 
     @engine.no_grad()
@@ -121,18 +145,31 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
         lr = self.get_lr()
+        from ..core.indexed_slices import IndexedSlices
         for p, g in params_grads:
             self._current_param_name = p.name
             lr_p = lr * p.optimize_attr.get("learning_rate", 1.0)
             garr = g.data.astype(p.data.dtype) if g.data.dtype != p.data.dtype \
                 else g.data
-            # per-parameter L2 regularizer (reference regularizer-as-op)
-            if getattr(p, "regularizer", None) is not None:
-                garr = garr + float(getattr(p.regularizer, "coeff", 0.0)) * \
-                    p.data
             slots = self._get_slots(p)
-            new_param, new_slots = self._update(p.data, garr, slots, lr_p,
-                                                self._step_count)
+            if isinstance(garr, IndexedSlices):
+                # row-sparse grad (SelectedRows analog): regularizers are
+                # skipped, matching the reference's warning-and-skip on
+                # SelectedRows grads (regularizer.py append_regularization)
+                merged = garr.merge()
+                res = self._update_sparse(p.data, merged, slots, lr_p,
+                                          self._step_count)
+                if res is None:
+                    res = self._update(p.data, merged.to_dense(), slots,
+                                       lr_p, self._step_count)
+                new_param, new_slots = res
+            else:
+                # per-parameter L2 regularizer (reference regularizer-as-op)
+                if getattr(p, "regularizer", None) is not None:
+                    garr = garr + float(getattr(p.regularizer, "coeff",
+                                                0.0)) * p.data
+                new_param, new_slots = self._update(p.data, garr, slots,
+                                                    lr_p, self._step_count)
             p._data = new_param
             self._slots[id(p)] = new_slots
 
@@ -214,6 +251,11 @@ class SGD(Optimizer):
         grad = self._l2(grad, param)
         return param - lr * grad, slots
 
+    def _update_sparse(self, param, grad, slots, lr, step):
+        # touched rows only (reference sgd_op.h SelectedRows branch)
+        return param.at[grad.rows].add(
+            (-lr * grad.values).astype(param.dtype)), slots
+
 
 class Momentum(Optimizer):
     _slot_names = ("velocity",)
@@ -294,6 +336,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _init_slots(self, p):
         f32 = jnp.float32
@@ -305,6 +348,11 @@ class Adam(Optimizer):
 
     def _update(self, param, grad, slots, lr, step):
         g = self._l2(grad.astype(jnp.float32), param.astype(jnp.float32))
+        if type(self)._decoupled_decay is Adam._decoupled_decay:
+            fused = _fused_adam_path(param, g, slots, lr, step, self._beta1,
+                                     self._beta2, self._epsilon, decay=0.0)
+            if fused is not None:
+                return fused
         m1 = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
         m2 = self._beta2 * slots["moment2"] + (1 - self._beta2) * g * g
         bc1 = 1 - self._beta1 ** step
@@ -313,6 +361,28 @@ class Adam(Optimizer):
         pf = param.astype(jnp.float32)
         pf = pf - lr * update - lr * self._decoupled_decay(pf, lr)
         return pf.astype(param.dtype), {"moment1": m1, "moment2": m2}
+
+    def _update_sparse(self, param, grad, slots, lr, step):
+        """lazy_mode=True: moments/params touched rows only (reference
+        SparseAdamFunctor with lazy_mode, adam_op.h:473). Default mode
+        decays every row's moments (grad=0 rows included), which is the
+        densified update — handled by the base-class fallback."""
+        if not self._lazy_mode:
+            return None
+        rows = grad.rows
+        g = grad.values.astype(jnp.float32)
+        m1r = slots["moment1"][rows]
+        m2r = slots["moment2"][rows]
+        m1r = self._beta1 * m1r + (1 - self._beta1) * g
+        m2r = self._beta2 * m2r + (1 - self._beta2) * g * g
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        update = (m1r / bc1) / (jnp.sqrt(m2r / bc2) + self._epsilon)
+        pr = param[rows].astype(jnp.float32)
+        pr = pr - lr * update - lr * self._decoupled_decay(pr, lr)
+        return (param.at[rows].set(pr.astype(param.dtype)),
+                {"moment1": slots["moment1"].at[rows].set(m1r),
+                 "moment2": slots["moment2"].at[rows].set(m2r)})
 
 
 class AdamW(Adam):
@@ -324,7 +394,7 @@ class AdamW(Adam):
                  grad_clip=None, lr_ratio=None, apply_decay_param_fun=None,
                  multi_precision=False, lazy_mode=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode=lazy_mode)
         self._coeff = float(weight_decay) if not hasattr(
             weight_decay, "coeff") else weight_decay.coeff
         self._apply_decay_fn = apply_decay_param_fun
@@ -337,6 +407,10 @@ class AdamW(Adam):
                 not self._apply_decay_fn(self._current_param_name):
             decay = 0.0
         g = grad.astype(jnp.float32)
+        fused = _fused_adam_path(param, g, slots, lr, step, self._beta1,
+                                 self._beta2, self._epsilon, decay=decay)
+        if fused is not None:
+            return fused
         m1 = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
         m2 = self._beta2 * slots["moment2"] + (1 - self._beta2) * g * g
         bc1 = 1 - self._beta1 ** step
@@ -345,6 +419,15 @@ class AdamW(Adam):
         pf = param.astype(jnp.float32) * (1 - lr * decay)
         pf = pf - lr * update
         return pf.astype(param.dtype), {"moment1": m1, "moment2": m2}
+
+    def _decoupled_decay(self, param, lr):
+        # used by the inherited lazy sparse path (_update_sparse)
+        decay = self._coeff
+        if self._apply_decay_fn is not None and \
+                self._current_param_name is not None and \
+                not self._apply_decay_fn(self._current_param_name):
+            decay = 0.0
+        return decay * param
 
 
 class Adamax(Optimizer):
