@@ -88,9 +88,15 @@ def compile_strategy(strategy: DistributedStrategy,
     # API compat but the engine path is scale-free)
     amp_dtype = "bfloat16" if conf.get("amp") else None
 
+    pp_microbatches = None
+    if pp > 1 or conf.get("pipeline"):
+        pc = conf.get("pipeline_configs", {}) or {}
+        pp_microbatches = int(pc.get("accumulate_steps", 0)) or None
+
     return {"degrees": degrees, "zero_stage": zero_stage,
             "grad_accum": grad_accum,
             "amp_dtype": amp_dtype,
+            "pp_microbatches": pp_microbatches,
             "recompute": bool(conf.get("recompute"))}
 
 

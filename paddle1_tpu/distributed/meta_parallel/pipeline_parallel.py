@@ -47,8 +47,18 @@ class PipelineParallel(DataParallel):
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Reference pipeline_parallel.py:98. data = [inputs, labels].
-        Splits into micro-batches, forward+backward each (grad accumulation
-        ≡ the 1F1B result), then one optimizer step."""
+
+        Runs the true 1F1B schedule over the PipelineLayer's heterogeneous
+        stage partition (the SectionWorker analog, section_worker.cc:
+        143-181): per-stage forward/backward segments interleave so stage
+        ``s`` never holds more than ``num_stages - s`` in-flight
+        microbatch activations — the bound the reference's
+        max_outstanding enforces. Activations move between stages as
+        detached leaves; the tape runs each segment's backward when the
+        downstream grad arrives. Gradients accumulate on parameters
+        exactly as sequential grad-accumulation would, so the result is
+        numerically identical while activation lifetime is bounded.
+        """
         inputs, labels = data
         total = inputs.shape[0]
         micro = max(1, self.micro_batch_size)
@@ -62,19 +72,10 @@ class PipelineParallel(DataParallel):
         if loss_fn is None:
             raise InvalidArgumentError(
                 "PipelineLayer needs loss_fn for train_batch")
-        total_loss = None
-        for i in range(n_micro):
-            lo, hi = i * micro, (i + 1) * micro
-            x = inputs[lo:hi]
-            y = labels[lo:hi]
-            out = self._layers(x)
-            loss = loss_fn(out, y)
-            scaled = loss / float(n_micro)
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            total_loss = loss if total_loss is None else total_loss + loss
+
+        total_loss = self._run_1f1b(inputs, labels, n_micro, micro,
+                                    loss_fn, scaler)
+
         if scaler is not None:
             # GradScaler.step() already advances the loss-scale state.
             scaler.step(optimizer)
@@ -84,6 +85,122 @@ class PipelineParallel(DataParallel):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return total_loss / float(n_micro)
+
+    def _run_1f1b(self, inputs, labels, n_micro, micro, loss_fn, scaler):
+        from collections import deque
+
+        from ...autograd.engine import run_backward
+        from ...core.tensor import Tensor
+
+        import jax.numpy as jnp
+
+        S = self._layers.num_stages
+        bounds = self._layers.segment_parts
+        run_fn = list(self._layers.run_function)
+        rc_k = self._layers.recompute_interval
+
+        def seg_forward(s, x):
+            # honor recompute_interval with the GLOBAL layer index, same
+            # as PipelineLayer.forward does on the sequential path
+            for gi in range(bounds[s], bounds[s + 1]):
+                lyr = run_fn[gi]
+                if rc_k > 0 and gi % rc_k == 0 and self._layers.training:
+                    from ..fleet.utils.recompute import recompute
+                    x = recompute(lyr, *x) if isinstance(x, tuple) \
+                        else recompute(lyr, x)
+                else:
+                    x = lyr(*x) if isinstance(x, tuple) else lyr(x)
+            return x
+
+        def as_tuple(v):
+            return v if isinstance(v, tuple) else (v,)
+
+        def make_leaves(s, act):
+            """Detached per-element leaves for a stage input; float leaves
+            (beyond stage 0) carry grads back across the boundary."""
+            leaves = []
+            for el in as_tuple(act):
+                d = el.data if isinstance(el, Tensor) else el
+                is_f = jnp.issubdtype(jnp.result_type(d), jnp.floating)
+                leaves.append(Tensor(d, stop_gradient=not (is_f and s > 0)))
+            return tuple(leaves)
+
+        input_q = [deque() for _ in range(S)]   # (mb, activation tuple)
+        grad_q = [deque() for _ in range(S)]    # (mb, out-grad tuple|None)
+        inflight = [{} for _ in range(S)]       # mb -> (leaves, out/loss)
+        fwd_done = [0] * S
+        bwd_done = [0] * S
+        self.last_max_in_flight = [0] * S
+        for i in range(n_micro):
+            input_q[0].append((i, inputs[i * micro:(i + 1) * micro]))
+        total_loss = None
+
+        def do_forward(s):
+            mb, x = input_q[s].popleft()
+            leaves = make_leaves(s, x)
+            out = seg_forward(s, leaves if len(leaves) > 1 else leaves[0])
+            if s == S - 1:
+                y = labels[mb * micro:(mb + 1) * micro]
+                loss = loss_fn(out, y)
+                inflight[s][mb] = (leaves, loss)
+                grad_q[s].append((mb, None))    # own bwd is now runnable
+            else:
+                inflight[s][mb] = (leaves, out)
+                handoff = tuple(o.detach() if isinstance(o, Tensor) else o
+                                for o in as_tuple(out))
+                input_q[s + 1].append(
+                    (mb, handoff if len(handoff) > 1 else handoff[0]))
+            fwd_done[s] += 1
+            self.last_max_in_flight[s] = max(
+                self.last_max_in_flight[s], fwd_done[s] - bwd_done[s])
+
+        def do_backward(s):
+            nonlocal total_loss
+            mb, g = grad_q[s].popleft()
+            leaves, out = inflight[s].pop(mb)
+            if s == S - 1:
+                scaled = out / float(n_micro)
+                if scaler is not None:
+                    scaler.scale(scaled).backward()
+                else:
+                    scaled.backward()
+                total_loss = out.detach() if total_loss is None \
+                    else total_loss + out.detach()
+            elif g is not None:
+                # back-propagate only the outputs a grad arrived for
+                outs = as_tuple(out)
+                pairs = [(o, gg) for o, gg in zip(outs, as_tuple(g))
+                         if gg is not None and isinstance(o, Tensor)
+                         and not o.stop_gradient]
+                if pairs:
+                    run_backward([o for o, _ in pairs],
+                                 [gg for _, gg in pairs])
+            # ALWAYS hand something upstream, else a non-differentiable
+            # boundary (int ids, detached features) starves the upstream
+            # queue and the schedule deadlocks
+            if s > 0:
+                gs = tuple(l.grad if not l.stop_gradient else None
+                           for l in leaves)
+                grad_q[s - 1].append(
+                    (mb, None if all(x is None for x in gs) else gs))
+            bwd_done[s] += 1
+
+        # event loop: each pass gives every stage one op — backward when a
+        # grad is waiting (frees memory), else forward within the 1F1B
+        # in-flight bound (stage s holds at most S - s microbatches)
+        while any(b < n_micro for b in bwd_done):
+            progressed = False
+            for s in range(S - 1, -1, -1):
+                if grad_q[s] and fwd_done[s] > bwd_done[s]:
+                    do_backward(s)
+                    progressed = True
+                elif input_q[s] and fwd_done[s] < n_micro and \
+                        (fwd_done[s] - bwd_done[s]) < (S - s):
+                    do_forward(s)
+                    progressed = True
+            if not progressed:  # pragma: no cover - schedule invariant
+                raise RuntimeError("1F1B schedule deadlocked")
+        return total_loss
 
     def eval_batch(self, data, compute_loss: bool = True):
         inputs, labels = data

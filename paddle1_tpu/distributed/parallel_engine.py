@@ -153,12 +153,40 @@ class ParallelEngine:
                  batch_spec: Optional[Any] = None,
                  donate: bool = True,
                  amp_dtype: Optional[str] = None,
-                 recompute: bool = False):
+                 recompute: bool = False,
+                 pp_microbatches: Optional[int] = None):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else build_mesh(
             **(degrees or {"dp": len(jax.devices())}))
         self.zero_stage = zero_stage
+
+        # Pipeline parallelism: tag every pipelined-body sublayer so its
+        # forward runs the in-graph scan+ppermute schedule over the 'pp'
+        # axis (layer_transformer.TransformerEncoder._forward_pipelined).
+        pp_n = int(self.mesh.shape.get("pp", 1))
+        from ..nn.layer_transformer import TransformerEncoder
+        if pp_n <= 1:
+            # clear stale tags from a previous pp engine on the same model,
+            # else _forward_pipelined would fire against the old mesh
+            for sub in model.sublayers(include_self=True):
+                if isinstance(sub, TransformerEncoder):
+                    sub.pipeline_axis = None
+        else:
+            flipped = 0
+            for sub in model.sublayers(include_self=True):
+                if isinstance(sub, TransformerEncoder):
+                    sub.pipeline_axis = "pp"
+                    sub.pipeline_mesh = self.mesh
+                    sub.pipeline_microbatches = pp_microbatches or pp_n
+                    flipped += 1
+            if not flipped:
+                from ..core.errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    "pp degree > 1 needs a pipelined body "
+                    "(TransformerEncoder) in the model; for arbitrary "
+                    "heterogeneous stage graphs use distributed."
+                    "meta_parallel.PipelineParallel (eager 1F1B schedule)")
 
         # Dedupe tied parameters (e.g. BERT's MLM decoder reuses the word
         # embedding): the same buffer must appear exactly once in the pjit

@@ -40,13 +40,17 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, micro_inputs,
-                   axis_name: str = "pp"):
+                   axis_name: str = "pp", micro_aux=None):
     """Run the pipelined forward inside shard_map.
 
-    stage_fn(params_one_stage, x) -> y, pure, same shape in/out.
+    stage_fn(params_one_stage, x) -> y, pure, same shape in/out — or
+    stage_fn(params, x, aux) when ``micro_aux`` is given.
     stacked_params: pytree with leading stage axis, arriving SHARDED over
     ``axis_name`` (leading dim 1 per device inside shard_map).
     micro_inputs: [n_micro, micro_bs, ...] replicated across pp.
+    micro_aux: optional pytree of [n_micro, ...] per-microbatch side
+    inputs (e.g. attention masks) consumed by EVERY stage; stage s at
+    tick t reads the aux of the microbatch it is processing (t - s).
 
     Returns [n_micro, micro_bs, ...]: outputs of the LAST stage in
     microbatch order (replicated via final broadcast).
@@ -72,7 +76,15 @@ def pipeline_apply(stage_fn: Callable, stacked_params, micro_inputs,
                           micro_inputs[jnp.minimum(t, n_micro - 1)],
                           jnp.zeros_like(micro_inputs[0]))
         x = jnp.where(stage_id == 0, fresh, buf)
-        y = stage_fn(local_params, x)
+        if micro_aux is not None:
+            mb_idx = jnp.clip(t - stage_id, 0, n_micro - 1)
+            aux = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, mb_idx, 0,
+                                                   keepdims=False),
+                micro_aux)
+            y = stage_fn(local_params, x, aux)
+        else:
+            y = stage_fn(local_params, x)
         # last stage emits microbatch t-(n_stages-1) at tick t
         out_idx = t - (n_stages - 1)
         is_out = (out_idx >= 0) & (stage_id == n_stages - 1)
@@ -87,8 +99,13 @@ def pipeline_apply(stage_fn: Callable, stacked_params, micro_inputs,
 
     buf0 = jnp.zeros_like(micro_inputs[0])
     outs0 = jnp.zeros_like(micro_inputs)
-    buf0 = lax.pvary(buf0, (axis_name,))
-    outs0 = lax.pvary(outs0, (axis_name,))
+    _vary = getattr(lax, "pcast", None)
+    if _vary is not None:
+        buf0 = _vary(buf0, (axis_name,), to="varying")
+        outs0 = _vary(outs0, (axis_name,), to="varying")
+    else:  # pragma: no cover - older jax
+        buf0 = lax.pvary(buf0, (axis_name,))
+        outs0 = lax.pvary(outs0, (axis_name,))
     (buf, outputs), _ = lax.scan(
         jax.checkpoint(tick), (buf0, outs0), jnp.arange(ticks))
     # broadcast last stage's outputs to every pp rank (so the loss is

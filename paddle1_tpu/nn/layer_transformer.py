@@ -16,6 +16,7 @@ from __future__ import annotations
 import collections
 from typing import Optional
 
+import jax
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -199,6 +200,19 @@ class TransformerEncoder(Layer):
         self.norm = norm
 
     def forward(self, src, src_mask=None, cache=None):
+        # In-graph pipeline parallelism: when the engine tagged this
+        # encoder with a pp mesh axis (ParallelEngine degrees={"pp": n}),
+        # the block stack runs as a scan+ppermute pipeline sharded over
+        # that axis instead of a sequential loop. Decode caches and eager
+        # calls keep the sequential path.
+        if (getattr(self, "pipeline_axis", None) is not None and
+                cache is None and
+                isinstance(src.data if hasattr(src, "data") else src,
+                           jax.core.Tracer)):
+            out = self._forward_pipelined(src, src_mask)
+            if self.norm is not None:
+                out = self.norm(out)
+            return out
         output = src
         new_caches = []
         # enable_recompute: per-block activation rematerialisation
@@ -223,6 +237,98 @@ class TransformerEncoder(Layer):
 
     def gen_cache(self, src):
         return [layer.gen_cache(src) for layer in self.layers]
+
+    def _forward_pipelined(self, src, src_mask=None):
+        """Block stack as an in-graph pipeline over the ``pipeline_axis``
+        mesh axis (SURVEY §7 hard part (b); reference SectionWorker
+        1F1B, section_worker.cc:143-181).
+
+        The batch splits into ``pipeline_microbatches`` microbatches; the
+        per-stage block parameters are stacked on a leading axis sharded
+        over pp; one lax.scan clocks every stage in SPMD with ppermute
+        rotating activations along ICI (distributed/pipeline.py). Only the
+        'pp' axis is manual in the shard_map — dp/mp/sharding stay under
+        GSPMD, so the pipeline composes with the other parallelisms.
+        Per-tick rematerialisation bounds live activations at one
+        microbatch per stage.
+        """
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        from ..distributed.pipeline import pipeline_apply
+
+        axis = self.pipeline_axis
+        mesh = self.pipeline_mesh
+        n_stages = int(mesh.shape[axis])
+        n_micro = int(getattr(self, "pipeline_microbatches", 0) or n_stages)
+        blocks = list(self.layers)
+        if len(blocks) % n_stages:
+            raise InvalidArgumentError(
+                f"pipelined encoder: {len(blocks)} blocks not divisible "
+                f"into {n_stages} stages")
+        bps = len(blocks) // n_stages
+        template = blocks[0]
+
+        x = src.data if isinstance(src, Tensor) else jnp.asarray(src)
+        b = x.shape[0]
+        if b % n_micro:
+            raise InvalidArgumentError(
+                f"pipelined encoder: batch {b} not divisible by "
+                f"{n_micro} microbatches")
+        mb = b // n_micro
+        micro_x = x.reshape((n_micro, mb) + x.shape[1:])
+
+        mask_arr = None
+        if src_mask is not None:
+            mask_arr = src_mask.data if isinstance(src_mask, Tensor) \
+                else jnp.asarray(src_mask)
+            if mask_arr.ndim >= 1 and mask_arr.shape[0] == b:
+                # per-example mask: split along batch with the microbatches
+                micro_mask = mask_arr.reshape((n_micro, mb) +
+                                              mask_arr.shape[1:])
+            else:
+                # broadcastable mask ([1,1,S,S], [S,S], ...): identical for
+                # every microbatch — replicate on the leading micro axis
+                micro_mask = jnp.broadcast_to(
+                    mask_arr[None], (n_micro,) + mask_arr.shape)
+
+        # [n_stages, bps, ...] per leaf — differentiable stack, so grads
+        # flow back to each block's own parameters
+        block_sds = [blk.state_dict() for blk in blocks]
+        keys = list(block_sds[0].keys())
+        stacked = {
+            k: jnp.stack([
+                jnp.stack([block_sds[s * bps + i][k].data
+                           for i in range(bps)])
+                for s in range(n_stages)])
+            for k in keys}
+
+        def stage_fn(sp, xx, aux=None):
+            t = Tensor(xx)
+            m = None if aux is None else Tensor(aux)
+            for i in range(bps):
+                blk_params = {k: v[i] for k, v in sp.items()}
+                with template.load_functional_state(blk_params):
+                    t = template(t, m)
+            return t.data if isinstance(t, Tensor) else t
+
+        in_specs = [{k: P(axis) for k in keys}, P()]
+        args = [stacked, micro_x]
+        if mask_arr is not None:
+            body = lambda sp, mi, mm: pipeline_apply(
+                stage_fn, sp, mi, axis, micro_aux=mm)
+            in_specs.append(P())
+            args.append(micro_mask)
+        else:
+            body = lambda sp, mi: pipeline_apply(stage_fn, sp, mi, axis)
+        out = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                        out_specs=P(), axis_names=frozenset({axis}),
+                        check_vma=False)(*args)
+        out = out.reshape((b,) + out.shape[2:])
+        return Tensor(out)  # traced-only path: the tape is off here
 
 
 class TransformerDecoderLayer(Layer):
