@@ -40,6 +40,7 @@ from . import metric
 from . import jit
 from . import static
 from . import distributed
+from . import inference
 from . import vision
 from . import text
 from . import hapi

@@ -19,8 +19,36 @@ __all__ = ["available", "BoundedQueue", "ShmArena", "stat_add", "stat_set",
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "native.cc")
 _LIB_PATH = os.path.join(_HERE, "libpaddle1_native.so")
+_CAPI_SRC = os.path.join(_HERE, "src", "capi.cc")
+_CAPI_LIB = os.path.join(_HERE, "libpaddle1_capi.so")
 _lib = None
 _build_lock = threading.Lock()
+
+
+def build_capi():
+    """Build the C inference ABI (src/capi.cc → libpaddle1_capi.so):
+    embedded-interpreter predictor for C/Go deployments (the reference's
+    inference/capi analog). Returns the .so path or None."""
+    import sysconfig
+    with _build_lock:
+        if os.path.exists(_CAPI_LIB) and (
+                not os.path.exists(_CAPI_SRC) or
+                os.path.getmtime(_CAPI_LIB) >= os.path.getmtime(_CAPI_SRC)):
+            return _CAPI_LIB  # prebuilt .so shipped without src/
+        if not os.path.exists(_CAPI_SRC):
+            return None
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+        pyver = f"python{sysconfig.get_config_var('py_version_short')}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               _CAPI_SRC, "-o", _CAPI_LIB, f"-I{inc}", f"-L{libdir}",
+               f"-l{pyver}", "-ldl", "-lm"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=180)
+            return _CAPI_LIB
+        except Exception:
+            return None
 
 
 def _build() -> bool:

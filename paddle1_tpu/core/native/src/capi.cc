@@ -1,0 +1,232 @@
+// C inference API over the paddle1_tpu Predictor.
+//
+// Analog of the reference's C inference API
+// (/root/reference/paddle/fluid/inference/capi/ — PD_NewAnalysisConfig,
+// PD_NewPredictor, PD_PredictorRun, c_api.cc), which wraps the C++
+// AnalysisPredictor for non-C++ consumers (the Go bindings sit on it).
+//
+// TPU-native inversion: the executable program is serialized StableHLO run
+// by the XLA runtime, whose supported embedding is the Python `jax` API —
+// so this C ABI hosts an embedded CPython interpreter (the image's
+// sanctioned binding route; no pybind11) and drives
+// paddle1_tpu.inference.Predictor through the CPython C API. A C (or Go,
+// via cgo) deployment links this .so plus libpython and never writes a
+// line of Python.
+//
+// Surface (mirrors PD_* naming):
+//   p1_predictor_create(model_base, device)  -> handle | NULL
+//   p1_predictor_num_inputs(h) / p1_predictor_num_outputs(h)
+//   p1_predictor_run_f32(h, inputs..., out_idx, out_buf, ...)
+//   p1_predictor_destroy(h)
+//   p1_last_error() -> static string
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 capi.cc -o libpaddle1_capi.so
+//        $(python3-config --includes --ldflags --embed)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_last_error;
+bool g_py_inited = false;
+
+void set_error(const char* where) {
+  std::string msg = where;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value) {
+      PyObject* s = PyObject_Str(value);
+      if (s) {
+        msg += ": ";
+        msg += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  g_last_error = msg;
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL so every entry point can take it via PyGILState.
+    PyEval_SaveThread();
+    g_py_inited = true;
+  }
+}
+
+struct P1Predictor {
+  PyObject* predictor;  // paddle1_tpu.inference.Predictor
+  int n_inputs;
+  int n_outputs;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* p1_last_error() { return g_last_error.c_str(); }
+
+// device: "auto" | "cpu" | "tpu"
+void* p1_predictor_create(const char* model_base, const char* device) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = nullptr;
+  PyObject* cfg = nullptr;
+  PyObject* pred = nullptr;
+  do {
+    mod = PyImport_ImportModule("paddle1_tpu.inference");
+    if (!mod) { set_error("import paddle1_tpu.inference"); break; }
+    cfg = PyObject_CallMethod(mod, "Config", "ss", model_base,
+                              (std::string(model_base) + ".pdiparams")
+                                  .c_str());
+    if (!cfg) { set_error("Config()"); break; }
+    if (device && std::strcmp(device, "cpu") == 0) {
+      PyObject* r = PyObject_CallMethod(cfg, "disable_gpu", nullptr);
+      if (!r) { set_error("disable_gpu()"); break; }
+      Py_DECREF(r);
+    } else if (device && std::strcmp(device, "tpu") == 0) {
+      PyObject* r = PyObject_CallMethod(cfg, "enable_tpu", nullptr);
+      if (!r) { set_error("enable_tpu()"); break; }
+      Py_DECREF(r);
+    }
+    pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+    if (!pred) { set_error("create_predictor()"); break; }
+
+    PyObject* names = PyObject_CallMethod(pred, "get_input_names", nullptr);
+    if (!names) { set_error("get_input_names()"); break; }
+    int n_in = static_cast<int>(PyList_Size(names));
+    Py_DECREF(names);
+    PyObject* onames =
+        PyObject_CallMethod(pred, "get_output_names", nullptr);
+    if (!onames) { set_error("get_output_names()"); break; }
+    int n_out = static_cast<int>(PyList_Size(onames));
+    Py_DECREF(onames);
+
+    auto* h = new P1Predictor{pred, n_in, n_out};
+    pred = nullptr;  // ownership moved
+    result = h;
+  } while (false);
+  Py_XDECREF(pred);
+  Py_XDECREF(cfg);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return result;
+}
+
+int p1_predictor_num_inputs(void* handle) {
+  return handle ? static_cast<P1Predictor*>(handle)->n_inputs : -1;
+}
+
+int p1_predictor_num_outputs(void* handle) {
+  return handle ? static_cast<P1Predictor*>(handle)->n_outputs : -1;
+}
+
+// Run with n_inputs f32 tensors; copy output out_idx into out_buf.
+// shapes: flattened per-input dims; ndims: per-input rank.
+// Returns 0 on success; fills out_shape (up to *out_ndim entries, which
+// on entry holds the capacity of out_shape) and the real rank.
+int p1_predictor_run_f32(void* handle, const float** inputs,
+                         const int64_t* shapes, const int* ndims,
+                         int n_inputs, int out_idx, float* out_buf,
+                         int64_t out_capacity, int64_t* out_shape,
+                         int* out_ndim) {
+  if (!handle) {
+    g_last_error = "null predictor handle";
+    return 1;
+  }
+  auto* h = static_cast<P1Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* np = nullptr;
+  PyObject* arglist = nullptr;
+  PyObject* outs = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (!np) { set_error("import numpy"); break; }
+    arglist = PyList_New(n_inputs);
+    if (!arglist) { set_error("alloc arg list"); break; }
+    const int64_t* sp = shapes;
+    bool ok = true;
+    for (int i = 0; i < n_inputs; ++i) {
+      int64_t numel = 1;
+      PyObject* shape = PyTuple_New(ndims[i]);
+      for (int d = 0; d < ndims[i]; ++d) {
+        numel *= sp[d];
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
+      }
+      sp += ndims[i];
+      PyObject* mv = PyMemoryView_FromMemory(
+          reinterpret_cast<char*>(const_cast<float*>(inputs[i])),
+          numel * sizeof(float), PyBUF_READ);
+      PyObject* flat =
+          mv ? PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32")
+             : nullptr;
+      PyObject* arr =
+          flat ? PyObject_CallMethod(flat, "reshape", "O", shape) : nullptr;
+      Py_XDECREF(mv);
+      Py_XDECREF(flat);
+      Py_DECREF(shape);
+      if (!arr) { set_error("build input array"); ok = false; break; }
+      PyList_SET_ITEM(arglist, i, arr);  // steals
+    }
+    if (!ok) break;
+    outs = PyObject_CallMethod(h->predictor, "run", "O", arglist);
+    if (!outs) { set_error("Predictor.run"); break; }
+    PyObject* out = PyList_GetItem(outs, out_idx);  // borrowed
+    if (!out) { set_error("output index out of range"); break; }
+    PyObject* out32 = PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                          out, "float32");
+    if (!out32) { set_error("ascontiguousarray"); break; }
+    PyObject* shape = PyObject_GetAttrString(out32, "shape");
+    int rank = static_cast<int>(PyTuple_Size(shape));
+    int64_t numel = 1;
+    for (int d = 0; d < rank; ++d) {
+      int64_t v = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+      if (d < *out_ndim) out_shape[d] = v;
+      numel *= v;
+    }
+    Py_DECREF(shape);
+    if (rank > *out_ndim || numel > out_capacity) {
+      g_last_error = "output buffer/shape capacity too small";
+      Py_DECREF(out32);
+      break;
+    }
+    *out_ndim = rank;
+    PyObject* bytes = PyObject_CallMethod(out32, "tobytes", nullptr);
+    Py_DECREF(out32);
+    if (!bytes) { set_error("tobytes"); break; }
+    std::memcpy(out_buf, PyBytes_AsString(bytes), numel * sizeof(float));
+    Py_DECREF(bytes);
+    rc = 0;
+  } while (false);
+  Py_XDECREF(outs);
+  Py_XDECREF(arglist);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void p1_predictor_destroy(void* handle) {
+  if (!handle) return;
+  auto* h = static_cast<P1Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->predictor);
+  PyGILState_Release(gil);
+  delete h;
+}
+
+}  // extern "C"

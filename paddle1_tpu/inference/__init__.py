@@ -1,0 +1,302 @@
+"""Inference deployment surface: Config + Predictor over the jit.save
+artifact.
+
+Analog of the reference's AnalysisConfig/AnalysisPredictor API
+(/root/reference/paddle/fluid/inference/api/paddle_api.h:85-301,
+paddle_analysis_config.h; Python bindings inference/api/api_impl.cc).
+
+TPU-native inversion: the reference predictor owns an optimization
+pipeline (IR passes, TensorRT subgraphs, memory reuse) applied to a
+ProgramDesc at load time. Here the artifact IS the optimized program — a
+serialized StableHLO executable produced by ``jit.save`` — and XLA
+performs fusion/layout/memory optimization at (cached) compile time, so
+most Config toggles are accepted for API parity and recorded in
+``summary()`` rather than steering passes. Device choice selects the jax
+backend. The C deployment path (reference inference/capi/) is
+``core/native/src/capi.cc`` — a plain C ABI over this module via an
+embedded interpreter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorHandle", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+class PlaceType:
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kTPU = 2
+    kXPU = 3
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class Config:
+    """Predictor configuration (reference AnalysisConfig).
+
+    Accepts either ``Config(model_dir)`` (directory containing
+    ``__model__``-style pair) or ``Config(prog_file, params_file)`` where
+    ``prog_file`` is the ``<path>.pdmodel`` written by ``jit.save``.
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._prog_file = None
+        self._params_file = None
+        if prog_file is not None and params_file is None and \
+                os.path.isdir(prog_file):
+            self.set_model(prog_file)
+        elif prog_file is not None:
+            self.set_model(prog_file, params_file)
+        self._device = "auto"      # auto → tpu if present else cpu
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._ir_optim = True      # XLA always optimizes; recorded only
+        self._profile = False
+        self._glog_info = True
+        self._cpu_math_threads = 1
+        self._extra: Dict[str, object] = {}
+
+    # -- model location ----------------------------------------------------
+
+    def set_model(self, prog_or_dir: str,
+                  params_file: Optional[str] = None) -> None:
+        if params_file is None and os.path.isdir(prog_or_dir):
+            # directory form: find a single *.pdmodel inside
+            cands = [f for f in os.listdir(prog_or_dir)
+                     if f.endswith(".pdmodel")]
+            if len(cands) != 1:
+                raise ValueError(
+                    f"Config(model_dir): expected exactly one .pdmodel in "
+                    f"{prog_or_dir}, found {cands}")
+            base = os.path.join(prog_or_dir, cands[0][:-len(".pdmodel")])
+            self._prog_file = base + ".pdmodel"
+            self._params_file = base + ".pdiparams"
+        else:
+            self._prog_file = prog_or_dir
+            self._params_file = params_file
+        if self._prog_file and not self._prog_file.endswith(".pdmodel"):
+            self._prog_file += ".pdmodel"
+        if self._params_file is None and self._prog_file:
+            self._params_file = self._prog_file[:-len(".pdmodel")] + \
+                ".pdiparams"
+
+    def model_program_path(self) -> Optional[str]:
+        return self._prog_file
+
+    def params_file_path(self) -> Optional[str]:
+        return self._params_file
+
+    # -- device ------------------------------------------------------------
+
+    def enable_tpu(self, device_id: int = 0) -> None:
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0) -> None:
+        # GPU request maps to the accelerator backend (TPU) if present —
+        # the artifact is device-agnostic StableHLO
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self) -> None:
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "tpu"
+
+    def gpu_device_id(self) -> int:
+        return self._device_id
+
+    def set_cpu_math_library_num_threads(self, n: int) -> None:
+        self._cpu_math_threads = int(n)
+
+    def cpu_math_library_num_threads(self) -> int:
+        return self._cpu_math_threads
+
+    # -- optimization toggles (parity; XLA owns the pipeline) --------------
+
+    def switch_ir_optim(self, flag: bool = True) -> None:
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True) -> None:
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self) -> bool:
+        return self._memory_optim
+
+    def enable_profile(self) -> None:
+        self._profile = True
+
+    def disable_glog_info(self) -> None:
+        self._glog_info = False
+
+    def set_optim_cache_dir(self, d: str) -> None:
+        self._extra["optim_cache_dir"] = d
+
+    def switch_use_feed_fetch_ops(self, flag: bool = False) -> None:
+        self._extra["use_feed_fetch_ops"] = bool(flag)
+
+    def switch_specify_input_names(self, flag: bool = True) -> None:
+        self._extra["specify_input_names"] = bool(flag)
+
+    def summary(self) -> str:
+        rows = [("model file", self._prog_file),
+                ("params file", self._params_file),
+                ("device", f"{self._device}:{self._device_id}"),
+                ("precision", self._precision),
+                ("ir optim (XLA)", self._ir_optim),
+                ("memory optim (XLA)", self._memory_optim),
+                ("profile", self._profile)]
+        w = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k.ljust(w)} : {v}" for k, v in rows)
+
+
+class PredictorHandle:
+    """Input/output tensor handle (reference ZeroCopyTensor,
+    paddle_api.h:117): host-side staging buffer with copy_from_cpu /
+    copy_to_cpu."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = list(shape) if shape is not None else None
+        self._dtype = dtype
+        self._buf: Optional[np.ndarray] = None
+
+    def reshape(self, shape: Sequence[int]) -> None:
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, arr) -> None:
+        self._buf = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._buf is None:
+            raise RuntimeError(f"handle {self.name!r}: no data (run() "
+                               "first for outputs / copy_from_cpu for "
+                               "inputs)")
+        return self._buf
+
+    def shape(self) -> List[int]:
+        if self._buf is not None:
+            return list(self._buf.shape)
+        return list(self._shape or [])
+
+    def type(self):
+        return self._buf.dtype if self._buf is not None else self._dtype
+
+
+class Predictor:
+    """Executable predictor over a jit.save artifact (reference
+    AnalysisPredictor via CreatePaddlePredictor, analysis_predictor.cc).
+    """
+
+    def __init__(self, config: Config):
+        self.config = config
+        prog = config.model_program_path()
+        if prog is None or not os.path.exists(prog):
+            raise FileNotFoundError(f"model file not found: {prog}")
+        base = prog[:-len(".pdmodel")]
+
+        if config._device == "cpu":
+            # pin the CPU backend BEFORE any jax import side effects
+            import jax
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+
+        from ..jit import load as jit_load
+        self._layer = jit_load(base)
+
+        meta_path = base + ".pdconfig"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self._input_meta = meta.get("inputs", [])
+            self._n_outputs = meta.get("n_outputs")
+        else:
+            self._input_meta = []
+            self._n_outputs = None
+        if not self._input_meta:
+            # no sidecar (pre-sidecar artifact): the exported in_tree is
+            # (params_dict, *inputs) flattened — subtract the param leaves
+            # to recover the real input count
+            try:
+                total = self._layer._exported.in_tree.num_leaves
+                n = max(1, total - len(self._layer._params_arrays))
+            except Exception:
+                n = 1
+            self._input_meta = [{"name": f"input_{i}"} for i in range(n)]
+        self._inputs = {m["name"]: PredictorHandle(
+            m["name"], m.get("shape"), m.get("dtype"))
+            for m in self._input_meta}
+        self._outputs: Dict[str, PredictorHandle] = {}
+
+    # -- reference surface --------------------------------------------------
+
+    def get_input_names(self) -> List[str]:
+        return [m["name"] for m in self._input_meta]
+
+    def get_input_handle(self, name: str) -> PredictorHandle:
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; inputs: "
+                           f"{self.get_input_names()}")
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        if self._outputs:
+            return list(self._outputs)
+        n = self._n_outputs or 1
+        return [f"output_{i}" for i in range(n)]
+
+    def get_output_handle(self, name: str) -> PredictorHandle:
+        if name not in self._outputs:
+            self._outputs[name] = PredictorHandle(name)
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute. Either positional ``inputs`` or pre-filled input
+        handles (zero-copy style)."""
+        if inputs is None:
+            inputs = [self._inputs[m["name"]].copy_to_cpu()
+                      for m in self._input_meta]
+        outs = self._layer(*inputs)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        arrs = [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+                for o in outs]
+        for i, a in enumerate(arrs):
+            self.get_output_handle(f"output_{i}").copy_from_cpu(a)
+        return arrs
+
+    def clear_intermediate_tensor(self) -> None:
+        pass  # XLA owns buffers; parity no-op
+
+    def try_shrink_memory(self) -> None:
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference paddle_infer::CreatePredictor."""
+    return Predictor(config)

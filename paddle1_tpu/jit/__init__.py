@@ -283,6 +283,19 @@ def save(layer, path, input_spec=None, **configs):
     from ..framework.io import save as fsave
     fsave({k: to_tensor(np.asarray(v)) for k, v in params.items()},
           path + ".pdiparams")
+    # feed/fetch metadata sidecar for the inference Predictor (the
+    # reference stores feed/fetch ops inside the ProgramDesc; StableHLO
+    # has positional args, so names ride alongside)
+    import json as _json
+    probe = exported.out_avals
+    meta = {"inputs": [{"name": s.name or f"input_{i}",
+                        "shape": list(s.shape),
+                        "dtype": str(np.dtype(s.dtype))}
+                       for i, s in enumerate(input_spec)],
+            "n_outputs": len(probe) if isinstance(probe, (list, tuple))
+            else 1}
+    with open(path + ".pdconfig", "w") as f:
+        _json.dump(meta, f)
 
 
 class TranslatedLayer(Layer):
