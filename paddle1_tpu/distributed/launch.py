@@ -16,10 +16,7 @@ from __future__ import annotations
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
-import time
 from typing import List, Optional
 
 __all__ = ["launch", "main"]
@@ -49,56 +46,9 @@ def _parse_args(argv):
     return p.parse_args(argv)
 
 
-def _spawn_one(rank: int, world: int, endpoints: List[str], args,
-               extra_env=None):
-    env = dict(os.environ)
-    env.update({
-        "PADDLE_TRAINER_ID": str(rank),
-        "PADDLE_TRAINERS_NUM": str(world),
-        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-        "RANK": str(rank),
-        "WORLD_SIZE": str(world),
-        "FLAGS_selected_tpus": str(rank),
-    })
-    if extra_env:
-        env.update(extra_env)
-    stdout = None
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
-        stdout = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
-    cmd = [sys.executable, "-u", args.training_script] + \
-        args.training_script_args
-    return subprocess.Popen(cmd, env=env, stdout=stdout,
-                            stderr=subprocess.STDOUT if stdout else None)
-
-
-def _watch(procs):
-    """Reference launch_utils.py:559: any death kills the pod, exit
-    nonzero."""
-    try:
-        while True:
-            alive = []
-            for p in procs:
-                ret = p.poll()
-                if ret is None:
-                    alive.append(p)
-                elif ret != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-                    sys.exit(ret)
-            if not alive:
-                return
-            time.sleep(1)
-    except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGTERM)
-        raise
-
-
 def launch(argv: Optional[List[str]] = None):
+    from .launch_utils import (get_cluster, start_local_trainers,
+                               watch_local_trainers)
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     nproc = args.nproc_per_node
     host, port = (args.master.split(":") + ["6170"])[:2]
@@ -110,17 +60,36 @@ def launch(argv: Optional[List[str]] = None):
         os.execve(sys.executable,
                   [sys.executable, "-u", args.training_script] +
                   args.training_script_args, env)
-    world = args.nnodes * nproc
-    endpoints = []
-    for node in range(args.nnodes):
-        h = host if args.ips is None else args.ips.split(",")[node]
-        for i in range(nproc):
-            endpoints.append(f"{h}:{int(port) + i}")
-    procs = [
-        _spawn_one(args.node_rank * nproc + i, world, endpoints, args)
-        for i in range(nproc)
-    ]
-    _watch(procs)
+    # Cluster/Pod model (reference launch_utils.py:58): --ips names the
+    # hosts; this invocation starts only its OWN pod's trainers, exactly
+    # like the reference (each host runs the same launch command with its
+    # node_rank). Single-host multi-proc testing uses one pod with
+    # nproc_per_node trainers.
+    node_ips = (args.ips.split(",") if args.ips else [host])
+    if len(node_ips) != args.nnodes:
+        if args.ips:
+            raise SystemExit(
+                f"--ips lists {len(node_ips)} hosts but --nnodes="
+                f"{args.nnodes}")
+        node_ips = [host] * args.nnodes  # local simulation of N nodes
+    cluster = get_cluster(node_ips, nproc, base_port=int(port))
+    if args.ips is None and args.nnodes > 1 and \
+            host in ("127.0.0.1", "localhost"):
+        # loopback master + no host list = local N-node simulation: this
+        # one command hosts EVERY pod (reference test_dist_base-style
+        # virtual cluster). A real multi-host run names a shared master
+        # (or --ips) and spawns only its own --node_rank pod below.
+        pods = cluster.pods
+    else:
+        pods = [cluster.pod(args.node_rank)]
+    procs = []
+    for pod in pods:
+        procs.extend(start_local_trainers(
+            cluster, pod, args.training_script, args.training_script_args,
+            log_dir=args.log_dir))
+    rc = watch_local_trainers(procs)
+    if rc != 0:
+        sys.exit(rc)
 
 
 def main():

@@ -1,0 +1,168 @@
+"""Cluster/Pod/Trainer topology model for the launcher.
+
+Analog of the reference's ``python/paddle/distributed/fleet/launch_utils.py``
+(Cluster:58 / Pod / Trainer, get_cluster:141, start_local_trainers:452,
+watch_local_trainers:559): the launcher builds an explicit cluster object
+from the node list, spawns one worker per (pod, trainer) with the rank env
+protocol, and a watch loop enforces fail-fast-kill-all.
+
+TPU-native notes: a "trainer" is one *process* (driving all its local
+chips via XLA), not one device; the coordination endpoint doubles as the
+``jax.distributed`` coordinator that ``init_parallel_env`` dials.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["Trainer", "Pod", "Cluster", "get_cluster",
+           "start_local_trainers", "watch_local_trainers",
+           "terminate_local_procs"]
+
+
+class Trainer:
+    """One worker process slot (reference launch_utils.py Trainer)."""
+
+    def __init__(self, endpoint: str, rank: int,
+                 accelerators: Optional[List[int]] = None):
+        self.endpoint = endpoint
+        self.rank = rank
+        self.accelerators = accelerators or []
+
+    def __repr__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint!r})"
+
+
+class Pod:
+    """All trainers on one host (reference launch_utils.py Pod)."""
+
+    def __init__(self, rank: int, addr: str):
+        self.rank = rank
+        self.addr = addr
+        self.trainers: List[Trainer] = []
+
+    @property
+    def endpoints(self) -> List[str]:
+        return [t.endpoint for t in self.trainers]
+
+    def __repr__(self):
+        return f"Pod(rank={self.rank}, addr={self.addr!r}, " \
+               f"trainers={self.trainers})"
+
+
+class Cluster:
+    """The whole job (reference launch_utils.py Cluster)."""
+
+    def __init__(self):
+        self.pods: List[Pod] = []
+
+    def trainers_endpoints(self) -> List[str]:
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def world_size(self) -> int:
+        return sum(len(p.trainers) for p in self.pods)
+
+    def pod(self, node_rank: int) -> Pod:
+        return self.pods[node_rank]
+
+    def __repr__(self):
+        return f"Cluster(pods={self.pods})"
+
+
+def get_cluster(node_ips: List[str], nproc_per_node: int,
+                base_port: int = 6170) -> Cluster:
+    """Build the Cluster from the host list (reference get_cluster:141:
+    one Pod per ip, one Trainer per selected device — here per process).
+    Ranks are assigned pod-major, matching the reference's endpoint
+    ordering so PADDLE_TRAINER_ID == index into the endpoint list."""
+    cluster = Cluster()
+    rank = 0
+    # distinct hosts reuse the same port block (the reference layout); a
+    # repeated ip means a LOCAL multi-node simulation, where every rank
+    # needs its own port
+    local_sim = len(set(node_ips)) != len(node_ips)
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod(node_rank, ip)
+        for i in range(nproc_per_node):
+            off = rank if local_sim else i
+            pod.trainers.append(Trainer(f"{ip}:{base_port + off}", rank))
+            rank += 1
+        cluster.pods.append(pod)
+    return cluster
+
+
+def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
+                         training_script_args: List[str],
+                         log_dir: Optional[str] = None,
+                         extra_env: Optional[dict] = None):
+    """Spawn this pod's trainers (reference start_local_trainers:452 —
+    same env protocol: PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT/
+    PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINERS_NUM, plus the coordination
+    address init_parallel_env hands to jax.distributed.initialize)."""
+    endpoints = cluster.trainers_endpoints()
+    world = cluster.world_size()
+    procs = []
+    for t in pod.trainers:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": t.endpoint,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_NODE_RANK": str(pod.rank),
+            "PADDLE_NNODES": str(len(cluster.pods)),
+            "RANK": str(t.rank),
+            "WORLD_SIZE": str(world),
+            "FLAGS_selected_tpus": str(t.rank),
+        })
+        if extra_env:
+            env.update(extra_env)
+        stdout = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stdout = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+        cmd = [sys.executable, "-u", training_script] + \
+            list(training_script_args)
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None))
+    return procs
+
+
+def terminate_local_procs(procs) -> None:
+    """Reference terminate_local_procs: SIGTERM the stragglers."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def watch_local_trainers(procs, poll_s: float = 1.0) -> int:
+    """Reference watch_local_trainers:559: block until all trainers exit;
+    the FIRST nonzero exit kills the rest and becomes the return code."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    terminate_local_procs(procs)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        terminate_local_procs(procs)
+        raise

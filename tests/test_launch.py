@@ -1,0 +1,123 @@
+"""Multi-node launch path (VERDICT r2 task 10): Cluster/Pod/Trainer model,
+2-process rendezvous through jax.distributed, cross-process allreduce, and
+fail-fast watch semantics. Reference launch_utils.py:58,141,452,559."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_",
+                                "PADDLE_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+class TestClusterModel:
+    def test_get_cluster_two_hosts(self):
+        from paddle1_tpu.distributed.launch_utils import get_cluster
+        c = get_cluster(["10.0.0.1", "10.0.0.2"], 2, base_port=7000)
+        assert c.world_size() == 4
+        assert c.trainers_endpoints() == [
+            "10.0.0.1:7000", "10.0.0.1:7001",
+            "10.0.0.2:7000", "10.0.0.2:7001"]
+        assert c.pod(1).trainers[0].rank == 2
+        assert c.pod(1).addr == "10.0.0.2"
+
+    def test_local_simulation_unique_ports(self):
+        from paddle1_tpu.distributed.launch_utils import get_cluster
+        c = get_cluster(["127.0.0.1", "127.0.0.1"], 2, base_port=7000)
+        eps = c.trainers_endpoints()
+        assert len(set(eps)) == 4  # every local rank gets its own port
+
+
+WORKER_ALLREDUCE = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    import paddle1_tpu.distributed as dist
+
+    pe = dist.init_parallel_env()   # dials jax.distributed
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    devs = jax.devices()
+    assert len(devs) == 2, devs     # 1 CPU device per process, global view
+
+    rank = dist.get_rank()
+    mesh = Mesh(np.array(devs), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    local = np.full((1, 4), float(rank + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(sharding, local, (2, 4))
+    summed = jax.jit(lambda a: jnp.sum(a, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))(garr)
+    val = float(np.asarray(summed.addressable_shards[0].data)[0])
+    print(f"RESULT rank={rank} endpoint="
+          f"{os.environ['PADDLE_CURRENT_ENDPOINT']} sum={val}", flush=True)
+    assert val == 3.0, val
+""")
+
+WORKER_FAILFAST = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 1:
+        sys.exit(7)
+    time.sleep(300)   # rank 0 must be killed by the watcher
+""")
+
+
+class TestLauncher:
+    def test_two_node_rendezvous_allreduce(self, tmp_path):
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER_ALLREDUCE)
+        logdir = tmp_path / "logs"
+        port = _free_port()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle1_tpu.distributed.launch",
+             "--nnodes", "2", "--nproc_per_node", "1",
+             "--master", f"127.0.0.1:{port}",
+             "--log_dir", str(logdir), str(worker)],
+            env=_clean_env(), cwd=REPO, capture_output=True, timeout=300)
+        logs = {i: (logdir / f"workerlog.{i}").read_text()
+                for i in range(2)}
+        assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode(),
+                                   logs)
+        for i in range(2):
+            assert f"RESULT rank={i}" in logs[i], logs
+            assert "sum=3.0" in logs[i], logs
+        # distinct endpoints per rank
+        assert f":{port}" in logs[0] and f":{port + 1}" in logs[1]
+
+    def test_fail_fast_kills_pod(self, tmp_path):
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER_FAILFAST)
+        port = _free_port()
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle1_tpu.distributed.launch",
+             "--nnodes", "2", "--nproc_per_node", "1",
+             "--master", f"127.0.0.1:{port}", str(worker)],
+            env=_clean_env(), cwd=REPO, capture_output=True, timeout=120)
+        dt = time.time() - t0
+        assert r.returncode == 7, (r.returncode, r.stderr.decode())
+        assert dt < 60, f"watcher failed to kill the sleeping rank ({dt}s)"
