@@ -41,6 +41,7 @@ from . import jit
 from . import static
 from . import distributed
 from . import inference
+from . import utils
 from . import vision
 from . import text
 from . import hapi

@@ -1,6 +1,8 @@
 """fleet.utils (reference python/paddle/distributed/fleet/utils/)."""
 
+from . import fs
 from . import recompute as _recompute_mod
+from .fs import HDFSClient, LocalFS
 from .recompute import recompute, recompute_sequential
 
 
@@ -24,4 +26,5 @@ class _FleetUtil:
 
 fleet_util = _FleetUtil()
 
-__all__ = ["recompute", "recompute_sequential", "fleet_util"]
+__all__ = ["recompute", "recompute_sequential", "fleet_util", "fs",
+           "LocalFS", "HDFSClient"]

@@ -1,0 +1,273 @@
+"""Filesystem abstraction: LocalFS + HDFSClient.
+
+Analog of the reference's
+/root/reference/python/paddle/distributed/fleet/utils/fs.py (FS base,
+LocalFS, HDFSClient driving the ``hadoop fs`` CLI with retries) and the
+C++ side /root/reference/paddle/fluid/framework/io/fs.cc. Checkpoints and
+fleet utilities write through this interface so a cluster deployment can
+point them at HDFS (or any hadoop-compatible store) without code changes.
+
+TPU-native note: on Cloud TPU pods the idiomatic remote store is GCS via
+a mounted path or gcsfuse — LocalFS covers that transparently; HDFSClient
+keeps the reference's on-prem contract.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import List, Optional, Tuple
+
+from ....core.errors import PreconditionNotMetError
+
+__all__ = ["ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
+           "FSTimeOut", "FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FS:
+    """Interface (reference fs.py FS abstract base)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local (or mounted-remote, e.g. gcsfuse) filesystem — reference
+    fs.py LocalFS."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        """Returns (dirs, files) — the reference's pair contract."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, f))
+             else files).append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        if not self.is_exist(fs_path):
+            return []
+        return [f for f in sorted(os.listdir(fs_path))
+                if os.path.isdir(os.path.join(fs_path, f))]
+
+    def upload(self, local_path, fs_path):
+        # local → local: a copy (mounted-remote case)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` CLI driver (reference fs.py HDFSClient: every call
+    shells out with configured retries; reference fs.cc does the same from
+    C++)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None,
+                 configs: Optional[dict] = None, time_out: int = 5 * 60,
+                 sleep_inter: int = 1000, retry_times: int = 3):
+        self._hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME")
+        self._configs = configs or {}
+        self._time_out = time_out
+        self._sleep_s = sleep_inter / 1000.0
+        self._retries = retry_times
+        bin_path = (os.path.join(self._hadoop_home, "bin", "hadoop")
+                    if self._hadoop_home else shutil.which("hadoop"))
+        if bin_path is None or not os.path.exists(bin_path):
+            raise PreconditionNotMetError(
+                "HDFSClient needs the hadoop CLI: pass hadoop_home= or set "
+                "HADOOP_HOME (reference fs.py requires the same). For "
+                "GCS-style remote storage on TPU pods, mount the bucket "
+                "and use LocalFS.")
+        self._bin = bin_path
+
+    def _run(self, *cmd) -> Tuple[int, str]:
+        full = [self._bin, "fs"]
+        for k, v in self._configs.items():
+            full += ["-D", f"{k}={v}"]
+        full += list(cmd)
+        last = ""
+        for attempt in range(self._retries):
+            try:
+                r = subprocess.run(full, capture_output=True, text=True,
+                                   timeout=self._time_out)
+            except subprocess.TimeoutExpired as e:
+                raise FSTimeOut(f"{' '.join(full)} timed out") from e
+            if r.returncode == 0:
+                return 0, r.stdout
+            last = r.stderr
+            if attempt + 1 < self._retries:  # no dead sleep after the last
+                time.sleep(self._sleep_s)
+        raise ExecuteError(f"{' '.join(full)} failed after "
+                           f"{self._retries} tries: {last}")
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        _, out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path) -> bool:
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+    def need_upload_download(self) -> bool:
+        return True

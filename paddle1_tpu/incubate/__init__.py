@@ -4,6 +4,10 @@ fluid/incubate): auto-checkpoint, functional higher-order autodiff bridge.
 
 from . import auto_checkpoint
 from . import functional
+from . import optimizer
 from .auto_checkpoint import train_epoch_range
+from .optimizer import (ExponentialMovingAverage, LookAhead, ModelAverage)
 
-__all__ = ["auto_checkpoint", "functional", "train_epoch_range"]
+__all__ = ["auto_checkpoint", "functional", "optimizer",
+           "train_epoch_range", "ExponentialMovingAverage", "LookAhead",
+           "ModelAverage"]

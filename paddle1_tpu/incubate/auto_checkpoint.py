@@ -27,10 +27,16 @@ _CKPT_ENV = "PADDLE_CHECKPOINT_DIR"
 class ExeTrainStatus:
     """Resume bookkeeping (reference auto_checkpoint.py ExeTrainStatus)."""
 
-    def __init__(self, name: str, max_epoch: int, save_dir: str):
+    def __init__(self, name: str, max_epoch: int, save_dir: str,
+                 fs=None, remote_dir: Optional[str] = None):
         self.name = name
         self.max_epoch = max_epoch
         self.save_dir = save_dir
+        # remote sink (reference writes snapshots to HDFS through the fs
+        # abstraction — fleet/utils/fs.py); local publish stays atomic and
+        # the remote copy follows
+        self.fs = fs
+        self.remote_dir = remote_dir
         self._layers = []
         self._optimizers = []
         self.epoch = -1
@@ -81,15 +87,46 @@ class ExeTrainStatus:
                 os.path.exists(self._last_saved):
             os.remove(self._last_saved)
         self._last_saved = path
+        if self.fs is not None and self.remote_dir:
+            self.fs.mkdirs(self.remote_dir)
+            for local in (path, self._meta_path()):
+                dst = os.path.join(self.remote_dir,
+                                   os.path.basename(local))
+                if self.fs.is_exist(dst):
+                    self.fs.delete(dst)
+                self.fs.upload(local, dst)
 
     def try_restore(self) -> int:
         """Returns the next epoch to run (0 if no snapshot)."""
         from ..framework.io import load as fload
+        if not os.path.exists(self._meta_path()) and self.fs is not None \
+                and self.remote_dir:
+            # cold host: pull the latest snapshot from the remote sink.
+            # The meta file is published LAST (os.replace after the state
+            # file lands) so a failed state download leaves no local meta
+            # and the pull retries on the next start.
+            rmeta = os.path.join(self.remote_dir,
+                                 os.path.basename(self._meta_path()))
+            if self.fs.is_exist(rmeta):
+                os.makedirs(self.save_dir, exist_ok=True)
+                mtmp = self._meta_path() + f".dl{os.getpid()}"
+                self.fs.download(rmeta, mtmp)
+                with open(mtmp) as f:
+                    remote_state = os.path.basename(json.load(f)["path"])
+                self.fs.download(
+                    os.path.join(self.remote_dir, remote_state),
+                    os.path.join(self.save_dir, remote_state))
+                os.replace(mtmp, self._meta_path())
         if not os.path.exists(self._meta_path()):
             return 0
         with open(self._meta_path()) as f:
             meta = json.load(f)
         path = meta.get("path")
+        if path and not os.path.exists(path):
+            # the snapshot may come from a host with a DIFFERENT save_dir
+            # (remote restore): resolve by basename in our own dir
+            local = os.path.join(self.save_dir, os.path.basename(path))
+            path = local if os.path.exists(local) else path
         if not path or not os.path.exists(path):
             return 0
         state = fload(path)
@@ -109,7 +146,8 @@ class ExeTrainStatus:
 
 def train_epoch_range(max_epoch_num: int, *objs, name: str = "auto_ckpt",
                       save_checkpoint_inter: int = 1,
-                      checkpoint_dir: Optional[str] = None
+                      checkpoint_dir: Optional[str] = None,
+                      fs=None, remote_dir: Optional[str] = None
                       ) -> Iterator[int]:
     """for epoch in train_epoch_range(N, model, opt): ...  (reference
     auto_checkpoint.py:71). Yields epoch indices, resuming after restart;
@@ -119,7 +157,8 @@ def train_epoch_range(max_epoch_num: int, *objs, name: str = "auto_ckpt",
     if not ckpt_dir:
         yield from range(max_epoch_num)
         return
-    status = ExeTrainStatus(name, max_epoch_num, ckpt_dir).register(*objs)
+    status = ExeTrainStatus(name, max_epoch_num, ckpt_dir, fs=fs,
+                            remote_dir=remote_dir).register(*objs)
     start = status.try_restore()
     for epoch in range(start, max_epoch_num):
         yield epoch

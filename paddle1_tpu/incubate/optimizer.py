@@ -1,0 +1,216 @@
+"""Optimizer wrappers: LookAhead, ModelAverage, ExponentialMovingAverage.
+
+Analogs of the reference's
+/root/reference/python/paddle/fluid/optimizer.py ExponentialMovingAverage
+(:3311), ModelAverage (:3620) and LookaheadOptimizer (:5703). The
+reference implements each as extra ops appended to the static program;
+here they are eager wrappers over the parameter list — slot buffers live
+beside the optimizer's, and ``apply()/restore()`` context-swap the
+parameter data exactly like the reference's apply/restore programs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (reference optimizer.py:3311).
+
+    ``update()`` after each optimizer step; ``apply()`` swaps EMA values
+    in (bias-corrected, as the reference's decay-power correction does);
+    ``restore()`` swaps the training values back.
+    """
+
+    def __init__(self, parameters, decay: float = 0.999, name=None):
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._decay = float(decay)
+        self._ema: Dict[int, jnp.ndarray] = {
+            id(p): jnp.zeros_like(p.data) for p in self._params}
+        self._step = 0
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def update(self) -> None:
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1.0 - d) * p.data
+
+    def apply(self, need_restore: bool = True):
+        """Swap EMA values into the parameters. Usable as a context
+        manager (``with ema.apply(): evaluate()``) or imperatively."""
+        if self._backup is not None:
+            raise InvalidArgumentError("EMA already applied; restore first")
+        if self._step == 0:
+            raise InvalidArgumentError(
+                "EMA.apply() before any update(): the moving averages are "
+                "all zeros and would silently wipe the parameters")
+        bc = 1.0 - self._decay ** self._step  # bias correction
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            p._data = (self._ema[id(p)] / bc).astype(p.data.dtype)
+        ema = self
+
+        class _Ctx:
+            def __enter__(self):
+                return ema
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+        return _Ctx()
+
+    def restore(self) -> None:
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self) -> dict:
+        return {"step": self._step, "decay": self._decay,
+                "ema": {i: np.asarray(v)
+                        for i, v in enumerate(self._ema.values())}}
+
+
+class ModelAverage:
+    """Sliding-window average of parameter values (reference
+    optimizer.py:3620 — accumulates sum_1/sum_2/sum_3 blocks over a
+    window sized by ``average_window_rate``; apply()/restore() swap the
+    averaged values in for evaluation)."""
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise InvalidArgumentError(
+                "ModelAverage needs the parameter list in eager mode")
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum: Dict[int, jnp.ndarray] = {
+            id(p): jnp.zeros_like(p.data) for p in self._params}
+        self._n = 0
+        self._total_steps = 0
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def update(self) -> None:
+        """Accumulate after each step; restart the window when it outgrows
+        max(min_average_window, total_steps * rate) (the reference's
+        window-restart rule)."""
+        self._total_steps += 1
+        window = max(self.min_w, int(self._total_steps * self.rate))
+        window = min(window, self.max_w)
+        if self._n >= window:
+            for p in self._params:
+                self._sum[id(p)] = jnp.zeros_like(p.data)
+            self._n = 0
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.data
+        self._n += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        if self._n == 0:
+            raise InvalidArgumentError("ModelAverage: no accumulated steps")
+        if self._backup is not None:
+            raise InvalidArgumentError("already applied; restore first")
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            p._data = (self._sum[id(p)] / self._n).astype(p.data.dtype)
+        ma = self
+
+        class _Ctx:
+            def __enter__(self):
+                return ma
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ma.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None) -> None:
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    # optimizer-protocol passthroughs so hapi/training loops accept it
+    def step(self):
+        self.update()
+
+    def clear_grad(self):
+        pass
+
+
+class LookAhead:
+    """Lookahead optimizer (reference LookaheadOptimizer:5703; k fast
+    steps with the inner optimizer, then slow weights catch up:
+    slow += alpha * (fast - slow); fast = slow)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if inner_optimizer is None:
+            raise InvalidArgumentError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise InvalidArgumentError("alpha must be in [0, 1]")
+        if k < 1:
+            raise InvalidArgumentError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        params = inner_optimizer._parameter_list or []
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p.data for p in params}
+        self._params = list(params)
+
+    def step(self) -> None:
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            a = self.alpha
+            for p in self._params:
+                slow = self._slow[id(p)]
+                slow = slow + a * (p.data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p.data.dtype)
+
+    minimize_step = step
+
+    def clear_grad(self) -> None:
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self) -> dict:
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step": self._step_count,
+                "slow": {str(i): np.asarray(v)
+                         for i, v in enumerate(self._slow.values())}}
+
+    def set_state_dict(self, state: dict) -> None:
+        # without this, __getattr__ would hand the wrong-shaped dict to
+        # the inner optimizer and silently drop its moments on resume
+        self.inner_optimizer.set_state_dict(state["inner"])
+        self._step_count = int(state.get("step", 0))
+        slow = state.get("slow", {})
+        for i, p in enumerate(self._params):
+            v = slow.get(str(i), slow.get(i))
+            if v is not None:
+                self._slow[id(p)] = jnp.asarray(v)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)

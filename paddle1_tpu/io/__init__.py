@@ -5,3 +5,4 @@ from .dataset import (BatchSampler, ChainDataset, ComposeDataset, Dataset,
                       DistributedBatchSampler, IterableDataset,
                       RandomSampler, Sampler, SequenceSampler, Subset,
                       TensorDataset, WeightedRandomSampler, random_split)
+from .file_dataset import (DatasetFactory, InMemoryDataset, QueueDataset)

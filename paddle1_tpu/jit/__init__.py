@@ -148,8 +148,29 @@ class StaticFunction:
 
         flat_inputs = (param_tensors +
                        input_tensors)
-        out = engine.apply(f"static:{getattr(self._fn, '__name__', 'fn')}",
-                           op_fn, tuple(flat_inputs))
+        try:
+            out = engine.apply(
+                f"static:{getattr(self._fn, '__name__', 'fn')}",
+                op_fn, tuple(flat_inputs))
+        except jax.errors.ConcretizationTypeError as e:
+            # covers TracerBoolConversionError too (its subclass)
+            # The reference rewrites `if tensor:` / tensor-bounded loops
+            # via its AST transformer (fluid/dygraph/dygraph_to_static/).
+            # This build is trace-based by design (SURVEY §7), so
+            # tensor-dependent Python control flow must be expressed with
+            # the graph-native primitives — teach, loudly, instead of
+            # surfacing a raw tracer error.
+            fn_name = getattr(self._fn, "__name__", "fn")
+            raise InvalidArgumentError(
+                f"to_static: `{fn_name}` uses a Tensor's VALUE in Python "
+                f"control flow (`if tensor:` / `while tensor:` / "
+                f"`tensor.item()`), which cannot be traced into a static "
+                f"program. Rewrite that branch with "
+                f"paddle1_tpu.static.nn.cond / case / switch_case, the "
+                f"loop with paddle1_tpu.static.nn.while_loop, or move the "
+                f"decision out of the compiled function (compute it "
+                f"eagerly and pass the result in). Original trace error: "
+                f"{type(e).__name__}: {e}") from e
         return out
 
     @property

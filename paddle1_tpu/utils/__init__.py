@@ -1,0 +1,152 @@
+"""paddle1_tpu.utils — custom-operator extension API (+ misc helpers).
+
+Analog of the reference's out-of-tree operator machinery:
+``paddle.utils.cpp_extension`` building a .so that
+``framework/custom_operator.cc`` registers into the op registry. The
+TPU-native inversion: device compute is authored as jax/Pallas Python
+(XLA compiles it for the chip — there is no ABI for hand-built TPU
+kernels), so a "custom op" here is a pure function registered into the
+tape dispatch, with an optional hand-written backward; *host-side* C/C++
+kernels still work, bridged through ``jax.pure_callback`` + ctypes
+(:func:`load_op_library`). Both forms run eagerly AND under jit, exactly
+like built-in ops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["register_op", "get_op", "registered_ops", "CustomOp",
+           "load_op_library", "cpp_extension"]
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom operator.
+
+    ``fwd(*arrays, **attrs)`` — pure jax (jnp / lax / Pallas) function.
+    ``bwd(residuals, cotangents)`` — optional custom backward returning
+    one grad per input (arrays or IndexedSlices); when given, ``fwd``
+    must return ``(outputs, residuals)``. Without ``bwd``, jax.vjp of
+    ``fwd`` provides the gradient (the common case).
+    """
+
+    def __init__(self, name: str, fwd: Callable,
+                 bwd: Optional[Callable] = None):
+        self.name = name
+        self.fwd = fwd
+        self.bwd = bwd
+
+    def __call__(self, *inputs, **attrs):
+        from ..autograd.engine import apply, apply_custom_vjp
+        from ..core.tensor import Tensor, to_tensor
+        tin = tuple(i if isinstance(i, Tensor) or not _tensorable(i)
+                    else to_tensor(i) for i in inputs)
+        if self.bwd is None:
+            return apply(self.name, self.fwd, tin, **attrs)
+        return apply_custom_vjp(self.name, self.fwd, self.bwd, tin, **attrs)
+
+    def __repr__(self):
+        return f"CustomOp({self.name!r}, custom_bwd={self.bwd is not None})"
+
+
+def _tensorable(x) -> bool:
+    import jax
+    return isinstance(x, (np.ndarray, jax.Array, list, tuple, int, float))
+
+
+def register_op(name: str, fwd: Optional[Callable] = None,
+                bwd: Optional[Callable] = None):
+    """Register a custom op (reference custom_operator.cc
+    RegisterOperatorWithMetaInfo). Usable directly or as a decorator::
+
+        @register_op("my_gelu")
+        def my_gelu(x):
+            return x * 0.5 * (1 + jnp.tanh(0.79788456 * (x + 0.044715*x**3)))
+
+        y = paddle.utils.get_op("my_gelu")(tensor)   # eager or traced
+    """
+    if fwd is None:
+        def deco(fn):
+            register_op(name, fn, bwd)
+            return fn
+        return deco
+    if name in _REGISTRY:
+        raise InvalidArgumentError(
+            f"custom op {name!r} is already registered (the reference "
+            f"rejects duplicate operator types the same way)")
+    op = CustomOp(name, fwd, bwd)
+    _REGISTRY[name] = op
+    return op
+
+
+def get_op(name: str) -> CustomOp:
+    if name not in _REGISTRY:
+        raise InvalidArgumentError(
+            f"custom op {name!r} not registered; known: "
+            f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+def load_op_library(so_path: str, name: str, symbol: str,
+                    out_like: int = 0):
+    """Bridge a host C kernel into the op dispatch (reference
+    LoadOpMetaInfoAndRegisterOp for .so custom ops).
+
+    The C symbol must have signature
+    ``void f(const float* in, float* out, int64_t n)`` (elementwise,
+    f32). It runs on the HOST via ``jax.pure_callback`` — under jit XLA
+    transfers the operand, calls back, and transfers the result; eagerly
+    it is a plain call. ``out_like`` names which input supplies the
+    output shape/dtype. TPU-resident custom kernels should be written as
+    Pallas and registered with :func:`register_op` instead.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    lib = ctypes.CDLL(so_path)
+    cfn = getattr(lib, symbol)
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_call(x):
+        x = np.ascontiguousarray(np.asarray(x), np.float32)
+        out = np.empty_like(x)
+        cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(x.size))
+        return out
+
+    def fwd(*arrays):
+        like = arrays[out_like]
+        return jax.pure_callback(
+            host_call, jax.ShapeDtypeStruct(like.shape, jnp.float32), like)
+
+    return register_op(name, fwd)
+
+
+class cpp_extension:
+    """Namespace parity with ``paddle.utils.cpp_extension``: points users
+    at the TPU-native custom-op route instead of CUDA build helpers."""
+
+    @staticmethod
+    def load(name=None, sources=None, **kwargs):
+        raise InvalidArgumentError(
+            "cpp_extension.load builds CUDA/C++ device ops, which cannot "
+            "target TPU. Write the kernel as jax/Pallas and register it "
+            "with paddle1_tpu.utils.register_op, or bridge a HOST C "
+            "kernel with paddle1_tpu.utils.load_op_library.")
+
+    CppExtension = staticmethod(load)
+    CUDAExtension = staticmethod(load)
