@@ -1,0 +1,131 @@
+// Package paddle — Go inference bindings for paddle1_tpu.
+//
+// Analog of the reference's Go bindings (/root/reference/go/paddle/
+// config.go, predictor.go, tensor.go — cgo over the C inference API).
+// These bindings sit on the paddle1_tpu C ABI
+// (paddle1_tpu/core/native/src/capi.cc): build libpaddle1_capi.so once
+// (python -c "from paddle1_tpu.core.native import build_capi; print(build_capi())")
+// and compile this package with cgo. The embedded interpreter inside the
+// .so runs the exported StableHLO artifact, so a Go service deploys a
+// trained model with no Python code of its own.
+//
+// Usage:
+//
+//	cfg := paddle.NewConfig("/models/lenet", "cpu")
+//	pred, err := paddle.NewPredictor(cfg)
+//	defer pred.Destroy()
+//	out, shape, err := pred.RunF32([][]float32{input}, [][]int64{{4, 1, 28, 28}}, 0)
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle1_capi -lpython3.12 -ldl -lm
+#include <stdint.h>
+#include <stdlib.h>
+
+extern void* p1_predictor_create(const char* model_base, const char* device);
+extern int p1_predictor_num_inputs(void* h);
+extern int p1_predictor_num_outputs(void* h);
+extern int p1_predictor_run_f32(void* h, const float** inputs,
+                                const int64_t* shapes, const int* ndims,
+                                int n_inputs, int out_idx, float* out_buf,
+                                int64_t out_capacity, int64_t* out_shape,
+                                int* out_ndim);
+extern void p1_predictor_destroy(void* h);
+extern const char* p1_last_error();
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Config mirrors the reference's AnalysisConfig surface that the Go
+// bindings expose (config.go SetModel/DisableGpu).
+type Config struct {
+	ModelBase string // path prefix of the .pdmodel/.pdiparams pair
+	Device    string // "auto" | "cpu" | "tpu"
+}
+
+func NewConfig(modelBase, device string) *Config {
+	if device == "" {
+		device = "auto"
+	}
+	return &Config{ModelBase: modelBase, Device: device}
+}
+
+// Predictor wraps the C handle (reference predictor.go Predictor).
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.p1_last_error()))
+}
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	cBase := C.CString(cfg.ModelBase)
+	cDev := C.CString(cfg.Device)
+	defer C.free(unsafe.Pointer(cBase))
+	defer C.free(unsafe.Pointer(cDev))
+	h := C.p1_predictor_create(cBase, cDev)
+	if h == nil {
+		return nil, lastError()
+	}
+	return &Predictor{h: h}, nil
+}
+
+func (p *Predictor) NumInputs() int  { return int(C.p1_predictor_num_inputs(p.h)) }
+func (p *Predictor) NumOutputs() int { return int(C.p1_predictor_num_outputs(p.h)) }
+
+// RunF32 executes the model on float32 inputs and returns output outIdx
+// (flattened) with its shape — the GetOutputData path of the reference's
+// tensor.go, f32-specialized like capi.cc.
+func (p *Predictor) RunF32(inputs [][]float32, shapes [][]int64,
+	outIdx int) ([]float32, []int64, error) {
+	n := len(inputs)
+	inPtrs := make([]*C.float, n)
+	var flatShapes []C.int64_t
+	ndims := make([]C.int, n)
+	outCap := int64(1)
+	for i, in := range inputs {
+		inPtrs[i] = (*C.float)(unsafe.Pointer(&in[0]))
+		ndims[i] = C.int(len(shapes[i]))
+		for _, d := range shapes[i] {
+			flatShapes = append(flatShapes, C.int64_t(d))
+		}
+	}
+	// output capacity heuristic: caller can re-run with a larger hint if
+	// the C side reports capacity-too-small
+	for _, in := range inputs {
+		if int64(len(in)) > outCap {
+			outCap = int64(len(in))
+		}
+	}
+	outCap *= 16
+	outBuf := make([]float32, outCap)
+	outShape := make([]C.int64_t, 8)
+	outNdim := C.int(8)
+
+	rc := C.p1_predictor_run_f32(p.h, &inPtrs[0], &flatShapes[0],
+		&ndims[0], C.int(n), C.int(outIdx),
+		(*C.float)(unsafe.Pointer(&outBuf[0])), C.int64_t(outCap),
+		&outShape[0], &outNdim)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	shape := make([]int64, int(outNdim))
+	numel := int64(1)
+	for i := range shape {
+		shape[i] = int64(outShape[i])
+		numel *= shape[i]
+	}
+	return outBuf[:numel], shape, nil
+}
+
+func (p *Predictor) Destroy() {
+	if p.h != nil {
+		C.p1_predictor_destroy(p.h)
+		p.h = nil
+	}
+}

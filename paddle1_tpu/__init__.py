@@ -42,6 +42,7 @@ from . import static
 from . import distributed
 from . import inference
 from . import utils
+from . import hub
 from . import vision
 from . import text
 from . import hapi

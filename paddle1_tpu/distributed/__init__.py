@@ -37,6 +37,13 @@ def __getattr__(name):
     if name == "launch":
         from . import launch as _launch_mod
         return _launch_mod
+    if name == "fleet":
+        # lazy: fleet pulls in the meta-optimizer stack; resolving it on
+        # first touch keeps `import paddle1_tpu` light. import_module (not
+        # `from . import`) — the latter re-enters this __getattr__ via
+        # _handle_fromlist before the submodule binds.
+        import importlib
+        return importlib.import_module(".fleet", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",

@@ -21,6 +21,7 @@ from ..meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                              LayerDesc, SharedLayerDesc, PipelineLayer,
                              SegmentLayers)
 from .utils import recompute, fleet_util
+from .trainer import HogwildWorker, MultiTrainer
 
 # module-level delegation to the singleton (the reference exposes
 # fleet.init etc. as module functions)

@@ -4,6 +4,8 @@ Analog of python/paddle/framework/ in the reference (io.py:494 save /
 :688 load).
 """
 
+from . import crypto
+from .crypto import Cipher, CipherFactory, CipherUtils
 from .param_attr import ParamAttr
 from .io import save, load
 from ..core.generator import seed as _seed

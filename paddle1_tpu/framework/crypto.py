@@ -1,0 +1,111 @@
+"""Model encryption (reference paddle/fluid/framework/io/crypto/:
+cipher.h CipherFactory / AESCipher over the inference artifacts, exposed
+via paddle_crypto and AnalysisConfig::SetModelBuffer for encrypted
+deployment).
+
+AES-256-GCM via the ``cryptography`` package: authenticated encryption,
+random 96-bit nonce per file, format ``b"P1CRYPT1" || nonce || ciphertext
+(|| GCM tag)``. Keys are raw 32-byte secrets (hex-encodable with
+:func:`CipherUtils.gen_key_to_file`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["Cipher", "CipherFactory", "CipherUtils"]
+
+_MAGIC = b"P1CRYPT1"
+
+
+class Cipher:
+    """AES-256-GCM cipher (reference AESCipher, crypto/aes_cipher.cc)."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise InvalidArgumentError(
+                f"cipher key must be 32 bytes (AES-256), got {len(key)}")
+        self._key = key
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        nonce = os.urandom(12)
+        ct = AESGCM(self._key).encrypt(nonce, plaintext, _MAGIC)
+        return _MAGIC + nonce + ct
+
+    def decrypt(self, blob: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        if not blob.startswith(_MAGIC):
+            raise InvalidArgumentError(
+                "not an encrypted paddle1_tpu artifact (bad magic)")
+        nonce, ct = blob[len(_MAGIC):len(_MAGIC) + 12], \
+            blob[len(_MAGIC) + 12:]
+        try:
+            return AESGCM(self._key).decrypt(nonce, ct, _MAGIC)
+        except Exception as e:
+            raise InvalidArgumentError(
+                "decryption failed: wrong key or corrupted file") from e
+
+    def encrypt_file(self, in_path: str, out_path: str) -> None:
+        with open(in_path, "rb") as f:
+            blob = self.encrypt(f.read())
+        tmp = out_path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, out_path)
+
+    def decrypt_file(self, in_path: str, out_path: str) -> None:
+        with open(in_path, "rb") as f:
+            plain = self.decrypt(f.read())
+        tmp = out_path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(plain)
+        os.replace(tmp, out_path)
+
+
+class CipherFactory:
+    """Reference CipherFactory::CreateCipher (config names an AES mode;
+    GCM is the only mode here — CBC without auth is not worth carrying)."""
+
+    @staticmethod
+    def create_cipher(config_fpath: Optional[str] = None,
+                      key: Optional[bytes] = None) -> Cipher:
+        if key is None:
+            raise InvalidArgumentError("create_cipher needs key=")
+        return Cipher(key)
+
+
+class CipherUtils:
+    """Reference crypto/cipher_utils.cc helpers."""
+
+    @staticmethod
+    def gen_key(length: int = 32) -> bytes:
+        return os.urandom(length)
+
+    @staticmethod
+    def gen_key_to_file(path: str, length: int = 32) -> bytes:
+        k = CipherUtils.gen_key(length)
+        # create with the final 0600 mode atomically — a write-then-chmod
+        # leaves a umask-default-readable window on multi-user hosts
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.write(fd, k.hex().encode())
+        finally:
+            os.close(fd)
+        return k
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return bytes.fromhex(f.read().decode().strip())
+
+
+def is_encrypted(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(_MAGIC)) == _MAGIC
+    except OSError:
+        return False

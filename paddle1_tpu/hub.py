@@ -1,0 +1,95 @@
+"""paddle.hub analog — model loading via the hubconf protocol.
+
+Reference: python/paddle/hapi/hub.py (list/help/load over a repo that
+exposes ``hubconf.py`` entrypoints; sources 'github', 'gitee', 'local').
+
+The TPU build environment is zero-egress, so 'local' is the first-class
+source (a directory containing ``hubconf.py``); the remote sources raise
+a clear error instead of half-downloading. The hubconf contract matches
+the reference: every public callable in hubconf.py is an entrypoint, and
+``dependencies = [...]`` is checked before load.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+from .core.errors import InvalidArgumentError, PreconditionNotMetError
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise InvalidArgumentError(
+            f"no {_HUBCONF} in {repo_dir!r} (the hub protocol requires "
+            f"one at the repo root, reference hapi/hub.py)")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle1_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", [])
+    missing = []
+    for d in deps:
+        try:
+            importlib.import_module(d)
+        except ImportError:
+            missing.append(d)
+    if missing:
+        raise PreconditionNotMetError(
+            f"hubconf dependencies not installed: {missing}")
+    return mod
+
+
+def _check_source(source: str, repo_dir: str) -> str:
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        raise PreconditionNotMetError(
+            f"hub source {source!r} needs network egress, which this "
+            f"environment does not have; clone the repo and use "
+            f"source='local'")
+    raise InvalidArgumentError(
+        f"unknown hub source {source!r} (expected github/gitee/local)")
+
+
+def list(repo_dir: str, source: str = "local",
+         force_reload: bool = False) -> List[str]:
+    """Entrypoint names exposed by the repo (reference hub.list)."""
+    d = _check_source(source, repo_dir)
+    mod = _load_hubconf(d)
+    return sorted(
+        name for name in dir(mod)
+        if callable(getattr(mod, name)) and not name.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> Optional[str]:
+    """Entrypoint docstring (reference hub.help)."""
+    d = _check_source(source, repo_dir)
+    mod = _load_hubconf(d)
+    if not hasattr(mod, model):
+        raise InvalidArgumentError(
+            f"no entrypoint {model!r}; available: {list(repo_dir, source)}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Build the model via its entrypoint (reference hub.load)."""
+    d = _check_source(source, repo_dir)
+    mod = _load_hubconf(d)
+    if not hasattr(mod, model):
+        raise InvalidArgumentError(
+            f"no entrypoint {model!r}; available: {list(repo_dir, source)}")
+    return getattr(mod, model)(**kwargs)

@@ -155,6 +155,12 @@ class Config:
     def set_optim_cache_dir(self, d: str) -> None:
         self._extra["optim_cache_dir"] = d
 
+    def set_cipher_key(self, key: bytes) -> None:
+        """Deploy encrypted artifacts (reference paddle_crypto +
+        AnalysisConfig::SetModelBuffer): the Predictor decrypts
+        .pdmodel/.pdiparams written by framework.crypto.Cipher."""
+        self._extra["cipher_key"] = key
+
     def switch_use_feed_fetch_ops(self, flag: bool = False) -> None:
         self._extra["use_feed_fetch_ops"] = bool(flag)
 
@@ -226,8 +232,37 @@ class Predictor:
             except Exception:
                 pass
 
-        from ..jit import load as jit_load
-        self._layer = jit_load(base)
+        key = config._extra.get("cipher_key")
+        from ..framework.crypto import is_encrypted
+        pfile = config.params_file_path()
+        enc_prog, enc_params = is_encrypted(prog), is_encrypted(pfile)
+        if (enc_prog or enc_params) and key is None:
+            raise ValueError(
+                "model artifact is encrypted; call Config.set_cipher_key()")
+        if key is not None and (enc_prog or enc_params):
+            # decrypt IN MEMORY (each file independently — either half may
+            # be plaintext): no plaintext ever touches disk, matching the
+            # reference's SetModelBuffer threat model
+            import pickle
+            from jax import export as jexport
+            from ..framework.crypto import Cipher
+            from ..framework.io import _unpack
+            from ..jit import TranslatedLayer
+            cipher = Cipher(key)
+            with open(prog, "rb") as f:
+                mbytes = f.read()
+            if enc_prog:
+                mbytes = cipher.decrypt(mbytes)
+            with open(pfile, "rb") as f:
+                pbytes = f.read()
+            if enc_params:
+                pbytes = cipher.decrypt(pbytes)
+            exported = jexport.deserialize(mbytes)
+            params = _unpack(pickle.loads(pbytes), return_numpy=True)
+            self._layer = TranslatedLayer(exported, params)
+        else:
+            from ..jit import load as jit_load
+            self._layer = jit_load(base)
 
         meta_path = base + ".pdconfig"
         if os.path.exists(meta_path):

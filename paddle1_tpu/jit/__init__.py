@@ -183,6 +183,13 @@ class StaticFunction:
         arr_args = _tree_map_tensors(args, lambda t: t.data)
         return self._jitted.lower(params, key, arr_args, {}, training=False)
 
+    def program_text(self, *args) -> str:
+        """The traced program as StableHLO MLIR text — the program
+        INSPECTION surface (reference: printing the ProgramDesc /
+        main_program of a to_static function). Transformation stays
+        XLA's job; inspection is the part users actually need."""
+        return self.lower(*args).as_text()
+
 
 def _count(tree) -> int:
     out = []
@@ -338,6 +345,11 @@ class TranslatedLayer(Layer):
         params = {p.name: p.data for p in self.parameters()}
         out = self._exported.call(params, *arrs)
         return _tree_map_tensors_from_arrays(out)
+
+    def program(self) -> str:
+        """Deserialized program as StableHLO MLIR text (reference: a
+        loaded inference program's desc is inspectable)."""
+        return str(self._exported.mlir_module())
 
 
 def _tree_map_tensors_from_arrays(obj):
