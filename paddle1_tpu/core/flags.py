@@ -159,6 +159,9 @@ def _define_builtin_flags() -> None:
                 "Pallas fused Adam/AdamW update: auto (TPU only), always, "
                 "never.",
                 validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("fused_softmax", "auto",
+                "Pallas fused softmax: auto (TPU only), always, never.",
+                validator=lambda v: v in ("auto", "always", "never"))
 
 
 _define_builtin_flags()

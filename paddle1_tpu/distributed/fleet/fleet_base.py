@@ -119,8 +119,12 @@ class Fleet:
         self._ensure_init()
         self._role_maker._barrier()
 
-    # PS-mode entry points: accepted for API parity; the brpc parameter
-    # server has no ICI analog (SURVEY §7 hard part f)
+    # PS-mode entry points: the brpc parameter server has no ICI analog
+    # (SURVEY §7 hard part f); its scoped replacement for the sparse
+    # workload is distributed.ps.EmbeddingService + fleet.MultiTrainer.
+    # init_worker/init_server stay callable (scripts call them before the
+    # strategy decides the mode) but a PS-only training entry raises
+    # loudly instead of silently no-op'ing.
     def init_worker(self):
         self._ensure_init()
 
@@ -129,8 +133,11 @@ class Fleet:
 
     def run_server(self):
         raise PreconditionNotMetError(
-            "Parameter-server mode is not available in the TPU build; "
-            "use collective (is_collective=True) training")
+            "Parameter-server mode has no TPU analog. For the sparse "
+            "embedding workload use paddle1_tpu.distributed."
+            "EmbeddingService (host-RAM sharded tables) with "
+            "fleet.MultiTrainer (Hogwild workers); for dense training "
+            "use collective mode (is_collective=True)")
 
     def stop_worker(self):
         pass

@@ -20,22 +20,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import block_rows as _block_rows, interpret as _interpret
+
 __all__ = ["fused_layer_norm", "supported"]
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-_VMEM_BUDGET = 4 * 1024 * 1024  # x block + y block (f32) must fit
-
-
-def _block_rows(rows: int, h: int) -> int:
-    for br in (256, 128, 64, 32, 16, 8):
-        # the actual VMEM block is [br, h] twice (input + output, f32)
-        if rows % br == 0 and br * h * 4 * 2 <= _VMEM_BUDGET:
-            return br
-    return 0
 
 
 def supported(shape, n_norm_axes: int) -> bool:

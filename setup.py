@@ -1,0 +1,30 @@
+"""Packaging shim + native-library build.
+
+The native host runtime (paddle1_tpu/core/native/src/native.cc) and the C
+inference ABI (capi.cc) normally build lazily on first import; `pip
+install .` pre-builds them here so deployment images need no compiler.
+Both remain optional: every consumer has a Python fallback.
+"""
+
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        super().run()
+        try:
+            subprocess.run(
+                [sys.executable, "-c",
+                 "from paddle1_tpu.core import native;"
+                 "assert native.available();"
+                 "native.build_capi()"],
+                check=False, timeout=300)
+        except Exception:
+            pass  # lazy build on first import remains the fallback
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -233,6 +233,61 @@ class TestFusedLayerNorm:
         np.testing.assert_allclose(np.asarray(y.data).mean(), 0.0, atol=1e-5)
 
 
+class TestFusedSoftmax:
+    @pytest.mark.parametrize("shape", [(16, 128), (2, 8, 256)])
+    def test_matches_jax(self, shape):
+        from paddle1_tpu.ops.pallas import softmax as psm
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 4)
+        assert psm.supported(shape, -1)
+        y = psm.fused_softmax(x)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(jax.nn.softmax(x, axis=-1)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grads_match(self):
+        from paddle1_tpu.ops.pallas import softmax as psm
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((8, 128)).astype(np.float32))
+        gf = jax.grad(lambda a: jnp.sum(psm.fused_softmax(a) ** 2))(x)
+        gr = jax.grad(lambda a: jnp.sum(jax.nn.softmax(a, -1) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_functional_routes(self):
+        from paddle1_tpu.ops.pallas import softmax as psm
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.random.default_rng(2).standard_normal(
+            (16, 128)).astype(np.float32)
+        called = {}
+        orig = psm.fused_softmax
+
+        def spy(*a, **kw):
+            called["yes"] = True
+            return orig(*a, **kw)
+
+        psm.fused_softmax = spy
+        try:
+            with flags_guard({"fused_softmax": "always"}):
+                y = F.softmax(to_tensor(x))
+        finally:
+            psm.fused_softmax = orig
+        assert called.get("yes")
+        np.testing.assert_allclose(np.asarray(y.data).sum(-1), 1.0,
+                                   rtol=1e-5)
+
+    def test_non_last_axis_falls_back(self):
+        from paddle1_tpu.nn import functional as F
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.random.default_rng(3).standard_normal(
+            (16, 128)).astype(np.float32)
+        with flags_guard({"fused_softmax": "always"}):
+            y = F.softmax(to_tensor(x), axis=0)   # not kernel-shaped
+        np.testing.assert_allclose(np.asarray(y.data).sum(0), 1.0,
+                                   rtol=1e-5)
+
+
 class TestFusedAdam:
     def test_matches_plain_adamw(self):
         from paddle1_tpu.ops.pallas import fused_adam as fadam
