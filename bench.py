@@ -77,8 +77,21 @@ def _emit(metric, value, unit, vs_baseline, detail):
                       "detail": detail}))
 
 
-def _assert_sane_mfu(mfu, detail):
+def _assert_sane_mfu(mfu, detail, step_fn=None):
     if mfu > 1.0:
+        if step_fn is not None:
+            # capture a device trace of one step so the violation can be
+            # root-caused offline (VERDICT r2: the r02 463% MFU could not
+            # be diagnosed because no trace existed)
+            try:
+                import jax
+                import tempfile
+                trace_dir = tempfile.mkdtemp(prefix="p1t_bench_trace_")
+                with jax.profiler.trace(trace_dir):
+                    jax.block_until_ready(step_fn())
+                detail = dict(detail, profiler_trace=trace_dir)
+            except Exception as e:  # the assert must still fire
+                detail = dict(detail, profiler_trace_error=str(e))
         raise AssertionError(
             f"IMPOSSIBLE MFU {mfu:.3f} (>100%) — timing or peak-FLOPs "
             f"accounting is broken; diagnostics: {json.dumps(detail)}")
@@ -145,7 +158,8 @@ def bench_bert_base(on_tpu):
               "peak_flops": _peak_flops(dev),
               "device": getattr(dev, "device_kind", dev.platform),
               "loss": float(loss)}
-    _assert_sane_mfu(mfu, detail)
+    _assert_sane_mfu(mfu, detail,
+                     step_fn=lambda: engine.step(b))
     _emit("bert_base_pretrain_samples_per_sec_per_chip", sps, "samples/s",
           mfu / 0.40, detail)
 

@@ -116,7 +116,8 @@ def bench_resnet50_dp(on_tpu):
               "step_ms_median": round(dt * 1e3, 2), "mfu": round(mfu, 4),
               "amp": "bfloat16" if on_tpu else "none",
               "loss": float(loss)}
-    _assert_sane_mfu(mfu, detail)
+    _assert_sane_mfu(mfu, detail,
+                     step_fn=lambda: engine.step(b))
     _emit("resnet50_dp_samples_per_sec", batch / dt, "samples/s",
           mfu / 0.40, detail)
 
@@ -186,7 +187,8 @@ def bench_ernie_sharded(on_tpu):
               "params": n_params, "devices": n, "zero_stage": 2,
               "step_ms_median": round(dt * 1e3, 2), "mfu": round(mfu, 4),
               "proxy": layers != 24, "loss": float(loss)}
-    _assert_sane_mfu(mfu, detail)
+    _assert_sane_mfu(mfu, detail,
+                     step_fn=lambda: engine.step(b))
     _emit("ernie_1p5b_zero2_samples_per_sec", batch / dt, "samples/s",
           mfu / 0.40, detail)
 

@@ -261,7 +261,11 @@ class ParallelEngine:
 
     def shard_batch(self, batch):
         """Host batch → device arrays sharded batch-dim over (dp, sharding)."""
-        arrs = _as_arrays(batch)
+        multi = jax.process_count() > 1
+        # multi-host: keep leaves on HOST — make_array_from_process_local_data
+        # consumes numpy directly; converting to device first would buy a
+        # device→host→device round-trip per leaf per step
+        arrs = batch if multi else _as_arrays(batch)
         spec = self.batch_spec
 
         def place(a):
@@ -272,8 +276,19 @@ class ParallelEngine:
             if self.grad_accum > 1:
                 axes = [None] + axes  # leading dim = accumulation steps
             ndim_spec = P(*(axes + [None] * (a.ndim - len(axes))))
-            return jax.device_put(a, NamedSharding(self.mesh, ndim_spec))
-        return jax.tree_util.tree_map(place, arrs)
+            sh = NamedSharding(self.mesh, ndim_spec)
+            if multi:
+                # multi-host: each process feeds its LOCAL batch shard;
+                # assemble the global array over the coordination service
+                # (reference: each trainer feeds its own data partition)
+                a = a.data if isinstance(a, Tensor) else a
+                return jax.make_array_from_process_local_data(
+                    sh, np.asarray(a))
+            return jax.device_put(a, sh)
+        return jax.tree_util.tree_map(
+            place, arrs,
+            is_leaf=lambda x: isinstance(x, Tensor)) if multi else \
+            jax.tree_util.tree_map(place, arrs)
 
     # -- training -----------------------------------------------------------
 
