@@ -4,12 +4,21 @@ north-star MFU target; the reference publishes no numeric baseline —
 BASELINE.md).
 
 Honesty contract (VERDICT r2: the r02 run claimed a physically impossible
-463% MFU):
-* per-step ``block_until_ready`` timing — every step is individually
-  synchronized, so dispatch pipelining cannot inflate throughput;
+463% MFU — root-caused in r3: the axon tunnel's ``block_until_ready``
+acknowledges while the remote execution is still in flight, so any
+blocking-based timing is fiction; a 20-deep 8192^3 bf16 matmul chain
+"completed" in 0.06 ms = 346 PFLOP/s. The same chain ending in a host
+readback measured 111-141 TFLOP/s — 57-72% of v5e peak, i.e. physical):
+* slope timing with a host readback barrier — wall-time a window of k
+  chained steps ending in a device->host fetch of the result, at two
+  window sizes; per-step cost = (T_hi - T_lo)/(hi - lo). The readback and
+  the tunnel's fixed ~70 ms round-trip appear in both windows and cancel,
+  and the params dependency chain serializes the steps on device, so the
+  slope can be neither inflated by async dispatch nor deflated by
+  pipelining;
 * ``mfu <= 1.0`` hard assert with a loud diagnostic dump on violation;
-* the median step time is reported (warmup + first-step recompiles do not
-  leak into the number);
+* the median slope across 3 trials is reported (warmup + recompiles are
+  flushed through a readback before timing starts);
 * bf16 autocast (the intended config-3 arithmetic) with f32 masters.
 
 Other configs (BASELINE.md 1/2/4/5) run via ``--config``; the driver's
@@ -57,18 +66,43 @@ def _probe_tpu(timeout_s: int = 180) -> bool:
         return False
 
 
-def _timed_steps(step_fn, n_steps):
-    """Run n_steps with per-step blocking; returns (per-step seconds, last
-    loss). Blocking each step is the honest protocol: async dispatch can
-    otherwise overlap host loops with device work and overstate speed."""
+def _read_back(x):
+    """Fetch a result to host memory — the only reliable completion barrier
+    through the axon tunnel, whose ``block_until_ready`` can acknowledge
+    while the remote execution is still in flight (measured: 346 PFLOP/s
+    "sustained" without readback vs 111-141 TFLOP/s with it)."""
     import jax
-    times, loss = [], None
-    for _ in range(n_steps):
+    for leaf in jax.tree_util.tree_leaves(x.data if hasattr(x, "data")
+                                          else x):
+        np.asarray(jax.device_get(leaf))
+
+
+def _timed_steps(step_fn, n_steps):
+    """Slope-timed stepping; returns (per-step-seconds estimates, last
+    result).
+
+    Wall-times a window of k chained steps ending in a host readback, for
+    k = lo and k = n_steps, three trials; each trial contributes the slope
+    (T_hi - T_lo)/(hi - lo). The readback cost and the tunnel's fixed
+    round-trip latency are identical in both windows and cancel; the
+    dependency chain through the updated params serializes the steps on
+    device, so the slope is the true per-step cost."""
+    n_steps = max(2, n_steps)  # the slope needs two distinct window sizes
+    lo = max(1, n_steps // 4)
+    slopes, out = [], None
+    for _ in range(3):
         t0 = time.perf_counter()
-        loss = step_fn()
-        jax.block_until_ready(loss.data if hasattr(loss, "data") else loss)
-        times.append(time.perf_counter() - t0)
-    return times, loss
+        for _ in range(lo):
+            out = step_fn()
+        _read_back(out)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = step_fn()
+        _read_back(out)
+        t_hi = time.perf_counter() - t0
+        slopes.append(max((t_hi - t_lo) / (n_steps - lo), 1e-9))
+    return slopes, out
 
 
 def _emit(metric, value, unit, vs_baseline, detail):
@@ -88,7 +122,7 @@ def _assert_sane_mfu(mfu, detail, step_fn=None):
                 import tempfile
                 trace_dir = tempfile.mkdtemp(prefix="p1t_bench_trace_")
                 with jax.profiler.trace(trace_dir):
-                    jax.block_until_ready(step_fn())
+                    _read_back(step_fn())
                 detail = dict(detail, profiler_trace=trace_dir)
             except Exception as e:  # the assert must still fire
                 detail = dict(detail, profiler_trace_error=str(e))
@@ -128,8 +162,7 @@ def bench_bert_base(on_tpu):
          "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
          "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)}
 
-    engine.step(b)  # warmup (compile)
-    jax.block_until_ready(engine.params)
+    _read_back(engine.step(b))  # warmup (compile) flushed to completion
 
     n_steps = 20 if on_tpu else 3
     times, loss = _timed_steps(lambda: engine.step(b), n_steps)
@@ -151,9 +184,10 @@ def bench_bert_base(on_tpu):
     mfu = (flops_per_step / dt) / _peak_flops(dev)
     detail = {"batch": batch, "seq_len": seq, "steps": n_steps,
               "params": n_params, "mfu": round(mfu, 4),
-              "step_ms_median": round(dt * 1e3, 2),
+              "step_ms_median": round(dt * 1e3, 2),   # median slope, 3 trials
               "step_ms_min": round(min(times) * 1e3, 2),
               "step_ms_max": round(max(times) * 1e3, 2),
+              "timing": "slope+readback",
               "amp": "bfloat16" if on_tpu else "none",
               "peak_flops": _peak_flops(dev),
               "device": getattr(dev, "device_kind", dev.platform),

@@ -2,9 +2,11 @@
 ``bench.py --config <name>``; config 3 (bert_base, the driver default)
 lives in bench.py itself.
 
-Every config follows bench.py's honesty contract: per-step
-``block_until_ready`` timing, median step time, and ``mfu <= 1.0``
-asserts wherever an MFU is computed. The reference publishes no numeric
+Every config follows bench.py's honesty contract: slope timing with a
+host-readback barrier (the axon tunnel's ``block_until_ready`` can
+acknowledge before remote execution completes — see bench.py), median
+slope across trials, and ``mfu <= 1.0`` asserts wherever an MFU is
+computed. The reference publishes no numeric
 baselines (BASELINE.md), so ``vs_baseline`` is MFU/0.40 where an MFU
 target applies and 1.0 (self-referential) for the throughput-only
 configs.
@@ -16,7 +18,8 @@ config file picks the op; here --config picks the model-level workload).
 
 import numpy as np
 
-from bench import _assert_sane_mfu, _emit, _peak_flops, _timed_steps
+from bench import (_assert_sane_mfu, _emit, _peak_flops, _read_back,
+                   _timed_steps)
 
 CONFIGS = {}
 
@@ -61,12 +64,13 @@ def bench_mnist_lenet(on_tpu):
         opt.clear_grad()
         return loss
 
-    step()  # warmup
-    times, loss = _timed_steps(step, 20 if on_tpu else 3)
+    _read_back(step())  # warmup, flushed to completion
+    n_steps = 20 if on_tpu else 3
+    times, loss = _timed_steps(step, n_steps)
     import statistics
     dt = statistics.median(times)
     _emit("mnist_lenet_eager_samples_per_sec", batch / dt, "samples/s", 1.0,
-          {"batch": batch, "steps": len(times),
+          {"batch": batch, "steps": n_steps,
            "step_ms_median": round(dt * 1e3, 2),
            "loss": float(loss.numpy()), "mode": "eager"})
 
@@ -104,8 +108,7 @@ def bench_resnet50_dp(on_tpu):
     b = {"x": rng.standard_normal((batch, 3, img, img)).astype(np.float32),
          "y": rng.integers(0, 1000, (batch,)).astype(np.int64)}
 
-    engine.step(b)  # compile
-    jax.block_until_ready(engine.params)
+    _read_back(engine.step(b))  # compile, flushed to completion
     times, loss = _timed_steps(lambda: engine.step(b), 10 if on_tpu else 3)
     dt = statistics.median(times)
 
@@ -174,8 +177,7 @@ def bench_ernie_sharded(on_tpu):
          "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
          "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)}
 
-    engine.step(b)
-    jax.block_until_ready(engine.params)
+    _read_back(engine.step(b))  # compile, flushed to completion
     times, loss = _timed_steps(lambda: engine.step(b), 10 if on_tpu else 2)
     dt = statistics.median(times)
 
@@ -221,10 +223,9 @@ def bench_yolov3_infer(on_tpu):
         with ag.no_grad(), model.load_functional_state(params):
             return [o.data for o in model(Tensor(x))]
 
-    outs = fwd(params, jnp.asarray(x))
-    jax.block_until_ready(outs)
-    times, _ = _timed_steps(lambda: fwd(params, jnp.asarray(x)),
-                            20 if on_tpu else 3)
+    _read_back(fwd(params, jnp.asarray(x)))  # compile, flushed
+    times, outs = _timed_steps(lambda: fwd(params, jnp.asarray(x)),
+                               20 if on_tpu else 3)
     dt = statistics.median(times)
 
     img_size = np.tile([[img, img]], (batch, 1)).astype(np.int32)  # [B,2]

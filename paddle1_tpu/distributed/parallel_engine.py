@@ -265,7 +265,12 @@ class ParallelEngine:
         # multi-host: keep leaves on HOST — make_array_from_process_local_data
         # consumes numpy directly; converting to device first would buy a
         # device→host→device round-trip per leaf per step
-        arrs = batch if multi else _as_arrays(batch)
+        # multi-host leaves stay numpy (host RAM); single-host leaves go
+        # through _as_arrays as before
+        arrs = jax.tree_util.tree_map(
+            lambda x: np.asarray(x.data if isinstance(x, Tensor) else x),
+            batch, is_leaf=lambda x: isinstance(x, Tensor)) \
+            if multi else _as_arrays(batch)
         spec = self.batch_spec
 
         def place(a):
@@ -275,20 +280,18 @@ class ParallelEngine:
             axes = list(s)
             if self.grad_accum > 1:
                 axes = [None] + axes  # leading dim = accumulation steps
+            # leaves with fewer dims than the spec (scalars: loss weights,
+            # step counters) are replicated, not batch-sharded
+            axes = axes[:a.ndim]
             ndim_spec = P(*(axes + [None] * (a.ndim - len(axes))))
             sh = NamedSharding(self.mesh, ndim_spec)
             if multi:
                 # multi-host: each process feeds its LOCAL batch shard;
                 # assemble the global array over the coordination service
                 # (reference: each trainer feeds its own data partition)
-                a = a.data if isinstance(a, Tensor) else a
-                return jax.make_array_from_process_local_data(
-                    sh, np.asarray(a))
+                return jax.make_array_from_process_local_data(sh, a)
             return jax.device_put(a, sh)
-        return jax.tree_util.tree_map(
-            place, arrs,
-            is_leaf=lambda x: isinstance(x, Tensor)) if multi else \
-            jax.tree_util.tree_map(place, arrs)
+        return jax.tree_util.tree_map(place, arrs)
 
     # -- training -----------------------------------------------------------
 

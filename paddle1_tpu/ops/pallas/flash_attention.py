@@ -22,6 +22,8 @@ from jax.experimental import pallas as pl
 
 BLOCK_Q = 128
 BLOCK_K = 128
+_LANES = 128  # Mosaic minor-dim tile: scalar-per-row outputs are stored
+              # broadcast across one 128-lane register row
 _NEG_INF = -1e30
 
 
@@ -109,8 +111,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = jnp.where(visible[:, None], acc / l_safe[:, None], 0.0)
     o_ref[:] = out.astype(o_ref.dtype)
-    lse_ref[:] = jnp.where(visible, m + jnp.log(l_safe),
-                           _NEG_INF).astype(jnp.float32)
+    # [BLOCK_Q] → [BLOCK_Q, _LANES]: Mosaic requires the last two block dims
+    # tile to (8, 128), so the per-row LSE is broadcast across one lane row
+    # (same layout as jax's own TPU flash kernel's l/m outputs)
+    lse = jnp.where(visible, m + jnp.log(l_safe), _NEG_INF)
+    lse_ref[:] = jax.lax.broadcast_in_dim(
+        lse.astype(jnp.float32), (BLOCK_Q, _LANES), (0,))
 
 
 def _flash_fwd(q, k, v, scale, causal, padding_mask=None):
@@ -146,16 +152,16 @@ def _flash_fwd(q, k, v, scale, causal, padding_mask=None):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, BLOCK_Q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((None, BLOCK_Q, _LANES), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, nq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, nq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, nq, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(*args)
     out = out.reshape(b, h, nq, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, nq)
+    lse = lse[:, :, 0].reshape(b, h, nq)
     return out, lse
 
 
