@@ -101,7 +101,14 @@ def _timed_steps(step_fn, n_steps):
             out = step_fn()
         _read_back(out)
         t_hi = time.perf_counter() - t0
-        slopes.append(max((t_hi - t_lo) / (n_steps - lo), 1e-9))
+        if t_hi > t_lo:
+            slopes.append((t_hi - t_lo) / (n_steps - lo))
+        # else: noise made the long window "faster" — reject the trial
+        # rather than fabricate a number (honesty contract)
+    if not slopes:
+        raise AssertionError(
+            "slope timing rejected all trials (t_hi <= t_lo every time): "
+            "host too noisy for these window sizes — raise n_steps")
     return slopes, out
 
 

@@ -103,8 +103,8 @@ def set_flags(flags: Dict[str, Any]) -> None:
 class flags_guard:
     """Context manager that temporarily overrides flags (test helper)."""
 
-    def __init__(self, overrides: Dict[str, Any]):
-        self._overrides = overrides
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None, **kw):
+        self._overrides = {**(overrides or {}), **kw}
         self._saved: Dict[str, Any] = {}
 
     def __enter__(self):
@@ -147,6 +147,12 @@ def _define_builtin_flags() -> None:
     # JIT
     define_flag("jit_donate_params", True,
                 "Donate parameter buffers in compiled training steps.")
+    define_flag("dy2static", True,
+                "Rewrite tensor-dependent Python control flow (if/while/"
+                "for-range, and/or/not) into lax.cond/while_loop under "
+                "jit.to_static (reference ProgramTranslator.enable analog)."
+                " Read at DECORATION time: set it before @to_static runs "
+                "(module import), not per call.")
     # Fused kernels (reference operators/fused/ role)
     define_flag("flash_attention", "auto",
                 "Pallas flash attention: auto (TPU only), always "

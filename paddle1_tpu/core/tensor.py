@@ -43,7 +43,7 @@ def _as_array(data, dtype=None) -> jax.Array:
         if dtype is not None:
             arr = arr.astype(dtypes.convert_dtype(dtype))
         return arr
-    if isinstance(data, np.ndarray):
+    if isinstance(data, (np.ndarray, np.generic)):
         if dtype is None and data.dtype == np.float64:
             dtype = dtypes.get_default_dtype()  # numpy float64 → default f32
         return jnp.asarray(data, dtype=dtypes.convert_dtype(dtype) if dtype else None)

@@ -163,8 +163,10 @@ class Fleet:
         if hcg.get_model_parallel_world_size() > 1:
             from ..meta_parallel.model_parallel import ModelParallel
             return ModelParallel(model, hcg, self._strategy)
+        fp16_comm = bool(self._strategy and self._strategy.fp16_allreduce)
         return DataParallel(model,
-                            group=hcg.get_data_parallel_group())
+                            group=hcg.get_data_parallel_group(),
+                            comm_dtype="bfloat16" if fp16_comm else None)
 
     def distributed_optimizer(self, optimizer,
                               strategy: Optional[DistributedStrategy] = None):

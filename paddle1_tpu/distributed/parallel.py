@@ -22,6 +22,7 @@ import os
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from ..core.errors import PreconditionNotMetError
 from ..core.tensor import Tensor
@@ -121,12 +122,17 @@ class DataParallel(Layer):
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int
                  = 25, last_comm_buffer_size: int = 1,
-                 find_unused_parameters: bool = False, group=None):
+                 find_unused_parameters: bool = False, group=None,
+                 comm_dtype=None):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self._group = group
         self._grad_sync_enabled = True
+        # fp16_allreduce analog (reference fp16_allreduce_optimizer.py):
+        # cast the gradient to a narrow dtype for the mean-reduce, cast
+        # back after — halves grad-comm bytes on the wire
+        self._comm_dtype = jnp.dtype(comm_dtype) if comm_dtype else None
         for p in layers.parameters():
             p.is_distributed = True
         # grad-sync hooks: fire during backward, psum-mean over dp axis when
@@ -148,8 +154,13 @@ class DataParallel(Layer):
             import jax.core as jcore
             from ..autograd.engine import apply as _apply
 
+            cdt = self._comm_dtype
+
             def f(g):
                 if isinstance(g, jcore.Tracer):
+                    if cdt is not None and jnp.issubdtype(g.dtype,
+                                                          jnp.floating):
+                        return lax.pmean(g.astype(cdt), axis).astype(g.dtype)
                     return lax.pmean(g, axis)
                 return g
             return _apply("dp_grad_sync", f, (grad,))

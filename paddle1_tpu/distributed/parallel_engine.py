@@ -74,6 +74,14 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
             params = {k: (v.astype(cdt)
                           if jnp.issubdtype(v.dtype, jnp.floating) else v)
                       for k, v in params.items()}
+            # feeds too (reference pure-fp16 casts the feed vars as well,
+            # fp16_utils.py cast_model_to_fp16): f32 images x bf16 conv
+            # weights is a dtype error on TPU
+            batch = jax.tree_util.tree_map(
+                lambda a: a.astype(cdt)
+                if (hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)) else a,
+                batch)
         with autograd_engine.no_grad(), rng_scope(key):
             with layer.load_functional_state(params):
                 out = loss_fn(layer, batch)

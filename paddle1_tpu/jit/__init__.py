@@ -84,6 +84,13 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, input_spec=None, layer: Optional[Layer]
                  = None, donate_params: bool = False):
+        from ..core.flags import flag
+        if flag("dy2static") and not getattr(fn, "__not_to_static__", False):
+            # AST fallback: tensor-dependent if/while/for-range lower to
+            # lax.cond/while_loop instead of tripping the teaching error
+            # (reference dygraph_to_static; see jit/dy2static.py)
+            from . import dy2static
+            fn = dy2static.convert_control_flow(fn)
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec

@@ -1,0 +1,656 @@
+"""AST fallback for tensor-dependent Python control flow under to_static.
+
+Reference analog: the dygraph→static AST rewriters in
+python/paddle/fluid/dygraph/dygraph_to_static/ (ifelse_transformer.py,
+loop_transformer.py, logical_transformer.py, convert_operators.py —
+8.9k LoC). This build keeps the reference's *runtime-dispatch* design:
+each ``if``/``while``/``for range()`` statement is rewritten to call a
+converter that executes plain Python when the condition is concrete and
+lowers to ``lax.cond`` / ``lax.while_loop`` when it is a traced Tensor.
+
+TPU-first scoping (SURVEY §7): tracing already handles everything except
+value-dependent control flow, so ONLY control flow is rewritten — no
+name mangling of the rest of the function, no program-desc construction.
+Inside a to_static trace the tape is disabled (StaticFunction._pure runs
+under engine.no_grad()) and autodiff is JAX's own over the traced ops,
+so the converters may close over traced Tensors freely; lax.cond/
+while_loop closure conversion keeps gradients correct.
+
+Scope (documented limits, each guarded by a loud teaching error or a
+clean fallback to the untransformed statement):
+
+* ``if`` / ``while`` / ``for .. in range(..)`` whose body has no
+  ``return`` / ``break`` / ``continue`` / ``yield`` are converted;
+  statements that do early-exit are left as plain Python (correct for
+  concrete conditions; a traced condition there still raises the
+  teaching error from StaticFunction).
+* ``a and b`` / ``a or b`` / ``not a`` are rewritten to converters that
+  preserve Python value semantics (incl. short-circuit) for concrete
+  operands and compute ``logical_and/or/not`` for traced ones.
+* Conversion applies to the decorated function itself; helpers it calls
+  are not rewritten (use static.nn.cond there, or decorate them too).
+* Functions using ``global``/``nonlocal``, or whose source is
+  unavailable (REPL/exec/lambda), fall back to the original unchanged.
+* A ``while``/``for`` whose bound is CONCRETE unrolls under the trace
+  (plain Python), so it stays reverse-differentiable; a traced bound
+  lowers to ``lax.while_loop``, which XLA cannot reverse-differentiate —
+  value/inference paths work, `.backward()` through such a loop raises
+  JAX's while-autodiff error (same shape as the reference's
+  while_grad-unsupported cases; use a concrete bound or lax.scan-style
+  ops for trainable loops).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor
+
+__all__ = ["convert_control_flow", "convert_ifelse", "convert_while",
+           "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "range_test", "UNDEF"]
+
+
+class _Undef:
+    """Sentinel bound to names that MIGHT be assigned by a branch/loop but
+    are unbound at its entry (the reference's UndefinedVar,
+    dygraph_to_static/utils.py). Any USE of the sentinel raises the same
+    UnboundLocalError plain Python would have raised at that point, naming
+    the variable — it must not flow silently into downstream math."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str = "<var>"):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            f"local variable '{self.name}' referenced before assignment "
+            f"(it is only bound on a branch/loop path that did not run; "
+            f"dy2static preserved Python's unbound semantics)")
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+    # every common interaction surfaces the error at the use site
+    __bool__ = __len__ = __iter__ = __call__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __matmul__ = __rmatmul__ = __neg__ = __abs__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __getitem__ = __contains__ = __float__ = __int__ = _raise
+
+    def __getattr__(self, item):
+        self._raise()
+
+
+UNDEF = _Undef()
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _to_bool(x) -> bool:
+    return bool(_raw(x))
+
+
+def _wrap_like(template, value):
+    """Re-wrap a branch output as Tensor iff the user-side value was one."""
+    return Tensor(value) if isinstance(template, Tensor) else value
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (reference: dygraph_to_static/convert_operators.py)
+# ---------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str]):
+    """``if`` dispatch. true_fn/false_fn take the current values of
+    ``names`` (every name assigned in either branch; UNDEF when unbound)
+    and return their values at branch exit."""
+    if not _is_traced(pred):
+        return true_fn(*init) if _to_bool(pred) else false_fn(*init)
+
+    t_out, f_out = true_fn(*init), false_fn(*init)
+    for name, tv, fv in zip(names, t_out, f_out):
+        if isinstance(tv, _Undef) or isinstance(fv, _Undef):
+            branch = "false" if isinstance(fv, _Undef) else "true"
+            raise InvalidArgumentError(
+                f"to_static: `{name}` is assigned in only one branch of a "
+                f"Tensor-condition `if` (unbound in the {branch} branch). "
+                f"Both sides of a traced branch must produce it — "
+                f"initialize `{name}` before the `if`.")
+    flat_t = [_raw(v) for v in t_out]
+    flat_f = [_raw(v) for v in f_out]
+    try:
+        outs = jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
+                            lambda _: tuple(jnp.asarray(v) for v in flat_t),
+                            lambda _: tuple(jnp.asarray(v) for v in flat_f),
+                            0)
+    except TypeError as e:
+        raise InvalidArgumentError(
+            f"to_static: the branches of a Tensor-condition `if` produce "
+            f"mismatched shapes/dtypes for {list(names)} — a traced branch "
+            f"must yield the same structure on both sides. ({e})") from e
+    return tuple(_wrap_like(t, o) for t, o in zip(t_out, outs))
+
+
+def convert_while(test_fn, body_fn, init, names: Sequence[str]):
+    """``while`` dispatch. test_fn/body_fn take the values of ``names``
+    (every name assigned in the loop body); body_fn returns their values
+    at iteration exit."""
+    vals = tuple(init)
+    probe = test_fn(*vals)
+    if not _is_traced(probe):
+        # concrete bound: plain Python — under a trace this UNROLLS the
+        # loop (traced carries are fine), which also keeps reverse-mode
+        # autodiff working; XLA cannot reverse-differentiate a dynamic
+        # while_loop, so the unrolled form is strictly more capable here
+        while _to_bool(test_fn(*vals)):
+            vals = tuple(body_fn(*vals))
+        return vals
+
+    for name, v in zip(names, vals):
+        if isinstance(v, _Undef):
+            raise InvalidArgumentError(
+                f"to_static: `{name}` is assigned inside a Tensor-condition "
+                f"`while` but is unbound at loop entry. Loop-carried state "
+                f"must exist before the loop — initialize `{name}` first "
+                f"(e.g. `{name} = paddle.zeros(...)`).")
+
+    def c(flat):
+        out = test_fn(*(_wrap_like(t, v) for t, v in zip(vals, flat)))
+        return jnp.reshape(_raw(out), ()).astype(bool)
+
+    def b(flat):
+        outs = body_fn(*(_wrap_like(t, v) for t, v in zip(vals, flat)))
+        return tuple(jnp.asarray(_raw(o)) for o in outs)
+
+    flat0 = tuple(jnp.asarray(_raw(v)) for v in vals)
+    try:
+        outs = jax.lax.while_loop(c, b, flat0)
+    except TypeError as e:
+        raise InvalidArgumentError(
+            f"to_static: a Tensor-condition `while` changes the "
+            f"shape/dtype of its loop variables {list(names)} across "
+            f"iterations — carried state must keep a fixed structure. "
+            f"({e})") from e
+    return tuple(_wrap_like(t, o) for t, o in zip(vals, outs))
+
+
+def for_seed(it, stop, step, name):
+    """Pre-loop value for the USER's for-range variable. Concrete range:
+    the unbound sentinel (Python leaves the var unbound until the first
+    iteration). Traced range: lax.while_loop needs a uniform carry, so
+    seed with the counter's start — a dead value, the body assigns the
+    variable before any read."""
+    if _is_traced(it) or _is_traced(stop) or _is_traced(step):
+        return it
+    return _Undef(name)
+
+
+def range_test(i, stop, step):
+    """``for i in range(...)`` desugars to a while; the continuation test
+    depends on the sign of step (negative ranges count down)."""
+    if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+        import paddle1_tpu.ops.math_ops  # registers Tensor operators
+        return convert_logical_or(
+            convert_logical_and(step > 0, lambda: i < stop),
+            lambda: convert_logical_and(step < 0, lambda: i > stop))
+    return (i < stop) if step > 0 else (i > stop)
+
+
+def convert_logical_and(a, b_fn: Callable):
+    if _is_traced(a):
+        b = b_fn()
+        return _wrap_like(a if isinstance(a, Tensor) else b,
+                          jnp.logical_and(jnp.asarray(_raw(a), bool),
+                                          jnp.asarray(_raw(b), bool)))
+    return a if not _to_bool(a) else b_fn()  # python value semantics
+
+
+def convert_logical_or(a, b_fn: Callable):
+    if _is_traced(a):
+        b = b_fn()
+        return _wrap_like(a if isinstance(a, Tensor) else b,
+                          jnp.logical_or(jnp.asarray(_raw(a), bool),
+                                         jnp.asarray(_raw(b), bool)))
+    return a if _to_bool(a) else b_fn()
+
+
+def convert_logical_not(a):
+    if _is_traced(a):
+        return _wrap_like(a, jnp.logical_not(jnp.asarray(_raw(a), bool)))
+    return not _to_bool(a)
+
+
+# ---------------------------------------------------------------------------
+# AST rewrite (reference: ifelse/loop/logical transformers)
+# ---------------------------------------------------------------------------
+
+_H = "__p1t_dy2s"  # namespace prefix for injected helpers/temporaries
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list, excluding nested function/class
+    scopes (their locals do not escape) and comprehension targets (own
+    scope in py3)."""
+
+    def __init__(self):
+        self.names = []
+        self.def_names = []
+
+    def _add(self, target):
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                if node.id not in self.names:
+                    self.names.append(node.id)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._add(node.optional_vars)
+
+    def visit_NamedExpr(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if node.name not in self.names:
+            self.names.append(node.name)
+        if node.name not in self.def_names:
+            self.def_names.append(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        if node.name not in self.names:
+            self.names.append(node.name)
+        if node.name not in self.def_names:
+            self.def_names.append(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ListComp(self, node):
+        pass
+
+    visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
+
+def _assigned(stmts) -> list:
+    """Names bound by stmts, minus the converter's injected helper
+    FUNCTIONS (nested conversions create ``__p1t_dy2s_true_*`` defs that
+    must not become branch outputs). Injected value temps (for-range
+    counters) DO count — they are genuine loop-carried state."""
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    helper_defs = {n for n in v.def_names if n.startswith(_H)}
+    return [n for n in v.names if n not in helper_defs]
+
+
+def _defines_scope(stmts) -> bool:
+    """True when stmts bind a user function/class (its object cannot flow
+    through lax.cond/while_loop, and hiding it inside the branch closure
+    would change plain-Python visibility) — such statements stay Python."""
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return any(not n.startswith(_H) for n in v.def_names)
+
+
+def _walk_scope(node):
+    """ast.walk that does not descend into nested function/class scopes
+    (their return/break/continue belong to the inner scope)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue  # inner scope: its return/break/yield are not ours
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has_early_exit(stmts) -> bool:
+    """return/break/continue/yield in THIS scope makes a statement
+    non-convertible (nested defs' returns don't count)."""
+    for s in stmts:
+        for node in _walk_scope(s):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue,
+                                 ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _prebind(names):
+    """``try: x\nexcept ...: x = UNDEF`` for each name — marks
+    maybe-unbound names so the converters can diagnose them."""
+    out = []
+    for n in names:
+        out.append(ast.Try(
+            body=[ast.Expr(value=_load(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_load("NameError"),
+                                     _load("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_store(n)],
+                    value=ast.Call(func=_load(f"{_H}_undef"),
+                                   args=[ast.Constant(value=n)],
+                                   keywords=[]))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+def _str_list(names):
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    # -- expressions --------------------------------------------------------
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = (f"{_H}_and" if isinstance(node.op, ast.And) else f"{_H}_or")
+        # fold left-to-right, each RHS deferred in a lambda (short-circuit)
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=_load(conv),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                       kwonlyargs=[], kw_defaults=[],
+                                       kwarg=None, defaults=[]),
+                    body=rhs)],
+                keywords=[])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(func=_load(f"{_H}_not"), args=[node.operand],
+                         keywords=[]), node)
+        return node
+
+    # -- nested scopes are not transformed ----------------------------------
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    # -- statements ---------------------------------------------------------
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_early_exit(node.body) or _has_early_exit(node.orelse):
+            return node
+        if _defines_scope(node.body + node.orelse):
+            return node
+        names = _assigned(node.body + node.orelse)
+        if not names:
+            # pure side-effect branches (e.g. list.append) — cannot be
+            # expressed as a value-flow cond; leave to plain Python
+            return node
+        self.counter += 1
+        i = self.counter
+        t_name, f_name = f"{_H}_true_{i}", f"{_H}_false_{i}"
+        # current values flow IN as parameters: a branch that reads a name
+        # it also assigns would otherwise hit UnboundLocalError (the name
+        # becomes branch-local), and an empty branch returns the incoming
+        # value unchanged
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n, annotation=None) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        ret = ast.Return(value=_names_tuple(names, ast.Load))
+
+        def mk(fname, body):
+            return ast.FunctionDef(
+                name=fname, args=params,
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[], returns=None, type_params=[])
+
+        call = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(func=_load(f"{_H}_ifelse"),
+                           args=[node.test, _load(t_name), _load(f_name),
+                                 _names_tuple(names, ast.Load),
+                                 _str_list(names)],
+                           keywords=[]))
+        out = (_prebind(names) +
+               [mk(t_name, node.body), mk(f_name, node.orelse), call])
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_early_exit(node.body) or node.orelse:
+            return node
+        if _defines_scope(node.body):
+            return node
+        names = _assigned(node.body)
+        if not names:
+            return node
+        self.counter += 1
+        i = self.counter
+        t_name, b_name = f"{_H}_test_{i}", f"{_H}_body_{i}"
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n, annotation=None) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        test_fn = ast.FunctionDef(
+            name=t_name, args=params,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        body_fn = ast.FunctionDef(
+            name=b_name, args=params,
+            body=node.body + [ast.Return(value=_names_tuple(names,
+                                                            ast.Load))],
+            decorator_list=[], returns=None, type_params=[])
+        call = ast.Assign(
+            targets=[_names_tuple(names, ast.Store)],
+            value=ast.Call(func=_load(f"{_H}_while"),
+                           args=[_load(t_name), _load(b_name),
+                                 _names_tuple(names, ast.Load),
+                                 _str_list(names)],
+                           keywords=[]))
+        out = _prebind(names) + [test_fn, body_fn, call]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    def visit_For(self, node):
+        """``for <name> in range(...)`` → an equivalent while, which then
+        converts via visit_While. Other iterables stay plain Python."""
+        if (not isinstance(node.target, ast.Name)
+                or node.orelse
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3
+                or any(isinstance(a, ast.Starred) for a in node.iter.args)
+                or _has_early_exit(node.body)):
+            self.generic_visit(node)
+            return node
+        a = node.iter.args
+        start = a[0] if len(a) > 1 else ast.Constant(value=0)
+        stop = a[0] if len(a) == 1 else a[1]
+        step = a[2] if len(a) > 2 else ast.Constant(value=1)
+        self.counter += 1
+        i_var = node.target.id
+        # the running counter is an internal temp; the USER's loop variable
+        # is assigned at the top of each iteration, so after the loop it
+        # holds the last executed value (Python semantics: not one-past),
+        # and stays unbound when the range is empty
+        it_var = f"{_H}_it_{self.counter}"
+        stop_var = f"{_H}_stop_{self.counter}"
+        step_var = f"{_H}_step_{self.counter}"
+        init = [
+            ast.Assign(targets=[_store(it_var)], value=start),
+            ast.Assign(targets=[_store(stop_var)], value=stop),
+            ast.Assign(targets=[_store(step_var)], value=step),
+            ast.Assign(targets=[_store(i_var)],
+                       value=ast.Call(func=_load(f"{_H}_for_seed"),
+                                      args=[_load(it_var), _load(stop_var),
+                                            _load(step_var),
+                                            ast.Constant(value=i_var)],
+                                      keywords=[])),
+        ]
+        test = ast.Call(func=_load(f"{_H}_range_test"),
+                        args=[_load(it_var), _load(stop_var),
+                              _load(step_var)],
+                        keywords=[])
+        enter = ast.Assign(targets=[_store(i_var)], value=_load(it_var))
+        bump = ast.AugAssign(target=_store(it_var), op=ast.Add(),
+                             value=_load(step_var))
+        loop = ast.While(test=test, body=[enter] + node.body + [bump],
+                         orelse=[])
+        out = []
+        for s in init:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+            out.append(s)
+        ast.copy_location(loop, node)
+        ast.fix_missing_locations(loop)
+        converted = self.visit_While(loop)
+        out.extend(converted if isinstance(converted, list) else [converted])
+        return out
+
+
+_HELPERS = {
+    f"{_H}_ifelse": convert_ifelse,
+    f"{_H}_while": convert_while,
+    f"{_H}_and": convert_logical_and,
+    f"{_H}_or": convert_logical_or,
+    f"{_H}_not": convert_logical_not,
+    f"{_H}_range_test": range_test,
+    f"{_H}_for_seed": for_seed,
+    f"{_H}_undef": _Undef,
+}
+
+
+def _uses_scope_stmts(tree) -> bool:
+    return any(isinstance(n, (ast.Global, ast.Nonlocal))
+               for n in ast.walk(tree))
+
+
+def convert_control_flow(fn: Callable) -> Callable:
+    """Rewrite fn's tensor-dependent control flow; on any obstacle return
+    fn unchanged (to_static then behaves exactly as before, including its
+    teaching error for traced conditions)."""
+    if getattr(fn, "__not_to_static__", False):
+        return fn
+    if getattr(fn, "_p1t_dy2s_converted", False):
+        return fn
+    if inspect.ismethod(fn):
+        # convert the underlying function, re-bind to the same instance
+        import types
+        conv = convert_control_flow(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if _uses_scope_stmts(fdef):
+        return fn
+
+    transformer = _ControlFlowTransformer()
+    fdef.decorator_list = []  # do not re-apply @to_static on exec
+    new_body = []
+    for stmt in fdef.body:
+        res = transformer.visit(stmt)
+        new_body.extend(res if isinstance(res, list) else [res])
+    if transformer.counter == 0:
+        return fn  # nothing converted — keep the original (zero risk)
+    fdef.body = new_body
+    ast.fix_missing_locations(tree)
+
+    namespace = dict(fn.__globals__)
+    namespace.update(_HELPERS)
+    if fn.__closure__:
+        # snapshot free variables (cells) — the recompiled function reads
+        # them as globals; late rebinding of the enclosing scope is out of
+        # scope for the converter (documented)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                namespace[name] = cell.cell_contents
+            except ValueError:
+                return fn  # unresolved cell (self-reference) — bail out
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, namespace)
+    except Exception:
+        return fn
+    new_fn = namespace[fdef.name]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn._p1t_dy2s_converted = True
+    return new_fn

@@ -176,6 +176,13 @@ def standard_normal(shape, dtype=None, name=None):
     return randn(shape, dtype)
 
 
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    """Gaussian creation (reference tensor/random.py gaussian)."""
+    dt = dtypes.convert_dtype(dtype)
+    return to_tensor(mean + std * jax.random.normal(
+        next_key(), _shape_list(shape), dt))
+
+
 def normal(mean=0.0, std=1.0, shape=None, name=None):
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
         m = _t(mean) if isinstance(mean, Tensor) else mean
@@ -287,6 +294,14 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         new = shape[:sa] + (-1,) + shape[ea + 1:]
         return jnp.reshape(x, new)
     return apply("flatten", f, (x,))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    if isinstance(x, Tensor):
+        x._replace_impl(out)
+        return x
+    return out
 
 
 def squeeze(x, axis=None, name=None):

@@ -182,8 +182,51 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return apply("matmul", f, (_t(x), _t(y)))
 
 
+def mm(input, mat2, name=None):
+    """Non-broadcasting matmul (reference tensor/math.py mm). Unlike
+    matmul, batch dims must match exactly and inner dims must agree —
+    ported code uses mm as a shape assertion."""
+    a, b = _t(input), _t(mat2)
+    if a.ndim < 1 or b.ndim < 1:
+        raise InvalidArgumentError("mm: inputs must have ndim >= 1")
+    ka = a.shape[-1]
+    kb = b.shape[-2] if b.ndim >= 2 else b.shape[-1]
+    if ka != kb or tuple(a.shape[:-2]) != tuple(b.shape[:-2]):
+        raise InvalidArgumentError(
+            f"mm does not broadcast: got shapes {list(a.shape)} x "
+            f"{list(b.shape)}; use matmul for broadcasting semantics")
+    return matmul(a, b)
+
+
 def bmm(x, y, name=None):
     return apply("bmm", jnp.matmul, (_t(x), _t(y)))
+
+
+def increment(x, value=1.0, name=None):
+    """In-place scalar increment (reference increment op, used by
+    counters in static loops)."""
+    out = apply("increment", lambda a: a + jnp.asarray(value, a.dtype),
+                (_t(x),))
+    if isinstance(x, Tensor):
+        x._replace_impl(out)
+        return x
+    return out
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Shape-only broadcast result (reference tensor/manipulation.py
+    broadcast_shape)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tanh_(x, name=None):
+    # single in-place implementation lives in nn.functional.activation
+    from ..nn.functional.activation import tanh_ as _impl
+    return _impl(x, name=name)
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):

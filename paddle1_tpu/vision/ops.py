@@ -173,6 +173,36 @@ def _nms_mask(boxes, scores, iou_threshold, top_k):
     return keep
 
 
+def _iou_matrix_np(b):
+    """Pairwise IoU on host (numpy twin of _pairwise_iou)."""
+    area = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    x0 = np.maximum(b[:, None, 0], b[None, :, 0])
+    y0 = np.maximum(b[:, None, 1], b[None, :, 1])
+    x1 = np.minimum(b[:, None, 2], b[None, :, 2])
+    y1 = np.minimum(b[:, None, 3], b[None, :, 3])
+    inter = np.maximum(x1 - x0, 0) * np.maximum(y1 - y0, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def _nms_keep_np(boxes, scores, iou_threshold):
+    """Greedy hard-NMS on host; returns keep mask [N] (numpy twin of
+    _nms_mask — postprocess runs beside the input pipeline, not on the
+    device: each eager device op through a remote chip costs a round
+    trip, which made per-class NMS pathologically slow)."""
+    n = boxes.shape[0]
+    order = np.argsort(-scores)
+    ious = _iou_matrix_np(boxes[order])
+    keep_sorted = np.ones(n, bool)
+    rng = np.arange(n)
+    for i in range(n):
+        if keep_sorted[i]:
+            keep_sorted &= ~((ious[i] > iou_threshold) & (rng > i))
+    keep = np.zeros(n, bool)
+    keep[order] = keep_sorted
+    return keep
+
+
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None, name=None):
     """Hard NMS (reference nms_op / paddle.vision.ops.nms). Returns kept
@@ -180,6 +210,24 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     b = _t(boxes)
     s = _t(scores) if scores is not None else to_tensor(
         np.arange(b.shape[0], 0, -1).astype(np.float32))
+
+    import jax.core as _jcore
+    cat_t = _t(category_idxs) if category_idxs is not None else None
+    concrete = not any(isinstance(t.data, _jcore.Tracer)
+                       for t in (b, s, cat_t) if t is not None)
+    if concrete:
+        bn = np.asarray(b.numpy())
+        sn = np.asarray(s.numpy())
+        if cat_t is not None:
+            c = np.asarray(cat_t.numpy()).astype(np.float32)
+            span = bn.max() - bn.min() + 1.0
+            bn = bn + c[:, None] * span
+        keep_np = _nms_keep_np(bn, sn, iou_threshold)
+        idx = np.nonzero(keep_np)[0]
+        idx = idx[np.argsort(-sn[idx])]
+        if top_k is not None:
+            idx = idx[:top_k]
+        return to_tensor(idx.astype(np.int64))
 
     def f(boxes, scores, *cat):
         if cat:
@@ -225,8 +273,10 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
             continue
         idx = np.nonzero(sel)[0]
         idx = idx[np.argsort(-cs[idx])][:nms_top_k]
-        keep_rel = np.asarray(nms(to_tensor(b[idx]), nms_threshold,
-                                  to_tensor(cs[idx])).numpy())
+        # host path end-to-end: no device round-trips per class
+        keep_mask = _nms_keep_np(b[idx], cs[idx], nms_threshold)
+        keep_rel = np.nonzero(keep_mask)[0]
+        keep_rel = keep_rel[np.argsort(-cs[idx][keep_rel])]
         for i in keep_rel:
             gi = idx[i]
             out.append([float(c), float(cs[gi])] + b[gi].tolist())

@@ -1,0 +1,367 @@
+"""dy2static: the AST fallback for tensor-dependent control flow under
+jit.to_static (paddle1_tpu/jit/dy2static.py).
+
+Reference analog: the dygraph_to_static unit tests
+(python/paddle/fluid/tests/unittests/dygraph_to_static/test_ifelse.py,
+test_loop.py, test_logical_op.py) — same behaviors, trace-native design.
+"""
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.core.tensor import Tensor, to_tensor
+from paddle1_tpu.jit import not_to_static, to_static
+from paddle1_tpu.jit.dy2static import convert_control_flow
+
+
+def _t(x, dtype="float32"):
+    return to_tensor(np.asarray(x, dtype))
+
+
+class TestIfElse:
+    def test_tensor_condition_both_values(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        pos = _t([1.0, 2.0])
+        neg = _t([-1.0, -2.0])
+        np.testing.assert_allclose(f(pos).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(neg).numpy(), [-2.0, -3.0])
+
+    def test_python_condition_untouched(self):
+        @to_static
+        def f(x, flag=True):
+            if flag:
+                return x + 1.0
+            return x - 1.0
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+
+    def test_modifies_existing_variable(self):
+        @to_static
+        def f(x):
+            y = x + 1.0
+            if (x.mean() > 0):
+                y = y * 3.0
+            return y
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [6.0])
+        np.testing.assert_allclose(f(_t([-1.0])).numpy(), [0.0])
+
+    def test_one_sided_assignment_teaches(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                z = x * 2.0
+            return z
+
+        with pytest.raises(InvalidArgumentError, match="only one branch"):
+            f(_t([1.0]))
+
+    def test_gradients_flow_through_cond(self):
+        lin = paddle.nn.Linear(2, 2)
+
+        @to_static
+        def f(x):
+            h = lin(x)
+            if (h.sum() > 0):
+                out = h * h
+            else:
+                out = h * 3.0
+            return out.sum()
+
+        x = _t([[0.5, -0.25]])
+        x.stop_gradient = False
+        loss = f(x)
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+        # eager reference (same params, taken branch)
+        h = lin(x)
+        ref = (h * h).sum() if float(h.sum().numpy()) > 0 \
+            else (h * 3.0).sum()
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(ref.numpy()), rtol=1e-5)
+
+    def test_nested_if(self):
+        @to_static
+        def f(x):
+            y = x
+            if (x.sum() > 0):
+                if (x.sum() > 10):
+                    y = x * 100.0
+                else:
+                    y = x * 10.0
+            else:
+                y = -x
+            return y
+
+        np.testing.assert_allclose(f(_t([20.0])).numpy(), [2000.0])
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [10.0])
+        np.testing.assert_allclose(f(_t([-3.0])).numpy(), [3.0])
+
+
+class TestLoops:
+    def test_tensor_while(self):
+        @to_static
+        def f(n):
+            i = to_tensor(np.float32(0.0))
+            acc = to_tensor(np.float32(0.0))
+            while (i < n):
+                acc = acc + i
+                i = i + 1.0
+            return acc
+
+        assert float(f(_t(5.0)).numpy()) == 10.0  # 0+1+2+3+4
+
+    def test_python_while_still_python(self):
+        @to_static
+        def f(x):
+            k = 0
+            while k < 3:
+                x = x + 1.0
+                k = k + 1
+            return x
+
+        np.testing.assert_allclose(f(_t([0.0])).numpy(), [3.0])
+
+    def test_for_range_tensor_bound(self):
+        @to_static
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        np.testing.assert_allclose(
+            f(_t([2.0]), to_tensor(np.int32(4))).numpy(), [8.0])
+
+    def test_for_range_python_bound(self):
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(3):
+                acc = acc + x * float(i)
+            return acc
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [3.0])
+
+    def test_while_uninitialized_carry_teaches(self):
+        @to_static
+        def f(n):
+            i = to_tensor(np.float32(0.0))
+            while (i < n):
+                s = i * 2.0
+                i = i + 1.0
+            return i
+
+        with pytest.raises(InvalidArgumentError, match="unbound at loop"):
+            f(_t(3.0))
+
+    def test_loop_with_break_stays_python(self):
+        # break → untransformed; python bounds still work
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for i in range(10):
+                if i >= 2:
+                    break
+                acc = acc + x
+            return acc
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+
+    def test_grad_through_unrolled_loop(self):
+        # concrete bound → the loop unrolls under the trace and stays
+        # reverse-differentiable (traced-bound while_loop is forward-only,
+        # an XLA limitation documented in dy2static.py)
+        @to_static
+        def f(x):
+            y = x
+            i = 0
+            while i < 2:
+                y = y * x
+                i = i + 1
+            return y.sum()
+
+        x = _t([2.0])
+        x.stop_gradient = False
+        loss = f(x)  # y = x^3
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-5)
+
+
+class TestLogicalOps:
+    def test_python_value_semantics_kept(self):
+        from paddle1_tpu.jit.dy2static import (convert_logical_and,
+                                               convert_logical_not,
+                                               convert_logical_or)
+
+        # python operands keep python `and`/`or`/`not` VALUE semantics,
+        # including short-circuit (the rhs lambda must not run)
+        assert convert_logical_or(0, lambda: "fallback") == "fallback"
+        assert convert_logical_or("first", lambda: 1 / 0) == "first"
+        assert convert_logical_and(0, lambda: 1 / 0) == 0
+        assert convert_logical_and(2, lambda: "rhs") == "rhs"
+        assert convert_logical_not(0) is True
+        assert convert_logical_not("x") is False
+
+    def test_tensor_logical(self):
+        @to_static
+        def f(x):
+            cond = (x.sum() > 0) and (x.max() < 10)
+            if cond:
+                out = x + 1.0
+            else:
+                out = x - 1.0
+            return out
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-2.0])
+        np.testing.assert_allclose(f(_t([100.0])).numpy(), [99.0])
+
+    def test_tensor_not(self):
+        @to_static
+        def f(x):
+            if not (x.sum() > 0):
+                out = x * -1.0
+            else:
+                out = x
+            return out
+
+        np.testing.assert_allclose(f(_t([-4.0])).numpy(), [4.0])
+        np.testing.assert_allclose(f(_t([4.0])).numpy(), [4.0])
+
+
+class TestOptOutAndFallback:
+    def test_not_to_static_keeps_teaching_error(self):
+        @to_static
+        @not_to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2.0
+            else:
+                y = x
+            return y
+
+        with pytest.raises(InvalidArgumentError, match="static.nn.cond"):
+            f(_t([1.0]))
+
+    def test_flag_disables_conversion(self):
+        from paddle1_tpu.core.flags import flags_guard
+
+        with flags_guard(dy2static=False):
+            @to_static
+            def f(x):
+                if (x.sum() > 0):
+                    y = x * 2.0
+                else:
+                    y = x
+                return y
+
+            with pytest.raises(InvalidArgumentError,
+                               match="static.nn.cond"):
+                f(_t([1.0]))
+
+    def test_source_unavailable_falls_back(self):
+        ns = {}
+        exec("def g(x):\n    return x + 1.0\n", ns)
+        converted = convert_control_flow(ns["g"])
+        assert converted is ns["g"]
+
+    def test_no_control_flow_untouched(self):
+        def g(x):
+            return x * 2.0
+
+        assert convert_control_flow(g) is g
+
+    def test_closure_snapshot(self):
+        scale = _t([3.0])
+
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * scale
+            else:
+                y = x
+            return y
+
+        np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])
+
+
+class TestInsideLayer:
+    def test_layer_forward_with_tensor_if(self):
+        class Gate(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(2, 2)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if (h.sum() > 0):
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        m = to_static(Gate())
+        x = _t([[1.0, 1.0]])
+        out = m(x)
+        h = m.lin(x)
+        factor = 2.0 if float(h.sum().numpy()) > 0 else 0.5
+        np.testing.assert_allclose(out.numpy(), (h * factor).numpy(),
+                                   rtol=1e-5)
+
+
+class TestPythonSemanticsParity:
+    """r3 review findings: the rewrite must not change plain-Python
+    behavior of converted functions."""
+
+    def test_for_loop_var_post_loop_value(self):
+        @to_static
+        def f(x):
+            s = x * 0.0
+            for i in range(3):
+                s = s + x
+            return s, i
+
+        s, i = f(_t([1.0]))
+        assert i == 2  # python: last executed value, not one-past
+
+    def test_for_empty_range_leaves_var_unbound(self):
+        @to_static
+        def f(x):
+            s = x * 0.0
+            for i in range(0):
+                s = s + x
+            return s, i
+
+        with pytest.raises(UnboundLocalError, match="'i'"):
+            f(_t([1.0]))
+
+    def test_skipped_branch_use_raises_unbound(self):
+        @to_static
+        def f(x, flag=False):
+            if flag:
+                y = x * 2.0
+            return y + 1.0
+
+        with pytest.raises(UnboundLocalError, match="'y'"):
+            f(_t([1.0]))
+
+    def test_mm_rejects_broadcast(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        a = _t(np.zeros((4, 2, 3), np.float32))
+        b = _t(np.zeros((3, 2), np.float32))
+        with pytest.raises(InvalidArgumentError, match="broadcast"):
+            paddle.mm(a, b)
+        ok = paddle.mm(_t(np.ones((2, 3), np.float32)),
+                       _t(np.ones((3, 2), np.float32)))
+        assert ok.shape == [2, 2]

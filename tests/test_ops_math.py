@@ -197,3 +197,44 @@ class TestPyLayer(OpTest):
         y = Double.apply(x)
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestParitySweepOps(OpTest):
+    """Ops added by the r3 API-parity sweep vs the reference's
+    python/paddle/tensor surface (mm, increment, is_tensor,
+    broadcast_shape, gaussian, flatten_, tanh_)."""
+
+    def test_mm(self):
+        a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        np.testing.assert_allclose(paddle.mm(a, b).numpy(),
+                                   a.numpy() @ b.numpy())
+
+    def test_increment_inplace(self):
+        x = paddle.to_tensor(np.float32(4.0))
+        out = paddle.increment(x, 1.5)
+        assert out is x
+        np.testing.assert_allclose(float(x.numpy()), 5.5)
+
+    def test_is_tensor(self):
+        assert paddle.is_tensor(paddle.to_tensor(np.float32(1.0)))
+        assert not paddle.is_tensor(np.float32(1.0))
+
+    def test_broadcast_shape(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_gaussian_moments(self):
+        g = paddle.gaussian([20000], mean=2.0, std=0.5)
+        assert abs(float(g.numpy().mean()) - 2.0) < 0.05
+        assert abs(float(g.numpy().std()) - 0.5) < 0.05
+
+    def test_flatten_inplace(self):
+        x = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+        out = paddle.flatten_(x, start_axis=1)
+        assert out is x and x.shape == [2, 12]
+
+    def test_tanh_inplace_grad_safe(self):
+        x = paddle.to_tensor(np.float32(0.5))
+        paddle.tanh_(x)
+        np.testing.assert_allclose(float(x.numpy()), np.tanh(0.5),
+                                   rtol=1e-6)
