@@ -66,16 +66,18 @@ class _Undef:
     UnboundLocalError plain Python would have raised at that point, naming
     the variable — it must not flow silently into downstream math."""
 
-    __slots__ = ("name",)
+    __slots__ = ("name", "hint")
 
     def __init__(self, name: str = "<var>"):
         self.name = name
+        self.hint = ""
 
     def _raise(self, *a, **k):
         raise UnboundLocalError(
             f"local variable '{self.name}' referenced before assignment "
             f"(it is only bound on a branch/loop path that did not run; "
-            f"dy2static preserved Python's unbound semantics)")
+            f"dy2static preserved Python's unbound semantics)"
+            + (f" — {self.hint}" if self.hint else ""))
 
     def __repr__(self):
         return f"<undefined {self.name}>"
@@ -116,67 +118,111 @@ def _wrap_like(template, value):
 # runtime converters (reference: dygraph_to_static/convert_operators.py)
 # ---------------------------------------------------------------------------
 
-def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str]):
+def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
+                   in_true: Sequence[bool], in_false: Sequence[bool]):
     """``if`` dispatch. true_fn/false_fn take the current values of
     ``names`` (every name assigned in either branch; UNDEF when unbound)
-    and return their values at branch exit."""
+    and return their values at branch exit. ``in_true``/``in_false`` mark
+    which names each branch ASSIGNS (known statically by the AST rewrite).
+
+    Traced path: names defined on both sides (assigned there, or already
+    bound before the `if`) flow through a real ``lax.cond`` — the branch
+    callbacks run INSIDE the cond, so only the taken branch executes on
+    device. One-sided names are excluded from the cond and come back as
+    named sentinels that raise at their (ill-defined) use site."""
     if not _is_traced(pred):
         return true_fn(*init) if _to_bool(pred) else false_fn(*init)
 
-    t_out, f_out = true_fn(*init), false_fn(*init)
-    for name, tv, fv in zip(names, t_out, f_out):
-        if isinstance(tv, _Undef) or isinstance(fv, _Undef):
-            branch = "false" if isinstance(fv, _Undef) else "true"
-            raise InvalidArgumentError(
-                f"to_static: `{name}` is assigned in only one branch of a "
-                f"Tensor-condition `if` (unbound in the {branch} branch). "
-                f"Both sides of a traced branch must produce it — "
-                f"initialize `{name}` before the `if`.")
-    flat_t = [_raw(v) for v in t_out]
-    flat_f = [_raw(v) for v in f_out]
+    bound = [not isinstance(v, _Undef) for v in init]
+    both = [(t or b) and (f or b)
+            for t, f, b in zip(in_true, in_false, bound)]
+    keep = [i for i, ok in enumerate(both) if ok]
+    templates = {}
+
+    def _branch(fn, key):
+        def inner(_):
+            outs = fn(*init)
+            templates[key] = outs
+            return tuple(jnp.asarray(_raw(outs[i])) for i in keep)
+        return inner
+
     try:
-        outs = jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
-                            lambda _: tuple(jnp.asarray(v) for v in flat_t),
-                            lambda _: tuple(jnp.asarray(v) for v in flat_f),
-                            0)
+        kept = jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
+                            _branch(true_fn, "t"),
+                            _branch(false_fn, "f"), 0)
     except TypeError as e:
         raise InvalidArgumentError(
             f"to_static: the branches of a Tensor-condition `if` produce "
             f"mismatched shapes/dtypes for {list(names)} — a traced branch "
             f"must yield the same structure on both sides. ({e})") from e
-    return tuple(_wrap_like(t, o) for t, o in zip(t_out, outs))
+    tmpl = templates.get("t") or templates.get("f")
+    out, ki = [], 0
+    for i, name in enumerate(names):
+        if both[i]:
+            out.append(_wrap_like(tmpl[i], kept[ki]))
+            ki += 1
+        else:
+            u = _Undef(name)
+            u.hint = ("under a Tensor-condition `if`, a variable must be "
+                      "assigned in BOTH branches (or initialized before "
+                      "the `if`) to be readable afterwards")
+            out.append(u)
+    return tuple(out)
 
 
-def convert_while(test_fn, body_fn, init, names: Sequence[str]):
+def convert_while(test_fn, body_fn, init, names: Sequence[str],
+                  needs_init: Optional[Sequence[bool]] = None):
     """``while`` dispatch. test_fn/body_fn take the values of ``names``
     (every name assigned in the loop body); body_fn returns their values
-    at iteration exit."""
-    vals = tuple(init)
+    at iteration exit. ``needs_init[i]`` marks names whose PRE-iteration
+    value is observable (read in the test, or read before written in the
+    body) — statically computed by the AST rewrite; per-iteration
+    temporaries (write-first) carry a dead input and need no init."""
+    vals = list(init)
     probe = test_fn(*vals)
     if not _is_traced(probe):
         # concrete bound: plain Python — under a trace this UNROLLS the
         # loop (traced carries are fine), which also keeps reverse-mode
         # autodiff working; XLA cannot reverse-differentiate a dynamic
-        # while_loop, so the unrolled form is strictly more capable here
-        while _to_bool(test_fn(*vals)):
-            vals = tuple(body_fn(*vals))
-        return vals
+        # while_loop, so the unrolled form is strictly more capable here.
+        # The probe IS the first test result (a side-effecting test must
+        # run exactly once per state).
+        while _to_bool(probe):
+            vals = list(body_fn(*vals))
+            probe = test_fn(*vals)
+        return tuple(vals)
 
-    for name, v in zip(names, vals):
-        if isinstance(v, _Undef):
+    if needs_init is None:
+        needs_init = [True] * len(names)
+    undef_ix = [i for i, v in enumerate(vals) if isinstance(v, _Undef)]
+    for i in undef_ix:
+        if needs_init[i]:
             raise InvalidArgumentError(
-                f"to_static: `{name}` is assigned inside a Tensor-condition "
-                f"`while` but is unbound at loop entry. Loop-carried state "
-                f"must exist before the loop — initialize `{name}` first "
-                f"(e.g. `{name} = paddle.zeros(...)`).")
-
-    def c(flat):
-        out = test_fn(*(_wrap_like(t, v) for t, v in zip(vals, flat)))
-        return jnp.reshape(_raw(out), ()).astype(bool)
+                f"to_static: `{names[i]}` is read by a Tensor-condition "
+                f"`while` (in its test, or before being assigned in the "
+                f"body) but is unbound at loop entry. Initialize "
+                f"`{names[i]}` before the loop (e.g. "
+                f"`{names[i]} = paddle.zeros(...)`).")
 
     def b(flat):
         outs = body_fn(*(_wrap_like(t, v) for t, v in zip(vals, flat)))
         return tuple(jnp.asarray(_raw(o)) for o in outs)
+
+    if undef_ix:
+        # write-first temporaries: their carry INPUT is dead, but
+        # lax.while_loop still needs a structure-matching seed. Discover
+        # each one's per-iteration structure via eval_shape (emits no
+        # ops — safe exactly because the placeholder is never read).
+        placeholder = jnp.zeros((), jnp.float32)
+        probe_flat = [placeholder if isinstance(v, _Undef)
+                      else jnp.asarray(_raw(v)) for v in vals]
+        shapes = jax.eval_shape(lambda *fl: b(fl), *probe_flat)
+        for i in undef_ix:
+            vals[i] = jnp.zeros(shapes[i].shape, shapes[i].dtype)
+
+    def c(flat):
+        out = test_fn(*(_wrap_like(t, v) for t, v in zip(vals, flat)))
+        return jnp.reshape(_raw(out), ()).astype(bool)
 
     flat0 = tuple(jnp.asarray(_raw(v)) for v in vals)
     try:
@@ -342,6 +388,78 @@ def _walk_scope(node):
         stack.extend(ast.iter_child_nodes(n))
 
 
+def _expr_loads(node) -> list:
+    """Names loaded by an expression (not descending into inner scopes)."""
+    out = []
+    for n in _walk_scope(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.append(n.id)
+    return out
+
+
+def _read_before_write(stmts, written=None) -> set:
+    """Names whose value at BLOCK ENTRY may be observed: loaded somewhere
+    before this block unconditionally writes them. A linear, conservative
+    approximation (nested branches contribute reads but never count as
+    definite writes), so a per-iteration temporary that is written first
+    is reliably classified, and anything uncertain stays 'read'."""
+    written = set(written or ())
+    reads = set()
+
+    def note_reads(expr):
+        for n in _expr_loads(expr):
+            if n not in written:
+                reads.add(n)
+
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            note_reads(s.value)
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    written.add(t.id)
+                else:
+                    note_reads(t)  # x[i] = ..: reads x (and i)
+        elif isinstance(s, ast.AugAssign):
+            note_reads(s.value)
+            if isinstance(s.target, ast.Name):
+                if s.target.id not in written:
+                    reads.add(s.target.id)  # x += v reads x
+                written.add(s.target.id)
+            else:
+                note_reads(s.target)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                note_reads(s.value)
+                if isinstance(s.target, ast.Name):
+                    written.add(s.target.id)
+        elif isinstance(s, ast.If):
+            note_reads(s.test)
+            reads |= _read_before_write(s.body, written)
+            reads |= _read_before_write(s.orelse, written)
+        elif isinstance(s, (ast.While,)):
+            note_reads(s.test)
+            reads |= _read_before_write(s.body, written)
+        elif isinstance(s, ast.For):
+            note_reads(s.iter)
+            reads |= _read_before_write(s.body, written)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            written.add(s.name)  # the def itself; body is an inner scope
+        elif isinstance(s, ast.Try):
+            reads |= _read_before_write(s.body, written)
+            for h in s.handlers:
+                reads |= _read_before_write(h.body, written)
+            reads |= _read_before_write(s.orelse, written)
+            reads |= _read_before_write(s.finalbody, written)
+        else:
+            note_reads(s)
+    return reads
+
+
+def _has_walrus(expr) -> bool:
+    return any(isinstance(n, ast.NamedExpr) for n in _walk_scope(expr))
+
+
 def _has_early_exit(stmts) -> bool:
     """return/break/continue/yield in THIS scope makes a statement
     non-convertible (nested defs' returns don't count)."""
@@ -440,6 +558,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         if _defines_scope(node.body + node.orelse):
             return node
+        if _has_walrus(node.test):
+            # a := in the test binds a name the nested test_fn would hide
+            return node
         names = _assigned(node.body + node.orelse)
         if not names:
             # pure side-effect branches (e.g. list.append) — cannot be
@@ -465,12 +586,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 body=(body or [ast.Pass()]) + [ret],
                 decorator_list=[], returns=None, type_params=[])
 
+        in_true = set(_assigned(node.body))
+        in_false = set(_assigned(node.orelse))
+
+        def mask(which):
+            return ast.Tuple(elts=[ast.Constant(value=n in which)
+                                   for n in names], ctx=ast.Load())
+
         call = ast.Assign(
             targets=[_names_tuple(names, ast.Store)],
             value=ast.Call(func=_load(f"{_H}_ifelse"),
                            args=[node.test, _load(t_name), _load(f_name),
                                  _names_tuple(names, ast.Load),
-                                 _str_list(names)],
+                                 _str_list(names),
+                                 mask(in_true), mask(in_false)],
                            keywords=[]))
         out = (_prebind(names) +
                [mk(t_name, node.body), mk(f_name, node.orelse), call])
@@ -485,9 +614,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         if _defines_scope(node.body):
             return node
+        if _has_walrus(node.test):
+            return node
         names = _assigned(node.body)
         if not names:
             return node
+        observed = (set(_expr_loads(node.test))
+                    | _read_before_write(node.body))
+        needs_init = ast.Tuple(
+            elts=[ast.Constant(value=n in observed) for n in names],
+            ctx=ast.Load())
         self.counter += 1
         i = self.counter
         t_name, b_name = f"{_H}_test_{i}", f"{_H}_body_{i}"
@@ -510,7 +646,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             value=ast.Call(func=_load(f"{_H}_while"),
                            args=[_load(t_name), _load(b_name),
                                  _names_tuple(names, ast.Load),
-                                 _str_list(names)],
+                                 _str_list(names), needs_init],
                            keywords=[]))
         out = _prebind(names) + [test_fn, body_fn, call]
         for s in out:

@@ -14,7 +14,8 @@ from ...autograd.engine import apply
 from ...core.tensor import Tensor, to_tensor
 
 __all__ = [
-    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "sigmoid",
+    "relu", "relu6", "relu_", "elu", "elu_", "selu", "celu", "gelu",
+    "sigmoid",
     "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "softshrink",
     "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid", "maxout",
     "silu", "swish", "mish", "softplus", "softsign", "tanh", "tanh_",
@@ -46,20 +47,31 @@ tanh = _un("tanh", jnp.tanh)
 tanhshrink = _un("tanhshrink", lambda x: x - jnp.tanh(x))
 
 
+def _inplace(x, out):
+    """In-place contract shared by the *_ variants: mutate a Tensor,
+    gracefully return the out-of-place result for raw arrays (matching
+    ops.manip_ops.flatten_ / math_ops.increment)."""
+    from ...core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x._replace_impl(out)
+        return x
+    return out
+
+
 def relu_(x, name=None):
-    out = relu(x)
-    x._replace_impl(out)
-    return x
+    return _inplace(x, relu(x))
 
 
 def tanh_(x, name=None):
-    out = tanh(x)
-    x._replace_impl(out)
-    return x
+    return _inplace(x, tanh(x))
 
 
 def elu(x, alpha=1.0, name=None):
     return apply("elu", lambda x: jax.nn.elu(x, alpha=alpha), (_t(x),))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _inplace(x, elu(x, alpha=alpha))
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
@@ -181,9 +193,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
-    out = softmax(x, axis, dtype)
-    x._replace_impl(out)
-    return x
+    return _inplace(x, softmax(x, axis, dtype))
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

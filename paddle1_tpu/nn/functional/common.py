@@ -388,3 +388,27 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
         rest = y[:, :, 2 * fold_c:]
         return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
     return apply("temporal_shift", f, (_t(x),))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference nn/functional/extension.py
+    diag_embed): places the last dim of ``input`` on the (dim1, dim2)
+    diagonal of a new square trailing matrix."""
+    x = _t(input)
+
+    def f(x):
+        n = x.shape[-1] + abs(offset)
+        nd_out = x.ndim + 1
+        d1 = dim1 % nd_out
+        d2 = dim2 % nd_out
+        base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        i = jnp.arange(x.shape[-1])
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        out = base.at[..., rows, cols].set(x)
+        # move the trailing (row, col) axes to (dim1, dim2)
+        return jnp.moveaxis(out, (nd_out - 2, nd_out - 1), (d1, d2))
+    return apply("diag_embed", f, (x,))
+
+
+__all__.append("diag_embed")

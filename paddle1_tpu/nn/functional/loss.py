@@ -12,6 +12,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...autograd.engine import apply
 from ...core.tensor import Tensor, to_tensor
@@ -326,3 +327,72 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return apply("ctc_loss", f, (_t(log_probs), _t(labels),
                                  _t(input_lengths), _t(label_lengths)))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py:312
+    hsigmoid_loss / operators/hierarchical_sigmoid_op). The O(log C)
+    softmax replacement used by the sparse/PS word-embedding workloads.
+
+    Default tree = the reference's SimpleCode complete binary tree over
+    ``num_classes`` leaves: for code ``c = label + num_classes`` the
+    path visits internal node ``(c >> (i+1)) - 1`` with branch bit
+    ``(c >> i) & 1`` for i in 0..len-2 (matrix_bit_code.h SimpleCode).
+    Custom trees ride ``path_table``/``path_code`` [N, L] with negative
+    padding. ``is_sparse`` is accepted for API parity — gradient
+    sparsity is an optimizer-side concern here (see distributed.ps).
+
+    input: [N, D]; label: [N]; weight: [num_classes-1, D];
+    bias: [num_classes-1]. Returns [N, 1] per-sample losses (reference
+    returns unreduced losses).
+    """
+    x = _t(input)
+    lab = _t(label)
+    w = _t(weight)
+    b = _t(bias) if bias is not None else None
+
+    if path_table is not None or path_code is not None:
+        if path_table is None or path_code is None:
+            raise InvalidArgumentError(
+                "hsigmoid_loss: path_table and path_code come together")
+        table = _t(path_table)
+        code = _t(path_code)
+
+        def f(x, lab, w, table, code, *mb):
+            idx = table.astype(jnp.int32)            # [N, L]
+            valid = idx >= 0
+            idx = jnp.maximum(idx, 0)
+            bits = code.astype(jnp.float32)
+            pre = jnp.einsum("nd,nld->nl", x, w[idx])
+            if mb:
+                pre = pre + mb[0][idx]
+            loss = jax.nn.softplus(pre) - bits * pre
+            loss = jnp.where(valid, loss, 0.0)
+            return jnp.sum(loss, axis=1, keepdims=True)
+        args = (x, lab, w, table, code) + ((b,) if b is not None else ())
+        return apply("hsigmoid_loss", f, args)
+
+    max_len = max(1, int(np.ceil(np.log2(max(2, num_classes)))) + 1)
+
+    def f(x, lab, w, *mb):
+        c = lab.astype(jnp.int32) + num_classes      # [N]
+        # significant length of c minus 1 = path length
+        i = jnp.arange(max_len)                      # [L]
+        node = (c[:, None] >> (i[None, :] + 1)) - 1  # [N, L]
+        bit = ((c[:, None] >> i[None, :]) & 1).astype(jnp.float32)
+        valid = node >= 0                            # steps past the root
+        idx = jnp.maximum(node, 0)
+        pre = jnp.einsum("nd,nld->nl", x, w[idx])    # [N, L]
+        if mb:
+            pre = pre + mb[0][idx]
+        loss = jax.nn.softplus(pre) - bit * pre
+        loss = jnp.where(valid, loss, 0.0)
+        return jnp.sum(loss, axis=1, keepdims=True)
+
+    args = (x, lab, w) + ((b,) if b is not None else ())
+    return apply("hsigmoid_loss", f, args)
+
+
+__all__.append("hsigmoid_loss")

@@ -184,3 +184,41 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss): owns the [num_classes-1, D] internal-node weights of
+    the binary tree; see F.hsigmoid_loss for the path/bit-code math."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        from .initializer import XavierUniform, Constant
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr,
+            default_initializer=XavierUniform())
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [n_nodes], attr=bias_attr, is_bias=True,
+                default_initializer=Constant(0.0))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("is_custom=True requires path_table/path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code,
+                               is_sparse=self.is_sparse)
+
+
+__all__.append("HSigmoidLoss")

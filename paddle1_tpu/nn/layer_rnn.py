@@ -24,7 +24,7 @@ from .layer_base import Layer
 from .layer_norm_act import LayerList
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
-           "SimpleRNN", "LSTM", "GRU"]
+           "SimpleRNN", "LSTM", "GRU", "RNNCellBase", "RNNBase"]
 
 
 class RNNCellBase(Layer):
@@ -413,3 +413,9 @@ class GRU(_RNNBase):
                  **kwargs):
         super().__init__(input_size, hidden_size, num_layers, direction,
                          time_major, dropout, **kwargs)
+
+
+# public base-class aliases (reference nn/layer/rnn.py RNNCellBase:134,
+# RNNBase:844) — custom cells subclass RNNCellBase; RNNBase is the shared
+# machinery behind SimpleRNN/LSTM/GRU
+RNNBase = _RNNBase

@@ -268,3 +268,83 @@ class TestLosses(OpTest):
         p = e / e.sum(-1, keepdims=True)
         ref = -np.log(p[np.arange(3), np.clip(labels, 0, None)])[mask].mean()
         np.testing.assert_allclose(out.item(), ref, rtol=1e-4)
+
+
+class TestParitySweepNN:
+    """r3 nn-surface parity sweep: hsigmoid_loss/HSigmoidLoss, diag_embed,
+    elu_, RNN base classes (reference nn/functional/loss.py:312,
+    nn/functional/extension.py diag_embed, nn/layer/rnn.py:134,844)."""
+
+    def test_hsigmoid_is_a_distribution(self):
+        # the binary-tree path losses must define a normalized
+        # distribution: sum_l exp(-loss(l)) == 1 for any x
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(0)
+        C, D = 11, 6
+        x = paddle.to_tensor(rng.standard_normal((1, D)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((C - 1, D))
+                             .astype(np.float32))
+        b = paddle.to_tensor(rng.standard_normal((C - 1,))
+                             .astype(np.float32))
+        probs = []
+        for label in range(C):
+            l = paddle.to_tensor(np.array([label]))
+            loss = F.hsigmoid_loss(x, l, C, w, bias=b)
+            probs.append(np.exp(-float(loss.numpy()[0, 0])))
+        np.testing.assert_allclose(sum(probs), 1.0, rtol=1e-5)
+
+    def test_hsigmoid_layer_trains(self):
+        import paddle1_tpu as paddle
+        rng = np.random.default_rng(1)
+        hs = paddle.nn.HSigmoidLoss(4, 6)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=hs.parameters())
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+        y = paddle.to_tensor(np.arange(8, dtype=np.int64) % 6)
+        first = None
+        for _ in range(30):
+            loss = hs(x, y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.7
+
+    def test_hsigmoid_custom_path(self):
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((2, 3)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+        lab = paddle.to_tensor(np.array([0, 1]))
+        table = paddle.to_tensor(np.array([[0, 1, -1], [0, 2, 3]],
+                                          np.int64))
+        code = paddle.to_tensor(np.array([[1.0, 0.0, 0.0],
+                                          [0.0, 1.0, 1.0]], np.float32))
+        loss = F.hsigmoid_loss(x, lab, 5, w, path_table=table,
+                               path_code=code)
+        assert loss.shape == [2, 1]
+        assert np.isfinite(loss.numpy()).all()
+
+    def test_diag_embed(self):
+        import paddle1_tpu.nn.functional as F
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        d = F.diag_embed(x)
+        assert d.shape == [2, 3, 3]
+        np.testing.assert_allclose(np.asarray(d.numpy())[1],
+                                   np.diag([3.0, 4.0, 5.0]))
+        off = F.diag_embed(x, offset=1)
+        assert off.shape == [2, 4, 4]
+        np.testing.assert_allclose(np.asarray(off.numpy())[0],
+                                   np.diag([0.0, 1.0, 2.0], k=1))
+
+    def test_elu_inplace(self):
+        import paddle1_tpu.nn.functional as F
+        t = paddle.to_tensor(np.float32([-1.0, 2.0]))
+        out = F.elu_(t)
+        assert out is t
+        np.testing.assert_allclose(t.numpy(), [np.expm1(-1.0), 2.0],
+                                   rtol=1e-6)
+
+    def test_rnn_base_classes_exported(self):
+        assert isinstance(paddle.nn.LSTM(4, 8), paddle.nn.RNNBase)
+        assert issubclass(paddle.nn.LSTMCell, paddle.nn.RNNCellBase)
