@@ -27,6 +27,8 @@ from .parallel_engine import ParallelEngine, make_train_step
 from .spawn import spawn
 from . import ps
 from .ps import DistributedEmbedding, EmbeddingService, SparseTable
+from . import ps_server
+from .ps_server import RemoteTable, TableServer, remote_service
 
 
 def __getattr__(name):

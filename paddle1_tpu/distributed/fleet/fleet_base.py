@@ -128,16 +128,49 @@ class Fleet:
     def init_worker(self):
         self._ensure_init()
 
-    def init_server(self, *args, **kwargs):
+    def init_server(self, *args, dim: int = None, table_kwargs: dict = None,
+                    **kwargs):
+        """Create this PS node's table shard (reference fleet.init_server
+        loads the server program; here the 'program' is one SparseTable —
+        the scoped PS holds only the sparse embedding workload).
+        ``dim`` may come as a kwarg or via ``PADDLE_PS_TABLE_DIM``."""
         self._ensure_init()
+        import os
+        if dim is None:
+            dim = int(os.environ.get("PADDLE_PS_TABLE_DIM", "0"))
+        if dim <= 0:
+            raise PreconditionNotMetError(
+                "init_server needs the table dim: fleet.init_server(dim=D) "
+                "or env PADDLE_PS_TABLE_DIM")
+        from ..ps import SparseTable
+        self._server_table = SparseTable(dim, **(table_kwargs or {}))
 
     def run_server(self):
-        raise PreconditionNotMetError(
-            "Parameter-server mode has no TPU analog. For the sparse "
-            "embedding workload use paddle1_tpu.distributed."
-            "EmbeddingService (host-RAM sharded tables) with "
-            "fleet.MultiTrainer (Hogwild workers); for dense training "
-            "use collective mode (is_collective=True)")
+        """Serve this node's table shard over TCP, blocking (reference
+        fleet.run_server → brpc_ps_server). Needs init_server first and a
+        port from ``PADDLE_PORT``. Trainers reach the table fleet via
+        distributed.ps_server.remote_service(dim,
+        PADDLE_PSERVERS_IP_PORT_LIST.split(','))."""
+        self._ensure_init()
+        import os
+        table = getattr(self, "_server_table", None)
+        if table is None:
+            raise PreconditionNotMetError(
+                "run_server: call fleet.init_server(dim=...) first")
+        from ..ps_server import TableServer
+        port_s = os.environ.get("PADDLE_PORT")
+        if port_s is None:
+            raise PreconditionNotMetError(
+                "run_server: PADDLE_PORT is not set — trainers dial the "
+                "CONFIGURED endpoint from PADDLE_PSERVERS_IP_PORT_LIST, so "
+                "an OS-assigned ephemeral port can never be reached. Set "
+                "PADDLE_PORT to this server's port (0 only for tests that "
+                "read the bound port back from fleet._table_server)")
+        port = int(port_s)
+        host = os.environ.get("POD_IP", "127.0.0.1")
+        srv = TableServer(table, host=host, port=port)
+        self._table_server = srv
+        srv.serve_forever()
 
     def stop_worker(self):
         pass

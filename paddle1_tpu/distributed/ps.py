@@ -136,10 +136,19 @@ class EmbeddingService:
     shard lives on one host; here shards are in-process with independent
     locks, preserving the interface and the concurrency structure."""
 
-    def __init__(self, dim: int, num_shards: int = 1, **table_kwargs):
+    def __init__(self, dim: int, num_shards: int = 1, shards=None,
+                 **table_kwargs):
+        self.dim = int(dim)
+        if shards is not None:
+            # prebuilt shards (e.g. ps_server.RemoteTable clients) — any
+            # object with the SparseTable pull/push/state interface
+            self.shards = list(shards)
+            if not self.shards:
+                raise ValueError("shards must be non-empty")
+            self.num_shards = len(self.shards)
+            return
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
-        self.dim = int(dim)
         self.num_shards = int(num_shards)
         self.shards = [SparseTable(dim, seed=s, **table_kwargs)
                        for s in range(num_shards)]

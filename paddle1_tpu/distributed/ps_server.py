@@ -1,0 +1,213 @@
+"""Network transport for the embedding-table service — the scoped analog
+of the reference's brpc parameter-server processes.
+
+The reference runs dedicated PS processes (BrpcPsServer,
+/root/reference/paddle/fluid/distributed/service/brpc_ps_server.cc) that
+workers dial for pull_sparse/push_sparse
+(brpc_ps_client.cc). Here the same split: :class:`TableServer` hosts
+:class:`~paddle1_tpu.distributed.ps.SparseTable` shards behind a TCP
+socket; :class:`RemoteTable` is a client with the exact pull/push
+interface of a local table, so :class:`EmbeddingService` routes to local
+and remote shards identically.
+
+Protocol: length-prefixed pickled (op, payload) tuples over TCP, one
+request per round-trip, thread-per-connection on the server. Pickle is
+acceptable for the same reason the reference's brpc endpoints are: the
+PS protocol runs inside a trusted training cluster, never on a public
+interface — bind to cluster-internal addresses only.
+
+Env contract (reference launch_utils.py PS mode):
+``PADDLE_PSERVERS_IP_PORT_LIST`` = comma-separated ``host:port`` of the
+table servers; ``TRAINING_ROLE`` = ``PSERVER`` | ``TRAINER``;
+``PADDLE_PORT`` = this server's port. ``fleet.init_server/run_server``
+consume these (fleet_base.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import PreconditionNotMetError
+from .ps import SparseTable
+
+__all__ = ["TableServer", "RemoteTable", "remote_service"]
+
+_HDR = struct.Struct("!I")
+_MAX_MSG = 1 << 30
+
+
+def _send(sock, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv(sock):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    if n > _MAX_MSG:
+        raise ValueError(f"ps message too large: {n} bytes")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("peer closed mid-message")
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise ConnectionError("peer closed mid-message")
+            return None  # clean EOF between messages
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        table: SparseTable = self.server.table  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = _recv(self.request)
+            except (ConnectionError, OSError):
+                return
+            if msg is None:
+                return
+            op, payload = msg
+            try:
+                if op == "pull":
+                    _send(self.request, ("ok", table.pull(payload)))
+                elif op == "push":
+                    ids, grads = payload
+                    table.push(ids, grads)
+                    _send(self.request, ("ok", None))
+                elif op == "len":
+                    _send(self.request, ("ok", len(table)))
+                elif op == "state":
+                    _send(self.request, ("ok", table.state_dict()))
+                elif op == "load":
+                    table.load_state_dict(payload)
+                    _send(self.request, ("ok", None))
+                elif op == "ping":
+                    _send(self.request, ("ok", "pong"))
+                elif op == "shutdown":
+                    _send(self.request, ("ok", None))
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True).start()
+                    return
+                else:
+                    _send(self.request, ("err", f"unknown op {op!r}"))
+            except Exception as e:  # keep serving other workers
+                try:
+                    _send(self.request, ("err", f"{type(e).__name__}: {e}"))
+                except OSError:
+                    return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TableServer:
+    """Serve ONE SparseTable shard over TCP (the reference's one
+    brpc_ps_server process per PS node). ``serve_forever`` blocks (use
+    from ``fleet.run_server``); ``start`` runs in a background thread
+    (tests, notebooks)."""
+
+    def __init__(self, table: SparseTable, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.table = table  # type: ignore[attr-defined]
+        self.table = table
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def serve_forever(self):
+        self._srv.serve_forever()
+
+    def start(self) -> "TableServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class RemoteTable:
+    """Client-side twin of SparseTable: same pull/push/state interface,
+    rows live in the server process (brpc_ps_client.cc pull_sparse/
+    push_sparse). One persistent connection, lock-serialized (matching
+    the per-table lock of the local shard)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._lock = threading.Lock()
+
+    def _call(self, op, payload=None):
+        with self._lock:
+            _send(self._sock, (op, payload))
+            status, out = _recv(self._sock)
+        if status != "ok":
+            raise PreconditionNotMetError(f"table server {self.endpoint}: "
+                                          f"{out}")
+        return out
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        return self._call("pull", np.asarray(ids, np.int64))
+
+    def push(self, ids: Sequence[int], grads) -> None:
+        self._call("push", (np.asarray(ids, np.int64),
+                            np.asarray(grads, np.float32)))
+
+    def __len__(self) -> int:
+        return self._call("len")
+
+    def state_dict(self) -> dict:
+        return self._call("state")
+
+    def load_state_dict(self, state: dict) -> None:
+        self._call("load", state)
+
+    def ping(self) -> bool:
+        return self._call("ping") == "pong"
+
+    def shutdown_server(self) -> None:
+        self._call("shutdown")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def remote_service(dim: int, endpoints: Sequence[str]):
+    """EmbeddingService whose shards are RemoteTables — one per server
+    endpoint, routed by ``id % num_shards`` exactly like local shards
+    (the reference's shard_num partition over PS nodes)."""
+    from .ps import EmbeddingService
+    return EmbeddingService(dim, shards=[RemoteTable(ep)
+                                         for ep in endpoints])

@@ -139,9 +139,20 @@ def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
     keep = [i for i, ok in enumerate(both) if ok]
     templates = {}
 
+    class _BranchError(Exception):
+        """Carrier for a TypeError raised by USER branch code — it must
+        escape the except-TypeError below, which is only for lax.cond's
+        branch-structure mismatch."""
+
+        def __init__(self, exc):
+            self.exc = exc
+
     def _branch(fn, key):
         def inner(_):
-            outs = fn(*init)
+            try:
+                outs = fn(*init)
+            except TypeError as ue:
+                raise _BranchError(ue) from ue
             templates[key] = outs
             return tuple(jnp.asarray(_raw(outs[i])) for i in keep)
         return inner
@@ -150,6 +161,8 @@ def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
         kept = jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
                             _branch(true_fn, "t"),
                             _branch(false_fn, "f"), 0)
+    except _BranchError as be:
+        raise be.exc
     except TypeError as e:
         raise InvalidArgumentError(
             f"to_static: the branches of a Tensor-condition `if` produce "
@@ -439,9 +452,11 @@ def _read_before_write(stmts, written=None) -> set:
         elif isinstance(s, (ast.While,)):
             note_reads(s.test)
             reads |= _read_before_write(s.body, written)
+            reads |= _read_before_write(s.orelse, written)
         elif isinstance(s, ast.For):
             note_reads(s.iter)
             reads |= _read_before_write(s.body, written)
+            reads |= _read_before_write(s.orelse, written)
         elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
                             ast.ClassDef)):
             written.add(s.name)  # the def itself; body is an inner scope

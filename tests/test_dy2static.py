@@ -442,3 +442,34 @@ class TestReviewRegressions:
 
         f(_t([0.0]))
         assert calls == [0, 1, 2, 3]  # exactly once per state
+
+    def test_orelse_read_counts_as_read_first(self):
+        # acc is read only inside a for/else in the traced-while body —
+        # still an observable pre-iteration read, must teach, not zero-seed
+        @to_static
+        def f(n):
+            i = to_tensor(np.float32(0.0))
+            while (i < n):
+                for _k in [1]:
+                    pass
+                else:
+                    acc = acc + 1.0
+                i = i + 1.0
+            return i
+
+        with pytest.raises(InvalidArgumentError,
+                           match="unbound at loop entry"):
+            f(_t(3.0))
+
+    def test_user_type_error_not_masked(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * None
+            else:
+                y = x
+            return y
+
+        with pytest.raises(TypeError) as e:
+            f(_t([1.0]))
+        assert "mismatched shapes" not in str(e.value)
