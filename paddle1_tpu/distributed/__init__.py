@@ -29,6 +29,8 @@ from . import ps
 from .ps import DistributedEmbedding, EmbeddingService, SparseTable
 from . import ps_server
 from .ps_server import RemoteTable, TableServer, remote_service
+from . import checkpoint
+from .checkpoint import CheckpointManager, load_sharded, save_sharded
 
 
 def __getattr__(name):

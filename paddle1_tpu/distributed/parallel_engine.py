@@ -321,6 +321,26 @@ class ParallelEngine:
             if k in sd:
                 sd[k]._data = arr
 
+    # -- sharded checkpoint (reference save_persistables sliced-vars
+    # analog; see distributed/checkpoint.py) ---------------------------------
+
+    def save_checkpoint(self, path: str) -> str:
+        """Save params + optimizer state shard-by-shard (each process
+        writes what it owns — no host gather, ZeRO-compatible)."""
+        from . import checkpoint as dckpt
+        return dckpt.save_sharded(path, {"params": self.params,
+                                         "opt_state": self.opt_state})
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore directly into the engine's current shardings and push
+        the weights back into the Layer."""
+        from . import checkpoint as dckpt
+        restored = dckpt.load_sharded(path, {"params": self.params,
+                                             "opt_state": self.opt_state})
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.sync_model()
+
     @property
     def train_step_fn(self):
         return self._jit

@@ -145,6 +145,13 @@ class EmbeddingService:
             self.shards = list(shards)
             if not self.shards:
                 raise ValueError("shards must be non-empty")
+            for k, sh in enumerate(self.shards):
+                sdim = getattr(sh, "dim", None)
+                if sdim is not None and int(sdim) != self.dim:
+                    raise ValueError(
+                        f"shard {k} serves dim={sdim} but the service was "
+                        f"configured with dim={self.dim} — the trainer and "
+                        f"table servers disagree on the embedding width")
             self.num_shards = len(self.shards)
             return
         if num_shards < 1:

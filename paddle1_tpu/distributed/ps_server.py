@@ -45,7 +45,8 @@ _MAX_MSG = 1 << 30
 
 def _send(sock, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    sock.sendall(_HDR.pack(len(payload)))
+    sock.sendall(payload)  # separate sends: no second copy of a big body
 
 
 def _recv(sock):
@@ -100,10 +101,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send(self.request, ("ok", None))
                 elif op == "ping":
                     _send(self.request, ("ok", "pong"))
+                elif op == "dim":
+                    _send(self.request, ("ok", table.dim))
                 elif op == "shutdown":
                     _send(self.request, ("ok", None))
-                    threading.Thread(
-                        target=self.server.shutdown, daemon=True).start()
+
+                    def _stop(server=self.server):
+                        server.shutdown()
+                        server.server_close()  # release the listening fd
+                    threading.Thread(target=_stop, daemon=True).start()
                     return
                 else:
                     _send(self.request, ("err", f"unknown op {op!r}"))
@@ -165,11 +171,16 @@ class RemoteTable:
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._lock = threading.Lock()
+        self.dim = self._call("dim")  # also validates the connection
 
     def _call(self, op, payload=None):
         with self._lock:
             _send(self._sock, (op, payload))
-            status, out = _recv(self._sock)
+            reply = _recv(self._sock)
+        if reply is None:
+            raise ConnectionError(
+                f"table server {self.endpoint} closed the connection")
+        status, out = reply
         if status != "ok":
             raise PreconditionNotMetError(f"table server {self.endpoint}: "
                                           f"{out}")

@@ -130,11 +130,11 @@ class TestFleetServerEntry:
         with pytest.raises(PreconditionNotMetError, match="dim"):
             fleet.fleet.init_server()
 
-    def test_server_lifecycle_via_fleet(self):
+    def test_server_lifecycle_via_fleet(self, monkeypatch):
         import paddle1_tpu.distributed.fleet as fleet
         fleet.init()
         fleet.fleet.init_server(dim=4)
-        os.environ["PADDLE_PORT"] = "0"
+        monkeypatch.setenv("PADDLE_PORT", "0")
         th = threading.Thread(target=fleet.fleet.run_server, daemon=True)
         th.start()
         # wait for the server object to bind
@@ -166,3 +166,15 @@ class TestReviewRegressions:
         monkeypatch.delenv("PADDLE_PORT", raising=False)
         with pytest.raises(PreconditionNotMetError, match="PADDLE_PORT"):
             fleet.fleet.run_server()
+
+    def test_dim_mismatch_teaches(self, server):  # server table dim=8
+        with pytest.raises(ValueError, match="dim=8"):
+            remote_service(4, [server.endpoint])
+
+    def test_closed_server_raises_connection_error(self):
+        srv = TableServer(SparseTable(4)).start()
+        t = RemoteTable(srv.endpoint)
+        t.shutdown_server()
+        with pytest.raises(ConnectionError):
+            t.ping()
+        t.close()

@@ -1,0 +1,108 @@
+"""Sharded checkpointing (distributed/checkpoint.py + engine methods):
+save shard-by-shard from a live mesh, restore into the same — or a
+DIFFERENT — sharding layout (reference save_persistables sliced-vars
+role, fluid/io.py)."""
+
+import numpy as np
+import pytest
+import jax
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core.tensor import Tensor
+from paddle1_tpu.distributed import (CheckpointManager, ParallelEngine,
+                                     build_mesh)
+
+
+def _make_engine(degrees, zero_stage=2, seed=0):
+    rng = np.random.default_rng(seed)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    # deterministic init across engines
+    for i, p in enumerate(model.parameters()):
+        p._data = jax.numpy.asarray(
+            np.random.default_rng(100 + i)
+            .standard_normal(p.shape).astype(np.float32) * 0.1)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        return ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+
+    n = int(np.prod(list(degrees.values())))
+    mesh = build_mesh(**degrees, devices=jax.devices()[:n])
+    eng = ParallelEngine(model, opt, loss_fn, mesh=mesh,
+                         zero_stage=zero_stage, donate=False)
+    batch = {"x": rng.standard_normal((8, 8)).astype(np.float32),
+             "y": rng.standard_normal((8, 4)).astype(np.float32)}
+    return eng, batch
+
+
+def _trees_close(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestShardedCheckpoint:
+    def test_save_restore_same_topology(self, tmp_path):
+        eng, batch = _make_engine({"dp": 2, "sharding": 2})
+        for _ in range(2):
+            eng.step(batch)
+        path = eng.save_checkpoint(str(tmp_path / "ck"))
+
+        eng2, _ = _make_engine({"dp": 2, "sharding": 2}, seed=1)
+        eng2.load_checkpoint(path)
+        _trees_close(eng.params, eng2.params)
+        _trees_close(eng.opt_state, eng2.opt_state)
+        # training continues identically
+        l1 = float(eng.step(batch))
+        l2 = float(eng2.step(batch))
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+    def test_restore_into_different_topology(self, tmp_path):
+        # ZeRO-2 over (dp=2, sharding=2) → restore into (dp=4) — orbax
+        # reshards on load; values identical, layout per target engine
+        eng, batch = _make_engine({"dp": 2, "sharding": 2})
+        eng.step(batch)
+        path = eng.save_checkpoint(str(tmp_path / "ck"))
+
+        eng2, _ = _make_engine({"dp": 4}, zero_stage=0, seed=2)
+        eng2.load_checkpoint(path)
+        _trees_close(eng.params, eng2.params)
+        l1 = float(eng.step(batch))
+        l2 = float(eng2.step(batch))
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_sync_model_after_load(self, tmp_path):
+        eng, batch = _make_engine({"dp": 2})
+        eng.step(batch)
+        path = eng.save_checkpoint(str(tmp_path / "ck"))
+        eng2, _ = _make_engine({"dp": 2}, seed=3)
+        eng2.load_checkpoint(path)
+        # the Layer itself carries the restored weights (save/eval path)
+        for k, arr in eng2.params.items():
+            sd = eng2.model.state_dict()
+            if k in sd:
+                np.testing.assert_allclose(np.asarray(sd[k]._data),
+                                           np.asarray(arr))
+
+    def test_manager_retention_and_latest(self, tmp_path):
+        eng, batch = _make_engine({"dp": 2})
+        mgr = CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2)
+        for s in (1, 2, 3):
+            eng.step(batch)
+            mgr.save(s, {"params": eng.params})
+        assert mgr.latest_step() == 3
+        import os
+        kept = sorted(int(d) for d in os.listdir(mgr.directory)
+                      if d.isdigit())
+        assert kept == [2, 3]
+        restored, step = mgr.restore({"params": eng.params})
+        assert step == 3
+        _trees_close(restored["params"], eng.params)
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager(str(tmp_path / "empty")).restore(
+                {"params": eng.params})
