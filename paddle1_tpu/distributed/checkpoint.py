@@ -72,6 +72,10 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
+        if int(max_to_keep) < 1:
+            raise ValueError(
+                f"max_to_keep must be >= 1, got {max_to_keep} "
+                f"(steps[:-0] would silently disable retention)")
         self.max_to_keep = int(max_to_keep)
         os.makedirs(self.directory, exist_ok=True)
 

@@ -41,6 +41,16 @@ def _parse_args(argv):
                    default=None, help="accepted for compat; TPU chips are "
                    "managed by XLA, not per-process pinning")
     p.add_argument("--log_dir", type=str, default=None)
+    # parameter-server mode (reference launch.py:278): the script serves
+    # both roles, branching on TRAINING_ROLE
+    p.add_argument("--server_num", type=int, default=0,
+                   help="PS mode: number of table-server processes")
+    p.add_argument("--servers", type=str, default="",
+                   help="PS mode: explicit server host:port list "
+                        "(overrides --server_num)")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="PS mode: number of trainer processes "
+                        "(default: nproc_per_node)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -52,6 +62,44 @@ def launch(argv: Optional[List[str]] = None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     nproc = args.nproc_per_node
     host, port = (args.master.split(":") + ["6170"])[:2]
+    if args.server_num > 0 or args.servers:
+        from .launch_utils import start_ps_procs, watch_ps_procs
+        n_trainers = (args.trainer_num if args.trainer_num is not None
+                      else nproc)
+        if args.servers:
+            server_eps = args.servers.split(",")
+            # multi-node PS: this node hosts only the servers bound to its
+            # own address, and its trainers get globally-unique ids
+            # (reference launch_utils start_pservers: per-node filtering)
+            my_ip = (args.ips.split(",")[args.node_rank] if args.ips
+                     else host)
+            local_server_eps = [ep for ep in server_eps
+                                if ep.rsplit(":", 1)[0] == my_ip] \
+                if args.nnodes > 1 else server_eps
+            trainer_id_base = args.node_rank * n_trainers
+            total_trainers = args.nnodes * n_trainers
+        elif args.nnodes > 1:
+            raise SystemExit(
+                "PS mode across nodes needs the explicit --servers "
+                "host:port list (each node must know every server and "
+                "which ones are its own); --server_num alone is "
+                "single-node")
+        else:
+            base = int(port) + 1000  # clear of the trainer port block
+            server_eps = [f"{host}:{base + i}"
+                          for i in range(args.server_num)]
+            local_server_eps = server_eps
+            trainer_id_base, total_trainers = 0, n_trainers
+        servers, trainers = start_ps_procs(
+            server_eps, n_trainers, args.training_script,
+            args.training_script_args, log_dir=args.log_dir,
+            local_server_endpoints=local_server_eps,
+            trainer_id_base=trainer_id_base,
+            total_trainers=total_trainers)
+        rc = watch_ps_procs(servers, trainers)
+        if rc != 0:
+            sys.exit(rc)
+        return
     if args.nnodes <= 1 and nproc <= 1:
         # single host, single process: exec in place (XLA owns all chips)
         env = dict(os.environ)

@@ -166,3 +166,81 @@ def watch_local_trainers(procs, poll_s: float = 1.0) -> int:
     except KeyboardInterrupt:
         terminate_local_procs(procs)
         raise
+
+
+def start_ps_procs(server_endpoints: List[str], n_trainers: int,
+                   training_script: str, training_script_args: List[str],
+                   log_dir: Optional[str] = None,
+                   local_server_endpoints: Optional[List[str]] = None,
+                   trainer_id_base: int = 0,
+                   total_trainers: Optional[int] = None):
+    """Spawn PS servers + trainers (reference launch.py:278
+    launch_ps / start_pservers+start_workers in launch_utils): each
+    server gets TRAINING_ROLE=PSERVER and its own PADDLE_PORT; trainers
+    get TRAINING_ROLE=TRAINER and the full server endpoint list. One
+    user script serves both roles by branching on TRAINING_ROLE (the
+    reference PS idiom)."""
+    eps = ",".join(server_endpoints)
+
+    def spawn(env_extra, tag):
+        env = dict(os.environ)
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = eps
+        env.update(env_extra)
+        stdout = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stdout = open(os.path.join(log_dir, tag), "w")
+        return subprocess.Popen(
+            [sys.executable, "-u", training_script] +
+            list(training_script_args), env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None)
+
+    local = (local_server_endpoints if local_server_endpoints is not None
+             else server_endpoints)
+    total = total_trainers if total_trainers is not None else n_trainers
+    servers = []
+    for i, ep in enumerate(server_endpoints):
+        if ep not in local:
+            continue  # another node's server (multi-node PS)
+        host, port = ep.rsplit(":", 1)
+        servers.append(spawn({"TRAINING_ROLE": "PSERVER",
+                              "PADDLE_PORT": port, "POD_IP": host,
+                              "PADDLE_SERVER_ID": str(i)},
+                             f"serverlog.{i}"))
+    trainers = []
+    for r in range(n_trainers):
+        gid = trainer_id_base + r
+        trainers.append(spawn({"TRAINING_ROLE": "TRAINER",
+                               "PADDLE_TRAINER_ID": str(gid),
+                               "PADDLE_TRAINERS_NUM": str(total)},
+                              f"workerlog.{gid}"))
+    return servers, trainers
+
+
+def watch_ps_procs(server_procs, trainer_procs, poll_s: float = 1.0) -> int:
+    """PS watch semantics (reference launch_utils watch for PS mode): the
+    job is DONE when every trainer exits 0 (servers are then torn down);
+    any nonzero exit — or a server stopping while trainers still run —
+    fails the job and kills everyone."""
+    try:
+        if not trainer_procs:
+            # server-only node: the job IS the servers — block until they
+            # exit, fail-fast on the first nonzero
+            return watch_local_trainers(server_procs, poll_s)
+        while True:
+            for p in server_procs + trainer_procs:
+                ret = p.poll()
+                if ret is not None and ret != 0:
+                    terminate_local_procs(server_procs + trainer_procs)
+                    return ret
+            if all(p.poll() is not None for p in trainer_procs):
+                terminate_local_procs(server_procs)
+                return 0
+            if any(p.poll() is not None for p in server_procs):
+                # a "successful" server exit mid-job still strands trainers
+                terminate_local_procs(server_procs + trainer_procs)
+                return 1
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        terminate_local_procs(server_procs + trainer_procs)
+        raise

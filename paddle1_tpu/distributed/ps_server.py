@@ -43,10 +43,18 @@ _HDR = struct.Struct("!I")
 _MAX_MSG = 1 << 30
 
 
+_SMALL_MSG = 1 << 20
+
+
 def _send(sock, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)))
-    sock.sendall(payload)  # separate sends: no second copy of a big body
+    if len(payload) < _SMALL_MSG:
+        # one segment: avoids the Nagle write-write-read stall on the
+        # per-step pull/push round-trips (the copy is cheap at this size)
+        sock.sendall(_HDR.pack(len(payload)) + payload)
+    else:
+        sock.sendall(_HDR.pack(len(payload)))
+        sock.sendall(payload)  # no second copy of a big body
 
 
 def _recv(sock):
@@ -75,6 +83,9 @@ def _recv_exact(sock, n: int) -> Optional[bytes]:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
     def handle(self):
         table: SparseTable = self.server.table  # type: ignore[attr-defined]
         while True:
@@ -170,6 +181,7 @@ class RemoteTable:
         self.endpoint = endpoint
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self.dim = self._call("dim")  # also validates the connection
 

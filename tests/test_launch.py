@@ -213,3 +213,66 @@ class TestLauncher:
         dt = time.time() - t0
         assert r.returncode == 7, (r.returncode, r.stderr.decode())
         assert dt < 60, f"watcher failed to kill the sleeping rank ({dt}s)"
+
+
+WORKER_PS = textwrap.dedent("""
+    import os
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle1_tpu as paddle
+    import paddle1_tpu.distributed.fleet as fleet
+
+    role = os.environ["TRAINING_ROLE"]
+    if role == "PSERVER":
+        fleet.init()
+        fleet.fleet.init_server(dim=4)
+        print("SERVER UP", os.environ["PADDLE_PORT"], flush=True)
+        fleet.fleet.run_server()
+    else:
+        import time
+        from paddle1_tpu.distributed import DistributedEmbedding, ps_server
+        eps = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+        svc = None
+        for _ in range(60):   # wait for servers to bind
+            try:
+                svc = ps_server.remote_service(4, eps)
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert svc is not None, "servers never came up"
+        emb = DistributedEmbedding(svc)
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        ids = np.array([0, 1, 2, 3]) + 4 * rank
+        first = None
+        for _ in range(20):
+            v = emb(ids)
+            loss = (v * v).mean()
+            loss.backward()
+            first = first if first is not None else float(loss.numpy())
+        print(f"PSTRAIN rank={rank} first={first:.8f} "
+              f"last={float(loss.numpy()):.8f}", flush=True)
+        assert float(loss.numpy()) <= first
+""")
+
+
+class TestLauncherPSMode:
+    def test_ps_job_one_server_two_trainers(self, tmp_path):
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER_PS)
+        logdir = tmp_path / "logs"
+        port = _free_port()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle1_tpu.distributed.launch",
+             "--server_num", "1", "--trainer_num", "2",
+             "--master", f"127.0.0.1:{port}",
+             "--log_dir", str(logdir), str(worker)],
+            env=_clean_env(), cwd=REPO, capture_output=True, timeout=300)
+        slog = (logdir / "serverlog.0").read_text()
+        tlogs = {i: (logdir / f"workerlog.{i}").read_text()
+                 for i in range(2)}
+        assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode(),
+                                   slog, tlogs)
+        assert "SERVER UP" in slog
+        for i in range(2):
+            assert f"PSTRAIN rank={i}" in tlogs[i], tlogs

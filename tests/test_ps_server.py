@@ -178,3 +178,8 @@ class TestReviewRegressions:
         with pytest.raises(ConnectionError):
             t.ping()
         t.close()
+
+    def test_checkpoint_manager_rejects_zero_keep(self, tmp_path):
+        from paddle1_tpu.distributed import CheckpointManager
+        with pytest.raises(ValueError, match="max_to_keep"):
+            CheckpointManager(str(tmp_path / "x"), max_to_keep=0)
