@@ -31,6 +31,7 @@ from . import ps_server
 from .ps_server import RemoteTable, TableServer, remote_service
 from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
+from .graph_table import GraphTable
 
 
 def __getattr__(name):

@@ -1,0 +1,170 @@
+"""Graph table — the graph-learning member of the PS table family.
+
+Reference analog: ``common_graph_table.h`` in
+/root/reference/paddle/fluid/distributed/table/ (GraphTable: adjacency
+lists with weighted neighbor sampling + per-node features, served by the
+brpc PS for distributed GNN training). Scoped the same way as the sparse
+table (SURVEY §7f): the graph lives in host RAM beside the input
+pipeline; the device mesh only ever sees the dense sampled id/feature
+batches.
+
+Weighted sampling uses per-node cumulative weights + binary search —
+the numpy twin of the reference's WeightedSampler
+(table/weighted_sampler.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["GraphTable"]
+
+
+class GraphTable:
+    """Host-RAM adjacency + node features with weighted neighbor
+    sampling. Thread-safe; servable via ps_server.TableServer (the
+    RPC_METHODS whitelist is the remote surface)."""
+
+    RPC_METHODS = frozenset({
+        "add_edges", "sample_neighbors", "node_degree", "num_nodes",
+        "num_edges", "set_node_feat", "get_node_feat", "random_walk",
+    })
+    dim = 0  # width handshake: a graph table has no embedding width
+
+    def __init__(self, seed: int = 0):
+        self._adj: Dict[int, list] = {}        # id -> [nbr ids]
+        self._w: Dict[int, list] = {}          # id -> [weights]
+        self._cum: Dict[int, np.ndarray] = {}  # id -> cumsum (lazy)
+        self._feat: Dict[int, np.ndarray] = {}
+        self._n_edges = 0
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    # -- construction -------------------------------------------------------
+
+    def add_edges(self, src: Sequence[int], dst: Sequence[int],
+                  weights: Optional[Sequence[float]] = None) -> None:
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if src.shape != dst.shape:
+            raise ValueError("src and dst must have the same length")
+        w = (np.asarray(weights, np.float64).reshape(-1)
+             if weights is not None else np.ones(src.shape[0]))
+        if w.shape != src.shape:
+            raise ValueError("weights must match src length")
+        if np.any(w <= 0):
+            raise ValueError("edge weights must be positive")
+        with self._lock:
+            for s, d, wt in zip(src, dst, w):
+                s = int(s)
+                self._adj.setdefault(s, []).append(int(d))
+                self._w.setdefault(s, []).append(float(wt))
+                self._cum.pop(s, None)  # invalidate the sampler cache
+            self._n_edges += src.shape[0]
+
+    # -- queries ------------------------------------------------------------
+
+    def num_nodes(self) -> int:
+        with self._lock:
+            return len(set(self._adj) | set(self._feat))
+
+    def num_edges(self) -> int:
+        return self._n_edges
+
+    def node_degree(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.asarray([len(self._adj.get(int(i), ()))
+                               for i in np.asarray(ids).reshape(-1)],
+                              np.int64)
+
+    def _cumsum(self, i: int) -> np.ndarray:
+        c = self._cum.get(i)
+        if c is None:
+            c = np.cumsum(np.asarray(self._w[i], np.float64))
+            self._cum[i] = c
+        return c
+
+    def sample_neighbors(self, ids: Sequence[int], sample_size: int,
+                         seed: Optional[int] = None) -> np.ndarray:
+        """[len(ids), sample_size] int64, weighted WITH replacement
+        (reference graph_table random_sample_neighbors semantics);
+        nodes without outgoing edges pad with -1."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full((ids.shape[0], int(sample_size)), -1, np.int64)
+        rng = np.random.default_rng(seed) if seed is not None else self._rng
+        with self._lock:
+            for r, i in enumerate(ids):
+                i = int(i)
+                nbrs = self._adj.get(i)
+                if not nbrs:
+                    continue
+                cum = self._cumsum(i)
+                u = rng.random(int(sample_size)) * cum[-1]
+                out[r] = np.asarray(nbrs, np.int64)[
+                    np.searchsorted(cum, u, side="right")]
+        return out
+
+    def random_walk(self, ids: Sequence[int], walk_len: int,
+                    seed: Optional[int] = None) -> np.ndarray:
+        """[len(ids), walk_len + 1] weighted random walks; a walk that
+        reaches a sink stays there (-1 padding for the remainder)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        walks = np.full((ids.shape[0], int(walk_len) + 1), -1, np.int64)
+        walks[:, 0] = ids
+        cur = ids
+        for t in range(1, int(walk_len) + 1):
+            step = self.sample_neighbors(cur, 1, seed=None if seed is None
+                                         else seed + t)[:, 0]
+            alive = (cur >= 0) & (step >= 0)
+            nxt = np.where(alive, step, -1)
+            walks[:, t] = nxt
+            cur = nxt
+        return walks
+
+    # -- node features ------------------------------------------------------
+
+    def set_node_feat(self, ids: Sequence[int], feats) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        feats = np.asarray(feats, np.float32)
+        if feats.ndim != 2 or feats.shape[0] != ids.shape[0]:
+            raise ValueError("feats must be [len(ids), feat_dim]")
+        with self._lock:
+            for k, i in enumerate(ids):
+                self._feat[int(i)] = feats[k].copy()
+
+    def get_node_feat(self, ids: Sequence[int],
+                      feat_dim: Optional[int] = None) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            if feat_dim is None:
+                if not self._feat:
+                    raise ValueError("no features stored and no feat_dim")
+                feat_dim = next(iter(self._feat.values())).shape[0]
+            out = np.zeros((ids.shape[0], int(feat_dim)), np.float32)
+            for k, i in enumerate(ids):
+                f = self._feat.get(int(i))
+                if f is not None:
+                    out[k] = f
+        return out
+
+    # -- persistence (same contract as SparseTable) -------------------------
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"adj": {i: list(v) for i, v in self._adj.items()},
+                    "w": {i: list(v) for i, v in self._w.items()},
+                    "feat": {i: f.copy() for i, f in self._feat.items()},
+                    "n_edges": self._n_edges}
+
+    def load_state_dict(self, state: dict) -> None:
+        with self._lock:
+            self._adj = {int(i): list(v)
+                         for i, v in state["adj"].items()}
+            self._w = {int(i): list(v) for i, v in state["w"].items()}
+            self._feat = {int(i): np.asarray(f, np.float32)
+                          for i, f in state["feat"].items()}
+            self._cum = {}
+            self._n_edges = int(state["n_edges"])

@@ -71,6 +71,10 @@ def launch(argv: Optional[List[str]] = None):
             # multi-node PS: this node hosts only the servers bound to its
             # own address, and its trainers get globally-unique ids
             # (reference launch_utils start_pservers: per-node filtering)
+            if args.nnodes > 1 and not args.ips:
+                raise SystemExit(
+                    "multi-node PS needs --ips so each node knows its own "
+                    "address (the master host is only correct for node 0)")
             my_ip = (args.ips.split(",")[args.node_rank] if args.ips
                      else host)
             local_server_eps = [ep for ep in server_eps

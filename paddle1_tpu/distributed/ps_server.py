@@ -114,6 +114,19 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send(self.request, ("ok", "pong"))
                 elif op == "dim":
                     _send(self.request, ("ok", table.dim))
+                elif op == "call":
+                    # generic table method — whitelisted per table class
+                    # (GraphTable sampling ops etc.); never arbitrary attrs
+                    method, args, kwargs = payload
+                    allowed = getattr(table, "RPC_METHODS", frozenset())
+                    if method not in allowed:
+                        _send(self.request,
+                              ("err", f"method {method!r} not in this "
+                                      f"table's RPC_METHODS"))
+                    else:
+                        _send(self.request,
+                              ("ok", getattr(table, method)(*args,
+                                                            **kwargs)))
                 elif op == "shutdown":
                     _send(self.request, ("ok", None))
 
@@ -216,6 +229,11 @@ class RemoteTable:
 
     def ping(self) -> bool:
         return self._call("ping") == "pong"
+
+    def call(self, method: str, *args, **kwargs):
+        """Invoke a whitelisted table method remotely (GraphTable's
+        sampling surface and other non-embedding tables)."""
+        return self._call("call", (method, args, kwargs))
 
     def shutdown_server(self) -> None:
         self._call("shutdown")

@@ -2,5 +2,8 @@
 ERNIE model zoo (BASELINE.md configs 3/4)."""
 
 from . import models
+from .datasets import (Conll05st, FakeTextDataset, Imdb, Imikolov,
+                       Movielens, UCIHousing, WMT14, WMT16)
 
-__all__ = ["models"]
+__all__ = ["models", "Imdb", "Imikolov", "Movielens", "Conll05st",
+           "UCIHousing", "WMT14", "WMT16", "FakeTextDataset"]

@@ -134,3 +134,347 @@ def mlm_masking(ids, vocab_size, mask_prob=0.15, mask_token_id=4,
     masked[rand_sel] = rng.integers(5, vocab_size,
                                     rand_sel.sum()).astype(ids.dtype)
     return masked, labels
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference text/datasets/imikolov.py):
+    builds the word dict from train+valid with a frequency cutoff
+    (sorted by (-freq, word), ``<unk>`` last), then yields NGRAM windows
+    or SEQ (src, trg) pairs over ``<s>``/``<e>``-wrapped sentences."""
+
+    _TRAIN = "./simple-examples/data/ptb.train.txt"
+    _VALID = "./simple-examples/data/ptb.valid.txt"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50):
+        if data_file is None:
+            _no_download("Imikolov")
+        data_type = data_type.upper()
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        if data_type == "NGRAM" and window_size < 2:
+            raise ValueError("NGRAM needs window_size >= 2")
+        import collections
+        with tarfile.open(data_file) as tf:
+            def lines(name):
+                return [ln.decode("utf-8", "ignore")
+                        for ln in tf.extractfile(name).read().splitlines()]
+            train, valid = lines(self._TRAIN), lines(self._VALID)
+        freq = collections.defaultdict(int)
+        for corpus in (train, valid):
+            for ln in corpus:
+                for w in ln.strip().split():
+                    freq[w] += 1
+                freq["<s>"] += 1
+                freq["<e>"] += 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > min_word_freq), key=lambda x: (-x[1], x[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        corpus = train if mode == "train" else valid
+        self.data = []
+        for ln in corpus:
+            toks = ["<s>"] + ln.strip().split() + ["<e>"]
+            ids = [self.word_idx.get(w, unk) for w in toks]
+            if data_type == "NGRAM":
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - window_size:i]))
+            else:
+                if len(ids) > 2:
+                    self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(d, np.int64) for d in self.data[idx]) \
+            if isinstance(self.data[idx][0], list) \
+            else np.asarray(self.data[idx], np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """ml-1m ratings (reference text/datasets/movielens.py): parses
+    movies.dat/users.dat/ratings.dat (``::``-separated, latin-1) and
+    yields (movie_id, category_ids, title_ids, user_id, gender, age,
+    job, rating) as arrays."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        if data_file is None:
+            _no_download("Movielens")
+        import zipfile
+        cat_dict, title_vocab = {}, {}
+        movies, users = {}, {}
+        with zipfile.ZipFile(data_file) as zf:
+            root = "ml-1m"
+            def lines(name):
+                return zf.read(f"{root}/{name}").decode(
+                    "latin-1").splitlines()
+            for ln in lines("movies.dat"):
+                if not ln.strip():
+                    continue
+                mid, title, cats = ln.strip().split("::")
+                tids = []
+                for w in title.split():
+                    tids.append(title_vocab.setdefault(w,
+                                                       len(title_vocab)))
+                cids = []
+                for c in cats.split("|"):
+                    cids.append(cat_dict.setdefault(c, len(cat_dict)))
+                movies[int(mid)] = (cids, tids)
+            for ln in lines("users.dat"):
+                if not ln.strip():
+                    continue
+                uid, gender, age, job = ln.strip().split("::")[:4]
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+            self.data = []
+            rng = np.random.default_rng(rand_seed)
+            for ln in lines("ratings.dat"):
+                if not ln.strip():
+                    continue
+                uid, mid, rating = ln.strip().split("::")[:3]
+                uid, mid = int(uid), int(mid)
+                if mid not in movies or uid not in users:
+                    continue
+                is_test = rng.random() < test_ratio
+                if (mode == "test") != is_test:
+                    continue
+                cids, tids = movies[mid]
+                g, a, j = users[uid]
+                self.data.append((mid, cids, tids, uid, g, a, j,
+                                  float(rating)))
+        self.categories_dict = cat_dict
+        self.movie_title_dict = title_vocab
+
+    def __getitem__(self, idx):
+        mid, cids, tids, uid, g, a, j, r = self.data[idx]
+        return (np.array([mid], np.int64), np.asarray(cids, np.int64),
+                np.asarray(tids, np.int64), np.array([uid], np.int64),
+                np.array([g], np.int64), np.array([a], np.int64),
+                np.array([j], np.int64), np.array([r], np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference text/datasets/conll05.py):
+    aligned words/props member files, one (sentence, predicate, labels)
+    sample per predicate column, props brackets converted to B-/I-/O
+    tags."""
+
+    _WORDS = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+    _PROPS = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+    def __init__(self, data_file=None):
+        if data_file is None:
+            _no_download("Conll05st")
+        with tarfile.open(data_file) as tf:
+            words_txt = gzip.decompress(
+                tf.extractfile(self._WORDS).read()).decode("utf-8")
+            props_txt = gzip.decompress(
+                tf.extractfile(self._PROPS).read()).decode("utf-8")
+        self.sentences, self.predicates, self.labels = [], [], []
+        w_sents = self._split_sents(words_txt)
+        p_sents = self._split_sents(props_txt)
+        for words, props in zip(w_sents, p_sents):
+            toks = [w.split()[0] for w in words]
+            cols = [p.split() for p in props]
+            lemmas = [c[0] for c in cols]
+            n_preds = len(cols[0]) - 1
+            for k in range(n_preds):
+                brackets = [c[k + 1] for c in cols]
+                tags = self._to_bio(brackets)
+                pred_rows = [i for i, t in enumerate(tags)
+                             if t.endswith("-V")]
+                pred = lemmas[pred_rows[0]] if pred_rows else "-"
+                self.sentences.append(toks)
+                self.predicates.append(pred)
+                self.labels.append(tags)
+        self.word_dict = self._vocab(w for s in self.sentences for w in s)
+        self.predicate_dict = self._vocab(self.predicates)
+        self.label_dict = self._vocab(t for ts in self.labels for t in ts)
+
+    @staticmethod
+    def _split_sents(text):
+        sents, cur = [], []
+        for ln in text.splitlines():
+            if ln.strip():
+                cur.append(ln.strip())
+            elif cur:
+                sents.append(cur)
+                cur = []
+        if cur:
+            sents.append(cur)
+        return sents
+
+    @staticmethod
+    def _to_bio(brackets):
+        tags, role = [], None
+        for b in brackets:
+            b = b.strip()
+            opened = None
+            if "(" in b:
+                opened = b[b.index("(") + 1:].split("*")[0]
+            if opened is not None:
+                tags.append(f"B-{opened}")
+                role = opened
+            elif role is not None:
+                tags.append(f"I-{role}")
+            else:
+                tags.append("O")
+            if ")" in b:
+                role = None
+        return tags
+
+    @staticmethod
+    def _vocab(items):
+        out = {}
+        for it in items:
+            out.setdefault(it, len(out))
+        return out
+
+    def __getitem__(self, idx):
+        words = np.asarray([self.word_dict[w]
+                            for w in self.sentences[idx]], np.int64)
+        pred = np.array([self.predicate_dict[self.predicates[idx]]],
+                        np.int64)
+        labels = np.asarray([self.label_dict[t]
+                             for t in self.labels[idx]], np.int64)
+        return words, pred, labels
+
+    def __len__(self):
+        return len(self.sentences)
+
+
+class _WMTBase(Dataset):
+    _BOS, _EOS, _UNK = "<s>", "<e>", "<unk>"
+
+    def _encode(self, pairs, src_dict, trg_dict):
+        for d, side in ((src_dict, "src"), (trg_dict, "trg")):
+            missing = [t for t in (self._BOS, self._EOS, self._UNK)
+                       if t not in d]
+            if missing:
+                raise ValueError(
+                    f"{side} dict lacks special tokens {missing} — "
+                    f"dict_size must cover <s>/<e>/<unk> (>= 3) and the "
+                    f"dict file must begin with them")
+        bos, eos = trg_dict[self._BOS], trg_dict[self._EOS]
+        sunk, tunk = src_dict[self._UNK], trg_dict[self._UNK]
+        self.data = []
+        for src, trg in pairs:
+            s = [src_dict.get(w, sunk) for w in src]
+            t = [trg_dict.get(w, tunk) for w in trg]
+            self.data.append((s, [bos] + t, t + [eos]))
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(d, np.int64) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """WMT14 en→fr (reference text/datasets/wmt14.py): archive carries
+    src.dict/trg.dict (one word per line, first dict_size used) and
+    train/test members of tab-separated sentence pairs; yields
+    (src_ids, <s>+trg_ids, trg_ids+<e>)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        if data_file is None:
+            _no_download("WMT14")
+        if dict_size <= 0:
+            raise ValueError("dict_size must be positive")
+        with tarfile.open(data_file) as tf:
+            src_dict = trg_dict = None
+            pairs = []
+            want = mode
+            for m in tf.getmembers():
+                if m.name.endswith("src.dict"):
+                    src_dict = self._read_dict(tf.extractfile(m),
+                                               dict_size)
+                elif m.name.endswith("trg.dict"):
+                    trg_dict = self._read_dict(tf.extractfile(m),
+                                               dict_size)
+                elif f"{want}/{want}" in m.name and m.isfile():
+                    for ln in tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").splitlines():
+                        parts = ln.split("\t")
+                        if len(parts) >= 2:
+                            pairs.append((parts[0].split(),
+                                          parts[1].split()))
+        if src_dict is None or trg_dict is None:
+            raise ValueError("archive lacks src.dict/trg.dict members")
+        self.src_ids, self.trg_ids = src_dict, trg_dict
+        self._encode(pairs, src_dict, trg_dict)
+
+    @staticmethod
+    def _read_dict(fd, size):
+        out = {}
+        for i, ln in enumerate(fd.read().decode("utf-8",
+                                                "ignore").splitlines()):
+            if i >= size:
+                break
+            out[ln.strip()] = i
+        return out
+
+
+class WMT16(_WMTBase):
+    """WMT16 en↔de (reference text/datasets/wmt16.py): tab-separated
+    pair files wmt16/{train,val,test}; dictionaries are built from the
+    TRAIN split with a size cap (reference builds and caches them the
+    same way), special tokens first."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        if data_file is None:
+            _no_download("WMT16")
+        if mode not in ("train", "val", "test"):
+            raise ValueError("mode must be train/val/test")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes must be positive")
+        src_col, trg_col = (0, 1) if lang == "en" else (1, 0)
+
+        def read_pairs(tf, which):
+            pairs = []
+            for m in tf.getmembers():
+                if m.name.endswith(f"wmt16/{which}") and m.isfile():
+                    for ln in tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").splitlines():
+                        parts = ln.split("\t")
+                        if len(parts) >= 2:
+                            pairs.append((parts[src_col].split(),
+                                          parts[trg_col].split()))
+            return pairs
+
+        with tarfile.open(data_file) as tf:
+            train_pairs = read_pairs(tf, "train")
+            pairs = train_pairs if mode == "train" else read_pairs(tf,
+                                                                   mode)
+        src_dict = self._build_dict((p[0] for p in train_pairs),
+                                    src_dict_size)
+        trg_dict = self._build_dict((p[1] for p in train_pairs),
+                                    trg_dict_size)
+        self.src_ids, self.trg_ids = src_dict, trg_dict
+        self._encode(pairs, src_dict, trg_dict)
+
+    @classmethod
+    def _build_dict(cls, seqs, size):
+        import collections
+        freq = collections.Counter()
+        for s in seqs:
+            freq.update(s)
+        out = {cls._BOS: 0, cls._EOS: 1, cls._UNK: 2}
+        for w, _ in sorted(freq.items(), key=lambda x: (-x[1], x[0])):
+            if len(out) >= size:
+                break
+            if w not in out:
+                out[w] = len(out)
+        return out
+
+
+__all__ += ["Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]

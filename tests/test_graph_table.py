@@ -1,0 +1,98 @@
+"""GraphTable (distributed/graph_table.py) — the graph-learning PS table
+(reference distributed/table/common_graph_table.h: weighted neighbor
+sampling + node features, served over the PS transport)."""
+
+import numpy as np
+import pytest
+
+from paddle1_tpu.distributed import GraphTable
+from paddle1_tpu.distributed.ps_server import RemoteTable, TableServer
+
+
+def _chain_graph():
+    g = GraphTable(seed=0)
+    # 0 -> 1 (w=1), 0 -> 2 (w=3); 1 -> 2; 2 is a sink
+    g.add_edges([0, 0, 1], [1, 2, 2], weights=[1.0, 3.0, 1.0])
+    return g
+
+
+class TestGraphTable:
+    def test_degree_counts(self):
+        g = _chain_graph()
+        np.testing.assert_array_equal(g.node_degree([0, 1, 2, 9]),
+                                      [2, 1, 0, 0])
+        assert g.num_edges() == 3
+        assert g.num_nodes() == 2  # nodes with outgoing edges or feats
+
+    def test_weighted_sampling_distribution(self):
+        g = _chain_graph()
+        s = g.sample_neighbors([0], 8000, seed=7)[0]
+        frac2 = float(np.mean(s == 2))
+        assert abs(frac2 - 0.75) < 0.03  # weight 3:1 toward node 2
+
+    def test_sink_pads_minus_one(self):
+        g = _chain_graph()
+        np.testing.assert_array_equal(g.sample_neighbors([2], 4),
+                                      [[-1, -1, -1, -1]])
+
+    def test_random_walk_respects_sinks(self):
+        g = _chain_graph()
+        w = g.random_walk([0, 2], 3, seed=1)
+        assert w.shape == (2, 4)
+        assert w[0, 0] == 0 and w[1, 0] == 2
+        assert w[1, 1] == -1  # sink stays terminated
+        row = w[0]
+        ended = False
+        for v in row[1:]:
+            if v == -1:
+                ended = True
+            assert not (ended and v != -1), "walk resumed after sink"
+
+    def test_node_features_roundtrip(self):
+        g = _chain_graph()
+        g.set_node_feat([0, 2], np.arange(8, dtype=np.float32)
+                        .reshape(2, 4))
+        f = g.get_node_feat([0, 1, 2])
+        np.testing.assert_allclose(f[0], [0, 1, 2, 3])
+        np.testing.assert_allclose(f[1], 0)  # unknown node → zeros
+        np.testing.assert_allclose(f[2], [4, 5, 6, 7])
+
+    def test_state_roundtrip(self):
+        g = _chain_graph()
+        g.set_node_feat([0], np.ones((1, 2), np.float32))
+        g2 = GraphTable()
+        g2.load_state_dict(g.state_dict())
+        assert g2.num_edges() == 3
+        np.testing.assert_array_equal(g2.node_degree([0]), [2])
+        np.testing.assert_allclose(g2.get_node_feat([0]), [[1.0, 1.0]])
+
+    def test_validation(self):
+        g = GraphTable()
+        with pytest.raises(ValueError, match="same length"):
+            g.add_edges([1, 2], [3])
+        with pytest.raises(ValueError, match="positive"):
+            g.add_edges([1], [2], weights=[0.0])
+
+
+class TestGraphTableOverWire:
+    def test_remote_sampling_and_feats(self):
+        srv = TableServer(_chain_graph()).start()
+        try:
+            t = RemoteTable(srv.endpoint)
+            assert t.dim == 0  # graph tables have no embedding width
+            np.testing.assert_array_equal(
+                t.call("node_degree", [0, 1, 2]), [2, 1, 0])
+            s = t.call("sample_neighbors", [0], 2000, seed=3)
+            assert abs(float(np.mean(s == 2)) - 0.75) < 0.05
+            t.call("set_node_feat", [1],
+                   np.full((1, 3), 2.0, np.float32))
+            np.testing.assert_allclose(t.call("get_node_feat", [1]),
+                                       [[2.0, 2.0, 2.0]])
+            # non-whitelisted method refused
+            from paddle1_tpu.core.errors import PreconditionNotMetError
+            with pytest.raises(PreconditionNotMetError,
+                               match="RPC_METHODS"):
+                t.call("load_state_dict", {})
+            t.close()
+        finally:
+            srv.stop()

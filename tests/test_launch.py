@@ -268,11 +268,10 @@ class TestLauncherPSMode:
              "--master", f"127.0.0.1:{port}",
              "--log_dir", str(logdir), str(worker)],
             env=_clean_env(), cwd=REPO, capture_output=True, timeout=300)
+        assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode())
         slog = (logdir / "serverlog.0").read_text()
         tlogs = {i: (logdir / f"workerlog.{i}").read_text()
                  for i in range(2)}
-        assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode(),
-                                   slog, tlogs)
         assert "SERVER UP" in slog
         for i in range(2):
             assert f"PSTRAIN rank={i}" in tlogs[i], tlogs

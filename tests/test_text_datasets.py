@@ -1,0 +1,201 @@
+"""Text dataset parsers (paddle1_tpu/text/datasets.py) against
+miniature archives synthesized in the OFFICIAL formats (no network
+egress; reference parsers: python/paddle/text/datasets/)."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle1_tpu.text import (Conll05st, Imikolov, Movielens, WMT14,
+                              WMT16)
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture()
+def ptb_tgz(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = "the cat sat\nthe dog sat\nthe cat ran\n" * 20
+    valid = "the cat sat\n" * 5
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt",
+                 train.encode())
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt",
+                 valid.encode())
+    return str(p)
+
+
+class TestImikolov:
+    def test_ngram_windows(self, ptb_tgz):
+        ds = Imikolov(ptb_tgz, data_type="NGRAM", window_size=3,
+                      min_word_freq=1)
+        assert len(ds) > 0
+        sample = ds[0]
+        assert sample.shape == (3,)
+        # dict: frequency-sorted, <unk> last
+        assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+        assert ds.word_idx["the"] < ds.word_idx["dog"]
+
+    def test_seq_mode_shifted_pair(self, ptb_tgz):
+        ds = Imikolov(ptb_tgz, data_type="SEQ", min_word_freq=1)
+        src, trg = ds[0]
+        assert len(src) == len(trg)
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_cutoff_drops_rare_words(self, ptb_tgz):
+        ds = Imikolov(ptb_tgz, data_type="NGRAM", window_size=2,
+                      min_word_freq=30)
+        assert "dog" not in ds.word_idx  # appears 20x <= 30
+
+
+@pytest.fixture()
+def ml1m_zip(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    movies = "1::Toy Story (1995)::Animation|Children's\n" \
+             "2::Heat (1995)::Action|Crime\n"
+    users = "1::M::25::4::55455\n2::F::35::7::55117\n"
+    ratings = "1::1::5::978300760\n1::2::3::978302109\n" \
+              "2::1::4::978301968\n"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/movies.dat", movies)
+        zf.writestr("ml-1m/users.dat", users)
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    return str(p)
+
+
+class TestMovielens:
+    def test_parse_and_fields(self, ml1m_zip):
+        ds = Movielens(ml1m_zip, mode="train", test_ratio=0.0)
+        assert len(ds) == 3
+        mid, cids, tids, uid, g, age, job, r = ds[0]
+        assert mid[0] == 1 and uid[0] == 1
+        assert g[0] == 0 and age[0] == 25 and job[0] == 4
+        assert r[0] == 5.0
+        assert len(ds.categories_dict) == 4  # Animation,Children's,Action,Crime
+        # female user mapped to 1
+        _, _, _, uid2, g2, _, _, _ = ds[2]
+        assert uid2[0] == 2 and g2[0] == 1
+
+    def test_split_disjoint(self, ml1m_zip):
+        tr = Movielens(ml1m_zip, mode="train", test_ratio=0.5,
+                       rand_seed=3)
+        te = Movielens(ml1m_zip, mode="test", test_ratio=0.5, rand_seed=3)
+        assert len(tr) + len(te) == 3
+
+
+@pytest.fixture()
+def conll_tgz(tmp_path):
+    p = tmp_path / "conll05st-tests.tar.gz"
+    # two sentences; first has 2 predicates (2 prop columns)
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = ("-\t(A0*\t(A0*\n"
+             "-\t*)\t*)\n"
+             "sit\t(V*)\t(V*)\n"
+             "\n"
+             "-\t(A0*)\n"
+             "bark\t(V*)\n"
+             "\n")
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gzip.compress(words.encode()))
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gzip.compress(props.encode()))
+    return str(p)
+
+
+class TestConll05st:
+    def test_one_sample_per_predicate(self, conll_tgz):
+        ds = Conll05st(conll_tgz)
+        assert len(ds) == 3  # 2 predicates + 1 predicate
+        words, pred, labels = ds[0]
+        assert words.shape == labels.shape == (3,)
+        inv_label = {v: k for k, v in ds.label_dict.items()}
+        tags = [inv_label[i] for i in labels]
+        assert tags == ["B-A0", "I-A0", "B-V"]
+        inv_pred = {v: k for k, v in ds.predicate_dict.items()}
+        assert inv_pred[int(pred[0])] == "sit"
+
+    def test_single_token_span_closes(self, conll_tgz):
+        ds = Conll05st(conll_tgz)
+        words, pred, labels = ds[2]  # "Dogs bark"
+        inv = {v: k for k, v in ds.label_dict.items()}
+        assert [inv[i] for i in labels] == ["B-A0", "B-V"]
+
+
+@pytest.fixture()
+def wmt14_tgz(tmp_path):
+    p = tmp_path / "wmt14.tgz"
+    src_dict = "<s>\n<e>\n<unk>\nle\nchat\nnoir\n"
+    trg_dict = "<s>\n<e>\n<unk>\nthe\ncat\nblack\n"
+    train = "le chat\tthe cat\nle noir\tthe black\n"
+    test = "le chat noir\tthe black cat\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", src_dict.encode())
+        _tar_add(tf, "wmt14/trg.dict", trg_dict.encode())
+        _tar_add(tf, "wmt14/train/train", train.encode())
+        _tar_add(tf, "wmt14/test/test", test.encode())
+    return str(p)
+
+
+class TestWMT14:
+    def test_triplets(self, wmt14_tgz):
+        ds = WMT14(wmt14_tgz, mode="train", dict_size=6)
+        assert len(ds) == 2
+        src, trg_in, trg_out = ds[0]
+        np.testing.assert_array_equal(src, [3, 4])       # le chat
+        assert trg_in[0] == ds.trg_ids["<s>"]
+        assert trg_out[-1] == ds.trg_ids["<e>"]
+        np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+    def test_unk_and_dict_cap(self, wmt14_tgz):
+        ds = WMT14(wmt14_tgz, mode="test", dict_size=4)  # drops chat/noir
+        src, _, _ = ds[0]
+        unk = ds.src_ids["<unk>"]
+        np.testing.assert_array_equal(src, [3, unk, unk])
+
+    def test_requires_dict_size(self, wmt14_tgz):
+        with pytest.raises(ValueError, match="dict_size"):
+            WMT14(wmt14_tgz)
+
+
+@pytest.fixture()
+def wmt16_tar(tmp_path):
+    p = tmp_path / "wmt16.tar"
+    train = "the cat\tdie katze\nthe dog\tder hund\n"
+    val = "the cat\tdie katze\n"
+    with tarfile.open(p, "w") as tf:
+        _tar_add(tf, "wmt16/train", train.encode())
+        _tar_add(tf, "wmt16/val", val.encode())
+        _tar_add(tf, "wmt16/test", val.encode())
+    return str(p)
+
+
+class TestWMT16:
+    def test_dict_built_from_train(self, wmt16_tar):
+        ds = WMT16(wmt16_tar, mode="val", src_dict_size=10,
+                   trg_dict_size=10)
+        assert len(ds) == 1
+        src, trg_in, trg_out = ds[0]
+        assert ds.src_ids["<s>"] == 0 and ds.src_ids["<unk>"] == 2
+        assert "the" in ds.src_ids and "katze" in ds.trg_ids
+        assert trg_in[0] == 0 and trg_out[-1] == 1
+
+    def test_lang_swap(self, wmt16_tar):
+        en = WMT16(wmt16_tar, mode="train", src_dict_size=10,
+                   trg_dict_size=10, lang="en")
+        de = WMT16(wmt16_tar, mode="train", src_dict_size=10,
+                   trg_dict_size=10, lang="de")
+        assert "the" in en.src_ids and "the" in de.trg_ids
+
+    def test_tiny_dict_teaches(self, wmt14_tgz):
+        with pytest.raises(ValueError, match="special tokens"):
+            WMT14(wmt14_tgz, mode="train", dict_size=2)
