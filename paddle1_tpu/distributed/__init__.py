@@ -31,6 +31,7 @@ from . import ps_server
 from .ps_server import RemoteTable, TableServer, remote_service
 from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
+from . import graph_table
 from .graph_table import GraphTable
 
 
@@ -63,4 +64,8 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "sharding_specs", "spawn", "launch", "ParallelEngine",
            "make_train_step", "sequence_parallel", "ring_attention",
            "ulysses_attention", "pipeline", "pipeline_apply",
-           "stack_stage_params"]
+           "stack_stage_params",
+           "ps", "SparseTable", "EmbeddingService", "DistributedEmbedding",
+           "ps_server", "TableServer", "RemoteTable", "remote_service",
+           "checkpoint", "CheckpointManager", "save_sharded",
+           "load_sharded", "graph_table", "GraphTable"]

@@ -37,7 +37,7 @@ class GraphTable:
     def __init__(self, seed: int = 0):
         self._adj: Dict[int, list] = {}        # id -> [nbr ids]
         self._w: Dict[int, list] = {}          # id -> [weights]
-        self._cum: Dict[int, np.ndarray] = {}  # id -> cumsum (lazy)
+        self._cum: Dict[int, tuple] = {}       # id -> (nbr arr, cumsum)
         self._feat: Dict[int, np.ndarray] = {}
         self._n_edges = 0
         self._rng = np.random.default_rng(seed)
@@ -80,10 +80,13 @@ class GraphTable:
                                for i in np.asarray(ids).reshape(-1)],
                               np.int64)
 
-    def _cumsum(self, i: int) -> np.ndarray:
+    def _sampler(self, i: int):
+        """(neighbor int64 array, cumulative weights) — both cached; the
+        hot sampling path must not rebuild arrays under the lock."""
         c = self._cum.get(i)
         if c is None:
-            c = np.cumsum(np.asarray(self._w[i], np.float64))
+            c = (np.asarray(self._adj[i], np.int64),
+                 np.cumsum(np.asarray(self._w[i], np.float64)))
             self._cum[i] = c
         return c
 
@@ -98,13 +101,15 @@ class GraphTable:
         with self._lock:
             for r, i in enumerate(ids):
                 i = int(i)
-                nbrs = self._adj.get(i)
-                if not nbrs:
+                if not self._adj.get(i):
                     continue
-                cum = self._cumsum(i)
+                nbrs, cum = self._sampler(i)
                 u = rng.random(int(sample_size)) * cum[-1]
-                out[r] = np.asarray(nbrs, np.int64)[
-                    np.searchsorted(cum, u, side="right")]
+                # u == cum[-1] is possible (rng.random() can round to the
+                # top); clamp like np.random.choice does
+                idx = np.minimum(np.searchsorted(cum, u, side="right"),
+                                 len(cum) - 1)
+                out[r] = nbrs[idx]
         return out
 
     def random_walk(self, ids: Sequence[int], walk_len: int,

@@ -205,6 +205,8 @@ class Movielens(Dataset):
                  rand_seed=0):
         if data_file is None:
             _no_download("Movielens")
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be train or test")
         import zipfile
         cat_dict, title_vocab = {}, {}
         movies, users = {}, {}
@@ -387,6 +389,8 @@ class WMT14(_WMTBase):
     def __init__(self, data_file=None, mode="train", dict_size=-1):
         if data_file is None:
             _no_download("WMT14")
+        if mode not in ("train", "test", "gen"):
+            raise ValueError("mode must be train/test/gen")
         if dict_size <= 0:
             raise ValueError("dict_size must be positive")
         with tarfile.open(data_file) as tf:

@@ -137,3 +137,186 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.num_samples
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory image tree (reference vision/datasets/
+    folder.py:65): root/class_x/xxx.png → (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(extensions)
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for sub, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    p = os.path.join(sub, fn)
+                    if is_valid_file(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"found 0 files in subfolders of {root}; supported "
+                f"extensions: {extensions}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive unlabeled image folder (reference folder.py:222):
+    yields [sample] per image."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(extensions)
+        self.samples = []
+        for sub, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(sub, fn)
+                if is_valid_file(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(f"found 0 images under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py):
+    102flowers.tgz image archive + imagelabels.mat + setid.mat; the
+    split comes from setid's trnid/valid/tstid index lists (1-based)."""
+
+    _SETID_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None):
+        if None in (data_file, label_file, setid_file):
+            _no_download("Flowers")
+        if mode not in self._SETID_KEY:
+            raise ValueError("mode must be train/valid/test")
+        import scipy.io as sio
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].reshape(-1)
+        indexes = sio.loadmat(setid_file)[
+            self._SETID_KEY[mode]].reshape(-1)
+        wanted = {f"jpg/image_{int(i):05d}.jpg": int(i) for i in indexes}
+        # one sequential pass over the archive, keeping the COMPRESSED
+        # jpeg bytes per sample: picklable for DataLoader workers, no
+        # shared fd, no per-__getitem__ gzip rewind (a .tgz member seek
+        # re-decompresses from the stream start)
+        self.samples = []
+        with tarfile.open(data_file) as tar:
+            for m in tar:
+                i = wanted.get(m.name)
+                if i is not None:
+                    self.samples.append((tar.extractfile(m).read(),
+                                         int(labels[i - 1])))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        raw, label = self.samples[idx]
+        img = Image.open(_io.BytesIO(raw)).convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([label], np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference vision/datasets/
+    voc2012.py): the trainval archive's ImageSets/Segmentation lists
+    select JPEGImages/x.jpg + SegmentationClass/x.png; yields
+    (image HWC uint8 array, label mask HW uint8 array)."""
+
+    _ROOT = "VOCdevkit/VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None):
+        if data_file is None:
+            _no_download("VOC2012")
+        if mode not in ("train", "val", "trainval"):
+            raise ValueError("mode must be train/val/trainval")
+        self.transform = transform
+        with tarfile.open(data_file) as tar:
+            listing = tar.extractfile(
+                f"{self._ROOT}/ImageSets/Segmentation/{mode}.txt")
+            names = [ln.strip() for ln in
+                     listing.read().decode().splitlines() if ln.strip()]
+            blobs = {}
+            want = set()
+            for n in names:
+                want.add(f"{self._ROOT}/JPEGImages/{n}.jpg")
+                want.add(f"{self._ROOT}/SegmentationClass/{n}.png")
+            for m in tar:
+                if m.name in want:
+                    blobs[m.name] = tar.extractfile(m).read()
+        # compressed bytes in memory (see Flowers): worker-safe + one pass
+        self.samples = []
+        for n in names:
+            jpg = blobs.get(f"{self._ROOT}/JPEGImages/{n}.jpg")
+            png = blobs.get(f"{self._ROOT}/SegmentationClass/{n}.png")
+            if jpg is not None and png is not None:
+                self.samples.append((jpg, png))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        jpg, png = self.samples[idx]
+        image = np.asarray(Image.open(_io.BytesIO(jpg)).convert("RGB"))
+        label = np.asarray(Image.open(_io.BytesIO(png)))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012",
+            "IMG_EXTENSIONS"]

@@ -1,0 +1,116 @@
+"""Vision datasets added by the r3 parity sweep (DatasetFolder,
+ImageFolder, Flowers, VOC2012) against miniature archives in the
+official formats (reference vision/datasets/{folder,flowers,voc2012})."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle1_tpu.vision.datasets import (DatasetFolder, Flowers,
+                                         ImageFolder, VOC2012)
+
+
+def _png_bytes(w=6, h=6, value=128, mode="RGB"):
+    from PIL import Image
+    arr = np.full((h, w, 3) if mode == "RGB" else (h, w), value, np.uint8)
+    img = Image.fromarray(arr, mode=mode)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(w=6, h=6, value=128):
+    from PIL import Image
+    arr = np.full((h, w, 3), value, np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _tar_add(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+class TestFolders:
+    def test_dataset_folder(self, tmp_path):
+        for cls, n in (("ants", 2), ("bees", 3)):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(n):
+                (d / f"{i}.png").write_bytes(_png_bytes())
+        (tmp_path / "notes.txt").write_text("ignored")
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["ants", "bees"]
+        assert len(ds) == 5
+        img, target = ds[0]
+        assert target == 0
+        assert np.asarray(img).shape == (6, 6, 3)
+        assert ds.samples[-1][1] == 1
+
+    def test_dataset_folder_empty_raises(self, tmp_path):
+        (tmp_path / "empty_class").mkdir()
+        with pytest.raises(RuntimeError, match="0 files"):
+            DatasetFolder(str(tmp_path))
+
+    def test_image_folder_flat(self, tmp_path):
+        for i in range(3):
+            (tmp_path / f"{i}.png").write_bytes(_png_bytes(value=i * 10))
+        ds = ImageFolder(str(tmp_path))
+        assert len(ds) == 3
+        [img] = ds[1]
+        assert np.asarray(img)[0, 0, 0] == 10
+
+
+class TestFlowers:
+    def test_split_and_labels(self, tmp_path):
+        import scipy.io as sio
+        data_p = tmp_path / "102flowers.tgz"
+        with tarfile.open(data_p, "w:gz") as tf:
+            for i in range(1, 5):
+                _tar_add(tf, f"jpg/image_{i:05d}.jpg",
+                         _jpg_bytes(value=i * 20))
+        sio.savemat(tmp_path / "imagelabels.mat",
+                    {"labels": np.array([[5, 6, 7, 8]])})
+        sio.savemat(tmp_path / "setid.mat",
+                    {"trnid": np.array([[1, 3]]),
+                     "valid": np.array([[2]]),
+                     "tstid": np.array([[4]])})
+        tr = Flowers(str(data_p), str(tmp_path / "imagelabels.mat"),
+                     str(tmp_path / "setid.mat"), mode="train")
+        assert len(tr) == 2
+        img, label = tr[0]
+        assert label[0] == 5  # image 1 → label 5
+        assert np.asarray(img).shape == (6, 6, 3)
+        te = Flowers(str(data_p), str(tmp_path / "imagelabels.mat"),
+                     str(tmp_path / "setid.mat"), mode="test")
+        assert len(te) == 1 and te[0][1][0] == 8
+
+
+class TestVOC2012:
+    def test_pairs_from_listing(self, tmp_path):
+        p = tmp_path / "voctrainval.tar"
+        root = "VOCdevkit/VOC2012"
+        with tarfile.open(p, "w") as tf:
+            _tar_add(tf, f"{root}/ImageSets/Segmentation/train.txt",
+                     b"img_a\n")
+            _tar_add(tf, f"{root}/ImageSets/Segmentation/val.txt",
+                     b"img_b\n")
+            for n, v in (("img_a", 30), ("img_b", 60)):
+                _tar_add(tf, f"{root}/JPEGImages/{n}.jpg",
+                         _jpg_bytes(value=v))
+                _tar_add(tf, f"{root}/SegmentationClass/{n}.png",
+                         _png_bytes(value=v // 10, mode="L"))
+        tr = VOC2012(str(p), mode="train")
+        assert len(tr) == 1
+        image, label = tr[0]
+        assert image.shape == (6, 6, 3) and label.shape == (6, 6)
+        assert int(label[0, 0]) == 3
+        va = VOC2012(str(p), mode="val")
+        assert len(va) == 1 and int(va[0][1][0, 0]) == 6
+        with pytest.raises(ValueError, match="mode"):
+            VOC2012(str(p), mode="bogus")
