@@ -150,6 +150,18 @@ def _pil_loader(path):
         return img.convert("RGB")
 
 
+def _extension_checker(extensions, is_valid_file):
+    """One place to normalize the extension filter (list → tuple;
+    str.endswith accepts only str/tuple) for both folder datasets."""
+    if is_valid_file is not None:
+        return is_valid_file
+    exts = tuple(extensions) if extensions else IMG_EXTENSIONS
+
+    def check(p):
+        return p.lower().endswith(exts)
+    return check
+
+
 class DatasetFolder(Dataset):
     """Class-per-subdirectory image tree (reference vision/datasets/
     folder.py:65): root/class_x/xxx.png → (sample, class_index)."""
@@ -159,16 +171,13 @@ class DatasetFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or _pil_loader
-        extensions = extensions or IMG_EXTENSIONS
+        is_valid_file = _extension_checker(extensions, is_valid_file)
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         if not classes:
             raise RuntimeError(f"no class folders under {root}")
         self.classes = classes
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
-        if is_valid_file is None:
-            def is_valid_file(p):
-                return p.lower().endswith(extensions)
         self.samples = []
         for c in classes:
             cdir = os.path.join(root, c)
@@ -180,7 +189,7 @@ class DatasetFolder(Dataset):
         if not self.samples:
             raise RuntimeError(
                 f"found 0 files in subfolders of {root}; supported "
-                f"extensions: {extensions}")
+                f"extensions: {tuple(extensions) if extensions else IMG_EXTENSIONS}")
 
     def __getitem__(self, idx):
         path, target = self.samples[idx]
@@ -202,10 +211,7 @@ class ImageFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or _pil_loader
-        extensions = extensions or IMG_EXTENSIONS
-        if is_valid_file is None:
-            def is_valid_file(p):
-                return p.lower().endswith(extensions)
+        is_valid_file = _extension_checker(extensions, is_valid_file)
         self.samples = []
         for sub, _, files in sorted(os.walk(root)):
             for fn in sorted(files):

@@ -114,3 +114,11 @@ class TestVOC2012:
         assert len(va) == 1 and int(va[0][1][0, 0]) == 6
         with pytest.raises(ValueError, match="mode"):
             VOC2012(str(p), mode="bogus")
+
+    def test_list_extensions_accepted(self, tmp_path):
+        d = tmp_path / "cls"
+        d.mkdir()
+        (d / "a.png").write_bytes(_png_bytes())
+        (d / "b.jpg").write_bytes(_jpg_bytes())
+        ds = DatasetFolder(str(tmp_path), extensions=[".png"])
+        assert len(ds) == 1  # list filter works, jpg excluded

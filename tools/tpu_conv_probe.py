@@ -1,0 +1,87 @@
+"""On-chip probe for the conv-throughput question (BASELINE.md "open
+perf questions"): honest slope+readback timing of (a) raw convs in both
+layouts, (b) one ResNet-50 engine step, (c) a profiler trace of that
+step. Run on the real chip: ``python tools/tpu_conv_probe.py``."""
+
+import sys
+import time
+
+import numpy as np
+
+
+def _slope(f, lo=2, hi=8):
+    import jax
+    f()  # warm
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(f())[0]))
+    ts = []
+    for k in (lo, hi):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = f()
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0]))
+        ts.append(time.perf_counter() - t0)
+    return (ts[1] - ts[0]) / (hi - lo)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    print("device:", dev, getattr(dev, "device_kind", ""))
+
+    # raw conv, both layouts, bf16 — ResNet hot shape
+    fl = 2 * 32 * 56 * 56 * 256 * 256 * 9
+    x_nchw = jnp.asarray(np.random.randn(32, 256, 56, 56), jnp.bfloat16)
+    w_oihw = jnp.asarray(np.random.randn(256, 256, 3, 3), jnp.bfloat16)
+    conv_nchw = jax.jit(lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))))
+    dt = _slope(lambda: conv_nchw(x_nchw, w_oihw))
+    print(f"conv NCHW bf16: {dt * 1e3:.2f} ms {fl / dt / 1e12:.1f} TF/s")
+
+    x_nhwc = jnp.asarray(np.random.randn(32, 56, 56, 256), jnp.bfloat16)
+    w_hwio = jnp.asarray(np.random.randn(3, 3, 256, 256), jnp.bfloat16)
+    conv_nhwc = jax.jit(lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))))
+    dt = _slope(lambda: conv_nhwc(x_nhwc, w_hwio))
+    print(f"conv NHWC bf16: {dt * 1e3:.2f} ms {fl / dt / 1e12:.1f} TF/s")
+
+    # full ResNet-50 engine step + trace
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.vision.models.resnet import resnet50
+    model = resnet50()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+
+    def loss_fn(m, b):
+        return paddle.nn.functional.cross_entropy(m(Tensor(b["x"])),
+                                                  Tensor(b["y"]))
+    eng = ParallelEngine(model, opt, loss_fn,
+                         mesh=build_mesh(dp=1, devices=[dev]),
+                         amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    b = {"x": rng.standard_normal((32, 3, 224, 224)).astype(np.float32),
+         "y": rng.integers(0, 1000, (32,)).astype(np.int64)}
+    dt = _slope(lambda: eng.step(b), lo=1, hi=4)
+    rflops = 3 * 32 * 4.1e9
+    print(f"resnet50 step: {dt * 1e3:.1f} ms "
+          f"{rflops / dt / 1e12:.1f} TF/s "
+          f"mfu={rflops / dt / 197e12:.3f}")
+
+    import tempfile
+    td = tempfile.mkdtemp(prefix="conv_probe_")
+    with jax.profiler.trace(td):
+        np.asarray(jax.device_get(eng.step(b).data
+                                  if hasattr(eng.step(b), "data")
+                                  else eng.step(b)))
+    print("trace:", td)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
