@@ -1,0 +1,90 @@
+"""`fluid` compatibility shim — the migration on-ramp for pre-2.0
+scripts (reference python/paddle/fluid/).
+
+Old code begins ``import paddle.fluid as fluid``; this package keeps
+those scripts importable against the TPU build. It is a THIN mapping
+onto the modern surface (the reference itself rebuilt paddle 2.0 on top
+of fluid; here the arrow points the other way):
+
+* ``fluid.dygraph`` — guard (a no-op context: eager IS the default),
+  to_variable, Layer/Linear/Embedding aliases, no_grad
+* ``fluid.layers`` — the high-traffic op subset mapped to modern ops;
+  anything else raises an AttributeError NAMING the modern equivalent
+  (teaching error, not a silent stub)
+* ``fluid.optimizer`` / ``fluid.initializer`` / ``fluid.regularizer`` —
+  class aliases
+* Executor/Program/CPUPlace/CUDAPlace re-exports from paddle1_tpu.static
+  and core.place (CUDAPlace maps to the TPU device — reference scripts
+  use it to mean "the accelerator")
+
+MIGRATING.md documents the full old→new mapping.
+"""
+
+from __future__ import annotations
+
+from .. import static as _static
+from ..core.place import CPUPlace, TPUPlace
+from ..core.tensor import Tensor, to_tensor
+from ..framework.io import load as _load, save as _save
+from ..static import (Executor, Program, default_main_program,
+                      default_startup_program)
+from . import dygraph, initializer, layers, optimizer, regularizer
+
+__all__ = ["layers", "dygraph", "optimizer", "initializer", "regularizer",
+           "Executor", "Program", "CPUPlace", "CUDAPlace", "TPUPlace",
+           "default_main_program", "default_startup_program",
+           "data", "embedding", "save", "load", "global_scope",
+           "scope_guard", "in_dygraph_mode", "enable_dygraph",
+           "disable_dygraph", "ParamAttr"]
+
+CUDAPlace = TPUPlace  # old scripts mean "the accelerator"
+
+from ..framework.param_attr import ParamAttr  # noqa: E402
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed-var declaration → InputSpec (trace-time placeholder)."""
+    from ..jit import InputSpec
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+embedding = layers.embedding
+save = _save
+load = _load
+
+
+class _Scope:
+    def var(self, name):
+        raise AttributeError(
+            "fluid.global_scope().var: variables live in Layer state "
+            "dicts now — use layer.state_dict() / paddle.save")
+
+
+def global_scope():
+    return _Scope()
+
+
+class scope_guard:
+    def __init__(self, scope):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def in_dygraph_mode() -> bool:
+    return True  # eager is the only imperative mode
+
+
+def enable_dygraph(place=None):
+    return None
+
+
+def disable_dygraph():
+    raise RuntimeError(
+        "static graph mode is jit.to_static tracing in this build; "
+        "wrap the model with paddle1_tpu.jit.to_static instead of "
+        "globally disabling dygraph")

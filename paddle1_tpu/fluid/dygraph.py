@@ -1,0 +1,65 @@
+"""fluid.dygraph compat (reference python/paddle/fluid/dygraph/):
+``guard`` is a no-op context because eager is this build's default mode;
+the Layer/op surface re-exports the modern classes under their old
+spellings."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..autograd import no_grad
+from ..core.tensor import Tensor, to_tensor
+from ..nn import (BatchNorm2D as BatchNorm, Embedding, Layer, LayerList,
+                  Linear, Sequential)
+from ..framework.io import load as load_dygraph_raw, save as save_dygraph
+
+__all__ = ["guard", "to_variable", "no_grad", "Layer", "Linear",
+           "Embedding", "BatchNorm", "LayerList", "Sequential",
+           "enable_dygraph", "disable_dygraph", "enabled",
+           "save_dygraph", "load_dygraph", "ParallelEnv",
+           "prepare_context", "DataParallel"]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager IS the default execution mode on this build; the guard
+    exists so `with fluid.dygraph.guard():` scripts run unchanged."""
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    return to_tensor(value, dtype=dtype)
+
+
+def enabled() -> bool:
+    return True
+
+
+def enable_dygraph(place=None):
+    return None
+
+
+def disable_dygraph():
+    from . import disable_dygraph as _impl
+    _impl()
+
+
+def load_dygraph(model_path, **config):
+    """Old API returned (param_dict, optimizer_dict)."""
+    state = load_dygraph_raw(model_path)
+    return state, None
+
+
+def ParallelEnv():
+    from ..distributed.parallel import ParallelEnv as _PE
+    return _PE()
+
+
+def prepare_context(strategy=None):
+    from ..distributed import init_parallel_env
+    return init_parallel_env()
+
+
+def DataParallel(layers, strategy=None, **kw):
+    from ..distributed import DataParallel as _DP
+    return _DP(layers, strategy=strategy, **kw)

@@ -1,0 +1,21 @@
+"""fluid.initializer compat: old spellings over the modern initializer
+classes (reference python/paddle/fluid/initializer.py)."""
+
+from ..nn.initializer import (Assign, Constant, KaimingNormal,
+                              KaimingUniform, Normal, TruncatedNormal,
+                              Uniform, XavierNormal, XavierUniform)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+__all__ = ["Constant", "ConstantInitializer", "Normal",
+           "NormalInitializer", "TruncatedNormal",
+           "TruncatedNormalInitializer", "Uniform", "UniformInitializer",
+           "XavierNormal", "XavierUniform", "XavierInitializer",
+           "KaimingNormal", "KaimingUniform", "MSRAInitializer",
+           "Assign", "NumpyArrayInitializer"]

@@ -1,0 +1,320 @@
+"""fluid.layers compat (reference python/paddle/fluid/layers/, 36k LoC
+of OpDesc emitters). The high-traffic subset maps straight onto the
+modern functional surface; everything else raises naming the modern
+equivalent so a migrating script fails loudly AND helpfully."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle1_tpu as _paddle
+from ..core.tensor import Tensor, to_tensor
+from ..nn import functional as F
+from ..ops import manip_ops as _manip, math_ops as _math
+
+__all__ = []  # populated implicitly; compat namespace, star-import unused
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# -- dense / conv / norm -----------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """One-shot linear over flattened trailing dims (reference
+    layers/nn.py:211). Weights are created on first call and cached on
+    the input-size key — the eager analog of the implicit parameter the
+    static fc op created."""
+    x = _t(input)
+    lead = x.shape[:num_flatten_dims]
+    flat = int(np.prod(x.shape[num_flatten_dims:]))
+    key = (flat, size, name or "fc")
+    store = fc.__dict__.setdefault("_layers", {})
+    if key not in store:
+        store[key] = _paddle.nn.Linear(flat, size)
+    lin = store[key]
+    out = lin(_manip.reshape(x, list(lead) + [flat]))
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    key = (tuple(size), padding_idx, name or "embedding")
+    store = embedding.__dict__.setdefault("_layers", {})
+    if key not in store:
+        store[key] = _paddle.nn.Embedding(size[0], size[1],
+                                          padding_idx=padding_idx,
+                                          sparse=is_sparse)
+    return store[key](_t(input))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    x = _t(input)
+    in_ch = x.shape[1 if data_format == "NCHW" else -1]
+    key = (in_ch, num_filters, filter_size, stride, padding, name or "c2d")
+    store = conv2d.__dict__.setdefault("_layers", {})
+    if key not in store:
+        store[key] = _paddle.nn.Conv2D(in_ch, num_filters, filter_size,
+                                       stride=stride, padding=padding,
+                                       dilation=dilation, groups=groups)
+    out = store[key](x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    x = _t(input)
+    if global_pooling:
+        return F.adaptive_avg_pool2d(x, 1) if pool_type == "avg" else \
+            F.adaptive_max_pool2d(x, 1)
+    f = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return f(x, kernel_size=pool_size, stride=pool_stride,
+             padding=pool_padding)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None):
+    x = _t(input)
+    ch = x.shape[1 if data_layout == "NCHW" else -1]
+    key = (ch, name or "bn")
+    store = batch_norm.__dict__.setdefault("_layers", {})
+    if key not in store:
+        store[key] = _paddle.nn.BatchNorm2D(ch, momentum=momentum,
+                                            epsilon=epsilon)
+    layer = store[key]
+    layer.training = not is_test
+    out = layer(x)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, name=None):
+    return F.dropout(_t(x), p=dropout_prob, training=not is_test)
+
+
+# -- math / manipulation -----------------------------------------------------
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """reference layers/nn.py:12478 mul op: flatten then matmul."""
+    a, b = _t(x), _t(y)
+    m = int(np.prod(a.shape[:x_num_col_dims]))
+    k = int(np.prod(a.shape[x_num_col_dims:]))
+    n = int(np.prod(b.shape[y_num_col_dims:]))
+    return _math.matmul(_manip.reshape(a, [m, k]),
+                        _manip.reshape(b, [k, n]))
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    # fluid semantics: input is POST-softmax probabilities
+    return F.nll_loss(_math.log(_t(input)), _t(label),
+                      ignore_index=ignore_index, reduction="none")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100,
+                               return_softmax=False):
+    out = F.softmax_with_cross_entropy(_t(logits), _t(label),
+                                       soft_label=soft_label, axis=axis,
+                                       ignore_index=ignore_index)
+    if return_softmax:
+        return out, F.softmax(_t(logits), axis=axis)
+    return out
+
+
+def mean(x, name=None):
+    return _math.mean(_t(x))
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    m = _paddle.metric.Accuracy(topk=(k,))
+    corr = m.compute(_t(input), _t(label))
+    return to_tensor(np.asarray(corr.numpy().mean(), np.float32))
+
+
+def concat(input, axis=0, name=None):
+    return _manip.concat(input, axis=axis)
+
+
+def reshape(x, shape, name=None):
+    return _manip.reshape(_t(x), shape)
+
+
+def cast(x, dtype):
+    return _manip.cast(_t(x), dtype)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return _paddle.full(shape, value, dtype=dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _manip.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, dtype="float32", seed=0):
+    return _manip.gaussian(shape, mean=mean, std=std, dtype=dtype)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _math.sum(_t(input), axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _math.mean(_t(input), axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _math.max(_t(input), axis=dim, keepdim=keep_dim)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = _t(x) + _t(y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    out = _t(x) - _t(y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    out = _t(x) * _t(y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    out = _t(x) / _t(y)
+    return getattr(F, act)(out) if act else out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    out = _math.matmul(_t(x), _t(y), transpose_x=transpose_x,
+                       transpose_y=transpose_y)
+    return out * alpha if alpha != 1.0 else out
+
+
+def topk(input, k, name=None):
+    return _math.topk(_t(input), k)
+
+
+def relu(x, name=None):
+    return F.relu(_t(x))
+
+
+def softmax(input, axis=-1, name=None):
+    return F.softmax(_t(input), axis=axis)
+
+
+def sigmoid(x, name=None):
+    return F.sigmoid(_t(x))
+
+
+def tanh(x, name=None):
+    return F.tanh(_t(x))
+
+
+def square(x, name=None):
+    return _t(x) * _t(x)
+
+
+def sqrt(x, name=None):
+    return _math.sqrt(_t(x))
+
+
+def log(x, name=None):
+    return _math.log(_t(x))
+
+
+def exp(x, name=None):
+    return _math.exp(_t(x))
+
+
+def clip(x, min, max, name=None):
+    return _math.clip(_t(x), min, max)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..static import nn as _snn
+    return _snn.cond(pred, true_fn, false_fn)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    from ..static import nn as _snn
+    return _snn.while_loop(cond, body, loop_vars, is_test=is_test)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn.layer_base import Layer
+    host = Layer()
+    return host.create_parameter(shape, attr=attr, dtype=dtype,
+                                 is_bias=is_bias,
+                                 default_initializer=default_initializer)
+
+
+def assign(input, output=None):
+    val = _t(input)
+    if output is not None:
+        output._replace_impl(val)
+        return output
+    return val
+
+
+def shape(input):
+    return to_tensor(np.asarray(_t(input).shape, np.int32))
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return F.one_hot(_t(input), depth)
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    from ..jit import InputSpec
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+# mapping old-name → modern path for the teaching __getattr__
+_MODERN = {
+    "lstm": "paddle1_tpu.nn.LSTM",
+    "dynamic_lstm": "paddle1_tpu.nn.LSTM",
+    "dynamic_gru": "paddle1_tpu.nn.GRU",
+    "gru_unit": "paddle1_tpu.nn.GRUCell",
+    "sequence_conv": "paddle1_tpu.ops.sequence_ops",
+    "sequence_pool": "paddle1_tpu.ops.sequence_ops.sequence_pool",
+    "sequence_expand": "paddle1_tpu.ops.sequence_ops.sequence_expand",
+    "layer_norm": "paddle1_tpu.nn.LayerNorm / nn.functional.layer_norm",
+    "yolo_box": "paddle1_tpu.vision.ops.yolo_box",
+    "yolov3_loss": "paddle1_tpu.vision.models.yolo.yolov3_loss",
+    "multiclass_nms": "paddle1_tpu.vision.ops.multiclass_nms",
+    "roi_align": "paddle1_tpu.vision.ops.roi_align",
+    "prior_box": "paddle1_tpu.vision.ops.prior_box",
+    "py_func": "plain Python (eager) or a custom op via "
+               "paddle1_tpu.utils.cpp_extension",
+    "beam_search": "paddle1_tpu.text (decode loops are lax.while_loop "
+                   "via static.nn.while_loop)",
+}
+
+
+def __getattr__(name):
+    hint = _MODERN.get(name)
+    if hint:
+        raise AttributeError(
+            f"fluid.layers.{name} moved — use {hint} in this build")
+    raise AttributeError(
+        f"fluid.layers.{name} has no compat shim. The modern op "
+        f"namespace is paddle1_tpu.* / paddle1_tpu.nn.functional.* "
+        f"(see MIGRATING.md); most fluid.layers names kept their "
+        f"spelling there")

@@ -1,0 +1,30 @@
+"""fluid.optimizer compat: the old classes (SGDOptimizer spelling) over
+the modern optimizer set (reference python/paddle/fluid/optimizer.py —
+there ~20 op-emitting classes; here aliases plus the wrapper trio that
+lives in incubate)."""
+
+from __future__ import annotations
+
+from ..optimizer import (SGD, AdaDelta, Adagrad, Adam, Adamax, AdamW,
+                         Lamb, Momentum, RMSProp)
+
+Adadelta = AdaDelta
+from ..incubate.optimizer import (ExponentialMovingAverage, LookAhead,
+                                  ModelAverage)
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+LambOptimizer = Lamb
+LookaheadOptimizer = LookAhead
+
+__all__ = ["SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+           "Adagrad", "AdagradOptimizer", "Adam", "AdamOptimizer",
+           "Adamax", "AdamaxOptimizer", "Adadelta", "AdadeltaOptimizer",
+           "RMSProp", "RMSPropOptimizer", "Lamb", "LambOptimizer",
+           "AdamW", "ExponentialMovingAverage", "ModelAverage",
+           "LookAhead", "LookaheadOptimizer"]

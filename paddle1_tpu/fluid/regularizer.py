@@ -1,0 +1,10 @@
+"""fluid.regularizer compat (reference python/paddle/fluid/
+regularizer.py): old Decay spellings over the modern classes."""
+
+from ..regularizer import L1Decay, L2Decay
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ["L1Decay", "L1DecayRegularizer", "L2Decay",
+           "L2DecayRegularizer"]

@@ -151,15 +151,23 @@ def _pil_loader(path):
 
 
 def _extension_checker(extensions, is_valid_file):
-    """One place to normalize the extension filter (list → tuple;
-    str.endswith accepts only str/tuple) for both folder datasets."""
+    """One place to normalize the extension filter for both folder
+    datasets. Returns (checker, normalized_extensions_or_None) — None
+    when a custom is_valid_file decides (extensions never consulted).
+    A lone string must NOT go through tuple(): tuple('.png') is
+    ('.', 'p', 'n', 'g') and matches nearly everything."""
     if is_valid_file is not None:
-        return is_valid_file
-    exts = tuple(extensions) if extensions else IMG_EXTENSIONS
+        return is_valid_file, None
+    if extensions is None:
+        exts = IMG_EXTENSIONS
+    elif isinstance(extensions, str):
+        exts = (extensions,)
+    else:
+        exts = tuple(extensions)
 
     def check(p):
         return p.lower().endswith(exts)
-    return check
+    return check, exts
 
 
 class DatasetFolder(Dataset):
@@ -171,7 +179,7 @@ class DatasetFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or _pil_loader
-        is_valid_file = _extension_checker(extensions, is_valid_file)
+        is_valid_file, exts = _extension_checker(extensions, is_valid_file)
         classes = sorted(d for d in os.listdir(root)
                          if os.path.isdir(os.path.join(root, d)))
         if not classes:
@@ -188,8 +196,9 @@ class DatasetFolder(Dataset):
                         self.samples.append((p, self.class_to_idx[c]))
         if not self.samples:
             raise RuntimeError(
-                f"found 0 files in subfolders of {root}; supported "
-                f"extensions: {tuple(extensions) if extensions else IMG_EXTENSIONS}")
+                f"found 0 files in subfolders of {root}; "
+                + (f"supported extensions: {exts}" if exts is not None
+                   else "the custom is_valid_file accepted nothing"))
 
     def __getitem__(self, idx):
         path, target = self.samples[idx]
@@ -211,7 +220,7 @@ class ImageFolder(Dataset):
         self.root = root
         self.transform = transform
         self.loader = loader or _pil_loader
-        is_valid_file = _extension_checker(extensions, is_valid_file)
+        is_valid_file, _ = _extension_checker(extensions, is_valid_file)
         self.samples = []
         for sub, _, files in sorted(os.walk(root)):
             for fn in sorted(files):

@@ -1,0 +1,124 @@
+"""fluid compat shim (paddle1_tpu/fluid/): pre-2.0 scripts written
+against `import paddle.fluid as fluid` run on the modern surface
+(reference python/paddle/fluid/)."""
+
+import numpy as np
+import pytest
+
+import paddle1_tpu.fluid as fluid
+
+
+class TestFluidDygraphScript:
+    def test_classic_training_script_shape(self):
+        """The canonical fluid dygraph idiom: guard + to_variable +
+        layers.fc + cross_entropy + backward + SGDOptimizer."""
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 8)).astype(np.float32)
+        Y = (X[:, 0] > 0).astype(np.int64)
+        with fluid.dygraph.guard():
+            losses = []
+            params = None
+            opt = None
+            for step in range(25):
+                x = fluid.dygraph.to_variable(X)
+                label = fluid.dygraph.to_variable(Y)
+                h = fluid.layers.fc(x, 16, act="relu")
+                logits = fluid.layers.fc(h, 2, name="head")
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(
+                        logits, fluid.layers.reshape(label, [-1, 1])))
+                loss.backward()
+                if opt is None:
+                    params = [p for l in
+                              fluid.layers.fc._layers.values()
+                              for p in l.parameters()]
+                    opt = fluid.optimizer.SGDOptimizer(
+                        learning_rate=0.5, parameters=params)
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            assert losses[-1] < losses[0] * 0.8
+
+    def test_layer_cache_reuses_weights(self):
+        with fluid.dygraph.guard():
+            x = fluid.dygraph.to_variable(
+                np.ones((2, 4), np.float32))
+            a = fluid.layers.fc(x, 3, name="shared")
+            b = fluid.layers.fc(x, 3, name="shared")
+            np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_ops_subset(self):
+        x = fluid.dygraph.to_variable(
+            np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(
+            fluid.layers.reduce_sum(x).numpy(), 15.0)
+        assert fluid.layers.mul(
+            x, fluid.dygraph.to_variable(
+                np.ones((3, 2), np.float32))).shape == [2, 2]
+        assert fluid.layers.elementwise_add(x, x).shape == [2, 3]
+        assert fluid.layers.cast(x, "int32").dtype == "int32"
+        assert fluid.layers.fill_constant([2], "float32", 3.0).shape == [2]
+        oh = fluid.layers.one_hot(
+            fluid.dygraph.to_variable(np.array([0, 2])), 3)
+        np.testing.assert_allclose(oh.numpy(),
+                                   [[1, 0, 0], [0, 0, 1]])
+
+    def test_cross_entropy_is_prob_space(self):
+        # fluid.layers.cross_entropy takes POST-softmax probabilities
+        probs = fluid.dygraph.to_variable(
+            np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+        label = fluid.dygraph.to_variable(np.array([0, 1]))
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(
+            ce.numpy().reshape(-1), [-np.log(0.9), -np.log(0.8)],
+            rtol=1e-5)
+
+
+class TestTeachingErrors:
+    def test_moved_op_names_destination(self):
+        with pytest.raises(AttributeError, match="nn.LSTM"):
+            fluid.layers.dynamic_lstm
+        with pytest.raises(AttributeError, match="multiclass_nms"):
+            fluid.layers.multiclass_nms
+
+    def test_unknown_op_points_at_modern_namespace(self):
+        with pytest.raises(AttributeError, match="MIGRATING"):
+            fluid.layers.this_never_existed
+
+    def test_disable_dygraph_teaches(self):
+        with pytest.raises(RuntimeError, match="to_static"):
+            fluid.disable_dygraph()
+
+    def test_global_scope_var_teaches(self):
+        with pytest.raises(AttributeError, match="state_dict"):
+            fluid.global_scope().var("w")
+
+
+class TestAliases:
+    def test_optimizer_spellings(self):
+        assert fluid.optimizer.SGDOptimizer is fluid.optimizer.SGD
+        assert fluid.optimizer.AdamOptimizer is fluid.optimizer.Adam
+
+    def test_places_and_static_shell(self):
+        assert fluid.CUDAPlace is fluid.TPUPlace  # "the accelerator"
+        assert fluid.Executor is not None
+        spec = fluid.data("x", [None, 8])
+        assert list(spec.shape) == [None, 8] or list(spec.shape) == [-1, 8]
+
+    def test_initializer_spellings(self):
+        assert fluid.initializer.ConstantInitializer \
+            is fluid.initializer.Constant
+        assert fluid.initializer.MSRAInitializer is not None
+
+    def test_batch_norm_and_pool(self):
+        x = fluid.dygraph.to_variable(
+            np.random.default_rng(0).standard_normal(
+                (2, 3, 8, 8)).astype(np.float32))
+        y = fluid.layers.batch_norm(x, act="relu")
+        assert y.shape == [2, 3, 8, 8]
+        assert float(y.numpy().min()) >= 0.0
+        p = fluid.layers.pool2d(x, pool_size=2, pool_type="max",
+                                pool_stride=2)
+        assert p.shape == [2, 3, 4, 4]
+        g = fluid.layers.pool2d(x, global_pooling=True, pool_type="avg")
+        assert g.shape == [2, 3, 1, 1]

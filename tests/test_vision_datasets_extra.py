@@ -122,3 +122,11 @@ class TestVOC2012:
         (d / "b.jpg").write_bytes(_jpg_bytes())
         ds = DatasetFolder(str(tmp_path), extensions=[".png"])
         assert len(ds) == 1  # list filter works, jpg excluded
+
+    def test_string_extension_not_exploded(self, tmp_path):
+        d = tmp_path / "cls"
+        d.mkdir()
+        (d / "a.png").write_bytes(_png_bytes())
+        (d / "b.jpg").write_bytes(_jpg_bytes())
+        ds = DatasetFolder(str(tmp_path), extensions=".png")
+        assert len(ds) == 1  # str must behave as one suffix, not chars

@@ -77,9 +77,8 @@ def main():
     import tempfile
     td = tempfile.mkdtemp(prefix="conv_probe_")
     with jax.profiler.trace(td):
-        np.asarray(jax.device_get(eng.step(b).data
-                                  if hasattr(eng.step(b), "data")
-                                  else eng.step(b)))
+        r = eng.step(b)
+        np.asarray(jax.device_get(r.data if hasattr(r, "data") else r))
     print("trace:", td)
 
 
