@@ -19,6 +19,19 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _site(name):
+    """Implicit-parameter identity. Reference fluid creates fresh
+    parameters per op CALL SITE (unique auto-generated names); keying the
+    eager cache on the caller's (file, line) reproduces that — a call in
+    a training loop reuses its weights, two textual fc calls do not
+    weight-tie. An explicit ``name`` overrides (named sharing)."""
+    if name:
+        return ("named", name)
+    import sys
+    f = sys._getframe(2)
+    return (f.f_code.co_filename, f.f_lineno)
+
+
 # -- dense / conv / norm -----------------------------------------------------
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
@@ -30,7 +43,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     x = _t(input)
     lead = x.shape[:num_flatten_dims]
     flat = int(np.prod(x.shape[num_flatten_dims:]))
-    key = (flat, size, name or "fc")
+    key = (_site(name), flat, size)
     store = fc.__dict__.setdefault("_layers", {})
     if key not in store:
         store[key] = _paddle.nn.Linear(flat, size)
@@ -43,7 +56,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None):
-    key = (tuple(size), padding_idx, name or "embedding")
+    key = (_site(name), tuple(size), padding_idx)
     store = embedding.__dict__.setdefault("_layers", {})
     if key not in store:
         store[key] = _paddle.nn.Embedding(size[0], size[1],
@@ -57,7 +70,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,
            act=None, name=None, data_format="NCHW"):
     x = _t(input)
     in_ch = x.shape[1 if data_format == "NCHW" else -1]
-    key = (in_ch, num_filters, filter_size, stride, padding, name or "c2d")
+    key = (_site(name), in_ch, num_filters, filter_size, stride,
+           padding, dilation, groups)
     store = conv2d.__dict__.setdefault("_layers", {})
     if key not in store:
         store[key] = _paddle.nn.Conv2D(in_ch, num_filters, filter_size,
@@ -85,7 +99,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9,
                data_layout="NCHW", name=None):
     x = _t(input)
     ch = x.shape[1 if data_layout == "NCHW" else -1]
-    key = (ch, name or "bn")
+    key = (_site(name), ch)
     store = batch_norm.__dict__.setdefault("_layers", {})
     if key not in store:
         store[key] = _paddle.nn.BatchNorm2D(ch, momentum=momentum,
@@ -115,8 +129,12 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 
 
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):
-    # fluid semantics: input is POST-softmax probabilities
-    return F.nll_loss(_math.log(_t(input)), _t(label),
+    # fluid semantics: input is POST-softmax probabilities; label may be
+    # the old mandatory [N, 1] shape
+    lab = _t(label)
+    if lab.ndim == 2 and lab.shape[-1] == 1:
+        lab = _manip.squeeze(lab, axis=-1)
+    return F.nll_loss(_math.log(_t(input)), lab,
                       ignore_index=ignore_index, reduction="none")
 
 
@@ -127,6 +145,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
                                        soft_label=soft_label, axis=axis,
                                        ignore_index=ignore_index)
     if return_softmax:
+        # under a trace XLA CSEs this with the loss's internal softmax;
+        # eager pays one extra pass (fluid parity beats micro-perf here)
         return out, F.softmax(_t(logits), axis=axis)
     return out
 
@@ -137,8 +157,11 @@ def mean(x, name=None):
 
 def accuracy(input, label, k=1, correct=None, total=None):
     m = _paddle.metric.Accuracy(topk=(k,))
-    corr = m.compute(_t(input), _t(label))
-    return to_tensor(np.asarray(corr.numpy().mean(), np.float32))
+    corr = np.asarray(m.compute(_t(input), _t(label)))
+    # compute() yields an [N, k] correctness matrix with at most one hit
+    # per row: top-k accuracy = any-hit per row, then mean
+    hits = corr.reshape(corr.shape[0], -1).max(axis=-1)
+    return to_tensor(np.asarray(hits.mean(), np.float32))
 
 
 def concat(input, axis=0, name=None):
@@ -177,23 +200,38 @@ def reduce_max(input, dim=None, keep_dim=False, name=None):
     return _math.max(_t(input), axis=dim, keepdim=keep_dim)
 
 
+def _ew_align(x, y, axis):
+    """fluid's mid-axis broadcast: align y's dims to x starting at
+    ``axis`` (the classic [N,C,H,W] + [C] bias-add uses axis=1)."""
+    x, y = _t(x), _t(y)
+    if axis != -1 and y.ndim < x.ndim:
+        pad = x.ndim - axis - y.ndim
+        if pad > 0:
+            y = reshape(y, list(y.shape) + [1] * pad)
+    return x, y
+
+
 def elementwise_add(x, y, axis=-1, act=None, name=None):
-    out = _t(x) + _t(y)
+    a, b = _ew_align(x, y, axis)
+    out = a + b
     return getattr(F, act)(out) if act else out
 
 
 def elementwise_sub(x, y, axis=-1, act=None, name=None):
-    out = _t(x) - _t(y)
+    a, b = _ew_align(x, y, axis)
+    out = a - b
     return getattr(F, act)(out) if act else out
 
 
 def elementwise_mul(x, y, axis=-1, act=None, name=None):
-    out = _t(x) * _t(y)
+    a, b = _ew_align(x, y, axis)
+    out = a * b
     return getattr(F, act)(out) if act else out
 
 
 def elementwise_div(x, y, axis=-1, act=None, name=None):
-    out = _t(x) / _t(y)
+    a, b = _ew_align(x, y, axis)
+    out = a / b
     return getattr(F, act)(out) if act else out
 
 
@@ -318,3 +356,28 @@ def __getattr__(name):
         f"namespace is paddle1_tpu.* / paddle1_tpu.nn.functional.* "
         f"(see MIGRATING.md); most fluid.layers names kept their "
         f"spelling there")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """fluid spelling: the transition parameter is implicit; created on
+    first call keyed by tag count (reference layers/nn.py
+    linear_chain_crf creates 'transition' via param_attr)."""
+    x = _t(input)
+    n_tags = x.shape[-1]
+    store = linear_chain_crf.__dict__.setdefault("_params", {})
+    if n_tags not in store:
+        store[n_tags] = create_parameter([n_tags + 2, n_tags])
+    # the fluid op returns the NEGATIVE log-likelihood (a cost to
+    # minimize — linear_chain_crf_op.h); F.linear_chain_crf returns
+    # +log p(label|emission)
+    return F.linear_chain_crf(x, store[n_tags], label,
+                              length=length) * -1.0
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    x = _t(input)
+    n_tags = x.shape[-1]
+    store = linear_chain_crf.__dict__.setdefault("_params", {})
+    if n_tags not in store:
+        store[n_tags] = create_parameter([n_tags + 2, n_tags])
+    return F.crf_decoding(x, store[n_tags], label=label, length=length)

@@ -10,3 +10,4 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, attention_ref  # noqa: F401
+from .crf import crf_decoding, linear_chain_crf  # noqa: F401

@@ -1,0 +1,152 @@
+"""Linear-chain CRF ops (reference operators/linear_chain_crf_op.cc and
+crf_decoding_op.cc — the sequence-labeling family the SRL workloads
+train with).
+
+TPU-idiomatic: the forward algorithm and Viterbi are ``lax.scan``s over
+time with batched [B, T, N] emissions and length masks — no LoD ragged
+walks; the reference's LoD sequences arrive as dense-plus-length
+(SURVEY §7d).
+
+Transition layout follows the reference op exactly
+(linear_chain_crf_op.h): ``transition`` is ``[num_tags + 2, num_tags]``
+— row 0 = start→tag scores, row 1 = tag→end scores, rows 2.. =
+pairwise ``transition[2 + i, j]`` for i→j.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...autograd.engine import apply
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["linear_chain_crf", "crf_decoding"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _split(transition):
+    return transition[0], transition[1], transition[2:]  # start, end, pair
+
+
+def _mask(lengths, T, B):
+    if lengths is None:
+        return jnp.ones((B, T), bool)
+    steps = jnp.arange(T)[None, :]
+    return steps < jnp.asarray(lengths).reshape(B, 1)
+
+
+def linear_chain_crf(emission, transition, label, length=None):
+    """Per-sequence log-likelihood ``log p(label | emission)`` [B, 1]
+    (reference linear_chain_crf op's LogLikelihood output — the training
+    objective is its negative).
+
+    emission: [B, T, N]; transition: [N+2, N]; label: [B, T] int;
+    length: optional [B] valid lengths (padding steps are ignored).
+    """
+    e, w, y = _t(emission), _t(transition), _t(label)
+    args = (e, w, y) + ((to_tensor(length),) if length is not None else ())
+
+    def f(e, w, y, *ml):
+        B, T, N = e.shape
+        start, end, pair = _split(w)
+        m = _mask(ml[0] if ml else None, T, B)           # [B, T]
+
+        # ---- gold path score -------------------------------------------
+        y0 = y[:, 0]
+        score = start[y0] + jnp.take_along_axis(
+            e[:, 0], y0[:, None], axis=1)[:, 0]
+
+        def step_score(carry, t):
+            s, prev = carry
+            yt = y[:, t]
+            add = (pair[prev, yt] + jnp.take_along_axis(
+                e[:, t], yt[:, None], axis=1)[:, 0])
+            valid = m[:, t]
+            s = jnp.where(valid, s + add, s)
+            prev = jnp.where(valid, yt, prev)
+            return (s, prev), None
+
+        (score, last), _ = lax.scan(step_score, (score, y0),
+                                    jnp.arange(1, T))
+        score = score + end[last]
+
+        # ---- partition function (forward algorithm) --------------------
+        alpha0 = start[None, :] + e[:, 0]                # [B, N]
+
+        def step_fwd(alpha, t):
+            nxt = jax.nn.logsumexp(
+                alpha[:, :, None] + pair[None, :, :], axis=1) + e[:, t]
+            alpha = jnp.where(m[:, t][:, None], nxt, alpha)
+            return alpha, None
+
+        alpha, _ = lax.scan(step_fwd, alpha0, jnp.arange(1, T))
+        logz = jax.nn.logsumexp(alpha + end[None, :], axis=1)
+        return (score - logz)[:, None]
+
+    return apply("linear_chain_crf", f, args)
+
+
+def crf_decoding(emission, transition, label=None, length=None):
+    """Viterbi decode → best tag path [B, T] int64 (reference
+    crf_decoding op; padding positions return 0). When ``label`` is
+    given, returns [B, T] 0/1 correctness marks like the reference
+    (1 where the decoded tag equals the label on valid steps)."""
+    e, w = _t(emission), _t(transition)
+    extra = ()
+    if length is not None:
+        extra = (to_tensor(length),)
+
+    def f(e, w, *ml):
+        B, T, N = e.shape
+        start, end, pair = _split(w)
+        m = _mask(ml[0] if ml else None, T, B)
+
+        alpha0 = start[None, :] + e[:, 0]
+
+        def step(alpha, t):
+            cand = alpha[:, :, None] + pair[None, :, :]   # [B, from, to]
+            best = jnp.max(cand, axis=1) + e[:, t]
+            back = jnp.argmax(cand, axis=1)               # [B, to]
+            valid = m[:, t][:, None]
+            alpha_n = jnp.where(valid, best, alpha)
+            # padding steps carry an identity backpointer
+            back = jnp.where(valid, back,
+                             jnp.arange(N)[None, :])
+            return alpha_n, back
+
+        alpha, backs = lax.scan(step, alpha0, jnp.arange(1, T))
+        last_tag = jnp.argmax(alpha + end[None, :], axis=1)  # [B]
+
+        def backtrace(tag, back_t):
+            # carry = tag at step t; emit it, hand back tag at t-1
+            prev = jnp.take_along_axis(back_t, tag[:, None],
+                                       axis=1)[:, 0]
+            return prev, tag
+
+        tag0, path_rest = lax.scan(backtrace, last_tag, backs,
+                                   reverse=True)
+        # reverse scan emits in ORIGINAL order: path_rest[k] = tag at
+        # step k+1; the final carry is the step-0 tag
+        path = jnp.concatenate([tag0[None, :], path_rest],
+                               axis=0).transpose(1, 0)
+        path = jnp.where(m, path, 0).astype(jnp.int64)
+        return path
+
+    out = apply("crf_decoding", f, (e, w) + extra)
+    if label is None:
+        return out
+    lab = _t(label)
+    valid = _mask(jnp.asarray(length) if length is not None else None,
+                  out.shape[1], out.shape[0]) if length is not None else None
+
+    def marks(path, y):
+        eq = (path == y).astype(jnp.int64)
+        if valid is not None:
+            eq = jnp.where(valid, eq, 0)
+        return eq
+    return apply("crf_marks", marks, (out, lab))

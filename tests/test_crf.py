@@ -1,0 +1,135 @@
+"""Linear-chain CRF (nn/functional/crf.py) vs brute-force enumeration
+over all tag paths (reference operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+import paddle1_tpu.nn.functional as F
+from paddle1_tpu.core.tensor import to_tensor
+
+
+def _score(e, w, path):
+    """Path score under the op's transition layout."""
+    start, end, pair = w[0], w[1], w[2:]
+    s = start[path[0]] + e[0, path[0]]
+    for t in range(1, len(path)):
+        s += pair[path[t - 1], path[t]] + e[t, path[t]]
+    return s + end[path[-1]]
+
+
+def _brute(e, w):
+    """(logZ, best_path) by enumeration. e: [T, N]."""
+    T, N = e.shape
+    scores = {p: _score(e, w, p)
+              for p in itertools.product(range(N), repeat=T)}
+    arr = np.array(list(scores.values()))
+    m = arr.max()
+    logz = m + np.log(np.exp(arr - m).sum())
+    best = max(scores, key=scores.get)
+    return logz, list(best)
+
+
+@pytest.fixture()
+def problem():
+    rng = np.random.default_rng(0)
+    B, T, N = 3, 5, 4
+    e = rng.standard_normal((B, T, N)).astype(np.float32)
+    w = rng.standard_normal((N + 2, N)).astype(np.float32)
+    return e, w
+
+
+class TestLinearChainCRF:
+    def test_log_likelihood_matches_enumeration(self, problem):
+        e, w = problem
+        B, T, N = e.shape
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, N, (B, T))
+        ll = F.linear_chain_crf(to_tensor(e), to_tensor(w),
+                                to_tensor(y)).numpy()
+        for b in range(B):
+            logz, _ = _brute(e[b], w)
+            want = _score(e[b], w, list(y[b])) - logz
+            np.testing.assert_allclose(ll[b, 0], want, rtol=1e-4)
+
+    def test_variable_lengths(self, problem):
+        e, w = problem
+        B, T, N = e.shape
+        y = np.random.default_rng(2).integers(0, N, (B, T))
+        lengths = np.array([5, 3, 1])
+        ll = F.linear_chain_crf(to_tensor(e), to_tensor(w), to_tensor(y),
+                                length=lengths).numpy()
+        for b, L in enumerate(lengths):
+            logz, _ = _brute(e[b, :L], w)
+            want = _score(e[b, :L], w, list(y[b, :L])) - logz
+            np.testing.assert_allclose(ll[b, 0], want, rtol=1e-4)
+
+    def test_likelihood_is_normalized(self, problem):
+        e, w = problem
+        B, T, N = e.shape
+        paths = np.asarray(list(itertools.product(range(N), repeat=T)))
+        e_rep = np.tile(e[0][None], (paths.shape[0], 1, 1))
+        ll = F.linear_chain_crf(to_tensor(e_rep), to_tensor(w),
+                                to_tensor(paths)).numpy()
+        np.testing.assert_allclose(np.exp(ll[:, 0]).sum(), 1.0,
+                                   rtol=1e-3)
+
+    def test_trains_toward_labels(self, problem):
+        e, w = problem
+        B, T, N = e.shape
+        y = np.random.default_rng(3).integers(0, N, (B, T))
+        wt = to_tensor(w.copy(), stop_gradient=False)
+        first = None
+        for step in range(40):
+            nll = -F.linear_chain_crf(to_tensor(e), wt,
+                                      to_tensor(y)).mean()
+            nll.backward()
+            wt = to_tensor(wt.numpy() - 0.2 * wt.grad.numpy(),
+                           stop_gradient=False)
+            first = first if first is not None else float(nll.numpy())
+        assert float(nll.numpy()) < first
+
+
+class TestCRFDecoding:
+    def test_viterbi_matches_enumeration(self, problem):
+        e, w = problem
+        B = e.shape[0]
+        path = F.crf_decoding(to_tensor(e), to_tensor(w)).numpy()
+        for b in range(B):
+            _, best = _brute(e[b], w)
+            np.testing.assert_array_equal(path[b], best)
+
+    def test_variable_length_padding_zeroed(self, problem):
+        e, w = problem
+        lengths = np.array([5, 3, 1])
+        path = F.crf_decoding(to_tensor(e), to_tensor(w),
+                              length=lengths).numpy()
+        for b, L in enumerate(lengths):
+            _, best = _brute(e[b, :L], w)
+            np.testing.assert_array_equal(path[b, :L], best)
+            assert (path[b, L:] == 0).all()
+
+    def test_marks_output(self, problem):
+        e, w = problem
+        path = F.crf_decoding(to_tensor(e), to_tensor(w)).numpy()
+        marks = F.crf_decoding(to_tensor(e), to_tensor(w),
+                               label=to_tensor(path)).numpy()
+        assert (marks == 1).all()  # decoded vs itself: all correct
+
+
+class TestFluidSpelling:
+    def test_fluid_layers_crf(self):
+        import paddle1_tpu.fluid as fluid
+        rng = np.random.default_rng(0)
+        x = fluid.dygraph.to_variable(
+            rng.standard_normal((2, 4, 3)).astype(np.float32))
+        y = fluid.dygraph.to_variable(rng.integers(0, 3, (2, 4)))
+        ll = fluid.layers.linear_chain_crf(x, y)
+        assert ll.shape == [2, 1]
+        path = fluid.layers.crf_decoding(x)
+        assert path.shape == [2, 4]
+        # shared transition parameter between the two entries
+        assert len(fluid.layers.linear_chain_crf._params) == 1

@@ -122,3 +122,64 @@ class TestAliases:
         assert p.shape == [2, 3, 4, 4]
         g = fluid.layers.pool2d(x, global_pooling=True, pool_type="avg")
         assert g.shape == [2, 3, 1, 1]
+
+
+class TestReviewRegressions:
+    def test_distinct_fc_call_sites_do_not_weight_tie(self):
+        x = fluid.dygraph.to_variable(
+            np.random.default_rng(0).standard_normal(
+                (2, 64)).astype(np.float32))
+        h1 = fluid.layers.fc(x, 64)
+        h2 = fluid.layers.fc(x, 64)  # different line: different weights
+        assert not np.allclose(h1.numpy(), h2.numpy())
+
+    def test_loop_call_site_reuses_weights(self):
+        x = fluid.dygraph.to_variable(
+            np.ones((1, 4), np.float32))
+        outs = []
+        for _ in range(2):
+            outs.append(fluid.layers.fc(x, 3).numpy())
+        np.testing.assert_allclose(outs[0], outs[1])
+
+    def test_conv2d_dilation_not_shared(self):
+        x = fluid.dygraph.to_variable(
+            np.random.default_rng(0).standard_normal(
+                (1, 2, 8, 8)).astype(np.float32))
+        a = fluid.layers.conv2d(x, 4, 3, padding=1, dilation=1)
+        b = fluid.layers.conv2d(x, 4, 3, padding=2, dilation=2)
+        assert a.shape == b.shape == [1, 4, 8, 8]
+
+    def test_elementwise_axis_broadcast(self):
+        x = fluid.dygraph.to_variable(
+            np.zeros((2, 3, 4, 5), np.float32))
+        bias = fluid.dygraph.to_variable(
+            np.arange(3, dtype=np.float32))
+        out = fluid.layers.elementwise_add(x, bias, axis=1)
+        assert out.shape == [2, 3, 4, 5]
+        np.testing.assert_allclose(out.numpy()[0, :, 0, 0], [0, 1, 2])
+
+    def test_cross_entropy_rank2_label(self):
+        probs = fluid.dygraph.to_variable(
+            np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+        label = fluid.dygraph.to_variable(
+            np.array([[0], [1]]))  # the old mandatory [N, 1]
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(ce.numpy().reshape(-1),
+                                   [-np.log(0.9), -np.log(0.8)],
+                                   rtol=1e-5)
+
+    def test_accuracy_topk(self):
+        probs = fluid.dygraph.to_variable(np.array(
+            [[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32))
+        label = fluid.dygraph.to_variable(np.array([[1], [0]]))
+        acc5 = fluid.layers.accuracy(probs, label, k=3)
+        np.testing.assert_allclose(float(acc5.numpy()), 1.0)
+
+    def test_crf_cost_sign(self):
+        # fluid's linear_chain_crf is a COST (negative log-likelihood)
+        rng = np.random.default_rng(0)
+        x = fluid.dygraph.to_variable(
+            rng.standard_normal((2, 4, 3)).astype(np.float32))
+        y = fluid.dygraph.to_variable(rng.integers(0, 3, (2, 4)))
+        cost = fluid.layers.linear_chain_crf(x, y)
+        assert float(cost.numpy().mean()) > 0  # -log p >= 0
