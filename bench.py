@@ -138,7 +138,8 @@ def _assert_sane_mfu(mfu, detail, step_fn=None):
             f"accounting is broken; diagnostics: {json.dumps(detail)}")
 
 
-def bench_bert_base(on_tpu):
+def bench_bert_base(on_tpu, batch_override=None, seq_override=None,
+                    steps_override=None):
     import jax
     import paddle1_tpu as paddle
     from paddle1_tpu.distributed import ParallelEngine, build_mesh
@@ -147,6 +148,8 @@ def bench_bert_base(on_tpu):
 
     dev = jax.devices()[0]
     batch, seq = (32, 128) if on_tpu else (4, 64)
+    batch = batch if batch_override is None else batch_override
+    seq = seq if seq_override is None else seq_override
 
     model = BertForPretraining(bert_base(
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
@@ -171,7 +174,8 @@ def bench_bert_base(on_tpu):
 
     _read_back(engine.step(b))  # warmup (compile) flushed to completion
 
-    n_steps = 20 if on_tpu else 3
+    n_steps = (20 if on_tpu else 3) if steps_override is None \
+        else steps_override
     times, loss = _timed_steps(lambda: engine.step(b), n_steps)
     dt = statistics.median(times)
 
@@ -209,6 +213,15 @@ def main():
     import os
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="bert_base")
+    def _pos(v):
+        v = int(v)
+        if v <= 0:
+            raise argparse.ArgumentTypeError("must be > 0")
+        return v
+    ap.add_argument("--batch", type=_pos, default=None,
+                    help="override the config's batch (MFU sweeps)")
+    ap.add_argument("--seq", type=_pos, default=None)
+    ap.add_argument("--steps", type=_pos, default=None)
     args = ap.parse_args()
 
     if not _probe_tpu():
@@ -220,7 +233,9 @@ def main():
     on_tpu = jax.devices()[0].platform == "tpu"
 
     if args.config == "bert_base":
-        bench_bert_base(on_tpu)
+        bench_bert_base(on_tpu, batch_override=args.batch,
+                        seq_override=args.seq,
+                        steps_override=args.steps)
     else:
         from benches import run_config  # configs 1/2/4/5
         run_config(args.config, on_tpu)

@@ -24,7 +24,12 @@ def _site(name):
     parameters per op CALL SITE (unique auto-generated names); keying the
     eager cache on the caller's (file, line) reproduces that — a call in
     a training loop reuses its weights, two textual fc calls do not
-    weight-tie. An explicit ``name`` overrides (named sharing)."""
+    weight-tie. An explicit ``name`` overrides (named sharing).
+
+    KNOWN LIMIT (differs from reference per-creation semantics): two
+    layers created THROUGH THE SAME LINE — `a = fc(x, 8); b = fc(x, 8)`
+    on one line, or a helper function invoked for two branches — share
+    weights. Disambiguate with distinct ``name=`` arguments there."""
     if name:
         return ("named", name)
     import sys
@@ -131,10 +136,13 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):
     # fluid semantics: input is POST-softmax probabilities; label may be
     # the old mandatory [N, 1] shape
+    x = _t(input)
     lab = _t(label)
-    if lab.ndim == 2 and lab.shape[-1] == 1:
+    # the old mandatory [N, 1] label only pairs with rank-2 input;
+    # rank-3 sequence input keeps its [N, T] labels as-is
+    if x.ndim == 2 and lab.ndim == 2 and lab.shape[-1] == 1:
         lab = _manip.squeeze(lab, axis=-1)
-    return F.nll_loss(_math.log(_t(input)), lab,
+    return F.nll_loss(_math.log(x), lab,
                       ignore_index=ignore_index, reduction="none")
 
 
@@ -364,20 +372,28 @@ def linear_chain_crf(input, label, param_attr=None, length=None):
     linear_chain_crf creates 'transition' via param_attr)."""
     x = _t(input)
     n_tags = x.shape[-1]
-    store = linear_chain_crf.__dict__.setdefault("_params", {})
-    if n_tags not in store:
-        store[n_tags] = create_parameter([n_tags + 2, n_tags])
+    w = _crf_param(n_tags, param_attr)
     # the fluid op returns the NEGATIVE log-likelihood (a cost to
     # minimize — linear_chain_crf_op.h); F.linear_chain_crf returns
     # +log p(label|emission)
-    return F.linear_chain_crf(x, store[n_tags], label,
-                              length=length) * -1.0
+    return F.linear_chain_crf(x, w, label, length=length) * -1.0
+
+
+def _crf_param(n_tags, param_attr):
+    """Transition parameter shared between linear_chain_crf and
+    crf_decoding the way the reference shares it: by param_attr NAME.
+    Unnamed CRFs share by tag count (fine for the single-head case the
+    old scripts overwhelmingly are); a program with several same-width
+    CRF heads must name them apart via param_attr."""
+    name = getattr(param_attr, "name", param_attr)
+    key = ("named", name) if isinstance(name, str) else ("tags", n_tags)
+    store = _crf_param.__dict__.setdefault("_params", {})
+    if key not in store:
+        store[key] = create_parameter([n_tags + 2, n_tags])
+    return store[key]
 
 
 def crf_decoding(input, param_attr=None, label=None, length=None):
     x = _t(input)
-    n_tags = x.shape[-1]
-    store = linear_chain_crf.__dict__.setdefault("_params", {})
-    if n_tags not in store:
-        store[n_tags] = create_parameter([n_tags + 2, n_tags])
-    return F.crf_decoding(x, store[n_tags], label=label, length=length)
+    return F.crf_decoding(x, _crf_param(x.shape[-1], param_attr),
+                          label=label, length=length)

@@ -92,7 +92,7 @@ def linear_chain_crf(emission, transition, label, length=None):
 
 
 def crf_decoding(emission, transition, label=None, length=None):
-    """Viterbi decode → best tag path [B, T] int64 (reference
+    """Viterbi decode → best tag path [B, T] int32 (reference
     crf_decoding op; padding positions return 0). When ``label`` is
     given, returns [B, T] 0/1 correctness marks like the reference
     (1 where the decoded tag equals the label on valid steps)."""
@@ -134,18 +134,18 @@ def crf_decoding(emission, transition, label=None, length=None):
         # step k+1; the final carry is the step-0 tag
         path = jnp.concatenate([tag0[None, :], path_rest],
                                axis=0).transpose(1, 0)
-        path = jnp.where(m, path, 0).astype(jnp.int64)
+        path = jnp.where(m, path, 0).astype(jnp.int32)
         return path
 
     out = apply("crf_decoding", f, (e, w) + extra)
     if label is None:
         return out
     lab = _t(label)
-    valid = _mask(jnp.asarray(length) if length is not None else None,
-                  out.shape[1], out.shape[0]) if length is not None else None
+    valid = (_mask(jnp.asarray(length), out.shape[1], out.shape[0])
+             if length is not None else None)
 
     def marks(path, y):
-        eq = (path == y).astype(jnp.int64)
+        eq = (path == y).astype(jnp.int32)
         if valid is not None:
             eq = jnp.where(valid, eq, 0)
         return eq

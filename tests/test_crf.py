@@ -132,4 +132,5 @@ class TestFluidSpelling:
         path = fluid.layers.crf_decoding(x)
         assert path.shape == [2, 4]
         # shared transition parameter between the two entries
-        assert len(fluid.layers.linear_chain_crf._params) == 1
+        from paddle1_tpu.fluid.layers import _crf_param
+        assert ("tags", 3) in _crf_param._params

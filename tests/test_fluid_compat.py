@@ -183,3 +183,30 @@ class TestReviewRegressions:
         y = fluid.dygraph.to_variable(rng.integers(0, 3, (2, 4)))
         cost = fluid.layers.linear_chain_crf(x, y)
         assert float(cost.numpy().mean()) > 0  # -log p >= 0
+
+    def test_rank3_input_rank2_label_cross_entropy(self):
+        # sequence probs [B, T, C] with [B, T] labels keep working
+        probs = fluid.dygraph.to_variable(
+            np.full((2, 1, 2), 0.5, np.float32))
+        label = fluid.dygraph.to_variable(np.array([[0], [1]]))
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(ce.numpy().reshape(-1),
+                                   [np.log(2.0)] * 2, rtol=1e-6)
+
+    def test_same_line_fc_documented_sharing(self):
+        x = fluid.dygraph.to_variable(np.ones((1, 4), np.float32))
+        a, b = fluid.layers.fc(x, 3), fluid.layers.fc(x, 3)  # one line
+        np.testing.assert_allclose(a.numpy(), b.numpy())  # documented tie
+        c = fluid.layers.fc(x, 3, name="other")
+        assert not np.allclose(a.numpy(), c.numpy())
+
+    def test_crf_heads_separable_by_name(self):
+        rng = np.random.default_rng(0)
+        x = fluid.dygraph.to_variable(
+            rng.standard_normal((1, 3, 4)).astype(np.float32))
+        y = fluid.dygraph.to_variable(rng.integers(0, 4, (1, 3)))
+        fluid.layers.linear_chain_crf(x, y, param_attr="head_a")
+        fluid.layers.linear_chain_crf(x, y, param_attr="head_b")
+        from paddle1_tpu.fluid.layers import _crf_param
+        assert ("named", "head_a") in _crf_param._params
+        assert ("named", "head_b") in _crf_param._params

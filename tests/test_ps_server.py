@@ -183,3 +183,56 @@ class TestReviewRegressions:
         from paddle1_tpu.distributed import CheckpointManager
         with pytest.raises(ValueError, match="max_to_keep"):
             CheckpointManager(str(tmp_path / "x"), max_to_keep=0)
+
+
+class TestDownpourComposition:
+    """The reference's DistMultiTrainer + DownpourWorker shape
+    (trainer.h:57, downpour_worker.cc): worker threads pull sparse rows
+    from the parameter server around each step and push gradients back,
+    with the optimizer living IN the table. Here: MultiTrainer Hogwild
+    workers x DistributedEmbedding over the TCP TableServer."""
+
+    def test_hogwild_workers_train_through_remote_tables(self):
+        import paddle1_tpu as paddle
+        from paddle1_tpu.distributed.fleet.trainer import MultiTrainer
+
+        ones = lambda rng, dim: np.ones(dim, np.float32)
+        servers = [TableServer(SparseTable(4, optimizer="adagrad",
+                                           lr=0.5, seed=s,
+                                           initializer=ones)).start()
+                   for s in range(2)]
+        try:
+            svc = remote_service(4, [s.endpoint for s in servers])
+            emb = DistributedEmbedding(svc)
+            dense = paddle.nn.Linear(4, 1)
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=dense.parameters())
+
+            def loss_fn(batch):
+                ids = batch[:, :3]
+                y = paddle.to_tensor(
+                    batch[:, 3:].astype(np.float32))
+                vecs = emb(ids)                      # pull over TCP
+                pooled = vecs.sum(axis=1)
+                return ((dense(pooled) - y) ** 2).mean()
+
+            rng = np.random.default_rng(0)
+            samples = [np.concatenate([rng.integers(0, 20, 3),
+                                       [rng.integers(0, 2)]])
+                       for _ in range(96)]
+            trainer = MultiTrainer(thread_num=3)
+            stats = trainer.train_from_dataset(samples, loss_fn, opt,
+                                               batch_size=8)
+            assert stats["batches"] == 12  # 96 / 8
+            assert stats["workers"] == 3
+            # sparse rows materialized on the right shards, updated by
+            # the in-table optimizer (adagrad slots advanced)
+            assert len(servers[0].table) + len(servers[1].table) <= 20
+            assert len(servers[0].table) > 0 and len(servers[1].table) > 0
+            assert np.isfinite(stats["loss_mean"])
+            # rows moved away from the all-ones init
+            row = servers[0].table.pull(
+                [next(iter(servers[0].table._rows))])
+            assert not np.allclose(row, 1.0)
+        finally:
+            [s.stop() for s in servers]
