@@ -61,3 +61,158 @@ def disable_static(place=None):
 def enable_static():
     from .static import enable_static_mode
     enable_static_mode()
+
+
+# -- pre-2.0 top-level compat (reference python/paddle/__init__.py names
+# that old scripts touch; the heavyweight surface lives in paddle1_tpu.fluid)
+from . import reader  # noqa: E402  (legacy reader decorators)
+from . import regularizer  # noqa: E402
+from .distributed import DataParallel  # noqa: E402
+from .framework.param_attr import ParamAttr  # noqa: E402
+from .hapi import callbacks  # noqa: E402
+
+VarBase = Tensor  # dygraph-era tensor name
+CUDAPlace = TPUPlace  # old scripts mean "the accelerator"
+
+
+class CUDAPinnedPlace:  # host-pinned staging has no TPU analog
+    def __repr__(self):
+        return "CUDAPinnedPlace (compat: host memory)"
+
+
+def batch(reader_fn, batch_size, drop_last=False):
+    """The classic reader batcher (reference python/paddle/reader —
+    ``paddle.batch(train(), 64)``); yields lists of samples."""
+    def impl():
+        buf = []
+        for s in reader_fn():
+            buf.append(s)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return impl
+
+
+def in_dygraph_mode() -> bool:
+    return True
+
+
+in_dynamic_mode = in_dygraph_mode
+
+
+def enable_dygraph(place=None):
+    return None
+
+
+def disable_dygraph():
+    from .fluid import disable_dygraph as _impl
+    _impl()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False  # TPU build
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+# is_compiled_with_tpu comes from core (line ~16): a REAL device probe,
+# not a constant — scripts branch on it to pick CPUPlace vs TPUPlace
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in the TPU stack
+
+
+def get_cuda_rng_state():
+    return get_rng_state()  # the accelerator RNG state
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .nn.layer_base import Layer
+    return Layer().create_parameter(shape, attr=attr, dtype=dtype,
+                                    is_bias=is_bias,
+                                    default_initializer=default_initializer)
+
+
+def rank(input):
+    """Tensor rank as a 0-d int tensor (reference layers rank op)."""
+    import numpy as _np
+    return to_tensor(_np.asarray(Tensor(input).ndim
+                                 if not isinstance(input, Tensor)
+                                 else input.ndim, _np.int32))
+
+
+def is_empty(x, name=None):
+    import numpy as _np
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+    return to_tensor(_np.asarray(t.size == 0))
+
+
+def reverse(x, axis, name=None):
+    from .ops import manip_ops as _m
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _m.flip(x, ax)
+
+
+def tolist(x):
+    return (x if isinstance(x, Tensor) else to_tensor(x)).numpy().tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Numpy-backed print options (Tensor repr renders via numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+full_version = __version__
+
+
+bool = bool_  # dtype spelling (paddle.bool)
+
+
+class NPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("NPU is not a target of this TPU build; "
+                           "devices are CPUPlace / TPUPlace")
+
+
+class XPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("XPU is not a target of this TPU build; "
+                           "devices are CPUPlace / TPUPlace")
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Old spelling of the crop op (reference crop_tensor; one cropper —
+    ops.manip_ops.crop — owns the arithmetic)."""
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+    if shape is None:
+        shape = [-1] * t.ndim
+    shape = [-1 if s is None else s for s in shape]
+    from .ops import manip_ops as _m
+    return _m.crop(t, shape=shape, offsets=offsets)

@@ -76,11 +76,13 @@ class scope_guard:
 
 
 def in_dygraph_mode() -> bool:
-    return True  # eager is the only imperative mode
+    from .. import in_dygraph_mode as _impl  # single source of truth
+    return _impl()
 
 
 def enable_dygraph(place=None):
-    return None
+    from .. import enable_dygraph as _impl
+    return _impl(place)
 
 
 def disable_dygraph():

@@ -138,9 +138,9 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
     # the old mandatory [N, 1] shape
     x = _t(input)
     lab = _t(label)
-    # the old mandatory [N, 1] label only pairs with rank-2 input;
-    # rank-3 sequence input keeps its [N, T] labels as-is
-    if x.ndim == 2 and lab.ndim == 2 and lab.shape[-1] == 1:
+    # fluid's mandatory trailing-1 label shape at ANY rank:
+    # [N, 1] with rank-2 input, [B, T, 1] with rank-3 sequences
+    if lab.ndim == x.ndim and lab.shape[-1] == 1:
         lab = _manip.squeeze(lab, axis=-1)
     return F.nll_loss(_math.log(x), lab,
                       ignore_index=ignore_index, reduction="none")

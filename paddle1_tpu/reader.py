@@ -1,0 +1,121 @@
+"""Legacy reader decorators (reference python/paddle/reader/decorator.py):
+pre-2.0 input pipelines compose generator factories —
+``paddle.batch(paddle.reader.shuffle(train(), buf_size=500), 64)``.
+Modern code uses paddle1_tpu.io.DataLoader; this module keeps the old
+scripts runnable."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Callable, Iterable
+
+__all__ = ["shuffle", "buffered", "compose", "chain", "map_readers",
+           "firstn", "cache", "multiprocess_reader", "xmap_readers"]
+
+
+def shuffle(reader: Callable, buf_size: int):
+    """Buffered shuffle (decorator.py shuffle): fill a buf_size window,
+    yield in random order."""
+    def impl():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+    return impl
+
+
+def buffered(reader: Callable, size: int):
+    """Background-buffered reader. The modern DataLoader owns real
+    prefetch; here a simple bounded deque keeps the API contract."""
+    def impl():
+        from collections import deque
+        buf: deque = deque()
+        it = reader()
+        for s in it:
+            buf.append(s)
+            if len(buf) >= size:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+    return impl
+
+
+def map_readers(func: Callable, *readers: Callable):
+    def impl():
+        for samples in zip(*[r() for r in readers]):
+            yield func(*samples)
+    return impl
+
+
+def compose(*readers: Callable, check_alignment: bool = True):
+    def impl():
+        iters = [r() for r in readers]
+        # both flag values stop at the shortest reader — the reference
+        # never fabricates padding samples (check only changes whether
+        # misalignment is an error upstream)
+        zipper = zip(*iters)
+        for outs in zipper:
+            flat = []
+            for o in outs:
+                if isinstance(o, tuple):
+                    flat.extend(o)
+                else:
+                    flat.append(o)
+            yield tuple(flat)
+    return impl
+
+
+def chain(*readers: Callable):
+    def impl():
+        for r in readers:
+            yield from r()
+    return impl
+
+
+def firstn(reader: Callable, n: int):
+    def impl():
+        yield from itertools.islice(reader(), n)
+    return impl
+
+
+def cache(reader: Callable):
+    all_data = None
+
+    def impl():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+    return impl
+
+
+def xmap_readers(mapper: Callable, reader: Callable, process_num: int = 1,
+                 buffer_size: int = 0, order: bool = False):
+    """Parallel map (decorator.py xmap_readers). Thread pool keeps
+    ordering when asked; heavy parallel IO belongs in DataLoader."""
+    def impl():
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        window = max(process_num * 2, buffer_size or 0, 2)
+        with ThreadPoolExecutor(max_workers=max(1, process_num)) as ex:
+            pending = deque()
+            for s in reader():          # lazy submission: bounded window
+                pending.append(ex.submit(mapper, s))
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+    return impl
+
+
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000):
+    """Compat: serial chain (the multiprocess analog is
+    paddle1_tpu.io.DataLoader(num_workers=N))."""
+    return chain(*readers)

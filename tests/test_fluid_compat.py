@@ -210,3 +210,13 @@ class TestReviewRegressions:
         from paddle1_tpu.fluid.layers import _crf_param
         assert ("named", "head_a") in _crf_param._params
         assert ("named", "head_b") in _crf_param._params
+
+    def test_rank3_input_rank3_label_cross_entropy(self):
+        # fluid's trailing-1 label applies at any rank: [B,T,1] labels
+        probs = fluid.dygraph.to_variable(
+            np.full((2, 2, 2), 0.5, np.float32))
+        label = fluid.dygraph.to_variable(
+            np.zeros((2, 2, 1), np.int64))
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(np.asarray(ce.numpy()).reshape(-1),
+                                   [np.log(2.0)] * 4, rtol=1e-6)

@@ -5,6 +5,7 @@ import tempfile
 import unittest
 
 import numpy as np
+import pytest
 
 import paddle1_tpu as paddle
 
@@ -88,3 +89,59 @@ class TestMisc(unittest.TestCase):
         from paddle1_tpu.vision.models import LeNet
         with self.assertRaises(NotImplementedError):
             paddle.onnx.export(LeNet(), "/tmp/x.onnx")
+
+
+class TestPre20TopLevelCompat:
+    """r3 namespace sweep vs reference python/paddle/__init__.py: the
+    pre-2.0 top-level names old scripts touch."""
+
+    def test_reader_pipeline(self):
+        import paddle1_tpu as paddle
+
+        def train():
+            for i in range(10):
+                yield np.float32([i]), i % 2
+
+        r = paddle.batch(paddle.reader.shuffle(train, buf_size=4), 4)
+        batches = list(r())
+        assert [len(b) for b in batches] == [4, 4, 2]
+        r2 = paddle.batch(train, 4, drop_last=True)
+        assert [len(b) for b in list(r2())] == [4, 4]
+        # decorators compose
+        fn = paddle.reader.firstn(paddle.reader.cache(train), 3)
+        assert len(list(fn())) == 3
+        m = paddle.reader.map_readers(lambda s: s[1], train)
+        assert list(m()) == [i % 2 for i in range(10)]
+
+    def test_flags_and_modes(self):
+        import paddle1_tpu as paddle
+        # the real device probe (False on the CPU test sim, True on chip)
+        assert isinstance(paddle.is_compiled_with_tpu(), (bool, np.bool_))
+        assert not paddle.is_compiled_with_cuda()
+        assert paddle.in_dygraph_mode() and paddle.in_dynamic_mode()
+        assert paddle.get_cudnn_version() is None
+
+    def test_tensor_utilities(self):
+        import paddle1_tpu as paddle
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4))
+        assert int(paddle.rank(x).numpy()) == 2
+        assert paddle.tolist(paddle.to_tensor(np.array([1, 2]))) == [1, 2]
+        assert not bool(paddle.is_empty(x).numpy())
+        np.testing.assert_array_equal(
+            paddle.reverse(paddle.to_tensor(np.array([1, 2, 3])),
+                           0).numpy(), [3, 2, 1])
+        np.testing.assert_array_equal(
+            paddle.crop_tensor(x, shape=[2, 2],
+                               offsets=[1, 1]).numpy(),
+            [[5, 6], [9, 10]])
+
+    def test_aliases_and_places(self):
+        import paddle1_tpu as paddle
+        assert paddle.VarBase is paddle.Tensor
+        assert paddle.CUDAPlace is paddle.TPUPlace
+        with pytest.raises(RuntimeError, match="TPU build"):
+            paddle.NPUPlace(0)
+        p = paddle.create_parameter([2, 3])
+        assert p.shape == [2, 3]
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
