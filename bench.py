@@ -238,7 +238,7 @@ def main():
                         steps_override=args.steps)
     else:
         from benches import run_config  # configs 1/2/4/5
-        run_config(args.config, on_tpu)
+        run_config(args.config, on_tpu, batch=args.batch)
 
 
 if __name__ == "__main__":

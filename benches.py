@@ -31,12 +31,22 @@ def config(name):
     return deco
 
 
-def run_config(name: str, on_tpu: bool) -> None:
+def run_config(name: str, on_tpu: bool, batch=None) -> None:
     if name not in CONFIGS:
         raise SystemExit(
             f"unknown bench config {name!r}; available: "
             f"{['bert_base'] + sorted(CONFIGS)}")
-    CONFIGS[name](on_tpu)
+    import inspect
+    fn = CONFIGS[name]
+    if batch is None:
+        fn(on_tpu)
+        return
+    if "batch_override" not in inspect.signature(fn).parameters:
+        raise SystemExit(
+            f"config {name!r} does not support --batch; it would run at "
+            f"its hardcoded batch while reporting yours (honesty "
+            f"contract: refuse rather than mislead)")
+    fn(on_tpu, batch_override=batch)
 
 
 @config("mnist_lenet")
@@ -76,7 +86,7 @@ def bench_mnist_lenet(on_tpu):
 
 
 @config("resnet50_dp")
-def bench_resnet50_dp(on_tpu):
+def bench_resnet50_dp(on_tpu, batch_override=None):
     """BASELINE config 2: ResNet-50 data-parallel over all local devices
     (compiled engine; GSPMD inserts the grad all-reduce over ICI)."""
     import jax
@@ -88,7 +98,13 @@ def bench_resnet50_dp(on_tpu):
 
     devs = jax.devices()
     img = 224 if on_tpu else 32
-    per_dev = 32 if on_tpu else 2
+    # batch_override is the GLOBAL batch (same meaning as bert_base's
+    # --batch); it must divide the device count
+    if batch_override is not None and batch_override % len(devs):
+        raise SystemExit(f"--batch {batch_override} not divisible by "
+                         f"{len(devs)} devices")
+    per_dev = (32 if on_tpu else 2) if batch_override is None \
+        else batch_override // len(devs)
     batch = per_dev * len(devs)
 
     model = resnet50()
