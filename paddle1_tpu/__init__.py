@@ -216,3 +216,7 @@ def crop_tensor(x, shape=None, offsets=None, name=None):
     shape = [-1 if s is None else s for s in shape]
     from .ops import manip_ops as _m
     return _m.crop(t, shape=shape, offsets=offsets)
+
+
+from . import version  # noqa: E402  (paddle.version.show() etc.)
+commit = version.commit

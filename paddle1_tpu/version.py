@@ -1,0 +1,28 @@
+"""Version metadata (reference python/paddle/version.py, generated at
+build time there; static here)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # TPU build: no CUDA in the stack
+cudnn_version = "False"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
