@@ -168,6 +168,13 @@ def _define_builtin_flags() -> None:
     define_flag("fused_softmax", "auto",
                 "Pallas fused softmax: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("flash_backward", "never",
+                "Pallas flash-attention BACKWARD kernels: auto (TPU "
+                "only), always (interpret on CPU), never (XLA recompute "
+                "backward). Default 'never' until the Mosaic lowering is "
+                "chip-smoked (tools/tpu_kernel_smoke.py) — interpret "
+                "mode does not enforce Mosaic tiling.",
+                validator=lambda v: v in ("auto", "always", "never"))
 
 
 _define_builtin_flags()

@@ -209,9 +209,27 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_dispatch(q, k, v, out, lse, dout, scale, causal,
+                  padding_mask=None):
+    """XLA recompute backward by default; the Pallas backward kernels
+    when the flash_backward flag allows (chip-smoked lowering only —
+    see flash_attention_bwd.py)."""
+    from ...core.flags import flag
+    mode = flag("flash_backward")
+    use = (mode == "always" or
+           (mode == "auto" and jax.default_backend() == "tpu"))
+    if use:
+        from .flash_attention_bwd import flash_attention_bwd, supported
+        if supported(q.shape, k.shape):
+            return flash_attention_bwd(q, k, v, out, lse, dout, scale,
+                                       causal, padding_mask=padding_mask)
+    return _bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                    padding_mask=padding_mask)
+
+
 def _flash_vjp_bwd(scale, causal, res, dout):
     q, k, v, out, lse = res
-    return _bwd_xla(q, k, v, out, lse, dout, scale, causal)
+    return _bwd_dispatch(q, k, v, out, lse, dout, scale, causal)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -230,8 +248,8 @@ def _flash_masked_vjp_fwd(q, k, v, padding_mask, scale, causal):
 
 def _flash_masked_vjp_bwd(scale, causal, res, dout):
     q, k, v, padding_mask, out, lse = res
-    dq, dk, dv = _bwd_xla(q, k, v, out, lse, dout, scale, causal,
-                          padding_mask=padding_mask)
+    dq, dk, dv = _bwd_dispatch(q, k, v, out, lse, dout, scale, causal,
+                               padding_mask=padding_mask)
     # mask enters as f32 0/1 (see flash_attention), so a plain zero
     # cotangent is the right "non-differentiable" answer
     return dq, dk, dv, jnp.zeros_like(padding_mask)

@@ -346,3 +346,97 @@ class TestFusedAdam:
                                        np.asarray(p_plain[k]),
                                        rtol=1e-6, atol=1e-7)
         assert n >= fadam._CHUNK  # the weight actually took the fused path
+
+
+class TestFlashBackwardKernels:
+    """Pallas flash BACKWARD (ops/pallas/flash_attention_bwd.py) vs the
+    XLA recompute backward and vs autodiff of the dense reference —
+    interpret mode (flag default stays 'never' until the chip smoke)."""
+
+    def _problem(self, causal=False, masked=False, nq=256, nk=256):
+        rng = np.random.default_rng(0)
+        B, H, D = 2, 4, 64
+        q, k, v = (jnp.asarray(rng.standard_normal((B, n, H, D))
+                               .astype(np.float32))
+                   for n in (nq, nk, nk))
+        pm = jnp.asarray((rng.random((B, nk)) > 0.25)
+                         .astype(np.float32)) if masked else None
+        dout = jnp.asarray(rng.standard_normal((B, nq, H, D))
+                           .astype(np.float32))
+        return q, k, v, pm, dout
+
+    def _grads(self, q, k, v, pm, dout, causal):
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        from paddle1_tpu.ops.pallas.flash_attention_bwd import \
+            flash_attention_bwd
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        out, lse = fa._flash_fwd(q, k, v, scale, causal,
+                                 padding_mask=pm)
+        got = flash_attention_bwd(q, k, v, out, lse, dout, scale,
+                                  causal, padding_mask=pm)
+        want = fa._bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                           padding_mask=pm)
+        return got, want
+
+    def _check(self, got, want):
+        for g, w, name in zip(got, want, "q k v".split()):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"d{name}")
+
+    def test_plain(self):
+        q, k, v, pm, dout = self._problem()
+        got, want = self._grads(q, k, v, None, dout, causal=False)
+        self._check(got, want)
+
+    def test_causal(self):
+        q, k, v, pm, dout = self._problem(causal=True)
+        got, want = self._grads(q, k, v, None, dout, causal=True)
+        self._check(got, want)
+
+    def test_padding_mask(self):
+        q, k, v, pm, dout = self._problem(masked=True)
+        got, want = self._grads(q, k, v, pm, dout, causal=False)
+        self._check(got, want)
+
+    def test_causal_rectangular(self):
+        # nq < nk (bottom-right alignment)
+        q, k, v, pm, dout = self._problem(causal=True, nq=128, nk=256)
+        got, want = self._grads(q, k, v, None, dout, causal=True)
+        self._check(got, want)
+
+    def test_matches_dense_autodiff_end_to_end(self):
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.nn.functional.attention import attention_ref
+        from paddle1_tpu.ops.pallas.flash_attention import flash_attention
+        q, k, v, pm, dout = self._problem(masked=True)
+
+        with flags_guard(flash_backward="always"):
+            dq_p = jax.grad(lambda q: jnp.sum(
+                flash_attention(q, k, v, padding_mask=pm) * dout))(q)
+        dq_ref = jax.grad(lambda q: jnp.sum(attention_ref(
+            q, k, v, mask=(pm[:, None, None, :] > 0.5)) * dout))(q)
+        np.testing.assert_allclose(np.asarray(dq_p), np.asarray(dq_ref),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_flag_default_is_never(self):
+        from paddle1_tpu.core.flags import flag
+        assert flag("flash_backward") == "never"
+
+    def test_fully_padded_row_zero_grads(self):
+        # one batch entry entirely padded: all three grads must be EXACT
+        # zeros for it (the sentinel-LSE remap; review r3 finding)
+        q, k, v, pm, dout = self._problem(masked=True)
+        pm = pm.at[1].set(0.0)
+        got, want = self._grads(q, k, v, pm, dout, causal=False)
+        for g, name in zip(got, "q k v".split()):
+            np.testing.assert_array_equal(
+                np.asarray(g)[1], 0.0,
+                err_msg=f"d{name} row 1 must be exactly zero")
+        self._check(got, want)
+
+    def test_supported_bounds_full_sequence_residency(self):
+        from paddle1_tpu.ops.pallas.flash_attention_bwd import supported
+        assert supported((2, 256, 4, 64), (2, 256, 4, 64))
+        # 65536 q rows x 128 head dim: full q+do residency > VMEM budget
+        assert not supported((1, 65536, 1, 128), (1, 1024, 1, 128))

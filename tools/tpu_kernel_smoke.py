@@ -55,6 +55,29 @@ def main():
               q, k, v, mask=(pm[:, None, None, :] > 0.5))
               .astype(jnp.float32).sum())(q), 8e-2)
 
+    # flash BACKWARD kernels (flag-gated 'never' until this smoke passes;
+    # flip core flag flash_backward to 'auto' once green here)
+    from paddle1_tpu.ops.pallas import flash_attention as fa_mod
+    from paddle1_tpu.ops.pallas.flash_attention_bwd import \
+        flash_attention_bwd
+    dout = jnp.asarray(rng.standard_normal(q.shape).astype(np.float32))
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def bwd_pair(causal, mask):
+        out, lse = fa_mod._flash_fwd(q, k, v, scale, causal,
+                                     padding_mask=mask)
+        got = flash_attention_bwd(q, k, v, out, lse, dout, scale,
+                                  causal, padding_mask=mask)
+        want = fa_mod._bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                               padding_mask=mask)
+        return got, want
+    for nm, ca, mk in (("flash_bwd", False, None),
+                       ("flash_bwd_causal", True, None),
+                       ("flash_bwd_masked", False, pm)):
+        got, want = bwd_pair(ca, mk)  # compute ONCE per config
+        for which, g, w in zip(("dq", "dk", "dv"), got, want):
+            check(f"{nm}.{which}", lambda g=g: g, lambda w=w: w, 8e-2)
+
     # fused layer norm
     from paddle1_tpu.ops.pallas.layer_norm import fused_layer_norm
     x = jnp.asarray(rng.standard_normal((512, 768)).astype(np.float32))
