@@ -27,8 +27,12 @@ clean fallback to the untransformed statement):
 * ``a and b`` / ``a or b`` / ``not a`` are rewritten to converters that
   preserve Python value semantics (incl. short-circuit) for concrete
   operands and compute ``logical_and/or/not`` for traced ones.
-* Conversion applies to the decorated function itself; helpers it calls
-  are not rewritten (use static.nn.cond there, or decorate them too).
+* Calls inside a converted function are routed through
+  :func:`convert_call` (the reference's convert_call,
+  dygraph_to_static/convert_call_func.py): plain user-defined Python
+  functions are recursively converted (cached); builtins, library code
+  (paddle1_tpu/jax/numpy/stdlib), classes, and anything marked
+  ``@not_to_static`` pass through untouched.
 * Functions using ``global``/``nonlocal``, or whose source is
   unavailable (REPL/exec/lambda), fall back to the original unchanged.
 * A ``while``/``for`` whose bound is CONCRETE unrolls under the trace
@@ -295,6 +299,75 @@ def convert_logical_not(a):
     return not _to_bool(a)
 
 
+_SKIP_MODULE_PREFIXES = ("paddle1_tpu", "jax", "numpy")
+
+
+def _is_library_code(fn) -> bool:
+    """Only USER code converts. A denylist of module names cannot cover
+    the stdlib + every third-party package (recompiling re.sub once
+    crashed sre's Tokenizer), so decide by FILE LOCATION: anything under
+    the interpreter's stdlib/site-packages trees — or with no file at
+    all — is library code."""
+    import sys
+    import sysconfig
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
+        return True
+    f = getattr(sys.modules.get(mod), "__file__", None)
+    if not f:
+        return True  # builtins / frozen / synthetic modules
+    paths = sysconfig.get_paths()
+    roots = {paths.get("stdlib"), paths.get("platstdlib"),
+             paths.get("purelib"), paths.get("platlib")}
+    import os
+    f = os.path.abspath(f)
+    return any(r and f.startswith(os.path.abspath(r) + os.sep)
+               for r in roots)
+
+
+import weakref
+_call_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def convert_call(fn):
+    """Route a call target through conversion (reference
+    convert_call_func.py convert_call): recursively convert plain
+    user-defined functions so tensor control flow in HELPERS also
+    lowers; leave library code, builtins, classes, callables without
+    source, and ``@not_to_static`` targets untouched. Conversion
+    failures fall back to the original callable (convert_control_flow's
+    own contract)."""
+    import types
+
+    if type(fn) is types.FunctionType:  # the hot path
+        if getattr(fn, "__not_to_static__", False) or \
+                getattr(fn, "_p1t_dy2s_converted", False) or \
+                _is_library_code(fn):
+            return fn
+        return _convert_cached(fn)
+    if isinstance(fn, types.MethodType):
+        if getattr(fn, "__not_to_static__", False) or \
+                getattr(fn.__func__, "_p1t_dy2s_converted", False) or \
+                _is_library_code(fn):
+            return fn
+        conv = _convert_cached(fn.__func__)
+        return fn if conv is fn.__func__ else \
+            types.MethodType(conv, fn.__self__)
+    return fn  # classes, builtins, callables, partials: untouched
+
+
+def _convert_cached(f):
+    conv = _call_cache.get(f)
+    if conv is None:
+        conv = convert_control_flow(f)
+        if conv is not f and hasattr(conv, "__wrapped__"):
+            # functools.wraps back-ref would make the weak cache entry
+            # immortal (value → key strong ref)
+            del conv.__wrapped__
+        _call_cache[f] = conv
+    return conv
+
+
 # ---------------------------------------------------------------------------
 # AST rewrite (reference: ifelse/loop/logical transformers)
 # ---------------------------------------------------------------------------
@@ -528,6 +601,27 @@ def _str_list(names):
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
+        self.wrapped_calls = 0
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        # route the call target through convert_call so tensor control
+        # flow inside HELPER functions converts too; skip the
+        # converter's own injected helpers
+        if isinstance(node.func, ast.Name) and node.func.id.startswith(_H):
+            return node
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "super", "range", "len", "isinstance", "getattr",
+                "print", "enumerate", "zip", "float", "int", "str",
+                "bool", "min", "max", "abs", "sum", "list", "tuple",
+                "dict", "set", "sorted", "repr", "hasattr", "setattr",
+                "type", "id", "format", "round", "divmod", "all", "any"):
+            return node  # hot builtins: no wrap needed
+        self.wrapped_calls += 1
+        node.func = ast.copy_location(
+            ast.Call(func=_load(f"{_H}_call"), args=[node.func],
+                     keywords=[]), node.func)
+        return node
 
     # -- expressions --------------------------------------------------------
 
@@ -737,6 +831,7 @@ _HELPERS = {
     f"{_H}_range_test": range_test,
     f"{_H}_for_seed": for_seed,
     f"{_H}_undef": _Undef,
+    f"{_H}_call": convert_call,
 }
 
 
@@ -760,10 +855,21 @@ def convert_control_flow(fn: Callable) -> Callable:
         if conv is fn.__func__:
             return fn
         return types.MethodType(conv, fn.__self__)
+    if "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() reads the implicit __class__ cell, which an
+        # exec'd def outside the class body cannot have
+        return fn
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
     except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    import re as _re
+    if _re.search(r"\.\s*__\w+[a-zA-Z0-9](?!_)", src) or \
+            _re.search(r"\.\s*__\w+[a-zA-Z0-9]\b(?!__)", src):
+        # private-name mangling (self.__attr) resolves against the class
+        # the code was compiled in; recompiled outside it, the name stays
+        # unmangled — bail rather than mis-resolve
         return fn
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -777,12 +883,23 @@ def convert_control_flow(fn: Callable) -> Callable:
     for stmt in fdef.body:
         res = transformer.visit(stmt)
         new_body.extend(res if isinstance(res, list) else [res])
-    if transformer.counter == 0:
+    if transformer.counter == 0 and transformer.wrapped_calls == 0:
         return fn  # nothing converted — keep the original (zero risk)
+    # recompile also when only CALLS were wrapped: the function itself
+    # may be control-flow-free while its helpers are not
     fdef.body = new_body
     ast.fix_missing_locations(tree)
 
-    namespace = dict(fn.__globals__)
+    if fn.__closure__:
+        # closures force the snapshot namespace (free variables become
+        # globals of the recompiled function; injecting them into the
+        # REAL module globals could shadow module names)
+        namespace = dict(fn.__globals__)
+    else:
+        # closure-free: compile against the LIVE module globals so later
+        # rebinding of module-level helpers/config is seen (the helper
+        # names are prefixed __p1t_dy2s_, collision-safe)
+        namespace = fn.__globals__
     namespace.update(_HELPERS)
     if fn.__closure__:
         # snapshot free variables (cells) — the recompiled function reads
@@ -793,6 +910,8 @@ def convert_control_flow(fn: Callable) -> Callable:
                 namespace[name] = cell.cell_contents
             except ValueError:
                 return fn  # unresolved cell (self-reference) — bail out
+    _missing = object()
+    prev_binding = namespace.get(fdef.name, _missing)
     try:
         code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")
@@ -800,6 +919,13 @@ def convert_control_flow(fn: Callable) -> Callable:
     except Exception:
         return fn
     new_fn = namespace[fdef.name]
+    # live-globals exec just bound the converted function over the
+    # module's own name — restore the original so only to_static-reached
+    # call sites see the conversion (no module-wide clobber)
+    if prev_binding is _missing:
+        del namespace[fdef.name]
+    else:
+        namespace[fdef.name] = prev_binding
     new_fn = functools.wraps(fn)(new_fn)
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__

@@ -473,3 +473,164 @@ class TestReviewRegressions:
         with pytest.raises(TypeError) as e:
             f(_t([1.0]))
         assert "mismatched shapes" not in str(e.value)
+
+
+class TestConvertCall:
+    """Recursive callee conversion (reference convert_call_func.py):
+    tensor control flow inside HELPERS converts too."""
+
+    def test_helper_with_tensor_if_converts(self):
+        def helper(x):
+            if (x.sum() > 0):
+                y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        @to_static
+        def f(x):
+            return helper(x) + 1.0
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [3.0])
+        np.testing.assert_allclose(f(_t([-2.0])).numpy(), [3.0])
+
+    def test_two_level_nesting(self):
+        def inner(x):
+            acc = x * 0.0
+            for i in range(3):
+                acc = acc + x
+            return acc
+
+        def outer(x):
+            if (x.sum() > 0):
+                out = inner(x)
+            else:
+                out = x
+            return out
+
+        @to_static
+        def f(x):
+            return outer(x)
+
+        np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])
+        np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-2.0])
+
+    def test_not_to_static_helper_untouched(self):
+        @not_to_static
+        def helper(x):
+            if (x.sum() > 0):  # would raise if traced
+                return x * 2.0
+            return x
+
+        @to_static
+        def f(x, use_helper=False):
+            if use_helper:
+                return helper(x)
+            return x + 1.0
+
+        # helper never converted; calling it with a concrete pred works
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+
+    def test_library_calls_pass_through(self):
+        @to_static
+        def f(x):
+            z = np.float32(2.0)  # numpy: untouched by convert_call
+            if (x.sum() > 0):
+                y = x * float(z)
+            else:
+                y = x
+            return y
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+
+    def test_user_method_converts(self):
+        class Scaler:
+            def pick(self, x):
+                if (x.sum() > 0):
+                    s = x * 10.0
+                else:
+                    s = x * 0.1
+                return s
+
+        sc = Scaler()
+
+        @to_static
+        def f(x):
+            return sc.pick(x)
+
+        np.testing.assert_allclose(f(_t([1.0])).numpy(), [10.0])
+        np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-0.1])
+
+    def test_conversion_cached(self):
+        from paddle1_tpu.jit.dy2static import _call_cache, convert_call
+
+        def helper(x):
+            if (x.sum() > 0):
+                y = x
+            else:
+                y = -x
+            return y
+
+        c1 = convert_call(helper)
+        c2 = convert_call(helper)
+        assert c1 is c2 and c1 is not helper
+
+    def test_stdlib_functions_never_converted(self):
+        import re as _re
+        from paddle1_tpu.jit.dy2static import convert_call
+        assert convert_call(_re.sub) is _re.sub
+        assert convert_call(_re.sub)("a", "b", "banana") == "bbnbnb"
+        import json
+        assert convert_call(json.dumps) is json.dumps
+
+    def test_super_method_bails_safely(self):
+        from paddle1_tpu.jit.dy2static import convert_call
+
+        class Base:
+            def forward(self, x):
+                return x + 1
+
+        class Child(Base):
+            def forward(self, x):
+                return super().forward(x) * 2
+
+        c = Child()
+        assert convert_call(c.forward)(10) == 22  # no __class__ crash
+
+    def test_private_name_mangling_bails(self):
+        from paddle1_tpu.jit.dy2static import convert_control_flow
+
+        class Secretive:
+            def __init__(self):
+                self.__hidden = 5
+
+            def peek(self):
+                if True:
+                    v = self.__hidden
+                return v
+
+        s = Secretive()
+        conv = convert_control_flow(s.peek)
+        assert conv() == 5  # mangled attr still resolves
+
+    def test_live_globals_no_module_clobber(self, tmp_path):
+        import sys
+        mod_file = tmp_path / "dy2s_usermod.py"
+        mod_file.write_text(
+            "SCALE = 2.0\n"
+            "def noop(v):\n    return v\n"
+            "def helper(x):\n    return noop(x) * SCALE\n")
+        sys.path.insert(0, str(tmp_path))
+        try:
+            import dy2s_usermod as um
+            from paddle1_tpu.jit.dy2static import convert_call
+            orig = um.helper
+            conv = convert_call(um.helper)
+            assert conv is not orig
+            assert um.helper is orig          # module binding untouched
+            assert conv(1.0) == 2.0
+            um.SCALE = 3.0
+            assert conv(1.0) == 3.0           # live module globals
+        finally:
+            sys.path.remove(str(tmp_path))
+            sys.modules.pop("dy2s_usermod", None)
