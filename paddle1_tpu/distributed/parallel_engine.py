@@ -55,7 +55,8 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                     grad_accum: int = 1,
                     clip_global_norm: Optional[float] = None,
                     amp_dtype: Optional[str] = None,
-                    recompute: bool = False):
+                    recompute: bool = False,
+                    grad_shardings=None):
     """Build the pure train-step: (params, opt_state, batch, key, lr) →
     (loss, params, opt_state).
 
@@ -123,6 +124,17 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
             loss = jnp.mean(losses)
         else:
             loss, grads = jax.value_and_grad(pure_loss)(params, batch, key)
+        if grad_shardings is not None:
+            # Pin each grad to its ZeRO layout HERE, at the autodiff
+            # boundary: the batch reduction then lowers to a
+            # reduce-scatter into the slot sharding. Without the pin,
+            # GSPMD propagates the slot sharding backward THROUGH the
+            # reduction onto the batch-sharded activation grad — a
+            # batch-dim→hidden-dim transition it can only satisfy by
+            # "involuntary full rematerialization" (replicate-then-slice;
+            # the MULTICHIP_r03 warnings). Reference intent:
+            # sharding_optimizer.py:146 "reduce rather than allreduce".
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
         if clip_global_norm is not None:
             leaves = jax.tree_util.tree_leaves(grads)
             gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -231,12 +243,22 @@ class ParallelEngine:
                     per[sname] = base
             self.slot_specs[k] = per
 
+        # Stage>=2: pin grads to the PARAM layout at the autodiff boundary
+        # (see make_train_step). Left unpinned, GSPMD backward-propagates
+        # the slot shardings ('sharding' on a hidden dim) through the
+        # param-grad einsums onto batch-sharded activation grads — a
+        # batch-dim→hidden-dim transition it can only satisfy by
+        # "involuntary full rematerialization" (the MULTICHIP_r03
+        # warnings: replicate-then-repartition of every activation grad).
+        # Pinned to the param spec, grads materialize via a plain
+        # reduction over the batch axes and the slot-sharded update
+        # consumes a local slice; XLA's allreduce+slice→reduce-scatter
+        # reassociation supplies the ZeRO-2 comm pattern on TPU.
+        self.grad_shardings = None
         if zero_stage >= 2:
-            # grads are reduce-scattered: same layout as stage-1 slots.
-            # (GSPMD derives this from the slot/output shardings; nothing to
-            # do explicitly — recorded here for documentation parity with
-            # sharding_optimizer.py:146 "reduce rather than allreduce".)
-            pass
+            self.grad_shardings = {
+                k: NamedSharding(self.mesh, self.param_specs.get(k, P()))
+                for k in self.params}
 
         self.batch_spec = batch_spec  # None → infer batch-dim sharding
         self.grad_accum = grad_accum
@@ -244,7 +266,8 @@ class ParallelEngine:
                                         grad_accum=grad_accum,
                                         clip_global_norm=clip_global_norm,
                                         amp_dtype=amp_dtype,
-                                        recompute=recompute)
+                                        recompute=recompute,
+                                        grad_shardings=self.grad_shardings)
 
         ns = lambda spec: NamedSharding(self.mesh, spec)
         param_sh = {k: ns(s) for k, s in self.param_specs.items()}
@@ -288,8 +311,23 @@ class ParallelEngine:
             axes = list(s)
             if self.grad_accum > 1:
                 axes = [None] + axes  # leading dim = accumulation steps
-            # leaves with fewer dims than the spec (scalars: loss weights,
-            # step counters) are replicated, not batch-sharded
+            # every leaf must carry the leading accumulation dim under
+            # grad_accum (lax.scan consumes the whole batch pytree as xs,
+            # scalars included) — a leaf missing it would scan the batch
+            # dim or die inside scan; error at placement, where the
+            # message can say so, not at jit trace time. With
+            # grad_accum=1, 0-d leaves (loss weights, step counters) and
+            # trailing spec axes absent from a leaf (e.g. a per-sample
+            # weight without the seq dim) truncate-and-replicate.
+            if (self.grad_accum > 1
+                    and (a.ndim == 0 or a.shape[0] != self.grad_accum)):
+                from ..core.errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    f"grad_accum={self.grad_accum} needs every batch leaf "
+                    "shaped [grad_accum, ...] (scalars too — broadcast "
+                    "them to the accumulation dim or close over them in "
+                    "loss_fn); got leaf with shape "
+                    f"{tuple(a.shape)}")
             axes = axes[:a.ndim]
             ndim_spec = P(*(axes + [None] * (a.ndim - len(axes))))
             sh = NamedSharding(self.mesh, ndim_spec)
