@@ -431,7 +431,21 @@ def run_backward(tensors: Sequence[Tensor],
                     "graph; pass allow_unused=True to return None for it")
             out.append(None if g is None else Tensor(g, stop_gradient=True))
         return out
+    # A full backward (Tensor.backward, not paddle.grad) marks the end of
+    # a forward pass — observers (e.g. fluid.layers implicit-parameter
+    # pass tracking) hook here.
+    for cb in list(_backward_end_callbacks):
+        cb()
     return None
+
+
+_backward_end_callbacks: List[Callable[[], None]] = []
+
+
+def register_backward_end_callback(fn: Callable[[], None]) -> None:
+    """Call ``fn`` after every completed full backward pass."""
+    if fn not in _backward_end_callbacks:
+        _backward_end_callbacks.append(fn)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

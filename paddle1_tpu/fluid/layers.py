@@ -19,22 +19,103 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _site(name):
-    """Implicit-parameter identity. Reference fluid creates fresh
-    parameters per op CALL SITE (unique auto-generated names); keying the
-    eager cache on the caller's (file, line) reproduces that — a call in
-    a training loop reuses its weights, two textual fc calls do not
-    weight-tie. An explicit ``name`` overrides (named sharing).
+class _SiteStore:
+    __slots__ = ("layers", "cursor", "frozen", "warned_collapse",
+                 "warned_growth")
 
-    KNOWN LIMIT (differs from reference per-creation semantics): two
-    layers created THROUGH THE SAME LINE — `a = fc(x, 8); b = fc(x, 8)`
-    on one line, or a helper function invoked for two branches — share
-    weights. Disambiguate with distinct ``name=`` arguments there."""
-    if name:
-        return ("named", name)
+    def __init__(self):
+        self.layers = []
+        self.cursor = 0
+        self.frozen = False
+        self.warned_collapse = False
+        self.warned_growth = False
+
+
+_implicit_registry = {}
+
+
+def _implicit_layer(name, sig, factory):
+    """Implicit-parameter identity with reference per-CREATION semantics.
+
+    Reference fluid creates a fresh parameter set per layer-op creation
+    (unique auto-generated names, framework.py unique_name). Eagerly we
+    key on (call site, signature, occurrence-within-pass): the n-th call
+    at a site during one forward pass maps to the n-th parameter set
+    created there — so ``a = fc(x, 8); b = fc(x, 8)`` on ONE line, or a
+    helper invoked for two branches, get distinct weights, while a
+    training loop reuses its weights across iterations (the pass counter
+    resets on every completed ``backward()``; see
+    :func:`reset_parameter_pass`). An explicit ``name`` opts into named
+    sharing instead."""
     import sys
-    f = sys._getframe(2)
-    return (f.f_code.co_filename, f.f_lineno)
+    if name:
+        base = ("named", name, sig)
+    else:
+        f = sys._getframe(2)
+        base = (f.f_code.co_filename, f.f_lineno, sig)
+    st = _implicit_registry.setdefault(base, _SiteStore())
+    if name:
+        if not st.layers:
+            st.layers.append(factory())
+        return st.layers[0]
+    if st.cursor < len(st.layers):
+        lay = st.layers[st.cursor]
+    elif st.frozen:
+        # more calls this pass than creations in the completed first
+        # pass: distinct creations now collapse onto existing weights
+        lay = st.layers[st.cursor % len(st.layers)]
+        if not st.warned_collapse:
+            st.warned_collapse = True
+            import warnings
+            warnings.warn(
+                f"fluid.layers call at {base[0]}:{base[1]} ran "
+                f"{st.cursor + 1} times this pass but created "
+                f"{len(st.layers)} parameter set(s) in the first pass — "
+                "the extra calls reuse existing weights. If these should "
+                "be distinct layers, give each a distinct name=; if this "
+                "is a loop without backward(), call "
+                "fluid.layers.reset_parameter_pass() per iteration.")
+    else:
+        lay = factory()
+        st.layers.append(lay)
+        if len(st.layers) == 8 and not st.warned_growth:
+            st.warned_growth = True
+            import warnings
+            warnings.warn(
+                f"fluid.layers call at {base[0]}:{base[1]} has created "
+                "8 parameter sets without an intervening backward(): if "
+                "this is an eager evaluation loop, its parameters never "
+                "reuse — call fluid.layers.reset_parameter_pass() per "
+                "iteration (or pass name= to share explicitly).")
+    st.cursor += 1
+    return lay
+
+
+def reset_parameter_pass():
+    """Mark the end of a forward pass: per-site occurrence counters
+    rewind so the next pass reuses the same parameter sets in creation
+    order. Runs automatically after every completed ``backward()``."""
+    for st in _implicit_registry.values():
+        st.cursor = 0
+        if st.layers:
+            st.frozen = True
+
+
+def implicit_parameters():
+    """All parameters created by implicit fluid.layers calls (fc/
+    embedding/conv2d/batch_norm), in creation order — feed these to an
+    optimizer's ``parameters=`` (the shim analog of the reference's
+    program-scope parameter collection)."""
+    out = []
+    for st in _implicit_registry.values():
+        for lay in st.layers:
+            out.extend(lay.parameters())
+    return out
+
+
+from ..autograd import engine as _ag_engine  # noqa: E402
+
+_ag_engine.register_backward_end_callback(reset_parameter_pass)
 
 
 # -- dense / conv / norm -----------------------------------------------------
@@ -48,11 +129,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     x = _t(input)
     lead = x.shape[:num_flatten_dims]
     flat = int(np.prod(x.shape[num_flatten_dims:]))
-    key = (_site(name), flat, size)
-    store = fc.__dict__.setdefault("_layers", {})
-    if key not in store:
-        store[key] = _paddle.nn.Linear(flat, size)
-    lin = store[key]
+    lin = _implicit_layer(name, ("fc", flat, size),
+                          lambda: _paddle.nn.Linear(flat, size))
     out = lin(_manip.reshape(x, list(lead) + [flat]))
     if act is not None:
         out = getattr(F, act)(out)
@@ -61,13 +139,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32", name=None):
-    key = (_site(name), tuple(size), padding_idx)
-    store = embedding.__dict__.setdefault("_layers", {})
-    if key not in store:
-        store[key] = _paddle.nn.Embedding(size[0], size[1],
-                                          padding_idx=padding_idx,
-                                          sparse=is_sparse)
-    return store[key](_t(input))
+    lay = _implicit_layer(
+        name, ("embedding", tuple(size), padding_idx),
+        lambda: _paddle.nn.Embedding(size[0], size[1],
+                                     padding_idx=padding_idx,
+                                     sparse=is_sparse))
+    return lay(_t(input))
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0,
@@ -75,14 +152,13 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,
            act=None, name=None, data_format="NCHW"):
     x = _t(input)
     in_ch = x.shape[1 if data_format == "NCHW" else -1]
-    key = (_site(name), in_ch, num_filters, filter_size, stride,
-           padding, dilation, groups)
-    store = conv2d.__dict__.setdefault("_layers", {})
-    if key not in store:
-        store[key] = _paddle.nn.Conv2D(in_ch, num_filters, filter_size,
-                                       stride=stride, padding=padding,
-                                       dilation=dilation, groups=groups)
-    out = store[key](x)
+    lay = _implicit_layer(
+        name, ("conv2d", in_ch, num_filters, filter_size, stride,
+               padding, dilation, groups),
+        lambda: _paddle.nn.Conv2D(in_ch, num_filters, filter_size,
+                                  stride=stride, padding=padding,
+                                  dilation=dilation, groups=groups))
+    out = lay(x)
     if act is not None:
         out = getattr(F, act)(out)
     return out
@@ -104,12 +180,10 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9,
                data_layout="NCHW", name=None):
     x = _t(input)
     ch = x.shape[1 if data_layout == "NCHW" else -1]
-    key = (_site(name), ch)
-    store = batch_norm.__dict__.setdefault("_layers", {})
-    if key not in store:
-        store[key] = _paddle.nn.BatchNorm2D(ch, momentum=momentum,
-                                            epsilon=epsilon)
-    layer = store[key]
+    layer = _implicit_layer(
+        name, ("batch_norm", ch),
+        lambda: _paddle.nn.BatchNorm2D(ch, momentum=momentum,
+                                       epsilon=epsilon))
     layer.training = not is_test
     out = layer(x)
     if act is not None:
@@ -138,6 +212,17 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
     # the old mandatory [N, 1] shape
     x = _t(input)
     lab = _t(label)
+    if soft_label:
+        # label is an [N, C] (or [..., C]) probability distribution
+        # (reference cross_entropy_op.h soft-label branch)
+        if tuple(lab.shape) != tuple(x.shape):
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                "cross_entropy(soft_label=True) needs label with the same "
+                f"shape as input; got label {tuple(lab.shape)} vs input "
+                f"{tuple(x.shape)}")
+        return F.cross_entropy(x, lab, soft_label=True, use_softmax=False,
+                               reduction="none")
     # fluid's mandatory trailing-1 label shape at ANY rank:
     # [N, 1] with rank-2 input, [B, T, 1] with rank-3 sequences
     if lab.ndim == x.ndim and lab.shape[-1] == 1:
