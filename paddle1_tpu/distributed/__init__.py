@@ -26,9 +26,13 @@ from .sequence_parallel import ring_attention, ulysses_attention
 from .parallel_engine import ParallelEngine, make_train_step
 from .spawn import spawn
 from . import ps
-from .ps import DistributedEmbedding, EmbeddingService, SparseTable
+from .ps import (DenseTable, DistributedEmbedding, EmbeddingService,
+                 SparseTable)
 from . import ps_server
 from .ps_server import RemoteTable, TableServer, remote_service
+from . import communicator
+from .communicator import (AsyncCommunicator, DenseEndpoint,
+                           GeoCommunicator)
 from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
 from . import graph_table

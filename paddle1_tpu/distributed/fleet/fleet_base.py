@@ -129,11 +129,14 @@ class Fleet:
         self._ensure_init()
 
     def init_server(self, *args, dim: int = None, table_kwargs: dict = None,
-                    **kwargs):
+                    dense_tables: dict = None, **kwargs):
         """Create this PS node's table shard (reference fleet.init_server
-        loads the server program; here the 'program' is one SparseTable —
-        the scoped PS holds only the sparse embedding workload).
-        ``dim`` may come as a kwarg or via ``PADDLE_PS_TABLE_DIM``."""
+        loads the server program; here the 'program' is one SparseTable
+        plus optional named dense blocks — the reference PS node's
+        sparse + CommonDenseTable pairing).
+        ``dim`` may come as a kwarg or via ``PADDLE_PS_TABLE_DIM``;
+        ``dense_tables`` maps name → shape tuple (or a prebuilt
+        :class:`~paddle1_tpu.distributed.ps.DenseTable`)."""
         self._ensure_init()
         import os
         if dim is None:
@@ -142,8 +145,12 @@ class Fleet:
             raise PreconditionNotMetError(
                 "init_server needs the table dim: fleet.init_server(dim=D) "
                 "or env PADDLE_PS_TABLE_DIM")
-        from ..ps import SparseTable
+        from ..ps import DenseTable, SparseTable
         self._server_table = SparseTable(dim, **(table_kwargs or {}))
+        self._server_dense = {
+            name: (spec if isinstance(spec, DenseTable)
+                   else DenseTable(spec, **(table_kwargs or {})))
+            for name, spec in (dense_tables or {}).items()}
 
     def run_server(self):
         """Serve this node's table shard over TCP, blocking (reference
@@ -168,7 +175,8 @@ class Fleet:
                 "read the bound port back from fleet._table_server)")
         port = int(port_s)
         host = os.environ.get("POD_IP", "127.0.0.1")
-        srv = TableServer(table, host=host, port=port)
+        srv = TableServer(table, host=host, port=port,
+                          aux_tables=getattr(self, "_server_dense", None))
         self._table_server = srv
         srv.serve_forever()
 

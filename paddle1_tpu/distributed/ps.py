@@ -33,7 +33,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["SparseTable", "EmbeddingService", "DistributedEmbedding"]
+__all__ = ["SparseTable", "DenseTable", "EmbeddingService",
+           "DistributedEmbedding"]
 
 
 class SparseTable:
@@ -128,6 +129,141 @@ class SparseTable:
                            for i, ss in state["slots"].items()}
             self._steps = {int(i): int(t)
                            for i, t in state.get("steps", {}).items()}
+
+
+class DenseTable:
+    """One dense parameter block living on the PS, updated by worker
+    gradients through the table's own optimizer — the analog of the
+    reference's CommonDenseTable (/root/reference/paddle/fluid/
+    distributed/table/common_dense_table.h): dense params trained
+    asynchronously through the PS rather than held worker-local.
+
+    Two update surfaces (both in the remote ``RPC_METHODS`` whitelist so
+    a :class:`~paddle1_tpu.distributed.ps_server.RemoteTable` reaches
+    them over the wire):
+
+    * ``push_dense_grad(grad)`` — in-table sgd/adagrad/adam step
+      (async-SGD mode; the reference Communicator's send path).
+    * ``push_dense_delta(delta)`` — additive merge of a worker-side
+      parameter delta (geo-async SGD; the reference's GeoSgd/
+      sparse_geo_table delta semantics applied to the dense block).
+
+    ``version`` counts applied updates — the staleness bookkeeping the
+    geo mode's bounded-staleness contract is tested against.
+    """
+
+    RPC_METHODS = frozenset({"pull_dense", "push_dense_grad",
+                             "push_dense_delta", "set_value",
+                             "get_version"})
+
+    def __init__(self, shape, initializer: Optional[Callable] = None,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 adagrad_eps: float = 1e-6, beta1: float = 0.9,
+                 beta2: float = 0.999, adam_eps: float = 1e-8,
+                 seed: int = 0):
+        self.shape = tuple(int(s) for s in shape)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown table optimizer {optimizer!r}")
+        self._adagrad_eps = adagrad_eps
+        self._beta1, self._beta2, self._adam_eps = beta1, beta2, adam_eps
+        rng = np.random.default_rng(seed)
+        init = initializer or (
+            lambda r, shp: (r.standard_normal(shp) * 0.01)
+            .astype(np.float32))
+        self._value = np.asarray(init(rng, self.shape), np.float32)
+        self._m1 = np.zeros(self.shape, np.float32)
+        self._m2 = np.zeros(self.shape, np.float32)
+        self._step = 0
+        self.version = 0
+        self._lock = threading.Lock()
+
+    # dim handshake: RemoteTable.__init__ reads it; a dense block
+    # reports its trailing dim (EmbeddingService never hosts these)
+    @property
+    def dim(self) -> int:
+        return self.shape[-1] if self.shape else 1
+
+    def __len__(self) -> int:
+        return self.shape[0] if self.shape else 1
+
+    def pull_dense(self) -> np.ndarray:
+        with self._lock:
+            return self._value.copy()
+
+    def get_version(self) -> int:
+        with self._lock:
+            return self.version
+
+    def set_value(self, value) -> None:
+        value = np.asarray(value, np.float32)
+        if value.shape != self.shape:
+            raise ValueError(f"set_value shape {value.shape} != table "
+                             f"shape {self.shape}")
+        with self._lock:
+            self._value = value.copy()
+            self.version += 1
+
+    def push_dense_grad(self, grad) -> None:
+        g = np.asarray(grad, np.float32)
+        if g.shape != self.shape:
+            raise ValueError(f"grad shape {g.shape} != table shape "
+                             f"{self.shape}")
+        with self._lock:
+            v = self._value
+            if self.optimizer == "sgd":
+                v -= self.lr * g
+            elif self.optimizer == "adagrad":
+                self._m1 += g * g
+                v -= self.lr * g / (np.sqrt(self._m1) + self._adagrad_eps)
+            else:  # adam
+                self._step += 1
+                self._m1 *= self._beta1
+                self._m1 += (1 - self._beta1) * g
+                self._m2 *= self._beta2
+                self._m2 += (1 - self._beta2) * g * g
+                bc1 = 1 - self._beta1 ** self._step
+                bc2 = 1 - self._beta2 ** self._step
+                v -= self.lr * (self._m1 / bc1) / (
+                    np.sqrt(self._m2 / bc2) + self._adam_eps)
+            self.version += 1
+
+    def push_dense_delta(self, delta) -> None:
+        d = np.asarray(delta, np.float32)
+        if d.shape != self.shape:
+            raise ValueError(f"delta shape {d.shape} != table shape "
+                             f"{self.shape}")
+        with self._lock:
+            self._value += d
+            self.version += 1
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"shape": self.shape, "optimizer": self.optimizer,
+                    "lr": self.lr, "value": self._value.copy(),
+                    "m1": self._m1.copy(), "m2": self._m2.copy(),
+                    "step": self._step, "version": self.version}
+
+    def load_state_dict(self, state: dict) -> None:
+        sshape = tuple(state.get("shape", np.shape(state["value"])))
+        if sshape != self.shape:
+            raise ValueError(
+                f"DenseTable checkpoint has shape {sshape}, this table "
+                f"is {self.shape}")
+        sopt = state.get("optimizer", self.optimizer)
+        if sopt != self.optimizer:
+            raise ValueError(
+                f"DenseTable checkpoint was trained with optimizer "
+                f"{sopt!r}, this table is configured {self.optimizer!r} "
+                "— the slot values would be misinterpreted")
+        with self._lock:
+            self._value = np.asarray(state["value"], np.float32)
+            self._m1 = np.asarray(state["m1"], np.float32)
+            self._m2 = np.asarray(state["m2"], np.float32)
+            self._step = int(state.get("step", 0))
+            self.version = int(state.get("version", 0))
+            self.lr = float(state.get("lr", self.lr))
 
 
 class EmbeddingService:

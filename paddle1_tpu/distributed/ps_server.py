@@ -14,7 +14,11 @@ Protocol: length-prefixed pickled (op, payload) tuples over TCP, one
 request per round-trip, thread-per-connection on the server. Pickle is
 acceptable for the same reason the reference's brpc endpoints are: the
 PS protocol runs inside a trusted training cluster, never on a public
-interface — bind to cluster-internal addresses only.
+interface — bind to cluster-internal addresses only. Defense-in-depth:
+set ``PADDLE_PS_SECRET`` (any string, same value on every node) and each
+frame carries an HMAC-SHA256 tag that is verified BEFORE the payload is
+unpickled, so a stray client that can reach the port but lacks the
+secret cannot reach the deserializer.
 
 Env contract (reference launch_utils.py PS mode):
 ``PADDLE_PSERVERS_IP_PORT_LIST`` = comma-separated ``host:port`` of the
@@ -25,6 +29,9 @@ consume these (fleet_base.py).
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import os
 import pickle
 import socket
 import socketserver
@@ -39,21 +46,37 @@ from .ps import SparseTable
 
 __all__ = ["TableServer", "RemoteTable", "remote_service"]
 
-_HDR = struct.Struct("!I")
+_HDR = struct.Struct("!BI")  # (tag-present flag, payload length)
 _MAX_MSG = 1 << 30
+_TAG_LEN = hashlib.sha256().digest_size
 
 
 _SMALL_MSG = 1 << 20
 
+_log = __import__("logging").getLogger("paddle1_tpu.ps")
+
+
+class _AuthError(ConnectionError):
+    """Frame failed/skipped HMAC authentication (vs. a plain socket
+    error): the server logs it and tells the peer why before closing."""
+
+
+def _secret() -> Optional[bytes]:
+    s = os.environ.get("PADDLE_PS_SECRET")
+    return s.encode() if s else None
+
 
 def _send(sock, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    key = _secret()
+    tag = _hmac.new(key, payload, hashlib.sha256).digest() if key else b""
+    hdr = _HDR.pack(1 if key else 0, len(payload))
     if len(payload) < _SMALL_MSG:
         # one segment: avoids the Nagle write-write-read stall on the
         # per-step pull/push round-trips (the copy is cheap at this size)
-        sock.sendall(_HDR.pack(len(payload)) + payload)
+        sock.sendall(hdr + tag + payload)
     else:
-        sock.sendall(_HDR.pack(len(payload)))
+        sock.sendall(hdr + tag)
         sock.sendall(payload)  # no second copy of a big body
 
 
@@ -61,12 +84,34 @@ def _recv(sock):
     hdr = _recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
-    (n,) = _HDR.unpack(hdr)
+    tagged, n = _HDR.unpack(hdr)
     if n > _MAX_MSG:
         raise ValueError(f"ps message too large: {n} bytes")
+    key = _secret()
+    tag = b""
+    if tagged:
+        tag = _recv_exact(sock, _TAG_LEN)
+        if tag is None:
+            raise ConnectionError("peer closed mid-message")
+    elif key:
+        # the flag makes asymmetric configuration a loud error, not a
+        # mutual read-hang: without it we would consume payload bytes as
+        # a tag and then block waiting for the remainder. Drain the body
+        # first so an err reply can be framed on an aligned stream.
+        _recv_exact(sock, n)
+        raise _AuthError(
+            "peer sent an unauthenticated ps frame but this side has "
+            "PADDLE_PS_SECRET set — configure the same secret on every "
+            "node")
     body = _recv_exact(sock, n)
     if body is None:
         raise ConnectionError("peer closed mid-message")
+    if key and not _hmac.compare_digest(
+            tag, _hmac.new(key, body, hashlib.sha256).digest()):
+        # authenticate BEFORE deserializing: an unauthenticated client
+        # never reaches pickle.loads
+        raise _AuthError("ps frame failed HMAC authentication "
+                         "(PADDLE_PS_SECRET mismatch)")
     return pickle.loads(body)
 
 
@@ -91,6 +136,17 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 msg = _recv(self.request)
+            except _AuthError as e:
+                # surface the misconfiguration on both sides: log here,
+                # send the reason to the peer (the reply frame carries a
+                # tag the peer simply skips if it has no secret), close
+                _log.warning("dropping ps client %s: %s",
+                             self.client_address, e)
+                try:
+                    _send(self.request, ("err", str(e)))
+                except OSError:
+                    pass
+                return
             except (ConnectionError, OSError):
                 return
             if msg is None:
@@ -114,19 +170,38 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send(self.request, ("ok", "pong"))
                 elif op == "dim":
                     _send(self.request, ("ok", table.dim))
-                elif op == "call":
-                    # generic table method — whitelisted per table class
-                    # (GraphTable sampling ops etc.); never arbitrary attrs
-                    method, args, kwargs = payload
-                    allowed = getattr(table, "RPC_METHODS", frozenset())
+                elif op in ("call", "tcall"):
+                    # whitelisted table method, never arbitrary attrs.
+                    # "call" targets the primary table (GraphTable
+                    # sampling etc.); "tcall" routes by table NAME
+                    # (reference: one brpc PS serves many tables by id —
+                    # a Downpour node pairs its sparse shard with dense
+                    # blocks on one port).
+                    if op == "call":
+                        tname, (method, args, kwargs) = None, payload
+                    else:
+                        tname, method, args, kwargs = payload
+                    aux = self.server.aux_tables  # type: ignore[attr-defined]
+                    tgt = table if tname is None else aux.get(tname)
+                    if tgt is None:
+                        _send(self.request,
+                              ("err", f"no table named {tname!r} on this "
+                                      f"server (have {sorted(aux)})"))
+                        continue
+                    allowed = getattr(tgt, "RPC_METHODS", frozenset())
                     if method not in allowed:
                         _send(self.request,
-                              ("err", f"method {method!r} not in this "
-                                      f"table's RPC_METHODS"))
+                              ("err", f"method {method!r} not in "
+                                      + ("this table's"
+                                         if tname is None else
+                                         f"table {tname!r}'s")
+                                      + " RPC_METHODS"))
                     else:
                         _send(self.request,
-                              ("ok", getattr(table, method)(*args,
-                                                            **kwargs)))
+                              ("ok", getattr(tgt, method)(*args, **kwargs)))
+                elif op == "tlist":
+                    _send(self.request,
+                          ("ok", sorted(self.server.aux_tables)))  # type: ignore[attr-defined]
                 elif op == "shutdown":
                     _send(self.request, ("ok", None))
 
@@ -156,10 +231,14 @@ class TableServer:
     (tests, notebooks)."""
 
     def __init__(self, table: SparseTable, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, aux_tables: Optional[dict] = None):
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.table = table  # type: ignore[attr-defined]
+        # named side tables on the same port (dense blocks beside the
+        # sparse shard — the reference's multi-table PS node)
+        self._srv.aux_tables = dict(aux_tables or {})  # type: ignore[attr-defined]
         self.table = table
+        self.aux_tables = self._srv.aux_tables  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -234,6 +313,16 @@ class RemoteTable:
         """Invoke a whitelisted table method remotely (GraphTable's
         sampling surface and other non-embedding tables)."""
         return self._call("call", (method, args, kwargs))
+
+    def table_call(self, table_name: Optional[str], method: str, *args,
+                   **kwargs):
+        """Invoke a whitelisted method on a NAMED table of this server
+        (dense blocks served beside the sparse shard); ``None`` targets
+        the primary table."""
+        return self._call("tcall", (table_name, method, args, kwargs))
+
+    def list_tables(self):
+        return self._call("tlist")
 
     def shutdown_server(self) -> None:
         self._call("shutdown")
