@@ -11,7 +11,8 @@ import random
 from typing import Callable, Iterable
 
 __all__ = ["shuffle", "buffered", "compose", "chain", "map_readers",
-           "firstn", "cache", "multiprocess_reader", "xmap_readers"]
+           "firstn", "cache", "multiprocess_reader", "xmap_readers",
+           "ComposeNotAligned"]
 
 
 def shuffle(reader: Callable, buf_size: int):
@@ -53,13 +54,23 @@ def map_readers(func: Callable, *readers: Callable):
     return impl
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different sample counts and
+    check_alignment=True (reference decorator.py ComposeNotAligned)."""
+
+
 def compose(*readers: Callable, check_alignment: bool = True):
     def impl():
         iters = [r() for r in readers]
-        # both flag values stop at the shortest reader — the reference
-        # never fabricates padding samples (check only changes whether
-        # misalignment is an error upstream)
-        zipper = zip(*iters)
+        if check_alignment:
+            sentinel = object()
+            zipper = (outs for outs in
+                      itertools.zip_longest(*iters, fillvalue=sentinel)
+                      if _aligned(outs, sentinel))
+        else:
+            # stop at the shortest reader — the reference never
+            # fabricates padding samples
+            zipper = zip(*iters)
         for outs in zipper:
             flat = []
             for o in outs:
@@ -69,6 +80,14 @@ def compose(*readers: Callable, check_alignment: bool = True):
                     flat.append(o)
             yield tuple(flat)
     return impl
+
+
+def _aligned(outs, sentinel):
+    if any(o is sentinel for o in outs):
+        raise ComposeNotAligned(
+            "compose: readers yielded different numbers of samples "
+            "(pass check_alignment=False to truncate at the shortest)")
+    return True
 
 
 def chain(*readers: Callable):

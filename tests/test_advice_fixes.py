@@ -206,3 +206,134 @@ class TestProfilerCrossThread(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestAdviceR3Fixes(unittest.TestCase):
+    """Regression tests for the ADVICE r3 findings."""
+
+    def test_fluid_cross_entropy_soft_label(self):
+        import paddle1_tpu.fluid.layers as L
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((4, 5)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        soft = rng.random((4, 5)).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        out = L.cross_entropy(to_tensor(probs), to_tensor(soft),
+                              soft_label=True)
+        expect = -(soft * np.log(probs)).sum(-1)
+        np.testing.assert_allclose(np.asarray(out.data), expect, rtol=1e-5)
+
+    def test_fluid_cross_entropy_soft_label_shape_mismatch_raises(self):
+        import paddle1_tpu.fluid.layers as L
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        probs = np.full((4, 5), 0.2, np.float32)
+        lab = np.zeros((4, 1), np.int64)
+        with self.assertRaises(InvalidArgumentError):
+            L.cross_entropy(to_tensor(probs), to_tensor(lab),
+                            soft_label=True)
+
+    def test_reader_compose_alignment_raises(self):
+        from paddle1_tpu import reader
+        r1 = lambda: iter([1, 2, 3])
+        r2 = lambda: iter([10, 20])
+        with self.assertRaises(reader.ComposeNotAligned):
+            list(reader.compose(r1, r2)())
+
+    def test_reader_compose_unchecked_truncates(self):
+        from paddle1_tpu import reader
+        r1 = lambda: iter([1, 2, 3])
+        r2 = lambda: iter([10, 20])
+        out = list(reader.compose(r1, r2, check_alignment=False)())
+        self.assertEqual(out, [(1, 10), (2, 20)])
+
+    def test_reader_compose_aligned_ok(self):
+        from paddle1_tpu import reader
+        r1 = lambda: iter([(1, 2), (3, 4)])
+        r2 = lambda: iter([10, 20])
+        out = list(reader.compose(r1, r2)())
+        self.assertEqual(out, [(1, 2, 10), (3, 4, 20)])
+
+    def test_ps_frame_hmac_rejects_unauthenticated(self):
+        import os
+        from paddle1_tpu.distributed import ps, ps_server
+        os.environ["PADDLE_PS_SECRET"] = "topsecret"
+        try:
+            srv = ps_server.TableServer(ps.SparseTable(dim=4)).start()
+            good = ps_server.RemoteTable(srv.endpoint)
+            self.assertTrue(good.ping())
+            # a frame with a forged tag must be dropped BEFORE the server
+            # unpickles it: the connection closes with no reply
+            import pickle
+            import socket as socketlib
+            def _drain(sock):
+                out = b""
+                while True:
+                    b_ = sock.recv(4096)
+                    if not b_:
+                        return out
+                    out += b_
+
+            payload = pickle.dumps(("ping", None))
+            raw = socketlib.create_connection(
+                (srv.host, srv.port), timeout=5.0)
+            raw.sendall(ps_server._HDR.pack(1, len(payload)) +
+                        b"\x00" * ps_server._TAG_LEN + payload)
+            reply = _drain(raw)  # err frame explaining, then close
+            self.assertIn(b"HMAC", reply)
+            self.assertNotIn(b"pong", reply)  # the op never executed
+            raw.close()
+            # an UNTAGGED frame against a secret-bearing server is a loud
+            # drop too (flag byte prevents the read-deadlock)
+            raw2 = socketlib.create_connection(
+                (srv.host, srv.port), timeout=5.0)
+            raw2.sendall(ps_server._HDR.pack(0, len(payload)) + payload)
+            reply2 = _drain(raw2)
+            self.assertIn(b"PADDLE_PS_SECRET", reply2)
+            self.assertNotIn(b"pong", reply2)
+            raw2.close()
+            self.assertTrue(good.ping())  # authed session unaffected
+            good.shutdown_server()
+        finally:
+            os.environ.pop("PADDLE_PS_SECRET", None)
+
+    def test_engine_place_rejects_silent_spec_drop(self):
+        """A 1-D leaf that would drop a sharded batch-spec axis under
+        grad_accum errors at placement, not deep inside jit."""
+        import paddle1_tpu as paddle
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        from paddle1_tpu.distributed import ParallelEngine
+        from paddle1_tpu.nn.layer_common import Linear
+
+        model = Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        loss = lambda m, b: m(b["x"]).mean() + b["w"].mean()
+        eng = ParallelEngine(model, opt, loss,
+                             degrees={"dp": len(jax.devices())},
+                             grad_accum=2)
+        bad = {"x": np.zeros((2, 8, 4), np.float32),
+               "w": np.zeros((8,), np.float32)}  # missing accum dim
+        with self.assertRaises(InvalidArgumentError):
+            eng.shard_batch(bad)
+        # a 0-d leaf dies inside lax.scan under grad_accum — also caught
+        # at placement with the friendly message
+        with self.assertRaises(InvalidArgumentError):
+            eng.shard_batch({"x": np.zeros((2, 8, 4), np.float32),
+                             "s": np.float32(2.0)})
+        ok = {"x": np.zeros((2, 8, 4), np.float32),
+              "w": np.zeros((2, 8), np.float32)}
+        eng.shard_batch(ok)  # placement fine; scalars still replicate
+
+    def test_engine_place_scalar_leaf_still_replicates(self):
+        import paddle1_tpu as paddle
+        from paddle1_tpu.distributed import ParallelEngine
+        from paddle1_tpu.nn.layer_common import Linear
+        model = Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        eng = ParallelEngine(model, opt,
+                             lambda m, b: m(b["x"]).mean(),
+                             degrees={"dp": len(jax.devices())})
+        placed = eng.shard_batch({"x": np.zeros((8, 4), np.float32),
+                                  "s": np.float32(2.0)})
+        self.assertEqual(placed["s"].shape, ())
