@@ -238,6 +238,10 @@ class ShmArena:
         buf = (ctypes.c_uint8 * n).from_address(ptr)
         return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
 
+    def incref(self, desc):
+        """Extra reader share of a block (multi-consumer broadcast)."""
+        self._lib.shm_incref(self._base, desc[0])
+
     def decref(self, desc):
         self._lib.shm_decref(self._base, desc[0])
 

@@ -22,6 +22,7 @@ from ..meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                              SegmentLayers)
 from .utils import recompute, fleet_util
 from .trainer import HogwildWorker, MultiTrainer
+from .process_trainer import ProcessMultiTrainer
 
 # module-level delegation to the singleton (the reference exposes
 # fleet.init etc. as module functions)

@@ -16,6 +16,11 @@ file datasets. Worker threads compute forward/backward concurrently
 serialized on a short lock — the asynchronous, slightly-stale update
 semantics of Hogwild, with the slot-state races removed. Sparse pushes
 through DistributedEmbedding hooks stay fully concurrent.
+
+For GIL-bound workloads (slot parsing, python feature engineering) use
+:class:`~paddle1_tpu.distributed.fleet.process_trainer.
+ProcessMultiTrainer` — real process workers over the shm arena with the
+same Hogwild semantics and actual multi-core throughput.
 """
 
 from __future__ import annotations

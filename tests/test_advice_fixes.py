@@ -204,8 +204,6 @@ class TestProfilerCrossThread(unittest.TestCase):
         self.assertEqual(seen["dir"], "/tmp/fake_dir_sentinel")
 
 
-if __name__ == "__main__":
-    unittest.main()
 
 
 class TestAdviceR3Fixes(unittest.TestCase):
@@ -337,3 +335,7 @@ class TestAdviceR3Fixes(unittest.TestCase):
         placed = eng.shard_batch({"x": np.zeros((8, 4), np.float32),
                                   "s": np.float32(2.0)})
         self.assertEqual(placed["s"].shape, ())
+
+
+if __name__ == "__main__":
+    unittest.main()
