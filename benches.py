@@ -144,12 +144,18 @@ def bench_resnet50_dp(on_tpu, batch_override=None):
 @config("ernie_sharded")
 def bench_ernie_sharded(on_tpu):
     """BASELINE config 4: ERNIE-1.5B-class training with ZeRO-2 sharding
-    (reduce-scatter over ICI). A single chip cannot hold 1.63B params +
-    f32 Adam moments, so on one device this measures a depth-proxy
-    (6 of 24 layers, same width — the per-layer compute the full model
-    replicates 4×); with >= 4 devices the full depth runs sharded. The
-    full-scale sharded compile path is validated on the virtual 8-device
-    mesh by tests/test_parallel_engine.py and __graft_entry__.py."""
+    (reduce-scatter over ICI). Published memory math
+    (tools/memory_math.py): full depth needs ~28 GiB (f32 masters +
+    Adam moments + grads + bf16 copy) — a single 16-GiB v5e cannot hold
+    it; ZeRO-2 fits it from 4 chips (~14.4 GiB/chip). On one device
+    this measures the LARGEST DEPTH THAT FITS: 10 of 24 layers at full
+    width (~12.9 GiB peak) — per-layer compute identical to full scale,
+    so full-depth throughput projects as value × (proxy step FLOPs /
+    full step FLOPs) with the same MFU; the detail dict carries that
+    projection. With >= 4 devices the full depth runs sharded; the
+    full-scale sharded compile path is validated on the virtual
+    8-device mesh by tests/test_parallel_engine.py, test_sharding_remat
+    and __graft_entry__.py."""
     import jax
     import statistics
     import paddle1_tpu as paddle
@@ -162,7 +168,9 @@ def bench_ernie_sharded(on_tpu):
 
     devs = jax.devices()
     n = len(devs)
-    layers = 24 if n >= 4 else 6
+    # memory math (tools/memory_math.py): 24 layers fit from 4 chips
+    # under ZeRO-2; one chip holds at most 10 full-width layers
+    layers = 24 if n >= 4 else (10 if on_tpu else 6)
     seq = 512 if on_tpu else 64
     per_dev = 4 if on_tpu else 1
     batch = per_dev * n
@@ -205,6 +213,20 @@ def bench_ernie_sharded(on_tpu):
               "params": n_params, "devices": n, "zero_stage": 2,
               "step_ms_median": round(dt * 1e3, 2), "mfu": round(mfu, 4),
               "proxy": layers != 24, "loss": float(loss)}
+    if layers != 24 and on_tpu:
+        # proxy basis (tools/memory_math.py): same width => same MFU;
+        # full-depth samples/s = measured × FLOPs(proxy)/FLOPs(24L).
+        # Per-layer param count inlined (NOT imported) so an import
+        # problem can never eat the measurement before the JSON emits.
+        H, I = enc.hidden_size, enc.intermediate_size
+        per_layer = (4 * H * H + 4 * H) + (H * I + I + I * H + H) + 4 * H
+        full_n = n_params + (24 - layers) * per_layer
+        attn24 = 12 * 24 * batch * seq * seq * H
+        flops_full = 6 * full_n * batch * seq + attn24
+        detail["proxy_basis"] = ("largest depth fitting 16GiB "
+                                 "(tools/memory_math.py)")
+        detail["projected_full_depth_samples_per_sec"] = round(
+            (batch / dt) * flops_step / flops_full, 2)
     _assert_sane_mfu(mfu, detail,
                      step_fn=lambda: engine.step(b))
     _emit("ernie_1p5b_zero2_samples_per_sec", batch / dt, "samples/s",

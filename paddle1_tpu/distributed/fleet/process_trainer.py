@@ -103,7 +103,10 @@ def _worker_main(worker_id, arena_name, task_q, grad_q, param_q,
         ep, _ver, pdescs = msg
         if ep != epoch.value:
             return False
-        flat = _tree_get(arena, pdescs)
+        # decref=False: a reset racing this read must see NO writes at
+        # stale offsets (decref's fetch_sub would land inside freshly
+        # allocated blocks); reset is the arena's only reclaimer anyway
+        flat = _tree_get(arena, pdescs, decref=False)
         if ep != epoch.value:
             return False
         for name, p in tparams.items():
@@ -269,6 +272,7 @@ class ProcessMultiTrainer:
         version = 0
         exited = 0
         error = None
+        draining = False
 
         def absorb(block):
             """Apply one grad message (or worker exit) from grad_q."""
@@ -319,7 +323,9 @@ class ProcessMultiTrainer:
             optimizer.step()
             optimizer.clear_grad()
             updates += 1
-            if updates % self.publish_interval == 0:
+            if updates % self.publish_interval == 0 and not draining:
+                # during the reset barrier the arena is near-full and a
+                # fresh republish follows the reset anyway
                 version += 1
                 publish(version)
             return True
@@ -329,8 +335,10 @@ class ProcessMultiTrainer:
             while True:
                 # memory barrier: drain in-flight, reset, republish
                 if arena.used() > self.arena_size * self.arena_reset_fraction:
+                    draining = True
                     while outstanding > 0 and error is None:
                         absorb(block=True)
+                    draining = False
                     # bump the epoch FIRST: any pre-reset param message
                     # still in transit (mp.Queue feeder threads) is now
                     # stale and the workers discard it by epoch check

@@ -28,7 +28,9 @@ from ..core.tensor import Tensor, to_tensor
 from ..framework.io import load as _load, save as _save
 from ..static import (Executor, Program, default_main_program,
                       default_startup_program)
-from . import dygraph, initializer, layers, optimizer, regularizer
+from . import (dygraph, initializer, layers, optimizer, regularizer,
+               transpiler)
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
 __all__ = ["layers", "dygraph", "optimizer", "initializer", "regularizer",
            "Executor", "Program", "CPUPlace", "CUDAPlace", "TPUPlace",

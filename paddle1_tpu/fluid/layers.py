@@ -424,14 +424,6 @@ _MODERN = {
     "dynamic_gru": "paddle1_tpu.nn.GRU",
     "gru_unit": "paddle1_tpu.nn.GRUCell",
     "sequence_conv": "paddle1_tpu.ops.sequence_ops",
-    "sequence_pool": "paddle1_tpu.ops.sequence_ops.sequence_pool",
-    "sequence_expand": "paddle1_tpu.ops.sequence_ops.sequence_expand",
-    "layer_norm": "paddle1_tpu.nn.LayerNorm / nn.functional.layer_norm",
-    "yolo_box": "paddle1_tpu.vision.ops.yolo_box",
-    "yolov3_loss": "paddle1_tpu.vision.models.yolo.yolov3_loss",
-    "multiclass_nms": "paddle1_tpu.vision.ops.multiclass_nms",
-    "roi_align": "paddle1_tpu.vision.ops.roi_align",
-    "prior_box": "paddle1_tpu.vision.ops.prior_box",
     "py_func": "plain Python (eager) or a custom op via "
                "paddle1_tpu.utils.cpp_extension",
     "beam_search": "paddle1_tpu.text (decode loops are lax.while_loop "
@@ -482,3 +474,8 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     x = _t(input)
     return F.crf_decoding(x, _crf_param(x.shape[-1], param_attr),
                           label=label, length=length)
+
+# -- breadth tier 2: the mechanical mappings (fluid spellings onto the
+# modern functional surface) live in layers_ext; the teaching
+# __getattr__ above still covers everything not mapped.
+from .layers_ext import *  # noqa: F401,F403,E402

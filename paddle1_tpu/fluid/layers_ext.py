@@ -1,0 +1,1017 @@
+"""fluid.layers breadth tier 2 (VERDICT r4 item 7): the mechanical
+mappings from the reference's 36k-LoC layers surface
+(/root/reference/python/paddle/fluid/layers/{nn,tensor,loss,ops,
+sequence_lod,detection,learning_rate_scheduler,rnn}.py) onto the modern
+functional API. Star-imported into :mod:`paddle1_tpu.fluid.layers`; the
+teaching ``__getattr__`` there still covers everything not mapped.
+
+Grouping and policy:
+* pure elementwise/reduction/manipulation ops → direct delegation;
+* parameter-bearing layer ops (layer_norm, group_norm, conv2d_transpose,
+  ...) → implicit-parameter creation through ``_implicit_layer`` (same
+  per-creation semantics as fc/conv2d);
+* LoD sequence ops → the dense+lengths analogs in
+  ``ops.sequence_ops`` (fluid spelling, ``length``/``lengths`` kwarg
+  instead of LoD — MIGRATING.md "LoD" section);
+* detection ops → ``vision.ops``;
+* LR decay functions → ``optimizer.lr`` scheduler objects (fluid's
+  decay "Variables" become scheduler instances every optimizer
+  accepts);
+* genuinely program-construction APIs (StaticRNN/While/Switch/
+  DynamicRNN) stay teaching errors in layers.py — their with-block
+  bodies build a static program the eager shim cannot re-execute;
+  ``nn.RNN``/``static.nn.while_loop`` are the working migrations.
+"""
+
+from __future__ import annotations
+
+import builtins as _bi  # several fluid names (range/abs/sum/...) shadow
+                        # builtins at module scope
+
+import numpy as np
+
+import paddle1_tpu as _paddle
+from ..core.tensor import Tensor, to_tensor
+from ..nn import functional as F
+from ..ops import manip_ops as _manip, math_ops as _math
+from ..ops import sequence_ops as _seq
+from .layers import _implicit_layer, _t
+
+__all__ = [
+    # elementwise / compare / logical
+    "elementwise_max", "elementwise_min", "elementwise_mod",
+    "elementwise_pow", "elementwise_floordiv", "equal", "not_equal",
+    "less_than", "less_equal", "greater_than", "greater_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    # reductions / creation
+    "reduce_min", "reduce_prod", "reduce_all", "reduce_any",
+    "ones", "zeros", "ones_like", "zeros_like", "eye", "linspace",
+    "range", "diag", "fill_constant_batch_size_like", "create_tensor",
+    "create_global_var", "sums", "sum",
+    # manipulation
+    "argmax", "argmin", "argsort", "slice", "strided_slice", "split",
+    "stack", "unstack", "unbind", "squeeze", "unsqueeze", "unique",
+    "unique_with_counts", "where", "multiplex", "triu", "expand",
+    "expand_as", "pad", "pad2d", "pad_constant_like", "crop",
+    "crop_tensor", "flatten", "transpose", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "size", "shard_index", "reverse",
+    "rank", "increment", "is_empty", "has_inf", "has_nan", "isfinite",
+    "space_to_depth", "shuffle_channel",
+    # activations / math
+    "relu6", "leaky_relu", "elu", "selu", "swish", "mish",
+    "hard_sigmoid", "hard_swish", "brelu", "soft_relu", "stanh",
+    "maxout", "prelu", "sign", "pow", "scale",
+    "rsqrt", "abs", "floor", "ceil", "round",
+    "erf", "sin", "cos", "clip_by_norm", "l2_normalize",
+    "label_smooth", "cumsum",
+    # losses / metrics
+    "mse_loss", "huber_loss", "smooth_l1", "log_loss", "kldiv_loss",
+    "bpr_loss", "rank_loss", "margin_rank_loss", "cos_sim",
+    "sigmoid_cross_entropy_with_logits", "sigmoid_focal_loss",
+    "npair_loss", "dice_loss", "square_error_cost", "warpctc",
+    "edit_distance", "mean_iou",
+    # norm / conv / pool / vision transforms (parameter-bearing use
+    # implicit params)
+    "layer_norm", "group_norm", "instance_norm", "lrn",
+    "conv2d_transpose", "conv3d", "pool3d", "adaptive_pool2d",
+    "image_resize", "resize_bilinear", "resize_nearest",
+    "resize_trilinear", "pixel_shuffle", "grid_sampler", "affine_grid",
+    "unfold", "temporal_shift",
+    # detection (vision.ops)
+    "yolo_box", "yolov3_loss", "multiclass_nms", "matrix_nms",
+    "prior_box", "box_coder", "roi_align", "roi_pool", "box_clip",
+    "iou_similarity", "distribute_fpn_proposals",
+    # sequence (dense+lengths analogs, fluid spelling)
+    "sequence_concat", "sequence_expand", "sequence_expand_as",
+    "sequence_first_step", "sequence_last_step", "sequence_mask",
+    "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_reverse", "sequence_softmax", "sequence_enumerate",
+    # LR schedules (objects accepted by every optimizer)
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "cosine_decay",
+    "noam_decay", "linear_lr_warmup",
+    # rnn cells / runners
+    "GRUCell", "LSTMCell", "rnn", "birnn",
+    # tensor-array (eager lists)
+    "create_array", "array_write", "array_read", "array_length",
+    "tensor_array_to_tensor",
+]
+
+
+# -- elementwise / compare / logical -----------------------------------------
+
+def _b(f):
+    """Binary delegate with fluid's mid-axis broadcast semantics
+    (reuses layers._ew_align: y of shape x.shape[axis:axis+y.ndim]
+    broadcasts from ``axis``, the classic NCHW + [C] pattern)."""
+    def impl(x, y, axis=-1, act=None, name=None):
+        from .layers import _ew_align
+        a, b = _ew_align(_t(x), _t(y), axis)
+        out = f(a, b)
+        return getattr(F, act)(out) if act else out
+    return impl
+
+
+elementwise_max = _b(_paddle.maximum)
+elementwise_min = _b(_paddle.minimum)
+elementwise_mod = _b(_paddle.mod)
+elementwise_pow = _b(_paddle.pow)
+elementwise_floordiv = _b(_paddle.floor_divide)
+
+
+def _cmp(f):
+    def impl(x, y, cond=None, name=None):
+        return f(_t(x), _t(y))
+    return impl
+
+
+equal, not_equal = _cmp(_paddle.equal), _cmp(_paddle.not_equal)
+less_than, less_equal = _cmp(_paddle.less_than), _cmp(_paddle.less_equal)
+greater_than = _cmp(_paddle.greater_than)
+greater_equal = _cmp(_paddle.greater_equal)
+logical_and, logical_or = _cmp(_paddle.logical_and), _cmp(_paddle.logical_or)
+logical_xor = _cmp(_paddle.logical_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return _paddle.logical_not(_t(x))
+
+
+# -- reductions / creation ---------------------------------------------------
+
+def _red(f):
+    def impl(input, dim=None, keep_dim=False, name=None):
+        return f(_t(input), axis=dim, keepdim=keep_dim)
+    return impl
+
+
+reduce_min = _red(_paddle.min)
+reduce_prod = _red(_paddle.prod)
+reduce_all = _red(_paddle.all)
+reduce_any = _red(_paddle.any)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return _paddle.ones(shape, dtype)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return _paddle.zeros(shape, dtype)
+
+
+def ones_like(x, out=None):
+    return _paddle.ones_like(_t(x))
+
+
+def zeros_like(x, out=None):
+    return _paddle.zeros_like(_t(x))
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    out = _paddle.eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        for n in reversed(batch_shape):
+            out = _manip.tile(_manip.unsqueeze(out, axis=0),
+                              [n] + [1] * out.ndim)
+    return out
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return _paddle.linspace(start, stop, num, dtype)
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A001 (fluid name)
+    return _paddle.arange(start, end, step, dtype)
+
+
+def diag(diagonal):
+    return _paddle.diag(_t(diagonal))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = _t(input).shape[input_dim_idx]
+    return _paddle.full(shape, value, dtype)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return _paddle.zeros([0], dtype)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from .layers import create_parameter
+    p = create_parameter(shape, dtype=dtype)
+    p._data = _paddle.full(shape, value, dtype).data
+    return p
+
+
+def sums(input, out=None):
+    return _paddle.add_n([_t(x) for x in input])
+
+
+def sum(x):  # noqa: A001 — fluid.layers.sum IS add_n over a list
+    if isinstance(x, (list, tuple)):
+        return _paddle.add_n([_t(v) for v in x])
+    return _math.sum(_t(x))
+
+
+# -- manipulation ------------------------------------------------------------
+
+def argmax(x, axis=0):
+    return _paddle.argmax(_t(x), axis=axis)
+
+
+def argmin(x, axis=0):
+    return _paddle.argmin(_t(x), axis=axis)
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    x = _t(input)
+    return (_paddle.sort(x, axis=axis, descending=descending),
+            _paddle.argsort(x, axis=axis, descending=descending))
+
+
+def slice(input, axes, starts, ends):  # noqa: A001
+    return _paddle.slice(_t(input), axes, starts, ends)
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    return _paddle.strided_slice(_t(input), axes, starts, ends, strides)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    return _paddle.split(_t(input), num_or_sections, axis=dim)
+
+
+def stack(x, axis=0, name=None):
+    return _paddle.stack([_t(v) for v in x] if isinstance(x, (list, tuple))
+                         else _t(x), axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    return _paddle.unstack(_t(x), axis=axis)
+
+
+def unbind(input, axis=0):
+    return _paddle.unbind(_t(input), axis=axis)
+
+
+def squeeze(input, axes, name=None):
+    return _manip.squeeze(_t(input), axis=axes)
+
+
+def unsqueeze(input, axes, name=None):
+    x = _t(input)
+    for a in (axes if isinstance(axes, (list, tuple)) else [axes]):
+        x = _manip.unsqueeze(x, axis=a)
+    return x
+
+
+def unique(x, dtype="int32"):
+    # fluid returns (unique values, index mapping input->unique)
+    u, inv = _paddle.unique(_t(x), return_inverse=True)
+    return u, inv.astype(dtype)
+
+
+def unique_with_counts(x, dtype="int32"):
+    u, inv, counts = _paddle.unique(_t(x), return_inverse=True,
+                                    return_counts=True)
+    return u, inv.astype(dtype), counts
+
+
+def where(condition):
+    return _paddle.nonzero(_t(condition))
+
+
+def multiplex(inputs, index):
+    return _paddle.multiplex([_t(x) for x in inputs], _t(index))
+
+
+def triu(input, diagonal=0, name=None):
+    return _paddle.triu(_t(input), diagonal)
+
+
+def expand(x, expand_times, name=None):
+    return _paddle.tile(_t(x), expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _paddle.expand_as(_t(x), _t(target_tensor))
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    # fluid: flat [before0, after0, before1, after1, ...] over ALL dims
+    return F.pad(_t(x), list(paddings), value=pad_value)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return F.pad(_t(input), list(paddings), mode=mode, value=pad_value,
+                 data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    x, y = _t(x), _t(y)
+    flat = []
+    for i in _bi.range(x.ndim):
+        flat += [0, x.shape[i] - y.shape[i]]
+    return F.pad(y, flat, value=pad_value)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _paddle.crop(_t(x), shape, offsets)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return _paddle.crop(_t(x), shape, offsets)
+
+
+def flatten(x, axis=1, name=None):
+    x = _t(x)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return _manip.reshape(x, [lead, int(np.prod(x.shape[axis:]))])
+
+
+def transpose(x, perm, name=None):
+    return _paddle.transpose(_t(x), perm)
+
+
+def gather(input, index, overwrite=True):
+    return _paddle.gather(_t(input), _t(index))
+
+
+def gather_nd(input, index, name=None):
+    return _paddle.gather_nd(_t(input), _t(index))
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    return _paddle.scatter(_t(input), _t(index), _t(updates),
+                           overwrite=overwrite)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _paddle.scatter_nd_add(_t(ref), _t(index), _t(updates))
+
+
+def size(input):
+    return _paddle.numel(_t(input))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _paddle.shard_index(_t(input), index_num, nshards, shard_id,
+                               ignore_value)
+
+
+def reverse(x, axis):
+    return _paddle.reverse(_t(x), axis)
+
+
+def rank(input):
+    return _paddle.rank(_t(input))
+
+
+def increment(x, value=1.0, in_place=True):
+    return _paddle.increment(_t(x), value)
+
+
+def is_empty(x, cond=None):
+    return _paddle.is_empty(_t(x))
+
+
+def has_inf(x):
+    return _math.any(_paddle.isinf(_t(x)))
+
+
+def has_nan(x):
+    return _math.any(_paddle.isnan(_t(x)))
+
+
+def isfinite(x):
+    return _math.all(_paddle.isfinite(_t(x)))
+
+
+def space_to_depth(x, blocksize, name=None):
+    import jax.numpy as jnp
+    from ..autograd.engine import apply
+    b = blocksize
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // b, b, w // b, b)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * b * b, h // b, w // b)
+    return apply("space_to_depth", f, (_t(x),))
+
+
+def shuffle_channel(x, group, name=None):
+    from ..autograd.engine import apply
+
+    def f(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, group, c // group, h, w) \
+                .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return apply("shuffle_channel", f, (_t(x),))
+
+
+# -- activations / math ------------------------------------------------------
+
+def _u(f, **fixed):
+    def impl(x, name=None, **kw):
+        kw.pop("act", None)
+        return f(_t(x), **{**fixed, **kw})
+    return impl
+
+
+relu6 = _u(F.relu6)
+elu = _u(F.elu)
+selu = _u(F.selu)
+mish = _u(F.mish)
+hard_swish = _u(F.hardswish)
+sign = _u(_paddle.sign)
+# (sigmoid/tanh/square/sqrt/exp stay in layers.py — defining them here
+# too would silently shadow those via the star import)
+rsqrt = _u(_paddle.rsqrt)
+abs = _u(_paddle.abs)  # noqa: A001
+floor = _u(_paddle.floor)
+ceil = _u(_paddle.ceil)
+round = _u(_paddle.round)  # noqa: A001
+erf = _u(_paddle.erf)
+sin = _u(_paddle.sin)
+cos = _u(_paddle.cos)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return F.leaky_relu(_t(x), negative_slope=alpha)
+
+
+def swish(x, beta=1.0, name=None):
+    return _t(x) * F.sigmoid(_t(x) * beta)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _math.clip(_t(x) * slope + offset, 0.0, 1.0)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _math.clip(_t(x), t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _math.log(1 + _paddle.exp(_math.clip(_t(x), -threshold,
+                                                threshold)))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * _paddle.tanh(_t(x) * scale_a)
+
+
+def maxout(x, groups, name=None, axis=1):
+    return F.maxout(_t(x), groups, axis=axis)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    x = _t(x)
+    num = 1 if mode == "all" else x.shape[1]
+    lay = _implicit_layer(getattr(param_attr, "name", param_attr),
+                          ("prelu", mode, num),
+                          lambda: _paddle.nn.PReLU(num_parameters=num))
+    return lay(x)
+
+
+def pow(x, factor=1.0, name=None):  # noqa: A001
+    return _paddle.pow(_t(x), factor)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    x = _t(x)
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return getattr(F, act)(out) if act else out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    x = _t(x)
+    norm = _math.sqrt(_math.sum(x * x))
+    return x * _math.clip(max_norm / _paddle.maximum(norm,
+                                                     to_tensor(1e-12)),
+                          None, 1.0)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return F.normalize(_t(x), p=2, axis=axis, epsilon=epsilon)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    return F.label_smooth(_t(label), prior_dist=prior_dist,
+                          epsilon=epsilon)
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
+    return _paddle.cumsum(_t(x), axis=axis)
+
+
+# -- losses ------------------------------------------------------------------
+
+def mse_loss(input, label):
+    return F.mse_loss(_t(input), _t(label))
+
+
+def huber_loss(input, label, delta):
+    d = _t(input) - _t(label)
+    ad = _paddle.abs(d)
+    quad = 0.5 * d * d
+    lin = delta * ad - 0.5 * delta * delta
+    return _paddle.where(ad <= delta, quad, lin)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    sigma = 1.0 if sigma is None else sigma
+    d = (_t(x) - _t(y)) * (_t(inside_weight) if inside_weight is not None
+                           else 1.0)
+    ad = _paddle.abs(d)
+    s2 = sigma * sigma
+    out = _paddle.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if outside_weight is not None:
+        out = out * _t(outside_weight)
+    return _math.sum(out, axis=-1, keepdim=True)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return F.log_loss(_t(input), _t(label), epsilon)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return F.kl_div(_t(x), _t(target), reduction=reduction)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking (reference loss.py bpr_loss):
+    -mean(log(sigmoid(score_pos - score_others)))."""
+    x, lab = _t(input), _t(label)
+    if lab.ndim == x.ndim and lab.shape[-1] == 1:
+        lab = _manip.squeeze(lab, axis=-1)
+    pos = _manip.reshape(
+        _paddle.index_sample(x, _manip.reshape(lab, [-1, 1]))
+        if hasattr(_paddle, "index_sample")
+        else _math.sum(x * F.one_hot(lab, x.shape[-1]), axis=-1,
+                       keepdim=True), [-1, 1])
+    diff = pos - x
+    loss = -_math.log(F.sigmoid(diff) + 1e-12)
+    n = x.shape[-1]
+    # the sum includes the positive-vs-itself term (diff=0 ->
+    # -log(sigmoid(0)) = log 2, gradient-free); subtract it exactly
+    return (_math.sum(loss, axis=-1, keepdim=True)
+            - float(np.log(2.0))) / max(n - 1, 1)
+
+
+def rank_loss(label, left, right, name=None):
+    lab, dl = _t(label), _t(left) - _t(right)
+    return F.softplus(dl) - lab * dl
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return F.relu(-_t(label) * (_t(left) - _t(right)) + margin)
+
+
+def cos_sim(X, Y):
+    return _manip.reshape(F.cosine_similarity(_t(X), _t(Y), axis=-1),
+                          [-1, 1])
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    out = F.binary_cross_entropy_with_logits(_t(x), _t(label),
+                                             reduction="none")
+    mask = (_t(label) != ignore_index).astype(out.dtype)
+    out = out * mask
+    if normalize:
+        out = out / _paddle.maximum(_math.sum(mask), to_tensor(1.0))
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return F.sigmoid_focal_loss(_t(x), _t(label),
+                                normalizer=_t(fg_num).astype("float32"),
+                                gamma=gamma, alpha=alpha,
+                                reduction="none")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return F.npair_loss(_t(anchor), _t(positive), _t(labels), l2_reg)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return F.dice_loss(_t(input), _t(label), epsilon)
+
+
+def square_error_cost(input, label):
+    return F.square_error_cost(_t(input), _t(label))
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    return F.ctc_loss(_t(input), _t(label),
+                      _t(input_length) if input_length is not None
+                      else None,
+                      _t(label_length) if label_length is not None
+                      else None, blank=blank, reduction="none")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (reference metric_op.py) — host
+    computation (dynamic programming is not a TPU shape-stable op)."""
+    import builtins
+    a_all = np.asarray(_t(input).numpy())
+    b_all = np.asarray(_t(label).numpy())
+    la = (np.asarray(_t(input_length).numpy())
+          if input_length is not None
+          else np.full(a_all.shape[0], a_all.shape[1], np.int64))
+    lb = (np.asarray(_t(label_length).numpy())
+          if label_length is not None
+          else np.full(b_all.shape[0], b_all.shape[1], np.int64))
+    out = np.zeros((a_all.shape[0], 1), np.float32)
+    seq_num = a_all.shape[0]
+    ignored = set(ignored_tokens or [])
+    for i in builtins.range(seq_num):
+        a = [t for t in a_all[i][:la[i]].tolist() if t not in ignored]
+        b = [t for t in b_all[i][:lb[i]].tolist() if t not in ignored]
+        dp = list(builtins.range(len(b) + 1))
+        for x_i, ca in enumerate(a, 1):
+            prev, dp[0] = dp[0], x_i
+            for y_i, cb in enumerate(b, 1):
+                prev, dp[y_i] = dp[y_i], min(dp[y_i] + 1, dp[y_i - 1] + 1,
+                                             prev + (ca != cb))
+        d = float(dp[len(b)])
+        out[i, 0] = d / max(len(b), 1) if normalized else d
+    return to_tensor(out), to_tensor(np.asarray([seq_num], np.int64))
+
+
+def mean_iou(input, label, num_classes):
+    from ..metric import mean_iou as _miou
+    return _miou(_t(input), _t(label), num_classes)
+
+
+# -- norm / conv / pool / vision transforms ----------------------------------
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    x = _t(input)
+    shape = list(x.shape[begin_norm_axis:])
+    lay = _implicit_layer(name, ("layer_norm", tuple(shape)),
+                          lambda: _paddle.nn.LayerNorm(shape,
+                                                       epsilon=epsilon))
+    out = lay(x)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    x = _t(input)
+    ch = x.shape[1 if data_layout == "NCHW" else -1]
+    lay = _implicit_layer(name, ("group_norm", groups, ch),
+                          lambda: _paddle.nn.GroupNorm(groups, ch,
+                                                       epsilon=epsilon))
+    out = lay(x)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    x = _t(input)
+    ch = x.shape[1]
+    lay = _implicit_layer(name, ("instance_norm", ch),
+                          lambda: _paddle.nn.InstanceNorm2D(
+                              ch, epsilon=epsilon))
+    return lay(x)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return F.local_response_norm(_t(input), size=n, alpha=alpha,
+                                 beta=beta, k=k)
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None, data_format="NCHW"):
+    x = _t(input)
+    in_ch = x.shape[1 if data_format == "NCHW" else -1]
+    if filter_size is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "conv2d_transpose needs filter_size= (note the fluid "
+            "argument order puts output_size BEFORE filter_size)")
+    lay = _implicit_layer(
+        name, ("conv2d_transpose", in_ch, num_filters, filter_size,
+               stride, padding, dilation, groups),
+        lambda: _paddle.nn.Conv2DTranspose(in_ch, num_filters,
+                                           filter_size, stride=stride,
+                                           padding=padding,
+                                           dilation=dilation,
+                                           groups=groups))
+    out = lay(x, output_size=output_size) if output_size else lay(x)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    x = _t(input)
+    in_ch = x.shape[1]
+    lay = _implicit_layer(
+        name, ("conv3d", in_ch, num_filters, filter_size, stride,
+               padding, dilation, groups),
+        lambda: _paddle.nn.Conv3D(in_ch, num_filters, filter_size,
+                                  stride=stride, padding=padding,
+                                  dilation=dilation, groups=groups))
+    out = lay(x)
+    return getattr(F, act)(out) if act else out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    x = _t(input)
+    if global_pooling:
+        pool_size = list(x.shape[2:])
+        pool_stride, pool_padding = pool_size, 0
+    f = F.max_pool3d if pool_type == "max" else F.avg_pool3d
+    return f(x, kernel_size=pool_size, stride=pool_stride,
+             padding=pool_padding)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    f = (F.adaptive_max_pool2d if pool_type == "max"
+         else F.adaptive_avg_pool2d)
+    return f(_t(input), pool_size)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None,
+                 align_corners=True, align_mode=1,
+                 data_format="NCHW"):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear"}[resample]
+    return F.interpolate(_t(input), size=out_shape, scale_factor=scale,
+                         mode=mode,
+                         align_corners=align_corners and mode != "nearest")
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners=align_corners)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners=False)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        align_corners=align_corners)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return F.pixel_shuffle(_t(x), upscale_factor)
+
+
+def grid_sampler(x, grid, name=None):
+    return F.grid_sample(_t(x), _t(grid))
+
+
+def affine_grid(theta, out_shape, name=None):
+    return F.affine_grid(_t(theta), out_shape)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    return F.unfold(_t(x), kernel_sizes, strides=strides,
+                    paddings=paddings, dilations=dilations)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return F.temporal_shift(_t(x), seg_num, shift_ratio)
+
+
+# -- detection ---------------------------------------------------------------
+
+def _v(fname):
+    def impl(*args, **kwargs):
+        from .. import vision
+        kwargs.pop("name", None)
+        args = tuple(_t(a) if isinstance(a, (np.ndarray, Tensor))
+                     else a for a in args)
+        return getattr(vision.ops, fname)(*args, **kwargs)
+    return impl
+
+
+yolo_box = _v("yolo_box")
+multiclass_nms = _v("multiclass_nms")
+matrix_nms = _v("matrix_nms")
+prior_box = _v("prior_box")
+box_coder = _v("box_coder")
+roi_align = _v("roi_align")
+roi_pool = _v("roi_pool")
+distribute_fpn_proposals = _v("distribute_fpn_proposals")
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    from ..vision.models.yolo import yolov3_loss as _impl
+    return _impl(_t(x), _t(gt_box), _t(gt_label), anchors, anchor_mask,
+                 class_num, ignore_thresh, downsample_ratio)
+
+
+def box_clip(input, im_info, name=None):
+    x, info = _t(input), _t(im_info)
+    h = info[:, 0] / info[:, 2] - 1
+    w = info[:, 1] / info[:, 2] - 1
+    from ..autograd.engine import apply
+    import jax.numpy as jnp
+
+    def f(b, hh, ww):
+        hh = hh.reshape(-1, *([1] * (b.ndim - 1)))
+        ww = ww.reshape(-1, *([1] * (b.ndim - 1)))
+        x1 = jnp.clip(b[..., 0::4], 0, ww)
+        y1 = jnp.clip(b[..., 1::4], 0, hh)
+        x2 = jnp.clip(b[..., 2::4], 0, ww)
+        y2 = jnp.clip(b[..., 3::4], 0, hh)
+        return jnp.stack([x1, y1, x2, y2], axis=-1).reshape(b.shape)
+    return apply("box_clip", f, (x, w, h))
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    from ..autograd.engine import apply
+    import jax.numpy as jnp
+
+    def f(a, b):
+        off = 0.0 if box_normalized else 1.0
+        ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+        bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+        area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+        ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+        iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+        ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+        iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+        iw = jnp.clip(ix2 - ix1 + off, 0, None)
+        ih = jnp.clip(iy2 - iy1 + off, 0, None)
+        inter = iw * ih
+        return inter / (area_a[:, None] + area_b[None, :] - inter)
+    return apply("iou_similarity", f, (_t(x), _t(y)))
+
+
+# -- sequence (dense + lengths analogs) --------------------------------------
+
+sequence_concat = _seq.sequence_concat
+sequence_expand = _seq.sequence_expand
+sequence_first_step = _seq.sequence_first_step
+sequence_last_step = _seq.sequence_last_step
+sequence_mask = _seq.sequence_mask
+sequence_pad = _seq.sequence_pad
+sequence_unpad = _seq.sequence_unpad
+sequence_pool = _seq.sequence_pool
+sequence_reverse = _seq.sequence_reverse
+sequence_softmax = _seq.sequence_softmax
+
+
+def sequence_expand_as(x, y, lengths=None, name=None):
+    return _seq.sequence_expand(_t(x), _t(y) if lengths is None
+                                else lengths)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    from ..autograd.engine import apply
+    import jax.numpy as jnp
+
+    def f(a):
+        T = a.shape[-1]
+        idx = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        win = jnp.where(idx < T, a[..., jnp.clip(idx, 0, T - 1)],
+                        pad_value)
+        return win
+    return apply("sequence_enumerate", f, (_t(input),))
+
+
+# -- LR schedules ------------------------------------------------------------
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer.lr import ExponentialDecay, StepDecay
+    if staircase:
+        return StepDecay(learning_rate, step_size=decay_steps,
+                         gamma=decay_rate)
+    return ExponentialDecay(learning_rate,
+                            gamma=decay_rate ** (1.0 / decay_steps))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer.lr import NaturalExpDecay
+    return NaturalExpDecay(learning_rate,
+                           gamma=decay_rate / decay_steps if not staircase
+                           else decay_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from ..optimizer.lr import InverseTimeDecay
+    return InverseTimeDecay(learning_rate, gamma=decay_rate / decay_steps)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    from ..optimizer.lr import PolynomialDecay
+    return PolynomialDecay(learning_rate, decay_steps,
+                           end_lr=end_learning_rate, power=power,
+                           cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    from ..optimizer.lr import PiecewiseDecay
+    return PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from ..optimizer.lr import CosineAnnealingDecay
+    return CosineAnnealingDecay(learning_rate,
+                                T_max=step_each_epoch * epochs)
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ..optimizer.lr import NoamDecay
+    return NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ..optimizer.lr import LinearWarmup
+    return LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# -- rnn cells / runners -----------------------------------------------------
+
+def GRUCell(hidden_size, **kw):  # noqa: N802 (fluid class-like factory)
+    return _paddle.nn.GRUCell(hidden_size, hidden_size, **kw)
+
+
+def LSTMCell(hidden_size, **kw):  # noqa: N802
+    return _paddle.nn.LSTMCell(hidden_size, hidden_size, **kw)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    runner = _paddle.nn.RNN(cell, is_reverse=is_reverse,
+                            time_major=time_major)
+    return runner(_t(inputs), initial_states)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    runner = _paddle.nn.BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return runner(_t(inputs), initial_states)
+
+
+# -- tensor arrays (eager lists) ---------------------------------------------
+
+def create_array(dtype):
+    return []
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(_t(i).numpy()) if not isinstance(i, int) else i
+    while len(array) <= i:
+        array.append(None)
+    array[i] = _t(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(_t(i).numpy()) if not isinstance(i, int) else i
+    return array[i]
+
+
+def array_length(array):
+    return to_tensor(np.asarray([len(array)], np.int64))
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    ts = [_t(x) for x in input]
+    out = (_paddle.stack(ts, axis=axis) if use_stack
+           else _manip.concat(ts, axis=axis))
+    sizes = to_tensor(np.asarray([t.shape[axis] for t in ts], np.int32))
+    return out, sizes

@@ -1,0 +1,109 @@
+"""Legacy transpilers (reference python/paddle/fluid/transpiler/
+distribute_transpiler.py:256) — deliberate teaching errors.
+
+The DistributeTranspiler rewrote a static ProgramDesc into
+trainer/pserver program pairs (split params onto PS nodes, insert
+send/recv ops); geo-SGD added delta-sync variants. In this build the
+same capabilities are first-class runtime features rather than program
+rewrites, so the transpiler surface exists only to point migrating
+scripts at them:
+
+* sync/async PS training   → ``distributed.fleet`` PS mode
+  (``fleet.init_server(dim=..., dense_tables=...)`` / ``run_server`` /
+  trainers over ``distributed.ps_server.remote_service``) with the
+  async ``distributed.AsyncCommunicator``;
+* geo-SGD                  → ``distributed.GeoCommunicator``;
+* collective (NCCL2) mode  → ``distributed.ParallelEngine`` /
+  ``fleet.distributed_model`` (GSPMD inserts the collectives).
+"""
+
+from __future__ import annotations
+
+from ..core.errors import UnimplementedError
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin", "memory_optimize",
+           "release_memory"]
+
+
+class DistributeTranspilerConfig:
+    """Accepted for source compatibility; every field is recorded but
+    the transpile step itself is unimplemented (see module docstring)."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+    sync_mode = True
+    runtime_split_send_recv = False
+
+
+class _SplitMethod:
+    pass
+
+
+class HashName(_SplitMethod):
+    def __init__(self, pserver_endpoints):
+        self.endpoints = list(pserver_endpoints)
+
+
+class RoundRobin(_SplitMethod):
+    def __init__(self, pserver_endpoints):
+        self.endpoints = list(pserver_endpoints)
+
+
+class DistributeTranspiler:
+    """Program-rewriting PS transpiler — unimplemented by design; the
+    error names the runtime replacement for each mode."""
+
+    def __init__(self, config: DistributeTranspilerConfig = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        geo = getattr(self.config, "geo_sgd_mode", False)
+        hint = ("distributed.GeoCommunicator (delta sync every "
+                "geo_sgd_need_push_nums steps)" if geo else
+                "fleet PS mode: servers run fleet.init_server(dim=..., "
+                "dense_tables=...) + fleet.run_server(); trainers use "
+                "distributed.ps_server.remote_service + "
+                "distributed.AsyncCommunicator for async dense updates")
+        raise UnimplementedError(
+            "DistributeTranspiler rewrote static programs into "
+            "trainer/pserver pairs; this build ships the same "
+            f"capability as a runtime feature instead — use {hint}. "
+            "Collective (NCCL2) mode maps to distributed.ParallelEngine "
+            "/ fleet.distributed_model (GSPMD emits the collectives). "
+            "See MIGRATING.md 'Parameter server'.")
+
+    def get_trainer_program(self, wait_port=True):
+        raise UnimplementedError(
+            "call transpile() first — which explains the runtime "
+            "replacement for the transpiler flow")
+
+    def get_pserver_program(self, endpoint):
+        raise UnimplementedError(
+            "call transpile() first — which explains the runtime "
+            "replacement for the transpiler flow")
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        raise UnimplementedError(
+            "call transpile() first — which explains the runtime "
+            "replacement for the transpiler flow")
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """Reference memory_optimize is a no-op pass since 1.6 (XLA owns
+    buffer reuse here); kept callable for old scripts."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None

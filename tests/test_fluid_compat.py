@@ -76,8 +76,10 @@ class TestTeachingErrors:
     def test_moved_op_names_destination(self):
         with pytest.raises(AttributeError, match="nn.LSTM"):
             fluid.layers.dynamic_lstm
-        with pytest.raises(AttributeError, match="multiclass_nms"):
-            fluid.layers.multiclass_nms
+        # r4 breadth tier 2: multiclass_nms is now MAPPED (vision.ops)
+        assert callable(fluid.layers.multiclass_nms)
+        with pytest.raises(AttributeError, match="cpp_extension"):
+            fluid.layers.py_func
 
     def test_unknown_op_points_at_modern_namespace(self):
         with pytest.raises(AttributeError, match="MIGRATING"):

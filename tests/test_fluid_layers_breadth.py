@@ -1,0 +1,246 @@
+"""fluid.layers breadth tier 2 (VERDICT r4 item 7): namespace sweep
+pinning coverage counts against the reference surface, plus functional
+spot-checks of the newly mapped groups and the transpiler teaching
+error (VERDICT r3 missing #3)."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle1_tpu.fluid as fluid
+import paddle1_tpu.fluid.layers as L
+from paddle1_tpu.core.tensor import to_tensor
+
+REF = "/root/reference/python/paddle/fluid/layers"
+
+
+def _reference_names():
+    names = set()
+    if not os.path.isdir(REF):
+        return names
+    for f in os.listdir(REF):
+        if not f.endswith(".py") or f == "__init__.py":
+            continue
+        txt = open(os.path.join(REF, f), encoding="utf-8",
+                   errors="replace").read()
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", txt, re.S)
+        if m:
+            names.update(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]",
+                                    m.group(1)))
+    return names
+
+
+class TestNamespaceSweep:
+    def test_coverage_counts(self):
+        """Pin the classification like the 242-name top-level sweep:
+        every reference name either resolves (mapped) or raises the
+        teaching AttributeError — and the mapped share stays >= 200."""
+        ref = _reference_names()
+        if not ref:
+            pytest.skip("reference tree unavailable")
+        mapped, teaching = [], []
+        for n in sorted(ref):
+            try:
+                getattr(L, n)
+                mapped.append(n)
+            except AttributeError as e:
+                teaching.append(n)
+                assert n in str(e), f"teaching error must name {n}"
+        assert len(ref) >= 300            # surface didn't shrink
+        assert len(mapped) >= 200, (len(mapped),
+                                    "tier-2 mapping regressed")
+        # the tier-2 groups are all mapped
+        for n in """elementwise_max logical_and reduce_prod ones eye
+                 linspace argsort gather_nd scatter squeeze stack split
+                 where triu expand pad flatten transpose relu6
+                 leaky_relu elu swish hard_sigmoid maxout prelu scale
+                 l2_normalize label_smooth mse_loss huber_loss log_loss
+                 kldiv_loss cos_sim sigmoid_cross_entropy_with_logits
+                 dice_loss layer_norm group_norm instance_norm lrn
+                 conv2d_transpose conv3d pool3d adaptive_pool2d
+                 image_resize resize_bilinear pixel_shuffle grid_sampler
+                 unfold yolo_box multiclass_nms prior_box box_coder
+                 roi_align iou_similarity sequence_pad sequence_pool
+                 sequence_softmax sequence_enumerate exponential_decay
+                 piecewise_decay cosine_decay noam_decay linear_lr_warmup
+                 rnn birnn GRUCell LSTMCell array_write array_read
+                 tensor_array_to_tensor edit_distance""".split():
+            assert n in mapped, n
+
+    def test_still_teaching_by_design(self):
+        """Program-construction APIs stay loud teaching errors."""
+        for n in ("StaticRNN", "DynamicRNN", "While", "Switch",
+                  "py_reader", "nce"):
+            with pytest.raises(AttributeError):
+                getattr(L, n)
+
+
+class TestMappedGroupsFunctional:
+    def test_elementwise_compare_reduce(self):
+        a = to_tensor(np.array([[1.0, 5.0], [3.0, 2.0]], np.float32))
+        b = to_tensor(np.array([[2.0, 4.0], [3.0, 1.0]], np.float32))
+        np.testing.assert_allclose(L.elementwise_max(a, b).numpy(),
+                                   [[2, 5], [3, 2]])
+        assert L.less_than(a, b).numpy().tolist() == [[True, False],
+                                                      [False, False]]
+        np.testing.assert_allclose(L.reduce_prod(a).numpy(), 30.0)
+        assert bool(L.reduce_any(L.equal(a, b)).numpy())
+
+    def test_creation_and_manipulation(self):
+        e = L.eye(3)
+        np.testing.assert_allclose(e.numpy(), np.eye(3, dtype=np.float32))
+        r = L.range(0, 6, 2, "int64")
+        assert r.numpy().tolist() == [0, 2, 4]
+        x = to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        f = L.fill_constant_batch_size_like(x, [1, 2], "float32", 7.0)
+        assert f.shape == [3, 2] and float(f.numpy()[0, 0]) == 7.0
+        s = L.split(x, 2, dim=1)
+        assert len(s) == 2 and s[0].shape == [3, 2]
+        st = L.stack([x, x], axis=0)
+        assert st.shape == [2, 3, 4]
+        assert L.flatten(x, axis=2).shape == [12, 1]
+        assert L.size(x).numpy() == 12
+
+    def test_activations_and_scale(self):
+        x = to_tensor(np.array([-2.0, 0.5, 9.0], np.float32))
+        np.testing.assert_allclose(L.relu6(x).numpy(), [0, 0.5, 6.0])
+        np.testing.assert_allclose(L.brelu(x, 0.0, 1.0).numpy(),
+                                   [0, 0.5, 1.0])
+        np.testing.assert_allclose(
+            L.hard_sigmoid(x).numpy(),
+            np.clip(np.array([-2, 0.5, 9]) * 0.2 + 0.5, 0, 1), rtol=1e-6)
+        np.testing.assert_allclose(
+            L.scale(x, scale=2.0, bias=1.0).numpy(), [-3, 2, 19])
+        np.testing.assert_allclose(
+            L.scale(x, scale=2.0, bias=1.0,
+                    bias_after_scale=False).numpy(), [-2, 3, 20])
+
+    def test_losses(self):
+        p = to_tensor(np.array([[0.2], [0.8]], np.float32))
+        y = to_tensor(np.array([[0.0], [1.0]], np.float32))
+        ll = L.log_loss(p, y).numpy()
+        np.testing.assert_allclose(
+            ll, [[-np.log(0.8)], [-np.log(0.8)]], atol=2e-4)
+        h = L.huber_loss(to_tensor(np.array([0.0, 3.0], np.float32)),
+                         to_tensor(np.array([0.5, 0.0], np.float32)),
+                         delta=1.0)
+        np.testing.assert_allclose(h.numpy(), [0.125, 2.5], rtol=1e-6)
+        d = L.edit_distance(
+            to_tensor(np.array([[1, 2, 3]], np.int64)),
+            to_tensor(np.array([[1, 3, 3]], np.int64)),
+            normalized=False)
+        assert float(d[0].numpy()[0, 0]) == 1.0
+
+    def test_param_bearing_norm_layers_train(self):
+        x = to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 4, 8)).astype(np.float32))
+        out = L.layer_norm(x, begin_norm_axis=2)
+        assert out.shape == [2, 4, 8]
+        # normalized over the trailing axis
+        np.testing.assert_allclose(np.asarray(out.numpy()).mean(-1),
+                                   np.zeros((2, 4)), atol=1e-5)
+        img = to_tensor(np.random.default_rng(1).standard_normal(
+            (2, 6, 8, 8)).astype(np.float32))
+        assert L.group_norm(img, groups=3).shape == [2, 6, 8, 8]
+        assert L.instance_norm(img).shape == [2, 6, 8, 8]
+        assert L.conv2d_transpose(img, 4, filter_size=3).shape[1] == 4
+
+    def test_lr_decays_are_schedulers(self):
+        from paddle1_tpu.optimizer.lr import LRScheduler
+        import paddle1_tpu as paddle
+        sched = L.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+        assert isinstance(sched, LRScheduler)
+        m = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=L.piecewise_decay(
+            [2], [0.1, 0.01]), parameters=m.parameters())
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+
+    def test_rnn_runner(self):
+        import paddle1_tpu as paddle
+        cell = L.GRUCell(hidden_size=8)
+        x = to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 5, 8)).astype(np.float32))
+        out, state = L.rnn(cell, x)
+        assert out.shape == [2, 5, 8]
+
+    def test_tensor_array_ops(self):
+        arr = L.create_array("float32")
+        L.array_write(to_tensor(np.ones((2, 3), np.float32)), 0, arr)
+        L.array_write(to_tensor(np.zeros((2, 3), np.float32)), 1, arr)
+        assert int(L.array_length(arr).numpy()[0]) == 2
+        assert L.array_read(arr, 1).numpy().sum() == 0
+        t, sizes = L.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+        assert t.shape == [2, 2, 3]
+
+    def test_detection_spotcheck(self):
+        iou = L.iou_similarity(
+            to_tensor(np.array([[0, 0, 10, 10]], np.float32)),
+            to_tensor(np.array([[0, 0, 10, 10], [20, 20, 30, 30]],
+                               np.float32)))
+        np.testing.assert_allclose(iou.numpy(), [[1.0, 0.0]], atol=1e-6)
+
+    def test_space_to_depth_and_shuffle_channel(self):
+        x = to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        y = L.space_to_depth(x, 2)
+        assert y.shape == [1, 4, 2, 2]
+        c = to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
+        s = L.shuffle_channel(c, group=2)
+        assert s.numpy().reshape(-1).tolist() == [0, 4, 1, 5, 2, 6, 3, 7]
+
+
+class TestTranspilerTeaching:
+    def test_distribute_transpiler_teaches_fleet(self):
+        from paddle1_tpu.core.errors import UnimplementedError
+        t = fluid.DistributeTranspiler()
+        with pytest.raises(UnimplementedError, match="fleet"):
+            t.transpile(trainer_id=0, pservers="127.0.0.1:6174",
+                        trainers=2)
+
+    def test_geo_mode_teaches_geo_communicator(self):
+        from paddle1_tpu.core.errors import UnimplementedError
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.geo_sgd_mode = True
+        with pytest.raises(UnimplementedError, match="GeoCommunicator"):
+            fluid.DistributeTranspiler(cfg).transpile(trainer_id=0)
+
+    def test_memory_optimize_noop(self):
+        assert fluid.transpiler.memory_optimize() is None
+
+
+class TestReviewRegressions:
+    def test_elementwise_max_mid_axis_broadcast(self):
+        x = to_tensor(np.zeros((2, 3, 4), np.float32))
+        y = to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = L.elementwise_max(x, y, axis=1)  # [C] broadcasts on dim 1
+        assert out.shape == [2, 3, 4]
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, :, 0],
+                                   [1, 2, 3])
+
+    def test_unique_returns_real_index_mapping(self):
+        u, idx = L.unique(to_tensor(np.array([2, 3, 2], np.int64)))
+        uv = np.asarray(u.numpy())
+        iv = np.asarray(idx.numpy())
+        np.testing.assert_array_equal(uv[iv],
+                                      np.array([2, 3, 2]))
+        u2, idx2, counts = L.unique_with_counts(
+            to_tensor(np.array([5, 5, 7], np.int64)))
+        assert np.asarray(counts.numpy()).tolist() == [2, 1]
+        np.testing.assert_array_equal(np.asarray(u2.numpy())[
+            np.asarray(idx2.numpy())], np.array([5, 5, 7]))
+
+    def test_bpr_loss_excludes_self_term(self):
+        # two classes, logits equal => only the self term and one
+        # diff=0 term... construct: pos=class0, score diff pos-other = 1
+        x = to_tensor(np.array([[2.0, 1.0]], np.float32))
+        y = to_tensor(np.array([[0]], np.int64))
+        loss = float(np.asarray(L.bpr_loss(x, y).numpy())[0, 0])
+        expect = -np.log(1.0 / (1.0 + np.exp(-1.0)))  # only pos-vs-other
+        assert abs(loss - expect) < 1e-5, (loss, expect)
+
+    def test_sigmoid_still_layers_version(self):
+        # the star import must not shadow layers.py's own definitions
+        import paddle1_tpu.fluid.layers as LL
+        import inspect
+        assert "layers_ext" not in inspect.getsourcefile(LL.sigmoid)
