@@ -175,6 +175,14 @@ def _define_builtin_flags() -> None:
                 "chip-smoked (tools/tpu_kernel_smoke.py) — interpret "
                 "mode does not enforce Mosaic tiling.",
                 validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("conv_nhwc", "never",
+                "Run NCHW-API convs internally in NHWC (transpose at the "
+                "op boundary; XLA cancels back-to-back transposes): the "
+                "candidate fix for the conv-throughput question in "
+                "BASELINE.md (configs 2/5 measured ~0.3% MFU; suspected "
+                "NCHW layout cost on the axon backend). Values: never / "
+                "always; tools/tpu_conv_probe.py measures both.",
+                validator=lambda v: v in ("always", "never"))
 
 
 _define_builtin_flags()

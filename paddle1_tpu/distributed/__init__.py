@@ -10,6 +10,7 @@ the JAX coordination service instead of raw-TCP ncclUniqueId broadcast.
 from . import env
 from .env import get_rank, get_world_size, spmd_axes, current_spmd_axis
 from .collective import (ReduceOp, Group, all_gather, all_gather_object,
+                         hierarchical_all_reduce,
                          all_reduce, alltoall, all_to_all, barrier,
                          broadcast, destroy_process_group, get_group,
                          irecv, is_initialized, isend, new_group, recv,
@@ -37,6 +38,8 @@ from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
 from . import graph_table
 from .graph_table import GraphTable
+from . import hbm_embedding
+from .hbm_embedding import HBMShardedEmbedding
 
 
 def __getattr__(name):

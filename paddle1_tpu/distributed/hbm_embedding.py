@@ -1,0 +1,115 @@
+"""Accelerator-resident embedding shards — the TPU analog of the
+reference's heter_ps tier (/root/reference/paddle/fluid/framework/fleet/
+heter_ps/hashtable.h:47 HashTable, heter_comm.h:50 HeterComm: GPU-HBM
+embedding shards with device-side optimizers, pooled across the worker
+group).
+
+On TPU the same tier is a table row-sharded over a DATA axis of the
+mesh ('sharding' by default — the pooled HBM of the dp/sharding group,
+NOT the tensor-parallel axis): each chip owns ``vocab/N`` rows; lookup
+runs inside jit as an owner-select + ``psum`` over ICI (O(batch × dim)
+communication, the table itself never moves); the backward transposes
+to a psum-free local scatter-add, so updates land directly on the
+owning shard and the optimizer state shards with the rows (ZeRO-style,
+via the weight's ``sharding_axes``).
+
+Tier hierarchy matching the reference's heter_ps design:
+  HBM shards (this class, hot rows, trained in-graph)
+    > host-RAM EmbeddingService (ps.py, the capacity tier)
+      > remote TableServers (ps_server.py, the cluster tier).
+``pull``/``push_grad`` give it the same service surface as the host
+tiers so callers can move a table between tiers without rewriting the
+model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..autograd.engine import apply
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor
+from ..nn.initializer import XavierUniform
+from ..nn.layer_base import Layer
+from . import env
+
+__all__ = ["HBMShardedEmbedding"]
+
+
+class HBMShardedEmbedding(Layer):
+    """Embedding whose table lives row-sharded in device HBM over a
+    data-mesh axis (default ``'sharding'``). Under an explicit-SPMD
+    region (shard_map / ParallelEngine) the lookup is the owner-select
+    + psum pattern; eagerly (or on one device) it is a plain gather, so
+    the layer composes with single-chip tests unchanged."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 axis: str = "sharding", axis_size: Optional[int] = None,
+                 weight_attr=None, name=None):
+        super().__init__()
+        if axis_size is not None and num_embeddings % axis_size:
+            # pad the vocab so every shard is equal-sized (the
+            # reference's hashtable shards by id hash; a fixed-capacity
+            # device table pads instead)
+            num_embeddings += axis_size - num_embeddings % axis_size
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._axis = axis
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.sharding_axes = (axis, None)
+        self.weight.is_distributed = True
+
+    @property
+    def vocab_size(self) -> int:
+        return self._num_embeddings
+
+    def forward(self, x):
+        axis = self._axis
+
+        def f(ids, w):
+            name = env.current_spmd_axis(axis)
+            if name is not None and isinstance(w, jax.core.Tracer):
+                # explicit-SPMD: w is the LOCAL row shard. Owner-select
+                # + psum: every chip answers for its rows, zeros
+                # elsewhere; the sum over the axis is the full gather.
+                per = w.shape[0]
+                start = lax.axis_index(name) * per
+                local = ids - start
+                ok = (local >= 0) & (local < per)
+                safe = jnp.clip(local, 0, per - 1)
+                out = jnp.where(ok[..., None], w[safe], 0.0)
+                return lax.psum(out, name)
+            return w[ids]
+
+        return apply("hbm_sharded_embedding", f, (x, self.weight))
+
+    # -- service surface (tier parity with ps.EmbeddingService) ------------
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        """[n, dim] rows to host (the host tiers' pull contract)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size and (int(ids.max()) >= self._num_embeddings
+                         or int(ids.min()) < 0):
+            bad = int(ids.max()) if int(ids.max()) >= \
+                self._num_embeddings else int(ids.min())
+            raise InvalidArgumentError(
+                f"id {bad} out of range for HBM table with "
+                f"{self._num_embeddings} rows — route cold ids to the "
+                "host tier (ps.EmbeddingService)")
+        return np.asarray(jax.device_get(self.weight.data))[ids]
+
+    def push_grad(self, ids: Sequence[int], grads,
+                  lr: float = 0.01) -> None:
+        """Host-pushed sparse SGD step (the host tiers' push contract;
+        in-graph training goes through autograd instead)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = jnp.asarray(np.asarray(grads, np.float32))
+        w = self.weight.data
+        self.weight._data = w.at[jnp.asarray(ids)].add(-lr * g)

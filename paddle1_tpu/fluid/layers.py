@@ -423,7 +423,6 @@ _MODERN = {
     "dynamic_lstm": "paddle1_tpu.nn.LSTM",
     "dynamic_gru": "paddle1_tpu.nn.GRU",
     "gru_unit": "paddle1_tpu.nn.GRUCell",
-    "sequence_conv": "paddle1_tpu.ops.sequence_ops",
     "py_func": "plain Python (eager) or a custom op via "
                "paddle1_tpu.utils.cpp_extension",
     "beam_search": "paddle1_tpu.text (decode loops are lax.while_loop "

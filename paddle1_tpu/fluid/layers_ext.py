@@ -86,6 +86,8 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_mask",
     "sequence_pad", "sequence_unpad", "sequence_pool",
     "sequence_reverse", "sequence_softmax", "sequence_enumerate",
+    "sequence_conv", "sequence_erase", "sequence_reshape",
+    "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
     # LR schedules (objects accepted by every optimizer)
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "cosine_decay",
@@ -214,7 +216,7 @@ def sums(input, out=None):
 def sum(x):  # noqa: A001 — fluid.layers.sum IS add_n over a list
     if isinstance(x, (list, tuple)):
         return _paddle.add_n([_t(v) for v in x])
-    return _math.sum(_t(x))
+    return _t(x)  # reference: a single input passes through unchanged
 
 
 # -- manipulation ------------------------------------------------------------
@@ -473,6 +475,12 @@ def maxout(x, groups, name=None, axis=1):
 
 def prelu(x, mode="all", param_attr=None, name=None):
     x = _t(x)
+    if mode == "element":
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "prelu(mode='element') (one alpha per activation) is not "
+            "mapped; use nn.PReLU with an explicit weight of the "
+            "activation shape, or mode='channel'")
     num = 1 if mode == "all" else x.shape[1]
     lay = _implicit_layer(getattr(param_attr, "name", param_attr),
                           ("prelu", mode, num),
@@ -509,8 +517,26 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
                           epsilon=epsilon)
 
 
-def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
-    return _paddle.cumsum(_t(x), axis=axis)
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    t = _t(x)
+    ax = -1 if axis is None else axis
+    if reverse:
+        t = _manip.flip(t, axis=ax) if hasattr(_manip, "flip") \
+            else _paddle.reverse(t, [ax])
+    out = _paddle.cumsum(t, axis=ax)
+    if exclusive:
+        # shift right by one along ax, zero-filled (reference semantics)
+        pads = [0] * (2 * out.ndim)
+        pads[2 * (ax % out.ndim)] = 1
+        shifted = F.pad(out, pads, value=0.0)
+        sl = [__import__("builtins").slice(None)] * out.ndim
+        sl[ax % out.ndim] = __import__("builtins").slice(0, out.shape[ax])
+        from ..autograd.engine import apply as _apply
+        out = _apply("exclusive_slice", lambda a: a[tuple(sl)], (shifted,))
+    if reverse:
+        out = _manip.flip(out, axis=ax) if hasattr(_manip, "flip") \
+            else _paddle.reverse(out, [ax])
+    return out
 
 
 # -- losses ------------------------------------------------------------------
@@ -886,11 +912,53 @@ sequence_unpad = _seq.sequence_unpad
 sequence_pool = _seq.sequence_pool
 sequence_reverse = _seq.sequence_reverse
 sequence_softmax = _seq.sequence_softmax
+sequence_erase = _seq.sequence_erase
+sequence_reshape = _seq.sequence_reshape
+sequence_scatter = _seq.sequence_scatter
+sequence_slice = _seq.sequence_slice
+sequence_topk_avg_pooling = _seq.sequence_topk_avg_pooling
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None,
+                  act=None, lengths=None, name=None):
+    """fluid spelling of the dense+lengths sequence_conv: the context
+    filter is an implicit parameter [filter_size*D, num_filters]
+    (reference layers/nn.py sequence_conv creates it from param_attr);
+    ``lengths`` is required (the LoD's replacement)."""
+    if lengths is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "sequence_conv needs lengths= in the dense+lengths world "
+            "(the reference reads them from the input LoD)")
+    if filter_stride != 1:
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "sequence_conv supports filter_stride=1 only (the reference "
+            "op has the same contract)")
+    x = _t(input)
+    D = x.shape[-1]
+    lay = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("sequence_conv", D, filter_size, num_filters),
+        lambda: _paddle.nn.Linear(filter_size * D, num_filters,
+                                  bias_attr=bias_attr
+                                  if bias_attr is not None else None))
+    out = _seq.sequence_conv(x, lengths, lay.weight,
+                             context_length=filter_size,
+                             bias=getattr(lay, "bias", None))
+    return getattr(F, act)(out) if act else out
 
 
 def sequence_expand_as(x, y, lengths=None, name=None):
-    return _seq.sequence_expand(_t(x), _t(y) if lengths is None
-                                else lengths)
+    if lengths is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "sequence_expand_as needs lengths= in the dense+lengths "
+            "world (the reference reads them from y's LoD): pass the "
+            "per-row repeat counts, e.g. sequence_expand_as(x, y, "
+            "lengths=row_lengths_of_y)")
+    return _seq.sequence_expand(_t(x), lengths)
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None):
@@ -920,15 +988,24 @@ def exponential_decay(learning_rate, decay_steps, decay_rate,
 
 def natural_exp_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    from ..optimizer.lr import NaturalExpDecay
+    from ..optimizer.lr import LambdaDecay, NaturalExpDecay
+    if staircase:
+        # reference: lr0 * exp(-rate * floor(step / decay_steps))
+        return LambdaDecay(learning_rate,
+                           lambda e: float(np.exp(
+                               -decay_rate * (e // decay_steps))))
     return NaturalExpDecay(learning_rate,
-                           gamma=decay_rate / decay_steps if not staircase
-                           else decay_rate)
+                           gamma=decay_rate / decay_steps)
 
 
 def inverse_time_decay(learning_rate, decay_steps, decay_rate,
                        staircase=False):
-    from ..optimizer.lr import InverseTimeDecay
+    from ..optimizer.lr import InverseTimeDecay, LambdaDecay
+    if staircase:
+        # reference: lr0 / (1 + rate * floor(step / decay_steps))
+        return LambdaDecay(learning_rate,
+                           lambda e: 1.0 / (1.0 + decay_rate *
+                                            (e // decay_steps)))
     return InverseTimeDecay(learning_rate, gamma=decay_rate / decay_steps)
 
 

@@ -6,6 +6,8 @@ Analog of python/paddle/framework/ in the reference (io.py:494 save /
 
 from . import crypto
 from .crypto import Cipher, CipherFactory, CipherUtils
+from . import op_version
+from .op_version import register_op_version
 from .param_attr import ParamAttr
 from .io import save, load
 from ..core.generator import seed as _seed

@@ -1,0 +1,129 @@
+"""Op-version / artifact-compatibility registry — the analog of the
+reference's op version registry (/root/reference/paddle/fluid/framework/
+op_version_registry.h: ops register semantic-change checkpoints;
+serialized programs carry the versions they were built with and loaders
+check compatibility).
+
+Here the serialized artifact is ``jit.save``'s StableHLO + sidecar; the
+XLA bytecode carries its own stability guarantees, so what needs
+versioning is the FRAMEWORK-level semantics around it: the artifact
+format (what files exist, how feeds/fetches are described) and the ops
+whose *numerical contract* changed between rounds (the reference's
+``ModifyAttr``/``NewInput`` checkpoint kinds collapse to a note string
+per bump).
+
+Surface:
+* :func:`register_op_version` — record a semantic-change checkpoint.
+* :func:`snapshot` — what ``jit.save`` embeds in the sidecar.
+* :func:`check_compat` — what ``jit.load``/the Predictor run against a
+  loaded sidecar: artifacts from a NEWER runtime refuse to load
+  (the reference's IsMatched failure); artifacts from an OLDER runtime
+  load with a warning listing the semantic changes in between.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+from ..core.errors import UnimplementedError
+
+__all__ = ["FORMAT_VERSION", "register_op_version", "op_version",
+           "snapshot", "check_compat", "OpVersionError"]
+
+# Artifact FORMAT version: bump when the .pdmodel/.pdiparams/.pdconfig
+# layout or contract changes shape.
+FORMAT_VERSION = 1
+
+# op name -> current version (unregistered ops are implicitly v1)
+_versions: Dict[str, int] = {}
+# (op, version) -> note describing the semantic change AT that bump
+_notes: Dict[Tuple[str, int], str] = {}
+
+
+class OpVersionError(UnimplementedError):
+    """Artifact was produced by an incompatible (newer) runtime."""
+
+
+def register_op_version(op: str, version: int, note: str = "") -> None:
+    """Record that ``op``'s semantics changed at ``version`` (the
+    reference REGISTER_OP_VERSION macro). Monotonic per op."""
+    cur = _versions.get(op, 1)
+    if version < cur:
+        raise ValueError(f"op {op!r} version going backwards: "
+                         f"{cur} -> {version}")
+    _versions[op] = version
+    if note:
+        _notes[(op, version)] = note
+
+
+def op_version(op: str) -> int:
+    return _versions.get(op, 1)
+
+
+def snapshot() -> dict:
+    from .. import version as _v
+    return {"format_version": FORMAT_VERSION,
+            "framework_version": getattr(_v, "full_version", "0.0.0"),
+            "op_versions": dict(_versions)}
+
+
+def check_compat(saved: Optional[dict], source: str = "artifact") -> None:
+    """Validate a loaded sidecar's compat block against this runtime.
+
+    * missing block: pre-versioning artifact — warn, load anyway.
+    * artifact format or any op version NEWER than the runtime: refuse
+      (we cannot know the newer semantics).
+    * op version OLDER than the runtime: warn with the notes of every
+      bump in between (semantics changed since it was saved).
+    """
+    if not saved:
+        warnings.warn(
+            f"{source} carries no version metadata (saved by a "
+            "pre-versioning build); loading as-is")
+        return
+    fmt = int(saved.get("format_version", 1))
+    if fmt > FORMAT_VERSION:
+        raise OpVersionError(
+            f"{source} uses artifact format v{fmt}, this runtime "
+            f"understands up to v{FORMAT_VERSION} — upgrade the "
+            "framework to load it")
+    changed: List[str] = []
+    for op, v in (saved.get("op_versions") or {}).items():
+        v = int(v)
+        cur = op_version(op)
+        if v > cur:
+            raise OpVersionError(
+                f"{source} was saved with {op} v{v}; this runtime has "
+                f"v{cur} — upgrade the framework to load it")
+        if v < cur:
+            steps = [f"v{k}: {_notes[(op, k)]}"
+                     for k in range(v + 1, cur + 1)
+                     if (op, k) in _notes]
+            changed.append(f"{op} v{v}->v{cur}"
+                           + (f" ({'; '.join(steps)})" if steps else ""))
+    if changed:
+        warnings.warn(
+            f"{source} was saved by an older runtime; op semantics "
+            "changed since: " + "; ".join(changed))
+
+
+# -- the project's own semantic-change history ------------------------------
+# (reference analog: each REGISTER_OP_VERSION in the op's .cc file)
+
+register_op_version(
+    "flash_attention", 2,
+    "r3: LSE layout fixed for real Mosaic lowering (lane-broadcast); "
+    "outputs differ from v1 beyond fp tolerance on padded batches")
+register_op_version(
+    "nms", 2,
+    "r2 advisor fix: category offsets use max-extent shifting, "
+    "negative-coordinate boxes no longer collapse categories")
+register_op_version(
+    "box_coder", 2,
+    "r2 advisor fix: axis=0/1 semantics corrected to reference "
+    "(decode aligned the prior with the wrong dim before)")
+register_op_version(
+    "cross_entropy", 2,
+    "r4: fluid soft_label branch computes the soft loss (was a shape "
+    "error); clipped log for zero-probability classes")

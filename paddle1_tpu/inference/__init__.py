@@ -132,6 +132,26 @@ class Config:
     def cpu_math_library_num_threads(self) -> int:
         return self._cpu_math_threads
 
+    # -- precision ---------------------------------------------------------
+
+    def enable_quantized_inference(self,
+                                   precision: int = PrecisionType.Int8
+                                   ) -> None:
+        """Weight-only quantized execution (the TPU-native stand-in for
+        the reference's MKLDNN/TRT int8 passes, mkldnn_quantizer.cc):
+        float parameters are stored as int8 + per-tensor scales and
+        dequantized IN-GRAPH to bfloat16 in front of the exported
+        program — 4x weight memory, XLA fuses the dequant into the
+        first consumer. Activations stay bf16 (weight-only int8 is the
+        TPU-idiomatic quantized-serving mode)."""
+        if precision not in (PrecisionType.Int8, PrecisionType.Bfloat16):
+            raise ValueError("quantized inference supports Int8 "
+                             "(weight-only) or Bfloat16")
+        self._precision = precision
+
+    def precision_mode(self) -> int:
+        return self._precision
+
     # -- optimization toggles (parity; XLA owns the pipeline) --------------
 
     def switch_ir_optim(self, flag: bool = True) -> None:
@@ -257,12 +277,27 @@ class Predictor:
                 pbytes = f.read()
             if enc_params:
                 pbytes = cipher.decrypt(pbytes)
+            # the sidecar is plaintext: run the same compat gate the
+            # unencrypted jit.load path enforces
+            from ..framework import op_version as _opv
+            saved_compat = None
+            try:
+                with open(base + ".pdconfig") as f:
+                    saved_compat = json.load(f).get("compat")
+            except (OSError, ValueError):
+                pass
+            _opv.check_compat(saved_compat,
+                              source=f"encrypted artifact {base!r}")
             exported = jexport.deserialize(mbytes)
             params = _unpack(pickle.loads(pbytes), return_numpy=True)
             self._layer = TranslatedLayer(exported, params)
         else:
             from ..jit import load as jit_load
             self._layer = jit_load(base)
+
+        if config._precision in (PrecisionType.Int8,
+                                 PrecisionType.Bfloat16):
+            self._enable_weight_quantization(config._precision)
 
         meta_path = base + ".pdconfig"
         if os.path.exists(meta_path):
@@ -330,6 +365,68 @@ class Predictor:
 
     def try_shrink_memory(self) -> None:
         pass
+
+    # -- weight-only quantized execution ------------------------------------
+
+    def _enable_weight_quantization(self, precision: int) -> None:
+        """Swap the loaded layer's forward for a jitted wrapper that
+        holds float params as int8 (+ per-tensor absmax scales) or
+        bfloat16 and dequantizes IN-GRAPH before calling the exported
+        program (Config.enable_quantized_inference)."""
+        import jax
+        import jax.numpy as jnp
+        layer = self._layer
+        exported = layer._exported
+        qparams: Dict[str, np.ndarray] = {}
+        scales: Dict[str, np.ndarray] = {}
+        for k, v in layer._params_arrays.items():
+            v = np.asarray(v)
+            # int8 only for matmul-class weights (ndim >= 2): 1-D
+            # params (biases, LayerNorm scales) are a rounding error of
+            # total bytes but outlier-sensitive — keep them float
+            if np.issubdtype(v.dtype, np.floating) and v.ndim >= 2:
+                if precision == PrecisionType.Int8:
+                    s = np.maximum(np.abs(v).max(), 1e-8) / 127.0
+                    qparams[k] = np.round(v / s).astype(np.int8)
+                    scales[k] = np.float32(s)
+                else:
+                    qparams[k] = v.astype(jnp.bfloat16)
+                    scales[k] = np.float32(1.0)
+            else:
+                qparams[k] = v
+                scales[k] = np.float32(0.0)  # marker: pass-through
+
+        def call(qp, sc, *inputs):
+            full = {}
+            for k, q in qp.items():
+                s = sc[k]
+                if q.dtype == jnp.int8:
+                    full[k] = (q.astype(jnp.bfloat16) * s).astype(
+                        jnp.float32)
+                elif q.dtype == jnp.bfloat16:
+                    full[k] = q.astype(jnp.float32)
+                else:
+                    full[k] = q
+            return exported.call(full, *inputs)
+
+        jitted = jax.jit(call)
+        qp = {k: jnp.asarray(v) for k, v in qparams.items()}
+        sc = {k: jnp.asarray(v) for k, v in scales.items()}
+
+        class _QuantRunner:
+            def __call__(self, *inputs):
+                from ..core.tensor import Tensor, to_tensor
+                arrs = [i.data if isinstance(i, Tensor) else
+                        np.asarray(i) for i in inputs]
+                out = jitted(qp, sc, *arrs)
+                if isinstance(out, (list, tuple)):
+                    return type(out)(to_tensor(o) for o in out)
+                return to_tensor(out)
+
+            _exported = exported
+            _params_arrays = layer._params_arrays
+
+        self._layer = _QuantRunner()
 
 
 def create_predictor(config: Config) -> Predictor:

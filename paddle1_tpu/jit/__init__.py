@@ -322,13 +322,17 @@ def save(layer, path, input_spec=None, **configs):
     # reference stores feed/fetch ops inside the ProgramDesc; StableHLO
     # has positional args, so names ride alongside)
     import json as _json
+    from ..framework import op_version as _opv
     probe = exported.out_avals
     meta = {"inputs": [{"name": s.name or f"input_{i}",
                         "shape": list(s.shape),
                         "dtype": str(np.dtype(s.dtype))}
                        for i, s in enumerate(input_spec)],
             "n_outputs": len(probe) if isinstance(probe, (list, tuple))
-            else 1}
+            else 1,
+            # artifact/op compat block (reference op_version_registry):
+            # loaders refuse newer-runtime artifacts, warn on older
+            "compat": _opv.snapshot()}
     with open(path + ".pdconfig", "w") as f:
         _json.dump(meta, f)
 
@@ -366,7 +370,16 @@ def _tree_map_tensors_from_arrays(obj):
 
 
 def load(path, **configs) -> TranslatedLayer:
+    import json as _json
     from jax import export as jexport
+    from ..framework import op_version as _opv
+    saved = None
+    try:
+        with open(path + ".pdconfig") as f:
+            saved = _json.load(f).get("compat")
+    except (OSError, ValueError):
+        pass  # sidecar optional; check_compat warns on None
+    _opv.check_compat(saved, source=f"jit artifact {path!r}")
     with open(path + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
     from ..framework.io import load as fload

@@ -69,7 +69,26 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
         dn_str = ("NDHWC", "OIDHW", "NDHWC") if channel_last else \
             ("NCDHW", "OIDHW", "NCDHW")
 
+    # NCHW-API convs can run internally in NHWC (the layout the TPU
+    # convolution engine prefers; see the conv_nhwc flag). Only the 2-D
+    # NCHW case participates — the transposes at the op boundary cancel
+    # between adjacent ops under XLA's algebraic simplifier.
+    from ...core.flags import flag as _flag
+    nhwc_internal = (not channel_last and ndim == 2
+                     and _flag("conv_nhwc") == "always")
+
     def f(x, w, *maybe_b):
+        if nhwc_internal:
+            xi = jnp.transpose(x, (0, 2, 3, 1))
+            dn = jax.lax.conv_dimension_numbers(
+                xi.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+            out = jax.lax.conv_general_dilated(
+                xi, w, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups)
+            if maybe_b:
+                out = out + maybe_b[0].reshape((1, 1, 1, -1))
+            return jnp.transpose(out, (0, 3, 1, 2))
         dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
         out = jax.lax.conv_general_dilated(
             x, w, window_strides=stride, padding=pad,

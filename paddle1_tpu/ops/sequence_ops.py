@@ -23,7 +23,10 @@ from ..core.tensor import Tensor, to_tensor
 __all__ = ["sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_pool", "sequence_softmax", "sequence_expand",
            "sequence_reverse", "sequence_concat", "sequence_first_step",
-           "sequence_last_step"]
+           "sequence_last_step", "sequence_conv", "sequence_enumerate",
+           "sequence_erase", "sequence_expand_as", "sequence_reshape",
+           "sequence_scatter", "sequence_slice",
+           "sequence_topk_avg_pooling"]
 
 
 def _t(x):
@@ -175,3 +178,194 @@ def sequence_concat(xs, lengths_list, name=None):
         return jnp.concatenate(rows, axis=0)
     packed = apply("sequence_concat", f, tuple(_t(x) for x in xs))
     return packed, to_tensor(np.sum(ls, axis=0))
+
+
+def sequence_conv(x, lengths, filter, context_length, context_start=None,
+                  bias=None, name=None):
+    """Context-window convolution over time (reference
+    sequence_conv_op): each position's context [t+start, t+start+L) is
+    concatenated feature-wise and projected by ``filter``
+    [L*D, out]. Out-of-sequence context rows are zeros; positions past
+    ``lengths`` zero out. ``context_start`` defaults to the centered
+    window -(L-1)//2 like the reference's common usage."""
+    L = int(context_length)
+    start = -((L - 1) // 2) if context_start is None else int(context_start)
+
+    def f(dense, lengths, w, *maybe_b):
+        B, T = dense.shape[0], dense.shape[1]
+        ids = jnp.arange(T)[None, :]
+        valid = ids < lengths[:, None]
+        ctx = []
+        for off in range(start, start + L):
+            src = ids + off
+            ok = (src >= 0) & (src < lengths[:, None])
+            safe = jnp.clip(src, 0, T - 1)
+            shifted = jnp.take_along_axis(
+                dense, safe[..., None].repeat(dense.shape[2], -1), axis=1)
+            ctx.append(jnp.where(ok[..., None], shifted, 0.0))
+        feats = jnp.concatenate(ctx, axis=-1)          # [B, T, L*D]
+        out = feats @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        return jnp.where(valid[..., None], out, 0.0)
+    args = (_t(x), _t(lengths), _t(filter)) + (
+        (_t(bias),) if bias is not None else ())
+    return apply("sequence_conv", f, args)
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (reference sequence_enumerate_op):
+    [B, T] → [B, T, win]; window cells past the row's length fill with
+    ``pad_value``."""
+    W = int(win_size)
+
+    def f(ids, lengths):
+        T = ids.shape[1]
+        pos = jnp.arange(T)[None, :, None] + jnp.arange(W)[None, None, :]
+        ok = pos < lengths[:, None, None]
+        safe = jnp.clip(pos, 0, T - 1)
+        win = jnp.take_along_axis(ids[:, :, None].repeat(W, -1), safe,
+                                  axis=1)
+        win = jnp.where(ok, win, pad_value)
+        # positions at/after the row length are all-pad
+        valid_row = jnp.arange(T)[None, :, None] < lengths[:, None, None]
+        return jnp.where(valid_row, win, pad_value)
+    return apply("sequence_enumerate", f, (_t(x), _t(lengths)))
+
+
+def sequence_erase(x, lengths, tokens, name=None):
+    """Remove every occurrence of ``tokens`` from each row's valid
+    prefix, compacting left (reference sequence_erase_op). Returns
+    (dense, new_lengths); freed tail cells are 0."""
+    toks = np.asarray(tokens, np.int64).reshape(-1)
+
+    def f(ids, lengths):
+        T = ids.shape[1]
+        pos = jnp.arange(T)[None, :]
+        in_len = pos < lengths[:, None]
+        erase = jnp.zeros_like(ids, dtype=bool)
+        for t in toks.tolist():
+            erase |= ids == t
+        keep = in_len & ~erase
+        # stable order: kept cells first, original order preserved
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        compacted = jnp.take_along_axis(ids, order, axis=1)
+        new_len = keep.sum(axis=1)
+        live = pos < new_len[:, None]
+        return jnp.where(live, compacted, 0), new_len
+    out, nl = apply("sequence_erase", f, (_t(x), _t(lengths)),
+                    n_outputs=2)
+    return out, nl
+
+
+def sequence_expand_as(x, lengths, name=None):
+    """Repeat row b of x lengths[b] times (reference
+    sequence_expand_as_op — the lengths come from the reference's y
+    LoD; here they are explicit)."""
+    return sequence_expand(x, lengths, name=name)
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """Re-chunk each row's flat data to width ``new_dim`` (reference
+    sequence_reshape_op): row b's len[b]*D values become
+    len[b]*D/new_dim rows. Every len[b]*D must divide new_dim-evenly.
+    Returns (dense [B, T*D//new_dim, new_dim], new_lengths)."""
+    nd = int(new_dim)
+
+    ln = lengths.numpy() if isinstance(lengths, Tensor) else lengths
+    ln_np = np.asarray(ln) if not hasattr(ln, "aval") else None
+    if ln_np is not None:
+        D_in = _t(x).shape[-1]
+        bad = ln_np[(ln_np * D_in) % nd != 0]
+        if bad.size:
+            raise ValueError(
+                f"sequence_reshape: every lengths[b]*D must divide "
+                f"new_dim={nd}; rows with lengths {bad.tolist()} "
+                f"(D={D_in}) do not — their tail values would be "
+                "silently dropped")
+
+    def f(dense, lengths):
+        B, T, D = dense.shape
+        if (T * D) % nd:
+            raise ValueError(f"T*D={T * D} not divisible by {nd}")
+        out = dense.reshape(B, (T * D) // nd, nd)
+        new_len = lengths * D // nd
+        pos = jnp.arange(out.shape[1])[None, :]
+        return jnp.where(pos[..., None] < new_len[:, None, None], out,
+                         0), new_len
+    out, nl = apply("sequence_reshape", f, (_t(x), _t(lengths)),
+                    n_outputs=2)
+    return out, nl
+
+
+def sequence_scatter(x, index, updates, lengths, name=None):
+    """Scatter-ADD updates into per-row positions (reference
+    sequence_scatter_op): x [B, T], index/updates [B, S]; update s of
+    row b lands at x[b, index[b, s]] for s < lengths[b]."""
+
+    def f(dense, idx, upd, lengths):
+        S = idx.shape[1]
+        ok = jnp.arange(S)[None, :] < lengths[:, None]
+        upd = jnp.where(ok, upd, 0)
+        b_ids = jnp.arange(dense.shape[0])[:, None].repeat(S, 1)
+        return dense.at[b_ids.reshape(-1),
+                        idx.reshape(-1)].add(upd.reshape(-1))
+    return apply("sequence_scatter", f,
+                 (_t(x), _t(index), _t(updates), _t(lengths)))
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row subsequence (reference sequence_slice_op): row b keeps
+    [offset[b], offset[b]+length[b]). Output is dense
+    [B, max(length), ...] (freed cells 0) plus the new lengths."""
+    off_np = np.asarray(offset.numpy() if isinstance(offset, Tensor)
+                        else offset, np.int64).reshape(-1)
+    len_np = np.asarray(length.numpy() if isinstance(length, Tensor)
+                        else length, np.int64).reshape(-1)
+    T_in = _t(x).shape[1]
+    if ((off_np < 0).any() or (len_np < 0).any()
+            or (off_np + len_np > T_in).any()):
+        raise ValueError(
+            f"sequence_slice: offset+length must stay inside the time "
+            f"dim (T={T_in}); got offset={off_np.tolist()} "
+            f"length={len_np.tolist()} (reference sequence_slice_op "
+            "enforces the same)")
+    ml = int(len_np.max()) if len_np.size else 0
+
+    def f(dense):
+        T = dense.shape[1]
+        pos = jnp.arange(ml)[None, :] + jnp.asarray(off_np)[:, None]
+        ok = jnp.arange(ml)[None, :] < jnp.asarray(len_np)[:, None]
+        safe = jnp.clip(pos, 0, T - 1)
+        idx = safe.reshape(safe.shape + (1,) * (dense.ndim - 2))
+        out = jnp.take_along_axis(dense, idx, axis=1)
+        okx = ok.reshape(ok.shape + (1,) * (dense.ndim - 2))
+        return jnp.where(okx, out, 0)
+    out = apply("sequence_slice", f, (_t(x),))
+    return out, to_tensor(len_np)
+
+
+def sequence_topk_avg_pooling(x, lengths, topks, name=None):
+    """Average of the top-k valid timesteps per channel, for each k in
+    ``topks`` (reference sequence_topk_avg_pooling_op, dense analog):
+    x [B, T, C] → [B, len(topks)*C]. Rows shorter than k average their
+    full valid prefix (the reference pads with the available values)."""
+    ks = [int(k) for k in topks]
+
+    def f(dense, lengths):
+        B, T, C = dense.shape
+        mask = jnp.arange(T)[None, :, None] < lengths[:, None, None]
+        neg = jnp.finfo(dense.dtype).min
+        masked = jnp.where(mask, dense, neg)
+        srt = jnp.sort(masked, axis=1)[:, ::-1]       # desc over time
+        outs = []
+        for k in ks:
+            kk = min(k, T)
+            top = srt[:, :kk]
+            cnt = jnp.minimum(lengths, kk)[:, None].astype(dense.dtype)
+            valid = (jnp.arange(kk)[None, :, None]
+                     < jnp.minimum(lengths, kk)[:, None, None])
+            s = jnp.where(valid, top, 0).sum(axis=1)
+            outs.append(s / jnp.maximum(cnt, 1))
+        return jnp.concatenate(outs, axis=-1)
+    return apply("sequence_topk_avg_pooling", f, (_t(x), _t(lengths)))

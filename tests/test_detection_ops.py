@@ -152,3 +152,103 @@ class TestSequenceOps(unittest.TestCase):
         out.sum().backward()
         np.testing.assert_array_equal(
             x.grad.numpy()[:, :, 0], [[1, 1, 0], [1, 1, 1]])
+
+
+class TestSequenceOpsLongTail(unittest.TestCase):
+    """r4 breadth: the remaining reference sequence_ops/ family on the
+    dense+lengths representation (sequence_conv/enumerate/erase/
+    reshape/scatter/slice/topk_avg_pooling)."""
+
+    def test_sequence_conv_window_math(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+        lens = np.array([3], np.int64)       # position 3 is padding
+        # identity-ish filter: context L=1 => plain projection
+        w = np.eye(3, dtype=np.float32)
+        out = S.sequence_conv(to_tensor(x), to_tensor(lens),
+                              to_tensor(w), context_length=1,
+                              context_start=0)
+        o = np.asarray(out.numpy())
+        np.testing.assert_allclose(o[0, :3], x[0, :3])
+        np.testing.assert_allclose(o[0, 3], 0.0)     # masked tail
+        # centered L=3 window at t=0 must NOT see t=-1
+        w3 = np.zeros((9, 1), np.float32)
+        w3[0] = 1.0  # picks feature 0 of the t-1 context slot
+        o3 = np.asarray(S.sequence_conv(to_tensor(x), to_tensor(lens),
+                                        to_tensor(w3),
+                                        context_length=3).numpy())
+        self.assertEqual(float(o3[0, 0, 0]), 0.0)
+        self.assertEqual(float(o3[0, 1, 0]), float(x[0, 0, 0]))
+
+    def test_sequence_enumerate_windows(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        ids = np.array([[1, 2, 3, 9]], np.int64)
+        lens = np.array([3], np.int64)
+        out = np.asarray(S.sequence_enumerate(
+            to_tensor(ids), to_tensor(lens), win_size=2,
+            pad_value=0).numpy())
+        np.testing.assert_array_equal(out[0, :3],
+                                      [[1, 2], [2, 3], [3, 0]])
+        np.testing.assert_array_equal(out[0, 3], [0, 0])
+
+    def test_sequence_erase_compacts(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        ids = np.array([[5, 1, 5, 2], [7, 7, 3, 0]], np.int64)
+        lens = np.array([4, 3], np.int64)
+        out, nl = S.sequence_erase(to_tensor(ids), to_tensor(lens), [5, 7])
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      [[1, 2, 0, 0], [3, 0, 0, 0]])
+        self.assertEqual(np.asarray(nl.numpy()).tolist(), [2, 1])
+
+    def test_sequence_reshape_rechunks(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.arange(8, dtype=np.float32).reshape(1, 2, 4)
+        lens = np.array([2], np.int64)
+        out, nl = S.sequence_reshape(to_tensor(x), to_tensor(lens), 2)
+        self.assertEqual(list(out.shape), [1, 4, 2])
+        self.assertEqual(np.asarray(nl.numpy()).tolist(), [4])
+        np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1),
+                                   np.arange(8))
+
+    def test_sequence_scatter_masked_add(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.zeros((2, 5), np.float32)
+        idx = np.array([[0, 2], [4, 4]], np.int64)
+        upd = np.array([[1.0, 2.0], [3.0, 9.0]], np.float32)
+        lens = np.array([2, 1], np.int64)   # row 1's second update masked
+        out = np.asarray(S.sequence_scatter(
+            to_tensor(x), to_tensor(idx), to_tensor(upd),
+            to_tensor(lens)).numpy())
+        np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+        np.testing.assert_allclose(out[1], [0, 0, 0, 0, 3])
+
+    def test_sequence_slice_per_row(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.arange(10, dtype=np.float32).reshape(2, 5)
+        out, nl = S.sequence_slice(to_tensor(x), [1, 0], [2, 3])
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[1, 2, 0], [5, 6, 7]])
+        self.assertEqual(np.asarray(nl.numpy()).tolist(), [2, 3])
+
+    def test_sequence_topk_avg_pooling(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.array([[[1.0], [5.0], [3.0], [99.0]]], np.float32)
+        lens = np.array([3], np.int64)       # 99 is padding
+        out = np.asarray(S.sequence_topk_avg_pooling(
+            to_tensor(x), to_tensor(lens), topks=[1, 2]).numpy())
+        np.testing.assert_allclose(out, [[5.0, 4.0]])
+
+    def test_sequence_expand_as_alias(self):
+        from paddle1_tpu.ops import sequence_ops as S
+        from paddle1_tpu.core.tensor import to_tensor
+        x = np.array([[1.0], [2.0]], np.float32)
+        out = S.sequence_expand_as(to_tensor(x), [2, 1])
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[1], [1], [2]])

@@ -191,3 +191,56 @@ class TestCAPI:
         assert b"C-OK" in r.stdout
         got = np.fromfile(outp, np.float32).reshape(4, 10)
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestQuantizedInference:
+    """Weight-only int8/bf16 predictor mode (VERDICT r3 missing #8;
+    reference mkldnn_quantizer.cc role, TPU-native form)."""
+
+    def _artifact(self, tmp_path):
+        import paddle1_tpu as paddle
+        from paddle1_tpu.jit import InputSpec
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+            paddle.nn.Linear(16, 4))
+        path = str(tmp_path / "q/model")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([2, 8], "float32", "x")])
+        return model, path
+
+    def test_int8_weight_only_close_to_fp32(self, tmp_path):
+        from paddle1_tpu import inference
+        model, path = self._artifact(tmp_path)
+        x = np.random.default_rng(0).standard_normal((2, 8)).astype(
+            np.float32)
+
+        cfg = inference.Config(path + ".pdmodel")
+        ref = inference.create_predictor(cfg).run([x])[0]
+
+        qcfg = inference.Config(path + ".pdmodel")
+        qcfg.enable_quantized_inference()  # int8 default
+        assert qcfg.precision_mode() == inference.PrecisionType.Int8
+        out = inference.create_predictor(qcfg).run([x])[0]
+        assert out.shape == ref.shape
+        # int8 weight-only: small quantization error, same prediction
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+        assert not np.allclose(out, ref)  # actually quantized
+
+    def test_bf16_mode_runs(self, tmp_path):
+        from paddle1_tpu import inference
+        _, path = self._artifact(tmp_path)
+        cfg = inference.Config(path + ".pdmodel")
+        cfg.enable_quantized_inference(
+            inference.PrecisionType.Bfloat16)
+        out = inference.create_predictor(cfg).run(
+            [np.ones((2, 8), np.float32)])[0]
+        assert out.shape == (2, 4)
+
+    def test_bad_precision_rejected(self):
+        from paddle1_tpu import inference
+        cfg = inference.Config()
+        with pytest.raises(ValueError, match="Int8"):
+            cfg.enable_quantized_inference(
+                inference.PrecisionType.Half)

@@ -348,3 +348,55 @@ class TestParitySweepNN:
     def test_rnn_base_classes_exported(self):
         assert isinstance(paddle.nn.LSTM(4, 8), paddle.nn.RNNBase)
         assert issubclass(paddle.nn.LSTMCell, paddle.nn.RNNCellBase)
+
+
+class TestConvNHWCInternal(OpTest):
+    """conv_nhwc flag (BASELINE conv-throughput candidate fix): the
+    NHWC-internal path must be numerically identical to the NCHW path,
+    forward and backward."""
+
+    def test_flag_path_matches_nchw(self):
+        import numpy as np
+        from paddle1_tpu.core import flags as core_flags
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        b = rng.standard_normal((4,)).astype(np.float32)
+
+        def run():
+            xt = to_tensor(x)
+            xt.stop_gradient = False
+            out = F.conv2d(xt, to_tensor(w), to_tensor(b), stride=2,
+                           padding=1)
+            out.sum().backward()
+            return np.asarray(out.numpy()), np.asarray(xt.grad.numpy())
+
+        o1, g1 = run()
+        core_flags.set_flags({"conv_nhwc": "always"})
+        try:
+            o2, g2 = run()
+        finally:
+            core_flags.set_flags({"conv_nhwc": "never"})
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5)
+
+    def test_grouped_conv_flag_path(self):
+        import numpy as np
+        from paddle1_tpu.core import flags as core_flags
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((8, 2, 3, 3)).astype(np.float32)
+        o1 = np.asarray(F.conv2d(to_tensor(x), to_tensor(w), groups=2,
+                                 padding=1).numpy())
+        core_flags.set_flags({"conv_nhwc": "always"})
+        try:
+            o2 = np.asarray(F.conv2d(to_tensor(x), to_tensor(w),
+                                     groups=2, padding=1).numpy())
+        finally:
+            core_flags.set_flags({"conv_nhwc": "never"})
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)

@@ -74,6 +74,24 @@ def main():
           f"{rflops / dt / 1e12:.1f} TF/s "
           f"mfu={rflops / dt / 197e12:.3f}")
 
+    # the candidate fix: same engine step with NHWC-internal convs
+    # (core flag conv_nhwc; boundary transposes cancel under XLA)
+    from paddle1_tpu.core import flags as core_flags
+    core_flags.set_flags({"conv_nhwc": "always"})
+    try:
+        model2 = resnet50()
+        opt2 = paddle.optimizer.Momentum(learning_rate=0.1,
+                                         parameters=model2.parameters())
+        eng2 = ParallelEngine(model2, opt2, loss_fn,
+                              mesh=build_mesh(dp=1, devices=[dev]),
+                              amp_dtype="bfloat16")
+        dt2 = _slope(lambda: eng2.step(b), lo=1, hi=4)
+        print(f"resnet50 step (conv_nhwc=always): {dt2 * 1e3:.1f} ms "
+              f"{rflops / dt2 / 1e12:.1f} TF/s "
+              f"mfu={rflops / dt2 / 197e12:.3f}")
+    finally:
+        core_flags.set_flags({"conv_nhwc": "never"})
+
     import tempfile
     td = tempfile.mkdtemp(prefix="conv_probe_")
     with jax.profiler.trace(td):
