@@ -244,3 +244,40 @@ class TestReviewRegressions:
         import paddle1_tpu.fluid.layers as LL
         import inspect
         assert "layers_ext" not in inspect.getsourcefile(LL.sigmoid)
+
+    def test_lr_decay_staircase_semantics(self):
+        sched = L.natural_exp_decay(0.1, decay_steps=1000,
+                                    decay_rate=0.5, staircase=True)
+        for _ in range(5):
+            sched.step()
+        assert abs(sched() - 0.1) < 1e-9  # still inside the first stair
+        sched2 = L.inverse_time_decay(0.1, decay_steps=2,
+                                      decay_rate=1.0, staircase=True)
+        sched2.step(); sched2.step()  # step=2 -> floor(2/2)=1 -> lr/2
+        assert abs(sched2() - 0.05) < 1e-9
+
+    def test_cumsum_reverse_exclusive(self):
+        x = to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(L.cumsum(x, reverse=True).numpy(),
+                                   [6, 5, 3])
+        np.testing.assert_allclose(L.cumsum(x, exclusive=True).numpy(),
+                                   [0, 1, 3])
+        np.testing.assert_allclose(
+            L.cumsum(x, exclusive=True, reverse=True).numpy(), [5, 3, 0])
+
+    def test_sum_single_tensor_passes_through(self):
+        x = to_tensor(np.ones((2, 3), np.float32))
+        assert L.sum(x).shape == [2, 3]
+        assert float(L.sum([x, x]).numpy()[0, 0]) == 2.0
+
+    def test_sequence_expand_as_needs_lengths(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        x = to_tensor(np.ones((2, 3), np.float32))
+        with pytest.raises(InvalidArgumentError, match="lengths"):
+            L.sequence_expand_as(x, x)
+
+    def test_prelu_element_mode_teaches(self):
+        from paddle1_tpu.core.errors import UnimplementedError
+        x = to_tensor(np.ones((1, 2, 3), np.float32))
+        with pytest.raises(UnimplementedError, match="element"):
+            L.prelu(x, mode="element")
