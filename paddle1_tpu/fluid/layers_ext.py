@@ -88,6 +88,8 @@ __all__ = [
     "sequence_reverse", "sequence_softmax", "sequence_enumerate",
     "sequence_conv", "sequence_erase", "sequence_reshape",
     "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
+    "Print", "Assert", "case", "switch_case", "double_buffer",
+    "Normal", "Uniform", "Categorical", "auc",
     # LR schedules (objects accepted by every optimizer)
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "cosine_decay",
@@ -679,6 +681,100 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
 def mean_iou(input, label, num_classes):
     from ..metric import mean_iou as _miou
     return _miou(_t(input), _t(label), num_classes)
+
+
+# -- tier 3: distributions / control-flow-lite / misc ------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Eager print-passthrough (reference control_flow.py Print op)."""
+    x = _t(input)
+    n = None if summarize is None or summarize < 0 else summarize
+    vals = np.asarray(x.numpy()).reshape(-1)[:n]
+    print((message or "") + f" shape={list(x.shape)} "
+          f"dtype={x.dtype} values={vals.tolist()}")
+    return x
+
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
+    """Eager assert (reference control_flow.py Assert op)."""
+    c = _t(cond)
+    if not bool(np.asarray(c.numpy()).all()):
+        extra = ""
+        if data is not None:
+            n = None if summarize is None or summarize < 0 else summarize
+            extra = "; data=" + ", ".join(
+                str(np.asarray(_t(d).numpy()).reshape(-1)[:n])
+                for d in (data if isinstance(data, (list, tuple))
+                          else [data]))
+        raise AssertionError(f"fluid.layers.Assert failed{extra}")
+    return c
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Eager first-match dispatch (reference control_flow.py case):
+    under trace, tensor predicates must be concrete — use
+    static.nn.cond for traced branching."""
+    for pred, fn in pred_fn_pairs:
+        if bool(np.asarray(_t(pred).numpy())):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(_t(branch_index).numpy()))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is owned by io.DataLoader here; identity for
+    API parity (reference io.py double_buffer)."""
+    return reader
+
+
+def Normal(loc, scale):  # noqa: N802
+    from ..distribution import Normal as _N
+    return _N(loc, scale)
+
+
+def Uniform(low, high):  # noqa: N802
+    from ..distribution import Uniform as _U
+    return _U(low, high)
+
+
+def Categorical(logits):  # noqa: N802
+    from ..distribution import Categorical as _C
+    return _C(logits)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """One-shot AUC over this batch (reference metric_op.py auc op; the
+    stateful accumulation lives in metric.Auc). Returns (auc_value,
+    [auc_value]) — the reference's (out, stat) pair collapses to the
+    value."""
+    if curve != "ROC":
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            f"auc(curve={curve!r}): only ROC is implemented "
+            "(metric.Auc); PR-curve AUC is not mapped")
+    from ..metric import Auc as _Auc
+    m = _Auc(num_thresholds=num_thresholds)
+    x = np.asarray(_t(input).numpy())
+    y = np.asarray(_t(label).numpy()).reshape(-1, 1)
+    m.update(x, y)
+    v = float(m.accumulate())
+    return to_tensor(np.float32(v)), [to_tensor(np.float32(v))]
 
 
 # -- norm / conv / pool / vision transforms ----------------------------------

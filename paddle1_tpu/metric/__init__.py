@@ -166,3 +166,36 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     x = input if isinstance(input, Tensor) else Tensor(input)
     y = label if isinstance(label, Tensor) else Tensor(label)
     return apply("accuracy", f, (x, y))
+
+
+def mean_iou(pred, label, num_classes):
+    """Mean intersection-over-union over classes (reference
+    mean_iou_op.h): returns (mean_iou, out_wrong, out_correct) — the
+    per-class wrong/correct counts ride along like the reference's
+    outputs. Classes absent from both pred and label are excluded from
+    the mean."""
+    import jax.numpy as jnp
+    from ..autograd.engine import apply
+    from ..core.tensor import Tensor, to_tensor
+
+    p = pred if isinstance(pred, Tensor) else to_tensor(pred)
+    l = label if isinstance(label, Tensor) else to_tensor(label)
+
+    def f(p, l):
+        # scatter-add counts: O(N + C) memory (a dense one-hot would be
+        # ~2*N*C floats — hundreds of MB for segmentation maps)
+        p = p.reshape(-1).astype(jnp.int32)
+        l = l.reshape(-1).astype(jnp.int32)
+        z = jnp.zeros(num_classes, jnp.float32)
+        pred_c = z.at[p].add(1.0)
+        label_c = z.at[l].add(1.0)
+        correct = z.at[l].add((p == l).astype(jnp.float32))
+        union = pred_c + label_c - correct
+        present = union > 0
+        iou = jnp.where(present, correct / jnp.maximum(union, 1.0), 0.0)
+        miou = iou.sum() / jnp.maximum(present.sum(), 1)
+        wrong = (pred_c - correct).astype(jnp.int64)
+        return miou, wrong, correct.astype(jnp.int64)
+
+    import jax
+    return apply("mean_iou", f, (p, l), n_outputs=3)

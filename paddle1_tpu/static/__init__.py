@@ -152,6 +152,59 @@ class Executor:
             return out if isinstance(out, (list, tuple)) else [out]
         return []
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None, *, loss_fn=None,
+                           optimizer=None, batch_size=1, collate=None,
+                           model_fn=None, optimizer_fn=None,
+                           process_num=0):
+        """The industrial CPU-training entry (reference
+        fluid/executor.py:1113 → TrainerDesc → MultiTrainer/
+        HogwildWorker). The reference derives the work from a
+        ProgramDesc; here the work is a callable: pass
+        ``loss_fn(batch)->Tensor`` + ``optimizer`` for thread workers
+        (``thread`` of them, fleet.MultiTrainer), or picklable
+        ``model_fn``/``loss_fn(model,batch)``/``optimizer_fn`` with
+        ``process_num`` for real process workers over the shm arena
+        (fleet.ProcessMultiTrainer)."""
+        from ..core.errors import InvalidArgumentError
+        if dataset is None:
+            raise InvalidArgumentError("train_from_dataset needs dataset=")
+        if process_num and process_num > 0:
+            if model_fn is None or loss_fn is None or optimizer_fn is None:
+                raise InvalidArgumentError(
+                    "process workers need picklable model_fn=, "
+                    "loss_fn=(model, batch), optimizer_fn=(model) "
+                    "(fleet.ProcessMultiTrainer contract)")
+            from ..distributed.fleet import ProcessMultiTrainer
+            tr = ProcessMultiTrainer(process_num=process_num)
+            return tr.train_from_dataset(dataset, model_fn, loss_fn,
+                                         optimizer_fn,
+                                         batch_size=batch_size,
+                                         collate=collate, debug=debug)
+        if loss_fn is None or optimizer is None:
+            raise InvalidArgumentError(
+                "train_from_dataset cannot derive the loss from a "
+                "Program shell: pass loss_fn=(batch)->Tensor and "
+                "optimizer= (the eager work the reference encoded in "
+                "the ProgramDesc)")
+        from ..distributed.fleet import MultiTrainer
+        tr = MultiTrainer(thread_num=max(int(thread), 1))
+        return tr.train_from_dataset(dataset, loss_fn, optimizer,
+                                     batch_size=batch_size,
+                                     collate=collate, debug=debug)
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        """Inference twin of train_from_dataset (reference
+        executor.py:1219): same drain, no optimizer — pass a loss_fn
+        that only evaluates."""
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "infer_from_dataset: drain the dataset through "
+            "io.DataLoader + model.eval() (or hapi.Model.predict); the "
+            "trainer runtime exists for the training half")
+
     def close(self):
         pass
 

@@ -281,3 +281,52 @@ class TestReviewRegressions:
         x = to_tensor(np.ones((1, 2, 3), np.float32))
         with pytest.raises(UnimplementedError, match="element"):
             L.prelu(x, mode="element")
+
+
+class TestTier3:
+    def test_mean_iou_counts(self):
+        pred = to_tensor(np.array([0, 0, 1, 1], np.int64))
+        lab = to_tensor(np.array([0, 1, 1, 1], np.int64))
+        miou, wrong, correct = L.mean_iou(pred, lab, 2)
+        # class0: corr 1, union 2 -> 0.5; class1: corr 2, union 3 -> 2/3
+        np.testing.assert_allclose(float(miou.numpy()),
+                                   (0.5 + 2 / 3) / 2, rtol=1e-6)
+        assert np.asarray(correct.numpy()).tolist() == [1, 2]
+        assert np.asarray(wrong.numpy()).tolist() == [1, 0]
+
+    def test_case_and_switch_case(self):
+        t, f = to_tensor(np.array(True)), to_tensor(np.array(False))
+        out = L.case([(f, lambda: 1), (t, lambda: 2)],
+                     default=lambda: 3)
+        assert out == 2
+        assert L.switch_case(to_tensor(np.array(1)),
+                             {0: lambda: "a", 1: lambda: "b"}) == "b"
+        assert L.switch_case(to_tensor(np.array(9)),
+                             {0: lambda: "a"},
+                             default=lambda: "d") == "d"
+
+    def test_assert_and_print(self):
+        x = to_tensor(np.ones(3, np.float32))
+        assert L.Print(x, message="dbg") is x
+        L.Assert(to_tensor(np.array(True)))
+        with pytest.raises(AssertionError):
+            L.Assert(to_tensor(np.array(False)),
+                     data=[to_tensor(np.arange(3))])
+
+    def test_distributions(self):
+        n = L.Normal(0.0, 1.0)
+        s = n.sample([4])
+        assert list(s.shape)[:1] == [4]
+        u = L.Uniform(0.0, 2.0)
+        vals = np.asarray(u.sample([100]).numpy())
+        assert (vals >= 0).all() and (vals <= 2).all()
+        c = L.Categorical(to_tensor(np.array([1.0, 1.0, 1.0],
+                                             np.float32)))
+        assert c is not None
+
+    def test_auc_functional(self):
+        scores = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7],
+                           [0.6, 0.4]], np.float32)
+        labels = np.array([1, 0, 1, 0], np.int64)
+        v, stat = L.auc(to_tensor(scores), to_tensor(labels))
+        assert float(v.numpy()) == 1.0  # perfectly separable

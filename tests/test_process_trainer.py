@@ -163,3 +163,48 @@ class TestDeadWorkerDetection:
             tr.train_from_dataset(batches, _exit_model_fn, _mse_loss,
                                   _optimizer_fn, batch_size=None)
         assert time.monotonic() - t0 < 120
+
+
+class TestExecutorEntry:
+    """exe.train_from_dataset parity (reference executor.py:1113)."""
+
+    def test_thread_route(self):
+        import paddle1_tpu as paddle
+        from paddle1_tpu.core.tensor import to_tensor
+        from paddle1_tpu.static import Executor
+        m = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=m.parameters())
+        rng = np.random.default_rng(0)
+        W = rng.standard_normal((4, 1)).astype(np.float32)
+        data = []
+        for _ in range(30):
+            X = rng.standard_normal((8, 4)).astype(np.float32)
+            data.append({"x": X, "y": X @ W})
+
+        def loss_fn(b):
+            d = m(to_tensor(b["x"])) - to_tensor(b["y"])
+            return (d * d).mean()
+
+        out = Executor().train_from_dataset(
+            dataset=data, thread=2, loss_fn=loss_fn, optimizer=opt,
+            batch_size=None)
+        assert out["batches"] == 30
+
+    def test_process_route(self):
+        from paddle1_tpu.static import Executor
+        batches, _ = _make_xy_batches(10)
+        out = Executor().train_from_dataset(
+            dataset=batches, process_num=2, model_fn=_model_fn,
+            loss_fn=_mse_loss, optimizer_fn=_optimizer_fn,
+            batch_size=None)
+        assert out["batches"] == 10 and out["workers"] == 2
+
+    def test_missing_args_teach(self):
+        import pytest as _pytest
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        from paddle1_tpu.static import Executor
+        with _pytest.raises(InvalidArgumentError, match="loss_fn"):
+            Executor().train_from_dataset(dataset=[1, 2])
+        with _pytest.raises(InvalidArgumentError, match="picklable"):
+            Executor().train_from_dataset(dataset=[1], process_num=2)
