@@ -40,8 +40,10 @@ clean fallback to the untransformed statement):
   lowers to ``lax.while_loop``, which XLA cannot reverse-differentiate —
   value/inference paths work, `.backward()` through such a loop raises
   JAX's while-autodiff error (same shape as the reference's
-  while_grad-unsupported cases; use a concrete bound or lax.scan-style
-  ops for trainable loops).
+  while_grad-unsupported cases). For a TRAINABLE dynamic loop, call
+  ``static.nn.while_loop(cond, body, vars, max_iter=N)`` directly — the
+  bounded lax.scan lowering freezes the state once the condition goes
+  false and stays reverse-differentiable.
 """
 
 from __future__ import annotations

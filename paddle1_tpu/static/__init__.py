@@ -57,7 +57,18 @@ class nn:
                                  else pred,))
 
     @staticmethod
-    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    def while_loop(cond, body, loop_vars, is_test=False, name=None,
+                   max_iter=None):
+        """Traced while loop. Plain form lowers to ``lax.while_loop``
+        (forward-only: XLA cannot reverse-differentiate a dynamic
+        loop — the limit dy2static's teaching error points here about).
+        With ``max_iter=N`` it lowers to a bounded ``lax.scan`` that
+        runs N steps and freezes the state once ``cond`` goes false —
+        same result for any loop that terminates within N, and fully
+        DIFFERENTIABLE (grad flows through the taken iterations; the
+        frozen tail contributes identity). This is the TPU answer to
+        the reference while_op's backward (control_flow.py While with
+        grad): trade a static bound for reverse-mode support."""
         arrs = [v.data if isinstance(v, Tensor) else jnp.asarray(v)
                 for v in loop_vars]
 
@@ -73,7 +84,20 @@ class nn:
                     outs = (outs,)
                 return tuple(o.data if isinstance(o, Tensor) else o
                              for o in outs)
-            return jax.lax.while_loop(c, b, tuple(xs))
+
+            if max_iter is None:
+                return jax.lax.while_loop(c, b, tuple(xs))
+
+            def step(vals, _):
+                live = c(vals)
+                nxt = b(vals)
+                out = tuple(jnp.where(live, n, v)
+                            for n, v in zip(nxt, vals))
+                return out, None
+
+            final, _ = jax.lax.scan(step, tuple(xs), None,
+                                    length=int(max_iter))
+            return final
         res = apply("while_loop", f,
                     tuple(to_tensor(a) for a in arrs),
                     n_outputs=len(arrs))
