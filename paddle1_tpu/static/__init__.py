@@ -88,14 +88,28 @@ class nn:
             if max_iter is None:
                 return jax.lax.while_loop(c, b, tuple(xs))
 
+            init = tuple(xs)
+
             def step(vals, _):
                 live = c(vals)
-                nxt = b(vals)
+                # double-where: the dead (post-termination) body still
+                # executes under scan — feed it the INITIAL state (known
+                # body-safe) so an inf/nan from e.g. x/(n-i) on the
+                # frozen state cannot poison the gradient through
+                # where's vjp (nan * 0 = nan)
+                safe = tuple(jnp.where(live, v, v0)
+                             for v, v0 in zip(vals, init))
+                nxt = b(safe)
+                if len(nxt) != len(vals):
+                    raise TypeError(
+                        f"while_loop body returned {len(nxt)} values "
+                        f"for {len(vals)} loop_vars (carry structure "
+                        "must match, like lax.while_loop)")
                 out = tuple(jnp.where(live, n, v)
                             for n, v in zip(nxt, vals))
                 return out, None
 
-            final, _ = jax.lax.scan(step, tuple(xs), None,
+            final, _ = jax.lax.scan(step, init, None,
                                     length=int(max_iter))
             return final
         res = apply("while_loop", f,

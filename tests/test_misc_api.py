@@ -206,5 +206,44 @@ class TestBoundedDifferentiableWhile(unittest.TestCase):
             s = s.data if hasattr(s, "data") else s
             return (s * s).sum()
 
-        with self.assertRaises(Exception):
+        # forward works; reverse mode specifically is what fails
+        self.assertAlmostEqual(float(loss(jnp.float32(2.0))), 36.0,
+                               places=4)
+        with self.assertRaises(ValueError) as cm:
             jax.grad(loss)(jnp.float32(2.0))
+        self.assertIn("while", str(cm.exception).lower())
+
+    def test_bounded_grad_survives_unsafe_frozen_body(self):
+        """Double-where regression: the dead body evaluation after
+        termination (here x/(3-i) hitting i=3 -> x/0) must not poison
+        the gradient with NaN."""
+        import jax
+        import jax.numpy as jnp
+        from paddle1_tpu import static
+
+        def loss(x):
+            def cond(i, s):
+                return i < 3
+
+            def body(i, s):
+                return i + 1, s + x / (3.0 - i.astype(jnp.float32))
+
+            i, s = static.nn.while_loop(cond, body,
+                                        [jnp.int32(0), jnp.zeros(())],
+                                        max_iter=5)
+            s = s.data if hasattr(s, "data") else s
+            return s
+
+        v = float(loss(jnp.float32(2.0)))
+        self.assertAlmostEqual(v, 2 * (1 / 3 + 1 / 2 + 1.0), places=4)
+        g = float(jax.grad(loss)(jnp.float32(2.0)))
+        self.assertAlmostEqual(g, 1 / 3 + 1 / 2 + 1.0, places=4)
+
+    def test_bounded_body_arity_mismatch_raises(self):
+        import jax.numpy as jnp
+        from paddle1_tpu import static
+        with self.assertRaises(TypeError):
+            static.nn.while_loop(
+                lambda i, s: i < 2,
+                lambda i, s: (i + 1, s, s),   # 3 outputs for 2 vars
+                [jnp.int32(0), jnp.zeros(())], max_iter=4)
