@@ -89,6 +89,10 @@ __all__ = [
     "sequence_conv", "sequence_erase", "sequence_reshape",
     "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
     "Print", "Assert", "case", "switch_case", "double_buffer",
+    "hsigmoid", "bilinear_tensor_product", "fsp_matrix", "row_conv",
+    "im2sequence", "center_loss", "sampling_id",
+    "teacher_student_sigmoid_loss", "anchor_generator",
+    "bipartite_match", "density_prior_box",
     "Normal", "Uniform", "Categorical", "auc",
     # LR schedules (objects accepted by every optimizer)
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
@@ -1188,3 +1192,315 @@ def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
            else _manip.concat(ts, axis=axis))
     sizes = to_tensor(np.asarray([t.shape[axis] for t in ts], np.int32))
     return out, sizes
+
+
+# -- tier 4: remaining mappable nn/detection long-tail ------------------------
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """Hierarchical sigmoid (reference layers/nn.py hsigmoid): the
+    [num_classes-1, D] inner-node weights are implicit parameters."""
+    x = _t(input)
+    D = x.shape[-1]
+    lay = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("hsigmoid", D, num_classes),
+        lambda: _paddle.nn.Linear(D, num_classes - 1))
+    w = _manip.transpose(lay.weight, [1, 0])  # [C-1, D] like reference
+    return F.hsigmoid_loss(x, _t(label), num_classes, w, lay.bias,
+                           path_table=path_table, path_code=path_code)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y + b_k (reference layers/nn.py
+    bilinear_tensor_product); W [size, dx, dy] is implicit."""
+    xt, yt = _t(x), _t(y)
+    dx, dy = xt.shape[-1], yt.shape[-1]
+    holder = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("bilinear_tp", dx, dy, size),
+        lambda: _paddle.nn.Bilinear(dx, dy, size))
+    out = holder(xt, yt)
+    return getattr(F, act)(out) if act else out
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (reference layers/nn.py
+    fsp_matrix, distillation): [N,C1,H,W] x [N,C2,H,W] →
+    [N, C1, C2] = mean over H*W of outer products."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+
+    def f(a, b):
+        n, c1 = a.shape[0], a.shape[1]
+        c2 = b.shape[1]
+        hw = a.shape[2] * a.shape[3]
+        af = a.reshape(n, c1, hw)
+        bf = b.reshape(n, c2, hw)
+        return jnp.einsum("ncx,ndx->ncd", af, bf) / hw
+    return _apply("fsp_matrix", f, (_t(x), _t(y)))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             lengths=None, name=None):
+    """Lookahead row convolution (reference row_conv_op, DeepSpeech):
+    out[t] = sum_{k=0..K} w[k] * x[t+k], per feature channel. The
+    [K+1, D] weight is implicit. Dense form: [B, T, D] (+ optional
+    lengths masking)."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x = _t(input)
+    D = x.shape[-1]
+    K = int(future_context_size)
+    holder = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("row_conv", K, D),
+        lambda: _paddle.nn.Linear(K + 1, D, bias_attr=False))
+    w = holder.weight  # [K+1, D]
+
+    def f(a, wv, *maybe_len):
+        T = a.shape[1]
+        bound = (maybe_len[0][:, None] if maybe_len
+                 else jnp.full((a.shape[0], 1), T))
+        out = jnp.zeros_like(a)
+        for k in _bi.range(K + 1):
+            shifted = jnp.roll(a, -k, axis=1)
+            # context frame t+k must exist INSIDE the sequence (the
+            # reference truncates at each sequence's end, not at T)
+            ok = ((jnp.arange(T)[None, :] + k) < bound)[..., None]
+            out = out + jnp.where(ok, shifted, 0.0) * wv[k][None, None, :]
+        if maybe_len:
+            valid = (jnp.arange(T)[None, :] < bound)[..., None]
+            out = jnp.where(valid, out, 0.0)
+        return out
+    args = (x, w) + ((_t(lengths),) if lengths is not None else ())
+    out = _apply("row_conv", f, args)
+    return getattr(F, act)(out) if act else out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    """Image → patch sequence (reference im2sequence_op): [N,C,H,W] →
+    [N, oh*ow, C*fh*fw] via unfold."""
+    x = _t(input)
+    cols = F.unfold(x, filter_size, strides=stride, paddings=padding)
+    # unfold gives [N, C*fh*fw, L]; the reference sequence layout is
+    # [N, L, C*fh*fw]
+    return _manip.transpose(cols, [0, 2, 1])
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """Center loss (reference center_loss_op): pulls features toward
+    per-class centers; centers are an implicit parameter updated by a
+    moving average when ``update_center``."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x, lab = _t(input), _t(label)
+    if lab.ndim > 1:
+        lab = _manip.reshape(lab, [-1])
+    D = x.shape[-1]
+    holder = _implicit_layer(
+        getattr(param_attr, "name", param_attr),
+        ("center_loss", num_classes, D),
+        lambda: _paddle.nn.Embedding(num_classes, D))
+    centers = holder.weight
+    # centers update ONLY by the moving average below (reference
+    # center_loss_op grad maker emits d/dX alone) — enter the graph as
+    # a stop-gradient value so an optimizer over implicit_parameters()
+    # cannot double-update them
+    centers_sg = to_tensor(centers.data)
+
+    def f(feat, lb, c):
+        sel = c[lb]
+        diff = feat - sel
+        return 0.5 * (diff * diff).sum(axis=-1, keepdims=True)
+    loss = _apply("center_loss", f, (x, lab, centers_sg))
+    if update_center:
+        # reference updates centers OUTSIDE autodiff: c_j -= alpha *
+        # mean_{i: y_i=j}(c_j - x_i)
+        import numpy as _np
+        feat = _np.asarray(x.numpy())
+        lb = _np.asarray(lab.numpy())
+        c = _np.array(centers.numpy())  # writable copy
+        delta = _np.zeros_like(c)
+        counts = _np.zeros(num_classes, _np.float32)
+        _np.add.at(delta, lb, c[lb] - feat)
+        _np.add.at(counts, lb, 1.0)
+        c -= alpha * delta / (1.0 + counts)[:, None]
+        centers._data = jnp.asarray(c)
+    return loss
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int32"):  # noqa: A002
+    """Sample one index per row from row-probabilities (reference
+    sampling_id_op; reproducible under a fixed seed like the repo's
+    other RNG ops). Non-differentiable sample: no tape edge."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.generator import next_key
+    xt = _t(x)
+    key = (jax.random.fold_in(jax.random.key(seed), 0) if seed
+           else next_key())
+    out = jax.random.categorical(
+        key, jnp.log(jnp.clip(xt.data, 1e-30, None)), axis=-1)
+    return to_tensor(out.astype(jnp.dtype(dtype)))
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation loss (reference teacher_student_sigmoid_loss_op):
+    label < 0 → teacher part -z*sigmoid(x); else standard logistic
+    + teacher-weighted term (the reference's piecewise contract)."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+
+    def f(x, y):
+        # reference piecewise (teacher_student_sigmoid_loss_op.h:43-63;
+        # the bounds clip only the GRADIENT there, forward is exact):
+        #   y < -1        -> log(1+e^x)
+        #   -1 <= y < 0   -> log(1+e^x) - x
+        #   y >= 0        -> 2*log(1+e^x) - x*y
+        log1pex = jnp.logaddexp(0.0, x)
+        return jnp.where(y < -1.0, log1pex,
+                         jnp.where(y < 0.0, log1pex - x,
+                                   2.0 * log1pex - x * y))
+    return _apply("teacher_student_sigmoid_loss", f,
+                  (_t(input), _t(label)))
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    """SSD/FasterRCNN anchors per feature-map cell (reference
+    detection.py anchor_generator). Returns (anchors [H,W,A,4],
+    variances [H,W,A,4]) in xyxy like the reference."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x = _t(input)
+    H, W = x.shape[-2], x.shape[-1]
+    sizes = [float(s) for s in (anchor_sizes or [64., 128., 256., 512.])]
+    ratios = [float(r) for r in (aspect_ratios or [0.5, 1.0, 2.0])]
+    sx, sy = (float(stride[0]), float(stride[1])) if stride else (16., 16.)
+    boxes = []
+    # reference anchor_generator_op.h:75-94: per ratio, the base box is
+    # round(sqrt(stride_area / ar)) x round(base_w * ar), scaled by
+    # size/stride — NOT size*sqrt(ar) (which transposes w/h)
+    for r in ratios:
+        base_area = sx * sy
+        base_w = round((base_area / r) ** 0.5)
+        base_h = round(base_w * r)
+        for s in sizes:
+            boxes.append((base_w * s / sx, base_h * s / sy))
+    A = len(boxes)
+
+    def f(_):
+        # centers at offset*(stride-1) + cell*stride; corners use the
+        # (w-1)/2 pixel convention, both per the reference
+        cx = offset * (sx - 1) + jnp.arange(W) * sx
+        cy = offset * (sy - 1) + jnp.arange(H) * sy
+        cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+        wh = jnp.asarray(boxes)                   # [A, 2]
+        x1 = cxg[..., None] - (wh[None, None, :, 0] - 1) / 2
+        y1 = cyg[..., None] - (wh[None, None, :, 1] - 1) / 2
+        x2 = cxg[..., None] + (wh[None, None, :, 0] - 1) / 2
+        y2 = cyg[..., None] + (wh[None, None, :, 1] - 1) / 2
+        anchors = jnp.stack([x1, y1, x2, y2], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variance), anchors.shape)
+        return anchors, var
+    return _apply("anchor_generator", f, (x,), n_outputs=2)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference bipartite_match_op, SSD
+    target assignment). Host computation (argmax loops are not
+    shape-stable); returns (match_indices [N,M], match_dist [N,M]) for
+    a [N?, M, P]-less 2-D [M, P] or batched input list semantics
+    reduced to the common [M, P] case."""
+    d = np.asarray(_t(dist_matrix).numpy())
+    if d.ndim != 2:
+        raise ValueError("bipartite_match expects a [M, P] distance "
+                         "matrix (per-image)")
+    M, P = d.shape
+    match_idx = -np.ones(P, np.int64)
+    match_dist = np.zeros(P, np.float32)
+    work = d.copy()
+    # stage 1: mutual-best greedy assignment
+    for _ in _bi.range(min(M, P)):
+        i, j = np.unravel_index(np.argmax(work), work.shape)
+        if work[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = d[i, j]
+        work[i, :] = -1.0
+        work[:, j] = -1.0
+    if match_type == "per_prediction":
+        thr = dist_threshold if dist_threshold is not None else 0.5
+        for j in np.where(match_idx < 0)[0]:
+            i = int(np.argmax(d[:, j]))
+            if d[i, j] >= thr:
+                match_idx[j] = i
+                match_dist[j] = d[i, j]
+    return (to_tensor(match_idx.reshape(1, P)),
+            to_tensor(match_dist.reshape(1, P)))
+
+
+def density_prior_box(input, image=None, densities=None,
+                      fixed_sizes=None, fixed_ratios=None,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified prior boxes (reference detection.py density_prior_box):
+    each (density, fixed_size) pair lays density^2 shifted boxes per
+    cell of every fixed_ratio."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x = _t(input)
+    H, W = x.shape[-2], x.shape[-1]
+    img_h, img_w = (_t(image).shape[-2:] if image is not None
+                    else (H * 16, W * 16))
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    densities = [int(d) for d in (densities or [1])]
+    fixed_sizes = [float(s) for s in (fixed_sizes or [step_w])]
+    fixed_ratios = [float(r) for r in (fixed_ratios or [1.0])]
+    # reference density_prior_box_op.h: sub-box shifts use the INTEGER
+    # step_average; coordinates clamp to [0,1] in the assignment itself
+    # (the clip arg is a no-op second pass there — kept for signature)
+    step_avg = int((step_w + step_h) / 2)
+    specs = []  # (w, h, shift_x, shift_y) per sub-box
+    for density, size in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            shift = step_avg / density
+            for di in _bi.range(density):
+                for dj in _bi.range(density):
+                    specs.append((bw, bh,
+                                  -step_avg / 2.0 + shift / 2.0
+                                  + dj * shift,
+                                  -step_avg / 2.0 + shift / 2.0
+                                  + di * shift))
+    A = len(specs)
+
+    def f(_):
+        cx = (jnp.arange(W) + offset) * step_w
+        cy = (jnp.arange(H) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)
+        sp = jnp.asarray(specs)                   # [A, 4]
+        bx = cxg[..., None] + sp[None, None, :, 2]
+        by = cyg[..., None] + sp[None, None, :, 3]
+        x1 = (bx - sp[None, None, :, 0] / 2) / img_w
+        y1 = (by - sp[None, None, :, 1] / 2) / img_h
+        x2 = (bx + sp[None, None, :, 0] / 2) / img_w
+        y2 = (by + sp[None, None, :, 1] / 2) / img_h
+        out = jnp.clip(jnp.stack([x1, y1, x2, y2], axis=-1), 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance), out.shape)
+        if flatten_to_2d:
+            return out.reshape(-1, 4), var.reshape(-1, 4)
+        return out, var
+    return _apply("density_prior_box", f, (x,), n_outputs=2)

@@ -90,23 +90,26 @@ class nn:
 
             init = tuple(xs)
 
-            def step(vals, _):
-                live = c(vals)
-                # double-where: the dead (post-termination) body still
-                # executes under scan — feed it the INITIAL state (known
-                # body-safe) so an inf/nan from e.g. x/(n-i) on the
-                # frozen state cannot poison the gradient through
-                # where's vjp (nan * 0 = nan)
-                safe = tuple(jnp.where(live, v, v0)
-                             for v, v0 in zip(vals, init))
-                nxt = b(safe)
+            def taken(vals):
+                nxt = b(vals)
                 if len(nxt) != len(vals):
                     raise TypeError(
                         f"while_loop body returned {len(nxt)} values "
                         f"for {len(vals)} loop_vars (carry structure "
                         "must match, like lax.while_loop)")
-                out = tuple(jnp.where(live, n, v)
-                            for n, v in zip(nxt, vals))
+                return tuple(nxt)
+
+            def step(vals, _):
+                live = c(vals)
+                # the dead (post-termination) body must not EXECUTE —
+                # where-select alone would still run it and an inf/nan
+                # on the frozen state would poison the gradient
+                # (nan * 0 = nan through where's vjp). lax.cond skips
+                # the untaken branch, including the zero-iteration case
+                # (cond false on entry). Caveat: if XLA ever lowers the
+                # branch pair to a select (tiny bodies), guard the body
+                # against its frozen state explicitly.
+                out = jax.lax.cond(live, taken, lambda vs: vs, vals)
                 return out, None
 
             final, _ = jax.lax.scan(step, init, None,

@@ -330,3 +330,140 @@ class TestTier3:
         labels = np.array([1, 0, 1, 0], np.int64)
         v, stat = L.auc(to_tensor(scores), to_tensor(labels))
         assert float(v.numpy()) == 1.0  # perfectly separable
+
+
+class TestTier4:
+    def test_hsigmoid_trains(self):
+        x = to_tensor(np.random.default_rng(0).standard_normal(
+            (4, 8)).astype(np.float32))
+        y = to_tensor(np.array([0, 1, 2, 3], np.int64))
+        loss = L.hsigmoid(x, y, num_classes=6)
+        assert loss.shape[0] == 4
+        loss.sum().backward()
+
+    def test_bilinear_tensor_product(self):
+        x = to_tensor(np.ones((2, 3), np.float32))
+        y = to_tensor(np.ones((2, 5), np.float32))
+        out = L.bilinear_tensor_product(x, y, size=4)
+        assert out.shape == [2, 4]
+
+    def test_fsp_matrix(self):
+        a = to_tensor(np.ones((2, 3, 4, 4), np.float32))
+        b = to_tensor(np.full((2, 5, 4, 4), 2.0, np.float32))
+        out = L.fsp_matrix(a, b)
+        assert out.shape == [2, 3, 5]
+        np.testing.assert_allclose(np.asarray(out.numpy()), 2.0)
+
+    def test_row_conv_lookahead(self):
+        x = to_tensor(np.eye(4, dtype=np.float32).reshape(1, 4, 4))
+        out = L.row_conv(x, future_context_size=1)
+        assert out.shape == [1, 4, 4]
+
+    def test_im2sequence_patches(self):
+        x = to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = L.im2sequence(x, filter_size=2, stride=2)
+        assert out.shape == [1, 4, 4]  # 4 patches of 1*2*2
+
+    def test_center_loss_updates_centers(self):
+        feats = to_tensor(np.ones((4, 3), np.float32))
+        labels = to_tensor(np.zeros((4,), np.int64))
+        losses = []
+        for _ in range(2):  # same site across two passes
+            l = L.center_loss(feats, labels, num_classes=2, alpha=0.5)
+            losses.append(float(l.numpy().sum()))
+            L.reset_parameter_pass()  # end of pass (no backward here)
+        # centers moved toward the features: loss decreased
+        assert losses[1] < losses[0], losses
+
+    def test_sampling_id_range(self):
+        probs = to_tensor(np.array([[0.0, 1.0, 0.0]] * 8, np.float32))
+        ids = np.asarray(L.sampling_id(probs).numpy())
+        assert (ids == 1).all()
+
+    def test_anchor_generator_shapes(self):
+        fmap = to_tensor(np.zeros((1, 8, 4, 6), np.float32))
+        anchors, var = L.anchor_generator(
+            fmap, anchor_sizes=[64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        assert anchors.shape == [4, 6, 1, 4]
+        a = np.asarray(anchors.numpy())
+        # reference convention: center offset*(stride-1)=7.5, corners
+        # +-(w-1)/2 with w = round(sqrt(256/1)) * 64/16 = 64
+        np.testing.assert_allclose(a[0, 0, 0], [-24, -24, 39, 39])
+        assert var.shape == [4, 6, 1, 4]
+
+    def test_bipartite_match_greedy(self):
+        d = to_tensor(np.array([[0.9, 0.1],
+                                [0.8, 0.7]], np.float32))
+        idx, dist = L.bipartite_match(d)
+        iv = np.asarray(idx.numpy())[0]
+        assert iv[0] == 0 and iv[1] == 1   # mutual-best then next-best
+        np.testing.assert_allclose(np.asarray(dist.numpy())[0],
+                                   [0.9, 0.7])
+
+    def test_density_prior_box_counts(self):
+        fmap = to_tensor(np.zeros((1, 3, 2, 2), np.float32))
+        boxes, var = L.density_prior_box(
+            fmap, densities=[2], fixed_sizes=[32.0],
+            fixed_ratios=[1.0], steps=[16.0, 16.0], clip=True,
+            flatten_to_2d=True)
+        # 2x2 cells x density^2(4) boxes = 16
+        assert boxes.shape == [16, 4]
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_teacher_student_loss_runs(self):
+        x = to_tensor(np.array([0.5, -0.5], np.float32))
+        y = to_tensor(np.array([1.0, 0.0], np.float32))
+        out = L.teacher_student_sigmoid_loss(x, y)
+        assert out.shape == [2]
+
+    def test_teacher_student_piecewise_values(self):
+        x = np.array([1.0, 1.0, 1.0, 1.0], np.float32)
+        y = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+        out = np.asarray(L.teacher_student_sigmoid_loss(
+            to_tensor(x), to_tensor(y)).numpy())
+        l1p = np.log1p(np.exp(1.0))
+        np.testing.assert_allclose(
+            out, [l1p, l1p - 1.0, 2 * l1p - 0.5, 2 * l1p - 2.0],
+            rtol=1e-5)
+
+    def test_row_conv_truncates_at_sequence_end(self):
+        x = np.zeros((1, 4, 2), np.float32)
+        x[0, 3] = 99.0                      # padding content
+        out = np.asarray(L.row_conv(
+            to_tensor(x), future_context_size=2,
+            lengths=to_tensor(np.array([3], np.int64))).numpy())
+        # valid positions must not see the padding frame at t=3
+        assert np.isfinite(out).all() and (np.abs(out[0, :3]) < 50).all()
+
+    def test_density_prior_box_clamps_unconditionally(self):
+        fmap = to_tensor(np.zeros((1, 3, 2, 2), np.float32))
+        boxes, _ = L.density_prior_box(
+            fmap, densities=[1], fixed_sizes=[64.0],
+            fixed_ratios=[1.0], steps=[16.0, 16.0], clip=False,
+            flatten_to_2d=True)
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_sampling_id_seeded_reproducible(self):
+        probs = to_tensor(np.full((4, 3), 1 / 3, np.float32))
+        a = np.asarray(L.sampling_id(probs, seed=7).numpy())
+        b = np.asarray(L.sampling_id(probs, seed=7).numpy())
+        np.testing.assert_array_equal(a, b)
+
+    def test_center_loss_centers_not_in_autograd(self):
+        feats = to_tensor(np.ones((2, 3), np.float32))
+        feats.stop_gradient = False
+        labels = to_tensor(np.zeros((2,), np.int64))
+        loss = L.center_loss(feats, labels, num_classes=2, alpha=0.0,
+                             update_center=False)
+        loss.sum().backward()
+        assert feats.grad is not None
+        # the centers parameter got NO autograd gradient
+        from paddle1_tpu.fluid.layers import _implicit_registry
+        for st in _implicit_registry.values():
+            for lay in st.layers:
+                for pp in lay.parameters():
+                    if tuple(pp.shape) == (2, 3):
+                        assert pp.grad is None

@@ -247,3 +247,22 @@ class TestBoundedDifferentiableWhile(unittest.TestCase):
                 lambda i, s: i < 2,
                 lambda i, s: (i + 1, s, s),   # 3 outputs for 2 vars
                 [jnp.int32(0), jnp.zeros(())], max_iter=4)
+
+    def test_zero_iteration_loop_grad_clean(self):
+        """cond false on entry: the body (x/0 on the initial state)
+        must never execute, so both value and grad stay finite."""
+        import jax
+        import jax.numpy as jnp
+        from paddle1_tpu import static
+
+        def loss(x):
+            i, s = static.nn.while_loop(
+                lambda i, s: i < 0,
+                lambda i, s: (i + 1,
+                              s + x / (0.0 - i.astype(jnp.float32))),
+                [jnp.int32(0), jnp.zeros(())], max_iter=3)
+            s = s.data if hasattr(s, "data") else s
+            return s
+
+        self.assertEqual(float(loss(jnp.float32(2.0))), 0.0)
+        self.assertEqual(float(jax.grad(loss)(jnp.float32(2.0))), 0.0)
