@@ -20,10 +20,14 @@ Scope (documented limits, each guarded by a loud teaching error or a
 clean fallback to the untransformed statement):
 
 * ``if`` / ``while`` / ``for .. in range(..)`` whose body has no
-  ``return`` / ``break`` / ``continue`` / ``yield`` are converted;
-  statements that do early-exit are left as plain Python (correct for
-  concrete conditions; a traced condition there still raises the
-  teaching error from StaticFunction).
+  ``break`` / ``continue`` / ``yield`` are converted; EARLY ``return``
+  converts too (r4): an ``if`` whose body tail-returns absorbs the rest
+  of the function as its else-branch (single-exit normalization, the
+  reference return_transformer idea) and all-paths-return ``if``s
+  become a ``lax.cond`` over the return values
+  (:func:`convert_ifelse_return`). Loop-exit statements stay plain
+  Python (correct for concrete conditions; a traced condition there
+  still raises the teaching error from StaticFunction).
 * ``a and b`` / ``a or b`` / ``not a`` are rewritten to converters that
   preserve Python value semantics (incl. short-circuit) for concrete
   operands and compute ``logical_and/or/not`` for traced ones.
@@ -124,6 +128,28 @@ def _wrap_like(template, value):
 # runtime converters (reference: dygraph_to_static/convert_operators.py)
 # ---------------------------------------------------------------------------
 
+class _BranchError(Exception):
+    """Carrier for a TypeError raised by USER branch code — it must
+    escape the except-TypeError around lax.cond, which is only for the
+    cond's own branch-structure mismatch."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _cond_dispatch(pred, branch_t, branch_f, mismatch_msg):
+    """lax.cond over two wrapped branches, disambiguating user
+    TypeErrors from cond structure mismatches (shared by convert_ifelse
+    and convert_ifelse_return)."""
+    try:
+        return jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
+                            branch_t, branch_f, 0)
+    except _BranchError as be:
+        raise be.exc
+    except TypeError as e:
+        raise InvalidArgumentError(mismatch_msg + f" ({e})") from e
+
+
 def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
                    in_true: Sequence[bool], in_false: Sequence[bool]):
     """``if`` dispatch. true_fn/false_fn take the current values of
@@ -145,14 +171,6 @@ def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
     keep = [i for i, ok in enumerate(both) if ok]
     templates = {}
 
-    class _BranchError(Exception):
-        """Carrier for a TypeError raised by USER branch code — it must
-        escape the except-TypeError below, which is only for lax.cond's
-        branch-structure mismatch."""
-
-        def __init__(self, exc):
-            self.exc = exc
-
     def _branch(fn, key):
         def inner(_):
             try:
@@ -163,17 +181,11 @@ def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
             return tuple(jnp.asarray(_raw(outs[i])) for i in keep)
         return inner
 
-    try:
-        kept = jax.lax.cond(jnp.reshape(_raw(pred), ()).astype(bool),
-                            _branch(true_fn, "t"),
-                            _branch(false_fn, "f"), 0)
-    except _BranchError as be:
-        raise be.exc
-    except TypeError as e:
-        raise InvalidArgumentError(
-            f"to_static: the branches of a Tensor-condition `if` produce "
-            f"mismatched shapes/dtypes for {list(names)} — a traced branch "
-            f"must yield the same structure on both sides. ({e})") from e
+    kept = _cond_dispatch(
+        pred, _branch(true_fn, "t"), _branch(false_fn, "f"),
+        f"to_static: the branches of a Tensor-condition `if` produce "
+        f"mismatched shapes/dtypes for {list(names)} — a traced branch "
+        f"must yield the same structure on both sides.")
     tmpl = templates.get("t") or templates.get("f")
     out, ki = [], 0
     for i, name in enumerate(names):
@@ -187,6 +199,50 @@ def convert_ifelse(pred, true_fn, false_fn, init, names: Sequence[str],
                       "the `if`) to be readable afterwards")
             out.append(u)
     return tuple(out)
+
+
+def convert_ifelse_return(pred, true_fn, false_fn):
+    """Early-return ``if`` dispatch: both branch closures RETURN from the
+    enclosing function (the AST pass proved every path through them ends
+    in ``return``), so unlike :func:`convert_ifelse` no locals flow out —
+    the branches' return VALUES are the whole contract. Traced predicate
+    → ``lax.cond`` over the two return values (same pytree structure
+    required, like the reference's RETURN-transformer path in
+    dygraph_to_static/return_transformer.py)."""
+    if not _is_traced(pred):
+        return true_fn() if _to_bool(pred) else false_fn()
+
+    templates = {}
+    _is_tensor = lambda v: isinstance(v, Tensor)
+
+    def _branch(fn, key):
+        def inner(_):
+            try:
+                out = fn()
+            except TypeError as ue:
+                raise _BranchError(ue) from ue
+            templates[key] = out
+            leaves, _ = jax.tree_util.tree_flatten(out, is_leaf=_is_tensor)
+            return tuple(jnp.asarray(_raw(v)) for v in leaves)
+        return inner
+
+    msg = ("to_static: a Tensor-condition `if` where both paths RETURN "
+           "must return the same structure (shapes/dtypes/pytree) on "
+           "both sides.")
+    kept = _cond_dispatch(pred, _branch(true_fn, "t"),
+                          _branch(false_fn, "f"), msg)
+    # equal LEAF structure got past lax.cond; the PYTREE structure
+    # (tuple-vs-list, grouping) must match too — silently imposing the
+    # true branch's shape would be wrong data, not an error
+    td_t = jax.tree_util.tree_structure(templates["t"], is_leaf=_is_tensor)
+    td_f = jax.tree_util.tree_structure(templates["f"], is_leaf=_is_tensor)
+    if td_t != td_f:
+        raise InvalidArgumentError(msg + f" (true branch returns {td_t}, "
+                                         f"false branch {td_f})")
+    tmpl = templates["t"]
+    leaves, treedef = jax.tree_util.tree_flatten(tmpl, is_leaf=_is_tensor)
+    rebuilt = [_wrap_like(t, k) for t, k in zip(leaves, kept)]
+    return jax.tree_util.tree_unflatten(treedef, rebuilt)
 
 
 def convert_while(test_fn, body_fn, init, names: Sequence[str],
@@ -561,6 +617,75 @@ def _has_early_exit(stmts) -> bool:
     return False
 
 
+def _has_loop_exit_or_yield(stmts) -> bool:
+    """UNSCOPED break/continue (i.e. belonging to a loop OUTSIDE these
+    statements) or any yield in scope. A break/continue inside a loop
+    that is itself part of ``stmts`` exits only that inner loop —
+    absorbing such statements into an else-branch stays
+    semantics-preserving."""
+    def check(node, in_loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return False
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
+            return True
+        enter_loop = in_loop or isinstance(node, (ast.While, ast.For,
+                                                  ast.AsyncFor))
+        return any(check(ch, enter_loop)
+                   for ch in ast.iter_child_nodes(node))
+    return any(check(s, False) for s in stmts)
+
+
+def _ends_in_return(stmts) -> bool:
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_ends_in_return(last.body)
+                and _ends_in_return(last.orelse))
+    return False
+
+
+def _normalize_tail_returns(stmts):
+    """Single-exit normalization (the reference's return_transformer
+    idea, scoped to the tail-return pattern): an ``if`` whose body ends
+    in ``return`` absorbs the REMAINDER of the statement list as its
+    else-branch, so both paths return and the `if` becomes a pure
+    value choice. Applied only OUTSIDE loops (inside a loop the
+    remainder of the body does not end the iteration's scope)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            body = _normalize_tail_returns(s.body)
+            rest = stmts[idx + 1:]
+            if (_ends_in_return(body)
+                    and not _has_loop_exit_or_yield(body)
+                    and not _has_loop_exit_or_yield(s.orelse)
+                    and not _has_loop_exit_or_yield(rest)):
+                # merge the RAW orelse with the remainder FIRST, then
+                # normalize the combined list — normalizing the orelse
+                # alone would close an elif's fall-through path with a
+                # premature bare `return`
+                merged = _normalize_tail_returns(list(s.orelse) + rest)
+                if not _ends_in_return(merged):
+                    merged = merged + [ast.Return(value=None)]
+                new_if = ast.If(test=s.test, body=body, orelse=merged)
+                ast.copy_location(new_if, s)
+                ast.fix_missing_locations(new_if)
+                out.append(new_if)
+                return out
+            s = ast.If(test=s.test, body=body,
+                       orelse=_normalize_tail_returns(s.orelse))
+            ast.copy_location(s, stmts[idx])
+            ast.fix_missing_locations(s)
+        out.append(s)
+    return out
+
+
 def _load(name):
     return ast.Name(id=name, ctx=ast.Load())
 
@@ -665,6 +790,38 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_If(self, node):
         self.generic_visit(node)
+        # ALL-PATHS-RETURN form (produced by _normalize_tail_returns or
+        # written directly): both branches end the function, so the `if`
+        # is a pure choice of return value — emit nullary branch
+        # closures over the current locals and dispatch through
+        # convert_ifelse_return (concrete → plain call, traced →
+        # lax.cond over the return values).
+        if (_ends_in_return(node.body) and node.orelse
+                and _ends_in_return(node.orelse)
+                and not _has_loop_exit_or_yield(node.body)
+                and not _has_loop_exit_or_yield(node.orelse)
+                and not _has_walrus(node.test)):
+            self.counter += 1
+            i = self.counter
+            t_name, f_name = f"{_H}_rett_{i}", f"{_H}_retf_{i}"
+            empty = ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                  kwonlyargs=[], kw_defaults=[],
+                                  kwarg=None, defaults=[])
+            defs = [ast.FunctionDef(name=t_name, args=empty,
+                                    body=node.body, decorator_list=[],
+                                    returns=None, type_params=[]),
+                    ast.FunctionDef(name=f_name, args=empty,
+                                    body=node.orelse, decorator_list=[],
+                                    returns=None, type_params=[])]
+            ret = ast.Return(value=ast.Call(
+                func=_load(f"{_H}_ifret"),
+                args=[node.test, _load(t_name), _load(f_name)],
+                keywords=[]))
+            out = defs + [ret]
+            for n in out:
+                ast.copy_location(n, node)
+                ast.fix_missing_locations(n)
+            return out
         if _has_early_exit(node.body) or _has_early_exit(node.orelse):
             return node
         if _defines_scope(node.body + node.orelse):
@@ -825,6 +982,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
 
 _HELPERS = {
+    f"{_H}_ifret": convert_ifelse_return,
     f"{_H}_ifelse": convert_ifelse,
     f"{_H}_while": convert_while,
     f"{_H}_and": convert_logical_and,
@@ -881,6 +1039,10 @@ def convert_control_flow(fn: Callable) -> Callable:
 
     transformer = _ControlFlowTransformer()
     fdef.decorator_list = []  # do not re-apply @to_static on exec
+    # single-exit normalization first: early-return `if`s absorb the
+    # rest of the function as their else-branch (semantics-preserving
+    # for plain Python; enables the traced all-paths-return conversion)
+    fdef.body = _normalize_tail_returns(fdef.body)
     new_body = []
     for stmt in fdef.body:
         res = transformer.visit(stmt)

@@ -634,3 +634,146 @@ class TestConvertCall:
         finally:
             sys.path.remove(str(tmp_path))
             sys.modules.pop("dy2s_usermod", None)
+
+
+class TestEarlyReturn:
+    """RETURN transformer (r4): an `if` whose paths all return becomes a
+    lax.cond over the return values (reference
+    dygraph_to_static/return_transformer.py)."""
+
+    def test_tensor_condition_early_return(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        pos = np.ones(3, np.float32)
+        neg = -np.ones(3, np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_tensor(pos)).numpy()),
+                                   pos * 2)
+        np.testing.assert_allclose(np.asarray(f(to_tensor(neg)).numpy()),
+                                   neg - 1)
+
+    def test_early_return_with_statements_after(self):
+        @to_static
+        def f(x):
+            y = x + 1.0
+            if y.sum() > 10.0:
+                z = y * 3.0
+                return z
+            w = y * 2.0
+            w = w + 0.5
+            return w
+
+        small = np.zeros(3, np.float32)
+        big = np.full(3, 10.0, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f(to_tensor(small)).numpy()), 2.5)
+        np.testing.assert_allclose(
+            np.asarray(f(to_tensor(big)).numpy()), 33.0)
+
+    def test_elif_chain_returns(self):
+        @to_static
+        def f(x):
+            if x.sum() > 10.0:
+                return x * 0.0 + 3.0
+            elif x.sum() > 0.0:
+                return x * 0.0 + 2.0
+            return x * 0.0 + 1.0
+
+        for fill, expect in ((20.0, 3.0), (1.0, 2.0), (-5.0, 1.0)):
+            out = f(to_tensor(np.full(2, fill, np.float32)))
+            np.testing.assert_allclose(np.asarray(out.numpy()), expect)
+
+    def test_early_return_differentiable(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return (x * 3.0).sum()
+            return (x * 5.0).sum()
+
+        x = to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        f(x).backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), 3.0)
+
+    def test_tuple_returns_match(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0, x + 1.0
+            return x * 4.0, x - 1.0
+
+        a, b = f(to_tensor(-np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(a.numpy()), -4.0)
+        np.testing.assert_allclose(np.asarray(b.numpy()), -2.0)
+
+    def test_mismatched_structures_teach(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x, x
+            return x
+
+        with pytest.raises(InvalidArgumentError, match="same structure"):
+            f(to_tensor(np.ones(2, np.float32)))
+
+    def test_implicit_none_fallthrough_teaches(self):
+        # `if t: return x` with nothing after: the implicit fall-off
+        # returns None — a structure mismatch under a traced condition,
+        # surfaced as the teaching error (not silent wrong values)
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x
+
+        with pytest.raises(InvalidArgumentError, match="same structure"):
+            f(to_tensor(np.ones(2, np.float32)))
+
+    def test_plain_python_unchanged(self):
+        # a CONCRETE condition (closure constant — an argument bool
+        # would be traced by jit) keeps exact Python semantics incl.
+        # side effects only on the taken path
+        calls = []
+        flag = True
+
+        @to_static
+        def f(x):
+            if flag:
+                calls.append("t")
+                return x + 1
+            calls.append("f")
+            return x - 1
+
+        assert float(f(to_tensor(np.float32(1.0))).numpy()) == 2.0
+        assert calls == ["t"]
+
+    def test_treedef_mismatch_with_equal_leaves_teaches(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x, (x, x)
+            return (x, x), x
+
+        with pytest.raises(InvalidArgumentError, match="same structure"):
+            f(to_tensor(np.ones(2, np.float32)))
+
+    def test_early_return_before_loop_with_break_converts(self):
+        # the break belongs to the inner for-loop; absorbing the loop
+        # into the else branch is safe and must not block conversion
+        @to_static
+        def f(x):
+            if x.sum() > 100.0:
+                return x * 0.0
+            acc = x * 0.0
+            for i in range(3):
+                acc = acc + x
+                if i == 1:
+                    break
+            return acc
+
+        out = f(to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 2.0)
+        big = f(to_tensor(np.full(2, 100.0, np.float32)))
+        np.testing.assert_allclose(np.asarray(big.numpy()), 0.0)
