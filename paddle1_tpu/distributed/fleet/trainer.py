@@ -32,7 +32,8 @@ import numpy as np
 
 from ...core.errors import InvalidArgumentError
 
-__all__ = ["HogwildWorker", "MultiTrainer"]
+__all__ = ["HogwildWorker", "MultiTrainer", "TrainerDesc",
+           "DeviceWorkerDesc", "create_trainer"]
 
 
 def _batched(sample_iter: Iterable, batch_size: int, collate: Callable):
@@ -136,3 +137,51 @@ class MultiTrainer:
                   f"{self.thread_num} workers, mean loss "
                   f"{out['loss_mean']:.6f}")
         return out
+
+
+class DeviceWorkerDesc:
+    """Which worker runs each slot of the trainer (reference
+    trainer_desc.proto DeviceWorkerDesc / device_worker_factory.cc).
+    ``hogwild`` → shared-memory async workers; ``section`` (pipeline)
+    maps to meta_parallel.PipelineParallel and is routed there."""
+
+    KINDS = ("hogwild", "section")
+
+    def __init__(self, kind: str = "hogwild"):
+        if kind not in self.KINDS:
+            raise InvalidArgumentError(
+                f"device worker {kind!r}; available: {self.KINDS} "
+                "(DownpourSV/PSGPU map onto hogwild + the PS tables; "
+                "heter workers have no TPU meaning)")
+        self.kind = kind
+
+
+class TrainerDesc:
+    """Trainer configuration (reference framework/trainer_desc.proto +
+    trainer_factory.cc): picks the trainer family and its concurrency.
+    ``thread_num`` → GIL-sharing thread workers (MultiTrainer);
+    ``process_num`` → real process workers over the shm arena
+    (ProcessMultiTrainer — the HogwildWorker-throughput form)."""
+
+    def __init__(self, thread_num: int = 1, process_num: int = 0,
+                 device_worker: "DeviceWorkerDesc" = None,
+                 publish_interval: int = 4):
+        self.thread_num = int(thread_num)
+        self.process_num = int(process_num)
+        self.device_worker = device_worker or DeviceWorkerDesc()
+        self.publish_interval = int(publish_interval)
+
+
+def create_trainer(desc: TrainerDesc):
+    """trainer_factory.cc analog: desc → trainer instance."""
+    if desc.device_worker.kind == "section":
+        raise InvalidArgumentError(
+            "section (pipeline) workers: build the model with "
+            "meta_parallel.PipelineLayer and train with "
+            "PipelineParallel.train_batch (the 1F1B schedule), or use "
+            "ParallelEngine(pp=...) for the in-graph form")
+    if desc.process_num and desc.process_num > 0:
+        from .process_trainer import ProcessMultiTrainer
+        return ProcessMultiTrainer(process_num=desc.process_num,
+                                   publish_interval=desc.publish_interval)
+    return MultiTrainer(thread_num=max(desc.thread_num, 1))

@@ -208,3 +208,25 @@ class TestExecutorEntry:
             Executor().train_from_dataset(dataset=[1, 2])
         with _pytest.raises(InvalidArgumentError, match="picklable"):
             Executor().train_from_dataset(dataset=[1], process_num=2)
+
+
+class TestTrainerDesc:
+    """TrainerDesc/DeviceWorkerDesc factory parity (reference
+    trainer_desc.proto + trainer_factory.cc)."""
+
+    def test_routes_by_desc(self):
+        import paddle1_tpu.distributed.fleet as fleet
+        t = fleet.create_trainer(fleet.TrainerDesc(thread_num=3))
+        assert isinstance(t, fleet.MultiTrainer) and t.thread_num == 3
+        p = fleet.create_trainer(fleet.TrainerDesc(process_num=2))
+        assert isinstance(p, fleet.ProcessMultiTrainer)
+        assert p.process_num == 2
+
+    def test_bad_worker_kind_teaches(self):
+        import paddle1_tpu.distributed.fleet as fleet
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="hogwild"):
+            fleet.DeviceWorkerDesc("heter")
+        with pytest.raises(InvalidArgumentError, match="PipelineParallel"):
+            fleet.create_trainer(fleet.TrainerDesc(
+                device_worker=fleet.DeviceWorkerDesc("section")))
