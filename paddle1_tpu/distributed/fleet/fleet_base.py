@@ -183,6 +183,88 @@ class Fleet:
     def stop_worker(self):
         pass
 
+    def save_inference_model(self, executor=None, dirname: str = None,
+                             feeded_var_names=None, target_vars=None,
+                             main_program=None, export_for_deployment=True,
+                             *, model=None, input_spec=None):
+        """PS/collective checkpoint of the serving program (reference
+        fleet save_inference_model → jit.save artifact here). Rank 0
+        writes; other workers no-op (the reference gates on
+        is_first_worker the same way)."""
+        self._ensure_init()
+        if not self.is_first_worker():
+            return
+        if model is None or input_spec is None or dirname is None:
+            raise PreconditionNotMetError(
+                "fleet.save_inference_model(dirname=..., model=..., "
+                "input_spec=[InputSpec(...)]) — the StableHLO artifact "
+                "needs the Layer and its input shapes (the reference "
+                "read them from the feed/fetch vars of a Program)")
+        import os as _os
+        from ... import jit as _jit
+        _jit.save(model, _os.path.join(dirname, "model"),
+                  input_spec=input_spec)
+
+    def _ps_shard_id(self) -> int:
+        """This node's shard identity for table checkpoints. Servers are
+        launched with PADDLE_SERVER_ID (launch_utils PS mode), NOT a
+        trainer rank — worker_index() is 0 on every server, so keying
+        shards on it would make all servers collide on one file."""
+        import os as _os
+        sid = _os.environ.get("PADDLE_SERVER_ID")
+        return int(sid) if sid is not None else self.worker_index()
+
+    def save_persistables(self, executor=None, dirname: str = None,
+                          main_program=None, mode: int = 0, *,
+                          model=None):
+        """Persist trainable state + this node's PS tables (reference
+        fleet save_persistables: dense vars + the server's table
+        shards). Dense params write from worker rank 0; each SERVER
+        writes its own ps_shard_<server_id> file."""
+        self._ensure_init()
+        if dirname is None:
+            raise PreconditionNotMetError(
+                "fleet.save_persistables needs dirname=")
+        import os as _os
+        _os.makedirs(dirname, exist_ok=True)
+        from ...framework.io import save as _fsave
+        table = getattr(self, "_server_table", None)
+        if (model is not None and self.is_first_worker()
+                and table is None):
+            _fsave(model.state_dict(),
+                   _os.path.join(dirname, "dense.pdparams"))
+        if table is not None:
+            states = {"sparse": table.state_dict(),
+                      "dense_tables": {
+                          n: t.state_dict()
+                          for n, t in getattr(self, "_server_dense",
+                                              {}).items()}}
+            _fsave(states, _os.path.join(
+                dirname, f"ps_shard_{self._ps_shard_id()}.pkl"))
+
+    def load_persistables(self, executor=None, dirname: str = None,
+                          main_program=None, mode: int = 0, *,
+                          model=None):
+        """Restore what save_persistables wrote (this node's view)."""
+        self._ensure_init()
+        if dirname is None:
+            raise PreconditionNotMetError(
+                "fleet.load_persistables needs dirname=")
+        import os as _os
+        from ...framework.io import load as _fload
+        dense = _os.path.join(dirname, "dense.pdparams")
+        if model is not None and _os.path.exists(dense):
+            model.set_state_dict(_fload(dense))
+        shard = _os.path.join(dirname,
+                              f"ps_shard_{self._ps_shard_id()}.pkl")
+        table = getattr(self, "_server_table", None)
+        if table is not None and _os.path.exists(shard):
+            states = _fload(shard, return_numpy=True)
+            table.load_state_dict(states["sparse"])
+            for n, sd in states.get("dense_tables", {}).items():
+                if n in getattr(self, "_server_dense", {}):
+                    self._server_dense[n].load_state_dict(sd)
+
     # -- the distributed wrappers ------------------------------------------
 
     @property
