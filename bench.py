@@ -69,7 +69,7 @@ def _probe_tpu(timeout_s: int = None, attempts: int = None) -> bool:
     timeout_s = timeout_s if timeout_s is not None else int(
         os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
     attempts = attempts if attempts is not None else int(
-        os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+        os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
     for i in range(max(attempts, 1)):
         if i:
             backoff = min(20 * i, 60)
