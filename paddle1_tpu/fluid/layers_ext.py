@@ -89,6 +89,10 @@ __all__ = [
     "sequence_conv", "sequence_erase", "sequence_reshape",
     "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
     "Print", "Assert", "case", "switch_case", "double_buffer",
+    "gather_tree", "add_position_encoding", "affine_channel",
+    "autoincreased_step_counter", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "chunk_eval", "polygon_box_transform",
+    "RNNCell",
     "hsigmoid", "bilinear_tensor_product", "fsp_matrix", "row_conv",
     "im2sequence", "center_loss", "sampling_id",
     "teacher_student_sigmoid_loss", "anchor_generator",
@@ -1504,3 +1508,201 @@ def density_prior_box(input, image=None, densities=None,
             return out.reshape(-1, 4), var.reshape(-1, 4)
         return out, var
     return _apply("density_prior_box", f, (x,), n_outputs=2)
+
+
+# -- tier 5: decode/misc long tail -------------------------------------------
+
+def gather_tree(ids, parents):
+    """Back-trace beam-search parent pointers into full sequences
+    (reference gather_tree_op / paddle.nn.functional.gather_tree):
+    ids/parents [T, B, beam] → sequences aligned per final beam."""
+    from ..autograd.engine import apply as _apply
+    import jax
+    import jax.numpy as jnp
+
+    def f(ids, parents):
+        T = ids.shape[0]
+
+        def step(beam_idx, t):
+            # walking backwards: select ids at the CURRENT beam index,
+            # then hop to that beam's parent
+            sel = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+            par = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+            return par, sel
+        init = jnp.broadcast_to(jnp.arange(ids.shape[-1]),
+                                ids.shape[1:]).astype(ids.dtype)
+        _, out = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return out[::-1]
+    return _apply("gather_tree", f, (_t(ids), _t(parents)))
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding added to [B, T, D] (reference
+    add_position_encoding_op): out = alpha*x + beta*PE."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+
+    def f(x):
+        B, T, D = x.shape
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        half = D // 2
+        # reference add_position_encoding_op.h: divisor exponent is
+        # k/(half-1) (and pos/10000 for the degenerate half==1)
+        if half > 1:
+            div = jnp.power(10000.0,
+                            jnp.arange(half, dtype=jnp.float32)
+                            / (half - 1))
+        else:
+            div = jnp.full((half,), 10000.0, jnp.float32)
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        if pe.shape[-1] < D:
+            pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[-1])))
+        return alpha * x + beta * pe[None].astype(x.dtype)
+    return _apply("add_position_encoding", f, (_t(input),))
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   act=None, name=None):
+    """Per-channel affine with FIXED (non-learned) scale/bias (reference
+    affine_channel_op — frozen-BN folding in detection models)."""
+    xt = _t(x)
+    c_axis = 1 if data_layout == "NCHW" else -1
+    shape = [1] * xt.ndim
+    shape[c_axis] = xt.shape[c_axis]
+    out = xt
+    if scale is not None:
+        out = out * _manip.reshape(_t(scale), shape)
+    if bias is not None:
+        out = out + _manip.reshape(_t(bias), shape)
+    return getattr(F, act)(out) if act else out
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Per-name monotone counter (reference layers/nn.py
+    autoincreased_step_counter — the global_step idiom)."""
+    key = counter_name or "@STEP_COUNTER@"
+    v = _step_counters.get(key, begin - step) + step
+    _step_counters[key] = v
+    return to_tensor(np.asarray([v], np.int64))
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """IndexedSlices (the SelectedRows analog) → its [n_rows, dim]
+    VALUES tensor (reference get_tensor_from_selected_rows_op returns
+    the rows' values as-is, NOT a zero-filled dense scatter)."""
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(x, IndexedSlices):
+        return to_tensor(x.values)
+    return _t(x)
+
+
+def merge_selected_rows(x, name=None):
+    """Merge duplicate rows of an IndexedSlices (reference
+    merge_selected_rows_op — the grad-merge before an SGD sparse
+    update)."""
+    from ..core.indexed_slices import IndexedSlices
+    if isinstance(x, IndexedSlices):
+        return x.merge()
+    return _t(x)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    chunk_eval_op; IOB/IOE/IOBES schemes). Host computation — returns
+    (precision, recall, f1, num_infer, num_label, num_correct) like the
+    reference's six outputs."""
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"chunk_scheme {chunk_scheme!r}; "
+                         f"available {sorted(schemes)}")
+    tag_num = schemes[chunk_scheme]
+    excluded = set(excluded_chunk_types or [])
+
+    def extract(seq):
+        """tag id -> (chunk_type, position-in-scheme); chunks as
+        (start, end, type) triples. Begin/end rules per reference
+        chunk_eval_op.h ChunkBegin/ChunkEnd: IOB begins on B; IOE ends
+        on E; IOBES begins on B|S and ends on E|S."""
+        chunks, start, ctype = [], None, None
+        for i, t in enumerate(seq):
+            t = int(t)
+            if t == tag_num * num_chunk_types:  # the O tag
+                if start is not None:
+                    chunks.append((start, i, ctype))
+                    start = None
+                continue
+            typ, pos = divmod(t, tag_num)
+            begin = ((chunk_scheme == "IOB" and pos == 0)
+                     or (chunk_scheme == "IOBES" and pos in (0, 3)))
+            if start is not None and (begin or typ != ctype):
+                chunks.append((start, i, ctype))
+                start = None
+            if start is None:
+                start, ctype = i, typ
+            end = ((chunk_scheme == "IOE" and pos == 1)
+                   or (chunk_scheme == "IOBES" and pos in (2, 3)))
+            if end:
+                chunks.append((start, i + 1, ctype))
+                start = None
+        if start is not None:
+            chunks.append((start, len(seq), ctype))
+        return {c for c in chunks if c[2] not in excluded}
+
+    inf = np.atleast_2d(np.asarray(_t(input).numpy()))
+    inf = inf.reshape(inf.shape[0], -1)
+    lab = np.asarray(_t(label).numpy()).reshape(inf.shape)
+    lens = (np.asarray(_t(seq_length).numpy()).reshape(-1)
+            if seq_length is not None
+            else np.full(inf.shape[0], inf.shape[1], np.int64))
+    n_inf = n_lab = n_cor = 0
+    for b in _bi.range(inf.shape[0]):
+        ci = extract(inf[b][:lens[b]])
+        cl = extract(lab[b][:lens[b]])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mk = lambda v, dt=np.float32: to_tensor(np.asarray([v], dt))
+    return (mk(prec), mk(rec), mk(f1), mk(n_inf, np.int64),
+            mk(n_lab, np.int64), mk(n_cor, np.int64))
+
+
+def polygon_box_transform(input, name=None):
+    """Quad-vertex offset map → absolute coordinates (reference
+    polygon_box_transform_op, EAST-style text detection): channel 2k is
+    an x-offset added to 4*col, channel 2k+1 a y-offset added to
+    4*row."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+
+    def f(x):
+        N, C, H, W = x.shape
+        xs = jnp.arange(W, dtype=x.dtype)[None, None, None, :] * 4
+        ys = jnp.arange(H, dtype=x.dtype)[None, None, :, None] * 4
+        is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+        return jnp.where(is_x, xs - x, ys - x)
+    return _apply("polygon_box_transform", f, (_t(input),))
+
+
+class RNNCell:  # noqa: N801 — fluid name
+    """Abstract cell base (reference rnn.py:62) — the working base here
+    is paddle1_tpu.nn.RNNCellBase; both constructing AND subclassing
+    this stub teach that."""
+
+    _MSG = ("fluid.layers.RNNCell: subclass paddle1_tpu.nn.RNNCellBase "
+            "instead (or use GRUCell/LSTMCell here)")
+
+    def __init__(self, *a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(self._MSG)
+
+    def __init_subclass__(cls, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(RNNCell._MSG)

@@ -467,3 +467,91 @@ class TestTier4:
                 for pp in lay.parameters():
                     if tuple(pp.shape) == (2, 3):
                         assert pp.grad is None
+
+
+class TestTier5:
+    def test_gather_tree_backtrace(self):
+        # T=3, B=1, beam=2; parent pointers trace the winning path
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+        out = np.asarray(L.gather_tree(to_tensor(ids),
+                                       to_tensor(parents)).numpy())
+        # beam 0 at t=2 came from parent 1 at t=1 (which came from 0)
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 4, 6), np.float32)
+        out = np.asarray(L.add_position_encoding(
+            to_tensor(x), alpha=1.0, beta=1.0).numpy())
+        np.testing.assert_allclose(out[0, 0, :3], 0.0, atol=1e-6)  # sin 0
+        np.testing.assert_allclose(out[0, 0, 3:], 1.0, atol=1e-6)  # cos 0
+        assert abs(out[0, 1, 0] - np.sin(1.0)) < 1e-5
+
+    def test_affine_channel(self):
+        x = to_tensor(np.ones((1, 2, 2, 2), np.float32))
+        out = L.affine_channel(x, scale=np.array([2.0, 3.0], np.float32),
+                               bias=np.array([1.0, 0.0], np.float32))
+        o = np.asarray(out.numpy())
+        assert o[0, 0, 0, 0] == 3.0 and o[0, 1, 0, 0] == 3.0
+
+    def test_step_counter_increments(self):
+        a = int(L.autoincreased_step_counter("t5c").numpy()[0])
+        b = int(L.autoincreased_step_counter("t5c").numpy()[0])
+        assert b == a + 1
+
+    def test_selected_rows_bridges(self):
+        from paddle1_tpu.core.indexed_slices import IndexedSlices
+        import jax.numpy as jnp
+        s = IndexedSlices(jnp.asarray([0, 0], jnp.int32),
+                          jnp.ones((2, 3)), (4, 3))
+        merged = L.merge_selected_rows(s)
+        rows = L.get_tensor_from_selected_rows(merged)
+        vals = np.asarray(rows.numpy())
+        # reference semantics: the VALUES tensor [n_rows, dim], not a
+        # zero-filled dense scatter
+        assert vals.shape == (1, 3)
+        np.testing.assert_allclose(vals[0], 2.0)  # duplicate rows merged
+
+    def test_chunk_eval_iob(self):
+        # 2 chunk types, IOB: tags B0=0 I0=1 B1=2 I1=3 O=4
+        label = np.array([[0, 1, 4, 2, 3]], np.int64)
+        pred = np.array([[0, 1, 4, 2, 4]], np.int64)
+        p, r, f1, ni, nl, nc = L.chunk_eval(
+            to_tensor(pred), to_tensor(label), "IOB", 2)
+        assert int(nl.numpy()[0]) == 2
+        assert int(ni.numpy()[0]) == 2
+        assert int(nc.numpy()[0]) == 1          # chunk (0,2,type0) only
+        assert abs(float(p.numpy()[0]) - 0.5) < 1e-6
+        assert abs(float(f1.numpy()[0]) - 0.5) < 1e-6
+
+    def test_polygon_box_transform_offsets(self):
+        x = to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+        out = np.asarray(L.polygon_box_transform(x).numpy())
+        # zero offsets -> absolute grid coords (x: 4*col, y: 4*row)
+        np.testing.assert_allclose(out[0, 0], [[0, 4], [0, 4]])
+        np.testing.assert_allclose(out[0, 1], [[0, 0], [4, 4]])
+
+    def test_chunk_eval_iobes_malformed(self):
+        # B0 E0 I0: E closes (0..2); the dangling I opens (2..3)
+        # (reference ChunkEnd on E even for non-canonical sequences)
+        # tags: B0=0 I0=1 E0=2 S0=3, O=8 (2 types x 4)
+        pred = np.array([[0, 2, 1]], np.int64)
+        label = np.array([[0, 2, 1]], np.int64)
+        p, r, f1, ni, nl, nc = L.chunk_eval(
+            to_tensor(pred), to_tensor(label), "IOBES", 2)
+        assert int(ni.numpy()[0]) == 2
+        assert int(nc.numpy()[0]) == 2
+        assert float(f1.numpy()[0]) == 1.0
+
+    def test_add_position_encoding_reference_divisor(self):
+        x = np.zeros((1, 2, 6), np.float32)
+        out = np.asarray(L.add_position_encoding(to_tensor(x)).numpy())
+        # k=1 divisor is 10000^(1/(half-1)) = 10000^0.5 for half=3
+        assert abs(out[0, 1, 1] - np.sin(1.0 / 10000 ** 0.5)) < 1e-6
+
+    def test_rnncell_teaches_on_subclass(self):
+        from paddle1_tpu.core.errors import UnimplementedError
+        with pytest.raises(UnimplementedError, match="RNNCellBase"):
+            class _C(L.RNNCell):
+                pass
