@@ -49,7 +49,7 @@ class TestNamespaceSweep:
                 teaching.append(n)
                 assert n in str(e), f"teaching error must name {n}"
         assert len(ref) >= 300            # surface didn't shrink
-        assert len(mapped) >= 200, (len(mapped),
+        assert len(mapped) >= 230, (len(mapped),
                                     "tier-2 mapping regressed")
         # the tier-2 groups are all mapped
         for n in """elementwise_max logical_and reduce_prod ones eye
