@@ -75,7 +75,8 @@ __all__ = [
     "layer_norm", "group_norm", "instance_norm", "lrn",
     "conv2d_transpose", "conv3d", "pool3d", "adaptive_pool2d",
     "image_resize", "resize_bilinear", "resize_nearest",
-    "resize_trilinear", "pixel_shuffle", "grid_sampler", "affine_grid",
+    "resize_trilinear", "resize_linear", "image_resize_short",
+    "lod_reset", "lod_append", "pixel_shuffle", "grid_sampler", "affine_grid",
     "unfold", "temporal_shift",
     # detection (vision.ops)
     "yolo_box", "yolov3_loss", "multiclass_nms", "matrix_nms",
@@ -1706,3 +1707,44 @@ class RNNCell:  # noqa: N801 — fluid name
     def __init_subclass__(cls, **k):
         from ..core.errors import UnimplementedError
         raise UnimplementedError(RNNCell._MSG)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    """1-D linear interpolation (reference resize_linear)."""
+    return F.interpolate(_t(input), size=out_shape, scale_factor=scale,
+                         mode="linear", align_corners=align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize keeping aspect ratio so the SHORT side equals
+    out_short_len (reference image_resize_short)."""
+    x = _t(input)
+    h, w = x.shape[-2], x.shape[-1]
+    short, long_ = (h, w) if h <= w else (w, h)
+    new_long = int(out_short_len * long_ / short)
+    out_shape = ([out_short_len, new_long] if h <= w
+                 else [new_long, out_short_len])
+    return image_resize(x, out_shape=out_shape, resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """LoD carried as explicit lengths in this build: returns
+    (x, new_lengths) — the lengths REPLACE the old partition (reference
+    lod_reset_op semantics on the dense+lengths representation)."""
+    if y is not None:
+        lengths = y if not isinstance(y, Tensor) else y
+        return _t(x), _t(lengths)
+    if target_lod is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError("lod_reset needs y= or target_lod= "
+                                   "(the new row lengths)")
+    return _t(x), to_tensor(np.asarray(target_lod, np.int64))
+
+
+def lod_append(x, level):
+    """Append a deeper partition level. The dense+lengths world carries
+    ONE level; the appended level is returned alongside for the caller
+    to thread (reference lod_append on the LoD stack)."""
+    return _t(x), to_tensor(np.asarray(level, np.int64))

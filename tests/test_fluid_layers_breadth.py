@@ -555,3 +555,13 @@ class TestTier5:
         with pytest.raises(UnimplementedError, match="RNNCellBase"):
             class _C(L.RNNCell):
                 pass
+
+    def test_resize_short_and_linear_and_lod(self):
+        img = to_tensor(np.zeros((1, 3, 8, 16), np.float32))
+        out = L.image_resize_short(img, 4)
+        assert out.shape == [1, 3, 4, 8]
+        seq = to_tensor(np.zeros((1, 3, 6), np.float32))
+        assert L.resize_linear(seq, out_shape=[12]).shape == [1, 3, 12]
+        x, lens = L.lod_reset(to_tensor(np.zeros((2, 3), np.float32)),
+                              target_lod=[2, 1])
+        assert np.asarray(lens.numpy()).tolist() == [2, 1]
