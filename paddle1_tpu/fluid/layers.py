@@ -425,8 +425,6 @@ _MODERN = {
     "gru_unit": "paddle1_tpu.nn.GRUCell",
     "py_func": "plain Python (eager) or a custom op via "
                "paddle1_tpu.utils.cpp_extension",
-    "beam_search": "paddle1_tpu.text (decode loops are lax.while_loop "
-                   "via static.nn.while_loop)",
 }
 
 

@@ -90,6 +90,7 @@ __all__ = [
     "sequence_conv", "sequence_erase", "sequence_reshape",
     "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
     "Print", "Assert", "case", "switch_case", "double_buffer",
+    "beam_search", "beam_search_decode",
     "gather_tree", "add_position_encoding", "affine_channel",
     "autoincreased_step_counter", "get_tensor_from_selected_rows",
     "merge_selected_rows", "chunk_eval", "polygon_box_transform",
@@ -1721,9 +1722,11 @@ def image_resize_short(input, out_short_len, resample="BILINEAR"):
     """Resize keeping aspect ratio so the SHORT side equals
     out_short_len (reference image_resize_short)."""
     x = _t(input)
+    if x.ndim != 4:
+        raise ValueError("image_resize_short expects a 4-D NCHW tensor")
     h, w = x.shape[-2], x.shape[-1]
     short, long_ = (h, w) if h <= w else (w, h)
-    new_long = int(out_short_len * long_ / short)
+    new_long = int(out_short_len * long_ / short + 0.5)  # reference rounds
     out_shape = ([out_short_len, new_long] if h <= w
                  else [new_long, out_short_len])
     return image_resize(x, out_shape=out_shape, resample=resample)
@@ -1734,8 +1737,9 @@ def lod_reset(x, y=None, target_lod=None):
     (x, new_lengths) — the lengths REPLACE the old partition (reference
     lod_reset_op semantics on the dense+lengths representation)."""
     if y is not None:
-        lengths = y if not isinstance(y, Tensor) else y
-        return _t(x), _t(lengths)
+        if not isinstance(y, Tensor):
+            y = to_tensor(np.asarray(y, np.int64))
+        return _t(x), y
     if target_lod is None:
         from ..core.errors import InvalidArgumentError
         raise InvalidArgumentError("lod_reset needs y= or target_lod= "
@@ -1748,3 +1752,92 @@ def lod_append(x, level):
     ONE level; the appended level is returned alongside for the caller
     to thread (reference lod_append on the LoD stack)."""
     return _t(x), to_tensor(np.asarray(level, np.int64))
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search step (reference beam_search_op) on the dense
+    representation: ``pre_ids``/``pre_scores`` [B*beam, 1],
+    ``scores`` [B*beam, V] (accumulated log-probs when
+    ``is_accumulated``, else per-step log-probs added to pre_scores).
+    Finished beams (pre_id == end_id) keep exactly one candidate — the
+    end token at their frozen score. Returns (selected_ids,
+    selected_scores[, parent_idx]) with [B*beam, 1] shapes."""
+    from ..autograd.engine import apply as _apply
+    import jax
+    import jax.numpy as jnp
+    pre_ids_t, pre_sc_t, sc_t = _t(pre_ids), _t(pre_scores), _t(scores)
+    V = sc_t.shape[-1]
+    total = sc_t.shape[0]
+    B = total // beam_size
+    pruned = ids is not None  # scores are topk-pruned: column j of row
+    # r is the candidate whose VOCAB id is ids[r, j] (the reference's
+    # canonical topk-then-beam_search usage)
+    ids_t = _t(ids) if pruned else None
+
+    def f(pid, psc, sc, *maybe_ids):
+        pid = pid.reshape(B, beam_size)
+        psc = psc.reshape(B, beam_size)
+        sc = sc.reshape(B, beam_size, V)
+        if not is_accumulated:
+            sc = psc[..., None] + sc
+        finished = pid == end_id
+        neg = jnp.finfo(sc.dtype).min
+        if pruned:
+            # finished beams survive through their column-0 slot at the
+            # frozen score (its token is forced to end_id below)
+            only = jnp.full((B, beam_size, V), neg, sc.dtype)
+            only = only.at[:, :, 0].set(psc)
+        else:
+            only = jnp.full((B, beam_size, V), neg, sc.dtype)
+            only = only.at[:, :, end_id].set(psc)
+        sc = jnp.where(finished[..., None], only, sc)
+        flat = sc.reshape(B, beam_size * V)
+        top_sc, top_ix = jax.lax.top_k(flat, beam_size)
+        parent = (top_ix // V).astype(jnp.int64)
+        col = (top_ix % V).astype(jnp.int64)
+        if pruned:
+            cand = maybe_ids[0].reshape(B, beam_size, V)
+            token = jnp.take_along_axis(
+                cand[jnp.arange(B)[:, None], parent], col[..., None],
+                axis=-1)[..., 0].astype(jnp.int64)
+        else:
+            token = col
+        parent_finished = jnp.take_along_axis(finished, parent, axis=-1)
+        token = jnp.where(parent_finished, end_id, token)
+        return (token.reshape(-1, 1), top_sc.reshape(-1, 1),
+                parent.reshape(-1, 1))
+    args = (pre_ids_t, pre_sc_t, sc_t) + ((ids_t,) if pruned else ())
+    sel_ids, sel_sc, parent = _apply("beam_search", f, args,
+                                     n_outputs=3)
+    if return_parent_idx:
+        return sel_ids, sel_sc, parent
+    return sel_ids, sel_sc
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """Back-trace the per-step beam selections into final sequences
+    (reference beam_search_decode_op). Dense form: ``ids``/``parents``
+    stacked [T, B, beam] (parents from beam_search's
+    return_parent_idx); returns (sequences [T, B, beam],
+    final scores passthrough) with positions after each beam's end_id
+    filled with end_id."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    if parents is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "beam_search_decode needs parents= (the stacked parent_idx "
+            "from beam_search(..., return_parent_idx=True)) in the "
+            "dense world — the reference read them from the LoD")
+    seq = gather_tree(ids, parents)
+
+    def f(s):
+        # every position from the first end_id on becomes end_id
+        # (replacing the end marker itself is a no-op)
+        ended = jnp.cumsum((s == end_id).astype(jnp.int32), axis=0) >= 1
+        return jnp.where(ended, end_id, s)
+    return (_apply("beam_search_decode", f, (seq,)),
+            _t(scores) if scores is not None else None)

@@ -565,3 +565,73 @@ class TestTier5:
         x, lens = L.lod_reset(to_tensor(np.zeros((2, 3), np.float32)),
                               target_lod=[2, 1])
         assert np.asarray(lens.numpy()).tolist() == [2, 1]
+
+    def test_beam_search_step_and_decode(self):
+        B, beam, V, end = 1, 2, 5, 0
+        pre_ids = np.array([[3], [4]], np.int64)      # both alive
+        pre_sc = np.array([[-0.5], [-1.0]], np.float32)
+        # beam 0 strongly prefers token 2; beam 1 prefers token 1
+        acc = np.full((2, V), -10.0, np.float32)
+        acc[0, 2] = -0.6
+        acc[0, 1] = -0.9
+        acc[1, 1] = -1.1
+        ids, sc, par = L.beam_search(pre_ids, pre_sc, None, acc,
+                                     beam_size=beam, end_id=end,
+                                     return_parent_idx=True)
+        iv = np.asarray(ids.numpy()).reshape(-1)
+        pv = np.asarray(par.numpy()).reshape(-1)
+        assert iv.tolist() == [2, 1] and pv.tolist() == [0, 0]
+
+        # finished beam keeps exactly its end candidate
+        pre_ids2 = np.array([[0], [4]], np.int64)     # beam 0 finished
+        ids2, sc2 = L.beam_search(pre_ids2, pre_sc, None, acc,
+                                  beam_size=beam, end_id=end)
+        i2 = np.asarray(ids2.numpy()).reshape(-1)
+        s2 = np.asarray(sc2.numpy()).reshape(-1)
+        assert 0 in i2.tolist()
+        assert abs(s2[i2.tolist().index(0)] - (-0.5)) < 1e-6
+
+        # decode: T=2 steps of (ids, parents)
+        step_ids = np.array([[[2, 1]], [[0, 3]]], np.int64)
+        step_par = np.array([[[0, 0]], [[0, 1]]], np.int64)
+        seqs, _ = L.beam_search_decode(step_ids, None, beam, end,
+                                       parents=step_par)
+        sq = np.asarray(seqs.numpy())
+        assert sq[:, 0, 0].tolist() == [2, 0]   # ends at end_id
+        assert sq[:, 0, 1].tolist() == [1, 3]
+
+    def test_beam_search_pruned_ids_path(self):
+        # topk-pruned usage: scores [B*beam, K] with candidate vocab
+        # ids in `ids` — selected tokens must be VOCAB ids
+        pre_ids = np.array([[3], [4]], np.int64)
+        pre_sc = np.array([[-0.5], [-1.0]], np.float32)
+        cand_ids = np.array([[7, 9], [11, 13]], np.int64)   # K=2
+        cand_sc = np.array([[-0.6, -0.9], [-1.1, -5.0]], np.float32)
+        ids, sc, par = L.beam_search(pre_ids, pre_sc, cand_ids, cand_sc,
+                                     beam_size=2, end_id=0,
+                                     return_parent_idx=True)
+        assert np.asarray(ids.numpy()).reshape(-1).tolist() == [7, 9]
+        # finished beam in pruned mode: token forced to end_id
+        pre_ids2 = np.array([[0], [4]], np.int64)
+        ids2, _ = L.beam_search(pre_ids2, pre_sc, cand_ids, cand_sc,
+                                beam_size=2, end_id=0)
+        assert 0 in np.asarray(ids2.numpy()).reshape(-1).tolist()
+
+    def test_beam_decode_fills_after_end(self):
+        step_ids = np.array([[[5, 1]], [[0, 3]], [[7, 4]]], np.int64)
+        step_par = np.array([[[0, 0]], [[0, 1]], [[0, 1]]], np.int64)
+        seqs, _ = L.beam_search_decode(step_ids, None, 2, 0,
+                                       parents=step_par)
+        sq = np.asarray(seqs.numpy())
+        assert sq[:, 0, 0].tolist() == [5, 0, 0]  # 7 after end -> end
+
+    def test_image_resize_short_rounds(self):
+        img = to_tensor(np.zeros((1, 1, 4, 6), np.float32))
+        out = L.image_resize_short(img, 3)
+        assert out.shape == [1, 1, 3, 5]  # 6*3/4=4.5 -> rounds to 5
+
+    def test_lod_reset_y_dtype(self):
+        x = to_tensor(np.zeros((2, 3), np.float32))
+        _, l1 = L.lod_reset(x, y=[2, 1])
+        _, l2 = L.lod_reset(x, target_lod=[2, 1])
+        assert str(l1.dtype) == str(l2.dtype)
