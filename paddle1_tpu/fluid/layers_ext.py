@@ -90,7 +90,9 @@ __all__ = [
     "sequence_conv", "sequence_erase", "sequence_reshape",
     "sequence_scatter", "sequence_slice", "sequence_topk_avg_pooling",
     "Print", "Assert", "case", "switch_case", "double_buffer",
-    "beam_search", "beam_search_decode",
+    "beam_search", "beam_search_decode", "spectral_norm",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "lstm_unit", "hash", "target_assign",
     "gather_tree", "add_position_encoding", "affine_channel",
     "autoincreased_step_counter", "get_tensor_from_selected_rows",
     "merge_selected_rows", "chunk_eval", "polygon_box_transform",
@@ -1841,3 +1843,115 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None,
         return jnp.where(ended, end_id, s)
     return (_apply("beam_search_decode", f, (seq,)),
             _t(scores) if scores is not None else None)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization (reference
+    spectral_norm_op): the u/v vectors are implicit parameters of the
+    call site."""
+    w = _t(weight)
+    lay = _implicit_layer(
+        name, ("spectral_norm", tuple(w.shape), dim, power_iters),
+        lambda: _paddle.nn.SpectralNorm(list(w.shape), dim=dim,
+                                        power_iters=power_iters,
+                                        eps=eps))
+    return lay(w)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    """uniform_random with one dim copied from a reference tensor
+    (reference uniform_random_batch_size_like_op)."""
+    shape = list(shape)
+    shape[output_dim_idx] = _t(input).shape[input_dim_idx]
+    from ..ops.manip_ops import uniform as _uniform
+    return _uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = _t(input).shape[input_dim_idx]
+    from .layers import gaussian_random
+    return gaussian_random(shape, mean=mean, std=std, dtype=dtype)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step with implicit gate weights (reference
+    lstm_unit_op): gates = [x_t, h_prev] @ W + b with W
+    [D_x + D_h, 4*D_h]; returns (hidden, cell)."""
+    from ..autograd.engine import apply as _apply
+    import jax
+    import jax.numpy as jnp
+    x, h, c = _t(x_t), _t(hidden_t_prev), _t(cell_t_prev)
+    dx, dh = x.shape[-1], h.shape[-1]
+    lay = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("lstm_unit", dx, dh),
+        lambda: _paddle.nn.Linear(dx + dh, 4 * dh))
+    gates = lay(_manip.concat([x, h], axis=-1))
+
+    def f(g, c):
+        i, f_, ct, o = jnp.split(g, 4, axis=-1)
+        f_ = jax.nn.sigmoid(f_ + forget_bias)
+        i = jax.nn.sigmoid(i)
+        o = jax.nn.sigmoid(o)
+        new_c = f_ * c + i * jnp.tanh(ct)
+        return jnp.tanh(new_c) * o, new_c
+    hidden, cell = _apply("lstm_unit", f, (gates, c), n_outputs=2)
+    return hidden, cell
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
+    """Bucket integer ids by ``num_hash`` deterministic hashes into
+    [0, hash_size) (reference hash_op's xxhash-mod role — the exact
+    hash family differs, the contract of stable well-mixed buckets is
+    kept)."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+
+    def f(ids):
+        ids = ids.astype(jnp.uint32)
+        outs = []
+        for k in _bi.range(num_hash):
+            salt = (0x9E3779B9 * (k + 1)) & 0xFFFFFFFF
+            h = ids * jnp.uint32(2654435761) + jnp.uint32(salt)
+            h ^= h >> 16
+            h = h * jnp.uint32(0x85EBCA6B)
+            h ^= h >> 13
+            outs.append((h % jnp.uint32(hash_size)).astype(jnp.int64))
+        return jnp.stack(outs, axis=-1)
+    return _apply("hash", f, (_t(input),))
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Assign per-prior targets from matched entity rows (reference
+    target_assign_op, SSD training): out[i, j] = input[i,
+    matched[i, j]] where matched >= 0, else mismatch_value; weights are
+    1 for matched (and listed negatives), 0 otherwise. Returns (out,
+    out_weight)."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x, m = _t(input), _t(matched_indices)
+
+    def f(x, m):
+        B, P = m.shape
+        safe = jnp.clip(m, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, safe[..., None].repeat(x.shape[-1], -1), axis=1)
+        ok = (m >= 0)[..., None]
+        out = jnp.where(ok, gathered, mismatch_value)
+        w = ok.astype(x.dtype)
+        return out, w
+    out, w = _apply("target_assign", f, (x, m), n_outputs=2)
+    if negative_indices is not None:
+        import numpy as _np
+        wv = _np.asarray(w.numpy())
+        neg = _np.asarray(_t(negative_indices).numpy()).reshape(-1)
+        wv[:, neg] = 1.0
+        w = to_tensor(wv)
+    return out, w

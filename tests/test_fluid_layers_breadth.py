@@ -635,3 +635,49 @@ class TestTier5:
         _, l1 = L.lod_reset(x, y=[2, 1])
         _, l2 = L.lod_reset(x, target_lod=[2, 1])
         assert str(l1.dtype) == str(l2.dtype)
+
+
+class TestTier6:
+    def test_spectral_norm_unit_sigma(self):
+        w = np.random.default_rng(0).standard_normal(
+            (4, 6)).astype(np.float32) * 3.0
+        out = L.spectral_norm(to_tensor(w), power_iters=20)
+        o = np.asarray(out.numpy())
+        s = np.linalg.svd(o, compute_uv=False)[0]
+        assert abs(s - 1.0) < 0.05  # spectral radius normalized to ~1
+
+    def test_batch_size_like_randoms(self):
+        x = to_tensor(np.zeros((5, 2), np.float32))
+        u = L.uniform_random_batch_size_like(x, [1, 3])
+        assert u.shape == [5, 3]
+        g = L.gaussian_random_batch_size_like(x, [1, 4])
+        assert g.shape == [5, 4]
+
+    def test_lstm_unit_step(self):
+        x = to_tensor(np.ones((2, 3), np.float32))
+        h = to_tensor(np.zeros((2, 4), np.float32))
+        c = to_tensor(np.zeros((2, 4), np.float32))
+        h2, c2 = L.lstm_unit(x, h, c)
+        assert h2.shape == [2, 4] and c2.shape == [2, 4]
+        # |h| = |tanh(c)*o| < 1 strictly
+        assert np.abs(np.asarray(h2.numpy())).max() < 1.0
+
+    def test_hash_buckets_stable(self):
+        ids = to_tensor(np.array([[1], [2], [1]], np.int64))
+        a = np.asarray(L.hash(ids, hash_size=1000, num_hash=2).numpy())
+        b = np.asarray(L.hash(ids, hash_size=1000, num_hash=2).numpy())
+        np.testing.assert_array_equal(a, b)       # deterministic
+        assert a.shape == (3, 1, 2)
+        assert (a >= 0).all() and (a < 1000).all()
+        np.testing.assert_array_equal(a[0], a[2])  # same id same bucket
+
+    def test_target_assign(self):
+        ent = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+        matched = np.array([[2, -1, 0]], np.int64)
+        out, w = L.target_assign(to_tensor(ent), to_tensor(matched),
+                                 mismatch_value=-5.0)
+        o = np.asarray(out.numpy())
+        np.testing.assert_allclose(o[0, 0], ent[0, 2])
+        np.testing.assert_allclose(o[0, 1], -5.0)
+        np.testing.assert_allclose(np.asarray(w.numpy())[0, :, 0],
+                                   [1, 0, 1])
