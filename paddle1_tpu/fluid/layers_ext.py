@@ -1874,6 +1874,12 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
                                     seed=0, dtype="float32"):
     shape = list(shape)
     shape[output_dim_idx] = _t(input).shape[input_dim_idx]
+    if seed:
+        import jax
+        import jax.numpy as jnp
+        key = jax.random.fold_in(jax.random.key(seed), 0)
+        return to_tensor(mean + std * jax.random.normal(
+            key, tuple(shape), jnp.dtype(dtype)))
     from .layers import gaussian_random
     return gaussian_random(shape, mean=mean, std=std, dtype=dtype)
 
@@ -1890,12 +1896,14 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     dx, dh = x.shape[-1], h.shape[-1]
     lay = _implicit_layer(
         getattr(param_attr, "name", param_attr) or name,
-        ("lstm_unit", dx, dh),
-        lambda: _paddle.nn.Linear(dx + dh, 4 * dh))
+        ("lstm_unit", dx, dh, bias_attr is False),
+        lambda: _paddle.nn.Linear(dx + dh, 4 * dh,
+                                  bias_attr=bias_attr))
     gates = lay(_manip.concat([x, h], axis=-1))
 
     def f(g, c):
-        i, f_, ct, o = jnp.split(g, 4, axis=-1)
+        # reference lstm_unit_op.h gate layout: (i, f, o, g)
+        i, f_, o, ct = jnp.split(g, 4, axis=-1)
         f_ = jax.nn.sigmoid(f_ + forget_bias)
         i = jax.nn.sigmoid(i)
         o = jax.nn.sigmoid(o)
@@ -1922,8 +1930,14 @@ def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
             h ^= h >> 16
             h = h * jnp.uint32(0x85EBCA6B)
             h ^= h >> 13
-            outs.append((h % jnp.uint32(hash_size)).astype(jnp.int64))
-        return jnp.stack(outs, axis=-1)
+            # the reference hashes the WHOLE last-dim row as one key
+            # (n-gram windows); mix the per-element hashes into one
+            acc = jnp.zeros(h.shape[:-1], jnp.uint32)
+            for j in _bi.range(h.shape[-1]):
+                acc = acc * jnp.uint32(1099087573) + h[..., j]
+            outs.append((acc % jnp.uint32(hash_size)).astype(jnp.int64))
+        # reference HashOutputSize: (..., num_hash, 1)
+        return jnp.stack(outs, axis=-1)[..., None]
     return _apply("hash", f, (_t(input),))
 
 
@@ -1949,9 +1963,19 @@ def target_assign(input, matched_indices, negative_indices=None,
         return out, w
     out, w = _apply("target_assign", f, (x, m), n_outputs=2)
     if negative_indices is not None:
+        # reference NegTargetAssignFunctor: negatives are PER ROW (the
+        # LoD partition) — out forced to mismatch_value, weight to 1
         import numpy as _np
-        wv = _np.asarray(w.numpy())
-        neg = _np.asarray(_t(negative_indices).numpy()).reshape(-1)
-        wv[:, neg] = 1.0
-        w = to_tensor(wv)
+        wv = _np.array(w.numpy())   # writable copies
+        ov = _np.array(out.numpy())
+        neg = _np.asarray(_t(negative_indices).numpy())
+        if neg.ndim == 1:
+            neg = _np.tile(neg[None, :], (wv.shape[0], 1))
+        for b in _bi.range(wv.shape[0]):
+            for j in neg[b].reshape(-1):
+                j = int(j)
+                if j >= 0:
+                    wv[b, j] = 1.0
+                    ov[b, j] = mismatch_value
+        return to_tensor(ov), to_tensor(wv)
     return out, w

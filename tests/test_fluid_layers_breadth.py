@@ -667,9 +667,15 @@ class TestTier6:
         a = np.asarray(L.hash(ids, hash_size=1000, num_hash=2).numpy())
         b = np.asarray(L.hash(ids, hash_size=1000, num_hash=2).numpy())
         np.testing.assert_array_equal(a, b)       # deterministic
-        assert a.shape == (3, 1, 2)
+        # reference HashOutputSize: (..., num_hash, 1); the whole
+        # last-dim row is ONE key
+        assert a.shape == (3, 2, 1)
         assert (a >= 0).all() and (a < 1000).all()
         np.testing.assert_array_equal(a[0], a[2])  # same id same bucket
+        bi = np.array([[1, 2]], np.int64)          # bigram row = one key
+        hb = np.asarray(L.hash(to_tensor(bi), hash_size=1000).numpy())
+        assert hb.shape == (1, 1, 1)
+        assert hb.reshape(-1)[0] != a[0, 0, 0]     # row-key, not elementwise
 
     def test_target_assign(self):
         ent = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
@@ -681,3 +687,41 @@ class TestTier6:
         np.testing.assert_allclose(o[0, 1], -5.0)
         np.testing.assert_allclose(np.asarray(w.numpy())[0, :, 0],
                                    [1, 0, 1])
+
+    def test_target_assign_negatives_per_row(self):
+        ent = np.ones((2, 2, 1), np.float32)
+        matched = np.array([[0, 1], [1, 0]], np.int64)
+        neg = np.array([[1], [0]], np.int64)   # DIFFERENT prior per row
+        out, w = L.target_assign(to_tensor(ent), to_tensor(matched),
+                                 negative_indices=to_tensor(neg),
+                                 mismatch_value=0.0)
+        o = np.asarray(out.numpy())
+        wv = np.asarray(w.numpy())
+        # row 0: negative at prior 1 only; row 1: at prior 0 only
+        assert o[0, 1, 0] == 0.0 and o[1, 0, 0] == 0.0
+        assert o[0, 0, 0] == 1.0 and o[1, 1, 0] == 1.0
+        assert wv[0, 1, 0] == 1.0 and wv[1, 0, 0] == 1.0
+
+    def test_lstm_unit_reference_gate_order_and_bias_attr(self):
+        import paddle1_tpu as paddle
+        x = to_tensor(np.ones((1, 2), np.float32))
+        h = to_tensor(np.zeros((1, 3), np.float32))
+        c = to_tensor(np.full((1, 3), 2.0, np.float32))
+        L.reset_parameter_pass()
+        h2, c2 = L.lstm_unit(x, h, c, forget_bias=100.0,
+                             bias_attr=False)
+        # forget gate (slot 1) saturated at 1: c2 = c + i*g in (1, 3)
+        assert (np.asarray(c2.numpy()) > 1.0).all()
+        L.reset_parameter_pass()
+        _, c3 = L.lstm_unit(x, h, c, forget_bias=-100.0,
+                            bias_attr=False)
+        # forget gate saturated at 0: c3 = i*g in (-1, 1)
+        assert (np.abs(np.asarray(c3.numpy())) < 1.0).all()
+
+    def test_gaussian_batch_size_like_seeded(self):
+        x = to_tensor(np.zeros((3, 2), np.float32))
+        a = np.asarray(L.gaussian_random_batch_size_like(
+            x, [1, 4], seed=11).numpy())
+        b = np.asarray(L.gaussian_random_batch_size_like(
+            x, [1, 4], seed=11).numpy())
+        np.testing.assert_array_equal(a, b)
