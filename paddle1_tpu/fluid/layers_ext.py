@@ -92,7 +92,8 @@ __all__ = [
     "Print", "Assert", "case", "switch_case", "double_buffer",
     "beam_search", "beam_search_decode", "spectral_norm",
     "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
-    "lstm_unit", "hash", "target_assign",
+    "lstm_unit", "hash", "target_assign", "continuous_value_model",
+    "data_norm",
     "gather_tree", "add_position_encoding", "affine_channel",
     "autoincreased_step_counter", "get_tensor_from_selected_rows",
     "merge_selected_rows", "chunk_eval", "polygon_box_transform",
@@ -1979,3 +1980,76 @@ def target_assign(input, matched_indices, negative_indices=None,
                     ov[b, j] = mismatch_value
         return to_tensor(ov), to_tensor(wv)
     return out, w
+
+
+def continuous_value_model(input, show_click, use_cvm=True):
+    """CTR show/click feature transform (reference cvm_op): with
+    ``use_cvm`` the first two embedding columns become log(show+1) and
+    log(click+1)-log(show+1); without it they are dropped."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x, sc = _t(input), _t(show_click)
+
+    def f(x, sc):
+        show = jnp.log(sc[:, 0:1] + 1.0)
+        click = jnp.log(sc[:, 1:2] + 1.0) - show
+        if use_cvm:
+            return jnp.concatenate([show, click, x[:, 2:]], axis=-1)
+        return x[:, 2:]
+    return _apply("cvm", f, (x, sc))
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay=0.9999999, update=True):
+    """Global data normalization by ACCUMULATED batch statistics
+    (reference data_norm_op — the CTR-model alternative to batch_norm:
+    no per-batch recomputation at serving time; the summary stats
+    batch_size/batch_sum/batch_square_sum are persistent and updated
+    OUTSIDE autograd)."""
+    from ..autograd.engine import apply as _apply
+    import jax.numpy as jnp
+    x = _t(input)
+    D = x.shape[-1]
+
+    class _Stats(_paddle.nn.Layer if hasattr(_paddle.nn, "Layer")
+                 else object):
+        pass
+
+    holder = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("data_norm", D),
+        lambda: _make_data_norm_stats(D, epsilon))
+    bsize, bsum, bsq = holder.batch_size, holder.batch_sum, \
+        holder.batch_square_sum
+    # stop-gradient stats (the reference's summaries update by decay,
+    # not by autodiff)
+    means = to_tensor(bsum.data) / to_tensor(bsize.data)
+    scales = _math.sqrt(to_tensor(bsize.data)
+                        / to_tensor(bsq.data))
+    out = (x - means) * scales
+    if update:
+        import numpy as _np
+        n = x.shape[0]
+        xs = _np.asarray(x.numpy())
+        bsize._data = (bsize.data * summary_decay + n)
+        bsum._data = (bsum.data * summary_decay
+                      + jnp.asarray(xs.sum(axis=0)))
+        bsq._data = (bsq.data * summary_decay
+                     + jnp.asarray((xs * xs).sum(axis=0)))
+    return getattr(F, act)(out) if act else out
+
+
+def _make_data_norm_stats(D, epsilon):
+    lay = _paddle.nn.Layer()
+    lay.batch_size = lay.create_parameter(
+        [D], default_initializer=_paddle.nn.initializer.Constant(1e4))
+    lay.batch_sum = lay.create_parameter(
+        [D], default_initializer=_paddle.nn.initializer.Constant(0.0))
+    lay.batch_square_sum = lay.create_parameter(
+        [D], default_initializer=_paddle.nn.initializer.Constant(1e4))
+    for p in (lay.batch_size, lay.batch_sum, lay.batch_square_sum):
+        p.stop_gradient = True
+    return lay

@@ -725,3 +725,50 @@ class TestTier6:
         b = np.asarray(L.gaussian_random_batch_size_like(
             x, [1, 4], seed=11).numpy())
         np.testing.assert_array_equal(a, b)
+
+    def test_continuous_value_model(self):
+        x = np.ones((2, 4), np.float32)
+        sc = np.array([[3.0, 1.0], [0.0, 0.0]], np.float32)
+        out = np.asarray(L.continuous_value_model(
+            to_tensor(x), to_tensor(sc)).numpy())
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+        np.testing.assert_allclose(out[0, 1],
+                                   np.log(2.0) - np.log(4.0), rtol=1e-6)
+        np.testing.assert_allclose(out[:, 2:], 1.0)
+        out2 = np.asarray(L.continuous_value_model(
+            to_tensor(x), to_tensor(sc), use_cvm=False).numpy())
+        assert out2.shape == (2, 2)
+
+    def test_data_norm_reference_formula(self):
+        import jax.numpy as jnp
+        from paddle1_tpu.fluid.layers import _implicit_registry
+        L.reset_parameter_pass()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 3)).astype(np.float32)
+
+        def dn(**kw):  # ONE call site -> one implicit stat holder
+            return L.data_norm(to_tensor(x), **kw)
+
+        before = np.asarray(dn().numpy())
+        assert before.shape == (8, 3)
+        # locate the stat holder and pin known summaries: the output
+        # must follow the reference math out = (x - sum/size) *
+        # sqrt(size / square_sum) (data_norm_op.cc:302-303)
+        holder = None
+        for st in _implicit_registry.values():
+            for lay in st.layers:
+                if hasattr(lay, "batch_square_sum") and \
+                        tuple(lay.batch_sum.shape) == (3,):
+                    holder = lay
+        assert holder is not None
+        holder.batch_size._data = jnp.full((3,), 10.0)
+        holder.batch_sum._data = jnp.full((3,), 20.0)     # mean 2
+        holder.batch_square_sum._data = jnp.full((3,), 40.0)  # scale 0.5
+        L.reset_parameter_pass()
+        out = np.asarray(dn(update=False).numpy())
+        np.testing.assert_allclose(out, (x - 2.0) * 0.5, rtol=1e-5)
+        # update=True accumulates with the decay applied
+        L.reset_parameter_pass()
+        dn()
+        assert float(np.asarray(holder.batch_size.numpy())[0]) > 10.0
