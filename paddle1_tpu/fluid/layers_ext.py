@@ -1985,18 +1985,37 @@ def target_assign(input, matched_indices, negative_indices=None,
 def continuous_value_model(input, show_click, use_cvm=True):
     """CTR show/click feature transform (reference cvm_op): with
     ``use_cvm`` the first two embedding columns become log(show+1) and
-    log(click+1)-log(show+1); without it they are dropped."""
+    log(click+1)-log(show+1); without it they are dropped. The BACKWARD
+    matches the reference grad kernel: dX's first two columns receive
+    the CVM show/click values themselves (cvm_op grad), not autodiff
+    zeros."""
     from ..autograd.engine import apply as _apply
+    import jax
     import jax.numpy as jnp
     x, sc = _t(input), _t(show_click)
 
-    def f(x, sc):
+    @jax.custom_vjp
+    def cvm(x, sc):
         show = jnp.log(sc[:, 0:1] + 1.0)
         click = jnp.log(sc[:, 1:2] + 1.0) - show
         if use_cvm:
             return jnp.concatenate([show, click, x[:, 2:]], axis=-1)
         return x[:, 2:]
-    return _apply("cvm", f, (x, sc))
+
+    def fwd(x, sc):
+        show = jnp.log(sc[:, 0:1] + 1.0)
+        click = jnp.log(sc[:, 1:2] + 1.0) - show
+        out = (jnp.concatenate([show, click, x[:, 2:]], axis=-1)
+               if use_cvm else x[:, 2:])
+        return out, (show, click)
+
+    def bwd(res, g):
+        show, click = res
+        tail = g[:, 2:] if use_cvm else g
+        dx = jnp.concatenate([show, click, tail], axis=-1)
+        return dx, None
+    cvm.defvjp(fwd, bwd)
+    return _apply("cvm", cvm, (x, sc))
 
 
 def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
@@ -2009,19 +2028,19 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     no per-batch recomputation at serving time; the summary stats
     batch_size/batch_sum/batch_square_sum are persistent and updated
     OUTSIDE autograd)."""
-    from ..autograd.engine import apply as _apply
     import jax.numpy as jnp
+    if slot_dim not in (-1, 0):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "data_norm slot_dim (per-slot zero-show special casing) is "
+            "not mapped; use slot_dim=-1 or normalize slots separately")
     x = _t(input)
     D = x.shape[-1]
-
-    class _Stats(_paddle.nn.Layer if hasattr(_paddle.nn, "Layer")
-                 else object):
-        pass
 
     holder = _implicit_layer(
         getattr(param_attr, "name", param_attr) or name,
         ("data_norm", D),
-        lambda: _make_data_norm_stats(D, epsilon))
+        lambda: _make_data_norm_stats(D))
     bsize, bsum, bsq = holder.batch_size, holder.batch_sum, \
         holder.batch_square_sum
     # stop-gradient stats (the reference's summaries update by decay,
@@ -2031,18 +2050,42 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
                         / to_tensor(bsq.data))
     out = (x - means) * scales
     if update:
-        import numpy as _np
-        n = x.shape[0]
-        xs = _np.asarray(x.numpy())
-        bsize._data = (bsize.data * summary_decay + n)
-        bsum._data = (bsum.data * summary_decay
-                      + jnp.asarray(xs.sum(axis=0)))
-        bsq._data = (bsq.data * summary_decay
-                     + jnp.asarray((xs * xs).sum(axis=0)))
+        # the reference updates the summaries in the GRAD op — once per
+        # backward — so stage a PENDING update (on-device sums) that the
+        # backward-end callback commits; eval-only forwards never touch
+        # the stats, and multiple forwards before one backward count
+        # once (latest wins, like one grad-op run)
+        holder._pending = (x.shape[0],
+                           jnp.sum(x.data, axis=0),
+                           jnp.sum(x.data * x.data, axis=0),
+                           summary_decay)
+        _data_norm_pending.add(holder)
     return getattr(F, act)(out) if act else out
 
 
-def _make_data_norm_stats(D, epsilon):
+_data_norm_pending = set()
+
+
+def _commit_data_norm_updates():
+    for holder in list(_data_norm_pending):
+        pend = getattr(holder, "_pending", None)
+        if pend is None:
+            continue
+        n, ssum, ssq, decay = pend
+        holder.batch_size._data = holder.batch_size.data * decay + n
+        holder.batch_sum._data = holder.batch_sum.data * decay + ssum
+        holder.batch_square_sum._data = (holder.batch_square_sum.data
+                                         * decay + ssq)
+        holder._pending = None
+    _data_norm_pending.clear()
+
+
+from .layers import _ag_engine as _ag  # noqa: E402
+
+_ag.register_backward_end_callback(_commit_data_norm_updates)
+
+
+def _make_data_norm_stats(D):
     lay = _paddle.nn.Layer()
     lay.batch_size = lay.create_parameter(
         [D], default_initializer=_paddle.nn.initializer.Constant(1e4))

@@ -747,8 +747,11 @@ class TestTier6:
         rng = np.random.default_rng(0)
         x = rng.standard_normal((8, 3)).astype(np.float32)
 
+        xt = to_tensor(x)
+        xt.stop_gradient = False  # so backward() can drive the commit
+
         def dn(**kw):  # ONE call site -> one implicit stat holder
-            return L.data_norm(to_tensor(x), **kw)
+            return L.data_norm(xt, **kw)
 
         before = np.asarray(dn().numpy())
         assert before.shape == (8, 3)
@@ -768,7 +771,14 @@ class TestTier6:
         L.reset_parameter_pass()
         out = np.asarray(dn(update=False).numpy())
         np.testing.assert_allclose(out, (x - 2.0) * 0.5, rtol=1e-5)
-        # update=True accumulates with the decay applied
+        # updates are STAGED at forward and committed on backward-end
+        # (the reference updates in the grad op): eval forwards leave
+        # the stats untouched
         L.reset_parameter_pass()
         dn()
+        np.testing.assert_allclose(
+            np.asarray(holder.batch_size.numpy()), 10.0)
+        L.reset_parameter_pass()
+        y = dn()
+        y.sum().backward()      # commit fires here
         assert float(np.asarray(holder.batch_size.numpy())[0]) > 10.0
