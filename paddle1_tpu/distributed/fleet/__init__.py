@@ -21,8 +21,8 @@ from ..meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                              LayerDesc, SharedLayerDesc, PipelineLayer,
                              SegmentLayers)
 from .utils import recompute, fleet_util
-from .trainer import (HogwildWorker, MultiTrainer, TrainerDesc,
-                      DeviceWorkerDesc, create_trainer)
+from .trainer import (HogwildWorker, InferWorker, MultiTrainer,
+                      TrainerDesc, DeviceWorkerDesc, create_trainer)
 from .process_trainer import ProcessMultiTrainer
 
 # module-level delegation to the singleton (the reference exposes

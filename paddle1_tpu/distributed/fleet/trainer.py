@@ -32,7 +32,7 @@ import numpy as np
 
 from ...core.errors import InvalidArgumentError
 
-__all__ = ["HogwildWorker", "MultiTrainer", "TrainerDesc",
+__all__ = ["HogwildWorker", "InferWorker", "MultiTrainer", "TrainerDesc",
            "DeviceWorkerDesc", "create_trainer"]
 
 
@@ -92,6 +92,29 @@ class MultiTrainer:
             raise InvalidArgumentError("thread_num must be >= 1")
         self.thread_num = int(thread_num)
 
+    def _drain(self, dataset, batch_size, collate, make_worker) -> dict:
+        """Shared worker drain: batch the dataset once, spawn
+        ``thread_num`` workers via ``make_worker(i, batch_iter,
+        iter_lock, stats)``, join, re-raise the first worker error."""
+        if collate is None:
+            collate = lambda buf: np.stack(buf)
+        if batch_size is None:
+            batch_iter = iter(dataset)
+        else:
+            batch_iter = _batched(iter(dataset), batch_size, collate)
+        iter_lock = threading.Lock()
+        stats: dict = {}
+        workers = [make_worker(i, batch_iter, iter_lock, stats)
+                   for i in range(self.thread_num)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        for w in workers:
+            if w.error is not None:
+                raise w.error
+        return stats
+
     def train_from_dataset(self, dataset, loss_fn: Callable, optimizer,
                            batch_size: int = 1,
                            collate: Optional[Callable] = None,
@@ -105,25 +128,11 @@ class MultiTrainer:
         Returns aggregate stats (reference prints fetch vars per period;
         the per-worker loss series is returned instead).
         """
-        if collate is None:
-            collate = lambda buf: np.stack(buf)
-        if batch_size is None:
-            batch_iter = iter(dataset)
-        else:
-            batch_iter = _batched(iter(dataset), batch_size, collate)
-        iter_lock = threading.Lock()
         step_lock = threading.Lock()
-        stats: dict = {}
-        workers = [HogwildWorker(i, batch_iter, iter_lock, step_lock,
-                                 loss_fn, optimizer, stats)
-                   for i in range(self.thread_num)]
-        for w in workers:
-            w.start()
-        for w in workers:
-            w.join()
-        for w in workers:
-            if w.error is not None:
-                raise w.error
+        stats = self._drain(
+            dataset, batch_size, collate,
+            lambda i, it, it_lock, st: HogwildWorker(
+                i, it, it_lock, step_lock, loss_fn, optimizer, st))
         all_losses: List[float] = []
         for s in stats.values():
             all_losses.extend(s["losses"])
@@ -137,6 +146,75 @@ class MultiTrainer:
                   f"{self.thread_num} workers, mean loss "
                   f"{out['loss_mean']:.6f}")
         return out
+
+    def infer_from_dataset(self, dataset, infer_fn: Callable,
+                           batch_size: int = 1,
+                           collate: Optional[Callable] = None,
+                           fetch_handler: Optional[Callable] = None,
+                           debug: bool = False) -> dict:
+        """Drain ``dataset`` once through ``infer_fn(batch) -> out``
+        with no optimizer (reference executor.infer_from_dataset,
+        fluid/executor.py:1539: same trainer runtime, infer_mode on).
+
+        With ``fetch_handler`` each batch's output is handed to it and
+        not retained (the reference's FetchHandler role); otherwise all
+        outputs are collected under ``per_worker``.
+        """
+        handler_lock = threading.Lock()
+        stats = self._drain(
+            dataset, batch_size, collate,
+            lambda i, it, it_lock, st: InferWorker(
+                i, it, it_lock, infer_fn, fetch_handler, handler_lock,
+                st))
+        out = {"workers": self.thread_num,
+               "batches": sum(s["batches"] for s in stats.values()),
+               "per_worker": stats}
+        if debug:
+            print(f"MultiTrainer(infer): {out['batches']} batches over "
+                  f"{self.thread_num} workers")
+        return out
+
+
+class InferWorker(threading.Thread):
+    """Inference twin of HogwildWorker (reference device_worker.h
+    HogwildWorker with infer_mode / executor.infer_from_dataset,
+    fluid/executor.py:1539): drains batches, runs forward only, no
+    optimizer step."""
+
+    def __init__(self, worker_id: int, batch_iter, iter_lock,
+                 infer_fn: Callable, fetch_handler, handler_lock,
+                 stats: dict):
+        super().__init__(daemon=True, name=f"infer-{worker_id}")
+        self.worker_id = worker_id
+        self._batch_iter = batch_iter
+        self._iter_lock = iter_lock
+        self._infer_fn = infer_fn
+        self._fetch_handler = fetch_handler
+        self._handler_lock = handler_lock
+        self._stats = stats
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        outputs, n = [], 0
+        try:
+            while True:
+                with self._iter_lock:
+                    batch = next(self._batch_iter, None)
+                if batch is None:
+                    break
+                out = self._infer_fn(batch)
+                if self._fetch_handler is not None:
+                    # serialized like the reference's single
+                    # FetchHandlerMonitor thread — handlers may do
+                    # read-modify-write or file IO
+                    with self._handler_lock:
+                        self._fetch_handler(out)
+                else:
+                    outputs.append(out)
+                n += 1
+        except BaseException as e:
+            self.error = e
+        self._stats[self.worker_id] = {"batches": n, "outputs": outputs}
 
 
 class DeviceWorkerDesc:

@@ -191,7 +191,22 @@ class Executor:
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
-        return []
+        if feed is not None or fetch_list is not None:
+            # A non-callable Program with feed/fetch is genuine fluid
+            # graph execution — the shell records no ops, so silently
+            # returning [] would hide the porting gap. Teach loudly
+            # (reference fluid/executor.py:475 runs the ProgramDesc).
+            from ..core.errors import UnimplementedError
+            raise UnimplementedError(
+                "Executor.run(program, feed=..., fetch_list=...): the "
+                "Program shell records no ops (graph capture here is "
+                "tracing, not program construction). Port the model "
+                "body to a callable and pass it as `program` (feed "
+                "becomes its kwargs), decorate it with "
+                "paddle1_tpu.jit.to_static for compiled execution, or "
+                "use Executor.train_from_dataset(loss_fn=..., "
+                "optimizer=...) for the industrial dataset loop")
+        return []  # exe.run(startup_program) initialization idiom: no-op
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -236,15 +251,33 @@ class Executor:
                                      batch_size=batch_size,
                                      collate=collate, debug=debug)
 
-    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None, *, infer_fn=None,
+                           batch_size=1, collate=None):
         """Inference twin of train_from_dataset (reference
-        executor.py:1219): same drain, no optimizer — pass a loss_fn
-        that only evaluates."""
-        from ..core.errors import UnimplementedError
-        raise UnimplementedError(
-            "infer_from_dataset: drain the dataset through "
-            "io.DataLoader + model.eval() (or hapi.Model.predict); the "
-            "trainer runtime exists for the training half")
+        fluid/executor.py:1539: same trainer runtime, infer_mode —
+        forward only, no update). Pass ``infer_fn(batch) -> out`` (or a
+        callable ``program``); ``fetch_handler`` receives each batch's
+        output as it is produced."""
+        from ..core.errors import InvalidArgumentError
+        if dataset is None:
+            raise InvalidArgumentError("infer_from_dataset needs dataset=")
+        if infer_fn is None and callable(program):
+            infer_fn = program
+        if infer_fn is None:
+            raise InvalidArgumentError(
+                "infer_from_dataset cannot derive the forward pass from "
+                "a Program shell: pass infer_fn=(batch)->out (the eager "
+                "or jit-compiled model forward)")
+        from ..distributed.fleet import MultiTrainer
+        tr = MultiTrainer(thread_num=max(int(thread), 1))
+        return tr.infer_from_dataset(dataset, infer_fn,
+                                     batch_size=batch_size,
+                                     collate=collate,
+                                     fetch_handler=fetch_handler,
+                                     debug=debug)
 
     def close(self):
         pass

@@ -225,6 +225,62 @@ class TestMultiTrainer:
                 batch_size=1)
 
 
+class TestInferFromDataset:
+    def test_executor_drains_without_update(self, tmp_path):
+        lin = paddle.nn.Linear(4, 1)
+        w_before = np.asarray(lin.weight.numpy()).copy()
+        rng = np.random.default_rng(3)
+        rows = rng.standard_normal((30, 4)).astype(np.float32)
+        p = tmp_path / "infer.txt"
+        p.write_text("\n".join(" ".join(map(str, r)) for r in rows) + "\n")
+        ds = paddle.io.QueueDataset()
+        ds.set_filelist([str(p)])
+        ds.set_rank_world(0, 1)
+
+        got = []
+
+        def infer_fn(batch):
+            return np.asarray(lin(to_tensor(batch)).numpy())
+
+        exe = paddle.static.Executor()
+        out = exe.infer_from_dataset(dataset=ds, infer_fn=infer_fn,
+                                     batch_size=10, thread=2,
+                                     fetch_handler=got.append)
+        assert out["batches"] == 3
+        assert sum(len(g) for g in got) == 30
+        # forward only: parameters untouched
+        np.testing.assert_array_equal(np.asarray(lin.weight.numpy()),
+                                      w_before)
+        # outputs match a direct forward over the same rows
+        direct = np.asarray(lin(to_tensor(rows)).numpy())
+        np.testing.assert_allclose(
+            np.sort(np.concatenate(got, axis=0), axis=0),
+            np.sort(direct, axis=0), rtol=1e-5)
+
+    def test_needs_infer_fn(self):
+        exe = paddle.static.Executor()
+        with pytest.raises(Exception, match="infer_fn"):
+            exe.infer_from_dataset(dataset=[np.zeros(2)])
+
+
+class TestExecutorRunTeaching:
+    def test_startup_idiom_is_noop(self):
+        exe = paddle.static.Executor()
+        assert exe.run(paddle.static.default_startup_program()) == []
+
+    def test_real_program_run_teaches_loudly(self):
+        from paddle1_tpu.core.errors import UnimplementedError
+        exe = paddle.static.Executor()
+        with pytest.raises(UnimplementedError, match="train_from_dataset"):
+            exe.run(paddle.static.default_main_program(),
+                    feed={"x": np.zeros(2)}, fetch_list=["out"])
+
+    def test_callable_program_still_runs(self):
+        exe = paddle.static.Executor()
+        out = exe.run(lambda x: x + 1, feed={"x": 41})
+        assert out == [42]
+
+
 class _NoOpt:
     def step(self):
         pass
