@@ -109,6 +109,10 @@ __all__ = [
     "noam_decay", "linear_lr_warmup",
     # rnn cells / runners
     "GRUCell", "LSTMCell", "rnn", "birnn",
+    # seq2seq decode stack (nn.decode re-exports)
+    "Decoder", "BeamSearchDecoder", "dynamic_decode", "DecodeHelper",
+    "TrainingHelper", "GreedyEmbeddingHelper", "SampleEmbeddingHelper",
+    "BasicDecoder",
     # tensor-array (eager lists)
     "create_array", "array_write", "array_read", "array_length",
     "tensor_array_to_tensor",
@@ -1168,6 +1172,14 @@ def birnn(cell_fw, cell_bw, inputs, initial_states=None,
           sequence_length=None, time_major=False, **kwargs):
     runner = _paddle.nn.BiRNN(cell_fw, cell_bw, time_major=time_major)
     return runner(_t(inputs), initial_states)
+
+
+# seq2seq decode stack: the fluid spellings are the nn.decode objects
+# (reference fluid/layers/rnn.py:753-2127 → paddle1_tpu/nn/decode.py)
+from ..nn.decode import (  # noqa: E402,F401
+    Decoder, BeamSearchDecoder, dynamic_decode, DecodeHelper,
+    TrainingHelper, GreedyEmbeddingHelper, SampleEmbeddingHelper,
+    BasicDecoder)
 
 
 # -- tensor arrays (eager lists) ---------------------------------------------

@@ -10,5 +10,6 @@ from .layer_conv_pool import *  # noqa: F401,F403
 from .layer_loss import *  # noqa: F401,F403
 from .layer_norm_act import *  # noqa: F401,F403
 from .layer_rnn import *  # noqa: F401,F403
+from .decode import *  # noqa: F401,F403
 from .layer_transformer import *  # noqa: F401,F403
 from ..framework.param_attr import ParamAttr  # re-export convenience
