@@ -418,14 +418,7 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
 
 
 # mapping old-name → modern path for the teaching __getattr__
-_MODERN = {
-    "lstm": "paddle1_tpu.nn.LSTM",
-    "dynamic_lstm": "paddle1_tpu.nn.LSTM",
-    "dynamic_gru": "paddle1_tpu.nn.GRU",
-    "gru_unit": "paddle1_tpu.nn.GRUCell",
-    "py_func": "plain Python (eager) or a custom op via "
-               "paddle1_tpu.utils.cpp_extension",
-}
+_MODERN = {}
 
 
 def __getattr__(name):

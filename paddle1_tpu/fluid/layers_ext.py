@@ -113,6 +113,16 @@ __all__ = [
     "Decoder", "BeamSearchDecoder", "dynamic_decode", "DecodeHelper",
     "TrainingHelper", "GreedyEmbeddingHelper", "SampleEmbeddingHelper",
     "BasicDecoder",
+    # fluid RNN-era recurrent ops (rnn_legacy)
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm",
+    # sampled large-vocab losses
+    "nce", "sampled_softmax_with_cross_entropy",
+    # tier 7: user-op / crop / 3d long tail
+    "py_func", "random_crop", "conv3d_transpose", "adaptive_pool3d",
+    "scatter_nd",
+    # detection training family
+    "rpn_target_assign", "generate_proposals", "ssd_loss",
+    "multi_box_head", "deformable_conv",
     # tensor-array (eager lists)
     "create_array", "array_write", "array_read", "array_length",
     "tensor_array_to_tensor",
@@ -374,6 +384,10 @@ def scatter(input, index, updates, overwrite=True, name=None):
 
 def scatter_nd_add(ref, index, updates, name=None):
     return _paddle.scatter_nd_add(_t(ref), _t(index), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _paddle.scatter_nd(_t(index), _t(updates), shape)
 
 
 def size(input):
@@ -891,6 +905,141 @@ def adaptive_pool2d(input, pool_size, pool_type="max",
     return f(_t(input), pool_size)
 
 
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    f = (F.adaptive_max_pool3d if pool_type == "max"
+         else F.adaptive_avg_pool3d)
+    return f(_t(input), pool_size)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, name=None, data_format="NCDHW"):
+    x = _t(input)
+    in_ch = x.shape[1 if data_format == "NCDHW" else -1]
+    if filter_size is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "conv3d_transpose needs filter_size= (the fluid argument "
+            "order puts output_size BEFORE filter_size)")
+    lay = _implicit_layer(
+        name, ("conv3d_transpose", in_ch, num_filters, filter_size,
+               stride, padding, dilation, groups),
+        lambda: _paddle.nn.Conv3DTranspose(in_ch, num_filters,
+                                           filter_size, stride=stride,
+                                           padding=padding,
+                                           dilation=dilation,
+                                           groups=groups))
+    out = lay(x, output_size=output_size) if output_size else lay(x)
+    return getattr(F, act)(out) if act else out
+
+
+def random_crop(x, shape, seed=None):
+    """Per-instance random crop of the trailing dims to ``shape``
+    (reference random_crop_op: dim 0 is the batch, every instance draws
+    its own offsets)."""
+    from ..autograd.engine import apply as _apply
+    import jax
+    import jax.numpy as jnp
+    from ..core.generator import next_key
+    xt = _t(x)
+    shape = list(shape)
+    if len(shape) != xt.ndim - 1:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"random_crop shape must cover the non-batch dims "
+            f"({xt.ndim - 1}), got {shape}")
+    key = (jax.random.key(int(seed)) if seed is not None
+           else next_key())
+    B = xt.shape[0]
+
+    def f(a):
+        maxs = jnp.asarray([a.shape[i + 1] - shape[i]
+                            for i in _bi.range(len(shape))])
+        offs = jax.vmap(
+            lambda k: jax.random.randint(k, (len(shape),), 0,
+                                         maxs + 1))(
+            jax.random.split(key, B))
+
+        def crop_one(ai, off):
+            return jax.lax.dynamic_slice(ai, tuple(off), tuple(shape))
+        return jax.vmap(crop_one)(a, offs)
+    return _apply("random_crop", f, (xt,))
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a user Python function as an op (reference layers/nn.py
+    py_func, py_func_op.cc): ``func`` sees numpy arrays; with
+    ``backward_func(*(inputs + outputs + out_grads)) -> input grads``
+    the op is differentiable. ``skip_vars_in_backward_input`` removes
+    specific input/output tensors from the backward call, matching the
+    reference by object identity. ``out`` template tensors (if given)
+    are updated in place and returned."""
+    from ..autograd.py_layer import PyLayer
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    xs = [_t(v) for v in xs]
+    outs_tpl = (list(out) if isinstance(out, (list, tuple))
+                else ([out] if out is not None else None))
+    skip = set(id(v) for v in (skip_vars_in_backward_input or []))
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *inputs):
+            np_in = [np.asarray(t.numpy()) for t in inputs]
+            res = func(*np_in)
+            res_list = (list(res) if isinstance(res, (list, tuple))
+                        else [res])
+            outs = [to_tensor(np.asarray(r)) for r in res_list]
+            ctx.save_for_backward(*inputs, *outs)
+            ctx._n_in = len(inputs)
+            return tuple(outs) if len(outs) > 1 else outs[0]
+
+        @staticmethod
+        def backward(ctx, *gouts):
+            if backward_func is None:
+                from ..core.errors import PreconditionNotMetError
+                raise PreconditionNotMetError(
+                    "py_func: backward reached but no backward_func= "
+                    "was given")
+            saved = ctx.saved_tensor
+            ins, fouts = saved[:ctx._n_in], saved[ctx._n_in:]
+            args = []
+            for t in list(ins) + list(fouts):
+                if id(t) in skip or \
+                        any(t.data is s.data for s in _skip_tensors):
+                    continue
+                args.append(np.asarray(t.numpy()))
+            args += [np.asarray(g.numpy()) for g in gouts]
+            gres = backward_func(*args)
+            gres = (list(gres) if isinstance(gres, (list, tuple))
+                    else [gres])
+            gts = [None if g is None else to_tensor(np.asarray(g))
+                   for g in gres]
+            diff_n = len([t for t in ins if not t.stop_gradient])
+            if len(gts) == len(ins):
+                gts = [g for g, t in zip(gts, ins)
+                       if not t.stop_gradient]
+            if len(gts) != diff_n:
+                from ..core.errors import PreconditionNotMetError
+                raise PreconditionNotMetError(
+                    f"py_func backward_func returned {len(gts)} grads "
+                    f"for {diff_n} differentiable inputs")
+            return tuple(gts)
+
+    _skip_tensors = [v for v in (skip_vars_in_backward_input or [])
+                     if isinstance(v, Tensor)]
+    result = _PyFunc.apply(*xs)
+    res_list = (list(result) if isinstance(result, tuple)
+                else [result])
+    if outs_tpl is not None:
+        for tpl, r in zip(outs_tpl, res_list):
+            if isinstance(tpl, Tensor) and hasattr(tpl, "_replace_impl"):
+                tpl._replace_impl(r)
+    return result
+
+
 def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", actual_shape=None,
                  align_corners=True, align_mode=1,
@@ -1180,6 +1329,13 @@ from ..nn.decode import (  # noqa: E402,F401
     Decoder, BeamSearchDecoder, dynamic_decode, DecodeHelper,
     TrainingHelper, GreedyEmbeddingHelper, SampleEmbeddingHelper,
     BasicDecoder)
+from .rnn_legacy import (  # noqa: E402,F401
+    dynamic_lstm, dynamic_lstmp, dynamic_gru, gru_unit, lstm)
+from .sampled_loss import (  # noqa: E402,F401
+    nce, sampled_softmax_with_cross_entropy)
+from .detection_train import (  # noqa: E402,F401
+    rpn_target_assign, generate_proposals, ssd_loss, multi_box_head,
+    deformable_conv)
 
 
 # -- tensor arrays (eager lists) ---------------------------------------------

@@ -1,0 +1,364 @@
+"""Fluid RNN-era recurrent ops: dynamic_lstm(p) / dynamic_gru /
+gru_unit / lstm on the dense+lengths representation.
+
+Reference: /root/reference/python/paddle/fluid/layers/rnn.py
+(dynamic_lstm:2262, lstm:2439, dynamic_lstmp:2616, dynamic_gru:2835,
+gru_unit:2998) over the C++ kernels in
+paddle/fluid/operators/lstm_op.h, lstmp_op.h, gru_op.*,
+math/detail/{lstm,gru}_kernel.h.
+
+Semantics pinned to the kernels, not the docstrings:
+
+- dynamic_lstm gate layout along the 4H axis is the OLD-API order
+  **[c̃, i, f, o]** (lstm_cpu_kernel.h:63 ``old_api_version`` branch;
+  the docstring's {b_c, b_i, b_f, b_o} agrees). Peephole weights live
+  in bias[:, 4H:7H] as [W_ic, W_fc, W_oc]; the o-gate peephole reads
+  the CURRENT cell state (lstm_kernel.h forward).
+- dynamic_gru gate layout along the 3D axis is **[u, r, c̃]** with
+  W[:, :2D] the u/r recurrence and W[:, 2D:] applied to r⊙h_prev
+  (gru_kernel.h gru_resetOutput/gru_finalOutput). ``origin_mode=True``
+  gives h = u⊙h_prev + (1-u)⊙c̃; False (default) gives
+  h = (1-u)⊙h_prev + u⊙c̃.
+
+TPU-native: each op is ONE traced computation containing a
+``lax.scan`` over time — the whole recurrence compiles to a single
+fused XLA while-loop. LoD is carried as explicit ``lengths``: padded
+positions carry the state through unchanged and emit zeros, and
+``is_reverse`` reverses each row inside its own length (the reference
+re-batches by LoD; same numbers, dense layout).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle1_tpu as _paddle
+from ..autograd.engine import apply
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit",
+           "lstm"]
+
+_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+         "relu": jax.nn.relu, "identity": (lambda x: x)}
+
+
+def _act(name):
+    if name not in _ACTS:
+        raise InvalidArgumentError(
+            f"activation {name!r}; available {sorted(_ACTS)}")
+    return _ACTS[name]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _lens_arr(lengths, B, T):
+    if lengths is None:
+        return None
+    return _t(lengths)
+
+
+def _row_reverse(x, lens):
+    """Reverse each row of [B, T, ...] within its own length; padded
+    tail positions stay in place (they are masked anyway)."""
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, x.shape[:2] + x.shape[2:]), axis=1)
+
+
+def _holder(name, sig, shapes, is_bias=()):
+    """Implicit parameter set for a call site (layers._implicit_layer
+    semantics: per-creation unless name= shares)."""
+    from .layers import _implicit_layer
+
+    def factory():
+        lay = _paddle.nn.Layer()
+        for pname, shape in shapes.items():
+            p = lay.create_parameter(list(shape),
+                                     is_bias=pname in is_bias)
+            setattr(lay, pname, p)
+        return lay
+    return _implicit_layer(name, sig, factory)
+
+
+def dynamic_lstm(input, size, lengths=None, h_0=None, c_0=None,
+                 param_attr=None, bias_attr=None, use_peepholes=True,
+                 is_reverse=False, gate_activation="sigmoid",
+                 cell_activation="tanh", candidate_activation="tanh",
+                 dtype="float32", name=None, *, _proj_size=0,
+                 _proj_activation="tanh", _cell_clip=None,
+                 _proj_clip=None):
+    """LSTM recurrence over pre-projected gates (reference
+    dynamic_lstm, rnn.py:2262): ``input`` [B, T, 4H] already holds
+    x_t@W_x; this op owns the [H, 4H] recurrence weight and the
+    [1, 4H or 7H] bias (peepholes in the tail). Returns
+    (hidden [B,T,H], cell [B,T,H]); padded positions are zero.
+
+    Internal: ``_proj_size>0`` turns this into dynamic_lstmp
+    (rnn.py:2616) — recurrence runs on the projection r_t
+    (weight [P, 4H], extra proj weight [H, P]), returning
+    (projection [B,T,P], cell)."""
+    if bias_attr is False:
+        # reference rnn.py:2383 asserts the same
+        raise InvalidArgumentError(
+            "bias_attr should not be False in dynamic_lstm")
+    x = _t(input)
+    if x.ndim != 3 or x.shape[-1] != size or size % 4:
+        raise InvalidArgumentError(
+            "dynamic_lstm: input must be dense [batch, time, 4*hidden] "
+            f"with size=4*hidden (got {tuple(x.shape)}, size={size}); "
+            "LoD is carried via lengths=")
+    H = size // 4
+    P = _proj_size
+    rec_dim = P if P else H
+    bias_cols = 7 * H if use_peepholes else 4 * H
+    shapes = {"weight": (rec_dim, 4 * H), "bias": (1, bias_cols)}
+    if P:
+        shapes["proj_weight"] = (H, P)
+    hold = _holder(getattr(param_attr, "name", param_attr) or name,
+                   ("dynamic_lstm", H, P, use_peepholes), shapes,
+                   is_bias=("bias",))
+    B, T = x.shape[0], x.shape[1]
+    act_g, act_c = _act(gate_activation), _act(cell_activation)
+    act_cand = _act(candidate_activation)
+    act_p = _act(_proj_activation)
+    lens = _lens_arr(lengths, B, T)
+    h0 = _t(h_0) if h_0 is not None else None
+    c0 = _t(c_0) if c_0 is not None else None
+
+    def f(x, *args):
+        args = list(args)
+        ln = args.pop(0) if lens is not None else None
+        w = args.pop(0)
+        b = args.pop(0)
+        pw = args.pop(0) if P else None
+        h_init = args.pop(0) if h0 is not None else \
+            jnp.zeros((B, rec_dim), x.dtype)
+        c_init = args.pop(0) if c0 is not None else \
+            jnp.zeros((B, H), x.dtype)
+        gates_bias = b[0, :4 * H]
+        if use_peepholes:
+            ck_i = b[0, 4 * H:5 * H]
+            ck_f = b[0, 5 * H:6 * H]
+            ck_o = b[0, 6 * H:7 * H]
+        else:
+            ck_i = ck_f = ck_o = jnp.zeros((H,), x.dtype)
+        xs = x
+        if ln is not None and is_reverse:
+            xs = _row_reverse(xs, ln)
+        elif is_reverse:
+            xs = jnp.flip(xs, axis=1)
+        mask = (jnp.arange(T)[None, :] < ln[:, None]).astype(x.dtype) \
+            if ln is not None else jnp.ones((B, T), x.dtype)
+        xs_t = jnp.swapaxes(xs, 0, 1)          # [T, B, 4H]
+        mask_t = jnp.swapaxes(mask, 0, 1)[..., None]  # [T, B, 1]
+
+        def step(carry, xm):
+            h, c = carry
+            xt, m = xm
+            g = xt + h @ w + gates_bias
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)  # old-api order
+            i = act_g(gi + c * ck_i)
+            fg = act_g(gf + c * ck_f)
+            c_new = fg * c + i * act_cand(gc)
+            if _cell_clip is not None:
+                c_new = jnp.clip(c_new, -_cell_clip, _cell_clip)
+            o = act_g(go + c_new * ck_o)
+            h_new = o * act_c(c_new)
+            if P:
+                h_new = act_p(h_new @ pw)
+                if _proj_clip is not None:
+                    h_new = jnp.clip(h_new, -_proj_clip, _proj_clip)
+            h2 = m * h_new + (1 - m) * h
+            c2 = m * c_new + (1 - m) * c
+            return (h2, c2), (m * h_new, m * c_new)
+        _, (hs, cs) = jax.lax.scan(step, (h_init, c_init),
+                                   (xs_t, mask_t))
+        hs = jnp.swapaxes(hs, 0, 1)
+        cs = jnp.swapaxes(cs, 0, 1)
+        if ln is not None and is_reverse:
+            hs, cs = _row_reverse(hs, ln), _row_reverse(cs, ln)
+        elif is_reverse:
+            hs, cs = jnp.flip(hs, axis=1), jnp.flip(cs, axis=1)
+        return hs, cs
+
+    args = [x]
+    if lens is not None:
+        args.append(lens)
+    args += [hold.weight, hold.bias]
+    if P:
+        args.append(hold.proj_weight)
+    if h0 is not None:
+        args.append(h0)
+    if c0 is not None:
+        args.append(c0)
+    return apply("dynamic_lstm", f, tuple(args), n_outputs=2)
+
+
+def dynamic_lstmp(input, size, proj_size, lengths=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None,
+                  h_0=None, c_0=None, cell_clip=None, proj_clip=None):
+    """LSTM with a learned projection fed back as the recurrent state
+    (reference dynamic_lstmp, rnn.py:2616). Returns
+    (projection [B,T,P], cell [B,T,H])."""
+    return dynamic_lstm(
+        input, size, lengths=lengths, h_0=h_0, c_0=c_0,
+        param_attr=param_attr, bias_attr=bias_attr,
+        use_peepholes=use_peepholes, is_reverse=is_reverse,
+        gate_activation=gate_activation, cell_activation=cell_activation,
+        candidate_activation=candidate_activation, dtype=dtype,
+        name=name, _proj_size=proj_size,
+        _proj_activation=proj_activation, _cell_clip=cell_clip,
+        _proj_clip=proj_clip)
+
+
+def dynamic_gru(input, size, lengths=None, param_attr=None,
+                bias_attr=None, is_reverse=False,
+                gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None,
+                origin_mode=False, name=None):
+    """GRU recurrence over pre-projected gates (reference dynamic_gru,
+    rnn.py:2835): ``input`` [B, T, 3D] holds x@W_x; this op owns the
+    [D, 3D] recurrence weight (u/r in the first 2D columns, candidate
+    in the last D applied to r⊙h_prev) and the [1, 3D] bias. Returns
+    hidden [B, T, D]; padded positions are zero."""
+    x = _t(input)
+    if x.ndim != 3 or x.shape[-1] != 3 * size:
+        raise InvalidArgumentError(
+            "dynamic_gru: input must be dense [batch, time, 3*size] "
+            f"(got {tuple(x.shape)}, size={size}); LoD via lengths=")
+    D = size
+    with_bias = bias_attr is not False  # reference: Bias is optional
+    shapes = {"weight": (D, 3 * D)}
+    if with_bias:
+        shapes["bias"] = (1, 3 * D)
+    hold = _holder(getattr(param_attr, "name", param_attr) or name,
+                   ("dynamic_gru", D, origin_mode, with_bias),
+                   shapes, is_bias=("bias",))
+    B, T = x.shape[0], x.shape[1]
+    act_g, act_c = _act(gate_activation), _act(candidate_activation)
+    lens = _lens_arr(lengths, B, T)
+    h0 = _t(h_0) if h_0 is not None else None
+
+    def f(x, *args):
+        args = list(args)
+        ln = args.pop(0) if lens is not None else None
+        w = args.pop(0)
+        b = args.pop(0) if with_bias else None
+        h_init = args.pop(0) if h0 is not None else \
+            jnp.zeros((B, D), x.dtype)
+        w_ur, w_c = w[:, :2 * D], w[:, 2 * D:]
+        xs = x + b[0] if with_bias else x
+        if ln is not None and is_reverse:
+            xs = _row_reverse(xs, ln)
+        elif is_reverse:
+            xs = jnp.flip(xs, axis=1)
+        mask = (jnp.arange(T)[None, :] < ln[:, None]).astype(x.dtype) \
+            if ln is not None else jnp.ones((B, T), x.dtype)
+        xs_t = jnp.swapaxes(xs, 0, 1)
+        mask_t = jnp.swapaxes(mask, 0, 1)[..., None]
+
+        def step(h, xm):
+            xt, m = xm
+            g_ur = xt[:, :2 * D] + h @ w_ur
+            u = act_g(g_ur[:, :D])
+            r = act_g(g_ur[:, D:])
+            c = act_c(xt[:, 2 * D:] + (r * h) @ w_c)
+            if origin_mode:
+                h_new = u * h + c - u * c
+            else:
+                h_new = h - u * h + u * c
+            h2 = m * h_new + (1 - m) * h
+            return h2, m * h_new
+        _, hs = jax.lax.scan(step, h_init, (xs_t, mask_t))
+        hs = jnp.swapaxes(hs, 0, 1)
+        if ln is not None and is_reverse:
+            hs = _row_reverse(hs, ln)
+        elif is_reverse:
+            hs = jnp.flip(hs, axis=1)
+        return hs
+
+    args = [x]
+    if lens is not None:
+        args.append(lens)
+    args.append(hold.weight)
+    if with_bias:
+        args.append(hold.bias)
+    if h0 is not None:
+        args.append(h0)
+    return apply("dynamic_gru", f, tuple(args))
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """One GRU step (reference gru_unit, rnn.py:2998; gru_unit_op):
+    ``input`` [B, 3D] pre-projected, ``hidden`` [B, D], ``size`` = 3D.
+    Returns (updated_hidden, reset_hidden_prev, gate) with ``gate``
+    the activated [u, r, c̃] concat — the op's three outputs."""
+    if size % 3:
+        raise InvalidArgumentError("gru_unit: size must be 3*hidden")
+    D = size // 3
+    with_bias = bias_attr is not False  # reference: Bias is optional
+    shapes = {"weight": (D, 3 * D)}
+    if with_bias:
+        shapes["bias"] = (1, 3 * D)
+    hold = _holder(getattr(param_attr, "name", param_attr) or name,
+                   ("gru_unit", D, origin_mode, with_bias), shapes,
+                   is_bias=("bias",))
+    act_c, act_g = _act(activation), _act(gate_activation)
+
+    def f(xt, h, w, *maybe_b):
+        g = xt + maybe_b[0][0] if with_bias else xt
+        w_ur, w_c = w[:, :2 * D], w[:, 2 * D:]
+        g_ur = g[:, :2 * D] + h @ w_ur
+        u = act_g(g_ur[:, :D])
+        r = act_g(g_ur[:, D:])
+        rh = r * h
+        c = act_c(g[:, 2 * D:] + rh @ w_c)
+        if origin_mode:
+            h_new = u * h + c - u * c
+        else:
+            h_new = h - u * h + u * c
+        return h_new, rh, jnp.concatenate([u, r, c], axis=-1)
+    args = (_t(input), _t(hidden), hold.weight) + \
+        ((hold.bias,) if with_bias else ())
+    return apply("gru_unit", f, args, n_outputs=3)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """The cudnn-style fused LSTM (reference lstm, rnn.py:2439 over
+    cudnn_lstm_op): ``input`` [T, B, D] time-major, ``init_h/init_c``
+    [num_layers*num_directions, B, H]. Maps onto nn.LSTM's single-scan
+    form (the XLA fused-while analog of the cudnn kernel). Returns
+    (rnn_out [T, B, H*ndir], last_h, last_c)."""
+    x = _t(input)
+    if x.ndim != 3:
+        raise InvalidArgumentError(
+            "lstm: input must be [seq_len, batch, input_size] "
+            "(time-major, like the cudnn op)")
+    direction = "bidirectional" if is_bidirec else "forward"
+    net = _holder(name, ("cudnn_lstm", x.shape[-1], hidden_size,
+                         num_layers, is_bidirec),
+                  {})  # parameters live in the nn.LSTM below
+    if not hasattr(net, "rnn"):
+        net.rnn = _paddle.nn.LSTM(x.shape[-1], hidden_size,
+                                  num_layers=num_layers,
+                                  direction=direction, time_major=True,
+                                  dropout=dropout_prob)
+    net.rnn.training = not is_test
+    out, (h, c) = net.rnn(x, (_t(init_h), _t(init_c)))
+    return out, h, c
