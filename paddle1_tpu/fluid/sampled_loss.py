@@ -1,0 +1,212 @@
+"""Sampled large-vocab losses: nce + sampled_softmax_with_cross_entropy.
+
+Reference: /root/reference/python/paddle/fluid/layers/loss.py (nce:644,
+sampled_softmax_with_cross_entropy:1026) over
+paddle/fluid/operators/nce_op.h and sample_logits_op; sampler
+probability formulas from operators/math/sampler.cc
+(uniform: 1/range; log-uniform over range N:
+q(v) = log((v+2)/(v+1)) / log(N+1)).
+
+TPU-native split: class sampling is host-side numpy (static [B, S]
+index arrays, no device round-trip — the reference's CPU Sampler plays
+the same role), while the differentiable scoring (weight-row gather →
+dot → sigmoid → NCE cost, or gathered-logit softmax-CE) is one traced
+op each, so the [B, S, dim] contraction lands on the MXU and autodiff
+covers input/weight/bias without a hand-written grad kernel."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["nce", "sampled_softmax_with_cross_entropy"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_t(x).numpy())
+
+
+def _log_uniform_q(values, n_classes):
+    return (np.log((values + 2.0) / (values + 1.0))
+            / np.log(n_classes + 1.0))
+
+
+def _sample_negatives(rng, shape, sampler, n_classes, custom_dist):
+    """Host-side class sampling (math/sampler.cc semantics, with
+    replacement like the reference's Sample() loop)."""
+    if sampler == "uniform":
+        neg = rng.integers(0, n_classes, size=shape)
+        q = np.full(shape, 1.0 / n_classes, np.float64)
+    elif sampler == "log_uniform":
+        u = rng.random(size=shape)
+        neg = np.minimum(
+            np.exp(u * np.log(n_classes + 1.0)).astype(np.int64) - 1,
+            n_classes - 1)
+        neg = np.maximum(neg, 0)
+        q = _log_uniform_q(neg, n_classes)
+    elif sampler == "custom_dist":
+        if custom_dist is None:
+            raise InvalidArgumentError(
+                "sampler='custom_dist' needs custom_dist= "
+                "(probabilities per class)")
+        p = np.asarray(custom_dist, np.float64)
+        p = p / p.sum()
+        neg = rng.choice(n_classes, size=shape, p=p)
+        q = p[neg]
+    else:
+        raise InvalidArgumentError(
+            f"sampler {sampler!r}; available: uniform, log_uniform, "
+            "custom_dist")
+    return neg.astype(np.int64), q
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False, custom_neg_classes=None):
+    """Noise-contrastive estimation loss (reference loss.py:644 /
+    nce_op.h): per row, the true columns contribute
+    -log(o/(o+b)) and the sampled negatives -log(b/(o+b)) with
+    o = sigmoid(x·w_c + bias_c) and b = q(c)·num_neg. Owns the
+    [num_classes, dim] weight and [num_classes, 1] bias (implicit
+    params). Returns cost [B, 1].
+
+    ``custom_neg_classes`` (the op's unit-test attr) fixes the negative
+    list shared by every row. ``is_sparse`` is accepted for API parity —
+    XLA turns the row gather into a sparse update on its own."""
+    from .layers import _implicit_layer
+    x, lab = _t(input), _t(label)
+    if lab.ndim == 1:
+        from ..ops import manip_ops
+        lab = manip_ops.reshape(lab, [-1, 1])
+    B, dim = x.shape
+    num_true = lab.shape[1]
+    n_neg = 10 if num_neg_samples is None else int(num_neg_samples)
+    hold = _implicit_layer(
+        getattr(param_attr, "name", param_attr) or name,
+        ("nce", num_total_classes, dim, bias_attr is not False),
+        lambda: _make_nce_params(num_total_classes, dim,
+                                 bias_attr is not False))
+    lab_np = _np(lab).astype(np.int64)
+    rng = np.random.default_rng(seed if seed else None)
+    if sampler == "custom_dist" and custom_dist is None:
+        raise InvalidArgumentError(
+            "sampler='custom_dist' needs custom_dist= "
+            "(probabilities per class)")
+    if custom_neg_classes is not None:
+        neg = np.tile(np.asarray(custom_neg_classes, np.int64),
+                      (B, 1))
+        if sampler == "uniform":
+            q_neg = np.full(neg.shape, 1.0 / num_total_classes)
+        elif sampler == "log_uniform":
+            q_neg = _log_uniform_q(neg.astype(np.float64),
+                                   num_total_classes)
+        else:
+            p = np.asarray(custom_dist, np.float64)
+            q_neg = (p / p.sum())[neg]
+    else:
+        neg, q_neg = _sample_negatives(rng, (B, n_neg), sampler,
+                                       num_total_classes, custom_dist)
+    samples = np.concatenate([lab_np, neg], axis=1)  # [B, T+S]
+    if sampler == "uniform":
+        q_true = np.full(lab_np.shape, 1.0 / num_total_classes)
+    elif sampler == "log_uniform":
+        q_true = _log_uniform_q(lab_np.astype(np.float64),
+                                num_total_classes)
+    else:
+        p = np.asarray(custom_dist, np.float64)
+        q_true = (p / p.sum())[lab_np]
+    bvec = (np.concatenate([q_true, q_neg], axis=1)
+            * float(len(neg[0]) if custom_neg_classes is not None
+                    else n_neg)).astype(np.float32)
+    sw = _t(sample_weight) if sample_weight is not None else None
+
+    def f(x, w, *rest):
+        rest = list(rest)
+        bias = rest.pop(0) if hold.bias is not None else None
+        swt = rest.pop(0) if sw is not None else None
+        w_rows = w[samples]                      # [B, T+S, dim]
+        logits = jnp.einsum("bd,bsd->bs", x, w_rows)
+        if bias is not None:
+            logits = logits + bias[samples, 0]
+        o = jax.nn.sigmoid(logits)
+        bq = jnp.asarray(bvec)
+        true_cost = -jnp.log(o / (o + bq))
+        neg_cost = -jnp.log(bq / (o + bq))
+        j = jnp.arange(samples.shape[1])[None, :]
+        cost = jnp.where(j < num_true, true_cost, neg_cost).sum(axis=1)
+        if swt is not None:
+            cost = cost * swt.reshape(-1)
+        return cost[:, None]
+
+    args = [x, hold.weight]
+    if hold.bias is not None:
+        args.append(hold.bias)
+    if sw is not None:
+        args.append(sw)
+    return apply("nce", f, tuple(args))
+
+
+def _make_nce_params(n_classes, dim, with_bias):
+    import paddle1_tpu as _paddle
+    lay = _paddle.nn.Layer()
+    lay.weight = lay.create_parameter([n_classes, dim])
+    lay.bias = lay.create_parameter([n_classes, 1], is_bias=True) \
+        if with_bias else None
+    return lay
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Softmax CE over true + log-uniform-sampled classes (reference
+    loss.py:1026 / sample_logits_op): gathered logits are corrected by
+    -log Q(y|x), accidental negative hits of a true label are pushed to
+    -1e20, and the target is uniform (1/T) over the true columns.
+    Returns loss [N, 1]."""
+    lg, lab = _t(logits), _t(label)
+    N, K = lg.shape
+    T = num_true
+    if lab.shape[1] != T:
+        raise InvalidArgumentError(
+            f"label must be [N, num_true={T}] (got {tuple(lab.shape)})")
+    lab_np = _np(lab).astype(np.int64)
+    if use_customized_samples:
+        samples = np.asarray(_np(customized_samples), np.int64)
+        probs = np.asarray(_np(customized_probabilities), np.float32)
+    else:
+        rng = np.random.default_rng(seed if seed else None)
+        neg, q_neg = _sample_negatives(rng, (N, num_samples),
+                                       "log_uniform", K, None)
+        samples = np.concatenate([lab_np, neg], axis=1)
+        probs = np.concatenate(
+            [_log_uniform_q(lab_np.astype(np.float64), K), q_neg],
+            axis=1).astype(np.float32)
+    if remove_accidental_hits:
+        hit = (samples[:, None, T:] == lab_np[:, :, None]).any(axis=1)
+        hit = np.concatenate(
+            [np.zeros((N, T), bool), hit], axis=1)
+    else:
+        hit = np.zeros(samples.shape, bool)
+
+    def f(lg):
+        s_logits = jnp.take_along_axis(lg, jnp.asarray(samples), axis=1)
+        s_logits = jnp.where(jnp.asarray(hit), s_logits - 1e20,
+                             s_logits)
+        s_logits = s_logits - jnp.log(jnp.asarray(probs))
+        logp = jax.nn.log_softmax(s_logits, axis=-1)
+        return -(logp[:, :T].sum(axis=1) / T)[:, None]
+    return apply("sampled_softmax_with_cross_entropy", f, (lg,))

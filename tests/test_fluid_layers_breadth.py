@@ -72,7 +72,7 @@ class TestNamespaceSweep:
     def test_still_teaching_by_design(self):
         """Program-construction APIs stay loud teaching errors."""
         for n in ("StaticRNN", "DynamicRNN", "While", "Switch",
-                  "py_reader", "nce"):
+                  "py_reader"):
             with pytest.raises(AttributeError):
                 getattr(L, n)
 
