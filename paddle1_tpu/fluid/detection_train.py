@@ -1,0 +1,600 @@
+"""Detection TRAINING ops: rpn_target_assign, generate_proposals,
+ssd_loss, multi_box_head, deformable_conv.
+
+Reference: /root/reference/python/paddle/fluid/layers/detection.py
+(rpn_target_assign:311, ssd_loss:1513, multi_box_head:2106,
+generate_proposals:2894) and layers/nn.py deformable_conv:14236, over
+the C++ kernels in paddle/fluid/operators/detection/
+(rpn_target_assign_op.cc, generate_proposals_op.cc,
+mine_hard_examples_op.cc, bbox_util.h) and
+operators/deformable_conv_op.*.
+
+TPU-native split, same as the reference's own: target assignment,
+sampling, and NMS are data-dependent host logic (the reference pins
+these ops to CPU), while everything that must carry gradient — the
+gathers of predicted scores/locations, the SSD losses, and the
+deformable bilinear sampling — is traced, so autodiff covers the
+training path and the heavy sampling contraction lands on device.
+"""
+
+from __future__ import annotations
+
+import builtins as _bi
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle1_tpu as _paddle
+from ..autograd.engine import apply
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["rpn_target_assign", "generate_proposals", "ssd_loss",
+           "multi_box_head", "deformable_conv"]
+
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_t(x).numpy())
+
+
+def _bbox_overlaps(a, b):
+    """IoU with the +1 pixel offset (bbox_util.h BboxOverlaps)."""
+    aw = (a[:, 2] - a[:, 0] + 1)[:, None]
+    ah = (a[:, 3] - a[:, 1] + 1)[:, None]
+    bw = b[None, :, 2] - b[None, :, 0] + 1
+    bh = b[None, :, 3] - b[None, :, 1] + 1
+    ix = (np.minimum(a[:, None, 2], b[None, :, 2])
+          - np.maximum(a[:, None, 0], b[None, :, 0]) + 1).clip(0)
+    iy = (np.minimum(a[:, None, 3], b[None, :, 3])
+          - np.maximum(a[:, None, 1], b[None, :, 1]) + 1).clip(0)
+    inter = ix * iy
+    return inter / (aw * ah + bw * bh - inter)
+
+
+def _box_to_delta(ex, gt):
+    """bbox_util.h BoxToDelta with normalized=False (+1 offset), no
+    weights — the RPN regression target encoding."""
+    ew = ex[:, 2] - ex[:, 0] + 1
+    eh = ex[:, 3] - ex[:, 1] + 1
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def _reservoir(rng, inds, num, use_random):
+    """rpn_target_assign_op.cc ReservoirSampling."""
+    inds = list(inds)
+    if len(inds) > num:
+        if use_random:
+            for i in _bi.range(num, len(inds)):
+                j = int(np.floor(rng.random() * i))
+                if j < num:
+                    inds[j], inds[i] = inds[i], inds[j]
+        del inds[num:]
+    return inds
+
+
+def _rpn_assign_one(rng, anchors, gt, im_hw_scale, cfg):
+    """Per-image assignment (rpn_target_assign_op.cc Compute body).
+    Returns (loc_index, score_index, labels, tgt_bbox, inside_w) with
+    indices into the FULL anchor list."""
+    (batch_per_im, straddle, fg_frac, pos_ov, neg_ov, use_random) = cfg
+    im_h, im_w, im_scale = im_hw_scale
+    A = anchors.shape[0]
+    if gt.shape[0] == 0:
+        # negative image: no fg, sample background from every anchor
+        bg = _reservoir(rng, list(np.arange(A)), batch_per_im,
+                        use_random)
+        return (np.zeros(0, np.int64),
+                np.asarray(bg, np.int64),
+                np.zeros(len(bg), np.int64),
+                np.zeros((0, 4), np.float32),
+                np.zeros((0, 4), np.float32))
+    if straddle >= 0:
+        inside = np.where(
+            (anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+            & (anchors[:, 2] < im_w + straddle)
+            & (anchors[:, 3] < im_h + straddle))[0]
+    else:
+        inside = np.arange(A)
+    ia = anchors[inside]
+    gt = gt * im_scale
+    overlap = _bbox_overlaps(ia, gt)        # [Ai, G]
+    a2g_max = overlap.max(axis=1)
+    a2g_arg = overlap.argmax(axis=1)
+    g2a_max = overlap.max(axis=0)
+    eps = 1e-5
+    # fg: best-anchor-per-gt OR above threshold (ScoreAssign)
+    best = (np.abs(overlap - g2a_max[None, :]) < eps).any(axis=1)
+    fg_fake = list(np.where(best | (a2g_max >= pos_ov))[0])
+    if fg_frac > 0 and batch_per_im > 0:
+        fg_num = int(fg_frac * batch_per_im)
+        fg_fake = _reservoir(rng, fg_fake, fg_num, use_random)
+    label = -np.ones(ia.shape[0], np.int64)
+    label[fg_fake] = 1
+    fg_fake_num = len(fg_fake)
+    bg_cand = list(np.where(a2g_max < neg_ov)[0])
+    if fg_frac > 0 and batch_per_im > 0:
+        bg_cand = _reservoir(rng, bg_cand,
+                             batch_per_im - fg_fake_num, use_random)
+    # bg may overwrite an fg pick: it stays in loc targets with zero
+    # inside-weight (the reference's fg_fake bookkeeping)
+    fake_extra, inside_w = [], []
+    for j in bg_cand:
+        if label[j] == 1:
+            fake_extra.append(fg_fake[0])
+            inside_w.append(np.zeros(4, np.float32))
+        label[j] = 0
+    fg_inds = list(np.where(label == 1)[0])
+    bg_inds = list(np.where(label == 0)[0])
+    loc_fake = fake_extra + fg_inds
+    inside_w += [np.ones(4, np.float32)] * len(fg_inds)
+    inside_w = (np.stack(inside_w) if inside_w
+                else np.zeros((0, 4), np.float32))
+    gt_idx = a2g_arg[loc_fake]
+    tgt_bbox = _box_to_delta(anchors[inside[loc_fake]], gt[gt_idx]) \
+        if loc_fake else np.zeros((0, 4), np.float32)
+    labels = np.concatenate([np.ones(len(fg_inds), np.int64),
+                             np.zeros(len(bg_inds), np.int64)])
+    loc_index = inside[loc_fake] if loc_fake else np.zeros(0, np.int64)
+    score_index = inside[fg_inds + bg_inds] \
+        if (fg_inds or bg_inds) else np.zeros(0, np.int64)
+    return (loc_index.astype(np.int64), score_index.astype(np.int64),
+            labels, tgt_bbox.astype(np.float32), inside_w)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info, gt_lengths=None,
+                      rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      seed=None):
+    """RPN training targets (reference detection.py:311): sample
+    fg/bg anchors by IoU, encode regression targets, and gather the
+    matching predictions DIFFERENTIABLY. ``bbox_pred`` [N, M, 4],
+    ``cls_logits`` [N, M, 1], ``anchor_box`` [M, 4]; dense LoD:
+    ``gt_boxes`` [N, G, 4] + ``gt_lengths``, ``is_crowd`` [N, G].
+    Returns (pred_scores, pred_loc, tgt_label, tgt_bbox,
+    bbox_inside_weight)."""
+    bp, cl = _t(bbox_pred), _t(cls_logits)
+    anchors = _np(anchor_box).astype(np.float32)
+    gts = _np(gt_boxes).astype(np.float32)
+    crowd = _np(is_crowd).astype(np.int64) if is_crowd is not None \
+        else np.zeros(gts.shape[:2], np.int64)
+    info = _np(im_info).astype(np.float32)
+    N, M = bp.shape[0], bp.shape[1]
+    lens = (_np(gt_lengths).astype(np.int64) if gt_lengths is not None
+            else np.full(N, gts.shape[1], np.int64))
+    rng = np.random.default_rng(seed)
+    cfg = (rpn_batch_size_per_im, rpn_straddle_thresh, rpn_fg_fraction,
+           rpn_positive_overlap, rpn_negative_overlap, use_random)
+    loc_idx, score_idx, labels, tgts, inw = [], [], [], [], []
+    for i in _bi.range(N):
+        g = gts[i, :lens[i]]
+        g = g[crowd[i, :lens[i]] == 0]
+        li, si, lb, tb, iw = _rpn_assign_one(rng, anchors, g, info[i],
+                                             cfg)
+        loc_idx.append(li + i * M)
+        score_idx.append(si + i * M)
+        labels.append(lb)
+        tgts.append(tb)
+        inw.append(iw)
+    loc_idx = np.concatenate(loc_idx)
+    score_idx = np.concatenate(score_idx)
+
+    def gather_loc(bp):
+        return bp.reshape(-1, 4)[loc_idx]
+
+    def gather_score(cl):
+        return cl.reshape(-1, 1)[score_idx]
+    pred_loc = apply("rpn_gather_loc", gather_loc, (bp,))
+    pred_score = apply("rpn_gather_score", gather_score, (cl,))
+    tgt_label = to_tensor(np.concatenate(labels).reshape(-1, 1))
+    tgt_bbox = to_tensor(np.concatenate(tgts))
+    inside_w = to_tensor(np.concatenate(inw))
+    return pred_score, pred_loc, tgt_label, tgt_bbox, inside_w
+
+
+def _nms_with_offset(boxes, scores, thresh, eta=1.0):
+    """Greedy NMS with the +1 pixel offset (generate_proposals's
+    NMS path), adaptive threshold via eta."""
+    order = scores.argsort()[::-1]
+    keep = []
+    adaptive = thresh
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        iou = _bbox_overlaps(boxes[i:i + 1], boxes[order[1:]])[0]
+        order = order[1:][iou <= adaptive]
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors,
+                       variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """RPN proposal generation (reference detection.py:2894 /
+    generate_proposals_op.cc): decode anchor deltas, clip, filter
+    small boxes, NMS, per image. ``scores`` [N, A, H, W],
+    ``bbox_deltas`` [N, 4A, H, W], ``anchors``/``variances``
+    [H, W, A, 4]. Returns (rois [R, 4], roi_probs [R, 1],
+    lengths [N]) — lengths is the dense-LoD row partition (always
+    returned; the reference's return_rois_num flag adds it as
+    rois_num)."""
+    sc = _np(scores).astype(np.float32)
+    bd = _np(bbox_deltas).astype(np.float32)
+    info = _np(im_info).astype(np.float32)
+    anc = _np(anchors).astype(np.float32).reshape(-1, 4)
+    var = _np(variances).astype(np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    all_rois, all_probs, lengths = [], [], []
+    for n in _bi.range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(
+            -1, 4)
+        if 0 < pre_nms_top_n < s.size:
+            top = np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        else:
+            top = np.argsort(-s, kind="stable")
+        s_top, d_top = s[top], d[top]
+        a_top, v_top = anc[top], var[top]
+        aw = a_top[:, 2] - a_top[:, 0] + 1
+        ah = a_top[:, 3] - a_top[:, 1] + 1
+        acx = a_top[:, 0] + 0.5 * aw
+        acy = a_top[:, 1] + 0.5 * ah
+        cx = v_top[:, 0] * d_top[:, 0] * aw + acx
+        cy = v_top[:, 1] * d_top[:, 1] * ah + acy
+        w = np.exp(np.minimum(v_top[:, 2] * d_top[:, 2],
+                              _BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(v_top[:, 3] * d_top[:, 3],
+                              _BBOX_CLIP)) * ah
+        props = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+        im_h, im_w, im_scale = info[n]
+        props[:, 0] = props[:, 0].clip(0, im_w - 1)
+        props[:, 1] = props[:, 1].clip(0, im_h - 1)
+        props[:, 2] = props[:, 2].clip(0, im_w - 1)
+        props[:, 3] = props[:, 3].clip(0, im_h - 1)
+        ms = max(min_size, 1.0)
+        ws = (props[:, 2] - props[:, 0]) / im_scale + 1
+        hs = (props[:, 3] - props[:, 1]) / im_scale + 1
+        cx_ok = props[:, 0] + (props[:, 2] - props[:, 0] + 1) / 2 <= im_w
+        cy_ok = props[:, 1] + (props[:, 3] - props[:, 1] + 1) / 2 <= im_h
+        keep = np.where((ws >= ms) & (hs >= ms) & cx_ok & cy_ok)[0]
+        props, s_keep = props[keep], s_top[keep]
+        if props.shape[0]:
+            k = _nms_with_offset(props, s_keep, nms_thresh, eta)
+            if post_nms_top_n > 0:
+                k = k[:post_nms_top_n]
+            props, s_keep = props[k], s_keep[k]
+        all_rois.append(props)
+        all_probs.append(s_keep.reshape(-1, 1))
+        lengths.append(props.shape[0])
+    rois = to_tensor(np.concatenate(all_rois).astype(np.float32))
+    probs = to_tensor(np.concatenate(all_probs).astype(np.float32))
+    lens = to_tensor(np.asarray(lengths, np.int64))
+    return rois, probs, lens
+
+
+def _softmax_ce_np(logits, labels):
+    m = logits - logits.max(axis=-1, keepdims=True)
+    logp = m - np.log(np.exp(m).sum(axis=-1, keepdims=True))
+    return -np.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, gt_lengths=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """SSD multibox loss (reference detection.py:1513): bipartite (+
+    per-prediction) matching, max-negative hard mining on the conf
+    loss, encoded regression targets, smooth-L1 + softmax-CE, weighted
+    and normalized. ``location`` [N, Np, 4], ``confidence``
+    [N, Np, C], ``gt_box`` [N, G, 4] (+``gt_lengths``), ``gt_label``
+    [N, G] or [N, G, 1], ``prior_box`` [Np, 4] normalized. Returns
+    loss [N, 1]."""
+    if mining_type != "max_negative":
+        raise InvalidArgumentError(
+            "Only mining_type='max_negative' is supported (the "
+            "reference python wrapper enforces the same)")
+    loc, conf = _t(location), _t(confidence)
+    gtb = _np(gt_box).astype(np.float32)
+    gtl = _np(gt_label).astype(np.int64).reshape(gtb.shape[0], -1)
+    pb = _np(prior_box).astype(np.float32)
+    pv = (_np(prior_box_var).astype(np.float32)
+          if prior_box_var is not None
+          else np.ones_like(pb))
+    N, P = loc.shape[0], loc.shape[1]
+    lens = (_np(gt_lengths).astype(np.int64) if gt_lengths is not None
+            else np.full(N, gtb.shape[1], np.int64))
+    conf_np = _np(conf)
+    pw = pb[:, 2] - pb[:, 0]
+    ph = pb[:, 3] - pb[:, 1]
+    pcx = pb[:, 0] + 0.5 * pw
+    pcy = pb[:, 1] + 0.5 * ph
+    tgt_label = np.full((N, P), background_label, np.int64)
+    tgt_bbox = np.zeros((N, P, 4), np.float32)
+    loc_w = np.zeros((N, P), np.float32)
+    conf_w = np.zeros((N, P), np.float32)
+    for n in _bi.range(N):
+        g = gtb[n, :lens[n]]
+        gl = gtl[n, :lens[n]]
+        if g.shape[0] == 0:
+            continue
+        # normalized IoU (no +1 offset): SSD boxes are in [0, 1]
+        ix = (np.minimum(g[:, None, 2], pb[None, :, 2])
+              - np.maximum(g[:, None, 0], pb[None, :, 0])).clip(0)
+        iy = (np.minimum(g[:, None, 3], pb[None, :, 3])
+              - np.maximum(g[:, None, 1], pb[None, :, 1])).clip(0)
+        inter = ix * iy
+        ga = ((g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1]))[:, None]
+        pa = ((pb[:, 2] - pb[:, 0]) * (pb[:, 3] - pb[:, 1]))[None, :]
+        iou = inter / np.maximum(ga + pa - inter, 1e-12)
+        # bipartite + per_prediction (bipartite_match_op)
+        match = -np.ones(P, np.int64)
+        dist = np.zeros(P, np.float32)
+        work = iou.copy()
+        for _ in _bi.range(min(iou.shape[0], P)):
+            i, j = np.unravel_index(np.argmax(work), work.shape)
+            if work[i, j] <= 0:
+                break
+            match[j], dist[j] = i, iou[i, j]
+            work[i, :] = -1
+            work[:, j] = -1
+        if match_type == "per_prediction":
+            for j in np.where(match < 0)[0]:
+                i = int(np.argmax(iou[:, j]))
+                if iou[i, j] >= overlap_threshold:
+                    match[j], dist[j] = i, iou[i, j]
+        pos = match >= 0
+        num_pos = int(pos.sum())
+        # mine_hard_examples_op max_negative
+        cls_loss = _softmax_ce_np(
+            conf_np[n], np.where(pos, gtl[n][match.clip(0)],
+                                 background_label))
+        elig = np.where((match == -1) & (dist < neg_overlap))[0]
+        neg_sel = min(int(num_pos * neg_pos_ratio), elig.size)
+        neg = elig[np.argsort(-cls_loss[elig], kind="stable")[:neg_sel]]
+        # targets
+        tgt_label[n][pos] = gl[match[pos]]
+        conf_w[n][pos] = 1.0
+        conf_w[n][neg] = 1.0
+        # encode_center_size with prior variance
+        mg = g[match[pos]]
+        gw = mg[:, 2] - mg[:, 0]
+        gh = mg[:, 3] - mg[:, 1]
+        gcx = mg[:, 0] + 0.5 * gw
+        gcy = mg[:, 1] + 0.5 * gh
+        sel = np.where(pos)[0]
+        tgt_bbox[n, sel, 0] = (gcx - pcx[sel]) / pw[sel] / pv[sel, 0]
+        tgt_bbox[n, sel, 1] = (gcy - pcy[sel]) / ph[sel] / pv[sel, 1]
+        tgt_bbox[n, sel, 2] = np.log(gw / pw[sel]) / pv[sel, 2]
+        tgt_bbox[n, sel, 3] = np.log(gh / ph[sel]) / pv[sel, 3]
+        loc_w[n][pos] = 1.0
+
+    def f(loc, conf):
+        lc = loc.reshape(N * P, 4)
+        cf = conf.reshape(N * P, -1)
+        tb = jnp.asarray(tgt_bbox.reshape(N * P, 4))
+        # smooth_l1 (sigma=1), summed per row
+        d = lc - tb
+        sl = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                       jnp.abs(d) - 0.5).sum(axis=1, keepdims=True)
+        sl = sl * jnp.asarray(loc_w.reshape(N * P, 1))
+        logp = jax.nn.log_softmax(cf, axis=-1)
+        ce = -jnp.take_along_axis(
+            logp, jnp.asarray(tgt_label.reshape(N * P, 1)), axis=1)
+        ce = ce * jnp.asarray(conf_w.reshape(N * P, 1))
+        loss = (conf_loss_weight * ce + loc_loss_weight * sl).reshape(
+            N, P).sum(axis=1, keepdims=True)
+        if normalize:
+            loss = loss / jnp.maximum(loc_w.sum(), 1e-6)
+        return loss
+    return apply("ssd_loss", f, (loc, conf))
+
+
+def multi_box_head(inputs, image, base_size, num_classes,
+                   aspect_ratios, min_ratio=None, max_ratio=None,
+                   min_sizes=None, max_sizes=None, steps=None,
+                   step_w=None, step_h=None, offset=0.5,
+                   variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1,
+                   name=None, min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (reference
+    detection.py:2106): per input, conv heads for loc/conf + prior
+    boxes; concatenated across maps. Returns (mbox_loc [N, M, 4],
+    mbox_conf [N, M, C], boxes [M, 4], variances [M, 4])."""
+    from .layers import _implicit_layer
+    from ..ops import manip_ops
+    from ..vision.ops import prior_box as _prior_box
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # ratio interpolation (reference lines: min_ratio..max_ratio
+        # split over the in-between layers; first layer base*0.1)
+        min_sizes, max_sizes = [], []
+        # reference formula needs >= 3 maps; with fewer, one ratio
+        # bucket covers the whole [min_ratio, max_ratio] span
+        step = (int(np.floor((max_ratio - min_ratio) / (n_layer - 2)))
+                if n_layer > 2 else (max_ratio - min_ratio + 1))
+        for ratio in _bi.range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, x in enumerate(inputs):
+        x = _t(x)
+        ms = min_sizes[i]
+        xs = max_sizes[i] if max_sizes else None
+        ms = [ms] if not isinstance(ms, (list, tuple)) else list(ms)
+        xs = ([xs] if xs is not None
+              and not isinstance(xs, (list, tuple)) else xs)
+        ar = aspect_ratios[i]
+        ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
+        st = (steps[i] if steps
+              else ((step_w[i] if step_w else 0.0),
+                    (step_h[i] if step_h else 0.0)))
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)
+        box, var = _prior_box(x, _t(image), ms, xs, ar, variance,
+                              flip, clip, st, offset)
+        box = manip_ops.reshape(box, [-1, 4])
+        var = manip_ops.reshape(var, [-1, 4])
+        boxes_l.append(box)
+        vars_l.append(var)
+        num_priors = box.shape[0] // (x.shape[2] * x.shape[3])
+        in_ch = x.shape[1]
+        conv_loc = _implicit_layer(
+            (name or "") + f"_loc{i}" if name else None,
+            ("mbox_loc", i, in_ch, num_priors, kernel_size),
+            lambda in_ch=in_ch, num_priors=num_priors:
+            _paddle.nn.Conv2D(in_ch, num_priors * 4, kernel_size,
+                              stride=stride, padding=pad))
+        conv_conf = _implicit_layer(
+            (name or "") + f"_conf{i}" if name else None,
+            ("mbox_conf", i, in_ch, num_priors, kernel_size,
+             num_classes),
+            lambda in_ch=in_ch, num_priors=num_priors:
+            _paddle.nn.Conv2D(in_ch, num_priors * num_classes,
+                              kernel_size, stride=stride, padding=pad))
+        loc = conv_loc(x)       # [N, P*4, H, W]
+        conf = conv_conf(x)     # [N, P*C, H, W]
+        loc = manip_ops.reshape(
+            manip_ops.transpose(loc, [0, 2, 3, 1]),
+            [x.shape[0], -1, 4])
+        conf = manip_ops.reshape(
+            manip_ops.transpose(conf, [0, 2, 3, 1]),
+            [x.shape[0], -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+    mbox_loc = manip_ops.concat(locs, axis=1)
+    mbox_conf = manip_ops.concat(confs, axis=1)
+    boxes = manip_ops.concat(boxes_l, axis=0)
+    variances = manip_ops.concat(vars_l, axis=0)
+    return mbox_loc, mbox_conf, boxes, variances
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """Deformable convolution v1/v2 (reference layers/nn.py:14236,
+    operators/deformable_conv_op): each kernel tap samples the input
+    at a learned fractional offset (bilinear), v2 additionally
+    modulates by ``mask``. ``offset`` [N, 2*dg*kh*kw, Ho, Wo] with
+    (y, x) interleaved per tap; ``mask`` [N, dg*kh*kw, Ho, Wo].
+
+    Traced end-to-end: the sampling is a differentiable gather and the
+    tap contraction is one einsum — the im2col+GEMM structure of the
+    reference kernel expressed for the MXU."""
+    from .layers import _implicit_layer
+    x, off = _t(input), _t(offset)
+    msk = _t(mask) if (modulated and mask is not None) else None
+    kh, kw = (filter_size if isinstance(filter_size, (list, tuple))
+              else (filter_size, filter_size))
+    sh, sw = (stride if isinstance(stride, (list, tuple))
+              else (stride, stride))
+    ph_, pw_ = (padding if isinstance(padding, (list, tuple))
+                else (padding, padding))
+    dh, dw = (dilation if isinstance(dilation, (list, tuple))
+              else (dilation, dilation))
+    N, C, H, W = x.shape
+    dg = deformable_groups
+    Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    hold = _implicit_layer(
+        name, ("deformable_conv", C, num_filters, kh, kw, groups),
+        lambda: _make_dcn_params(C, num_filters, kh, kw, groups,
+                                 bias_attr))
+
+    def f(x, off, *rest):
+        rest = list(rest)
+        m = rest.pop(0) if msk is not None else None
+        w = rest.pop(0)
+        b = rest.pop(0) if hold.bias is not None else None
+        # base sampling grid per output position and tap
+        ys = jnp.arange(Ho) * sh - ph_
+        xs = jnp.arange(Wo) * sw - pw_
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = ys[:, None, None, None] + ky[None, None, :, None]
+        base_x = xs[None, :, None, None] + kx[None, None, None, :]
+        # offsets: [N, dg, kh, kw, 2, Ho, Wo] (y then x per tap)
+        o = off.reshape(N, dg, kh, kw, 2, Ho, Wo)
+        py = base_y.transpose(2, 3, 0, 1)[None, None] + o[:, :, :, :, 0]
+        px = base_x.transpose(2, 3, 0, 1)[None, None] + o[:, :, :, :, 1]
+        # bilinear sample: [N, dg, kh, kw, Ho, Wo] positions over
+        # x [N, C, H, W] with channels split into dg groups
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+        xg = x.reshape(N, dg, C // dg, H, W)
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            # index: [N, dg, kh, kw, Ho, Wo] → per (n, dg) flat gather
+            flat = xg.reshape(N, dg, C // dg, H * W)
+            idx = (yc * W + xc).reshape(N, dg, 1, -1)
+            got = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx,
+                                       (N, dg, C // dg, idx.shape[-1])),
+                axis=3)
+            got = got.reshape(N, dg, C // dg, kh, kw, Ho, Wo)
+            inb = ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                   & (xi <= W - 1))[:, :, None]
+            return got * inb.reshape(N, dg, 1, kh, kw, Ho, Wo)
+        v = ((1 - wy) * (1 - wx))[:, :, None] * gather(y0, x0) \
+            + ((1 - wy) * wx)[:, :, None] * gather(y0, x0 + 1) \
+            + (wy * (1 - wx))[:, :, None] * gather(y0 + 1, x0) \
+            + (wy * wx)[:, :, None] * gather(y0 + 1, x0 + 1)
+        if m is not None:
+            v = v * m.reshape(N, dg, 1, kh, kw, Ho, Wo)
+        col = v.reshape(N, C, kh, kw, Ho, Wo)
+        # grouped contraction: w [F, C/g, kh, kw]
+        cg = col.reshape(N, groups, C // groups, kh, kw, Ho, Wo)
+        wg = w.reshape(groups, num_filters // groups, C // groups,
+                       kh, kw)
+        out = jnp.einsum("ngcklhw,gfckl->ngfhw", cg, wg).reshape(
+            N, num_filters, Ho, Wo)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    args = [x, off]
+    if msk is not None:
+        args.append(msk)
+    args.append(hold.weight)
+    if hold.bias is not None:
+        args.append(hold.bias)
+    return apply("deformable_conv", f, tuple(args))
+
+
+def _make_dcn_params(C, F, kh, kw, groups, bias_attr):
+    lay = _paddle.nn.Layer()
+    lay.weight = lay.create_parameter([F, C // groups, kh, kw])
+    lay.bias = (lay.create_parameter([F], is_bias=True)
+                if bias_attr is not False else None)
+    return lay
