@@ -137,13 +137,21 @@ class RNGStatesTracker:
 
     def __init__(self):
         self._states: Dict[str, Generator] = {}
+        self._seeds: set = set()
 
     def reset(self, base_seed: Optional[int] = None) -> None:
         self._states.clear()
+        self._seeds.clear()
 
     def add(self, name: str, seed_: int) -> None:
         if name in self._states:
             raise AlreadyExistsError(f"RNG state {name!r} already exists")
+        if seed_ in self._seeds:
+            # reference random.py:40 — two streams sharing a seed would
+            # silently draw identical masks, the exact bug this guards
+            raise AlreadyExistsError(f"RNG seed {seed_} already used by "
+                                     "another tracked state")
+        self._seeds.add(seed_)
         self._states[name] = Generator(seed_)
 
     @contextlib.contextmanager
@@ -151,9 +159,26 @@ class RNGStatesTracker:
         if name not in self._states:
             raise NotFoundError(
                 f"RNG state {name!r} not registered; call add() first")
+        gen = self._states[name]
+        if in_rng_scope():
+            # jit path: stay functional — derive a per-name subkey from
+            # the scope key so the trace is deterministic in its key
+            # argument and distinct per tracked stream. The OUTER
+            # counter advances too, so repeated rng_state regions in
+            # one trace (the per-layer dropout pattern) draw distinct
+            # subkeys instead of restarting the same stream.
+            scope = getattr(_tls, "scope", None)
+            n = scope[1]
+            scope[1] = n + 1
+            sub = jax.random.fold_in(
+                jax.random.fold_in(scope[0], n),
+                gen.initial_seed & 0x7FFFFFFF)
+            with rng_scope(sub):
+                yield
+            return
         global default_generator
         prev = default_generator
-        default_generator = self._states[name]
+        default_generator = gen
         try:
             yield
         finally:

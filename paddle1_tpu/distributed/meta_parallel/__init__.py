@@ -9,9 +9,12 @@ from .model_parallel import ModelParallel
 from .pipeline_parallel import PipelineParallel
 from .hybrid_optimizer import (HybridParallelGradScaler,
                                HybridParallelOptimizer)
+from .random import (get_rng_state_tracker, model_parallel_random_seed,
+                     RNGStatesTracker)
 
 __all__ = ["ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
            "SharedLayerDesc", "PipelineLayer", "SegmentLayers",
            "ModelParallel", "PipelineParallel", "HybridParallelOptimizer",
-           "HybridParallelGradScaler"]
+           "HybridParallelGradScaler", "get_rng_state_tracker",
+           "model_parallel_random_seed", "RNGStatesTracker"]

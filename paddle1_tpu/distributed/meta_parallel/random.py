@@ -1,0 +1,45 @@
+"""Tensor-parallel RNG wiring (reference
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+random.py:23-77).
+
+Two kinds of randomness coexist under tensor parallelism: ops on
+REPLICATED tensors (weight init, dropout before the split) must draw
+identical values on every mp rank, while dropout on mp-SHARDED
+activations must draw a distinct mask per rank — otherwise the "random"
+mask is correlated across the hidden dimension. The tracker provides
+both: the default stream is seeded identically everywhere
+(``paddle.seed(global)``), and the ``model_parallel_rng`` tracked
+stream is seeded per-rank; wrap sharded-region dropout in
+``get_rng_state_tracker().rng_state()`` exactly as in the reference
+(e.g. inside the ColumnParallel->dropout->RowParallel MLP block).
+
+TPU note: under jit tracing the tracked stream stays functional — the
+per-name subkey is folded from the ``rng_scope`` key, so the compiled
+step is deterministic in its key argument on every rank while still
+decorrelated across ranks.
+"""
+
+from __future__ import annotations
+
+from ...core.generator import (MODEL_PARALLEL_RNG, RNGStatesTracker,
+                               get_rng_tracker, seed as _seed_all)
+
+__all__ = ["get_rng_state_tracker", "model_parallel_random_seed",
+           "RNGStatesTracker", "MODEL_PARALLEL_RNG"]
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """The reference's spelling for the global tracker."""
+    return get_rng_tracker()
+
+
+def model_parallel_random_seed(seed: int = 2048) -> None:
+    """Seed the replicated stream with ``seed`` and register the
+    per-rank ``model_parallel_rng`` stream at ``seed + 1024 + mp_rank``
+    (reference random.py:66)."""
+    from ..topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg is not None else 0
+    local_seed = seed + 1024 + rank
+    _seed_all(seed)  # also resets the tracker
+    get_rng_tracker().add(MODEL_PARALLEL_RNG, local_seed)
