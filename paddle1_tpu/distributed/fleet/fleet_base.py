@@ -299,6 +299,8 @@ class Fleet:
         self._ensure_init()
         if strategy is not None:
             self._user_defined_strategy = strategy
+        from .meta_optimizers import apply_optimizer_meta
+        optimizer = apply_optimizer_meta(optimizer, self._strategy)
         from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 

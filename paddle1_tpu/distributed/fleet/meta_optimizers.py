@@ -100,6 +100,43 @@ def compile_strategy(strategy: DistributedStrategy,
             "recompute": bool(conf.get("recompute"))}
 
 
+def apply_optimizer_meta(optimizer, strategy: DistributedStrategy):
+    """The lars/lamb meta-optimizer rewrites (reference
+    meta_optimizers/lars_optimizer.py, lamb_optimizer.py): with
+    ``strategy.lars`` a plain Momentum optimizer is swapped for LARS
+    (and Adam for Lamb under ``strategy.lamb``), keeping lr/momentum/
+    parameter list. Other optimizer types pass through unchanged, as
+    the reference's can_apply gate does."""
+    from ...optimizer import Adam, Lamb, Lars, Momentum
+    conf = strategy.to_dict()
+    if conf.get("lars") and type(optimizer) is Momentum:
+        lc = conf.get("lars_configs", {}) or {}
+        return Lars(learning_rate=optimizer._learning_rate,
+                    momentum=optimizer._momentum,
+                    parameters=optimizer._parameter_list,
+                    lars_coeff=float(lc.get("lars_coeff", 0.001)),
+                    lars_weight_decay=float(
+                        lc.get("lars_weight_decay", 0.0005)),
+                    epsilon=float(lc.get("epsilon", 1e-9)),
+                    # carry the user's regularization through the swap
+                    # (reference lars meta-opt passes regularization=)
+                    weight_decay=optimizer._weight_decay or None,
+                    grad_clip=optimizer._grad_clip)
+    if conf.get("lamb") and type(optimizer) is Adam:
+        lc = conf.get("lamb_configs", {}) or {}
+        # LAMB's decay is its own decoupled term: an Adam weight_decay
+        # becomes the lamb_weight_decay unless lamb_configs overrides
+        wd = lc.get("lamb_weight_decay",
+                    optimizer._weight_decay or 0.01)
+        return Lamb(learning_rate=optimizer._learning_rate,
+                    beta1=optimizer._beta1, beta2=optimizer._beta2,
+                    epsilon=optimizer._epsilon,
+                    parameters=optimizer._parameter_list,
+                    lamb_weight_decay=float(wd),
+                    grad_clip=optimizer._grad_clip)
+    return optimizer
+
+
 class _WrappedOptimizer:
     """Shared plumbing: delegate everything, intercept step()."""
 

@@ -6,9 +6,10 @@ lives in incubate)."""
 from __future__ import annotations
 
 from ..optimizer import (SGD, AdaDelta, Adagrad, Adam, Adamax, AdamW,
-                         Lamb, Momentum, RMSProp)
+                         Ftrl, Lamb, Lars, Momentum, RMSProp)
 
 Adadelta = AdaDelta
+LarsMomentum = Lars
 from ..incubate.optimizer import (ExponentialMovingAverage, LookAhead,
                                   ModelAverage)
 
@@ -21,10 +22,13 @@ AdadeltaOptimizer = Adadelta
 RMSPropOptimizer = RMSProp
 LambOptimizer = Lamb
 LookaheadOptimizer = LookAhead
+FtrlOptimizer = Ftrl
+LarsMomentumOptimizer = Lars
 
 __all__ = ["SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
            "Adagrad", "AdagradOptimizer", "Adam", "AdamOptimizer",
            "Adamax", "AdamaxOptimizer", "Adadelta", "AdadeltaOptimizer",
            "RMSProp", "RMSPropOptimizer", "Lamb", "LambOptimizer",
            "AdamW", "ExponentialMovingAverage", "ModelAverage",
-           "LookAhead", "LookaheadOptimizer"]
+           "LookAhead", "LookaheadOptimizer", "Ftrl", "FtrlOptimizer",
+           "LarsMomentum", "LarsMomentumOptimizer"]

@@ -1,5 +1,5 @@
 """paddle1_tpu.optimizer (reference python/paddle/optimizer analog)."""
 
 from . import lr
-from .optimizer import (SGD, AdaDelta, Adagrad, Adam, Adamax, AdamW, Lamb,
-                        Lars, Momentum, Optimizer, RMSProp)
+from .optimizer import (SGD, AdaDelta, Adagrad, Adam, Adamax, AdamW,
+                        Ftrl, Lamb, Lars, Momentum, Optimizer, RMSProp)

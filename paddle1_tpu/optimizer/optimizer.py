@@ -282,24 +282,68 @@ class Lars(Momentum):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=1e-9,
-                 grad_clip=None, exclude_from_weight_decay=None, name=None):
+                 weight_decay=None, grad_clip=None,
+                 exclude_from_weight_decay=None, name=None):
         super().__init__(learning_rate, momentum, parameters,
-                         grad_clip=grad_clip)
+                         weight_decay=weight_decay, grad_clip=grad_clip)
         self._lars_coeff = lars_coeff
         self._lars_wd = lars_weight_decay
         self._epsilon = epsilon
 
     def _update(self, param, grad, slots, lr, step):
+        # user regularization applies BEFORE the LARS math (reference
+        # LarsMomentumOptimizer: regularization ops precede the op,
+        # which then adds its own lars_weight_decay term)
+        grad = self._l2(grad, param)
         p_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
         g_norm = jnp.sqrt(jnp.sum(jnp.square(grad)))
+        # lars_momentum_op.h: the adaptive rate applies only when
+        # weight decay is on AND both norms are positive; otherwise the
+        # update degrades to plain momentum at the base lr
+        adaptive = (self._lars_wd > 0)
         local_lr = jnp.where(
-            (p_norm > 0) & (g_norm > 0),
+            adaptive & (p_norm > 0) & (g_norm > 0),
             self._lars_coeff * p_norm /
             (g_norm + self._lars_wd * p_norm + self._epsilon),
             1.0)
         v = self._momentum * slots["velocity"] + lr * local_lr * (
             grad + self._lars_wd * param)
         return param - v, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference ftrl_op.h): per-coordinate adaptive
+    rates from the squared-gradient accumulator, L1 shrinkage through
+    the linear accumulator. The reference kernel adds 1e-10 to both
+    regularizers; kept for bit-parity."""
+
+    _slot_names = ("squared", "linear")
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0,
+                 lr_power=-0.5, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._ftrl_l1 = float(l1) + 1e-10
+        self._ftrl_l2 = float(l2) + 1e-10
+        self._lr_power = float(lr_power)
+
+    def _update(self, param, grad, slots, lr, step):
+        grad = self._l2(grad, param)
+        l1, l2 = self._ftrl_l1, self._ftrl_l2
+        sq, lin = slots["squared"], slots["linear"]
+        new_sq = sq + grad * grad
+        p = self._lr_power
+        if p == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+            y = jnp.sqrt(new_sq) / lr + 2.0 * l2
+        else:
+            sigma = (new_sq ** (-p) - sq ** (-p)) / lr
+            y = new_sq ** (-p) / lr + 2.0 * l2
+        new_lin = lin + grad - sigma * param
+        x = l1 * jnp.sign(new_lin) - new_lin
+        new_p = jnp.where(jnp.abs(new_lin) > l1, x / y, 0.0)
+        return new_p, {"squared": new_sq, "linear": new_lin}
 
 
 class Adagrad(Optimizer):
