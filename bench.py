@@ -260,8 +260,12 @@ def main():
     args = ap.parse_args()
 
     if not _probe_tpu():
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   " --xla_force_host_platform_device_count=1")
+        # the collective bench needs a multi-device mesh to smoke its
+        # psum path; every other config falls back to one host device
+        count = 8 if args.config == "allreduce_busbw" else 1
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={count}")
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax

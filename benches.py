@@ -279,3 +279,71 @@ def bench_yolov3_infer(on_tpu):
            "postprocess_ms_per_batch": round(post_ms, 2),
            "detections_img0": int(np.asarray(
                results[0][0].numpy()).shape[0]) if results else 0})
+
+
+@config("allreduce_busbw")
+def bench_allreduce_busbw(on_tpu, batch_override=None):
+    """BASELINE primary metric's fleet half: allreduce bus bandwidth.
+
+    Payload sweep of in-graph ``psum`` over every visible device
+    (nccl-tests conventions: algbw = per-rank payload / time,
+    busbw = algbw * 2(n-1)/n — the wire traffic of a ring). On one
+    chip there is no ICI to measure: the run still executes (the
+    numbers are the on-device reduction path) but is loudly marked
+    ``blocked: single-chip``. On the virtual CPU mesh this smokes the
+    full multi-device path; real numbers land whenever multi-chip
+    hardware exists."""
+    import statistics
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs), ("x",))
+    sizes_mb = [1, 4, 16, 64] if on_tpu else [1, 4]
+    if batch_override:  # --batch reinterprets as max payload MB
+        sizes_mb = [m for m in sizes_mb if m <= batch_override] \
+            or [batch_override]
+    sweep = []
+    for mb in sizes_mb:
+        elems = mb * (1 << 20) // 4
+        x = jax.device_put(
+            jnp.ones((n, elems), jnp.float32),
+            NamedSharding(mesh, P("x", None)))
+
+        @jax.jit
+        def allreduce(v):
+            return shard_map(
+                lambda s: jax.lax.psum(s, "x") * (1.0 / n),
+                mesh=mesh, in_specs=P("x", None),
+                out_specs=P("x", None))(v)
+
+        state = {"x": x}
+
+        def step_fn():
+            state["x"] = allreduce(state["x"])  # chained dependency
+            return state["x"]
+
+        _read_back(allreduce(x))  # compile outside the timing
+        times, _ = _timed_steps(step_fn, 8 if on_tpu else 4)
+        dt = statistics.median(times)
+        payload = elems * 4  # bytes per rank
+        algbw = payload / dt
+        busbw = algbw * (2 * (n - 1) / n)
+        sweep.append({"payload_mb": mb,
+                      "time_us": round(dt * 1e6, 1),
+                      "algbw_gbps": round(algbw / 1e9, 3),
+                      "busbw_gbps": round(busbw / 1e9, 3)})
+    best = max(s["busbw_gbps"] for s in sweep)
+    detail = {"device": str(devs[0].device_kind
+                            if hasattr(devs[0], "device_kind")
+                            else devs[0].platform),
+              "n_devices": n, "sweep": sweep,
+              "convention": "nccl-tests: busbw = algbw * 2(n-1)/n"}
+    if n == 1:
+        detail["blocked"] = ("single-chip: no ICI to measure — busbw "
+                             "is 0 by the ring formula; sweep times "
+                             "are the on-device reduction path only")
+    _emit("fleet_allreduce_busbw", best, "GB/s", 1.0, detail)
