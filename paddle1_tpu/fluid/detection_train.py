@@ -31,7 +31,9 @@ from ..core.errors import InvalidArgumentError
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = ["rpn_target_assign", "generate_proposals", "ssd_loss",
-           "multi_box_head", "deformable_conv"]
+           "multi_box_head", "deformable_conv",
+           "retinanet_target_assign", "retinanet_detection_output",
+           "generate_proposal_labels", "generate_mask_labels"]
 
 _BBOX_CLIP = float(np.log(1000.0 / 16.0))
 
@@ -280,7 +282,17 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors,
         cy_ok = props[:, 1] + (props[:, 3] - props[:, 1] + 1) / 2 <= im_h
         keep = np.where((ws >= ms) & (hs >= ms) & cx_ok & cy_ok)[0]
         props, s_keep = props[keep], s_top[keep]
-        if props.shape[0]:
+        if props.shape[0] == 0:
+            # keep-the-graph-alive contract (generate_proposals_op.cc
+            # keep_num==0 branch): one zero box, score 0
+            props = np.zeros((1, 4), np.float32)
+            s_keep = np.zeros(1, np.float32)
+        elif nms_thresh <= 0:
+            # reference skips NMS entirely for non-positive thresholds
+            if post_nms_top_n > 0:
+                props = props[:post_nms_top_n]
+                s_keep = s_keep[:post_nms_top_n]
+        else:
             k = _nms_with_offset(props, s_keep, nms_thresh, eta)
             if post_nms_top_n > 0:
                 k = k[:post_nms_top_n]
@@ -598,3 +610,340 @@ def _make_dcn_params(C, F, kh, kw, groups, bias_attr):
     lay.bias = (lay.create_parameter([F], is_bias=True)
                 if bias_attr is not False else None)
     return lay
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
+                            anchor_var, gt_boxes, gt_labels, is_crowd,
+                            im_info, num_classes=1, gt_lengths=None,
+                            positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet training targets (reference detection.py:3106 /
+    rpn_target_assign_op.cc retinanet branch): NO subsampling — every
+    anchor above ``positive_overlap`` (or best-per-gt) is fg with its
+    gt CLASS label, everything under ``negative_overlap`` is bg
+    (label 0); returns the focal-loss normalizer fg_num = #fg + 1 per
+    image. ``cls_logits`` [N, M, C]. Outputs (pred_scores [S, C],
+    pred_loc, target_label [S, 1], target_bbox, bbox_inside_weight,
+    fg_num [N, 1])."""
+    bp, cl = _t(bbox_pred), _t(cls_logits)
+    anchors = _np(anchor_box).astype(np.float32)
+    gts = _np(gt_boxes).astype(np.float32)
+    gtl = _np(gt_labels).astype(np.int64).reshape(gts.shape[0], -1)
+    crowd = _np(is_crowd).astype(np.int64) if is_crowd is not None \
+        else np.zeros(gts.shape[:2], np.int64)
+    info = _np(im_info).astype(np.float32)
+    N, M = bp.shape[0], bp.shape[1]
+    C = cl.shape[-1]
+    lens = (_np(gt_lengths).astype(np.int64) if gt_lengths is not None
+            else np.full(N, gts.shape[1], np.int64))
+    loc_idx, score_idx, labels, tgts, inw, fg_nums = \
+        [], [], [], [], [], []
+    for i in _bi.range(N):
+        keep = crowd[i, :lens[i]] == 0
+        g = gts[i, :lens[i]][keep]
+        gl = gtl[i, :lens[i]][keep]
+        im_h, im_w, im_scale = info[i]
+        if g.shape[0] == 0:
+            bg = np.arange(M)
+            score_idx.append(bg + i * M)
+            labels.append(np.zeros(M, np.int64))
+            loc_idx.append(np.zeros(0, np.int64))
+            tgts.append(np.zeros((0, 4), np.float32))
+            inw.append(np.zeros((0, 4), np.float32))
+            fg_nums.append(1)
+            continue
+        overlap = _bbox_overlaps(anchors, g * im_scale)
+        a2g_max = overlap.max(axis=1)
+        a2g_arg = overlap.argmax(axis=1)
+        g2a_max = overlap.max(axis=0)
+        best = (np.abs(overlap - g2a_max[None, :]) < 1e-5).any(axis=1)
+        fg = np.where(best | (a2g_max >= positive_overlap))[0]
+        bg = np.where(a2g_max < negative_overlap)[0]
+        bg = np.setdiff1d(bg, fg, assume_unique=False)
+        lab = np.concatenate([gl[a2g_arg[fg]],
+                              np.zeros(bg.size, np.int64)])
+        tb = _box_to_delta(anchors[fg], (g * im_scale)[a2g_arg[fg]]) \
+            if fg.size else np.zeros((0, 4), np.float32)
+        loc_idx.append(fg + i * M)
+        score_idx.append(np.concatenate([fg, bg]) + i * M)
+        labels.append(lab)
+        tgts.append(tb.astype(np.float32))
+        inw.append(np.ones((fg.size, 4), np.float32))
+        fg_nums.append(int(fg.size) + 1)
+    loc_idx = np.concatenate(loc_idx)
+    score_idx = np.concatenate(score_idx)
+
+    pred_loc = apply("retina_gather_loc",
+                     lambda bp: bp.reshape(-1, 4)[loc_idx], (bp,))
+    pred_score = apply("retina_gather_score",
+                       lambda cl: cl.reshape(-1, C)[score_idx], (cl,))
+    return (pred_score, pred_loc,
+            to_tensor(np.concatenate(labels).reshape(-1, 1)),
+            to_tensor(np.concatenate(tgts)),
+            to_tensor(np.concatenate(inw)),
+            to_tensor(np.asarray(fg_nums, np.int32).reshape(-1, 1)))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference (reference detection.py:3106 /
+    retinanet_detection_output_op): per FPN level, keep scores above
+    threshold (top nms_top_k), decode against that level's anchors,
+    then class-wise NMS across levels. Single image: ``bboxes`` list
+    of [Mi, 4] deltas, ``scores`` list of [Mi, C] sigmoid scores,
+    ``anchors`` list of [Mi, 4]. Returns [K, 6]."""
+    from ..vision.ops import multiclass_nms
+    info = _np(im_info).reshape(-1).astype(np.float64)
+    im_h, im_w = info[0], info[1]
+    all_boxes, all_scores, all_cls = [], [], []
+    for lvl in _bi.range(len(bboxes)):
+        d = _np(bboxes[lvl]).astype(np.float64)
+        s = _np(scores[lvl]).astype(np.float64)
+        a = _np(anchors[lvl]).astype(np.float64)
+        flat = s.reshape(-1)
+        cand = np.where(flat > score_threshold)[0]
+        if cand.size > nms_top_k:
+            cand = cand[np.argsort(-flat[cand], kind="stable")
+                        [:nms_top_k]]
+        ai, ci = cand // s.shape[1], cand % s.shape[1]
+        aw = a[ai, 2] - a[ai, 0] + 1
+        ah = a[ai, 3] - a[ai, 1] + 1
+        acx = a[ai, 0] + 0.5 * aw
+        acy = a[ai, 1] + 0.5 * ah
+        dd = d[ai]
+        cx = dd[:, 0] * aw + acx
+        cy = dd[:, 1] * ah + acy
+        w = np.exp(np.minimum(dd[:, 2], _BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(dd[:, 3], _BBOX_CLIP)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, im_w - 1)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, im_h - 1)
+        all_boxes.append(boxes)
+        all_scores.append(flat[cand])
+        all_cls.append(ci)
+    if not all_boxes or not np.concatenate(all_scores).size:
+        return to_tensor(np.zeros((0, 6), np.float32))
+    boxes = np.concatenate(all_boxes)
+    scs = np.concatenate(all_scores)
+    cls = np.concatenate(all_cls)
+    rows = []
+    for c in np.unique(cls):
+        sel = cls == c
+        sub = multiclass_nms(
+            to_tensor(boxes[sel].astype(np.float32)),
+            to_tensor(scs[sel][None, :].astype(np.float32)),
+            score_threshold=score_threshold, nms_top_k=nms_top_k,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            normalized=False, background_label=-1)
+        sv = _np(sub)
+        if sv.size:
+            sv = sv.copy()
+            sv[:, 0] = c
+            rows.append(sv)
+    if not rows:
+        return to_tensor(np.zeros((0, 6), np.float32))
+    allr = np.concatenate(rows)
+    order = np.argsort(-allr[:, 1], kind="stable")[:keep_top_k]
+    return to_tensor(allr[order].astype(np.float32))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, rois_lengths=None,
+                             gt_lengths=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             seed=None, is_cls_agnostic=False,
+                             is_cascade_rcnn=False):
+    """RCNN second-stage sampling (reference detection.py:2246 /
+    generate_proposal_labels_op.cc): per image, append gt to the
+    proposals, sample fg (IoU>=fg_thresh, capped at
+    fg_fraction*batch) and bg (bg_thresh_lo<=IoU<bg_thresh_hi),
+    encode per-class regression targets with ``bbox_reg_weights``.
+    Dense LoD: rois [Rt, 4] + rois_lengths [N]; gt [N, G, ...] +
+    gt_lengths. Returns (rois, labels_int32 [S,1],
+    bbox_targets [S, 4*class_nums], bbox_inside_weights,
+    bbox_outside_weights, lengths [N])."""
+    rois_all = _np(rpn_rois).astype(np.float64)
+    gts = _np(gt_boxes).astype(np.float64)
+    gtc = _np(gt_classes).astype(np.int64).reshape(gts.shape[0], -1)
+    crowd = _np(is_crowd).astype(np.int64) if is_crowd is not None \
+        else np.zeros(gtc.shape, np.int64)
+    info = _np(im_info).astype(np.float64)
+    N = gts.shape[0]
+    if class_nums is None:
+        class_nums = int(gtc.max()) + 1
+    rl = (_np(rois_lengths).astype(np.int64).reshape(-1)
+          if rois_lengths is not None
+          else np.asarray([rois_all.shape[0]] +
+                          [0] * (N - 1), np.int64))
+    gl = (_np(gt_lengths).astype(np.int64).reshape(-1)
+          if gt_lengths is not None
+          else np.full(N, gts.shape[1], np.int64))
+    rng = np.random.default_rng(seed)
+    out_rois, out_lab, out_tgt, out_inw, lengths = [], [], [], [], []
+    roff = 0
+    fg_per_im = int(np.round(fg_fraction * batch_size_per_im))
+    for i in _bi.range(N):
+        rois = rois_all[roff:roff + rl[i]]
+        roff += rl[i]
+        keep = crowd[i, :gl[i]] == 0
+        g = gts[i, :gl[i]][keep] * info[i, 2]
+        gc = gtc[i, :gl[i]][keep]
+        if not is_cascade_rcnn:
+            rois = np.concatenate([rois, g], axis=0) if g.size else rois
+        if g.shape[0] == 0:
+            sel_bg = np.arange(min(rois.shape[0], batch_size_per_im))
+            out_rois.append(rois[sel_bg])
+            out_lab.append(np.zeros(sel_bg.size, np.int64))
+            z = np.zeros((sel_bg.size, 4 * class_nums), np.float64)
+            out_tgt.append(z)
+            out_inw.append(z.copy())
+            lengths.append(sel_bg.size)
+            continue
+        iou = _bbox_overlaps(rois, g)
+        mx = iou.max(axis=1)
+        arg = iou.argmax(axis=1)
+        fg = np.where(mx >= fg_thresh)[0]
+        bg = np.where((mx < bg_thresh_hi) & (mx >= bg_thresh_lo))[0]
+        if fg.size > fg_per_im:
+            fg = (rng.choice(fg, fg_per_im, replace=False)
+                  if use_random else fg[:fg_per_im])
+        n_bg = min(batch_size_per_im - fg.size, bg.size)
+        if bg.size > n_bg:
+            bg = (rng.choice(bg, n_bg, replace=False)
+                  if use_random else bg[:n_bg])
+        sel = np.concatenate([fg, bg])
+        lab = np.concatenate([gc[arg[fg]],
+                              np.zeros(bg.size, np.int64)])
+        deltas = _box_to_delta(rois[fg], g[arg[fg]]) if fg.size else \
+            np.zeros((0, 4))
+        deltas = deltas / np.asarray(bbox_reg_weights)
+        tgt = np.zeros((sel.size, 4 * class_nums), np.float64)
+        iw = np.zeros_like(tgt)
+        for k in _bi.range(fg.size):
+            c = 1 if is_cls_agnostic else int(gc[arg[fg[k]]])
+            tgt[k, 4 * c:4 * c + 4] = deltas[k]
+            iw[k, 4 * c:4 * c + 4] = 1.0
+        out_rois.append(rois[sel])
+        out_lab.append(lab)
+        out_tgt.append(tgt)
+        out_inw.append(iw)
+        lengths.append(sel.size)
+    f32 = np.float32
+    return (to_tensor(np.concatenate(out_rois).astype(f32)),
+            to_tensor(np.concatenate(out_lab).astype(np.int32)
+                      .reshape(-1, 1)),
+            to_tensor(np.concatenate(out_tgt).astype(f32)),
+            to_tensor(np.concatenate(out_inw).astype(f32)),
+            to_tensor(np.concatenate(out_inw).astype(f32)),
+            to_tensor(np.asarray(lengths, np.int64)))
+
+
+def _rasterize_polygon(polys, h, w):
+    """Even-odd scanline fill of a polygon list onto an [h, w] grid
+    (the reference rasterizes gt_segms the same way via mask_util)."""
+    mask = np.zeros((h, w), np.uint8)
+    for poly in polys:
+        pts = np.asarray(poly, np.float64).reshape(-1, 2)
+        ys = np.arange(h) + 0.5
+        for yi, y in enumerate(ys):
+            xs = []
+            for k in _bi.range(pts.shape[0]):
+                x1, y1 = pts[k]
+                x2, y2 = pts[(k + 1) % pts.shape[0]]
+                if (y1 <= y < y2) or (y2 <= y < y1):
+                    xs.append(x1 + (y - y1) / (y2 - y1) * (x2 - x1))
+            xs.sort()
+            for a, b in zip(xs[::2], xs[1::2]):
+                lo = max(0, int(np.ceil(a - 0.5)))
+                hi = min(w, int(np.floor(b + 0.5)))
+                if hi > lo:
+                    mask[yi, lo:hi] ^= 1
+    return mask
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms,
+                         rois, labels_int32, num_classes, resolution,
+                         rois_lengths=None, gt_lengths=None):
+    """Mask R-CNN mask targets (reference detection.py:2022 /
+    generate_mask_labels_op.cc): for each fg roi, crop+resize the
+    best-overlapping gt mask to resolution², write it into the roi's
+    CLASS slot of [P, num_classes*res*res]; other slots are -1
+    (ignored by the mask loss). ``gt_segms`` per-gt polygons (list of
+    lists) or pre-rasterized [G, Hm, Wm] bitmaps per image."""
+    info = _np(im_info).astype(np.float64)
+    rois_np = _np(rois).astype(np.float64)
+    labels = _np(labels_int32).reshape(-1).astype(np.int64)
+    N = info.shape[0]
+    rl = (_np(rois_lengths).astype(np.int64).reshape(-1)
+          if rois_lengths is not None
+          else np.asarray([rois_np.shape[0]] + [0] * (N - 1)))
+    res = int(resolution)
+    mask_rois, roi_has_mask, mask_targets, lengths = [], [], [], []
+    roff = 0
+    for i in _bi.range(N):
+        im_h = int(round(info[i, 0] / info[i, 2]))
+        im_w = int(round(info[i, 1] / info[i, 2]))
+        segs = gt_segms[i]
+        gmasks = []
+        for s in segs:
+            if isinstance(s, np.ndarray) and s.ndim == 2:
+                gmasks.append(s.astype(np.uint8))
+            else:
+                gmasks.append(_rasterize_polygon(
+                    s if isinstance(s[0], (list, np.ndarray)) else [s],
+                    im_h, im_w))
+        r = rois_np[roff:roff + rl[i]] / info[i, 2]
+        lab = labels[roff:roff + rl[i]]
+        roff += rl[i]
+        fg = np.where(lab > 0)[0]
+        if not gmasks:
+            # box annotations without segms: no mask targets for this
+            # image (its fg rois contribute nothing to the mask head)
+            lengths.append(0)
+            continue
+        mboxes = _mask_bboxes(gmasks)  # roi-invariant: hoisted
+        for j in fg:
+            x1, y1, x2, y2 = r[j]
+            # best gt by IoU of the roi against each gt's mask bbox
+            ious = _bbox_overlaps(r[j:j + 1], mboxes)[0]
+            gsel = int(np.argmax(ious)) if len(gmasks) else 0
+            m = gmasks[gsel]
+            xs = np.clip(np.linspace(x1, x2, res), 0, m.shape[1] - 1)
+            ys = np.clip(np.linspace(y1, y2, res), 0, m.shape[0] - 1)
+            crop = m[np.round(ys).astype(int)[:, None],
+                     np.round(xs).astype(int)[None, :]]
+            tgt = np.full(num_classes * res * res, -1, np.int32)
+            c = int(lab[j])
+            tgt[c * res * res:(c + 1) * res * res] = crop.reshape(-1)
+            mask_rois.append(rois_np[roff - rl[i] + j])
+            roi_has_mask.append(j)
+            mask_targets.append(tgt)
+        lengths.append(fg.size)
+    if not mask_rois:
+        return (to_tensor(np.zeros((0, 4), np.float32)),
+                to_tensor(np.zeros((0, 1), np.int32)),
+                to_tensor(np.zeros((0, num_classes * res * res),
+                                   np.int32)),
+                to_tensor(np.asarray(lengths, np.int64)))
+    return (to_tensor(np.stack(mask_rois).astype(np.float32)),
+            to_tensor(np.asarray(roi_has_mask, np.int32)
+                      .reshape(-1, 1)),
+            to_tensor(np.stack(mask_targets)),
+            to_tensor(np.asarray(lengths, np.int64)))
+
+
+def _mask_bboxes(gmasks):
+    out = []
+    for m in gmasks:
+        ys, xs = np.where(m > 0)
+        if ys.size:
+            out.append([xs.min(), ys.min(), xs.max(), ys.max()])
+        else:
+            out.append([0, 0, 0, 0])
+    return np.asarray(out, np.float64)

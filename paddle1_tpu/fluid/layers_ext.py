@@ -102,7 +102,8 @@ __all__ = [
     "im2sequence", "center_loss", "sampling_id",
     "teacher_student_sigmoid_loss", "anchor_generator",
     "bipartite_match", "density_prior_box",
-    "Normal", "Uniform", "Categorical", "auc",
+    "Normal", "Uniform", "Categorical", "MultivariateNormalDiag",
+    "auc",
     # LR schedules (objects accepted by every optimizer)
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "cosine_decay",
@@ -123,6 +124,16 @@ __all__ = [
     # detection training family
     "rpn_target_assign", "generate_proposals", "ssd_loss",
     "multi_box_head", "deformable_conv",
+    # tier 8: decode/filter/io/detection-inference misc
+    "ctc_greedy_decoder", "similarity_focus", "filter_by_instag",
+    "reorder_lod_tensor_by_rank", "load", "read_file", "inplace_abn",
+    "detection_output", "box_decoder_and_assign",
+    "collect_fpn_proposals", "locality_aware_nms",
+    # tier 9: roi pooling/warp + retinanet/rcnn label generators
+    "psroi_pool", "prroi_pool", "deformable_roi_pooling",
+    "roi_perspective_transform", "retinanet_target_assign",
+    "retinanet_detection_output", "generate_proposal_labels",
+    "generate_mask_labels",
     # tensor-array (eager lists)
     "create_array", "array_write", "array_read", "array_length",
     "tensor_array_to_tensor",
@@ -788,6 +799,42 @@ def Categorical(logits):  # noqa: N802
     return _C(logits)
 
 
+class MultivariateNormalDiag:  # noqa: N801 — fluid class name
+    """Multivariate normal with diagonal covariance (reference
+    fluid/layers/distributions.py:528): ``loc`` [k], ``scale`` the
+    [k, k] diagonal covariance matrix; entropy and KL per the
+    reference's determinant/trace formulas."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _diag(self):
+        import numpy as _np2
+        return _np2.diag(_np2.asarray(self.scale.numpy()))
+
+    def entropy(self):
+        import math
+        k = self.scale.shape[0]
+        det = float(np.prod(self._diag()))
+        return to_tensor(np.asarray(
+            0.5 * (k * (1.0 + math.log(2 * math.pi))
+                   + math.log(det)), np.float32))
+
+    def kl_divergence(self, other):
+        d_self = self._diag().astype(np.float64)
+        d_other = other._diag().astype(np.float64)
+        mu = (np.asarray(other.loc.numpy(), np.float64)
+              - np.asarray(self.loc.numpy(), np.float64))
+        k = self.scale.shape[0]
+        tr = float((d_self / d_other).sum())
+        quad = float((mu * (1.0 / d_other) * mu).sum())
+        ln_cov = float(np.log(d_other.prod())
+                       - np.log(d_self.prod()))
+        return to_tensor(np.asarray(
+            0.5 * (tr + quad - k + ln_cov), np.float32))
+
+
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         slide_steps=1):
     """One-shot AUC over this batch (reference metric_op.py auc op; the
@@ -1335,7 +1382,17 @@ from .sampled_loss import (  # noqa: E402,F401
     nce, sampled_softmax_with_cross_entropy)
 from .detection_train import (  # noqa: E402,F401
     rpn_target_assign, generate_proposals, ssd_loss, multi_box_head,
-    deformable_conv)
+    deformable_conv, retinanet_target_assign,
+    retinanet_detection_output, generate_proposal_labels,
+    generate_mask_labels)
+from .misc_tail import (  # noqa: E402,F401
+    ctc_greedy_decoder, similarity_focus, filter_by_instag,
+    reorder_lod_tensor_by_rank, load, read_file, inplace_abn,
+    detection_output, box_decoder_and_assign, collect_fpn_proposals,
+    locality_aware_nms)
+from .roi_tail import (  # noqa: E402,F401
+    psroi_pool, prroi_pool, deformable_roi_pooling,
+    roi_perspective_transform)
 
 
 # -- tensor arrays (eager lists) ---------------------------------------------

@@ -1,0 +1,376 @@
+"""Fluid long-tail tier 8: decode/filter/io/detection-inference misc.
+
+Reference: /root/reference/python/paddle/fluid/layers/
+(ctc_greedy_decoder nn.py:5465, similarity_focus nn.py:12921,
+filter_by_instag nn.py:14645, inplace_abn nn.py:3198,
+reorder_lod_tensor_by_rank control_flow.py:1328, load io-ops,
+read_file; detection.py: detection_output:651,
+box_decoder_and_assign:3854, collect_fpn_proposals:3964,
+locality_aware_nms:2461).
+
+Host logic where the reference op is host logic (filtering, NMS,
+greedy decode ordering); traced math where gradients matter
+(inplace_abn == batch_norm+activation — the in-place memory trick is
+XLA's buffer-reuse job here, not the API's).
+"""
+
+from __future__ import annotations
+
+import builtins as _bi
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["ctc_greedy_decoder", "similarity_focus", "filter_by_instag",
+           "reorder_lod_tensor_by_rank", "load", "read_file",
+           "inplace_abn", "detection_output", "box_decoder_and_assign",
+           "collect_fpn_proposals", "locality_aware_nms"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_t(x).numpy())
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode (reference ctc_align_op): per step argmax,
+    merge consecutive repeats, drop blanks. Dense form: ``input``
+    [B, T, C] (+ ``input_length`` [B]); returns (decoded [B, Tmax]
+    padded with ``padding_value``, out_lengths [B, 1])."""
+    x = _np(input)
+    if x.ndim != 3:
+        raise InvalidArgumentError(
+            "ctc_greedy_decoder: input must be dense [batch, time, "
+            "classes] (LoD via input_length=)")
+    B, T, C = x.shape
+    lens = (_np(input_length).reshape(-1).astype(np.int64)
+            if input_length is not None
+            else np.full(B, T, np.int64))
+    ids = x.argmax(axis=-1)
+    outs = []
+    for b in _bi.range(B):
+        seq, prev = [], -1
+        for t in _bi.range(int(lens[b])):
+            tok = int(ids[b, t])
+            if tok != prev and tok != blank:
+                seq.append(tok)
+            prev = tok
+        outs.append(seq)
+    max_len = max((len(s) for s in outs), default=0) or 1
+    dec = np.full((B, max_len), padding_value, np.int64)
+    for b, s in enumerate(outs):
+        dec[b, :len(s)] = s
+    out_lens = np.asarray([[len(s)] for s in outs], np.int64)
+    return to_tensor(dec), to_tensor(out_lens)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (reference similarity_focus_op): for each
+    selected slice along ``axis``, greedily mark min(B, C) cells that
+    are row/column-distinct maxima; masks OR across ``indexes`` and
+    broadcast along ``axis``."""
+    x = _np(input)
+    if x.ndim != 4 or axis not in (1, 2, 3):
+        raise InvalidArgumentError(
+            "similarity_focus expects a 4-D input and axis in {1,2,3}")
+    mask = np.zeros_like(x, np.float32)
+    for b in _bi.range(x.shape[0]):
+        acc = None
+        for idx in indexes:
+            tm = np.take(x[b], idx, axis=axis - 1)  # 2-D slice
+            R, Cc = tm.shape
+            used_r = np.zeros(R, bool)
+            used_c = np.zeros(Cc, bool)
+            m = np.zeros((R, Cc), bool)
+            flat_order = np.argsort(-tm, axis=None, kind="stable")
+            picked = 0
+            for f in flat_order:
+                i, j = divmod(int(f), Cc)
+                if used_r[i] or used_c[j]:
+                    continue
+                m[i, j] = True
+                used_r[i] = used_c[j] = True
+                picked += 1
+                if picked == min(R, Cc):
+                    break
+            acc = m if acc is None else (acc | m)
+        full = np.expand_dims(acc, axis - 1)
+        mask[b] = np.broadcast_to(full, x.shape[1:])
+    return to_tensor(mask)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Keep instances whose tag list intersects ``filter_tag``
+    (reference filter_by_instag_op). Dense form: ``ins`` [N, D] rows,
+    ``ins_tag`` [N, K] padded with -1 (or a list of per-row tag
+    lists). Returns [filtered_ins, loss_weight [M, 1]]; when nothing
+    passes, one row filled with ``out_val_if_empty`` and weight 0 —
+    the op's keep-the-graph-alive contract."""
+    x = _np(ins)
+    want = set(np.asarray(_np(filter_tag)).reshape(-1).tolist())
+    if isinstance(ins_tag, (list, tuple)):
+        tags = [set(map(int, row)) for row in ins_tag]
+    else:
+        it = _np(ins_tag)
+        tags = [set(int(v) for v in row if v >= 0) for row in
+                np.atleast_2d(it)]
+    keep = [i for i, tg in enumerate(tags) if tg & want]
+    if keep:
+        out = x[keep]
+        w = np.ones((len(keep), 1), np.float64)
+    else:
+        out = np.full((1,) + x.shape[1:], out_val_if_empty, x.dtype)
+        w = np.zeros((1, 1), np.float64)
+    return [to_tensor(out), to_tensor(w)]
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder batch rows by another tensor's length rank (reference
+    reorder_lod_tensor_by_rank_op over lod_rank_table: sequences
+    sorted by length, descending, stable). Dense form: ``rank_table``
+    is the [B] lengths tensor the table was built from."""
+    xt = _t(x)
+    lens = _np(rank_table).reshape(-1)
+    order = np.argsort(-lens, kind="stable")
+    from ..ops import manip_ops
+    return manip_ops.gather(xt, to_tensor(order.astype(np.int64)),
+                            axis=0)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """Load one saved variable into ``out`` in place (reference
+    load_op over paddle.save'd data)."""
+    import paddle1_tpu as _paddle
+    val = _paddle.load(file_path)
+    if isinstance(val, dict) and len(val) == 1:
+        val = next(iter(val.values()))
+    arr = np.asarray(val.numpy() if hasattr(val, "numpy") else val)
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    t = to_tensor(arr)
+    if isinstance(out, Tensor) and hasattr(out, "_replace_impl"):
+        out._replace_impl(t)
+        return out
+    return t
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference read_file op —
+    paired with decode_jpeg in the vision IO path)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9,
+                epsilon=1e-5, param_attr=None, bias_attr=None,
+                data_layout="NCHW", name=None, moving_mean_name=None,
+                moving_variance_name=None,
+                do_model_average_for_mean_and_var=True,
+                use_global_stats=False, act_alpha=1.0):
+    """In-place activated batch norm (reference inplace_abn_op):
+    numerically batch_norm followed by the activation; the reference's
+    in-place buffer reuse is XLA's job here. Supported activations per
+    the reference: None/identity/leaky_relu/elu."""
+    from .layers import batch_norm
+    from ..nn import functional as F
+    if act not in (None, "identity", "leaky_relu", "elu"):
+        raise InvalidArgumentError(
+            f"inplace_abn supports act in (None, identity, leaky_relu, "
+            f"elu); got {act!r} (reference enforces the same)")
+    # use_global_stats means "normalize with the moving averages even
+    # while training" — the stats side of is_test (batch_norm routes
+    # both through layer.training)
+    y = batch_norm(input, act=None,
+                   is_test=is_test or use_global_stats,
+                   momentum=momentum, epsilon=epsilon,
+                   param_attr=param_attr, bias_attr=bias_attr,
+                   data_layout=data_layout, name=name)
+    if act == "leaky_relu":
+        return F.leaky_relu(y, negative_slope=act_alpha)
+    if act == "elu":
+        return F.elu(y, alpha=act_alpha)
+    return y
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD inference head (reference detection.py:651): decode
+    ``loc`` [N, M, 4] against the priors, then per-class NMS over
+    ``scores`` [N, M, C]. Returns a list of per-image [K, 6]
+    (label, score, x0, y0, x1, y1) arrays (the dense analog of the
+    LoD output)."""
+    from ..vision.ops import box_coder, multiclass_nms
+    lc, sc = _t(loc), _np(scores)
+    decoded = box_coder(_t(prior_box), _t(prior_box_var), lc,
+                        code_type="decode_center_size", axis=0)
+    dec = _np(decoded)          # [N, M, 4]
+    outs = []
+    for n in _bi.range(dec.shape[0]):
+        out = multiclass_nms(
+            to_tensor(dec[n]), to_tensor(sc[n].T),
+            score_threshold=score_threshold, nms_top_k=nms_top_k,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            normalized=True, background_label=background_label)
+        outs.append(out)
+    return outs  # always a per-image list, as documented
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    """Per-class decode + argmax-class assignment (reference
+    box_decoder_and_assign_op): ``target_box`` [N, 4*C] class-wise
+    deltas, ``box_score`` [N, C]. Returns (decode_box [N, 4*C],
+    assigned [N, 4])."""
+    pb = _np(prior_box)
+    pv = _np(prior_box_var)
+    tb = _np(target_box)
+    sc = _np(box_score)
+    N, C4 = tb.shape
+    C = C4 // 4
+    pw = pb[:, 2] - pb[:, 0] + 1
+    ph = pb[:, 3] - pb[:, 1] + 1
+    pcx = pb[:, 0] + 0.5 * pw
+    pcy = pb[:, 1] + 0.5 * ph
+    dec = np.zeros_like(tb)
+    clip = float(box_clip)
+    for c in _bi.range(C):
+        d = tb[:, 4 * c:4 * c + 4]
+        dx = d[:, 0] * pv[:, 0]
+        dy = d[:, 1] * pv[:, 1]
+        dw = np.minimum(d[:, 2] * pv[:, 2], clip)
+        dh = np.minimum(d[:, 3] * pv[:, 3], clip)
+        cx = dx * pw + pcx
+        cy = dy * ph + pcy
+        w = np.exp(dw) * pw
+        h = np.exp(dh) * ph
+        dec[:, 4 * c + 0] = cx - w / 2
+        dec[:, 4 * c + 1] = cy - h / 2
+        dec[:, 4 * c + 2] = cx + w / 2 - 1
+        dec[:, 4 * c + 3] = cy + h / 2 - 1
+    best = sc.argmax(axis=1)
+    assigned = np.stack([dec[np.arange(N), 4 * best + k]
+                         for k in _bi.range(4)], axis=1)
+    return to_tensor(dec.astype(np.float32)), \
+        to_tensor(assigned.astype(np.float32))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level,
+                          max_level, post_nms_top_n, name=None,
+                          rois_lengths=None):
+    """Concat per-level proposals and keep the score top-k per image
+    (reference collect_fpn_proposals_op). Dense forms: single image —
+    each ``multi_rois`` entry [Ri, 4], ``multi_scores`` [Ri, 1],
+    returns rois [K, 4]; batched — pass ``rois_lengths`` as one [N]
+    lengths array per level (the LoD partitions) and get
+    (rois, out_lengths [N]) with the top-k taken per image."""
+    rois_l = [_np(r).reshape(-1, 4) for r in multi_rois]
+    scores_l = [_np(s).reshape(-1) for s in multi_scores]
+    if rois_lengths is None:
+        rois = np.concatenate(rois_l, axis=0)
+        scores = np.concatenate(scores_l, axis=0)
+        k = min(int(post_nms_top_n), scores.shape[0])
+        top = np.argsort(-scores, kind="stable")[:k]
+        return to_tensor(rois[top].astype(np.float32))
+    lens_l = [np.asarray(_np(ln), np.int64).reshape(-1)
+              for ln in rois_lengths]
+    N = lens_l[0].shape[0]
+    offs = [np.concatenate([[0], np.cumsum(ln)]) for ln in lens_l]
+    out_rois, out_lens = [], []
+    for i in _bi.range(N):
+        r = np.concatenate([rl[o[i]:o[i + 1]]
+                            for rl, o in zip(rois_l, offs)], axis=0)
+        s = np.concatenate([sl[o[i]:o[i + 1]]
+                            for sl, o in zip(scores_l, offs)], axis=0)
+        k = min(int(post_nms_top_n), s.shape[0])
+        top = np.argsort(-s, kind="stable")[:k]
+        out_rois.append(r[top])
+        out_lens.append(k)
+    return (to_tensor(np.concatenate(out_rois).astype(np.float32)),
+            to_tensor(np.asarray(out_lens, np.int64)))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (reference locality_aware_nms_op, EAST text
+    detection): consecutive boxes above threshold are first merged by
+    score-weighted averaging, then standard per-class NMS. Single
+    image: ``bboxes`` [M, 4], ``scores`` [C, M]; returns [K, 6]."""
+    from ..vision.ops import multiclass_nms
+    b = _np(bboxes).astype(np.float64)
+    s = _np(scores).astype(np.float64)
+
+    def iou(p, q):
+        off = 0.0 if normalized else 1.0
+        ix = max(0.0, min(p[2], q[2]) - max(p[0], q[0]) + off)
+        iy = max(0.0, min(p[3], q[3]) - max(p[1], q[1]) + off)
+        inter = ix * iy
+        pa = (p[2] - p[0] + off) * (p[3] - p[1] + off)
+        qa = (q[2] - q[0] + off) * (q[3] - q[1] + off)
+        return inter / (pa + qa - inter) if inter > 0 else 0.0
+
+    merged_b, merged_s = [], []
+    for c in _bi.range(s.shape[0]):
+        if c == background_label:
+            merged_b.append(None)
+            merged_s.append(s[c])
+            continue
+        boxes_c = b.copy()
+        sc_c = s[c].copy()
+        out_boxes, out_scores = [], []
+        cur, cur_s = None, 0.0
+        for i in _bi.range(boxes_c.shape[0]):
+            if sc_c[i] < score_threshold:
+                continue
+            bx, sx = boxes_c[i], sc_c[i]
+            if cur is not None and iou(cur, bx) > nms_threshold:
+                # weighted merge (the op's PolyWeightedMerge on axis-
+                # aligned boxes): coordinates average by score mass
+                tot = cur_s + sx
+                cur = (cur * cur_s + bx * sx) / tot
+                cur_s = tot
+            else:
+                if cur is not None:
+                    out_boxes.append(cur)
+                    out_scores.append(cur_s)
+                cur, cur_s = bx.copy(), sx
+        if cur is not None:
+            out_boxes.append(cur)
+            out_scores.append(cur_s)
+        merged_b.append((np.asarray(out_boxes)
+                         if out_boxes else np.zeros((0, 4))))
+        merged_s.append(np.asarray(out_scores))
+    # run standard NMS per class over the merged sets: rebuild a
+    # boxes/scores pair per class and reuse multiclass_nms per class
+    rows = []
+    for c in _bi.range(s.shape[0]):
+        if merged_b[c] is None or merged_b[c].shape[0] == 0:
+            continue
+        sub = multiclass_nms(
+            to_tensor(merged_b[c].astype(np.float32)),
+            to_tensor(np.clip(merged_s[c], 0, None)[None, :]
+                      .astype(np.float32)),
+            score_threshold=score_threshold, nms_top_k=nms_top_k,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            normalized=normalized, background_label=-1)
+        sv = _np(sub)
+        if sv.size:
+            sv = sv.copy()
+            sv[:, 0] = c
+            rows.append(sv)
+    if not rows:
+        return to_tensor(np.zeros((0, 6), np.float32))
+    allr = np.concatenate(rows, axis=0)
+    order = np.argsort(-allr[:, 1], kind="stable")[:keep_top_k]
+    return to_tensor(allr[order].astype(np.float32))

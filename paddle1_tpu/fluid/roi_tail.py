@@ -1,0 +1,347 @@
+"""Position-sensitive / precise / deformable ROI pooling + perspective
+ROI transform.
+
+Reference: /root/reference/python/paddle/fluid/layers/nn.py
+(psroi_pool:13738, prroi_pool:13807, deformable_roi_pooling:14592) and
+detection.py roi_perspective_transform:2504, over the C++ kernels
+psroi_pool_op.h, prroi_pool_op.h, deformable_psroi_pooling_op.h,
+detection/roi_perspective_transform_op.cc.
+
+All four are traced and differentiable: bin averaging, bilinear/tent
+sampling and the per-ROI gathers are jnp expressions, so input (and
+for prroi/deformable, coordinate/offset) gradients come from autodiff —
+the reference ships hand-written grad kernels for each.
+"""
+
+from __future__ import annotations
+
+import builtins as _bi
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["psroi_pool", "prroi_pool", "deformable_roi_pooling",
+           "roi_perspective_transform"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _np(x):
+    return np.asarray(_t(x).numpy())
+
+
+def _roi_batch_ids(rois_num, R):
+    if rois_num is None:
+        return np.zeros(R, np.int64)
+    lens = np.asarray(_np(rois_num), np.int64).reshape(-1)
+    return np.repeat(np.arange(lens.shape[0]), lens)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale,
+               pooled_height, pooled_width, rois_num=None, name=None):
+    """Position-sensitive ROI average pooling (reference
+    psroi_pool_op.h, R-FCN): bin (c, ph, pw) averages input channel
+    ``(c*pooled_h + ph)*pooled_w + pw`` over its integer-floored bin
+    window. ``rois`` [R, 4]; ``rois_num`` [N] is the dense-LoD
+    partition. Returns [R, output_channels, ph, pw]."""
+    x = _t(input)
+    N, C, H, W = x.shape
+    if C != output_channels * pooled_height * pooled_width:
+        raise InvalidArgumentError(
+            f"psroi_pool: input channels {C} must equal "
+            f"output_channels*ph*pw = "
+            f"{output_channels * pooled_height * pooled_width}")
+    r = _np(rois).astype(np.float64)
+    R = r.shape[0]
+    batch_ids = _roi_batch_ids(rois_num, R)
+    # host-side bin windows (integer, shape-static per call)
+    sw = np.round(r[:, 0]) * spatial_scale
+    sh = np.round(r[:, 1]) * spatial_scale
+    ew = (np.round(r[:, 2]) + 1.0) * spatial_scale
+    eh = (np.round(r[:, 3]) + 1.0) * spatial_scale
+    rh = np.maximum(eh - sh, 0.1)
+    rw = np.maximum(ew - sw, 0.1)
+    bh = rh / pooled_height
+    bw = rw / pooled_width
+    # [R, ph] / [R, pw] windows
+    hs = np.clip(np.floor(sh[:, None]
+                          + np.arange(pooled_height)[None] * bh[:, None]),
+                 0, H).astype(np.int64)
+    he = np.clip(np.ceil(sh[:, None]
+                         + (np.arange(pooled_height)[None] + 1)
+                         * bh[:, None]), 0, H).astype(np.int64)
+    ws = np.clip(np.floor(sw[:, None]
+                          + np.arange(pooled_width)[None] * bw[:, None]),
+                 0, W).astype(np.int64)
+    we = np.clip(np.ceil(sw[:, None]
+                         + (np.arange(pooled_width)[None] + 1)
+                         * bw[:, None]), 0, W).astype(np.int64)
+
+    def f(x):
+        # mask-sum formulation: per (roi, bin) a [H] and [W] 0/1 window
+        iy = jnp.arange(H)
+        ix = jnp.arange(W)
+        mh = ((iy[None, None, :] >= jnp.asarray(hs)[:, :, None])
+              & (iy[None, None, :] < jnp.asarray(he)[:, :, None]))
+        mw = ((ix[None, None, :] >= jnp.asarray(ws)[:, :, None])
+              & (ix[None, None, :] < jnp.asarray(we)[:, :, None]))
+        xr = x[jnp.asarray(batch_ids)]              # [R, C, H, W]
+        xr = xr.reshape(R, output_channels, pooled_height,
+                        pooled_width, H, W)
+        # integral over the bin window of the bin's own channel
+        s = jnp.einsum("rcpqhw,rph,rqw->rcpq", xr,
+                       mh.astype(x.dtype), mw.astype(x.dtype))
+        area = ((jnp.asarray(he - hs))[:, None, :, None]
+                * (jnp.asarray(we - ws))[:, None, None, :])
+        return jnp.where(area > 0, s / jnp.maximum(area, 1), 0.0)
+    return apply("psroi_pool", f, (x,))
+
+
+def _tent_integral(lo, hi, n):
+    """∫ over [lo, hi] of the tent basis max(0, 1-|t-i|) for every
+    integer i in [0, n): closed form, vectorized, differentiable."""
+    i = jnp.arange(n, dtype=lo.dtype)
+
+    def seg(a, b):
+        # ∫_a^b max(0, 1-|t|) dt via the antiderivative
+        # F(t) = t - sign(t)·t²/2 on [-1, 1], clipped outside
+        ta = jnp.clip(a, -1.0, 1.0)
+        tb = jnp.clip(b, -1.0, 1.0)
+        Fa = ta - jnp.sign(ta) * ta * ta / 2
+        Fb = tb - jnp.sign(tb) * tb * tb / 2
+        return Fb - Fa
+    lo_ = lo[..., None] - i
+    hi_ = hi[..., None] - i
+    return seg(lo_, hi_)
+
+
+def prroi_pool(input, rois, pooled_height=1, pooled_width=1,
+               spatial_scale=1.0, batch_roi_nums=None, name=None):
+    """Precise ROI pooling (reference prroi_pool_op.h): each bin is the
+    EXACT integral of the bilinearly-interpolated feature over the bin
+    rectangle, divided by the bin area — no sampling-point
+    quantization. Closed form here: bilinear interpolation is a
+    separable tent expansion, f(x,y)=Σ F[i,j]·tent(y-i)·tent(x-j), so
+    the bin integral is Iy^T F Ix with per-axis tent integrals.
+    Fully differentiable, including w.r.t. the ROI coordinates."""
+    x = _t(input)
+    rois_t = _t(rois)
+    N, C, H, W = x.shape
+    R = rois_t.shape[0]
+    batch_ids = _roi_batch_ids(batch_roi_nums, R)
+    ph_, pw_ = pooled_height, pooled_width
+
+    def f(x, r):
+        r = r * spatial_scale
+        x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bw = rw / pw_
+        bh = rh / ph_
+        # bin edges [R, ph+?]: lo/hi per bin
+        wlo = x1[:, None] + jnp.arange(pw_) * bw[:, None]
+        whi = wlo + bw[:, None]
+        hlo = y1[:, None] + jnp.arange(ph_) * bh[:, None]
+        hhi = hlo + bh[:, None]
+        Ix = _tent_integral(wlo, whi, W)     # [R, pw, W]
+        Iy = _tent_integral(hlo, hhi, H)     # [R, ph, H]
+        xr = x[jnp.asarray(batch_ids)]       # [R, C, H, W]
+        integ = jnp.einsum("rchw,rph,rqw->rcpq", xr, Iy, Ix)
+        area = (bw * bh)[:, None, None, None]
+        return jnp.where(area > 0, integ / jnp.maximum(area, 1e-12),
+                         0.0)
+    return apply("prroi_pool", f, (x, rois_t))
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           rois_num=None, name=None):
+    """Deformable (PS-)ROI pooling (reference
+    deformable_psroi_pooling_op.h): each bin's sampling window shifts
+    by a learned normalized offset from ``trans``
+    [R, 2, part_h, part_w]; ``sample_per_part``² bilinear samples
+    average per bin; out-of-image samples are dropped from the count.
+    ``position_sensitive`` maps bin (c, gh, gw) to input channel
+    (c*group_h+gh)*group_w+gw."""
+    x, tr = _t(input), _t(trans)
+    N, C, H, W = x.shape
+    gh_, gw_ = (group_size if isinstance(group_size, (list, tuple))
+                else (group_size, group_size))
+    if part_size is None:
+        part_size = (pooled_height, pooled_width)
+    part_h, part_w = part_size
+    out_dim = C // (gh_ * gw_) if position_sensitive else C
+    r = _np(rois).astype(np.float64)
+    R = r.shape[0]
+    batch_ids = _roi_batch_ids(rois_num, R)
+    ph_, pw_, spp = pooled_height, pooled_width, sample_per_part
+
+    # static per-bin part/group indices
+    ph_idx = np.arange(ph_)
+    pw_idx = np.arange(pw_)
+    parth = np.floor(ph_idx / ph_ * part_h).astype(np.int64)
+    partw = np.floor(pw_idx / pw_ * part_w).astype(np.int64)
+    gh_idx = np.clip(np.floor(ph_idx * gh_ / ph_), 0,
+                     gh_ - 1).astype(np.int64)
+    gw_idx = np.clip(np.floor(pw_idx * gw_ / pw_), 0,
+                     gw_ - 1).astype(np.int64)
+
+    sw = np.round(r[:, 0]) * spatial_scale - 0.5
+    sh = np.round(r[:, 1]) * spatial_scale - 0.5
+    ew = (np.round(r[:, 2]) + 1.0) * spatial_scale - 0.5
+    eh = (np.round(r[:, 3]) + 1.0) * spatial_scale - 0.5
+    rw = np.maximum(ew - sw, 0.1)
+    rh = np.maximum(eh - sh, 0.1)
+
+    def f(x, tr):
+        bw = jnp.asarray(rw / pw_)
+        bh = jnp.asarray(rh / ph_)
+        sbw = bw / spp
+        sbh = bh / spp
+        if no_trans:
+            tx = jnp.zeros((R, ph_, pw_))
+            ty = jnp.zeros((R, ph_, pw_))
+        else:
+            tx = tr[:, 0][:, jnp.asarray(parth)][:, :,
+                                                 jnp.asarray(partw)] \
+                * trans_std
+            ty = tr[:, 1][:, jnp.asarray(parth)][:, :,
+                                                 jnp.asarray(partw)] \
+                * trans_std
+        wstart = (jnp.asarray(sw)[:, None, None]
+                  + pw_idx[None, None, :] * bw[:, None, None]
+                  + tx * jnp.asarray(rw)[:, None, None])
+        hstart = (jnp.asarray(sh)[:, None, None]
+                  + ph_idx[None, :, None] * bh[:, None, None]
+                  + ty * jnp.asarray(rh)[:, None, None])
+        # sample grid [R, ph, pw, spp, spp]
+        ww = wstart[..., None, None] \
+            + jnp.arange(spp)[None, None, None, None, :] \
+            * sbw[:, None, None, None, None]
+        hh = hstart[..., None, None] \
+            + jnp.arange(spp)[None, None, None, :, None] \
+            * sbh[:, None, None, None, None]
+        valid = ((ww >= -0.5) & (ww <= W - 0.5)
+                 & (hh >= -0.5) & (hh <= H - 0.5))
+        wc = jnp.clip(ww, 0.0, W - 1.0)
+        hc = jnp.clip(hh, 0.0, H - 1.0)
+        x0 = jnp.floor(wc)
+        y0 = jnp.floor(hc)
+        fx = wc - x0
+        fy = hc - y0
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x1i = jnp.minimum(x0i + 1, W - 1)
+        y1i = jnp.minimum(y0i + 1, H - 1)
+        # channel map per (c, ph, pw)
+        if position_sensitive:
+            cmap = ((np.arange(out_dim)[:, None, None] * gh_
+                     + gh_idx[None, :, None]) * gw_
+                    + gw_idx[None, None, :])        # [out, ph, pw]
+        else:
+            cmap = np.broadcast_to(np.arange(out_dim)[:, None, None],
+                                   (out_dim, ph_, pw_)).copy()
+        xr = x[jnp.asarray(batch_ids)]              # [R, C, H, W]
+        cm = jnp.asarray(cmap)
+
+        def gat(yi, xi):
+            # xr[r, cmap[c,p,q], yi[r,p,q,s,t], xi[r,p,q,s,t]]
+            ridx = jnp.arange(R)[:, None, None, None, None, None]
+            cidx = cm[None, :, :, :, None, None]
+            yy = yi[:, None, :, :, :, :]
+            xx = xi[:, None, :, :, :, :]
+            return xr[ridx, cidx, yy, xx]
+        v = (gat(y0i, x0i) * ((1 - fx) * (1 - fy))[:, None]
+             + gat(y0i, x1i) * (fx * (1 - fy))[:, None]
+             + gat(y1i, x0i) * ((1 - fx) * fy)[:, None]
+             + gat(y1i, x1i) * (fx * fy)[:, None])
+        vmask = valid[:, None].astype(x.dtype)
+        cnt = vmask.sum(axis=(-1, -2))
+        s = (v * vmask).sum(axis=(-1, -2))
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+    return apply("deformable_roi_pooling", f, (x, tr))
+
+
+def _perspective_matrix(quad, th, tw):
+    """getPerspectiveTransform: output-rect corners → quad corners
+    (roi_perspective_transform_op get_transform_matrix)."""
+    src = np.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                      [0, th - 1]], np.float64)
+    dst = quad.reshape(4, 2).astype(np.float64)
+    A = np.zeros((8, 8))
+    b = np.zeros(8)
+    for k in _bi.range(4):
+        x, y = src[k]
+        u, v = dst[k]
+        A[2 * k] = [x, y, 1, 0, 0, 0, -u * x, -u * y]
+        A[2 * k + 1] = [0, 0, 0, x, y, 1, -v * x, -v * y]
+        b[2 * k] = u
+        b[2 * k + 1] = v
+    h = np.linalg.solve(A, b)
+    return np.append(h, 1.0).reshape(3, 3)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Perspective-warp quadrilateral ROIs to a fixed rectangle
+    (reference roi_perspective_transform_op, EAST-style text
+    recognition): ``rois`` [R, 8] quads (x1..y4 clockwise from
+    top-left). Per ROI a homography maps output pixels into the quad;
+    bilinear sampling, zero+mask outside. Returns (out [R, C, th, tw],
+    mask [R, 1, th, tw], transform_matrix [R, 9])."""
+    x = _t(input)
+    N, C, H, W = x.shape
+    q = _np(rois).astype(np.float64) * spatial_scale
+    R = q.shape[0]
+    th, tw = transformed_height, transformed_width
+    batch_ids = _roi_batch_ids(rois_num, R)
+    mats = np.stack([_perspective_matrix(q[i], th, tw)
+                     for i in _bi.range(R)]) if R else \
+        np.zeros((0, 3, 3))
+    ys, xs = np.meshgrid(np.arange(th), np.arange(tw), indexing="ij")
+    ones = np.ones_like(xs)
+    grid = np.stack([xs, ys, ones], axis=-1).astype(np.float64)
+    src = np.einsum("rab,hwb->rhwa", mats, grid)
+    sx = src[..., 0] / src[..., 2]
+    sy = src[..., 1] / src[..., 2]
+    mask_np = ((sx >= 0) & (sx <= W - 1) & (sy >= 0)
+               & (sy <= H - 1)).astype(np.float32)
+    sxc = np.clip(sx, 0, W - 1)
+    syc = np.clip(sy, 0, H - 1)
+
+    def f(x):
+        xr = x[jnp.asarray(batch_ids)]          # [R, C, H, W]
+        gx = jnp.asarray(sxc)
+        gy = jnp.asarray(syc)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        fx = (gx - x0).astype(x.dtype)[:, None]
+        fy = (gy - y0).astype(x.dtype)[:, None]
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x1i = jnp.minimum(x0i + 1, W - 1)
+        y1i = jnp.minimum(y0i + 1, H - 1)
+        ridx = jnp.arange(R)[:, None, None, None]
+        cidx = jnp.arange(C)[None, :, None, None]
+
+        def gat(yi, xi):
+            return xr[ridx, cidx, yi[:, None], xi[:, None]]
+        v = (gat(y0i, x0i) * (1 - fx) * (1 - fy)
+             + gat(y0i, x1i) * fx * (1 - fy)
+             + gat(y1i, x0i) * (1 - fx) * fy
+             + gat(y1i, x1i) * fx * fy)
+        return v * jnp.asarray(mask_np)[:, None]
+    out = apply("roi_perspective_transform", f, (x,))
+    return (out, to_tensor(mask_np[:, None].astype(np.float32)),
+            to_tensor(mats.reshape(R, 9).astype(np.float32)))

@@ -158,6 +158,24 @@ class TestGenerateProposals:
             r2[0], anchors.reshape(-1, 4)[best], atol=1e-5)
 
 
+class TestGenerateProposalsEdge:
+    def test_all_filtered_emits_zero_box(self):
+        """keep-the-graph-alive contract: an image whose proposals are
+        all filtered still contributes one [0,0,0,0] roi, score 0."""
+        anchors = np.zeros((1, 1, 1, 4), np.float32)
+        anchors[0, 0, 0] = [0, 0, 0.5, 0.5]   # sub-min_size anchor
+        variances = np.ones((1, 1, 1, 4), np.float32)
+        scores = np.ones((1, 1, 1, 1), np.float32)
+        deltas = np.zeros((1, 4, 1, 1), np.float32)
+        info = np.array([[16, 16, 1.0]], np.float32)
+        rois, probs, lens = L.generate_proposals(
+            to_tensor(scores), to_tensor(deltas), to_tensor(info),
+            to_tensor(anchors), to_tensor(variances), min_size=8.0)
+        assert _np(lens).tolist() == [1]
+        np.testing.assert_array_equal(_np(rois), [[0, 0, 0, 0]])
+        np.testing.assert_array_equal(_np(probs), [[0.0]])
+
+
 class TestSSDLoss:
     def _toy(self, seed=5):
         rng = np.random.default_rng(seed)

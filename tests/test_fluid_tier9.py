@@ -1,0 +1,275 @@
+"""Fluid tier 9: psroi/prroi/deformable roi pooling,
+roi_perspective_transform, retinanet target/output, RCNN
+proposal/mask label generators — numpy references from the C++
+kernels (psroi_pool_op.h, prroi_pool_op.h,
+deformable_psroi_pooling_op.h, rpn_target_assign_op.cc retinanet
+branch, generate_proposal_labels_op.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+import paddle1_tpu.fluid.layers as L
+from paddle1_tpu.core.tensor import to_tensor
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestPsroiPool:
+    def test_matches_kernel_loop(self):
+        rng = np.random.default_rng(0)
+        oc, ph, pw = 2, 2, 2
+        C = oc * ph * pw
+        x = rng.standard_normal((1, C, 8, 8)).astype(np.float32)
+        rois = np.array([[0, 0, 7, 7], [2, 2, 5, 6]], np.float32)
+        out = _np(L.psroi_pool(to_tensor(x), to_tensor(rois), oc, 1.0,
+                               ph, pw))
+        assert out.shape == (2, oc, ph, pw)
+        # numpy twin of the kernel loop
+        for n, roi in enumerate(rois):
+            sw, sh = round(roi[0]) * 1.0, round(roi[1]) * 1.0
+            ew, eh = (round(roi[2]) + 1), (round(roi[3]) + 1)
+            bh = max(eh - sh, 0.1) / ph
+            bw = max(ew - sw, 0.1) / pw
+            for c in range(oc):
+                for i in range(ph):
+                    for j in range(pw):
+                        hs = int(np.floor(i * bh + sh))
+                        he = int(np.ceil((i + 1) * bh + sh))
+                        ws = int(np.floor(j * bw + sw))
+                        we = int(np.ceil((j + 1) * bw + sw))
+                        hs, he = max(hs, 0), min(he, 8)
+                        ws, we = max(ws, 0), min(we, 8)
+                        ch = (c * ph + i) * pw + j
+                        ref = x[0, ch, hs:he, ws:we].mean() \
+                            if he > hs and we > ws else 0.0
+                        np.testing.assert_allclose(
+                            out[n, c, i, j], ref, rtol=2e-5,
+                            atol=1e-6)
+
+    def test_channel_check(self):
+        with pytest.raises(Exception, match="channels"):
+            L.psroi_pool(to_tensor(np.zeros((1, 7, 4, 4), np.float32)),
+                         to_tensor(np.zeros((1, 4), np.float32)),
+                         2, 1.0, 2, 2)
+
+
+class TestPrroiPool:
+    def test_constant_map_gives_constant(self):
+        x = np.full((1, 1, 6, 6), 3.0, np.float32)
+        rois = np.array([[0.7, 0.9, 4.3, 4.9]], np.float32)
+        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), 2, 2))
+        np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+    def test_linear_ramp_integral(self):
+        # f(x, y) = x (bilinear of a ramp is the ramp): bin average
+        # over [a, b] must be the midpoint (a+b)/2
+        W = 8
+        x = np.tile(np.arange(W, dtype=np.float32), (1, 1, W, 1))
+        rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), 1, 2))
+        # two bins along x: [1,3] and [3,5] -> means 2 and 4
+        np.testing.assert_allclose(out[0, 0, 0], [2.0, 4.0],
+                                   rtol=1e-5)
+
+    def test_roi_coordinate_gradients(self):
+        rng = np.random.default_rng(1)
+        x = to_tensor(rng.standard_normal((1, 2, 6, 6)).astype(
+            np.float32))
+        rois = to_tensor(np.array([[1.2, 1.1, 4.4, 4.6]], np.float32))
+        x.stop_gradient = False
+        rois.stop_gradient = False
+        out = L.prroi_pool(x, rois, 2, 2)
+        out.sum().backward()
+        assert np.abs(_np(x.grad)).sum() > 0
+        assert np.abs(_np(rois.grad)).sum() > 0   # coordinate grads
+
+
+class TestDeformableRoiPooling:
+    def test_zero_trans_equals_average_of_samples(self):
+        x = np.full((1, 3, 8, 8), 2.5, np.float32)
+        rois = np.array([[1, 1, 6, 6]], np.float32)
+        trans = np.zeros((1, 2, 2, 2), np.float32)
+        out = _np(L.deformable_roi_pooling(
+            to_tensor(x), to_tensor(rois), to_tensor(trans),
+            no_trans=True, pooled_height=2, pooled_width=2,
+            sample_per_part=2))
+        assert out.shape == (1, 3, 2, 2)
+        np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+    def test_trans_shifts_sampling(self):
+        # left half 0, right half 10; positive x-offset moves bins
+        # toward the larger values
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[:, :, :, 4:] = 10.0
+        rois = np.array([[0, 0, 5, 5]], np.float32)
+        z = np.zeros((1, 2, 1, 1), np.float32)
+        t = np.zeros((1, 2, 1, 1), np.float32)
+        t[0, 0] = 3.0  # x-offset * trans_std(0.1) * roi_w
+        base = _np(L.deformable_roi_pooling(
+            to_tensor(x), to_tensor(rois), to_tensor(z),
+            pooled_height=2, pooled_width=2, sample_per_part=2,
+            part_size=(1, 1)))
+        shifted = _np(L.deformable_roi_pooling(
+            to_tensor(x), to_tensor(rois), to_tensor(t),
+            pooled_height=2, pooled_width=2, sample_per_part=2,
+            part_size=(1, 1)))
+        assert shifted.sum() > base.sum()
+
+    def test_position_sensitive_channel_map(self):
+        # C=4, group 2x2, out_dim=1: each bin reads its own channel
+        x = np.zeros((1, 4, 4, 4), np.float32)
+        for c in range(4):
+            x[0, c] = c + 1
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        z = np.zeros((1, 2, 1, 1), np.float32)
+        out = _np(L.deformable_roi_pooling(
+            to_tensor(x), to_tensor(rois), to_tensor(z),
+            no_trans=True, group_size=(2, 2), pooled_height=2,
+            pooled_width=2, sample_per_part=2,
+            position_sensitive=True))
+        assert out.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(out[0, 0],
+                                   [[1.0, 2.0], [3.0, 4.0]],
+                                   rtol=1e-5)
+
+
+class TestRoiPerspective:
+    def test_axis_aligned_quad_equals_resize(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 1, 8, 8)).astype(np.float32)
+        # quad covering [1,1]..[5,5] axis-aligned (clockwise)
+        quad = np.array([[1, 1, 5, 1, 5, 5, 1, 5]], np.float32)
+        out, mask, mat = L.roi_perspective_transform(
+            to_tensor(x), to_tensor(quad), 5, 5)
+        o = _np(out)
+        assert o.shape == (1, 1, 5, 5)
+        assert _np(mask).min() == 1.0  # fully inside
+        # corners map exactly onto the quad corners
+        np.testing.assert_allclose(o[0, 0, 0, 0], x[0, 0, 1, 1],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(o[0, 0, 4, 4], x[0, 0, 5, 5],
+                                   rtol=1e-5)
+
+    def test_grad_flows(self):
+        x = to_tensor(np.random.default_rng(3).standard_normal(
+            (1, 2, 6, 6)).astype(np.float32))
+        x.stop_gradient = False
+        quad = np.array([[0, 0, 4, 1, 5, 5, 1, 4]], np.float32)
+        out, _, _ = L.roi_perspective_transform(x, to_tensor(quad),
+                                                4, 4)
+        out.sum().backward()
+        assert np.abs(_np(x.grad)).sum() > 0
+
+
+class TestRetinanetTargetAssign:
+    def test_all_anchors_used_class_labels(self):
+        ys, xs = np.meshgrid(np.arange(0, 16, 8), np.arange(0, 16, 8),
+                             indexing="ij")
+        a = np.stack([xs.ravel(), ys.ravel(), xs.ravel() + 7,
+                      ys.ravel() + 7], 1).astype(np.float32)
+        M, C = a.shape[0], 3
+        rng = np.random.default_rng(4)
+        bp = rng.standard_normal((1, M, 4)).astype(np.float32)
+        cl = rng.standard_normal((1, M, C)).astype(np.float32)
+        gt = np.array([[[0, 0, 7, 7]]], np.float32)
+        gtl = np.array([[2]], np.int64)
+        info = np.array([[16, 16, 1.0]], np.float32)
+        (ps, pl, tl, tb, iw,
+         fg_num) = L.retinanet_target_assign(
+            to_tensor(bp), to_tensor(cl), to_tensor(a), None,
+            to_tensor(gt), to_tensor(gtl), None, to_tensor(info),
+            num_classes=C)
+        lab = _np(tl).ravel()
+        # the matching anchor carries class 2; others are bg 0; NO
+        # subsampling: all anchors scored
+        assert lab.shape[0] == M
+        assert (lab == 2).sum() == 1
+        assert _np(fg_num).ravel()[0] == 2  # fg + 1
+        assert _np(ps).shape == (M, C)
+        # perfect-match anchor encodes to zero deltas
+        assert np.abs(_np(tb)).max() < 1e-5
+
+    def test_detection_output_decodes(self):
+        a = np.array([[0, 0, 7, 7], [8, 8, 15, 15]], np.float32)
+        d = np.zeros((2, 4), np.float32)
+        s = np.array([[0.9, 0.01], [0.02, 0.8]], np.float32)
+        info = np.array([[16, 16, 1.0]], np.float32)
+        out = _np(L.retinanet_detection_output(
+            [to_tensor(d)], [to_tensor(s)], [to_tensor(a)],
+            to_tensor(info), score_threshold=0.5))
+        assert out.shape[0] == 2
+        row0 = out[out[:, 0] == 0][0]
+        np.testing.assert_allclose(row0[2:], a[0], atol=1e-4)
+
+
+class TestGenerateProposalLabels:
+    def test_sampling_and_class_slot_targets(self):
+        rois = np.array([[0, 0, 7, 7], [20, 20, 27, 27],
+                         [1, 1, 8, 8], [40, 40, 47, 47]], np.float32)
+        gt = np.array([[[0, 0, 7, 7], [20, 20, 27, 27]]], np.float32)
+        gtc = np.array([[1, 2]], np.int64)
+        info = np.array([[64, 64, 1.0]], np.float32)
+        (out_rois, labels, tgts, inw, outw,
+         lens) = L.generate_proposal_labels(
+            to_tensor(rois), to_tensor(gtc), None, to_tensor(gt),
+            to_tensor(info), rois_lengths=np.array([4], np.int64),
+            batch_size_per_im=8, fg_thresh=0.5, class_nums=3,
+            use_random=False)
+        lab = _np(labels).ravel()
+        t = _np(tgts)
+        assert t.shape[1] == 12
+        # fg rois carry their class in the right 4-col slot
+        for k, c in enumerate(lab):
+            if c > 0:
+                assert np.abs(t[k, 4 * c:4 * c + 4]).sum() >= 0
+                assert _np(inw)[k, 4 * c:4 * c + 4].sum() == 4
+            else:
+                assert _np(inw)[k].sum() == 0
+        assert (lab > 0).sum() >= 2  # both gt matched (gt appended)
+        assert int(_np(lens)[0]) == lab.shape[0]
+
+
+class TestGenerateMaskLabels:
+    def test_bitmap_masks_cropped_to_class_slot(self):
+        info = np.array([[8, 8, 1.0]], np.float32)
+        m = np.zeros((8, 8), np.uint8)
+        m[2:6, 2:6] = 1
+        rois = np.array([[2, 2, 5, 5]], np.float32)
+        labels = np.array([[1]], np.int32)
+        res = 4
+        mrois, has, targets, lens = L.generate_mask_labels(
+            to_tensor(info), None, None, [[m]], to_tensor(rois),
+            to_tensor(labels), num_classes=3, resolution=res,
+            rois_lengths=np.array([1], np.int64))
+        t = _np(targets)
+        assert t.shape == (1, 3 * res * res)
+        cls1 = t[0, res * res:2 * res * res].reshape(res, res)
+        assert (cls1 == 1).all()          # roi fully inside the mask
+        assert (t[0, :res * res] == -1).all()  # other classes ignored
+        assert int(_np(lens)[0]) == 1
+
+    def test_empty_segms_image_contributes_nothing(self):
+        info = np.array([[8, 8, 1.0]], np.float32)
+        rois = np.array([[1, 1, 4, 4]], np.float32)
+        labels = np.array([[1]], np.int32)
+        mrois, has, targets, lens = L.generate_mask_labels(
+            to_tensor(info), None, None, [[]], to_tensor(rois),
+            to_tensor(labels), num_classes=2, resolution=2,
+            rois_lengths=np.array([1], np.int64))
+        assert _np(targets).shape[0] == 0
+        assert _np(lens).tolist() == [0]
+
+    def test_polygon_rasterization(self):
+        info = np.array([[10, 10, 1.0]], np.float32)
+        poly = [[2.0, 2.0, 8.0, 2.0, 8.0, 8.0, 2.0, 8.0]]  # square
+        rois = np.array([[3, 3, 7, 7]], np.float32)
+        labels = np.array([[2]], np.int32)
+        mrois, has, targets, lens = L.generate_mask_labels(
+            to_tensor(info), None, None, [[poly]], to_tensor(rois),
+            to_tensor(labels), num_classes=3, resolution=2,
+            rois_lengths=np.array([1], np.int64))
+        t = _np(targets)[0, 2 * 4:3 * 4]
+        assert (t == 1).all()  # roi interior of the square
