@@ -1,0 +1,133 @@
+// AnalysisConfig — Go mirror of the reference's config surface
+// (/root/reference/go/paddle/config.go over PD_AnalysisConfig).
+//
+// TPU-native mapping: the reference toggles select CUDA/MKLDNN/TensorRT
+// engine paths; here the engine is XLA, which owns graph optimization,
+// memory planning and kernel fusion. Accelerator toggles route to the
+// TPU device; pass/engine toggles are RECORDED (visible via the
+// summary the Python Config prints) so ported deployments keep their
+// call sites, but XLA decides — the same honesty contract as
+// paddle1_tpu.inference.Config.
+package paddle
+
+type Precision int
+
+const (
+	PrecisionFloat32 Precision = iota
+	PrecisionInt8
+	PrecisionHalf
+)
+
+type AnalysisConfig struct {
+	model, params     string
+	useAccel          bool // the build's accelerator is the TPU
+	accelDeviceID     int
+	memoryPoolInitMB  int
+	irOptim           bool
+	useFeedFetchOps   bool
+	specifyInputNames bool
+	memoryOptim       bool
+	profile           bool
+	glogInfo          bool
+	cpuMathThreads    int
+	mkldnn            bool
+	mkldnnQuantizer   bool
+	mkldnnBF16        bool
+	tensorRt          bool
+	deletedPasses     []string
+}
+
+func NewAnalysisConfig() *AnalysisConfig {
+	return &AnalysisConfig{irOptim: true, glogInfo: true,
+		cpuMathThreads: 1}
+}
+
+func (c *AnalysisConfig) SetModel(model, params string) {
+	c.model = model
+	c.params = params
+}
+
+func (c *AnalysisConfig) ModelDir() string   { return c.model }
+func (c *AnalysisConfig) ProgFile() string   { return c.model }
+func (c *AnalysisConfig) ParamsFile() string { return c.params }
+
+// EnableUseGpu routes to this build's accelerator — the TPU. The
+// memory-pool size is recorded only: XLA/PJRT owns device memory.
+func (c *AnalysisConfig) EnableUseGpu(memoryPoolInitSizeMb, deviceID int) {
+	c.useAccel = true
+	c.memoryPoolInitMB = memoryPoolInitSizeMb
+	c.accelDeviceID = deviceID
+}
+
+func (c *AnalysisConfig) DisableGpu()                { c.useAccel = false }
+func (c *AnalysisConfig) UseGpu() bool               { return c.useAccel }
+func (c *AnalysisConfig) GpuDeviceId() int           { return c.accelDeviceID }
+func (c *AnalysisConfig) MemoryPoolInitSizeMb() int  { return c.memoryPoolInitMB }
+
+// EnableCudnn is a recorded no-op: XLA emits TPU kernels directly.
+func (c *AnalysisConfig) EnableCudnn()       {}
+func (c *AnalysisConfig) CudnnEnabled() bool { return false }
+
+// IR optimization is XLA's job and always on there; the toggle is
+// recorded for parity.
+func (c *AnalysisConfig) SwitchIrOptim(x bool) { c.irOptim = x }
+func (c *AnalysisConfig) IrOptim() bool        { return c.irOptim }
+
+func (c *AnalysisConfig) SwitchUseFeedFetchOps(x bool) {
+	c.useFeedFetchOps = x
+}
+func (c *AnalysisConfig) UseFeedFetchOpsEnabled() bool {
+	return c.useFeedFetchOps
+}
+
+func (c *AnalysisConfig) SwitchSpecifyInputNames(x bool) {
+	c.specifyInputNames = x
+}
+func (c *AnalysisConfig) SpecifyInputName() bool {
+	return c.specifyInputNames
+}
+
+// TensorRT has no TPU meaning; recorded so ported call sites survive.
+func (c *AnalysisConfig) EnableTensorRtEngine(workspaceSize,
+	maxBatchSize, minSubgraphSize int, precision Precision,
+	useStatic, useCalibMode bool) {
+	c.tensorRt = true
+}
+func (c *AnalysisConfig) TensorrtEngineEnabled() bool { return c.tensorRt }
+
+func (c *AnalysisConfig) SwitchIrDebug(x bool) {}
+
+// MKLDNN toggles: XLA:CPU replaces MKLDNN on the host path; recorded.
+func (c *AnalysisConfig) EnableMkldnn()                {c.mkldnn = true}
+func (c *AnalysisConfig) MkldnnEnabled() bool          { return c.mkldnn }
+func (c *AnalysisConfig) EnableMkldnnQuantizer()       { c.mkldnnQuantizer = true }
+func (c *AnalysisConfig) MkldnnQuantizerEnabled() bool { return c.mkldnnQuantizer }
+func (c *AnalysisConfig) EnableMkldnnBfloat16()        { c.mkldnnBF16 = true }
+func (c *AnalysisConfig) MkldnnBfloat16Enabled() bool  { return c.mkldnnBF16 }
+
+func (c *AnalysisConfig) SetCpuMathLibraryNumThreads(n int) {
+	c.cpuMathThreads = n
+}
+func (c *AnalysisConfig) CpuMathLibraryNumThreads() int {
+	return c.cpuMathThreads
+}
+
+// Memory optimization is XLA's buffer-assignment pass; recorded.
+func (c *AnalysisConfig) EnableMemoryOptim()        { c.memoryOptim = true }
+func (c *AnalysisConfig) MemoryOptimEnabled() bool  { return c.memoryOptim }
+
+func (c *AnalysisConfig) EnableProfile()        { c.profile = true }
+func (c *AnalysisConfig) ProfileEnabled() bool  { return c.profile }
+
+func (c *AnalysisConfig) DisableGlogInfo()      { c.glogInfo = false }
+
+func (c *AnalysisConfig) DeletePass(pass string) {
+	c.deletedPasses = append(c.deletedPasses, pass)
+}
+
+func (c *AnalysisConfig) device() string {
+	if c.useAccel {
+		return "tpu"
+	}
+	return "cpu"
+}

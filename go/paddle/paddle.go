@@ -25,11 +25,19 @@ package paddle
 extern void* p1_predictor_create(const char* model_base, const char* device);
 extern int p1_predictor_num_inputs(void* h);
 extern int p1_predictor_num_outputs(void* h);
+extern const char* p1_predictor_input_name(void* h, int i);
+extern const char* p1_predictor_output_name(void* h, int i);
 extern int p1_predictor_run_f32(void* h, const float** inputs,
                                 const int64_t* shapes, const int* ndims,
                                 int n_inputs, int out_idx, float* out_buf,
                                 int64_t out_capacity, int64_t* out_shape,
                                 int* out_ndim);
+extern int p1_predictor_run_only_f32(void* h, const float** inputs,
+                                     const int64_t* shapes,
+                                     const int* ndims, int n_inputs);
+extern int p1_predictor_fetch_f32(void* h, int out_idx, float* out_buf,
+                                  int64_t out_capacity,
+                                  int64_t* out_shape, int* out_ndim);
 extern void p1_predictor_destroy(void* h);
 extern const char* p1_last_error();
 */
@@ -54,13 +62,30 @@ func NewConfig(modelBase, device string) *Config {
 	return &Config{ModelBase: modelBase, Device: device}
 }
 
-// Predictor wraps the C handle (reference predictor.go Predictor).
+// Predictor wraps the C handle (reference predictor.go Predictor);
+// staged/outputs hold the zero-copy tensor workflow state
+// (predictor.go SetZeroCopyInput/ZeroCopyRun/GetZeroCopyOutput).
 type Predictor struct {
-	h unsafe.Pointer
+	h       unsafe.Pointer
+	staged  map[string]*ZeroCopyTensor
+	outputs map[string]*ZeroCopyTensor
 }
 
 func lastError() error {
 	return errors.New(C.GoString(C.p1_last_error()))
+}
+
+func errMissingInput(name string) error {
+	return errors.New("ZeroCopyRun: input " + name +
+		" was never staged via SetZeroCopyInput")
+}
+
+func (p *Predictor) inputName(i int) string {
+	return C.GoString(C.p1_predictor_input_name(p.h, C.int(i)))
+}
+
+func (p *Predictor) outputName(i int) string {
+	return C.GoString(C.p1_predictor_output_name(p.h, C.int(i)))
 }
 
 func NewPredictor(cfg *Config) (*Predictor, error) {
@@ -127,5 +152,58 @@ func (p *Predictor) Destroy() {
 	if p.h != nil {
 		C.p1_predictor_destroy(p.h)
 		p.h = nil
+	}
+}
+
+// runOnly executes one forward pass and caches all outputs C-side
+// (p1_predictor_run_only_f32); read them with fetchF32.
+func (p *Predictor) runOnly(inputs [][]float32, shapes [][]int64) error {
+	n := len(inputs)
+	inPtrs := make([]*C.float, n)
+	var flatShapes []C.int64_t
+	ndims := make([]C.int, n)
+	for i, in := range inputs {
+		inPtrs[i] = (*C.float)(unsafe.Pointer(&in[0]))
+		ndims[i] = C.int(len(shapes[i]))
+		for _, d := range shapes[i] {
+			flatShapes = append(flatShapes, C.int64_t(d))
+		}
+	}
+	rc := C.p1_predictor_run_only_f32(p.h, &inPtrs[0], &flatShapes[0],
+		&ndims[0], C.int(n))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// fetchF32 copies cached output outIdx after runOnly, growing the
+// buffer on capacity errors.
+func (p *Predictor) fetchF32(outIdx int, capHint int64) ([]float32,
+	[]int64, error) {
+	outCap := capHint
+	for {
+		outBuf := make([]float32, outCap)
+		outShape := make([]C.int64_t, 8)
+		outNdim := C.int(8)
+		rc := C.p1_predictor_fetch_f32(p.h, C.int(outIdx),
+			(*C.float)(unsafe.Pointer(&outBuf[0])),
+			C.int64_t(outCap), &outShape[0], &outNdim)
+		if rc != 0 {
+			err := lastError()
+			if outCap < 1<<28 &&
+				err.Error() == "output buffer/shape capacity too small" {
+				outCap *= 8
+				continue
+			}
+			return nil, nil, err
+		}
+		shape := make([]int64, int(outNdim))
+		numel := int64(1)
+		for i := range shape {
+			shape[i] = int64(outShape[i])
+			numel *= shape[i]
+		}
+		return outBuf[:numel], shape, nil
 	}
 }

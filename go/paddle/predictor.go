@@ -1,0 +1,133 @@
+// Predictor methods — Go mirror of the reference's predictor surface
+// (/root/reference/go/paddle/predictor.go over PD_Predictor): the
+// zero-copy tensor workflow (GetInputTensors → SetValue →
+// ZeroCopyRun → GetZeroCopyOutput) on top of the capi.cc f32 path.
+package paddle
+
+// NewAnalysisPredictor builds a predictor from the reference-style
+// AnalysisConfig (NewPredictor keeps the simpler Config for
+// compatibility with earlier call sites).
+func NewAnalysisPredictor(config *AnalysisConfig) (*Predictor, error) {
+	return NewPredictor(&Config{ModelBase: config.model,
+		Device: config.device()})
+}
+
+func DeletePredictor(p *Predictor) { p.Destroy() }
+
+func (p *Predictor) GetInputNum() int  { return p.NumInputs() }
+func (p *Predictor) GetOutputNum() int { return p.NumOutputs() }
+
+func (p *Predictor) GetInputName(n int) string  { return p.inputName(n) }
+func (p *Predictor) GetOutputName(n int) string { return p.outputName(n) }
+
+func (p *Predictor) GetInputNames() []string {
+	names := make([]string, p.NumInputs())
+	for i := range names {
+		names[i] = p.inputName(i)
+	}
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	names := make([]string, p.NumOutputs())
+	for i := range names {
+		names[i] = p.outputName(i)
+	}
+	return names
+}
+
+// GetInputTensors returns one named ZeroCopyTensor per model input;
+// fill each with Reshape+SetValue, then SetZeroCopyInput.
+func (p *Predictor) GetInputTensors() []*ZeroCopyTensor {
+	out := make([]*ZeroCopyTensor, p.NumInputs())
+	for i := range out {
+		out[i] = &ZeroCopyTensor{name: p.inputName(i)}
+	}
+	return out
+}
+
+func (p *Predictor) GetOutputTensors() []*ZeroCopyTensor {
+	out := make([]*ZeroCopyTensor, p.NumOutputs())
+	for i := range out {
+		out[i] = &ZeroCopyTensor{name: p.outputName(i)}
+	}
+	return out
+}
+
+// SetZeroCopyInput stages a filled input tensor for the next
+// ZeroCopyRun (matched to its input slot by name; unnamed tensors
+// fill the first empty slot).
+func (p *Predictor) SetZeroCopyInput(tensor *ZeroCopyTensor) {
+	if p.staged == nil {
+		p.staged = make(map[string]*ZeroCopyTensor)
+	}
+	name := tensor.name
+	if name == "" {
+		// unnamed tensor fills the first UNSTAGED input slot
+		for i := 0; i < p.NumInputs(); i++ {
+			if _, ok := p.staged[p.inputName(i)]; !ok {
+				name = p.inputName(i)
+				break
+			}
+		}
+	}
+	p.staged[name] = tensor
+}
+
+// ZeroCopyRun executes ONE forward pass on the staged inputs
+// (p1_predictor_run_only_f32) and caches every output for
+// GetZeroCopyOutput — multi-output models pay a single execution.
+func (p *Predictor) ZeroCopyRun() error {
+	n := p.NumInputs()
+	inputs := make([][]float32, n)
+	shapes := make([][]int64, n)
+	capHint := int64(16)
+	for i := 0; i < n; i++ {
+		t, ok := p.staged[p.inputName(i)]
+		if !ok {
+			return errMissingInput(p.inputName(i))
+		}
+		inputs[i] = t.data
+		s := make([]int64, len(t.shape))
+		for d, v := range t.shape {
+			s[d] = int64(v)
+		}
+		shapes[i] = s
+		if int64(len(t.data)) > capHint {
+			capHint = int64(len(t.data))
+		}
+	}
+	if err := p.runOnly(inputs, shapes); err != nil {
+		return err
+	}
+	p.outputs = make(map[string]*ZeroCopyTensor)
+	for o := 0; o < p.NumOutputs(); o++ {
+		data, shape, err := p.fetchF32(o, capHint*16)
+		if err != nil {
+			return err
+		}
+		s32 := make([]int32, len(shape))
+		for d, v := range shape {
+			s32[d] = int32(v)
+		}
+		p.outputs[p.outputName(o)] = &ZeroCopyTensor{
+			name: p.outputName(o), shape: s32, data: data,
+			dtype: FLOAT32}
+	}
+	return nil
+}
+
+// GetZeroCopyOutput fills the caller's tensor (matched by name, or
+// the first output when unnamed) from the last ZeroCopyRun.
+func (p *Predictor) GetZeroCopyOutput(tensor *ZeroCopyTensor) {
+	name := tensor.name
+	if name == "" && p.NumOutputs() >= 1 {
+		name = p.outputName(0)
+	}
+	if src, ok := p.outputs[name]; ok {
+		tensor.name = src.name
+		tensor.shape = src.shape
+		tensor.data = src.data
+		tensor.dtype = src.dtype
+	}
+}

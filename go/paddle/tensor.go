@@ -1,0 +1,100 @@
+// ZeroCopyTensor — Go mirror of the reference's tensor surface
+// (/root/reference/go/paddle/tensor.go over PD_ZeroCopyTensor).
+//
+// The C ABI here is float32-specialized (capi.cc run_f32): SetValue
+// accepts []float32 (and []int32/[]int64/[]uint8, converted with the
+// dtype recorded) and Value returns the flat []float32 with Shape()
+// giving the dims — the decoded-reflect-array form of the reference
+// collapses to (flat data, shape) in this build.
+package paddle
+
+import (
+	"encoding/binary"
+	"unsafe"
+)
+
+type PaddleDType int
+
+const (
+	FLOAT32 PaddleDType = iota
+	INT32
+	INT64
+	UINT8
+	UNKDTYPE
+)
+
+type ZeroCopyTensor struct {
+	name  string
+	shape []int32
+	data  []float32
+	dtype PaddleDType
+}
+
+func NewZeroCopyTensor() *ZeroCopyTensor {
+	return &ZeroCopyTensor{dtype: FLOAT32}
+}
+
+func (t *ZeroCopyTensor) Shape() []int32       { return t.shape }
+func (t *ZeroCopyTensor) Name() string         { return t.name }
+func (t *ZeroCopyTensor) Rename(name string)   { t.name = name }
+func (t *ZeroCopyTensor) DataType() PaddleDType { return t.dtype }
+
+func (t *ZeroCopyTensor) Reshape(shape []int32) {
+	t.shape = append([]int32(nil), shape...)
+}
+
+func numel32(shape []int32) int32 {
+	n := int32(1)
+	for _, d := range shape {
+		n *= d
+	}
+	return n
+}
+
+// SetValue stores the flat payload (row-major, matching the current
+// Shape). Integer slices convert to the f32 wire format with the
+// original dtype recorded.
+func (t *ZeroCopyTensor) SetValue(value interface{}) {
+	switch v := value.(type) {
+	case []float32:
+		t.data = v
+		t.dtype = FLOAT32
+	case []int32:
+		t.data = make([]float32, len(v))
+		for i, x := range v {
+			t.data[i] = float32(x)
+		}
+		t.dtype = INT32
+	case []int64:
+		t.data = make([]float32, len(v))
+		for i, x := range v {
+			t.data[i] = float32(x)
+		}
+		t.dtype = INT64
+	case []uint8:
+		t.data = make([]float32, len(v))
+		for i, x := range v {
+			t.data[i] = float32(x)
+		}
+		t.dtype = UINT8
+	default:
+		t.dtype = UNKDTYPE
+	}
+}
+
+// Value returns the flat float32 payload; pair with Shape().
+func (t *ZeroCopyTensor) Value() []float32 { return t.data }
+
+// Lod: LoD is carried as explicit lengths tensors in this build; the
+// reference accessor is kept as an always-empty stub for parity.
+func (t *ZeroCopyTensor) Lod() [][]uint { return nil }
+
+// Endian reports the host byte order (reference tensor.go:187).
+func Endian() binary.ByteOrder {
+	buf := [2]byte{}
+	*(*uint16)(unsafe.Pointer(&buf[0])) = uint16(0xABCD)
+	if buf[0] == 0xCD {
+		return binary.LittleEndian
+	}
+	return binary.BigEndian
+}

@@ -29,6 +29,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -70,7 +71,95 @@ struct P1Predictor {
   PyObject* predictor;  // paddle1_tpu.inference.Predictor
   int n_inputs;
   int n_outputs;
+  std::vector<std::string> input_names;   // cached at create
+  std::vector<std::string> output_names;
+  PyObject* last_outputs = nullptr;  // run_only → fetch cache
 };
+
+// Build the numpy input list from the flat C buffers; returns a new
+// reference (or nullptr with g_last_error set).
+PyObject* build_inputs(PyObject* np, const float** inputs,
+                       const int64_t* shapes, const int* ndims,
+                       int n_inputs) {
+  PyObject* arglist = PyList_New(n_inputs);
+  if (!arglist) { set_error("alloc arg list"); return nullptr; }
+  const int64_t* sp = shapes;
+  for (int i = 0; i < n_inputs; ++i) {
+    int64_t numel = 1;
+    PyObject* shape = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d) {
+      numel *= sp[d];
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
+    }
+    sp += ndims[i];
+    PyObject* mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(inputs[i])),
+        numel * sizeof(float), PyBUF_READ);
+    PyObject* flat =
+        mv ? PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32")
+           : nullptr;
+    PyObject* arr =
+        flat ? PyObject_CallMethod(flat, "reshape", "O", shape)
+             : nullptr;
+    Py_XDECREF(mv);
+    Py_XDECREF(flat);
+    Py_DECREF(shape);
+    if (!arr) {
+      set_error("build input array");
+      Py_DECREF(arglist);
+      return nullptr;
+    }
+    PyList_SET_ITEM(arglist, i, arr);  // steals
+  }
+  return arglist;
+}
+
+// Copy output out_idx of `outs` into the caller's buffer. Returns 0
+// on success.
+int copy_output(PyObject* np, PyObject* outs, int out_idx,
+                float* out_buf, int64_t out_capacity,
+                int64_t* out_shape, int* out_ndim) {
+  PyObject* out = PyList_GetItem(outs, out_idx);  // borrowed
+  if (!out) { set_error("output index out of range"); return 1; }
+  PyObject* out32 = PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                        out, "float32");
+  if (!out32) { set_error("ascontiguousarray"); return 1; }
+  PyObject* shape = PyObject_GetAttrString(out32, "shape");
+  int rank = static_cast<int>(PyTuple_Size(shape));
+  int64_t numel = 1;
+  for (int d = 0; d < rank; ++d) {
+    int64_t v = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    if (d < *out_ndim) out_shape[d] = v;
+    numel *= v;
+  }
+  Py_DECREF(shape);
+  if (rank > *out_ndim || numel > out_capacity) {
+    g_last_error = "output buffer/shape capacity too small";
+    Py_DECREF(out32);
+    return 1;
+  }
+  *out_ndim = rank;
+  PyObject* bytes = PyObject_CallMethod(out32, "tobytes", nullptr);
+  Py_DECREF(out32);
+  if (!bytes) { set_error("tobytes"); return 1; }
+  std::memcpy(out_buf, PyBytes_AsString(bytes), numel * sizeof(float));
+  Py_DECREF(bytes);
+  return 0;
+}
+
+bool read_names(PyObject* pred, const char* method,
+                std::vector<std::string>* out) {
+  PyObject* names = PyObject_CallMethod(pred, method, nullptr);
+  if (!names) { set_error(method); return false; }
+  int n = static_cast<int>(PyList_Size(names));
+  for (int i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(names, i);  // borrowed
+    const char* s = item ? PyUnicode_AsUTF8(item) : nullptr;
+    out->push_back(s ? s : "");
+  }
+  Py_DECREF(names);
+  return true;
+}
 
 }  // namespace
 
@@ -106,17 +195,15 @@ void* p1_predictor_create(const char* model_base, const char* device) {
     pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
     if (!pred) { set_error("create_predictor()"); break; }
 
-    PyObject* names = PyObject_CallMethod(pred, "get_input_names", nullptr);
-    if (!names) { set_error("get_input_names()"); break; }
-    int n_in = static_cast<int>(PyList_Size(names));
-    Py_DECREF(names);
-    PyObject* onames =
-        PyObject_CallMethod(pred, "get_output_names", nullptr);
-    if (!onames) { set_error("get_output_names()"); break; }
-    int n_out = static_cast<int>(PyList_Size(onames));
-    Py_DECREF(onames);
+    std::vector<std::string> in_names, out_names;
+    if (!read_names(pred, "get_input_names", &in_names)) break;
+    if (!read_names(pred, "get_output_names", &out_names)) break;
+    int n_in = static_cast<int>(in_names.size());
+    int n_out = static_cast<int>(out_names.size());
 
-    auto* h = new P1Predictor{pred, n_in, n_out};
+    auto* h = new P1Predictor{pred, n_in, n_out,
+                              std::move(in_names),
+                              std::move(out_names)};
     pred = nullptr;  // ownership moved
     result = h;
   } while (false);
@@ -133,6 +220,24 @@ int p1_predictor_num_inputs(void* handle) {
 
 int p1_predictor_num_outputs(void* handle) {
   return handle ? static_cast<P1Predictor*>(handle)->n_outputs : -1;
+}
+
+// Name accessors (reference PD_GetInputName/PD_GetOutputName): the
+// returned pointer stays valid for the life of the predictor handle.
+const char* p1_predictor_input_name(void* handle, int i) {
+  if (!handle) return nullptr;
+  auto* h = static_cast<P1Predictor*>(handle);
+  if (i < 0 || i >= static_cast<int>(h->input_names.size()))
+    return nullptr;
+  return h->input_names[i].c_str();
+}
+
+const char* p1_predictor_output_name(void* handle, int i) {
+  if (!handle) return nullptr;
+  auto* h = static_cast<P1Predictor*>(handle);
+  if (i < 0 || i >= static_cast<int>(h->output_names.size()))
+    return nullptr;
+  return h->output_names[i].c_str();
 }
 
 // Run with n_inputs f32 tensors; copy output out_idx into out_buf.
@@ -157,61 +262,12 @@ int p1_predictor_run_f32(void* handle, const float** inputs,
   do {
     np = PyImport_ImportModule("numpy");
     if (!np) { set_error("import numpy"); break; }
-    arglist = PyList_New(n_inputs);
-    if (!arglist) { set_error("alloc arg list"); break; }
-    const int64_t* sp = shapes;
-    bool ok = true;
-    for (int i = 0; i < n_inputs; ++i) {
-      int64_t numel = 1;
-      PyObject* shape = PyTuple_New(ndims[i]);
-      for (int d = 0; d < ndims[i]; ++d) {
-        numel *= sp[d];
-        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(sp[d]));
-      }
-      sp += ndims[i];
-      PyObject* mv = PyMemoryView_FromMemory(
-          reinterpret_cast<char*>(const_cast<float*>(inputs[i])),
-          numel * sizeof(float), PyBUF_READ);
-      PyObject* flat =
-          mv ? PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32")
-             : nullptr;
-      PyObject* arr =
-          flat ? PyObject_CallMethod(flat, "reshape", "O", shape) : nullptr;
-      Py_XDECREF(mv);
-      Py_XDECREF(flat);
-      Py_DECREF(shape);
-      if (!arr) { set_error("build input array"); ok = false; break; }
-      PyList_SET_ITEM(arglist, i, arr);  // steals
-    }
-    if (!ok) break;
+    arglist = build_inputs(np, inputs, shapes, ndims, n_inputs);
+    if (!arglist) break;
     outs = PyObject_CallMethod(h->predictor, "run", "O", arglist);
     if (!outs) { set_error("Predictor.run"); break; }
-    PyObject* out = PyList_GetItem(outs, out_idx);  // borrowed
-    if (!out) { set_error("output index out of range"); break; }
-    PyObject* out32 = PyObject_CallMethod(np, "ascontiguousarray", "Os",
-                                          out, "float32");
-    if (!out32) { set_error("ascontiguousarray"); break; }
-    PyObject* shape = PyObject_GetAttrString(out32, "shape");
-    int rank = static_cast<int>(PyTuple_Size(shape));
-    int64_t numel = 1;
-    for (int d = 0; d < rank; ++d) {
-      int64_t v = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
-      if (d < *out_ndim) out_shape[d] = v;
-      numel *= v;
-    }
-    Py_DECREF(shape);
-    if (rank > *out_ndim || numel > out_capacity) {
-      g_last_error = "output buffer/shape capacity too small";
-      Py_DECREF(out32);
-      break;
-    }
-    *out_ndim = rank;
-    PyObject* bytes = PyObject_CallMethod(out32, "tobytes", nullptr);
-    Py_DECREF(out32);
-    if (!bytes) { set_error("tobytes"); break; }
-    std::memcpy(out_buf, PyBytes_AsString(bytes), numel * sizeof(float));
-    Py_DECREF(bytes);
-    rc = 0;
+    rc = copy_output(np, outs, out_idx, out_buf, out_capacity,
+                     out_shape, out_ndim);
   } while (false);
   Py_XDECREF(outs);
   Py_XDECREF(arglist);
@@ -220,10 +276,71 @@ int p1_predictor_run_f32(void* handle, const float** inputs,
   return rc;
 }
 
+// Run ONCE and cache all outputs on the handle; read them out with
+// p1_predictor_fetch_f32. This is the multi-output path (the Go
+// ZeroCopyRun): one forward execution regardless of output count.
+int p1_predictor_run_only_f32(void* handle, const float** inputs,
+                              const int64_t* shapes, const int* ndims,
+                              int n_inputs) {
+  if (!handle) {
+    g_last_error = "null predictor handle";
+    return 1;
+  }
+  auto* h = static_cast<P1Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* np = nullptr;
+  PyObject* arglist = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (!np) { set_error("import numpy"); break; }
+    arglist = build_inputs(np, inputs, shapes, ndims, n_inputs);
+    if (!arglist) break;
+    PyObject* outs = PyObject_CallMethod(h->predictor, "run", "O",
+                                         arglist);
+    if (!outs) { set_error("Predictor.run"); break; }
+    Py_XDECREF(h->last_outputs);
+    h->last_outputs = outs;  // ownership moved to the handle
+    rc = 0;
+  } while (false);
+  Py_XDECREF(arglist);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Copy output out_idx of the last p1_predictor_run_only_f32 call.
+int p1_predictor_fetch_f32(void* handle, int out_idx, float* out_buf,
+                           int64_t out_capacity, int64_t* out_shape,
+                           int* out_ndim) {
+  if (!handle) {
+    g_last_error = "null predictor handle";
+    return 1;
+  }
+  auto* h = static_cast<P1Predictor*>(handle);
+  if (!h->last_outputs) {
+    g_last_error = "fetch before p1_predictor_run_only_f32";
+    return 1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np) {
+    rc = copy_output(np, h->last_outputs, out_idx, out_buf,
+                     out_capacity, out_shape, out_ndim);
+    Py_DECREF(np);
+  } else {
+    set_error("import numpy");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
 void p1_predictor_destroy(void* handle) {
   if (!handle) return;
   auto* h = static_cast<P1Predictor*>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->last_outputs);
   Py_XDECREF(h->predictor);
   PyGILState_Release(gil);
   delete h;

@@ -96,17 +96,44 @@ class TestCrypto:
 
 class TestGoBindings:
     def test_symbols_match_c_abi(self):
-        """The cgo declarations in go/paddle/paddle.go must name symbols
+        """The cgo declarations in go/paddle/*.go must name symbols
         the C ABI actually exports (toolchain-free consistency check)."""
-        go_src = open(os.path.join(REPO, "go", "paddle",
-                                   "paddle.go")).read()
+        import glob
+        go_src = "".join(
+            open(p).read()
+            for p in glob.glob(os.path.join(REPO, "go", "paddle",
+                                            "*.go")))
         c_src = open(os.path.join(
             REPO, "paddle1_tpu", "core", "native", "src",
             "capi.cc")).read()
-        go_syms = set(re.findall(r"extern \w+\**\s*(p1_\w+)\(", go_src))
-        assert go_syms, "no extern declarations found in paddle.go"
+        go_syms = set(re.findall(r"extern [\w\s]+\**\s*(p1_\w+)\(",
+                                 go_src))
+        assert go_syms, "no extern declarations found in go/paddle"
         for sym in go_syms:
             assert sym in c_src, f"{sym} not exported by capi.cc"
+
+    def test_go_api_parity_surface(self):
+        """The reference's 3-file Go API (config/predictor/tensor)
+        exists with its method names (toolchain-free check)."""
+        base = os.path.join(REPO, "go", "paddle")
+        cfg = open(os.path.join(base, "config.go")).read()
+        for m in ("SetModel", "EnableUseGpu", "DisableGpu", "UseGpu",
+                  "SwitchIrOptim", "EnableMemoryOptim",
+                  "SetCpuMathLibraryNumThreads", "EnableProfile",
+                  "DeletePass", "EnableTensorRtEngine",
+                  "EnableMkldnn"):
+            assert f"func (c *AnalysisConfig) {m}(" in cfg, m
+        pred = open(os.path.join(base, "predictor.go")).read()
+        for m in ("GetInputNum", "GetOutputNum", "GetInputNames",
+                  "GetOutputNames", "GetInputTensors",
+                  "GetOutputTensors", "SetZeroCopyInput",
+                  "GetZeroCopyOutput", "ZeroCopyRun"):
+            assert f"func (p *Predictor) {m}(" in pred, m
+        ten = open(os.path.join(base, "tensor.go")).read()
+        for m in ("Shape", "Name", "Rename", "Reshape", "SetValue",
+                  "Value", "DataType", "Lod"):
+            assert f"func (t *ZeroCopyTensor) {m}(" in ten, m
+        assert "func Endian()" in ten
 
     def test_capi_so_exports(self):
         from paddle1_tpu.core.native import build_capi
@@ -118,7 +145,9 @@ class TestGoBindings:
                              text=True).stdout
         for sym in ("p1_predictor_create", "p1_predictor_run_f32",
                     "p1_predictor_destroy", "p1_last_error",
-                    "p1_predictor_num_inputs", "p1_predictor_num_outputs"):
+                    "p1_predictor_num_inputs", "p1_predictor_num_outputs",
+                    "p1_predictor_input_name",
+                    "p1_predictor_output_name"):
             assert sym in out
 
 
