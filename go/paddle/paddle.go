@@ -159,15 +159,26 @@ func (p *Predictor) Destroy() {
 // (p1_predictor_run_only_f32); read them with fetchF32.
 func (p *Predictor) runOnly(inputs [][]float32, shapes [][]int64) error {
 	n := len(inputs)
+	if n == 0 {
+		return errors.New("runOnly: no inputs staged")
+	}
 	inPtrs := make([]*C.float, n)
 	var flatShapes []C.int64_t
 	ndims := make([]C.int, n)
 	for i, in := range inputs {
+		if len(in) == 0 {
+			return errors.New(
+				"runOnly: input has no data — call SetValue (and " +
+					"Reshape) on every staged tensor")
+		}
 		inPtrs[i] = (*C.float)(unsafe.Pointer(&in[0]))
 		ndims[i] = C.int(len(shapes[i]))
 		for _, d := range shapes[i] {
 			flatShapes = append(flatShapes, C.int64_t(d))
 		}
+	}
+	if len(flatShapes) == 0 {
+		return errors.New("runOnly: every input is rank-0")
 	}
 	rc := C.p1_predictor_run_only_f32(p.h, &inPtrs[0], &flatShapes[0],
 		&ndims[0], C.int(n))
@@ -191,6 +202,9 @@ func (p *Predictor) fetchF32(outIdx int, capHint int64) ([]float32,
 			C.int64_t(outCap), &outShape[0], &outNdim)
 		if rc != 0 {
 			err := lastError()
+			// retry ONLY on the growable data-capacity shortfall; a
+			// rank overflow reports a distinct message and can never
+			// be fixed by a larger buffer
 			if outCap < 1<<28 &&
 				err.Error() == "output buffer/shape capacity too small" {
 				outCap *= 8

@@ -133,7 +133,14 @@ int copy_output(PyObject* np, PyObject* outs, int out_idx,
     numel *= v;
   }
   Py_DECREF(shape);
-  if (rank > *out_ndim || numel > out_capacity) {
+  if (rank > *out_ndim) {
+    // distinct from the data-capacity case: growing the data buffer
+    // can never fix a rank overflow, and callers retry on the other
+    g_last_error = "output rank exceeds shape capacity";
+    Py_DECREF(out32);
+    return 1;
+  }
+  if (numel > out_capacity) {
     g_last_error = "output buffer/shape capacity too small";
     Py_DECREF(out32);
     return 1;
@@ -291,6 +298,10 @@ int p1_predictor_run_only_f32(void* handle, const float** inputs,
   int rc = 1;
   PyObject* np = nullptr;
   PyObject* arglist = nullptr;
+  // drop the previous run's cache up front: a failed run must not
+  // leave stale outputs a later fetch would return as fresh
+  Py_XDECREF(h->last_outputs);
+  h->last_outputs = nullptr;
   do {
     np = PyImport_ImportModule("numpy");
     if (!np) { set_error("import numpy"); break; }
@@ -299,7 +310,6 @@ int p1_predictor_run_only_f32(void* handle, const float** inputs,
     PyObject* outs = PyObject_CallMethod(h->predictor, "run", "O",
                                          arglist);
     if (!outs) { set_error("Predictor.run"); break; }
-    Py_XDECREF(h->last_outputs);
     h->last_outputs = outs;  // ownership moved to the handle
     rc = 0;
   } while (false);

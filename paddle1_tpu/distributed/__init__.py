@@ -76,3 +76,50 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "ps_server", "TableServer", "RemoteTable", "remote_service",
            "checkpoint", "CheckpointManager", "save_sharded",
            "load_sharded", "graph_table", "GraphTable"]
+
+
+# -- PS-era dataset + sparse-table entry configs (reference
+# distributed/__init__.py re-exports) ---------------------------------------
+
+from ..io.file_dataset import InMemoryDataset, QueueDataset  # noqa: E402
+
+
+class _EntryConfig:
+    """Sparse-table entry admission policy (reference
+    distributed/entry_attr.py): serialized into the table config the
+    PS applies when admitting new embedding rows."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryConfig):
+    """Admit a sparse feature only after it has been seen
+    ``count_filter`` times (entry_attr.py CountFilterEntry)."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError(
+                "count_filter must be >= 0 (reference check)")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ProbabilityEntry(_EntryConfig):
+    """Admit a new sparse feature with probability ``probability``
+    (entry_attr.py ProbabilityEntry)."""
+
+    def __init__(self, probability: float):
+        if not 0 <= probability <= 1:
+            raise ValueError(
+                "probability must be in [0, 1] (reference check)")
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+__all__ += ["InMemoryDataset", "QueueDataset", "CountFilterEntry",
+            "ProbabilityEntry"]

@@ -24,6 +24,14 @@ from .utils import recompute, fleet_util
 from .trainer import (HogwildWorker, InferWorker, MultiTrainer,
                       TrainerDesc, DeviceWorkerDesc, create_trainer)
 from .process_trainer import ProcessMultiTrainer
+from .data_generator import (DataGenerator, MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from ...io.file_dataset import (DatasetBase, InMemoryDataset,
+                                QueueDataset)
+# the reference exposes the util singleton's class as UtilBase
+# (fleet_util imported above)
+UtilBase = type(fleet_util)
 
 # module-level delegation to the singleton (the reference exposes
 # fleet.init etc. as module functions)

@@ -1,0 +1,132 @@
+"""MultiSlot data generators: user ETL → the dataset text protocol.
+
+Reference: python/paddle/distributed/fleet/data_generator/
+data_generator.py (DataGenerator:20, MultiSlotDataGenerator:224,
+MultiSlotStringDataGenerator:180): users subclass, implement
+``generate_sample(line)`` returning an iterator of
+[(slot_name, [values...]), ...] per sample, and the generator formats
+the MultiSlot text lines that QueueDataset/InMemoryDataset (and the
+trainer's slot parser) consume:
+
+    <slot_len> v1 v2 ... <slot_len> v1 ...    (values form)
+    name:<len> ...                            (the reference keeps the
+                                               id order per line)
+
+``run_from_stdin`` is the pipe-command entry the reference installs
+into dataset.set_pipe_command; ``run_from_memory`` drains
+``generate_sample(None)`` for in-memory construction.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable, Iterator, List, Tuple
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Override: yield one or more samples for ``line`` — each a
+        list of (slot_name, values) pairs."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample "
+            "(reference data_generator.py:137)")
+
+    def generate_batch(self, samples):
+        """Override to batch-process; default passthrough (reference
+        :158)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- drivers ------------------------------------------------------------
+    def _emit_batched(self, samples, sink):
+        """Apply generate_batch per ``batch_size_`` window (the
+        reference's run loop applies the batch hook before
+        serialization)."""
+        buf = []
+
+        def flush():
+            for s in self.generate_batch(list(buf))():
+                if s is not None:
+                    sink(self._gen_str(s))
+            buf.clear()
+        for s in samples:
+            if s is None:
+                continue
+            buf.append(s)
+            if len(buf) == self.batch_size_:
+                flush()
+        if buf:
+            flush()
+
+    def run_from_stdin(self):
+        """Read lines from stdin, write protocol lines to stdout (the
+        dataset pipe-command contract)."""
+        def gen():
+            for line in sys.stdin:
+                for sample in self.generate_sample(line)():
+                    yield sample
+        self._emit_batched(gen(), sys.stdout.write)
+
+    def run_from_memory(self):
+        """Drain generate_sample(None); returns the protocol lines."""
+        out = []
+        self._emit_batched(self.generate_sample(None)(), out.append)
+        return out
+
+    def _gen_str(self, sample):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator (reference :175)")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, sample) -> str:
+        """[(name, [str values])...] → '<len> v ...' joined
+        (reference :180 — values emitted as-is)."""
+        parts: List[str] = []
+        for _, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, sample) -> str:
+        """Typed form (reference :224): validates that every line
+        carries the same slots in the same order; values int or
+        float."""
+        parts: List[str] = []
+        names = []
+        for name, values in sample:
+            names.append(name)
+            if not values:
+                raise ValueError(
+                    f"slot {name!r} has no values (reference "
+                    "data_generator check)")
+            parts.append(str(len(values)))
+            for v in values:
+                if not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"slot {name!r} value {v!r} is not int/float")
+                parts.append(str(v))
+        if self._proto_info is None:
+            self._proto_info = names
+        elif names != self._proto_info:
+            raise ValueError(
+                "sample slots changed between lines: "
+                f"{names} vs {self._proto_info} (the reference "
+                "enforces a stable slot order)")
+        return " ".join(parts) + "\n"

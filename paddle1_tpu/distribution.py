@@ -153,3 +153,27 @@ class Categorical(Distribution):
             pb = jax.nn.log_softmax(b, axis=-1)
             return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
         return apply("categorical_kl", f, (self.logits, other.logits))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int32"):  # noqa: A002
+    """Sample one class id per row of a probability matrix (reference
+    layers/distributions-adjacent sampling_id op; same object the fluid
+    spelling maps)."""
+    from .fluid.layers_ext import sampling_id as _impl
+    return _impl(x, min=min, max=max, seed=seed, dtype=dtype)
+
+
+def _mvn_diag(loc, scale):
+    from .fluid.layers_ext import MultivariateNormalDiag as _M
+    return _M(loc, scale)
+
+
+class MultivariateNormalDiag:
+    """Reference fluid/layers/distributions.py:528 — diagonal-covariance
+    multivariate normal (entropy + kl_divergence)."""
+
+    def __new__(cls, loc, scale):
+        return _mvn_diag(loc, scale)
+
+
+__all__ += ["MultivariateNormalDiag", "sampling_id"]

@@ -539,12 +539,33 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
         name, ("deformable_conv", C, num_filters, kh, kw, groups),
         lambda: _make_dcn_params(C, num_filters, kh, kw, groups,
                                  bias_attr))
+    return deform_conv2d_core(x, off, msk, hold.weight, hold.bias,
+                              (sh, sw), (ph_, pw_), (dh, dw), groups,
+                              dg)
+
+
+def deform_conv2d_core(x, off, msk, weight, bias, stride, padding,
+                       dilation, groups, dg):
+    """The traced deformable-conv math with EXPLICIT weight/bias —
+    shared by the fluid implicit-param spelling above and the 2.0
+    functional paddle.vision.ops.deform_conv2d."""
+    x, off = _t(x), _t(off)
+    weight = _t(weight)
+    bias = _t(bias) if bias is not None else None
+    msk = _t(msk) if msk is not None else None
+    sh, sw = stride
+    ph_, pw_ = padding
+    dh, dw = dilation
+    num_filters, _, kh, kw = weight.shape
+    N, C, H, W = x.shape
+    Ho = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
 
     def f(x, off, *rest):
         rest = list(rest)
         m = rest.pop(0) if msk is not None else None
         w = rest.pop(0)
-        b = rest.pop(0) if hold.bias is not None else None
+        b = rest.pop(0) if bias is not None else None
         # base sampling grid per output position and tap
         ys = jnp.arange(Ho) * sh - ph_
         xs = jnp.arange(Wo) * sw - pw_
@@ -598,9 +619,9 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
     args = [x, off]
     if msk is not None:
         args.append(msk)
-    args.append(hold.weight)
-    if hold.bias is not None:
-        args.append(hold.bias)
+    args.append(weight)
+    if bias is not None:
+        args.append(bias)
     return apply("deformable_conv", f, tuple(args))
 
 

@@ -1743,27 +1743,10 @@ def density_prior_box(input, image=None, densities=None,
 # -- tier 5: decode/misc long tail -------------------------------------------
 
 def gather_tree(ids, parents):
-    """Back-trace beam-search parent pointers into full sequences
-    (reference gather_tree_op / paddle.nn.functional.gather_tree):
-    ids/parents [T, B, beam] → sequences aligned per final beam."""
-    from ..autograd.engine import apply as _apply
-    import jax
-    import jax.numpy as jnp
-
-    def f(ids, parents):
-        T = ids.shape[0]
-
-        def step(beam_idx, t):
-            # walking backwards: select ids at the CURRENT beam index,
-            # then hop to that beam's parent
-            sel = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
-            par = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
-            return par, sel
-        init = jnp.broadcast_to(jnp.arange(ids.shape[-1]),
-                                ids.shape[1:]).astype(ids.dtype)
-        _, out = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
-        return out[::-1]
-    return _apply("gather_tree", f, (_t(ids), _t(parents)))
+    """Fluid spelling of paddle.nn.functional.gather_tree (the impl
+    lives there — reference gather_tree_op)."""
+    from ..nn.functional.common import gather_tree as _impl
+    return _impl(_t(ids), _t(parents))
 
 
 def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):

@@ -6,3 +6,10 @@ from .dataset import (BatchSampler, ChainDataset, ComposeDataset, Dataset,
                       RandomSampler, Sampler, SequenceSampler, Subset,
                       TensorDataset, WeightedRandomSampler, random_split)
 from .file_dataset import (DatasetFactory, InMemoryDataset, QueueDataset)
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: (id, num_workers, dataset); None in
+    the main process (reference io/dataloader/worker.py:77)."""
+    from .dataloader import _worker_info
+    return _worker_info()

@@ -146,8 +146,26 @@ class _SingleProcessIter:
         self.shutdown()
 
 
+class WorkerInfo:
+    """Visible through io.get_worker_info() inside a worker (reference
+    dataloader/worker.py WorkerInfo: id, num_workers, dataset)."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_current_worker_info = None
+
+
+def _worker_info():
+    return _current_worker_info
+
+
 def _mp_worker_loop(dataset, task_q, result_q, arena_name, collate_fn,
-                    worker_id, worker_init_fn, consumed_val):
+                    worker_id, worker_init_fn, consumed_val,
+                    num_workers=1):
     """Worker process body (reference dataloader/worker.py:171
     _worker_loop). Batches go to the parent as shm-arena descriptors —
     zero-copy apart from the final parent-side read."""
@@ -157,6 +175,8 @@ def _mp_worker_loop(dataset, task_q, result_q, arena_name, collate_fn,
     import numpy as np
 
     from ..core.native import ShmArena
+    global _current_worker_info
+    _current_worker_info = WorkerInfo(worker_id, num_workers, dataset)
     arena = ShmArena(arena_name, create=False)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
@@ -243,7 +263,7 @@ class _MultiProcessIter:
                 target=_mp_worker_loop,
                 args=(loader.dataset, self._task_qs[w], self._result_q,
                       self._arena_names[w], loader.collate_fn, w,
-                      loader.worker_init_fn, self._consumed[w]),
+                      loader.worker_init_fn, self._consumed[w], nw),
                 daemon=True)
             p.start()
             self._workers.append(p)

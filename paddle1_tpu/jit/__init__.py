@@ -385,3 +385,71 @@ def load(path, **configs) -> TranslatedLayer:
     from ..framework.io import load as fload
     params = fload(path + ".pdiparams", return_numpy=True)
     return TranslatedLayer(exported, params)
+
+
+# -- reference jit misc surface (dygraph/jit.py, ProgramTranslator) ----------
+
+declarative = to_static  # the 1.x spelling (jit.py:161)
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100):
+    """Reference dy2static logging knob: records the level (transformed
+    code is visible via StaticFunction.code here)."""
+    global _code_level
+    _code_level = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = int(level)
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static conversion (reference
+    dygraph/dygraph_to_static/program_translator.py:795). ``enable``
+    maps onto the engine's dy2static flag."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        from ..core.flags import set_flags
+        set_flags({"dy2static": bool(enable_to_static)})
+
+    def get_code(self, dygraph_func):
+        fn = to_static(dygraph_func)
+        return getattr(fn, "code", None)
+
+
+class TracedLayer:
+    """Reference dygraph/jit.py TracedLayer: trace a layer once and
+    replay the static form. Here tracing IS jit: ``trace`` wraps the
+    layer in to_static and runs it once to build the cache."""
+
+    def __init__(self, fn, example_inputs):
+        self._fn = fn
+        self._inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        fn = to_static(layer)
+        outs = fn(*inputs)
+        return outs, TracedLayer(fn, inputs)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._fn, path, input_spec=list(self._inputs))
+
+
+__all__ += ["declarative", "set_code_level", "set_verbosity",
+            "ProgramTranslator", "TracedLayer"]

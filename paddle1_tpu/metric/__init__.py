@@ -199,3 +199,14 @@ def mean_iou(pred, label, num_classes):
 
     import jax
     return apply("mean_iou", f, (p, l), n_outputs=3)
+
+
+# the reference exposes the implementation module as paddle.metric.metrics
+# (metric/__init__.py: from .metrics import ...); here the package IS the
+# implementation module, so the name aliases it — registered in
+# sys.modules so `import paddle1_tpu.metric.metrics` also works
+import sys as _sys
+
+metrics = _sys.modules[__name__]
+_sys.modules[__name__ + ".metrics"] = metrics
+__all__ = __all__ + ["metrics"]

@@ -412,3 +412,40 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
 
 
 __all__.append("diag_embed")
+
+
+def gather_tree(ids, parents):
+    """Back-trace beam-search parent pointers into full sequences
+    (reference gather_tree_op; the 2.0 canonical home of the op —
+    paddle.nn.functional.gather_tree): ids/parents [T, B, beam] →
+    sequences aligned per final beam."""
+    import jax
+    import jax.numpy as jnp
+    from ...autograd.engine import apply as _apply
+    from ...core.tensor import Tensor, to_tensor
+    ids_t = ids if isinstance(ids, Tensor) else to_tensor(ids)
+    par_t = parents if isinstance(parents, Tensor) else \
+        to_tensor(parents)
+
+    def f(ids, parents):
+        T = ids.shape[0]
+
+        def step(beam_idx, t):
+            sel = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+            par = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+            return par, sel
+        init = jnp.broadcast_to(jnp.arange(ids.shape[-1]),
+                                ids.shape[1:]).astype(ids.dtype)
+        _, out = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return out[::-1]
+    return _apply("gather_tree", f, (ids_t, par_t))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length vector → binary mask (reference sequence_mask op; 2.0
+    spelling paddle.nn.functional.sequence_mask)."""
+    from ...ops.sequence_ops import sequence_mask as _impl
+    return _impl(x, maxlen=maxlen, dtype=dtype)
+
+
+__all__ += ["gather_tree", "sequence_mask"]

@@ -3,3 +3,6 @@
 from . import lr
 from .optimizer import (SGD, AdaDelta, Adagrad, Adam, Adamax, AdamW,
                         Ftrl, Lamb, Lars, Momentum, Optimizer, RMSProp)
+
+# the 2.0 API spells it Adadelta (reference optimizer/adadelta.py)
+Adadelta = AdaDelta

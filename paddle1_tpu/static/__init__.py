@@ -299,3 +299,293 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 def load_inference_model(path_prefix, executor=None, **kwargs):
     layer = jit_load(path_prefix)
     return layer, [], []
+
+
+# -- reference paddle.static misc surface ------------------------------------
+# (static/__init__.py of the reference: executor/program/scope shells plus
+# the op helpers that survive eagerly)
+
+from ..fluid.layers import data  # noqa: E402  (InputSpec-producing)
+from ..fluid.layers_ext import py_func  # noqa: E402
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..fluid.layers_ext import auc as _auc
+    return _auc(input, label, curve=curve,
+                num_thresholds=num_thresholds, topk=topk,
+                slide_steps=slide_steps)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A mutable global tensor (reference layers/tensor.py
+    create_global_var) — eagerly just a Tensor."""
+    import numpy as np
+    from ..core.tensor import to_tensor
+    return to_tensor(np.full(shape, value, dtype))
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..fluid.layers import create_parameter as _cp
+    return _cp(shape, dtype=dtype, name=name, attr=attr,
+               is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def cpu_places(device_count=None):
+    """Reference static.cpu_places: one Place per host device."""
+    import os
+    from ..core.place import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """The accelerator of this build is the TPU: returns its places
+    (reference cuda_places; spelled for ported scripts)."""
+    import jax
+    from ..core.place import TPUPlace
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [TPUPlace(i) for i in ids]
+
+
+npu_places = cuda_places
+xpu_places = cuda_places
+mlu_places = cuda_places
+
+
+class Variable:
+    """Teaching shell: eager Tensors replace graph Variables (the
+    reference's static.Variable is a ProgramDesc node)."""
+
+    def __init__(self, *a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "static.Variable: tensors are eager here — use "
+            "paddle1_tpu.to_tensor / static.data (InputSpec) instead")
+
+
+from ..framework.param_attr import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr requesting weight normalization (reference
+    param_attr.py WeightNormParamAttr): carried as attributes; the
+    nn.utils.weight_norm wrapper applies the reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+class Scope:
+    """Variable scope shell (reference core Scope): eager parameters
+    live on Layers; kept for exe.run(scope=...) call sites."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+@_ctx.contextmanager
+def program_guard(main_program, startup_program=None):
+    """No-op scope (program construction is tracing here); kept so
+    ported build scripts run their body."""
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to a device; XLA owns placement
+    here — the body runs unpinned."""
+    yield
+
+
+class BuildStrategy:
+    """Recorded-toggle shell (reference BuildStrategy drives the SSA
+    graph passes; XLA owns fusion/memory planning here — the fields
+    are recorded so fleet.DistributedStrategy.build_strategy ports)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(+with_data_parallel) compiles a
+    ProgramDesc; here compilation is jit — this shell carries the
+    callable through exe.run."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "CompiledProgram.with_data_parallel: use "
+            "fleet.ParallelEngine / fleet.distributed_model (GSPMD "
+            "replaces the SSA multi-device graph)")
+
+    def __call__(self, *args, **kwargs):
+        if callable(self._program):
+            return self._program(*args, **kwargs)
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "CompiledProgram wraps a non-callable Program shell; pass "
+            "a callable (jit.to_static function) instead")
+
+
+class ParallelExecutor:
+    def __init__(self, *a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "ParallelExecutor: the multi-device executor is "
+            "fleet.ParallelEngine (strategy-compiled GSPMD) in this "
+            "build")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Eager analog of the Print op (reference control_flow.Print):
+    prints and passes the tensor through."""
+    import numpy as np
+    t = input
+    v = np.asarray(t.numpy())
+    parts = []
+    if message:
+        parts.append(message)
+    if print_tensor_shape:
+        parts.append(f"shape={tuple(v.shape)}")
+    flat = v.reshape(-1)
+    parts.append(f"data={flat[:summarize]}")
+    print(" ".join(str(p) for p in parts))
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Reference append_backward emits grad ops into the program; the
+    eager analog runs autodiff now and returns (param, grad) pairs."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from ..fluid.layers import implicit_parameters
+        params = implicit_parameters()
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist every parameter reachable from the program/callable
+    (reference static.save → .pdparams)."""
+    import paddle1_tpu as _paddle
+    from ..fluid.layers import implicit_parameters
+    state = {f"param_{i}": p for i, p in
+             enumerate(implicit_parameters())}
+    _paddle.save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore parameters saved by static.save (positional match —
+    the program shell records no names)."""
+    import paddle1_tpu as _paddle
+    from ..fluid.layers import implicit_parameters
+    state = _paddle.load(model_path + ".pdparams")
+    for i, p in enumerate(implicit_parameters()):
+        key = f"param_{i}"
+        if key in state:
+            v = state[key]
+            p.set_value(v.numpy() if hasattr(v, "numpy") else v)
+
+
+def save_program_state(program=None):
+    from ..fluid.layers import implicit_parameters
+    import numpy as np
+    return {f"param_{i}": np.asarray(p.numpy())
+            for i, p in enumerate(implicit_parameters())}
+
+
+def load_program_state(model_path, var_list=None):
+    import paddle1_tpu as _paddle
+    state = _paddle.load(model_path + ".pdparams")
+    import numpy as np
+    return {k: (np.asarray(v.numpy()) if hasattr(v, "numpy") else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    from ..fluid.layers import implicit_parameters
+    for i, p in enumerate(implicit_parameters()):
+        key = f"param_{i}"
+        if key in state_dict:
+            p.set_value(state_dict[key])
+
+
+__all__ += ["data", "py_func", "accuracy", "auc", "create_global_var",
+            "create_parameter", "cpu_places", "cuda_places",
+            "npu_places", "xpu_places", "mlu_places", "Variable",
+            "WeightNormParamAttr", "ParamAttr", "Scope",
+            "global_scope", "scope_guard", "program_guard",
+            "name_scope", "device_guard", "BuildStrategy",
+            "ExecutionStrategy", "CompiledProgram", "ParallelExecutor",
+            "Print", "append_backward", "save", "load",
+            "save_program_state", "load_program_state",
+            "set_program_state"]
+ParamAttr = _ParamAttr

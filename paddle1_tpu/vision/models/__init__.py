@@ -6,6 +6,9 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, wide_resnet50_2, wide_resnet101_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .yolo import DarkNet53, YOLOv3, yolov3, yolov3_loss
+# reference submodule spellings (vision/models/__init__ exposes the
+# implementation modules by name too)
+from . import lenet, mobilenet, mobilenetv1, mobilenetv2, resnet, vgg
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2",

@@ -154,11 +154,16 @@ def yolov3(pretrained=False, num_classes=80, **kwargs):
 
 def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
                 anchor_masks=None, num_classes=80, ignore_thresh=0.7,
-                downsample_ratios=(32, 16, 8)):
+                downsample_ratios=(32, 16, 8), gt_scores=None,
+                use_label_smooth=False, scale_x_y=1.0):
     """YOLOv3 training loss (reference yolov3_loss_op), vectorized.
 
     gt_boxes: [B, G, 4] cxcywh normalized to [0,1]; gt_labels: [B, G]
-    int (−1 pads). Returns scalar loss summing obj/cls/box terms.
+    int (−1 pads). ``gt_scores`` [B, G] weights each gt's loss terms
+    (mixup); ``use_label_smooth`` applies the op's
+    min(1/C, 1/40) positive/negative smoothing; ``scale_x_y`` decodes
+    x = s·sigmoid(tx) − (s−1)/2 (yolov3_loss_op.h:287-291,390).
+    Returns scalar loss summing obj/cls/box terms.
     """
     import jax.numpy as jnp
 
@@ -166,8 +171,14 @@ def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
     from ...core.tensor import Tensor
     anchors = np.asarray(anchors or _ANCHORS, np.float32).reshape(-1, 2)
     anchor_masks = anchor_masks or _MASKS
+    if use_label_smooth:
+        sw = min(1.0 / num_classes, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+    else:
+        label_pos, label_neg = 1.0, 0.0
+    sxy = float(scale_x_y)
 
-    def one_level(pred, gtb, gtl, mask, ds):
+    def one_level(pred, gtb, gtl, gts, mask, ds):
         na = len(mask)
         b, _, h, w = pred.shape
         pred = pred.reshape(b, na, 5 + num_classes, h, w)
@@ -203,8 +214,10 @@ def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
         # not negatives.
         gxn = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
         gyn = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
-        pcx = (jax.nn.sigmoid(tx) + gxn) / w
-        pcy = (jax.nn.sigmoid(ty) + gyn) / h
+        sx = sxy * jax.nn.sigmoid(tx) - 0.5 * (sxy - 1.0)
+        sy = sxy * jax.nn.sigmoid(ty) - 0.5 * (sxy - 1.0)
+        pcx = (sx + gxn) / w
+        pcy = (sy + gyn) / h
         paw = sub[:, 0][None, :, None, None]
         pah = sub[:, 1][None, :, None, None]
         pw_ = jnp.exp(jnp.clip(tw, -10, 10)) * paw / (w * ds)
@@ -240,13 +253,20 @@ def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
         jj = jnp.broadcast_to(cj[..., None], sel.shape)
         ii = jnp.broadcast_to(ci[..., None], sel.shape)
         selw = sel.astype(jnp.float32)
+        # per-gt mixup score rides every positive contribution
+        # (yolov3_loss_op.h:390 — score multiplies the gt's terms)
+        selws = selw * jnp.broadcast_to(gts[..., None], sel.shape)
         obj_target = obj_target.at[bb, aa, jj, ii].max(selw)
+        # with scale_x_y, the sigmoid target solves
+        # s·sig(t) − (s−1)/2 = frac  →  sig(t) = (frac + (s−1)/2)/s
+        fx = (gx - jnp.floor(gx) + 0.5 * (sxy - 1.0)) / sxy
+        fy = (gy - jnp.floor(gy) + 0.5 * (sxy - 1.0)) / sxy
         txt = txt.at[bb, aa, jj, ii].add(
-            selw * jnp.broadcast_to((gx - jnp.floor(gx))[..., None],
-                                    sel.shape))
+            selw * jnp.broadcast_to(
+                jnp.clip(fx, 0.0, 1.0)[..., None], sel.shape))
         tyt = tyt.at[bb, aa, jj, ii].add(
-            selw * jnp.broadcast_to((gy - jnp.floor(gy))[..., None],
-                                    sel.shape))
+            selw * jnp.broadcast_to(
+                jnp.clip(fy, 0.0, 1.0)[..., None], sel.shape))
         aw = sub[:, 0][None, None, :]
         ah = sub[:, 1][None, None, :]
         twt = twt.at[bb, aa, jj, ii].add(
@@ -255,22 +275,23 @@ def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
         tht = tht.at[bb, aa, jj, ii].add(
             selw * jnp.log(jnp.maximum(
                 gh[..., None] * h * ds / ah, 1e-9)))
-        box_w = box_w.at[bb, aa, jj, ii].max(selw)
+        box_w = box_w.at[bb, aa, jj, ii].max(selws)
         cls_oh = jax.nn.one_hot(jnp.clip(gtl, 0), num_classes)  # [B,G,C]
+        smooth_oh = cls_oh * label_pos + (1.0 - cls_oh) * label_neg
         cls_target = cls_target.at[
             bb, aa, :, jj, ii].max(selw[..., None] *
-                                   jnp.broadcast_to(cls_oh[:, :, None],
-                                                    sel.shape +
-                                                    (num_classes,)))
+                                   jnp.broadcast_to(
+                                       smooth_oh[:, :, None],
+                                       sel.shape + (num_classes,)))
 
         bce = lambda logit, tgt, wgt: jnp.sum(
             wgt * (jnp.maximum(logit, 0) - logit * tgt +
                    jnp.log1p(jnp.exp(-jnp.abs(logit)))))
         loss_xy = bce(tx, txt, box_w) + bce(ty, tyt, box_w)
         loss_wh = jnp.sum(box_w * ((tw - twt) ** 2 + (th - tht) ** 2)) * 0.5
-        # objectness: positives always count; negatives only where the
-        # best IoU vs gt stays below ignore_thresh
-        obj_w = jnp.where(obj_target > 0, 1.0,
+        # objectness: positives count at their gt score; negatives only
+        # where the best IoU vs gt stays below ignore_thresh
+        obj_w = jnp.where(obj_target > 0, jnp.maximum(box_w, 1e-8),
                           (best_iou < ignore_thresh).astype(jnp.float32))
         loss_obj = bce(tobj, obj_target, obj_w)
         loss_cls = bce(tcls, cls_target,
@@ -279,13 +300,17 @@ def yolov3_loss(outputs, gt_boxes, gt_labels, anchors=None,
 
     import jax
 
-    def f(gtb, gtl, *preds):
+    def f(gtb, gtl, gts, *preds):
         total = 0.0
         for pred, mask, ds in zip(preds, anchor_masks, downsample_ratios):
-            total = total + one_level(pred, gtb, gtl, mask, ds)
+            total = total + one_level(pred, gtb, gtl, gts, mask, ds)
         return total / preds[0].shape[0]
-    tensors = (gt_boxes, gt_labels) + tuple(outputs)
     from ...core.tensor import to_tensor as tt
+    if gt_scores is None:
+        gt_arr = (gt_boxes.numpy() if hasattr(gt_boxes, "numpy")
+                  else gt_boxes)
+        gt_scores = np.ones(np.asarray(gt_arr).shape[:2], np.float32)
+    tensors = (gt_boxes, gt_labels, gt_scores) + tuple(outputs)
     return apply("yolov3_loss", f,
                  tuple(t if isinstance(t, Tensor) else tt(t)
                        for t in tensors))

@@ -479,3 +479,98 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     restore = np.empty_like(order)
     restore[order] = np.arange(len(order))
     return outs, to_tensor(restore.astype(np.int64))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1,
+                  mask=None, name=None):
+    """2.0 functional deformable conv (reference vision/ops.py
+    deform_conv2d over deformable_conv_op): explicit ``weight``
+    [F, C/groups, kh, kw]; ``mask`` present → v2 (modulated)."""
+    from ..fluid.detection_train import deform_conv2d_core
+    two = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    return deform_conv2d_core(x, offset, mask, weight, bias,
+                              two(stride), two(padding), two(dilation),
+                              groups, deformable_groups)
+
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer form of deform_conv2d (reference vision/ops.py
+    DeformConv2D): owns weight/bias; offsets (and the v2 mask) are
+    inputs computed by a sibling conv. A real nn.Layer so an enclosing
+    model registers it (parameters/state_dict)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, deformable_groups=1,
+                 groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size if isinstance(kernel_size, (list, tuple))
+                  else (kernel_size, kernel_size))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels],
+                                           is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._cfg = (stride, padding, dilation, deformable_groups,
+                     groups)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Single-level YOLOv3 loss (reference vision/ops.py yolo_loss /
+    yolov3_loss_op): delegates to the multi-level
+    vision.models.yolo.yolov3_loss with one output map, forwarding
+    gt_score (mixup weights), label smoothing, and scale_x_y. Returns
+    the scalar loss (this build reduces over the batch; the reference
+    returns per-sample [N])."""
+    from .models.yolo import yolov3_loss
+    return yolov3_loss([x], gt_box, gt_label,
+                       anchors=[list(a) if isinstance(a, (list, tuple))
+                                else a for a in
+                                np.asarray(anchors).reshape(-1, 2)
+                                .tolist()],
+                       anchor_masks=[list(anchor_mask)],
+                       num_classes=class_num,
+                       ignore_thresh=ignore_thresh,
+                       downsample_ratios=(downsample_ratio,),
+                       gt_scores=gt_score,
+                       use_label_smooth=use_label_smooth,
+                       scale_x_y=scale_x_y)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference vision/ops.py
+    read_file; pairs with decode_jpeg)."""
+    from ..fluid.misc_tail import read_file as _impl
+    return _impl(filename, name=name)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference
+    vision/ops.py decode_jpeg over nvjpeg). Pure-numpy baseline
+    decoder (core/jpeg.py): sequential baseline DCT, the format the
+    reference's pipeline produces/consumes."""
+    from ..core.jpeg import decode_jpeg_bytes
+    data = np.asarray(_t(x).numpy(), np.uint8).tobytes()
+    img = decode_jpeg_bytes(data)  # [H, W, C] uint8
+    if mode == "gray" and img.shape[-1] == 3:
+        img = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+               + 0.114 * img[..., 2]).astype(np.uint8)[..., None]
+    from ..core.tensor import to_tensor
+    return to_tensor(np.ascontiguousarray(img.transpose(2, 0, 1)))
+
+
+__all__ += ["deform_conv2d", "DeformConv2D", "yolo_loss", "read_file",
+            "decode_jpeg"]

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""API coverage diff: the reference's public python surface vs this
+build (the api-diff half of the reference's CI tooling —
+/root/reference/tools/check_api_compatible.py,
+tools/print_signatures.py role).
+
+Sweeps each public namespace's reference ``__all__`` (falling back to
+``from X import Y`` re-exports) and classifies every name as mapped /
+missing here. Prints a per-namespace table and one JSON line for
+tooling; exits nonzero when coverage drops below the pinned floors so
+it can gate CI like the reference's API checker.
+
+Usage: python tools/api_diff.py [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REF = "/root/reference/python/paddle"
+
+# namespace -> (reference source file(s), our import path, floor %)
+NAMESPACES = {
+    "paddle": (["__init__.py"], "paddle1_tpu", 95),
+    "paddle.nn": (["nn/__init__.py"], "paddle1_tpu.nn", 90),
+    "paddle.nn.functional": (["nn/functional/__init__.py"],
+                             "paddle1_tpu.nn.functional", 90),
+    "paddle.optimizer": (["optimizer/__init__.py"],
+                         "paddle1_tpu.optimizer", 90),
+    "paddle.optimizer.lr": (["optimizer/lr.py"],
+                            "paddle1_tpu.optimizer.lr", 90),
+    "paddle.metric": (["metric/__init__.py"], "paddle1_tpu.metric",
+                      90),
+    "paddle.amp": (["amp/__init__.py"], "paddle1_tpu.amp", 90),
+    "paddle.static": (["static/__init__.py"], "paddle1_tpu.static",
+                      70),
+    "paddle.jit": (["jit/__init__.py"], "paddle1_tpu.jit", 80),
+    "paddle.io": (["io/__init__.py"], "paddle1_tpu.io", 80),
+    "paddle.vision.models": (["vision/models/__init__.py"],
+                             "paddle1_tpu.vision.models", 80),
+    "paddle.vision.ops": (["vision/ops.py"], "paddle1_tpu.vision.ops",
+                          80),
+    "paddle.vision.transforms": (["vision/transforms/__init__.py"],
+                                 "paddle1_tpu.vision.transforms", 80),
+    "paddle.distributed": (["distributed/__init__.py"],
+                           "paddle1_tpu.distributed", 75),
+    "paddle.distributed.fleet": (["distributed/fleet/__init__.py"],
+                                 "paddle1_tpu.distributed.fleet", 70),
+    "paddle.distribution": (["distribution.py"],
+                            "paddle1_tpu.distribution", 70),
+    "paddle.fluid.layers": (None, "paddle1_tpu.fluid.layers", 90),
+}
+
+
+def _ref_names(files):
+    names = set()
+    for f in files:
+        path = os.path.join(REF, f)
+        if not os.path.isfile(path):
+            continue
+        t = open(path, encoding="utf-8", errors="replace").read()
+        # __all__ (+= extensions included) is authoritative when
+        # present; the import-scan fallback would count internal
+        # imports as API
+        alls = re.findall(r"__all__\s*\+?=\s*\[(.*?)\]", t, re.S)
+        if alls:
+            for chunk in alls:
+                names.update(re.findall(r"['\"]([A-Za-z_][\w]*)['\"]",
+                                        chunk))
+            continue
+        names.update(re.findall(r"^from [\w.]+ import ([A-Za-z_]\w*)",
+                                t, re.M))
+        names.update(re.findall(
+            r"^from [\w.]+ import \w+ as ([A-Za-z_]\w*)", t, re.M))
+    return {n for n in names if not n.startswith("_")}
+
+
+def _fluid_layers_names():
+    names = set()
+    d = os.path.join(REF, "fluid", "layers")
+    for f in os.listdir(d):
+        if not f.endswith(".py") or f == "__init__.py":
+            continue
+        t = open(os.path.join(d, f), encoding="utf-8",
+                 errors="replace").read()
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", t, re.S)
+        if m:
+            names.update(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]",
+                                    m.group(1)))
+    return names
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    import importlib
+    rows = []
+    failed = False
+    for ns, (files, ours, floor) in NAMESPACES.items():
+        ref = (_fluid_layers_names() if files is None
+               else _ref_names(files))
+        if not ref:
+            continue
+        try:
+            mod = importlib.import_module(ours)
+        except Exception as e:
+            rows.append({"namespace": ns, "total": len(ref),
+                         "mapped": 0, "pct": 0.0,
+                         "missing": sorted(ref),
+                         "error": str(e)})
+            failed = True
+            continue
+        missing = sorted(n for n in ref if not hasattr(mod, n))
+        pct = 100.0 * (len(ref) - len(missing)) / len(ref)
+        if pct < floor:
+            failed = True
+        rows.append({"namespace": ns, "total": len(ref),
+                     "mapped": len(ref) - len(missing),
+                     "pct": round(pct, 1), "floor": floor,
+                     "missing": missing})
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        total = sum(r["total"] for r in rows)
+        mapped = sum(r["mapped"] for r in rows)
+        for r in rows:
+            flag = " *BELOW FLOOR*" if r["pct"] < r.get("floor", 0) \
+                else ""
+            print(f"{r['namespace']:32s} {r['mapped']:4d}/"
+                  f"{r['total']:4d}  {r['pct']:5.1f}%{flag}")
+            if r["missing"] and len(r["missing"]) <= 25:
+                print(f"    missing: {', '.join(r['missing'])}")
+            elif r["missing"]:
+                print(f"    missing ({len(r['missing'])}): "
+                      f"{', '.join(r['missing'][:25])} ...")
+        print(f"{'TOTAL':32s} {mapped:4d}/{total:4d}  "
+              f"{100.0 * mapped / total:5.1f}%")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-5 tunnel watcher: probe until ~7h from launch; on recovery run
+# the FULL queued chip sequence (VERDICT r4 items 1-2 + the r5 busbw
+# harness) and save everything under chip_results/. One pass, then exit.
+cd /root/repo
+mkdir -p chip_results
+LOG=chip_results/watch.log
+echo "chip_watch2 start $(date -u)" >> "$LOG"
+for i in $(seq 1 46); do
+  if timeout 120 python -c "import jax, jax.numpy as jnp; jax.devices(); print(float(jnp.ones(8).sum()))" 2>/dev/null | grep -q "8.0"; then
+    echo "tunnel ALIVE at $(date -u) (attempt $i)" >> "$LOG"
+    echo "== kernel smoke ==" >> "$LOG"
+    timeout 1800 python tools/tpu_kernel_smoke.py \
+        > chip_results/kernel_smoke.txt 2>&1
+    echo "kernel_smoke rc=$?" >> "$LOG"
+    echo "== conv probe (incl. conv_nhwc flag) ==" >> "$LOG"
+    timeout 2400 python tools/tpu_conv_probe.py \
+        > chip_results/conv_probe.txt 2>&1
+    echo "conv_probe rc=$?" >> "$LOG"
+    echo "== bert batch sweep ==" >> "$LOG"
+    for B in 32 64 128; do
+      timeout 1800 python bench.py --batch $B \
+          > "chip_results/bert_b$B.json" 2> "chip_results/bert_b$B.err"
+      echo "bert b$B rc=$?" >> "$LOG"
+    done
+    echo "== configs 1/2/4/5 + busbw ==" >> "$LOG"
+    for C in mnist_lenet resnet50_dp ernie_sharded yolov3_infer allreduce_busbw; do
+      timeout 2400 python bench.py --config $C \
+          > "chip_results/$C.json" 2> "chip_results/$C.err"
+      echo "$C rc=$?" >> "$LOG"
+    done
+    echo "chip sequence DONE $(date -u)" >> "$LOG"
+    exit 0
+  fi
+  echo "wedged attempt $i $(date -u)" >> "$LOG"
+  sleep 540
+done
+echo "chip_watch2 gave up $(date -u)" >> "$LOG"
+exit 1
