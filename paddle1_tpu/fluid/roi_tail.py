@@ -122,8 +122,8 @@ def _tent_integral(lo, hi, n):
     return seg(lo_, hi_)
 
 
-def prroi_pool(input, rois, pooled_height=1, pooled_width=1,
-               spatial_scale=1.0, batch_roi_nums=None, name=None):
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
     """Precise ROI pooling (reference prroi_pool_op.h): each bin is the
     EXACT integral of the bilinearly-interpolated feature over the bin
     rectangle, divided by the bin area — no sampling-point

@@ -60,7 +60,8 @@ class TestPrroiPool:
     def test_constant_map_gives_constant(self):
         x = np.full((1, 1, 6, 6), 3.0, np.float32)
         rois = np.array([[0.7, 0.9, 4.3, 4.9]], np.float32)
-        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), 2, 2))
+        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), pooled_height=2,
+                               pooled_width=2))
         np.testing.assert_allclose(out, 3.0, rtol=1e-5)
 
     def test_linear_ramp_integral(self):
@@ -69,7 +70,8 @@ class TestPrroiPool:
         W = 8
         x = np.tile(np.arange(W, dtype=np.float32), (1, 1, W, 1))
         rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
-        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), 1, 2))
+        out = _np(L.prroi_pool(to_tensor(x), to_tensor(rois), pooled_height=1,
+                               pooled_width=2))
         # two bins along x: [1,3] and [3,5] -> means 2 and 4
         np.testing.assert_allclose(out[0, 0, 0], [2.0, 4.0],
                                    rtol=1e-5)
@@ -81,7 +83,7 @@ class TestPrroiPool:
         rois = to_tensor(np.array([[1.2, 1.1, 4.4, 4.6]], np.float32))
         x.stop_gradient = False
         rois.stop_gradient = False
-        out = L.prroi_pool(x, rois, 2, 2)
+        out = L.prroi_pool(x, rois, pooled_height=2, pooled_width=2)
         out.sum().backward()
         assert np.abs(_np(x.grad)).sum() > 0
         assert np.abs(_np(rois.grad)).sum() > 0   # coordinate grads
