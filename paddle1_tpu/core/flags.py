@@ -168,12 +168,13 @@ def _define_builtin_flags() -> None:
     define_flag("fused_softmax", "auto",
                 "Pallas fused softmax: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))
-    define_flag("flash_backward", "never",
+    define_flag("flash_backward", "auto",
                 "Pallas flash-attention BACKWARD kernels: auto (TPU "
                 "only), always (interpret on CPU), never (XLA recompute "
-                "backward). Default 'never' until the Mosaic lowering is "
-                "chip-smoked (tools/tpu_kernel_smoke.py) — interpret "
-                "mode does not enforce Mosaic tiling.",
+                "backward). Default 'auto' since the Mosaic lowering "
+                "passed the on-chip smoke (tools/tpu_kernel_smoke.py, "
+                "r5: all dq/dk/dv variants max_err=0 vs the XLA "
+                "recompute backward on TPU v5 lite).",
                 validator=lambda v: v in ("auto", "always", "never"))
     define_flag("conv_nhwc", "never",
                 "Run NCHW-API convs internally in NHWC (transpose at the "

@@ -14,10 +14,13 @@ Both consume the forward's LSE and ``delta = rowsum(dout * out)``
 ride a trailing singleton dim ([bh, n, 1]) which satisfies Mosaic's
 (8, 128)-or-equal tiling rule without lane broadcasting.
 
-Gated OFF by default (core flag ``flash_backward``) until
-tools/tpu_kernel_smoke.py has validated the Mosaic lowering on a real
-chip — interpret mode does not enforce the tiling rules (the forward's
-LSE layout bug only surfaced on hardware).
+Gated by core flag ``flash_backward`` — default ``auto`` (engaged on
+TPU) since tools/tpu_kernel_smoke.py validated the Mosaic lowering on a
+real chip (r5, TPU v5 lite: every dq/dk/dv variant bit-exact vs the XLA
+recompute backward — chip_results/kernel_smoke.txt). ``never`` restores
+the XLA recompute backward; interpret mode (``always`` off-TPU) does not
+enforce the tiling rules (the forward's LSE layout bug only surfaced on
+hardware).
 """
 
 from __future__ import annotations

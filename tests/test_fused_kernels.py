@@ -419,9 +419,11 @@ class TestFlashBackwardKernels:
         np.testing.assert_allclose(np.asarray(dq_p), np.asarray(dq_ref),
                                    rtol=5e-3, atol=5e-3)
 
-    def test_flag_default_is_never(self):
+    def test_flag_default_is_auto(self):
+        # flipped never -> auto after the r5 on-chip smoke passed
+        # (chip_results/kernel_smoke.txt: all bwd variants max_err=0)
         from paddle1_tpu.core.flags import flag
-        assert flag("flash_backward") == "never"
+        assert flag("flash_backward") == "auto"
 
     def test_fully_padded_row_zero_grads(self):
         # one batch entry entirely padded: all three grads must be EXACT

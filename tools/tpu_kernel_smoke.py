@@ -55,8 +55,8 @@ def main():
               q, k, v, mask=(pm[:, None, None, :] > 0.5))
               .astype(jnp.float32).sum())(q), 8e-2)
 
-    # flash BACKWARD kernels (flag-gated 'never' until this smoke passes;
-    # flip core flag flash_backward to 'auto' once green here)
+    # flash BACKWARD kernels (this smoke passed on-chip in r5, so the
+    # core flag flash_backward now defaults to 'auto')
     from paddle1_tpu.ops.pallas import flash_attention as fa_mod
     from paddle1_tpu.ops.pallas.flash_attention_bwd import \
         flash_attention_bwd
