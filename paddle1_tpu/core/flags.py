@@ -100,6 +100,26 @@ def set_flags(flags: Dict[str, Any]) -> None:
             d.value = value
 
 
+def flag_active(name: str) -> bool:
+    """Resolve an auto/always/never flag against the backend: True when
+    ``always``, or when ``auto`` and the default backend is TPU. The
+    shared idiom behind the Pallas-kernel gates and the channels-last
+    region."""
+    v = flag(name)
+    if v == "always":
+        return True
+    if v == "auto":
+        import jax
+        return jax.default_backend() == "tpu"
+    return False
+
+
+def conv_nhwc_active() -> bool:
+    """Whether NCHW-API image ops should execute channels-last
+    internally (the conv_nhwc flag resolved against the backend)."""
+    return flag_active("conv_nhwc")
+
+
 class flags_guard:
     """Context manager that temporarily overrides flags (test helper)."""
 
@@ -176,14 +196,18 @@ def _define_builtin_flags() -> None:
                 "r5: all dq/dk/dv variants max_err=0 vs the XLA "
                 "recompute backward on TPU v5 lite).",
                 validator=lambda v: v in ("auto", "always", "never"))
-    define_flag("conv_nhwc", "never",
-                "Run NCHW-API convs internally in NHWC (transpose at the "
-                "op boundary; XLA cancels back-to-back transposes): the "
-                "candidate fix for the conv-throughput question in "
-                "BASELINE.md (configs 2/5 measured ~0.3% MFU; suspected "
-                "NCHW layout cost on the axon backend). Values: never / "
-                "always; tools/tpu_conv_probe.py measures both.",
-                validator=lambda v: v in ("always", "never"))
+    define_flag("conv_nhwc", "auto",
+                "Run NCHW-API image ops (2-D conv with HWIO weights, "
+                "max/avg pool, batch norm) internally channels-last, "
+                "transposing at each op boundary so XLA cancels the "
+                "interior transpose pairs. The r5 on-chip probes showed "
+                "the axon backend does no layout assignment of its own: "
+                "NHWC+HWIO convs sustain ~100 TF/s while NCHW convs and "
+                "NCHW reduce_window pooling are 20-100x slower "
+                "(chip_results/conv_probe2.txt). Values: auto (TPU "
+                "only), always, never; tools/tpu_conv_probe.py measures "
+                "both layouts.",
+                validator=lambda v: v in ("auto", "always", "never"))
 
 
 _define_builtin_flags()

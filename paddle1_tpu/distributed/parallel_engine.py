@@ -299,12 +299,24 @@ class ParallelEngine:
         # multi-host leaves stay numpy (host RAM); single-host leaves go
         # through _as_arrays as before
         arrs = jax.tree_util.tree_map(
-            lambda x: np.asarray(x.data if isinstance(x, Tensor) else x),
+            lambda x: x if isinstance(x, jax.Array)  # pre-staged leaf
+            else np.asarray(x.data if isinstance(x, Tensor) else x),
             batch, is_leaf=lambda x: isinstance(x, Tensor)) \
             if multi else _as_arrays(batch)
         spec = self.batch_spec
 
         def place(a):
+            # pass-through for leaves that are already global jax Arrays
+            # on this mesh (pre-staged batches re-fed to step): re-
+            # sharding would be a no-op single-host but np.asarray on a
+            # non-fully-addressable Array raises multi-host
+            if isinstance(a, jax.Array) and not isinstance(
+                    a, jax.core.Tracer):
+                sh = getattr(a, "sharding", None)
+                if (getattr(sh, "mesh", None) is not None
+                        and getattr(sh.mesh, "devices", None) is not None
+                        and sh.mesh.shape == self.mesh.shape):
+                    return a
             s = spec if spec is not None else data_partition_spec(
                 tuple(ax for ax in ("dp", "sharding")
                       if ax in self.mesh.shape))

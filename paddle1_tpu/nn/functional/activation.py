@@ -181,12 +181,9 @@ def softmax(x, axis=-1, dtype=None, name=None):
     def f(x):
         if dtype is not None:
             x = x.astype(dtypes.convert_dtype(dtype))
-        from ...core.flags import flag
+        from ...core.flags import flag_active
         from ...ops.pallas import softmax as psm
-        mode = flag("fused_softmax")
-        fused_ok = (mode == "always" or
-                    (mode == "auto" and jax.default_backend() == "tpu"))
-        if fused_ok and psm.supported(x.shape, axis):
+        if flag_active("fused_softmax") and psm.supported(x.shape, axis):
             return psm.fused_softmax(x)
         return jax.nn.softmax(x, axis=axis)
     return apply("softmax", f, (_t(x),))

@@ -91,10 +91,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         return jnp.asarray(fl > -1e4, jnp.float32)
 
     def f(q, k, v, *m):
-        from ...core.flags import flag
-        mode = flag("flash_attention")
-        flash_ok = (mode == "always" or
-                    (mode == "auto" and jax.default_backend() == "tpu"))
+        from ...core.flags import flag_active
+        flash_ok = flag_active("flash_attention")
         mask = m[0] if m else None
         if (use_flash and drop == 0.0 and flash_ok
                 and fa.supported(q.shape, k.shape, causal=is_causal)):

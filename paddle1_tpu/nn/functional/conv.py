@@ -69,21 +69,26 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
         dn_str = ("NDHWC", "OIDHW", "NDHWC") if channel_last else \
             ("NCDHW", "OIDHW", "NCDHW")
 
-    # NCHW-API convs can run internally in NHWC (the layout the TPU
-    # convolution engine prefers; see the conv_nhwc flag). Only the 2-D
-    # NCHW case participates — the transposes at the op boundary cancel
-    # between adjacent ops under XLA's algebraic simplifier.
-    from ...core.flags import flag as _flag
+    # NCHW-API convs can run internally in NHWC with HWIO weights (the
+    # layout the TPU convolution engine wants; see the conv_nhwc flag).
+    # Only the 2-D NCHW case participates — the transposes at the op
+    # boundary cancel between adjacent ops under XLA's algebraic
+    # simplifier, and the weight transpose is negligible next to the
+    # conv itself (r5 on-chip: NHWC+OIHW ran 4.5x slower than
+    # NHWC+HWIO — the axon backend does not relayout weights either;
+    # chip_results/conv_probe2.txt).
+    from ...core.flags import conv_nhwc_active
     nhwc_internal = (not channel_last and ndim == 2
-                     and _flag("conv_nhwc") == "always")
+                     and conv_nhwc_active())
 
     def f(x, w, *maybe_b):
         if nhwc_internal:
             xi = jnp.transpose(x, (0, 2, 3, 1))
+            wi = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
             dn = jax.lax.conv_dimension_numbers(
-                xi.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+                xi.shape, wi.shape, ("NHWC", "HWIO", "NHWC"))
             out = jax.lax.conv_general_dilated(
-                xi, w, window_strides=stride, padding=pad,
+                xi, wi, window_strides=stride, padding=pad,
                 rhs_dilation=dilation, dimension_numbers=dn,
                 feature_group_count=groups)
             if maybe_b:

@@ -33,7 +33,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reference's mutable mean/variance variables."""
     x = _t(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    ch_axis = x.ndim - 1 if channel_last else 1
+    # NCHW 4-D batch norm participates in the channels-last region (the
+    # conv_nhwc flag): computing with the channel axis last makes the
+    # boundary transposes sit directly against the neighboring convs'
+    # and pools', where XLA cancels them (chip_results/conv_probe2.txt).
+    from ...core.flags import conv_nhwc_active
+    nhwc_internal = (not channel_last and x.ndim == 4
+                     and conv_nhwc_active())
+    eff_last = channel_last or nhwc_internal
+    ch_axis = x.ndim - 1 if eff_last else 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     use_stats = (not training) if use_global_stats is None else use_global_stats
 
@@ -42,13 +50,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         shape[ch_axis] = -1
         return v.reshape(shape)
 
+    def to_internal(x):
+        return jnp.transpose(x, (0, 2, 3, 1)) if nhwc_internal else x
+
+    def from_internal(y):
+        return jnp.transpose(y, (0, 3, 1, 2)) if nhwc_internal else y
+
     if use_stats:
         def f(x, m, v, *wb):
+            x = to_internal(x)
             y = (x - bshape(m, x.ndim)) * jax.lax.rsqrt(
                 bshape(v, x.ndim) + epsilon)
             if wb:
                 y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
-            return y
+            return from_internal(y)
         args = (x, _t(running_mean), _t(running_var))
         if weight is not None:
             args = args + (_t(weight), _t(bias))
@@ -56,13 +71,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     # training: compute batch stats, update running stats in place
     def f(x, *wb):
+        x = to_internal(x)
         mean = jnp.mean(x, axis=reduce_axes)
         var = jnp.var(x, axis=reduce_axes)
         y = (x - bshape(mean, x.ndim)) * jax.lax.rsqrt(
             bshape(var, x.ndim) + epsilon)
         if wb:
             y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
-        return y, mean, var
+        return from_internal(y), mean, var
 
     args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
     y, mean, var = apply("batch_norm_train", f, args, n_outputs=3)
@@ -84,12 +100,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
     def f(x, *wb):
         if wb:
-            from ...core.flags import flag
+            from ...core.flags import flag_active
             from ...ops.pallas import layer_norm as pln
-            mode = flag("fused_layer_norm")
-            fused_ok = (mode == "always" or
-                        (mode == "auto" and jax.default_backend() == "tpu"))
-            if fused_ok and pln.supported(x.shape, n_axes):
+            if flag_active("fused_layer_norm") and pln.supported(
+                    x.shape, n_axes):
                 return pln.fused_layer_norm(x, wb[0], wb[1], epsilon)
         xf = x.astype(jnp.float32)  # stats in f32 even under bf16 AMP
         mean = jnp.mean(xf, axis=axes, keepdims=True)

@@ -37,7 +37,19 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
         pad_cfg = pad
 
     def f(x):
-        if channel_last:
+        # NCHW-API 2-D pools run channels-last internally when the
+        # conv_nhwc flag is active: the axon backend executes
+        # reduce_window in the literal layout given, and NCHW pooling
+        # measured ~100x slower than NHWC on chip
+        # (chip_results/conv_probe2.txt). Boundary transposes cancel
+        # against the neighboring convs' under XLA.
+        from ...core.flags import conv_nhwc_active
+        nhwc_internal = (not channel_last and ndim == 2 and x.ndim == 4
+                         and conv_nhwc_active())
+        if nhwc_internal:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        cl = channel_last or nhwc_internal
+        if cl:
             window = (1,) + k + (1,)
             strides = (1,) + s + (1,)
             spatial = list(range(1, 1 + ndim))
@@ -61,17 +73,21 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
         if mode == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
                 jnp.iinfo(x.dtype).min
-            return jax.lax.reduce_window(x, init, jax.lax.max, window,
-                                         strides, pads)
-        # avg
-        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add,
-                                       window, strides, pads)
-        if exclusive and pads != "VALID":
-            ones = jnp.ones_like(x)
-            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                           strides, pads)
-            return summed / counts
-        return summed / float(np.prod(k))
+            out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                        strides, pads)
+        else:
+            summed = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                           window, strides, pads)
+            if exclusive and pads != "VALID":
+                ones = jnp.ones_like(x)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                               window, strides, pads)
+                out = summed / counts
+            else:
+                out = summed / float(np.prod(k))
+        if nhwc_internal:
+            out = jnp.transpose(out, (0, 3, 1, 2))
+        return out
     return apply(op_name, f, (_t(x),))
 
 

@@ -214,11 +214,8 @@ def _bwd_dispatch(q, k, v, out, lse, dout, scale, causal,
     """XLA recompute backward by default; the Pallas backward kernels
     when the flash_backward flag allows (chip-smoked lowering only —
     see flash_attention_bwd.py)."""
-    from ...core.flags import flag
-    mode = flag("flash_backward")
-    use = (mode == "always" or
-           (mode == "auto" and jax.default_backend() == "tpu"))
-    if use:
+    from ...core.flags import flag_active
+    if flag_active("flash_backward"):
         from .flash_attention_bwd import flash_attention_bwd, supported
         if supported(q.shape, k.shape):
             return flash_attention_bwd(q, k, v, out, lse, dout, scale,

@@ -30,11 +30,9 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
 def _fused_adam_path(param, g, slots, lr, step, beta1, beta2, eps, decay):
     """Route large tensors through the Pallas fused-Adam kernel when the
     ``fused_adam`` flag allows; returns None to fall back to plain jnp."""
-    from ..core.flags import flag
+    from ..core.flags import flag_active
     from ..ops.pallas import fused_adam as fadam
-    mode = flag("fused_adam")
-    if mode == "never" or (mode == "auto"
-                           and jax.default_backend() != "tpu"):
+    if not flag_active("fused_adam"):
         return None
     if not fadam.supported(int(np.prod(param.shape))):
         return None

@@ -374,12 +374,10 @@ class TestConvNHWCInternal(OpTest):
             out.sum().backward()
             return np.asarray(out.numpy()), np.asarray(xt.grad.numpy())
 
-        o1, g1 = run()
-        core_flags.set_flags({"conv_nhwc": "always"})
-        try:
+        with core_flags.flags_guard(conv_nhwc="never"):
+            o1, g1 = run()
+        with core_flags.flags_guard(conv_nhwc="always"):
             o2, g2 = run()
-        finally:
-            core_flags.set_flags({"conv_nhwc": "never"})
         np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5)
 
@@ -391,12 +389,112 @@ class TestConvNHWCInternal(OpTest):
         rng = np.random.default_rng(1)
         x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
         w = rng.standard_normal((8, 2, 3, 3)).astype(np.float32)
-        o1 = np.asarray(F.conv2d(to_tensor(x), to_tensor(w), groups=2,
-                                 padding=1).numpy())
-        core_flags.set_flags({"conv_nhwc": "always"})
-        try:
+        with core_flags.flags_guard(conv_nhwc="never"):
+            o1 = np.asarray(F.conv2d(to_tensor(x), to_tensor(w),
+                                     groups=2, padding=1).numpy())
+        with core_flags.flags_guard(conv_nhwc="always"):
             o2 = np.asarray(F.conv2d(to_tensor(x), to_tensor(w),
                                      groups=2, padding=1).numpy())
-        finally:
-            core_flags.set_flags({"conv_nhwc": "never"})
         np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+
+    def test_pool_flag_path_matches_nchw(self):
+        # r5: pools joined the channels-last region (NCHW reduce_window
+        # measured ~100x slower on chip — chip_results/conv_probe2.txt)
+        import numpy as np
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 3, 9, 9)).astype(np.float32)
+        for fn, kw in [(F.max_pool2d, dict(kernel_size=3, stride=2,
+                                           padding=1)),
+                       (F.max_pool2d, dict(kernel_size=2, stride=2,
+                                           ceil_mode=True)),
+                       (F.avg_pool2d, dict(kernel_size=3, stride=2,
+                                           padding=1, exclusive=True)),
+                       (F.avg_pool2d, dict(kernel_size=3, stride=3,
+                                           exclusive=False)),
+                       (F.adaptive_avg_pool2d, dict(output_size=3))]:
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = fn(xt, **kw)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{fn.__name__} {kw}")
+            np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{fn.__name__} {kw} grad")
+
+    def test_batch_norm_flag_path_matches_nchw(self):
+        import numpy as np
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((4, 5, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((5,)).astype(np.float32)
+        b = rng.standard_normal((5,)).astype(np.float32)
+        m = rng.standard_normal((5,)).astype(np.float32)
+        v = rng.standard_normal((5,)).astype(np.float32) ** 2 + 0.5
+        for training in (False, True):
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = F.batch_norm(xt, to_tensor(m.copy()),
+                                   to_tensor(v.copy()), to_tensor(w),
+                                   to_tensor(b), training=training)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"training={training}")
+            np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"training={training} grad")
+
+    def test_small_cnn_end_to_end_flag_path(self):
+        # conv+bn+pool+residual+fc: the full channels-last region in one
+        # model, forward and parameter gradients identical to NCHW
+        import numpy as np
+        import paddle1_tpu as paddle
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import Tensor
+
+        def build_and_step(seed):
+            np.random.seed(seed)
+            paddle.seed(seed)
+            m = paddle.nn.Sequential(
+                paddle.nn.Conv2D(3, 8, 3, padding=1),
+                paddle.nn.BatchNorm2D(8),
+                paddle.nn.ReLU(),
+                paddle.nn.MaxPool2D(2, 2),
+                paddle.nn.Conv2D(8, 8, 3, padding=1),
+                paddle.nn.AdaptiveAvgPool2D(1),
+                paddle.nn.Flatten(),
+                paddle.nn.Linear(8, 4))
+            rng = np.random.default_rng(0)
+            x = Tensor(rng.standard_normal((2, 3, 12, 12))
+                       .astype(np.float32))
+            y = Tensor(rng.integers(0, 4, (2,)).astype(np.int64))
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            return (float(np.asarray(loss.data)),
+                    [np.asarray(p.grad.numpy()) for p in m.parameters()
+                     if p.grad is not None])
+        with flags_guard(conv_nhwc="never"):
+            l1, g1 = build_and_step(7)
+        with flags_guard(conv_nhwc="always"):
+            l2, g2 = build_and_step(7)
+        assert abs(l1 - l2) < 1e-5, (l1, l2)
+        assert len(g1) == len(g2) and len(g1) > 0
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

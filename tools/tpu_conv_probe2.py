@@ -1,0 +1,141 @@
+"""Conv probe round 2 (r5): separate tunnel-dispatch overhead from true
+device conv throughput, and measure the channels-last building blocks.
+
+The r5 first probe measured a SINGLE NHWC conv dispatch at 4.8 TF/s —
+ambiguous: per-dispatch overhead through the axon relay could dominate a
+~0.6 ms device op. Here every measurement chains K ops inside ONE jit so
+dispatch cost is amortized K-fold:
+
+* conv NHWC+HWIO chained        — the true device conv ceiling
+* conv NHWC+OIHW chained        — does weight layout matter?
+* conv NCHW chained             — the true NCHW penalty (not dispatch)
+* conv+BN+relu NHWC chained     — the ResNet hot block, channels-last
+* maxpool NHWC / NCHW           — reduce_window layout sensitivity
+* resnet50 fwd+bwd data_format  — end-to-end, if the model supports it
+
+Run on the real chip: ``python tools/tpu_conv_probe2.py``.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def _slope(f, lo=2, hi=8):
+    import jax
+    f()
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(f())[0]))
+    ts = []
+    for k in (lo, hi):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(k):
+            r = f()
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0]))
+        ts.append(time.perf_counter() - t0)
+    return (ts[1] - ts[0]) / (hi - lo)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    print("device:", dev, getattr(dev, "device_kind", ""))
+    K = 16  # convs chained per dispatch
+    fl1 = 2 * 32 * 56 * 56 * 256 * 256 * 9  # FLOPs per conv
+
+    rng = np.random.default_rng(0)
+    x_nhwc = jnp.asarray(rng.standard_normal((32, 56, 56, 256)),
+                         jnp.bfloat16)
+    w_hwio = jnp.asarray(rng.standard_normal((3, 3, 256, 256)) * 0.01,
+                         jnp.bfloat16)
+    w_oihw = jnp.transpose(w_hwio, (3, 2, 0, 1))
+    x_nchw = jnp.transpose(x_nhwc, (0, 3, 1, 2))
+
+    def chain(conv_fn, x, w):
+        def f(x, w):
+            y = x
+            for _ in range(K):
+                y = conv_fn(y, w)
+            return y
+        return jax.jit(f)
+
+    def report(name, dt, flops):
+        print(f"{name}: {dt * 1e3:.2f} ms/chain "
+              f"{flops / dt / 1e12:.1f} TF/s "
+              f"mfu={flops / dt / 197e12:.3f}")
+
+    c = chain(lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))), x_nhwc, w_hwio)
+    report("conv NHWC+HWIO x16", _slope(lambda: c(x_nhwc, w_hwio)),
+           K * fl1)
+
+    c = chain(lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))), x_nhwc, w_oihw)
+    report("conv NHWC+OIHW x16", _slope(lambda: c(x_nhwc, w_oihw)),
+           K * fl1)
+
+    c = chain(lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))), x_nchw, w_oihw)
+    report("conv NCHW+OIHW x16", _slope(lambda: c(x_nchw, w_oihw)),
+           K * fl1)
+
+    # the ResNet hot block channels-last: conv + scale/shift + relu
+    g = jnp.ones((256,), jnp.bfloat16)
+    b = jnp.zeros((256,), jnp.bfloat16)
+
+    def block(x, w):
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                x.shape, w.shape, ("NHWC", "HWIO", "NHWC")))
+        return jax.nn.relu(y * g + b)
+    c = chain(block, x_nhwc, w_hwio)
+    report("conv+bn+relu NHWC x16", _slope(lambda: c(x_nhwc, w_hwio)),
+           K * fl1)
+
+    # grad of the chain (the backward layouts)
+    def loss(x, w):
+        y = x
+        for _ in range(K):
+            y = block(y, w)
+        return jnp.sum(y.astype(jnp.float32))
+    gfn = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    report("grad(conv+bn+relu) x16", _slope(lambda: gfn(x_nhwc, w_hwio)),
+           3 * K * fl1)
+
+    # pooling layout sensitivity (K-chained 3x3/s1 maxpool, SAME)
+    def mp_nhwc(x):
+        y = x
+        for _ in range(K):
+            y = jax.lax.reduce_window(
+                y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1),
+                "SAME")
+        return y
+    def mp_nchw(x):
+        y = x
+        for _ in range(K):
+            y = jax.lax.reduce_window(
+                y, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+                "SAME")
+        return y
+    e = 32 * 56 * 56 * 256 * K  # elements touched per chain
+    f1 = jax.jit(mp_nhwc)
+    dt = _slope(lambda: f1(x_nhwc))
+    print(f"maxpool NHWC x16: {dt * 1e3:.2f} ms/chain "
+          f"{e * 2 / dt / 1e9:.0f} GB/s eff")
+    f2 = jax.jit(mp_nchw)
+    dt = _slope(lambda: f2(x_nchw))
+    print(f"maxpool NCHW x16: {dt * 1e3:.2f} ms/chain "
+          f"{e * 2 / dt / 1e9:.0f} GB/s eff")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+"""Conv probe round 4 (r5): jitter-proof timing of the conv fwd/bwd
+forms. The tunnel's latency noise is additive and positive (stalls), so:
+
+* every measured graph chains K=32 ops inside ONE jit and returns a
+  SCALAR (no 25 MB readbacks);
+* T(k) for k in {2, 8} calls is measured 5 times each and the MINIMUM
+  is kept (the cleanest pass through the tunnel);
+* per-op time = (minT(8) - minT(2)) / (6 * K).
+
+Run on the real chip: ``python tools/tpu_conv_probe4.py``.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+K = 32
+
+
+def _chain_time(f, flops_per_op):
+    """f: jitted fn returning a scalar, internally chaining K ops."""
+    import jax
+    np.asarray(jax.device_get(f()))  # compile + warm
+    mins = {}
+    for k in (2, 8):
+        best = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(k):
+                r = f()
+            np.asarray(jax.device_get(r))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        mins[k] = best
+    per_op = (mins[8] - mins[2]) / (6 * K)
+    return per_op, flops_per_op / per_op
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    print("device:", dev, getattr(dev, "device_kind", ""))
+
+    N, H, W, C, O, KH = 32, 56, 56, 256, 256, 3
+    fl1 = 2 * N * H * W * C * O * KH * KH
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((N, H, W, C)) * 0.05,
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((KH, KH, C, O)) * 0.05,
+                    jnp.bfloat16)
+    dy = jnp.asarray(rng.standard_normal((N, H, W, O)) * 0.05,
+                     jnp.bfloat16)
+    dn = lambda l, r, spec: jax.lax.conv_dimension_numbers(l, r, spec)
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=dn(x.shape, w.shape,
+                                 ("NHWC", "HWIO", "NHWC")))
+
+    def rep(name, t, tf):
+        print(f"{name}: {t * 1e3:.3f} ms/op {tf / 1e12:.1f} TF/s "
+              f"mfu={tf / 197e12:.3f}")
+
+    # 1. fwd conv chain (y feeds next conv; same w)
+    @jax.jit
+    def fwd_chain(x, w):
+        y = x
+        for _ in range(K):
+            y = conv(y, w)
+        return jnp.sum(y.astype(jnp.float32))
+    rep("fwd conv", *_chain_time(lambda: fwd_chain(x, w), fl1))
+
+    # 2. autodiff dgrad chain: grad of the K-chain wrt x pays K dgrads
+    #    (+K fwd recomputes are NOT in play: linear chain, no residuals
+    #    needed for conv-only graphs — conv is bilinear, dgrad needs only
+    #    w). jax grad of chain = K dgrad convs.
+    @jax.jit
+    def dgrad_chain(x, w):
+        return jnp.sum(jax.grad(
+            lambda x: jnp.sum(fwd_chain_raw(x, w).astype(jnp.float32)))(x)
+            .astype(jnp.float32))
+
+    def fwd_chain_raw(x, w):
+        y = x
+        for _ in range(K):
+            y = conv(y, w)
+        return y
+    rep("autodiff dgrad (chain)",
+        *_chain_time(lambda: dgrad_chain(x, w), fl1))
+
+    # 3. plain-conv dgrad chain
+    @jax.jit
+    def dgrad_plain_chain(dy, w):
+        wt = jnp.flip(w, (0, 1)).swapaxes(2, 3)
+        y = dy
+        for _ in range(K):
+            y = jax.lax.conv_general_dilated(
+                y, wt, (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=dn(y.shape, wt.shape,
+                                     ("NHWC", "HWIO", "NHWC")))
+        return jnp.sum(y.astype(jnp.float32))
+    rep("plain-conv dgrad",
+        *_chain_time(lambda: dgrad_plain_chain(dy, w), fl1))
+
+    # 4. autodiff wgrad chain: sum of K wgrads via grad wrt w
+    @jax.jit
+    def wgrad_chain(x, w):
+        return jnp.sum(jax.grad(
+            lambda w: jnp.sum(fwd_chain_raw(x, w).astype(jnp.float32)))(w)
+            .astype(jnp.float32))
+    rep("autodiff wgrad+dgrad mix (chain wrt w)",
+        *_chain_time(lambda: wgrad_chain(x, w), 2 * fl1))
+
+    # 5. plain-conv wgrad chain (fresh x each round via cheap shift to
+    #    stop CSE; same compute shape)
+    @jax.jit
+    def wgrad_plain_chain(x, dy):
+        acc = jnp.zeros((KH, KH, C, O), jnp.float32)
+        xi = x
+        for _ in range(K):
+            lhs = jnp.transpose(xi, (3, 1, 2, 0))
+            rhs = jnp.transpose(dy, (1, 2, 0, 3))
+            out = jax.lax.conv_general_dilated(
+                lhs, rhs, (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=dn(lhs.shape, rhs.shape,
+                                     ("NHWC", "HWIO", "NHWC")))
+            acc = acc + jnp.transpose(out, (1, 2, 0, 3)).astype(
+                jnp.float32)
+            xi = xi + 1.0  # new value, same shape: defeats CSE
+        return jnp.sum(acc)
+    rep("plain-conv wgrad",
+        *_chain_time(lambda: wgrad_plain_chain(x, dy), fl1))
+
+    # 6. full fwd+bwd of a conv+bn+relu block chain via autodiff (what a
+    #    real model pays per layer)
+    g0 = jnp.ones((O,), jnp.bfloat16)
+
+    def block(y, w):
+        y = conv(y, w)
+        return jax.nn.relu(y * g0)
+
+    @jax.jit
+    def block_chain_grad(x, w):
+        def loss(x, w):
+            y = x
+            for _ in range(K):
+                y = block(y, w)
+            return jnp.sum(y.astype(jnp.float32))
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+        return jnp.sum(gx.astype(jnp.float32)) + jnp.sum(
+            gw.astype(jnp.float32))
+    rep("fwd+bwd conv+bn+relu (autodiff)",
+        *_chain_time(lambda: block_chain_grad(x, w), 3 * fl1))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
