@@ -175,9 +175,26 @@ def _define_builtin_flags() -> None:
                 "(module import), not per call.")
     # Fused kernels (reference operators/fused/ role)
     define_flag("flash_attention", "auto",
-                "Pallas flash attention: auto (TPU only), always "
+                "Pallas flash attention: auto (TPU only, AND only when "
+                "the dense score tensor would exceed "
+                "flash_auto_score_mb — the r5 on-chip crossover sweep "
+                "showed XLA's fused dense attention is faster at every "
+                "compute-bound length, 1.25x at seq 128 up to 2.1x at "
+                "seq 4096, so flash earns its place purely as the "
+                "long-sequence memory escape; "
+                "chip_results/flash_crossover.txt), always "
                 "(interpret-mode on CPU, for tests), never.",
                 validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("flash_auto_score_mb", 1024.0,
+                "Estimated transient attention memory (MiB) above which "
+                "flash_attention=auto switches from XLA dense attention "
+                "to the Pallas flash kernels: batch*heads*seq_q*seq_k *"
+                " (compute-dtype itemsize + 8) bytes — the logits plus "
+                "the softmax's f32 stabilized-logits and probs copies. "
+                "At ~1 GiB the dense path starts to threaten HBM "
+                "headroom; below it dense is faster on chip (r5 "
+                "crossover sweep).",
+                validator=lambda v: v > 0)
     define_flag("fused_layer_norm", "auto",
                 "Pallas fused LayerNorm: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))

@@ -1,0 +1,43 @@
+"""The channels-last execution region (r5).
+
+The axon TPU backend performs no layout assignment of its own: NHWC
+convs with HWIO weights run at ~full MXU throughput while NCHW convs
+and NCHW ``reduce_window`` pooling are 20-100x slower
+(chip_results/conv_probe2.txt, conv_probe4.txt). Under the
+``conv_nhwc`` flag, every layout-sensitive NCHW-API image op (2-D conv,
+max/avg/adaptive pool, batch norm) therefore executes channels-last
+internally, transposing at its boundary; adjacent ops' boundary
+transposes are inverse pairs that XLA's algebraic simplifier cancels,
+so inside a jitted model only the stem input and head output transposes
+survive.
+
+This module is the single definition of the region's eligibility rule
+and transpose pair so the participating ops cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["channels_last_region"]
+
+_identity = lambda t: t
+_to_nhwc = lambda t: jnp.transpose(t, (0, 2, 3, 1))
+_to_nchw = lambda t: jnp.transpose(t, (0, 3, 1, 2))
+
+
+def channels_last_region(x_ndim: int, channel_last: bool):
+    """Resolve the channels-last region for one op application.
+
+    Returns ``(active, to_internal, from_internal)``: when ``active``,
+    the op should compute on ``to_internal(x)`` (NHWC) and return
+    ``from_internal(y)``. Only 4-D NCHW-API tensors participate —
+    callers with a separate spatial-rank notion (conv/pool) pass
+    ``x_ndim=4`` only for their 2-D case.
+    """
+    if channel_last or x_ndim != 4:
+        return False, _identity, _identity
+    from ...core.flags import conv_nhwc_active
+    if not conv_nhwc_active():
+        return False, _identity, _identity
+    return True, _to_nhwc, _to_nchw

@@ -91,8 +91,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         return jnp.asarray(fl > -1e4, jnp.float32)
 
     def f(q, k, v, *m):
-        from ...core.flags import flag_active
+        from ...core.flags import flag, flag_active
         flash_ok = flag_active("flash_attention")
+        if flash_ok and flag("flash_attention") == "auto":
+            # auto is memory-adaptive, not unconditional: the r5 on-chip
+            # crossover sweep (chip_results/flash_crossover.txt) showed
+            # XLA's fused dense attention beats the Pallas kernels at
+            # every compute-bound length on this backend, so flash only
+            # engages when the dense path's transient attention memory
+            # would threaten HBM headroom. Peak estimate per score
+            # element: the [b, h, sq, sk] logits in the compute dtype
+            # plus the f32 stabilized-logits and probs copies the
+            # softmax materializes (itemsize + 8 bytes).
+            bytes_per = jnp.dtype(q.dtype).itemsize + 8
+            score_mb = (q.shape[0] * q.shape[2] * q.shape[1]
+                        * k.shape[1] * bytes_per) / (1 << 20)
+            flash_ok = score_mb >= float(flag("flash_auto_score_mb"))
         mask = m[0] if m else None
         if (use_flash and drop == 0.0 and flash_ok
                 and fa.supported(q.shape, k.shape, causal=is_causal)):

@@ -69,21 +69,18 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
         dn_str = ("NDHWC", "OIDHW", "NDHWC") if channel_last else \
             ("NCDHW", "OIDHW", "NCDHW")
 
-    # NCHW-API convs can run internally in NHWC with HWIO weights (the
-    # layout the TPU convolution engine wants; see the conv_nhwc flag).
-    # Only the 2-D NCHW case participates — the transposes at the op
-    # boundary cancel between adjacent ops under XLA's algebraic
-    # simplifier, and the weight transpose is negligible next to the
-    # conv itself (r5 on-chip: NHWC+OIHW ran 4.5x slower than
-    # NHWC+HWIO — the axon backend does not relayout weights either;
-    # chip_results/conv_probe2.txt).
-    from ...core.flags import conv_nhwc_active
-    nhwc_internal = (not channel_last and ndim == 2
-                     and conv_nhwc_active())
+    # NCHW-API 2-D convs run internally in NHWC with HWIO weights when
+    # the channels-last region is active (see _layout.py; the weight
+    # transpose is negligible next to the conv itself — r5 on-chip:
+    # NHWC+OIHW ran 4.5x slower than NHWC+HWIO, the axon backend does
+    # not relayout weights either; chip_results/conv_probe2.txt).
+    from ._layout import channels_last_region
+    nhwc_internal, _to_nhwc, _to_nchw = channels_last_region(
+        4 if ndim == 2 else 0, channel_last)
 
     def f(x, w, *maybe_b):
         if nhwc_internal:
-            xi = jnp.transpose(x, (0, 2, 3, 1))
+            xi = _to_nhwc(x)
             wi = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
             dn = jax.lax.conv_dimension_numbers(
                 xi.shape, wi.shape, ("NHWC", "HWIO", "NHWC"))
@@ -93,7 +90,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
                 feature_group_count=groups)
             if maybe_b:
                 out = out + maybe_b[0].reshape((1, 1, 1, -1))
-            return jnp.transpose(out, (0, 3, 1, 2))
+            return _to_nchw(out)
         dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
         out = jax.lax.conv_general_dilated(
             x, w, window_strides=stride, padding=pad,

@@ -33,13 +33,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reference's mutable mean/variance variables."""
     x = _t(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    # NCHW 4-D batch norm participates in the channels-last region (the
-    # conv_nhwc flag): computing with the channel axis last makes the
+    # NCHW 4-D batch norm participates in the channels-last region
+    # (_layout.py): computing with the channel axis last makes the
     # boundary transposes sit directly against the neighboring convs'
     # and pools', where XLA cancels them (chip_results/conv_probe2.txt).
-    from ...core.flags import conv_nhwc_active
-    nhwc_internal = (not channel_last and x.ndim == 4
-                     and conv_nhwc_active())
+    from ._layout import channels_last_region
+    nhwc_internal, to_internal, from_internal = channels_last_region(
+        x.ndim, channel_last)
     eff_last = channel_last or nhwc_internal
     ch_axis = x.ndim - 1 if eff_last else 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
@@ -49,12 +49,6 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         shape = [1] * nd
         shape[ch_axis] = -1
         return v.reshape(shape)
-
-    def to_internal(x):
-        return jnp.transpose(x, (0, 2, 3, 1)) if nhwc_internal else x
-
-    def from_internal(y):
-        return jnp.transpose(y, (0, 3, 1, 2)) if nhwc_internal else y
 
     if use_stats:
         def f(x, m, v, *wb):

@@ -30,24 +30,17 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
           exclusive=True, op_name="pool"):
     k = _tuple(ksize, ndim)
     s = _tuple(stride if stride is not None else ksize, ndim)
-    pad = _padding(padding, ndim)
-    if isinstance(pad, str):
-        pad_cfg = pad
-    else:
-        pad_cfg = pad
+    pad_cfg = _padding(padding, ndim)
 
     def f(x):
-        # NCHW-API 2-D pools run channels-last internally when the
-        # conv_nhwc flag is active: the axon backend executes
-        # reduce_window in the literal layout given, and NCHW pooling
-        # measured ~100x slower than NHWC on chip
-        # (chip_results/conv_probe2.txt). Boundary transposes cancel
-        # against the neighboring convs' under XLA.
-        from ...core.flags import conv_nhwc_active
-        nhwc_internal = (not channel_last and ndim == 2 and x.ndim == 4
-                         and conv_nhwc_active())
-        if nhwc_internal:
-            x = jnp.transpose(x, (0, 2, 3, 1))
+        # NCHW-API 2-D pools join the channels-last region (_layout.py):
+        # the axon backend executes reduce_window in the literal layout
+        # given, and NCHW pooling measured ~100x slower than NHWC on
+        # chip (chip_results/conv_probe2.txt)
+        from ._layout import channels_last_region
+        nhwc_internal, _to_nhwc, _to_nchw = channels_last_region(
+            x.ndim if ndim == 2 else 0, channel_last)
+        x = _to_nhwc(x)
         cl = channel_last or nhwc_internal
         if cl:
             window = (1,) + k + (1,)
@@ -85,9 +78,7 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
                 out = summed / counts
             else:
                 out = summed / float(np.prod(k))
-        if nhwc_internal:
-            out = jnp.transpose(out, (0, 3, 1, 2))
-        return out
+        return _to_nchw(out)
     return apply(op_name, f, (_t(x),))
 
 

@@ -442,3 +442,59 @@ class TestFlashBackwardKernels:
         assert supported((2, 256, 4, 64), (2, 256, 4, 64))
         # 65536 q rows x 128 head dim: full q+do residency > VMEM budget
         assert not supported((1, 65536, 1, 128), (1, 1024, 1, 128))
+
+
+class TestFlashAutoDispatch:
+    """r5: flash_attention=auto is memory-adaptive — XLA dense attention
+    below flash_auto_score_mb, Pallas flash above (the on-chip crossover
+    sweep showed dense is faster at every compute-bound length;
+    chip_results/flash_crossover.txt)."""
+
+    def _route(self, monkeypatch, b, s, h=4, d=64, threshold_mb=4,
+               mode="auto"):
+        import jax
+        import numpy as np
+        from paddle1_tpu.core import flags as core_flags
+        from paddle1_tpu.core.tensor import Tensor
+        from paddle1_tpu.nn.functional.attention import \
+            scaled_dot_product_attention as sdpa
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        hit = {"flash": False}
+
+        def spy(*a, **k):
+            hit["flash"] = True
+            raise RuntimeError("stop-at-dispatch")
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        x = Tensor(np.zeros((b, s, h, d), np.float32))
+        with core_flags.flags_guard(flash_attention=mode,
+                                    flash_auto_score_mb=threshold_mb):
+            try:
+                sdpa(x, x, x)
+            except RuntimeError as e:
+                assert "stop-at-dispatch" in str(e)
+        return hit["flash"]
+
+    def test_small_seq_routes_dense(self, monkeypatch):
+        # est = 2*4*128*128*(4+8)B = 1.5 MiB < 4 MiB -> dense
+        assert self._route(monkeypatch, b=2, s=128) is False
+
+    def test_large_seq_routes_flash(self, monkeypatch):
+        # est = 2*4*1024*1024*(4+8)B = 96 MiB >= 4 MiB -> flash
+        assert self._route(monkeypatch, b=2, s=1024) is True
+
+    def test_always_ignores_threshold(self, monkeypatch):
+        assert self._route(monkeypatch, b=2, s=128, threshold_mb=10**6,
+                           mode="always") is True
+
+    def test_bad_threshold_rejected(self):
+        import pytest
+        from paddle1_tpu.core import flags as core_flags
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        for bad in (0, -5):
+            with pytest.raises(InvalidArgumentError):
+                core_flags.set_flags({"flash_auto_score_mb": bad})
+        # fractional thresholds are legal (float flag, not int)
+        with core_flags.flags_guard(flash_auto_score_mb=0.5):
+            assert core_flags.flag("flash_auto_score_mb") == 0.5

@@ -1,0 +1,82 @@
+"""Trace ONE BERT-base engine step on chip and print the top XLA ops by
+device time (r5: find where the non-MXU 60% goes at 40.1% MFU).
+``python tools/tpu_bert_trace.py [batch]``."""
+
+import collections
+import gzip
+import json
+import pathlib
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.text.models import (BertForPretraining,
+                                         BertPretrainingCriterion,
+                                         bert_base)
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    seq = 128
+    model = BertForPretraining(bert_base(
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    crit = BertPretrainingCriterion(model.bert.vocab_size)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        scores, rel = m(Tensor(b["ids"]))
+        return crit(scores, rel, Tensor(b["mlm"]), Tensor(b["nsp"]))
+
+    eng = ParallelEngine(model, opt, loss_fn,
+                         mesh=build_mesh(dp=1, devices=[jax.devices()[0]]),
+                         amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    v = model.bert.vocab_size
+    b = eng.shard_batch(
+        {"ids": rng.integers(1, v, (batch, seq)).astype(np.int32),
+         "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
+         "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)})
+    for _ in range(3):  # compile + warm
+        r = eng.step(b)
+    np.asarray(jax.device_get(r.data if hasattr(r, "data") else r))
+
+    td = tempfile.mkdtemp(prefix="bert_trace_")
+    with jax.profiler.trace(td):
+        r = eng.step(b)
+        np.asarray(jax.device_get(r.data if hasattr(r, "data") else r))
+    gz = list(pathlib.Path(td).rglob("*.trace.json.gz"))
+    if not gz:
+        print("no trace.json.gz produced under", td)
+        return 1
+    with gzip.open(gz[0]) as f:
+        tr = json.load(f)
+    ev = tr["traceEvents"]
+    pids, tids = {}, {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tids[(e["pid"], e["tid"])] = e["args"].get("name")
+    dur, cnt = collections.Counter(), collections.Counter()
+    for e in ev:
+        if (e.get("ph") == "X"
+                and "TPU" in str(pids.get(e["pid"], ""))
+                and tids.get((e["pid"], e["tid"])) == "XLA Ops"):
+            dur[e["name"]] += e.get("dur", 0)
+            cnt[e["name"]] += 1
+    tot = sum(dur.values())
+    print(f"total XLA-op device time: {tot / 1e3:.2f} ms "
+          f"({len(dur)} distinct ops)")
+    for name, d in dur.most_common(30):
+        print(f"{d / 1e3:8.3f} ms {100.0 * d / tot:5.1f}% "
+              f"{cnt[name]:4d}x  {name[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
