@@ -189,18 +189,24 @@ def _define_builtin_flags() -> None:
                 "Estimated transient attention memory (MiB) above which "
                 "flash_attention=auto switches from XLA dense attention "
                 "to the Pallas flash kernels: batch*heads*seq_q*seq_k *"
-                " (compute-dtype itemsize + 8) bytes — the logits plus "
-                "the softmax's f32 stabilized-logits and probs copies. "
-                "At ~1 GiB the dense path starts to threaten HBM "
+                " (2*compute-dtype itemsize + 8) bytes — the logits, "
+                "the softmax's f32 stabilized-logits and probs copies, "
+                "and the cast of probs back to the compute dtype. At "
+                "~1 GiB the dense path starts to threaten HBM "
                 "headroom; below it dense is faster on chip (r5 "
                 "crossover sweep).",
                 validator=lambda v: v > 0)
     define_flag("fused_layer_norm", "auto",
                 "Pallas fused LayerNorm: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))
-    define_flag("fused_adam", "auto",
-                "Pallas fused Adam/AdamW update: auto (TPU only), always, "
-                "never.",
+    define_flag("fused_adam", "never",
+                "Pallas fused Adam/AdamW update: auto (TPU only), "
+                "always, never. Default never since the r5 on-chip "
+                "ablation: XLA's plain update chain beat the Pallas "
+                "kernel by ~7 MFU points on BERT-base (1528 vs 1373 "
+                "samples/s) — the compiler fuses the elementwise "
+                "moment/param updates better than the hand-tiled slab "
+                "kernel on this backend (BASELINE.md r5).",
                 validator=lambda v: v in ("auto", "always", "never"))
     define_flag("fused_softmax", "auto",
                 "Pallas fused softmax: auto (TPU only), always, never.",

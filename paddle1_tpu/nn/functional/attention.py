@@ -52,6 +52,33 @@ def attention_ref(q, k, v, mask=None, dropout_p=0.0, scale=None,
     return jnp.swapaxes(out, 1, 2)
 
 
+def use_flash_for(q, k) -> bool:
+    """The dense-vs-flash dispatch policy (r5), shared by every
+    attention entry point (sdpa here, ulysses_attention in
+    distributed/sequence_parallel.py): ``never`` → False, ``always`` →
+    True, ``auto`` → TPU only AND only when the dense path's transient
+    attention memory would threaten HBM headroom. The r5 on-chip
+    crossover sweep (chip_results/flash_crossover.txt) showed XLA's
+    fused dense attention beats the Pallas kernels at every
+    compute-bound length on this backend, so under ``auto`` flash earns
+    its place purely as the long-sequence memory escape.
+
+    Peak-memory estimate per score element of the dense path: the
+    [b, h, sq, sk] logits in the compute dtype, the softmax's f32
+    stabilized-logits and probs copies, and the cast of probs back to
+    the compute dtype — ``2 * itemsize + 8`` bytes. q/k are
+    [batch, seq, heads, dim] arrays (or tracers)."""
+    from ...core.flags import flag, flag_active
+    if not flag_active("flash_attention"):
+        return False
+    if flag("flash_attention") != "auto":
+        return True
+    bytes_per = 2 * jnp.dtype(q.dtype).itemsize + 8
+    score_mb = (q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1]
+                * bytes_per) / (1 << 20)
+    return score_mb >= float(flag("flash_auto_score_mb"))
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None,
@@ -91,22 +118,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         return jnp.asarray(fl > -1e4, jnp.float32)
 
     def f(q, k, v, *m):
-        from ...core.flags import flag, flag_active
-        flash_ok = flag_active("flash_attention")
-        if flash_ok and flag("flash_attention") == "auto":
-            # auto is memory-adaptive, not unconditional: the r5 on-chip
-            # crossover sweep (chip_results/flash_crossover.txt) showed
-            # XLA's fused dense attention beats the Pallas kernels at
-            # every compute-bound length on this backend, so flash only
-            # engages when the dense path's transient attention memory
-            # would threaten HBM headroom. Peak estimate per score
-            # element: the [b, h, sq, sk] logits in the compute dtype
-            # plus the f32 stabilized-logits and probs copies the
-            # softmax materializes (itemsize + 8 bytes).
-            bytes_per = jnp.dtype(q.dtype).itemsize + 8
-            score_mb = (q.shape[0] * q.shape[2] * q.shape[1]
-                        * k.shape[1] * bytes_per) / (1 << 20)
-            flash_ok = score_mb >= float(flag("flash_auto_score_mb"))
+        flash_ok = use_flash_for(q, k)
         mask = m[0] if m else None
         if (use_flash and drop == 0.0 and flash_ok
                 and fa.supported(q.shape, k.shape, causal=is_causal)):

@@ -477,11 +477,11 @@ class TestFlashAutoDispatch:
         return hit["flash"]
 
     def test_small_seq_routes_dense(self, monkeypatch):
-        # est = 2*4*128*128*(4+8)B = 1.5 MiB < 4 MiB -> dense
+        # est = 2*4*128*128*(2*4+8)B = 2 MiB < 4 MiB -> dense
         assert self._route(monkeypatch, b=2, s=128) is False
 
     def test_large_seq_routes_flash(self, monkeypatch):
-        # est = 2*4*1024*1024*(4+8)B = 96 MiB >= 4 MiB -> flash
+        # est = 2*4*1024*1024*(2*4+8)B = 128 MiB >= 4 MiB -> flash
         assert self._route(monkeypatch, b=2, s=1024) is True
 
     def test_always_ignores_threshold(self, monkeypatch):

@@ -56,9 +56,9 @@ def main():
             g = jax.jit(lambda q: jnp.sum(
                 jax.grad(loss)(q).astype(jnp.float32)))
             return lambda: g(q)
-        fl = 4 * 2 * b * heads * s * s * d * 3  # fwd+bwd qk/av approx
-        t_flash = _min_time(make("always" if jax.default_backend() ==
-                                 "tpu" else "always"))
+        # fwd = 2 matmuls (qk^T, av) = 4*b*h*s^2*d FLOPs; bwd ~ 2x fwd
+        fl = 4 * b * heads * s * s * d * 3
+        t_flash = _min_time(make("always"))
         t_dense = _min_time(make("never"))
         w = "flash" if t_flash < t_dense else "dense"
         print(f"b={b:4d} s={s:5d}: flash {t_flash * 1e3:8.2f} ms "
