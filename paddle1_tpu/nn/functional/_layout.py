@@ -3,41 +3,61 @@
 The axon TPU backend performs no layout assignment of its own: NHWC
 convs with HWIO weights run at ~full MXU throughput while NCHW convs
 and NCHW ``reduce_window`` pooling are 20-100x slower
-(chip_results/conv_probe2.txt, conv_probe4.txt). Under the
-``conv_nhwc`` flag, every layout-sensitive NCHW-API image op (2-D conv,
-max/avg/adaptive pool, batch norm) therefore executes channels-last
-internally, transposing at its boundary; adjacent ops' boundary
-transposes are inverse pairs that XLA's algebraic simplifier cancels,
-so inside a jitted model only the stem input and head output transposes
-survive.
+(chip_results/conv_probe2.txt, conv_probe4.txt — measured for the 2-D
+case; the 1-D/3-D cases participate on the same physics, since the
+penalty comes from the channel dim not being the minor/lane dim).
+Under the ``conv_nhwc`` flag, every layout-sensitive channels-first-API
+image op (conv, max/avg/adaptive pool, batch norm, transposed conv)
+executes channels-last internally, transposing at its boundary;
+adjacent ops' boundary transposes are inverse pairs that XLA's
+algebraic simplifier cancels, so inside a jitted model only the stem
+input and head output transposes survive.
 
 This module is the single definition of the region's eligibility rule
-and transpose pair so the participating ops cannot drift apart.
+and transpose pairs so the participating ops cannot drift apart.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["channels_last_region"]
+__all__ = ["channels_last_region", "CONV_WEIGHT_PERM",
+           "CONV_CL_SPEC"]
 
 _identity = lambda t: t
-_to_nhwc = lambda t: jnp.transpose(t, (0, 2, 3, 1))
-_to_nchw = lambda t: jnp.transpose(t, (0, 3, 1, 2))
+
+# x_ndim -> (to channels-last, back to channels-first)
+_PERMS = {
+    3: ((0, 2, 1), (0, 2, 1)),                      # NCL  <-> NLC
+    4: ((0, 2, 3, 1), (0, 3, 1, 2)),                # NCHW <-> NHWC
+    5: ((0, 2, 3, 4, 1), (0, 4, 1, 2, 3)),          # NCDHW<->NDHWC
+}
+
+# spatial_rank -> permutation taking an [O, I, *k]-style weight to
+# spatial-major [*k, I, O] (the HWIO family), and the matching
+# channels-last conv_dimension_numbers spec — shared by _conv and
+# _conv_transpose so the two flag paths cannot drift apart
+CONV_WEIGHT_PERM = {1: (2, 1, 0), 2: (2, 3, 1, 0), 3: (2, 3, 4, 1, 0)}
+CONV_CL_SPEC = {1: ("NWC", "WIO", "NWC"),
+                2: ("NHWC", "HWIO", "NHWC"),
+                3: ("NDHWC", "DHWIO", "NDHWC")}
 
 
 def channels_last_region(x_ndim: int, channel_last: bool):
     """Resolve the channels-last region for one op application.
 
     Returns ``(active, to_internal, from_internal)``: when ``active``,
-    the op should compute on ``to_internal(x)`` (NHWC) and return
-    ``from_internal(y)``. Only 4-D NCHW-API tensors participate —
-    callers with a separate spatial-rank notion (conv/pool) pass
-    ``x_ndim=4`` only for their 2-D case.
+    the op should compute on ``to_internal(x)`` (channels-last) and
+    return ``from_internal(y)``. Only channels-first tensors with a
+    batch dim, a channel dim, and 1-3 spatial dims participate; callers
+    gate ineligible cases by passing ``x_ndim=0``.
     """
-    if channel_last or x_ndim != 4:
+    if channel_last or x_ndim not in _PERMS:
         return False, _identity, _identity
     from ...core.flags import conv_nhwc_active
     if not conv_nhwc_active():
         return False, _identity, _identity
-    return True, _to_nhwc, _to_nchw
+    fwd, bwd = _PERMS[x_ndim]
+    return (True,
+            lambda t: jnp.transpose(t, fwd),
+            lambda t: jnp.transpose(t, bwd))

@@ -59,8 +59,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
     pad = _padding(padding, ndim)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     if ndim == 1:
-        dn_str = ("NLC", "OIL", "NLC") if channel_last else ("NCL", "OIL", "NCL")
-        # lax uses single-char dims; use W for the spatial dim
+        # lax uses single-char dims; W stands in for the L spatial dim
         dn_str = ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
     elif ndim == 2:
         dn_str = ("NHWC", "OIHW", "NHWC") if channel_last else \
@@ -69,28 +68,35 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
         dn_str = ("NDHWC", "OIDHW", "NDHWC") if channel_last else \
             ("NCDHW", "OIDHW", "NCDHW")
 
-    # NCHW-API 2-D convs run internally in NHWC with HWIO weights when
-    # the channels-last region is active (see _layout.py; the weight
-    # transpose is negligible next to the conv itself — r5 on-chip:
-    # NHWC+OIHW ran 4.5x slower than NHWC+HWIO, the axon backend does
-    # not relayout weights either; chip_results/conv_probe2.txt).
-    from ._layout import channels_last_region
-    nhwc_internal, _to_nhwc, _to_nchw = channels_last_region(
-        4 if ndim == 2 else 0, channel_last)
+    # Channels-first-API convs run internally channels-last with
+    # spatial-major weights when the region is active (see _layout.py;
+    # the weight transpose is negligible next to the conv itself — r5
+    # on-chip: NHWC+OIHW ran 4.5x slower than NHWC+HWIO, the axon
+    # backend does not relayout weights either;
+    # chip_results/conv_probe2.txt).
+    from ._layout import (CONV_CL_SPEC, CONV_WEIGHT_PERM,
+                          channels_last_region)
+    x_rank = getattr(x, "ndim", None) or (
+        x.data.ndim if hasattr(x, "data") else 0)
+    nhwc_internal, _to_cl, _to_cf = channels_last_region(
+        x_rank if x_rank == ndim + 2 else 0, channel_last)
+    _w_perm = CONV_WEIGHT_PERM[ndim]
+    _cl_spec = CONV_CL_SPEC[ndim]
 
     def f(x, w, *maybe_b):
         if nhwc_internal:
-            xi = _to_nhwc(x)
-            wi = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+            xi = _to_cl(x)
+            wi = jnp.transpose(w, _w_perm)
             dn = jax.lax.conv_dimension_numbers(
-                xi.shape, wi.shape, ("NHWC", "HWIO", "NHWC"))
+                xi.shape, wi.shape, _cl_spec)
             out = jax.lax.conv_general_dilated(
                 xi, wi, window_strides=stride, padding=pad,
                 rhs_dilation=dilation, dimension_numbers=dn,
                 feature_group_count=groups)
             if maybe_b:
-                out = out + maybe_b[0].reshape((1, 1, 1, -1))
-            return _to_nchw(out)
+                out = out + maybe_b[0].reshape(
+                    (1,) * (out.ndim - 1) + (-1,))
+            return _to_cf(out)
         dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
         out = jax.lax.conv_general_dilated(
             x, w, window_strides=stride, padding=pad,
@@ -152,9 +158,19 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
         dn_str = ("NDHWC", "IODHW", "NDHWC") if channel_last else \
             ("NCDHW", "IODHW", "NCDHW")
 
+    # transposed convs join the channels-last region too (_layout.py):
+    # the lhs-dilated gradient-of-conv formulation below is still a
+    # conv_general_dilated, with the same literal-layout execution cost
+    # on the axon backend as the forward convs
+    from ._layout import (CONV_CL_SPEC, CONV_WEIGHT_PERM,
+                          channels_last_region)
+    x_rank = getattr(x, "ndim", None) or (
+        x.data.ndim if hasattr(x, "data") else 0)
+    nhwc_internal, _to_cl, _to_cf = channels_last_region(
+        x_rank if x_rank == ndim + 2 else 0, channel_last)
+    _w_perm = CONV_WEIGHT_PERM[ndim]
+
     def f(x, w, *maybe_b):
-        dn = jax.lax.conv_dimension_numbers(
-            x.shape, (w.shape[1] * groups, w.shape[0] // 1, *w.shape[2:]), dn_str)
         # Gradient-of-conv formulation: lhs-dilate input by stride.
         k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(ndim)]
         if pad_str == "SAME":
@@ -178,6 +194,19 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
             w_t = w_g.reshape(groups * ocg, ic // groups, *w.shape[2:])
         else:
             w_t = jnp.swapaxes(w_flip, 0, 1)
+        if nhwc_internal:
+            xi = _to_cl(x)
+            wi = jnp.transpose(w_t, _w_perm)  # OI+k -> k+IO (HWIO-form)
+            dn2 = jax.lax.conv_dimension_numbers(
+                xi.shape, wi.shape, CONV_CL_SPEC[ndim])
+            out = jax.lax.conv_general_dilated(
+                xi, wi, window_strides=(1,) * ndim, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn2, feature_group_count=groups)
+            if maybe_b:
+                out = out + maybe_b[0].reshape(
+                    (1,) * (out.ndim - 1) + (-1,))
+            return _to_cf(out)
         dn2 = jax.lax.conv_dimension_numbers(
             x.shape, w_t.shape,
             tuple(s.replace("IO", "OI") for s in dn_str))

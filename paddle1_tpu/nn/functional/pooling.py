@@ -33,14 +33,14 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
     pad_cfg = _padding(padding, ndim)
 
     def f(x):
-        # NCHW-API 2-D pools join the channels-last region (_layout.py):
-        # the axon backend executes reduce_window in the literal layout
-        # given, and NCHW pooling measured ~100x slower than NHWC on
-        # chip (chip_results/conv_probe2.txt)
+        # Channels-first-API pools join the channels-last region
+        # (_layout.py): the axon backend executes reduce_window in the
+        # literal layout given, and NCHW pooling measured ~100x slower
+        # than NHWC on chip (chip_results/conv_probe2.txt)
         from ._layout import channels_last_region
-        nhwc_internal, _to_nhwc, _to_nchw = channels_last_region(
-            x.ndim if ndim == 2 else 0, channel_last)
-        x = _to_nhwc(x)
+        nhwc_internal, _to_cl, _to_cf = channels_last_region(
+            x.ndim if x.ndim == ndim + 2 else 0, channel_last)
+        x = _to_cl(x)
         cl = channel_last or nhwc_internal
         if cl:
             window = (1,) + k + (1,)
@@ -78,7 +78,7 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
                 out = summed / counts
             else:
                 out = summed / float(np.prod(k))
-        return _to_nchw(out)
+        return _to_cf(out)
     return apply(op_name, f, (_t(x),))
 
 

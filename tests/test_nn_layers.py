@@ -461,6 +461,143 @@ class TestConvNHWCInternal(OpTest):
             np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5,
                                        err_msg=f"training={training} grad")
 
+    def test_conv_1d_3d_flag_path_matches(self):
+        # r5: the channels-last region generalized beyond 2-D — same
+        # physics (channel dim must be the lane dim on this backend)
+        import numpy as np
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(5)
+        cases = [
+            (F.conv1d, rng.standard_normal((2, 3, 12)),
+             rng.standard_normal((5, 3, 3)), dict(stride=2, padding=1)),
+            (F.conv1d, rng.standard_normal((1, 4, 10)),
+             rng.standard_normal((8, 2, 3)), dict(groups=2, padding=1)),
+            (F.conv3d, rng.standard_normal((2, 3, 5, 6, 6)),
+             rng.standard_normal((4, 3, 3, 3, 3)),
+             dict(stride=2, padding=1)),
+            (F.conv3d, rng.standard_normal((1, 4, 4, 5, 5)),
+             rng.standard_normal((8, 2, 3, 3, 3)),
+             dict(groups=2, padding=1, dilation=1)),
+        ]
+        for fn, x, w, kw in cases:
+            x = x.astype(np.float32)
+            w = (w * 0.3).astype(np.float32)
+
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = fn(xt, to_tensor(w), **kw)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"{fn.__name__} {kw}")
+            np.testing.assert_allclose(g1, g2, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"{fn.__name__} {kw} grad")
+
+    def test_conv_transpose_flag_path_matches(self):
+        import numpy as np
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(6)
+        cases = [
+            (F.conv1d_transpose, rng.standard_normal((2, 4, 8)),
+             rng.standard_normal((4, 3, 3)), dict(stride=2, padding=1)),
+            (F.conv2d_transpose, rng.standard_normal((2, 4, 6, 6)),
+             rng.standard_normal((4, 3, 3, 3)),
+             dict(stride=2, padding=1, output_padding=1)),
+            (F.conv2d_transpose, rng.standard_normal((1, 4, 5, 5)),
+             rng.standard_normal((4, 2, 3, 3)), dict(groups=2)),
+            (F.conv3d_transpose, rng.standard_normal((1, 3, 4, 4, 4)),
+             rng.standard_normal((3, 2, 3, 3, 3)),
+             dict(stride=2, padding=1)),
+        ]
+        for fn, x, w, kw in cases:
+            x = x.astype(np.float32)
+            w = (w * 0.3).astype(np.float32)
+
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = fn(xt, to_tensor(w), **kw)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"{fn.__name__} {kw}")
+            np.testing.assert_allclose(g1, g2, rtol=2e-5, atol=2e-5,
+                                       err_msg=f"{fn.__name__} {kw} grad")
+
+    def test_pool_1d_3d_and_bn_ranks_flag_path(self):
+        import numpy as np
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import to_tensor
+        import paddle1_tpu.nn.functional as F
+        rng = np.random.default_rng(7)
+        pool_cases = [
+            (F.max_pool1d, rng.standard_normal((2, 3, 11)),
+             dict(kernel_size=3, stride=2, padding=1)),
+            (F.avg_pool1d, rng.standard_normal((2, 3, 10)),
+             dict(kernel_size=2, stride=2)),
+            (F.max_pool3d, rng.standard_normal((2, 3, 6, 7, 7)),
+             dict(kernel_size=2, stride=2, ceil_mode=True)),
+            (F.avg_pool3d, rng.standard_normal((2, 3, 6, 6, 6)),
+             dict(kernel_size=3, stride=2, padding=1)),
+        ]
+        for fn, x, kw in pool_cases:
+            x = x.astype(np.float32)
+
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = fn(xt, **kw)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{fn.__name__} {kw}")
+            np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{fn.__name__} {kw} grad")
+        # batch norm at 3-D (NCL) and 5-D (NCDHW)
+        for shape in [(4, 5, 7), (2, 5, 3, 4, 4)]:
+            x = rng.standard_normal(shape).astype(np.float32)
+            w = rng.standard_normal((5,)).astype(np.float32)
+            b = rng.standard_normal((5,)).astype(np.float32)
+
+            def run():
+                xt = to_tensor(x)
+                xt.stop_gradient = False
+                out = F.batch_norm(xt, to_tensor(np.zeros(5, np.float32)),
+                                   to_tensor(np.ones(5, np.float32)),
+                                   to_tensor(w), to_tensor(b),
+                                   training=True)
+                out.sum().backward()
+                return (np.asarray(out.numpy()),
+                        np.asarray(xt.grad.numpy()))
+            with flags_guard(conv_nhwc="never"):
+                o1, g1 = run()
+            with flags_guard(conv_nhwc="always"):
+                o2, g2 = run()
+            np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"bn {shape}")
+            np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"bn {shape} grad")
+
     def test_small_cnn_end_to_end_flag_path(self):
         # conv+bn+pool+residual+fc: the full channels-last region in one
         # model, forward and parameter gradients identical to NCHW
