@@ -21,8 +21,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["channels_last_region", "CONV_WEIGHT_PERM",
-           "CONV_CL_SPEC"]
+__all__ = ["channels_last_region", "channels_last_region_for",
+           "CONV_WEIGHT_PERM", "CONV_CL_SPEC"]
 
 _identity = lambda t: t
 
@@ -61,3 +61,14 @@ def channels_last_region(x_ndim: int, channel_last: bool):
     return (True,
             lambda t: jnp.transpose(t, fwd),
             lambda t: jnp.transpose(t, bwd))
+
+
+def channels_last_region_for(x, spatial_rank: int, channel_last: bool):
+    """Region resolution for an op with a known spatial rank: only a
+    batched channels-first input of rank ``spatial_rank + 2``
+    participates — a mis-ranked input stays on the normal (flag-off)
+    path so its error message does not depend on a performance flag.
+    ``x`` may be a Tensor, array, or tracer (anything with ``ndim``)."""
+    rank = getattr(x, "ndim", 0)
+    return channels_last_region(
+        rank if rank == spatial_rank + 2 else 0, channel_last)

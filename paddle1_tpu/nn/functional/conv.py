@@ -75,11 +75,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
     # backend does not relayout weights either;
     # chip_results/conv_probe2.txt).
     from ._layout import (CONV_CL_SPEC, CONV_WEIGHT_PERM,
-                          channels_last_region)
-    x_rank = getattr(x, "ndim", None) or (
-        x.data.ndim if hasattr(x, "data") else 0)
-    nhwc_internal, _to_cl, _to_cf = channels_last_region(
-        x_rank if x_rank == ndim + 2 else 0, channel_last)
+                          channels_last_region_for)
+    nhwc_internal, _to_cl, _to_cf = channels_last_region_for(
+        x, ndim, channel_last)
     _w_perm = CONV_WEIGHT_PERM[ndim]
     _cl_spec = CONV_CL_SPEC[ndim]
 
@@ -163,11 +161,9 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
     # conv_general_dilated, with the same literal-layout execution cost
     # on the axon backend as the forward convs
     from ._layout import (CONV_CL_SPEC, CONV_WEIGHT_PERM,
-                          channels_last_region)
-    x_rank = getattr(x, "ndim", None) or (
-        x.data.ndim if hasattr(x, "data") else 0)
-    nhwc_internal, _to_cl, _to_cf = channels_last_region(
-        x_rank if x_rank == ndim + 2 else 0, channel_last)
+                          channels_last_region_for)
+    nhwc_internal, _to_cl, _to_cf = channels_last_region_for(
+        x, ndim, channel_last)
     _w_perm = CONV_WEIGHT_PERM[ndim]
 
     def f(x, w, *maybe_b):

@@ -37,9 +37,9 @@ def _pool(x, ksize, stride, padding, ndim, mode, channel_last, ceil_mode,
         # (_layout.py): the axon backend executes reduce_window in the
         # literal layout given, and NCHW pooling measured ~100x slower
         # than NHWC on chip (chip_results/conv_probe2.txt)
-        from ._layout import channels_last_region
-        nhwc_internal, _to_cl, _to_cf = channels_last_region(
-            x.ndim if x.ndim == ndim + 2 else 0, channel_last)
+        from ._layout import channels_last_region_for
+        nhwc_internal, _to_cl, _to_cf = channels_last_region_for(
+            x, ndim, channel_last)
         x = _to_cl(x)
         cl = channel_last or nhwc_internal
         if cl:

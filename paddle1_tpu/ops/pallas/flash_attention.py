@@ -165,34 +165,81 @@ def _flash_fwd(q, k, v, scale, causal, padding_mask=None):
     return out, lse
 
 
-def _bwd_xla(q, k, v, out, lse, dout, scale, causal, padding_mask=None):
+def _bwd_xla(q, k, v, out, lse, dout, scale, causal, padding_mask=None,
+             q_chunk=None):
     """Flash-style backward in XLA: recompute P per (b,h) from the saved
-    LSE; XLA blocks/fuses the einsums onto the MXU. (A hand-written Pallas
-    backward kernel is a later-round optimization.)"""
+    LSE; XLA blocks/fuses the einsums onto the MXU. Long sequences scan
+    over query chunks so the transient [B,H,C,Nk] score block stays
+    bounded (~512 MiB) instead of materializing the full [B,H,Nq,Nk]
+    matrix — this is the memory-escape backward for shapes the Pallas
+    kernels' VMEM model rejects (flash_attention_bwd.supported)."""
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B,H,Nq,D]
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
     doh = jnp.swapaxes(dout, 1, 2).astype(jnp.float32)
     oh = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
-
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-    if padding_mask is not None:
-        s = jnp.where(padding_mask[:, None, None, :] > 0.5, s, _NEG_INF)
-    if causal:
-        nq, nk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((nq, nk), bool), nk - nq)
-        s = jnp.where(mask, s, _NEG_INF)
+    b, h, nq, d = qh.shape
+    nk = kh.shape[2]
     # fully-masked rows carry the sentinel LSE from the forward: exp(s-lse)
     # would be exp(0)=1 per key there — gate p to zero instead so such rows
     # contribute no gradient (matching their zeroed forward output)
     lse = jnp.where(lse > _NEG_INF * 0.1, lse, jnp.inf)
-    p = jnp.exp(s - lse[..., None])                   # [B,H,Nq,Nk]
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
-    delta = jnp.sum(doh * oh, axis=-1, keepdims=True)  # [B,H,Nq,1]
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
+
+    def block_grads(qs, dos, os_, lses, q0):
+        """Gradient contributions of one query block [B,H,C,D]."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kh) * scale
+        if padding_mask is not None:
+            s = jnp.where(padding_mask[:, None, None, :] > 0.5, s,
+                          _NEG_INF)
+        if causal:
+            c = qs.shape[2]
+            q_ids = (q0 + (nk - nq) +
+                     jax.lax.broadcasted_iota(jnp.int32, (c, nk), 0))
+            k_ids = jax.lax.broadcasted_iota(jnp.int32, (c, nk), 1)
+            s = jnp.where((q_ids >= k_ids)[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lses[..., None])              # [B,H,C,Nk]
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, dos)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dos, vh)
+        delta = jnp.sum(dos * os_, axis=-1, keepdims=True)
+        ds = p * (dp - delta) * scale
+        dq_c = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds, qs)
+        return dq_c, dk_c, dv_c
+
+    # chunk size: bound the f32 score block near 512 MiB, keep the
+    # q dim a multiple that divides nq (nq is BLOCK_Q-aligned here);
+    # q_chunk overrides for tests
+    if q_chunk is not None:
+        chunk = q_chunk
+    else:
+        target = max(1, (512 * 1024 * 1024) // max(b * h * nk * 4, 1))
+        # floor at 128 (nq is BLOCK_Q-aligned on every path that
+        # reaches here): for the very largest workloads target drops
+        # below every candidate, and falling back to chunk=nq would
+        # materialize the full score matrix — the exact OOM this
+        # chunking exists to prevent
+        chunk = 128 if nq % 128 == 0 else nq
+        for cand in (4096, 2048, 1024, 512, 256):
+            if cand <= target and nq % cand == 0:
+                chunk = cand
+                break
+    if chunk >= nq:
+        dq, dk, dv = block_grads(qh, doh, oh, lse, 0)
+    else:
+        n_chunks = nq // chunk
+
+        def body(carry, i):
+            dk_acc, dv_acc = carry
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(
+                a, i * chunk, chunk, axis=2)
+            dq_c, dk_c, dv_c = block_grads(sl(qh), sl(doh), sl(oh),
+                                           sl(lse), i * chunk)
+            return (dk_acc + dk_c, dv_acc + dv_c), dq_c
+        (dk, dv), dq_chunks = jax.lax.scan(
+            body, (jnp.zeros_like(kh), jnp.zeros_like(vh)),
+            jnp.arange(n_chunks))
+        # [n_chunks, B, H, C, D] -> [B, H, Nq, D]
+        dq = jnp.moveaxis(dq_chunks, 0, 2).reshape(b, h, nq, d)
     to = lambda x: jnp.swapaxes(x, 1, 2)
     return (to(dq).astype(q.dtype), to(dk).astype(k.dtype),
             to(dv).astype(v.dtype))

@@ -43,10 +43,15 @@ def supported(q_shape, k_shape) -> bool:
         return False
     if d % 8 or d > 256:
         return False
-    # the dkv pass keeps FULL q+do rows resident ([nq, d] each); the dq
-    # pass keeps full k+v — bound both, f32, within the VMEM budget
-    budget = 8 * 1024 * 1024
-    if 2 * nq * d * 4 > budget or 2 * nk * d * 4 > budget:
+    # the dkv pass keeps FULL q+do rows resident; the dq pass keeps
+    # full k+v. Measured scoped-VMEM cost (r5, on-chip compile report
+    # at nq=nk=16384, d=64: 32.25 MiB vs the 16 MiB limit) is ~32
+    # bytes per row-element — operands + accumulators + pipeline
+    # double-buffering — so gate on that model with headroom. Shapes
+    # rejected here take the chunked XLA recompute backward
+    # (_bwd_xla), which is HBM-bounded instead.
+    budget = 14 * 1024 * 1024
+    if 32 * max(nq, nk) * d > budget:
         return False
     return True
 

@@ -498,3 +498,51 @@ class TestFlashAutoDispatch:
         # fractional thresholds are legal (float flag, not int)
         with core_flags.flags_guard(flash_auto_score_mb=0.5):
             assert core_flags.flag("flash_auto_score_mb") == 0.5
+
+
+class TestChunkedXlaBackward:
+    """r5: _bwd_xla scans over query chunks for long sequences (the
+    memory-escape backward when the Pallas kernels' VMEM model rejects
+    the shape). Chunked must equal dense exactly."""
+
+    def _problem(self, b=2, nq=256, nk=256, h=2, d=32, masked=False):
+        rng = np.random.default_rng(0)
+        mk = lambda *s: jnp.asarray(
+            rng.standard_normal(s).astype(np.float32) * 0.3)
+        q, k, v = mk(b, nq, h, d), mk(b, nk, h, d), mk(b, nk, h, d)
+        dout = mk(b, nq, h, d)
+        pm = None
+        if masked:
+            keep = np.ones((b, nk), np.float32)
+            keep[:, nk - 40:] = 0.0
+            pm = jnp.asarray(keep)
+        return q, k, v, pm, dout
+
+    @pytest.mark.parametrize("causal,masked,nq,nk", [
+        (False, False, 256, 256),
+        (True, False, 256, 256),
+        (True, False, 128, 256),     # rectangular bottom-right causal
+        (False, True, 256, 256),
+    ])
+    def test_chunked_equals_dense(self, causal, masked, nq, nk):
+        from paddle1_tpu.ops.pallas import flash_attention as fa
+        q, k, v, pm, dout = self._problem(nq=nq, nk=nk, masked=masked)
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        out, lse = fa._flash_fwd(q, k, v, scale, causal,
+                                 padding_mask=pm)
+        dense = fa._bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                            padding_mask=pm, q_chunk=nq)
+        chunked = fa._bwd_xla(q, k, v, out, lse, dout, scale, causal,
+                              padding_mask=pm, q_chunk=64)
+        for g1, g2, name in zip(dense, chunked, "dq dk dv".split()):
+            np.testing.assert_allclose(
+                np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5,
+                err_msg=f"{name} causal={causal} masked={masked}")
+
+    def test_vmem_model_rejects_long_seq(self):
+        from paddle1_tpu.ops.pallas.flash_attention_bwd import supported
+        assert supported((1, 4096, 12, 64), (1, 4096, 12, 64))
+        # 32 * 16384 * 64 = 32 MiB > the 14 MiB budget (measured OOM
+        # at 32.25 MiB scoped vmem on chip)
+        assert not supported((1, 16384, 12, 64), (1, 16384, 12, 64))
+        assert not supported((1, 8192, 12, 64), (1, 8192, 12, 64))
