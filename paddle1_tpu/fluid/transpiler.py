@@ -1,25 +1,43 @@
 """Legacy transpilers (reference python/paddle/fluid/transpiler/
-distribute_transpiler.py:256) — deliberate teaching errors.
+distribute_transpiler.py:256) — a WORKING mapping onto the PS runtime.
 
-The DistributeTranspiler rewrote a static ProgramDesc into
-trainer/pserver program pairs (split params onto PS nodes, insert
-send/recv ops); geo-SGD added delta-sync variants. In this build the
-same capabilities are first-class runtime features rather than program
-rewrites, so the transpiler surface exists only to point migrating
-scripts at them:
+The reference DistributeTranspiler rewrote a static ProgramDesc into
+trainer/pserver program pairs: split params onto PS nodes, move the
+optimizer server-side, insert send(grad)/recv(param) ops; geo-SGD
+pushed parameter DELTAS on a cadence instead. This build has no
+ProgramDesc, but it has the same runtime capability natively — TCP
+table servers with in-table sgd/adagrad/adam (distributed/ps_server.py,
+distributed/ps.py DenseTable) — so ``transpile`` produces REAL runnable
+program objects for ``static.Executor.run``:
 
-* sync/async PS training   → ``distributed.fleet`` PS mode
-  (``fleet.init_server(dim=..., dense_tables=...)`` / ``run_server`` /
-  trainers over ``distributed.ps_server.remote_service``) with the
-  async ``distributed.AsyncCommunicator``;
-* geo-SGD                  → ``distributed.GeoCommunicator``;
-* collective (NCCL2) mode  → ``distributed.ParallelEngine`` /
-  ``fleet.distributed_model`` (GSPMD inserts the collectives).
+* ``get_pserver_program(endpoint)`` → a blocking serve-loop program:
+  hosts the DenseTables for the params assigned to that endpoint
+  (server-side optimizer — exactly the reference's moved-optimizer
+  semantics). Stop it remotely via ``RemoteTable.shutdown_server()``.
+* ``get_trainer_program()`` → a per-step program: the user's loss
+  callable runs forward, the program backward()s it, PUSHES each
+  tracked param's gradient to its table (the send ops), waits for the
+  round in sync mode (all ``trainers`` pushes visible, via table
+  versions), and PULLS fresh values back into the live Tensors (the
+  recv ops).
+* geo-SGD mode (``DistributeTranspilerConfig.geo_sgd_mode``): local
+  SGD steps, with parameter deltas pushed/merged every
+  ``geo_sgd_need_push_nums`` steps (reference sparse_geo_table.h
+  delta-sync semantics, here via ``DenseTable.push_dense_delta``).
+
+Modern code should use ``distributed.fleet`` PS mode directly; this
+surface exists so reference transpiler scripts run with their role
+structure intact.
 """
 
 from __future__ import annotations
 
-from ..core.errors import UnimplementedError
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
            "HashName", "RoundRobin", "memory_optimize",
@@ -27,8 +45,12 @@ __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
 
 
 class DistributeTranspilerConfig:
-    """Accepted for source compatibility; every field is recorded but
-    the transpile step itself is unimplemented (see module docstring)."""
+    """Knobs honored by transpile(): ``split_method`` (RoundRobin /
+    HashName), ``sync_mode``, ``geo_sgd_mode`` +
+    ``geo_sgd_need_push_nums``, ``wait_port``. The block-slicing fields
+    (slice_var_up, min_block_size) are accepted but whole-param
+    placement is used — the tables shard per parameter, not per 8k
+    block."""
 
     slice_var_up = True
     split_method = None
@@ -48,54 +70,324 @@ class _SplitMethod:
 
 
 class HashName(_SplitMethod):
+    """Place each param by a stable hash of its name (reference
+    HashName split)."""
+
     def __init__(self, pserver_endpoints):
         self.endpoints = list(pserver_endpoints)
+
+    def assign(self, names, n):
+        import zlib
+        return [zlib.crc32(name.encode()) % n for name in names]
 
 
 class RoundRobin(_SplitMethod):
     def __init__(self, pserver_endpoints):
         self.endpoints = list(pserver_endpoints)
 
+    def assign(self, names, n):
+        return [i % n for i in range(len(names))]
+
+
+class PServerProgram:
+    """Blocking table-server program for one endpoint (the transpiled
+    pserver program). ``Executor.run(prog)`` serves until a client
+    calls ``RemoteTable(endpoint).shutdown_server()``."""
+
+    def __init__(self, endpoint: str, specs: Dict[str, dict]):
+        self.endpoint = endpoint
+        self.specs = specs          # name -> {value, optimizer, lr}
+        self._server = None
+
+    def start(self):
+        """Start serving in the background; returns self. Executor.run
+        uses the blocking ``serve`` instead."""
+        from ..distributed.ps import DenseTable, SparseTable
+        from ..distributed.ps_server import TableServer
+        if self._server is not None:
+            raise PreconditionNotMetError(
+                f"pserver program for {self.endpoint} already serving")
+        tables = {}
+        for name, spec in self.specs.items():
+            # seed via initializer (not set_value) so the version
+            # counter counts only trainer pushes — the sync barrier
+            # arithmetic depends on it
+            tables[name] = DenseTable(
+                spec["value"].shape,
+                initializer=lambda r, shp, v=spec["value"]: v.copy(),
+                optimizer=spec["optimizer"], lr=spec["lr"])
+        host, port = self.endpoint.rsplit(":", 1)
+        self._server = TableServer(SparseTable(dim=1), host=host,
+                                   port=int(port),
+                                   aux_tables=tables).start()
+        return self
+
+    def serve(self):
+        self.start()
+        try:
+            while self._server is not None and \
+                    self._server_thread_alive():
+                time.sleep(0.2)
+        finally:
+            self.stop()   # interrupt must not leak the thread/port
+        return []
+
+    def _server_thread_alive(self):
+        th = getattr(self._server, "_thread", None)
+        if th is not None:
+            return th.is_alive()
+        # fall back: probe our own socket
+        from ..distributed.ps_server import RemoteTable
+        try:
+            return RemoteTable(self.endpoint, timeout=2.0).ping()
+        except Exception:
+            return False
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+class TrainerProgram:
+    """The transpiled trainer-side program: run one step via
+    ``Executor.run(prog, feed={...}, fetch_list=[...])`` with the
+    original loss callable's kwargs as feed."""
+
+    def __init__(self, step_fn, params: Dict[str, "object"],
+                 placement: Dict[str, str], trainers: int,
+                 sync_mode: bool, wait_port: bool, geo_push_every: int,
+                 geo_lr: float):
+        self._step_fn = step_fn
+        self._params = params             # name -> live Tensor
+        self._placement = placement       # name -> endpoint
+        self._trainers = max(int(trainers), 1)
+        self._sync = bool(sync_mode)
+        self._wait_port = wait_port
+        self._geo_every = int(geo_push_every)  # 0 = grad-push mode
+        self._geo_lr = float(geo_lr)
+        self._remotes = {}                # endpoint -> RemoteTable
+        self._round = 0
+        self._geo_base: Dict[str, np.ndarray] = {}
+
+    # -- wiring -----------------------------------------------------------
+    def _remote(self, endpoint):
+        from ..distributed.ps_server import RemoteTable
+        if endpoint not in self._remotes:
+            # RemoteTable connects eagerly, so wait_port retries the
+            # CONSTRUCTION (trainer started before its pserver — the
+            # scenario wait_port exists for)
+            deadline = time.time() + (30.0 if self._wait_port else 0.0)
+            while True:
+                try:
+                    rt = RemoteTable(endpoint)
+                    if rt.ping():
+                        break
+                except Exception:
+                    if time.time() >= deadline:
+                        raise PreconditionNotMetError(
+                            f"pserver {endpoint} not reachable"
+                            + (" within 30s (wait_port)"
+                               if self._wait_port else
+                               " (wait_port disabled)"))
+                    time.sleep(0.2)
+            self._remotes[endpoint] = rt
+        return self._remotes[endpoint]
+
+    def _pull_all(self):
+        import jax.numpy as jnp
+        for name, t in self._params.items():
+            rt = self._remote(self._placement[name])
+            val = np.asarray(rt.table_call(name, "pull_dense"))
+            t._data = jnp.asarray(val.reshape(tuple(t.shape)))
+
+    def connect(self):
+        """Initial recv: overwrite local params with the served values
+        (the reference's startup broadcast from pservers)."""
+        self._pull_all()
+        if self._geo_every:
+            self._geo_base = {n: np.asarray(t.data).copy()
+                              for n, t in self._params.items()}
+        return self
+
+    # -- one step ---------------------------------------------------------
+    def run(self, feed=None, fetch_list=None):
+        from ..core.tensor import Tensor
+        if fetch_list is not None:
+            raise InvalidArgumentError(
+                "the transpiled trainer program returns its callable's "
+                "outputs directly (the loss first) — return extra "
+                "fetches from the callable instead of passing "
+                "fetch_list")
+        if not self._remotes:
+            self.connect()
+        for t in self._params.values():
+            if hasattr(t, "clear_grad"):
+                t.clear_grad()
+        out = self._step_fn(**(feed or {}))
+        loss = out[0] if isinstance(out, (list, tuple)) else out
+        if not isinstance(loss, Tensor):
+            raise InvalidArgumentError(
+                "the transpiled trainer program's callable must return "
+                "the loss Tensor (first, if a tuple)")
+        loss.backward()
+        self._round += 1
+        if self._geo_every:
+            # geo-SGD: local update now, delta sync on the cadence
+            for name, t in self._params.items():
+                if t.grad is not None:
+                    t._data = t.data - self._geo_lr * t.grad.data
+            if self._round % self._geo_every == 0:
+                for name, t in self._params.items():
+                    rt = self._remote(self._placement[name])
+                    delta = np.asarray(t.data) - self._geo_base[name]
+                    rt.table_call(name, "push_dense_delta",
+                                  delta.astype(np.float32))
+                self._pull_all()
+                self._geo_base = {n: np.asarray(t.data).copy()
+                                  for n, t in self._params.items()}
+        else:
+            # send ops: push grads (the server-side optimizer applies)
+            pushed = []
+            for name, t in self._params.items():
+                g = t.grad
+                if g is None:
+                    continue   # frozen / unused params are never pushed
+                rt = self._remote(self._placement[name])
+                rt.table_call(name, "push_dense_grad",
+                              np.asarray(g.data, np.float32))
+                pushed.append(name)
+            if self._sync and self._trainers > 1:
+                # sync barrier: a round is complete when every trainer's
+                # push is visible — table versions count pushes. Only
+                # tables THIS trainer pushed participate (a grad-less
+                # param's version never advances; waiting on it would
+                # deadlock every trainer)
+                target = self._round * self._trainers
+                deadline = time.time() + 60.0
+                for name in pushed:
+                    rt = self._remote(self._placement[name])
+                    while rt.table_call(name, "get_version") < target:
+                        if time.time() > deadline:
+                            raise PreconditionNotMetError(
+                                f"sync barrier timed out at round "
+                                f"{self._round} (table {name})")
+                        time.sleep(0.01)
+            # recv ops: pull fresh params
+            self._pull_all()
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
 
 class DistributeTranspiler:
-    """Program-rewriting PS transpiler — unimplemented by design; the
-    error names the runtime replacement for each mode."""
+    """PS transpiler over the runtime tables (see module docstring).
+
+    Extension over the reference signature: the server-side optimizer
+    is not recoverable from a ProgramDesc here, so ``transpile`` takes
+    ``optimizer=`` ("sgd" / "adagrad" / "adam") and ``lr=`` directly
+    (reference behavior: the optimizer op moved into the pserver
+    program), and the trainer work is a loss callable passed as
+    ``program=`` (feed becomes its kwargs) with the params tracked from
+    ``params=`` or the fluid.layers implicit-parameter registry."""
 
     def __init__(self, config: DistributeTranspilerConfig = None):
         self.config = config or DistributeTranspilerConfig()
+        self._trainer_prog: Optional[TrainerProgram] = None
+        self._pserver_specs: Dict[str, Dict[str, dict]] = {}
 
-    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
-                  trainers=1, sync_mode=True, startup_program=None,
-                  current_endpoint="127.0.0.1:6174"):
-        geo = getattr(self.config, "geo_sgd_mode", False)
-        hint = ("distributed.GeoCommunicator (delta sync every "
-                "geo_sgd_need_push_nums steps)" if geo else
-                "fleet PS mode: servers run fleet.init_server(dim=..., "
-                "dense_tables=...) + fleet.run_server(); trainers use "
-                "distributed.ps_server.remote_service + "
-                "distributed.AsyncCommunicator for async dense updates")
-        raise UnimplementedError(
-            "DistributeTranspiler rewrote static programs into "
-            "trainer/pserver pairs; this build ships the same "
-            f"capability as a runtime feature instead — use {hint}. "
-            "Collective (NCCL2) mode maps to distributed.ParallelEngine "
-            "/ fleet.distributed_model (GSPMD emits the collectives). "
-            "See MIGRATING.md 'Parameter server'.")
+    def _collect_params(self, spec):
+        from ..core.tensor import Tensor
+        from ..nn.layer_base import Layer
+        if isinstance(spec, dict):
+            return dict(spec)
+        if isinstance(spec, Layer):
+            # named PARAMETERS only: buffers (BN running stats) are
+            # local state, not PS-hosted — pulling them back each step
+            # would freeze their accumulation
+            return dict(spec.named_parameters())
+        if isinstance(spec, (list, tuple)) and spec and \
+                isinstance(spec[0], Tensor):
+            return {getattr(t, "name", None) or f"param_{i}": t
+                    for i, t in enumerate(spec)}
+        from . import layers as fluid_layers
+        ps = fluid_layers.implicit_parameters()
+        if not ps:
+            raise PreconditionNotMetError(
+                "transpile found no parameters: build the net first "
+                "(fluid.layers implicit params), or pass params= as a "
+                "Layer, a {name: Tensor} dict, or a Tensor list")
+        return {getattr(t, "name", None) or f"param_{i}": t
+                for i, t in enumerate(ps)}
+
+    def transpile(self, trainer_id, program=None,
+                  pservers="127.0.0.1:6174", trainers=1, sync_mode=True,
+                  startup_program=None, current_endpoint="127.0.0.1:6174",
+                  *, params=None, step_fn=None, optimizer="sgd",
+                  lr=0.01):
+        endpoints = ([e.strip() for e in pservers.split(",")]
+                     if isinstance(pservers, str) else list(pservers))
+        if not endpoints:
+            raise InvalidArgumentError(
+                "transpile needs pserver endpoints")
+        self.trainer_id = int(trainer_id)
+        self.endpoints = endpoints
+        tracked = self._collect_params(
+            params if params is not None else
+            (None if callable(program) else program))
+        step = step_fn if step_fn is not None else (
+            program if callable(program) else None)
+
+        names = list(tracked)
+        method = self.config.split_method or RoundRobin
+        if isinstance(method, type):
+            method = method(endpoints)
+        assign = method.assign(names, len(endpoints))
+        placement = {n: endpoints[a] for n, a in zip(names, assign)}
+
+        self._pserver_specs = {e: {} for e in endpoints}
+        for n, t in tracked.items():
+            # writable copy: np.asarray over a jax buffer is read-only
+            self._pserver_specs[placement[n]][n] = {
+                "value": np.array(t.data, np.float32),
+                "optimizer": optimizer, "lr": float(lr)}
+
+        geo = bool(getattr(self.config, "geo_sgd_mode", False))
+        self._trainer_prog = TrainerProgram(
+            step, tracked, placement, trainers,
+            sync_mode and not geo, self.config.wait_port,
+            getattr(self.config, "geo_sgd_need_push_nums", 100)
+            if geo else 0, lr)
+        return self
+
+    def _need_transpile(self):
+        if self._trainer_prog is None:
+            raise PreconditionNotMetError("call transpile() first")
 
     def get_trainer_program(self, wait_port=True):
-        raise UnimplementedError(
-            "call transpile() first — which explains the runtime "
-            "replacement for the transpiler flow")
+        self._need_transpile()
+        if self._trainer_prog._step_fn is None:
+            raise InvalidArgumentError(
+                "no trainer callable: pass the loss step as "
+                "transpile(program=<callable>) or step_fn=<callable> "
+                "(the ProgramDesc the reference rewrote is a callable "
+                "here)")
+        self._trainer_prog._wait_port = wait_port
+        return self._trainer_prog
 
     def get_pserver_program(self, endpoint):
-        raise UnimplementedError(
-            "call transpile() first — which explains the runtime "
-            "replacement for the transpiler flow")
+        self._need_transpile()
+        if endpoint not in self._pserver_specs:
+            raise InvalidArgumentError(
+                f"{endpoint!r} is not one of the transpiled pserver "
+                f"endpoints {list(self._pserver_specs)}")
+        return PServerProgram(endpoint, self._pserver_specs[endpoint])
+
+    def get_pserver_programs(self, endpoint):
+        prog = self.get_pserver_program(endpoint)
+        return prog, self.get_startup_program(endpoint, prog)
 
     def get_startup_program(self, endpoint, pserver_program=None):
-        raise UnimplementedError(
-            "call transpile() first — which explains the runtime "
-            "replacement for the transpiler flow")
+        self._need_transpile()
+        return lambda: []   # table init is embedded in the serve program
 
 
 def memory_optimize(input_program=None, skip_opt_set=None,

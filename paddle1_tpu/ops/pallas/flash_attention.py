@@ -210,6 +210,10 @@ def _bwd_xla(q, k, v, out, lse, dout, scale, causal, padding_mask=None,
     # q dim a multiple that divides nq (nq is BLOCK_Q-aligned here);
     # q_chunk overrides for tests
     if q_chunk is not None:
+        if nq % q_chunk:
+            raise ValueError(
+                f"q_chunk={q_chunk} must divide nq={nq} (a non-divisor "
+                "would silently drop the tail rows' gradients)")
         chunk = q_chunk
     else:
         target = max(1, (512 * 1024 * 1024) // max(b * h * nk * 4, 1))

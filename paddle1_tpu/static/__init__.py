@@ -188,6 +188,14 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        # transpiled PS programs (fluid.transpiler.DistributeTranspiler)
+        # are runnable: the pserver program serves its tables
+        # (blocking), the trainer program runs one push/pull-synced step
+        from ..fluid.transpiler import PServerProgram, TrainerProgram
+        if isinstance(program, PServerProgram):
+            return program.serve()
+        if isinstance(program, TrainerProgram):
+            return program.run(feed=feed, fetch_list=fetch_list)
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]

@@ -190,20 +190,28 @@ class TestMappedGroupsFunctional:
         assert s.numpy().reshape(-1).tolist() == [0, 4, 1, 5, 2, 6, 3, 7]
 
 
-class TestTranspilerTeaching:
-    def test_distribute_transpiler_teaches_fleet(self):
-        from paddle1_tpu.core.errors import UnimplementedError
+class TestTranspilerSurface:
+    # r5: the transpiler became a REAL mapping onto the PS runtime —
+    # the e2e train flow is tests/test_transpiler_ps.py; here the
+    # surface-level contracts
+    def test_transpile_without_net_teaches(self, monkeypatch):
+        from paddle1_tpu.core.errors import PreconditionNotMetError
+        from paddle1_tpu.fluid import layers as fl
+        # other tests in this file create implicit params; an empty
+        # registry is the condition under test
+        monkeypatch.setattr(fl, "_implicit_registry", {})
         t = fluid.DistributeTranspiler()
-        with pytest.raises(UnimplementedError, match="fleet"):
+        with pytest.raises(PreconditionNotMetError, match="parameters"):
             t.transpile(trainer_id=0, pservers="127.0.0.1:6174",
                         trainers=2)
 
-    def test_geo_mode_teaches_geo_communicator(self):
-        from paddle1_tpu.core.errors import UnimplementedError
-        cfg = fluid.DistributeTranspilerConfig()
-        cfg.geo_sgd_mode = True
-        with pytest.raises(UnimplementedError, match="GeoCommunicator"):
-            fluid.DistributeTranspiler(cfg).transpile(trainer_id=0)
+    def test_programs_require_transpile_first(self):
+        from paddle1_tpu.core.errors import PreconditionNotMetError
+        t = fluid.DistributeTranspiler()
+        with pytest.raises(PreconditionNotMetError, match="transpile"):
+            t.get_trainer_program()
+        with pytest.raises(PreconditionNotMetError, match="transpile"):
+            t.get_pserver_program("127.0.0.1:6174")
 
     def test_memory_optimize_noop(self):
         assert fluid.transpiler.memory_optimize() is None
