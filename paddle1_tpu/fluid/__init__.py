@@ -44,6 +44,17 @@ CUDAPlace = TPUPlace  # old scripts mean "the accelerator"
 from ..framework.param_attr import ParamAttr  # noqa: E402
 
 
+class _CoreShim:
+    """``fluid.core`` namespace for the names old scripts touch:
+    ``except fluid.core.EOFException`` (the py_reader epoch end) and
+    the place classes."""
+    from .reader import EOFException
+    CPUPlace, CUDAPlace, TPUPlace = CPUPlace, TPUPlace, TPUPlace
+
+
+core = _CoreShim()
+
+
 def data(name, shape, dtype="float32", lod_level=0):
     """Feed-var declaration → InputSpec (trace-time placeholder)."""
     from ..jit import InputSpec

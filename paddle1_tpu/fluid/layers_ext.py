@@ -137,6 +137,11 @@ __all__ = [
     # tensor-array (eager lists)
     "create_array", "array_write", "array_read", "array_length",
     "tensor_array_to_tensor",
+    # r5: queue-backed readers + the doc/codegen decorators (real
+    # implementations — fluid/reader.py)
+    "py_reader", "create_py_reader_by_data", "templatedoc", "autodoc",
+    "generate_layer_fn", "generate_activation_fn",
+    "generate_inplace_fn",
 ]
 
 
@@ -1393,6 +1398,9 @@ from .misc_tail import (  # noqa: E402,F401
 from .roi_tail import (  # noqa: E402,F401
     psroi_pool, prroi_pool, deformable_roi_pooling,
     roi_perspective_transform)
+from .reader import (  # noqa: E402,F401
+    py_reader, create_py_reader_by_data, templatedoc, autodoc,
+    generate_layer_fn, generate_activation_fn, generate_inplace_fn)
 
 
 # -- tensor arrays (eager lists) ---------------------------------------------

@@ -163,7 +163,12 @@ def load(out, file_path, load_as_fp16=False):
 
 def read_file(filename, name=None):
     """Raw file bytes as a uint8 tensor (reference read_file op —
-    paired with decode_jpeg in the vision IO path)."""
+    paired with decode_jpeg in the vision IO path). Passed a
+    ``py_reader`` instead, it pops one batch from the queue (the
+    reference fluid/layers/io.py read_file over a reader variable)."""
+    from .reader import PyReader
+    if isinstance(filename, PyReader):
+        return filename.read()
     with open(filename, "rb") as f:
         data = f.read()
     return to_tensor(np.frombuffer(data, np.uint8).copy())

@@ -1,0 +1,335 @@
+"""Queue-backed feeding readers + the fluid doc/codegen decorators.
+
+``py_reader`` (reference python/paddle/fluid/layers/io.py:418) and
+``create_py_reader_by_data`` (:629) created an in-graph queue the
+reader threads fed while ``read_file`` popped batches. The queue is a
+runtime object here — a bounded background-filled queue producing
+Tensors — rather than graph ops, so the reference idiom runs
+unchanged in shape:
+
+    reader = fluid.layers.py_reader(capacity=64,
+                                    shapes=[(-1, 784), (-1, 1)],
+                                    dtypes=['float32', 'int64'])
+    reader.decorate_paddle_reader(train_gen)
+    reader.start()
+    try:
+        while True:
+            img, label = fluid.layers.read_file(reader)
+            ...
+    except fluid.core.EOFException:
+        reader.reset()
+
+It is also a plain Python iterable (``for img, label in reader: ...``),
+matching the reference's iterable ``fluid.io.PyReader`` mode.
+
+``templatedoc``/``autodoc`` (reference
+python/paddle/fluid/layers/layer_function_generator.py) are real
+decorators here (docstring templating without the OpProto registry),
+and ``generate_layer_fn``/``generate_activation_fn``/
+``generate_inplace_fn`` generate callables from the modern functional
+registry instead of from op protos.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import (EnforceNotMet, InvalidArgumentError,
+                           PreconditionNotMetError)
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["PyReader", "py_reader", "create_py_reader_by_data",
+           "EOFException", "templatedoc", "autodoc",
+           "generate_layer_fn", "generate_activation_fn",
+           "generate_inplace_fn"]
+
+
+class EOFException(EnforceNotMet):
+    """End of the decorated reader's epoch (reference
+    fluid.core.EOFException, raised by the pop of a closed queue)."""
+
+
+_STOP = object()
+
+
+class PyReader:
+    """Bounded queue fed by a background thread from the decorated
+    generator; ``read()`` pops one batch as Tensors."""
+
+    def __init__(self, capacity: int, shapes=None, dtypes=None,
+                 lod_levels=None, name=None, use_double_buffer=True,
+                 iterable=True):
+        if capacity <= 0:
+            raise InvalidArgumentError("py_reader capacity must be > 0")
+        self._capacity = int(capacity)
+        self._shapes = shapes
+        self._dtypes = list(dtypes) if dtypes else None
+        self._gen: Optional[Callable] = None
+        self._collate = False
+        self._queue: Optional[_queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._exhausted = False
+
+    # -- decoration (reference PyReader decorate_* family) ---------------
+    def decorate_sample_list_generator(self, reader, places=None):
+        """``reader()`` yields a LIST OF SAMPLES per item — e.g. the
+        output of ``paddle.batch(...)``: ``[(img, label), ...]`` —
+        which is collated field-wise into batch arrays (the reference
+        decorate_sample_list_generator contract)."""
+        self._gen = reader
+        self._collate = True
+        return self
+
+    # reference decorate_paddle_reader consumes paddle.batch readers,
+    # i.e. sample-list items — same collation
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def decorate_batch_generator(self, reader, places=None):
+        """``reader()`` yields one already-batched item (tuple/list of
+        arrays, or a single array)."""
+        self._gen = reader
+        self._collate = False
+        return self
+
+    decorate_tensor_provider = decorate_batch_generator
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._gen is None:
+            raise PreconditionNotMetError(
+                "py_reader has no data source: call "
+                "decorate_paddle_reader(generator) first")
+        if self._thread is not None:
+            raise PreconditionNotMetError(
+                "py_reader already started; reset() before restarting")
+        self._queue = _queue.Queue(self._capacity)
+        self._stop_evt.clear()
+        self._exhausted = False
+
+        def fill(gen=self._gen, q=self._queue, stop=self._stop_evt):
+            tail = _STOP
+
+            def put(x):
+                while not stop.is_set():
+                    try:
+                        q.put(x, timeout=0.1)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+            try:
+                for item in gen():
+                    if not put(item):
+                        return
+            except BaseException as e:   # surfaces in read(), not a
+                tail = ("__pyreader_error__", e)   # silent epoch end
+            put(tail)
+        self._thread = threading.Thread(target=fill, daemon=True)
+        self._thread.start()
+        return self
+
+    def reset(self):
+        """Stop the feeding thread and drop queued batches (the
+        reference's post-EOF reset)."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._queue = None
+        self._exhausted = False
+
+    # -- consumption ------------------------------------------------------
+    @staticmethod
+    def _canon_dtype(dt):
+        """np dtype canonicalized the way this build's tensors are
+        (x64 disabled platform-wide: 64-bit types narrow to 32)."""
+        d = np.dtype(dt)
+        narrow = {np.dtype(np.int64): np.dtype(np.int32),
+                  np.dtype(np.uint64): np.dtype(np.uint32),
+                  np.dtype(np.float64): np.dtype(np.float32),
+                  np.dtype(np.complex128): np.dtype(np.complex64)}
+        return narrow.get(d, d)
+
+    def _to_tensors(self, item):
+        if self._collate and isinstance(item, (list, tuple)):
+            # list of per-sample tuples -> field-wise batch arrays
+            if item and isinstance(item[0], (list, tuple)):
+                item = [np.stack([np.asarray(f) for f in field])
+                        for field in zip(*item)]
+            else:                         # single-field sample list
+                item = [np.stack([np.asarray(s) for s in item])]
+        if isinstance(item, (tuple, list)):
+            out = [x if isinstance(x, Tensor) else
+                   to_tensor(np.asarray(x)) for x in item]
+            if self._dtypes and len(self._dtypes) == len(out):
+                fixed = []
+                for t, dt in zip(out, self._dtypes):
+                    want = self._canon_dtype(dt)
+                    if np.dtype(str(t.dtype)) != want:
+                        t = to_tensor(np.asarray(t.numpy(), dtype=want))
+                    fixed.append(t)
+                out = fixed
+            return out
+        return [item if isinstance(item, Tensor)
+                else to_tensor(np.asarray(item))]
+
+    def read(self):
+        """Pop one batch (the read_file op); EOFException at epoch
+        end (and on every further read until reset())."""
+        if self._queue is None:
+            raise PreconditionNotMetError(
+                "py_reader not started: call start() (or iterate the "
+                "reader, which starts it)")
+        if self._exhausted:
+            raise EOFException(
+                "py_reader epoch already ended — reset() then start() "
+                "for the next epoch")
+        item = self._queue.get()
+        if item is _STOP:
+            self._exhausted = True
+            raise EOFException("py_reader epoch ended (reset() then "
+                               "start() for the next epoch)")
+        if (isinstance(item, tuple) and len(item) == 2
+                and isinstance(item[0], str)
+                and item[0] == "__pyreader_error__"):
+            self._exhausted = True
+            raise item[1]   # the decorated generator's own failure
+        return self._to_tensors(item)
+
+    def __iter__(self):
+        if self._queue is None:
+            self.start()
+        while True:
+            try:
+                yield self.read()
+            except EOFException:
+                self.reset()
+                return
+
+    def next(self):
+        return self.read()
+
+    __next__ = next
+
+
+def py_reader(capacity, shapes=None, dtypes=None, lod_levels=None,
+              name=None, use_double_buffer=True):
+    """Reference fluid/layers/io.py:418 — returns the runtime reader
+    (see module docstring for the ported idiom)."""
+    return PyReader(capacity, shapes=shapes, dtypes=dtypes,
+                    lod_levels=lod_levels, name=name,
+                    use_double_buffer=use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Reference fluid/layers/io.py:629 — shapes/dtypes derived from
+    the feed variables."""
+    shapes = [tuple(getattr(v, "shape", ())) for v in (feed_list or [])]
+    dtypes = [str(getattr(v, "dtype", "float32")).replace("paddle.", "")
+              for v in (feed_list or [])]
+    return PyReader(capacity, shapes=shapes, dtypes=dtypes, name=name,
+                    use_double_buffer=use_double_buffer)
+
+
+# -- doc/codegen decorators (layer_function_generator.py) ----------------
+
+def templatedoc(op_type=None):
+    """Fill ``${comment}``-style placeholders in the decorated
+    function's docstring (reference templatedoc minus the OpProto
+    lookup: the comment becomes the function's own first docstring
+    line)."""
+    def deco(fn):
+        doc = fn.__doc__ or ""
+        first = doc.strip().splitlines()[0] if doc.strip() else \
+            (op_type or fn.__name__)
+        fn.__doc__ = doc.replace("${comment}", first)
+        return fn
+    return deco
+
+
+def autodoc(comment=""):
+    """Prefix the decorated function's docstring with ``comment``
+    (reference autodoc's generated-op summary)."""
+    def deco(fn):
+        fn.__doc__ = comment + (fn.__doc__ or "")
+        return fn
+    return deco
+
+
+def _lookup_op(op_name: str):
+    import importlib
+    probes = ("paddle1_tpu.nn.functional", "paddle1_tpu.ops.math_ops",
+              "paddle1_tpu.ops.manip_ops", "paddle1_tpu.fluid.layers")
+    for mod_name in probes:
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, op_name, None)
+        if callable(fn):
+            return fn
+    raise InvalidArgumentError(
+        f"generate_layer_fn: no op named {op_name!r} in the functional "
+        f"registry (searched {', '.join(probes)})")
+
+
+def generate_layer_fn(op_type: str):
+    """Reference generate_layer_fn built a layer fn from the OpProto;
+    here it resolves the SAME name from the modern functional registry
+    (nn.functional / ops / fluid.layers)."""
+    fn = _lookup_op(op_type)
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+        accepts_name = ("name" in params or any(
+            p.kind == p.VAR_KEYWORD for p in params.values()))
+    except (TypeError, ValueError):
+        accepts_name = True
+
+    def layer_fn(*args, **kwargs):
+        if not accepts_name:
+            kwargs.pop("name", None)
+        return fn(*args, **kwargs)
+    layer_fn.__name__ = op_type
+    layer_fn.__doc__ = (fn.__doc__ or
+                        f"Generated wrapper over {fn.__module__}."
+                        f"{op_type}")
+    return layer_fn
+
+
+def generate_activation_fn(op_type: str):
+    """Activation variant: unary, resolved from nn.functional."""
+    from ..nn import functional as F
+    fn = getattr(F, op_type, None)
+    if fn is None:
+        fn = _lookup_op(op_type)
+
+    def act_fn(x, name=None):
+        return fn(x)
+    act_fn.__name__ = op_type
+    act_fn.__doc__ = fn.__doc__ or f"Generated activation {op_type}."
+    return act_fn
+
+
+def generate_inplace_fn(inplace_op_type: str):
+    """The reference's ``relu_``-style in-place twins: functional
+    arrays are immutable here, so the generated fn computes
+    out-of-place and writes the result back into the input Tensor's
+    buffer — the observable contract (input holds the result) is
+    preserved."""
+    base = inplace_op_type.rstrip("_")
+    fn = generate_activation_fn(base)
+
+    def inplace_fn(x, name=None):
+        out = fn(x)
+        if isinstance(x, Tensor):
+            x._data = out.data if isinstance(out, Tensor) else out
+            return x
+        return out
+    inplace_fn.__name__ = inplace_op_type
+    inplace_fn.__doc__ = (f"In-place spelling of {base} (functional "
+                          "write-back; see generate_inplace_fn)")
+    return inplace_fn

@@ -49,7 +49,7 @@ class TestNamespaceSweep:
                 teaching.append(n)
                 assert n in str(e), f"teaching error must name {n}"
         assert len(ref) >= 300            # surface didn't shrink
-        assert len(mapped) >= 290, (len(mapped),
+        assert len(mapped) >= 300, (len(mapped),
                                     "r5 mapping floor regressed")
         # the tier-2 groups are all mapped
         for n in """elementwise_max logical_and reduce_prod ones eye
@@ -70,9 +70,11 @@ class TestNamespaceSweep:
             assert n in mapped, n
 
     def test_still_teaching_by_design(self):
-        """Program-construction APIs stay loud teaching errors."""
+        """Block-based program-construction APIs stay loud teaching
+        errors (py_reader became a real queue-backed reader in r5 —
+        tests/test_fluid_reader.py)."""
         for n in ("StaticRNN", "DynamicRNN", "While", "Switch",
-                  "py_reader"):
+                  "IfElse"):
             with pytest.raises(AttributeError):
                 getattr(L, n)
 
