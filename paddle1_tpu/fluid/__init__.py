@@ -66,26 +66,31 @@ save = _save
 load = _load
 
 
-class _Scope:
-    def var(self, name):
-        raise AttributeError(
-            "fluid.global_scope().var: variables live in Layer state "
-            "dicts now — use layer.state_dict() / paddle.save")
-
+# the REAL scope tree (r5): static's Scope sees every live named
+# parameter/persistable buffer, so the reference idiom
+# fluid.global_scope().find_var('linear_0.weight').get_tensor()
+# reads and writes the actual model state. Lazy delegation: fluid is
+# (re)imported while ..static is still executing its own module body.
 
 def global_scope():
-    return _Scope()
+    from ..static import global_scope as _gs
+    return _gs()
 
 
-class scope_guard:
-    def __init__(self, scope):
-        pass
+def scope_guard(scope):
+    from ..static import scope_guard as _sg
+    return _sg(scope)
 
-    def __enter__(self):
-        return self
 
-    def __exit__(self, *a):
-        return False
+def __getattr__(name):
+    # fluid.Scope must be the real CLASS (isinstance/subclass work),
+    # fetched lazily — fluid is (re)imported while ..static is still
+    # executing its module body
+    if name == "Scope":
+        from ..static import Scope
+        return Scope
+    raise AttributeError(f"module 'paddle1_tpu.fluid' has no "
+                         f"attribute {name!r}")
 
 
 def in_dygraph_mode() -> bool:

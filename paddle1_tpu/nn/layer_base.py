@@ -29,6 +29,13 @@ __all__ = ["Layer"]
 
 _global_layer_name_counts: Dict[str, int] = {}
 
+# live registry of named parameters/buffers for the variable-scope
+# surface (static.global_scope().find_var(name) — reference Scope
+# lookup of persistable vars); weak so layers still garbage-collect
+import weakref as _weakref
+_named_variables: "_weakref.WeakValueDictionary" = \
+    _weakref.WeakValueDictionary()
+
 
 def _unique_name(prefix: str) -> str:
     n = _global_layer_name_counts.get(prefix, 0)
@@ -78,6 +85,8 @@ class Layer:
         self._parameters[name] = parameter
         if parameter is not None and parameter.name is None:
             parameter.name = f"{self._full_name}.{name}"
+        if parameter is not None and parameter.name:
+            _named_variables[parameter.name] = parameter
         return parameter
 
     def add_sublayer(self, name: str, sublayer: "Layer"):
@@ -94,6 +103,12 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        elif tensor is not None:
+            # persistable buffers are scope-visible variables in the
+            # reference (BN running stats live in the Scope)
+            if getattr(tensor, "name", None) is None:
+                tensor.name = f"{self._full_name}.{name}"
+            _named_variables[tensor.name] = tensor
         return tensor
 
     def create_parameter(self, shape, attr=None, dtype=None,
@@ -137,6 +152,8 @@ class Layer:
             params[name] = value
             if value.name is None:
                 value.name = f"{self._full_name}.{name}"
+            if value.name:
+                _named_variables[value.name] = value
             return
         if isinstance(value, Layer):
             if layers is None:
@@ -150,6 +167,13 @@ class Layer:
         if buffers is not None and name in buffers:
             if value is None or isinstance(value, Tensor):
                 buffers[name] = value
+                if (value is not None and name not in
+                        self._non_persistable_buffer_names):
+                    # keep the reassigned buffer scope-visible (the
+                    # register_buffer invariant)
+                    if getattr(value, "name", None) is None:
+                        value.name = f"{self._full_name}.{name}"
+                    _named_variables[value.name] = value
                 return
         for d in (params, layers):
             if d is not None and name in d:

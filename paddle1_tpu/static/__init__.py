@@ -18,6 +18,8 @@ from __future__ import annotations
 
 from typing import Callable, List, Sequence
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -398,21 +400,134 @@ class WeightNormParamAttr(_ParamAttr):
         self.dim = dim
 
 
+class _ScopeTensorView:
+    """LoDTensor-style view over a variable's Tensor (reference
+    ``find_var(name).get_tensor()``): ``np.array(view)`` reads,
+    ``view.set(array, place)`` writes back into the framework's live
+    buffer — the reference idiom for surgically reading/patching
+    parameters through the scope."""
+
+    def __init__(self, variable):
+        self._var = variable
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._var._holder.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def set(self, value, place=None):
+        arr = np.asarray(value)
+        if self._var._unset:
+            # first set DEFINES shape and dtype, like LoDTensor.set on
+            # a fresh Variable
+            from ..core.tensor import Tensor as _T
+            self._var._holder = _T(arr.copy())
+            self._var._unset = False
+            return
+        cur = np.asarray(self._var._holder.data)
+        if tuple(arr.shape) != tuple(cur.shape):
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"tensor.set shape {arr.shape} != variable shape "
+                f"{cur.shape}")
+        self._var._holder._data = jnp.asarray(arr.astype(cur.dtype))
+
+    def shape(self):
+        return list(self._var._holder.shape)
+
+    def _dtype(self):
+        return self._var._holder.dtype
+
+
+class _ScopeVariable:
+    """A named slot in a Scope (reference framework::Variable)."""
+
+    def __init__(self, name, holder=None):
+        self.name = name
+        self._holder = holder
+        self._unset = holder is None
+
+    def get_tensor(self):
+        if self._holder is None:
+            # create-on-first-touch like the reference Variable's
+            # GetMutable<LoDTensor>; the first set() defines shape/dtype
+            from ..core.tensor import Tensor as _T
+            self._holder = _T(np.zeros((), np.float32))
+        return _ScopeTensorView(self)
+
+    def set_tensor(self, tensor):
+        self._holder = tensor
+        self._unset = False
+
+
 class Scope:
-    """Variable scope shell (reference core Scope): eager parameters
-    live on Layers; kept for exe.run(scope=...) call sites."""
+    """Variable scope TREE (reference framework/scope.h): ``var``
+    creates in THIS scope, ``find_var`` searches this scope then the
+    ancestors. The GLOBAL root scope (and only it) additionally sees
+    every live named parameter and persistable buffer the framework
+    has created, so
+    ``global_scope().find_var('linear_0.weight').get_tensor()``
+    reads/writes the real model state; a fresh ``Scope()`` is empty
+    and isolated, as ``scope_guard`` users expect."""
 
-    def __init__(self):
+    def __init__(self, parent: "Scope" = None):
         self._vars = {}
+        self._parent = parent
+        self._kids = []
+        self._live_bridge = False   # set only on the global root
 
+    # -- reference surface ----------------------------------------------
     def var(self, name):
-        return self._vars.setdefault(name, None)
+        v = self._vars.get(name)
+        if v is not None:
+            return v
+        if self._live_bridge:
+            live = self._find_live(name)
+            if live is not None:
+                # NOT cached: caching would pin the parameter against
+                # GC (defeating the weak registry) and would go stale
+                # if the layer reassigns the attribute
+                return live
+        v = _ScopeVariable(name)
+        self._vars[name] = v
+        return v
 
     def find_var(self, name):
-        return self._vars.get(name)
+        v = self._vars.get(name)
+        if v is not None:
+            return v
+        if self._live_bridge:
+            live = self._find_live(name)
+            if live is not None:
+                return live
+        if self._parent is not None:
+            return self._parent.find_var(name)
+        return None
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self):
+        names = set(self._vars)
+        if self._live_bridge:
+            from ..nn.layer_base import _named_variables
+            names |= set(_named_variables.keys())
+        return sorted(names)
+
+    # -- the live-model bridge (global root only) ------------------------
+    @staticmethod
+    def _find_live(name):
+        from ..nn.layer_base import _named_variables
+        t = _named_variables.get(name)
+        return _ScopeVariable(name, holder=t) if t is not None else None
 
 
 _global_scope = Scope()
+_global_scope._live_bridge = True
 
 
 def global_scope():

@@ -88,9 +88,72 @@ class TestTeachingErrors:
         with pytest.raises(RuntimeError, match="to_static"):
             fluid.disable_dygraph()
 
-    def test_global_scope_var_teaches(self):
-        with pytest.raises(AttributeError, match="state_dict"):
-            fluid.global_scope().var("w")
+    def test_global_scope_is_real(self):
+        # r5: the scope tree is real — find_var sees live parameters
+        # and get_tensor() reads/writes them (reference scope.h idiom)
+        import numpy as np
+        import paddle1_tpu as paddle
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 2)
+        v = fluid.global_scope().find_var(lin.weight.name)
+        assert v is not None
+        t = v.get_tensor()
+        assert np.array(t).shape == (3, 2)
+        t.set(np.full((3, 2), 2.0, np.float32))
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), 2.0)
+        # persistable buffers (BN stats) are scope-visible too
+        bn = paddle.nn.BatchNorm1D(4)
+        assert fluid.global_scope().find_var(bn._mean.name) is not None
+        # scope TREE: child lookup falls through to the root
+        kid = fluid.global_scope().new_scope()
+        kid.var("local").get_tensor().set(
+            np.float32(1.0).reshape(()))
+        assert kid.find_var(lin.weight.name) is not None
+        assert fluid.global_scope().find_var("local") is None
+        assert "local" in kid.local_var_names()
+        kid2 = kid.new_scope()
+        assert kid2.find_var("local") is not None
+        fluid.global_scope().drop_kids()
+        # shape-mismatched writes are loud
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="shape"):
+            fluid.global_scope().find_var(lin.weight.name) \
+                 .get_tensor().set(np.zeros((5, 5), np.float32))
+
+    def test_scope_guard_switches_global(self):
+        s = fluid.Scope()
+        assert isinstance(s, fluid.Scope)   # the real class, lazily
+        with fluid.scope_guard(s):
+            assert fluid.global_scope() is s
+        assert fluid.global_scope() is not s
+
+    def test_fresh_scope_is_isolated(self):
+        # review finding: only the global ROOT carries the live-model
+        # bridge — a user Scope must be empty (scope_guard isolation)
+        import numpy as np
+        import paddle1_tpu as paddle
+        lin = paddle.nn.Linear(2, 2)
+        s = fluid.Scope()
+        assert s.find_var(lin.weight.name) is None
+        assert s.local_var_names() == []
+        # and a fresh variable's first set() DEFINES shape/dtype
+        # (reference LoDTensor.set on a new Variable)
+        t = s.var("img").get_tensor()
+        t.set(np.ones((3, 4), np.float32))
+        assert np.array(t).shape == (3, 4)
+        # subsequent sets enforce the established shape
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="shape"):
+            t.set(np.ones((2, 2), np.float32))
+
+    def test_root_var_does_not_pin_params(self):
+        # review finding: var() on a live param must not cache a strong
+        # reference (GC pinning / staleness)
+        import paddle1_tpu as paddle
+        lin = paddle.nn.Linear(2, 2)
+        name = lin.weight.name
+        fluid.global_scope().var(name)
+        assert name not in fluid.global_scope()._vars
 
 
 class TestAliases:
