@@ -100,11 +100,21 @@ class SyncBatchNorm(_BatchNormBase):
             return super().forward(x)
         import jax
         from ..autograd.engine import apply
-        ch_axis = 1 if self._data_format == "NCHW" else x.ndim - 1
+        from .functional._layout import channels_last_region
+        # the cross-replica path joins the channels-last region too
+        # (_layout.py): computing channel-last keeps its boundary
+        # transposes adjacent to the neighboring convs' so XLA cancels
+        # them (the stats/elementwise math is layout-agnostic)
+        nhwc_internal, _to_cl, _to_cf = channels_last_region(
+            x.ndim if self._data_format == "NCHW" else 0,
+            self._data_format != "NCHW")
+        ch_axis = (x.ndim - 1 if (self._data_format != "NCHW"
+                                  or nhwc_internal) else 1)
         reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
         eps, mom = self._epsilon, self._momentum
 
         def f(x, w, b):
+            x = _to_cl(x)
             local_sum = jnp.sum(x, axis=reduce_axes)
             local_sqsum = jnp.sum(x * x, axis=reduce_axes)
             count = np.prod([x.shape[i] for i in reduce_axes])
@@ -117,12 +127,20 @@ class SyncBatchNorm(_BatchNormBase):
             shape[ch_axis] = -1
             y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
                 var.reshape(shape) + eps)
-            return y * w.reshape(shape) + b.reshape(shape), mean, var
+            y = y * w.reshape(shape) + b.reshape(shape)
+            return _to_cf(y), mean, var
         y, mean, var = apply("sync_batch_norm", f,
                              (x, self.weight, self.bias), n_outputs=3)
-        self._mean._data = mom * self._mean.data + (1 - mom) * mean.data
-        self._variance._data = mom * self._variance.data + \
-            (1 - mom) * var.data
+        if not isinstance(mean.data, jax.core.Tracer):
+            # eager SPMD only: under jit/shard_map the stats are traced
+            # values — assigning them to the buffer would leak a tracer
+            # into eval-mode forwards and state_dict. Compiled training
+            # tracks buffers functionally (ParallelEngine), matching
+            # the reference's moving-stat handling in graph mode.
+            self._mean._data = (mom * self._mean.data
+                                + (1 - mom) * mean.data)
+            self._variance._data = mom * self._variance.data + \
+                (1 - mom) * var.data
         return y
 
     @classmethod

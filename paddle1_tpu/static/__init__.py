@@ -423,13 +423,14 @@ class _ScopeTensorView:
             self._var._holder = _T(arr.copy())
             self._var._unset = False
             return
-        cur = np.asarray(self._var._holder.data)
-        if tuple(arr.shape) != tuple(cur.shape):
+        cur_shape = tuple(self._var._holder.shape)
+        if tuple(arr.shape) != cur_shape:
             from ..core.errors import InvalidArgumentError
             raise InvalidArgumentError(
                 f"tensor.set shape {arr.shape} != variable shape "
-                f"{cur.shape}")
-        self._var._holder._data = jnp.asarray(arr.astype(cur.dtype))
+                f"{cur_shape}")
+        self._var._holder._data = jnp.asarray(
+            arr.astype(self._var._holder.dtype))
 
     def shape(self):
         return list(self._var._holder.shape)
@@ -441,10 +442,11 @@ class _ScopeTensorView:
 class _ScopeVariable:
     """A named slot in a Scope (reference framework::Variable)."""
 
-    def __init__(self, name, holder=None):
+    def __init__(self, name, holder=None, live=False):
         self.name = name
         self._holder = holder
         self._unset = holder is None
+        self._live = live
 
     def get_tensor(self):
         if self._holder is None:
@@ -455,6 +457,14 @@ class _ScopeVariable:
         return _ScopeTensorView(self)
 
     def set_tensor(self, tensor):
+        if self._live:
+            # live-bridge wrappers are fresh per lookup; rebinding the
+            # wrapper would silently vanish — write the VALUE through
+            # into the framework's live buffer instead
+            self.get_tensor().set(
+                tensor.numpy() if hasattr(tensor, "numpy")
+                else np.asarray(tensor))
+            return
         self._holder = tensor
         self._unset = False
 
@@ -477,28 +487,30 @@ class Scope:
 
     # -- reference surface ----------------------------------------------
     def var(self, name):
-        v = self._vars.get(name)
-        if v is not None:
-            return v
         if self._live_bridge:
+            # live model state takes precedence over local placeholders
+            # (a var() touched before the parameter existed must not
+            # shadow the real parameter afterwards). NOT cached: caching
+            # would pin the parameter against GC (defeating the weak
+            # registry) and would go stale if the layer reassigns the
+            # attribute.
             live = self._find_live(name)
             if live is not None:
-                # NOT cached: caching would pin the parameter against
-                # GC (defeating the weak registry) and would go stale
-                # if the layer reassigns the attribute
                 return live
-        v = _ScopeVariable(name)
-        self._vars[name] = v
+        v = self._vars.get(name)
+        if v is None:
+            v = _ScopeVariable(name)
+            self._vars[name] = v
         return v
 
     def find_var(self, name):
-        v = self._vars.get(name)
-        if v is not None:
-            return v
         if self._live_bridge:
             live = self._find_live(name)
             if live is not None:
                 return live
+        v = self._vars.get(name)
+        if v is not None:
+            return v
         if self._parent is not None:
             return self._parent.find_var(name)
         return None
@@ -523,7 +535,8 @@ class Scope:
     def _find_live(name):
         from ..nn.layer_base import _named_variables
         t = _named_variables.get(name)
-        return _ScopeVariable(name, holder=t) if t is not None else None
+        return (_ScopeVariable(name, holder=t, live=True)
+                if t is not None else None)
 
 
 _global_scope = Scope()

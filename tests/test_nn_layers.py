@@ -635,3 +635,54 @@ class TestConvNHWCInternal(OpTest):
         assert len(g1) == len(g2) and len(g1) > 0
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestSyncBatchNorm(OpTest):
+    """Cross-replica BN (reference sync_batch_norm_op): stats psum'd
+    over dp must equal GLOBAL-batch BN, in both layouts of the
+    channels-last region (r5)."""
+
+    def _run(self, conv_nhwc):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle1_tpu.core.flags import flags_guard
+        from paddle1_tpu.core.tensor import Tensor
+        from paddle1_tpu.distributed.env import spmd_axes
+
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.asarray(devs), ("data",))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 3, 4, 4)).astype(np.float32) * 2 + 1
+
+        paddle.seed(0)
+        sbn = nn.SyncBatchNorm(3)
+        w = sbn.weight.data
+        b = sbn.bias.data
+
+        def shard_fn(xs, w, b):
+            with spmd_axes(dp="data"), flags_guard(conv_nhwc=conv_nhwc):
+                y, = (sbn(Tensor(xs)).data,)
+            return y
+
+        y = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("data"), P(), P()),
+            out_specs=P("data")))(jnp.asarray(x), w, b)
+
+        # global-batch reference
+        mean = x.mean(axis=(0, 2, 3), keepdims=True)
+        var = x.var(axis=(0, 2, 3), keepdims=True)
+        want = (x - mean) / np.sqrt(var + sbn._epsilon)
+        want = want * np.asarray(w).reshape(1, -1, 1, 1) + \
+            np.asarray(b).reshape(1, -1, 1, 1)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_matches_global_bn_nchw_path(self):
+        self._run("never")
+
+    def test_matches_global_bn_channels_last_region(self):
+        self._run("always")
