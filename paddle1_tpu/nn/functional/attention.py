@@ -76,7 +76,15 @@ def use_flash_for(q, k) -> bool:
     bytes_per = 2 * jnp.dtype(q.dtype).itemsize + 8
     score_mb = (q.shape[0] * q.shape[2] * q.shape[1] * k.shape[1]
                 * bytes_per) / (1 << 20)
-    return score_mb >= float(flag("flash_auto_score_mb"))
+    threshold = float(flag("flash_auto_score_mb"))
+    if not (isinstance(q, jax.core.Tracer)
+            or isinstance(k, jax.core.Tracer)):
+        # EAGER execution: the dense measurements behind the large
+        # default threshold relied on XLA fusing the whole attention
+        # under jit — op-by-op eager really does materialize the score
+        # tensor, so cap the eager threshold at 1 GiB of transient
+        threshold = min(threshold, 1024.0)
+    return score_mb >= threshold
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -76,7 +76,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
     y, mean, var = apply("batch_norm_train", f, args, n_outputs=3)
-    if running_mean is not None:
+    if running_mean is not None and not isinstance(mean.data,
+                                                  jax.core.Tracer):
+        # eager only: under jit/shard_map the batch stats are traced
+        # values — assigning them into the buffer would leak a tracer
+        # (eval forward / state_dict would then fail). Compiled
+        # training uses the static buffers; refresh running stats with
+        # an eager pass when eval-mode stats are needed.
         rm = _t(running_mean)
         rv = _t(running_var)
         rm._data = momentum * rm.data + (1 - momentum) * mean.data

@@ -115,28 +115,35 @@ class SyncBatchNorm(_BatchNormBase):
 
         def f(x, w, b):
             x = _to_cl(x)
-            local_sum = jnp.sum(x, axis=reduce_axes)
-            local_sqsum = jnp.sum(x * x, axis=reduce_axes)
+            # stats in f32 regardless of compute dtype (reference
+            # sync_batch_norm_op): a bf16 element count is inexact
+            # past 256 and E[x^2]-mean^2 cancels catastrophically
+            xf = x.astype(jnp.float32)
+            local_sum = jnp.sum(xf, axis=reduce_axes)
+            local_sqsum = jnp.sum(xf * xf, axis=reduce_axes)
             count = np.prod([x.shape[i] for i in reduce_axes])
             g_sum = jax.lax.psum(local_sum, axis)
             g_sqsum = jax.lax.psum(local_sqsum, axis)
-            g_count = jax.lax.psum(jnp.asarray(count, x.dtype), axis)
+            g_count = jax.lax.psum(jnp.asarray(count, jnp.float32),
+                                   axis)
             mean = g_sum / g_count
-            var = g_sqsum / g_count - mean * mean
+            var = jnp.maximum(g_sqsum / g_count - mean * mean, 0.0)
             shape = [1] * x.ndim
             shape[ch_axis] = -1
-            y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+            y = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
                 var.reshape(shape) + eps)
-            y = y * w.reshape(shape) + b.reshape(shape)
-            return _to_cf(y), mean, var
+            y = (y * w.reshape(shape).astype(jnp.float32)
+                 + b.reshape(shape).astype(jnp.float32))
+            return _to_cf(y.astype(x.dtype)), mean, var
         y, mean, var = apply("sync_batch_norm", f,
                              (x, self.weight, self.bias), n_outputs=3)
         if not isinstance(mean.data, jax.core.Tracer):
             # eager SPMD only: under jit/shard_map the stats are traced
             # values — assigning them to the buffer would leak a tracer
             # into eval-mode forwards and state_dict. Compiled training
-            # tracks buffers functionally (ParallelEngine), matching
-            # the reference's moving-stat handling in graph mode.
+            # keeps the buffers static; refresh running stats with an
+            # eager pass (or use_global_stats) when eval-mode stats are
+            # needed after jitted training.
             self._mean._data = (mom * self._mean.data
                                 + (1 - mom) * mean.data)
             self._variance._data = mom * self._variance.data + \
