@@ -293,11 +293,14 @@ def bench_yolov3_infer(on_tpu):
     dt = statistics.median(times)
 
     img_size = np.tile([[img, img]], (batch, 1)).astype(np.int32)  # [B,2]
-    t0 = time.perf_counter()
     with ag.no_grad():
+        # warm pass first: deploy-time serving is steady-state, and the
+        # eager decode/NMS ops compile per shape on first touch
+        model.postprocess([Tensor(o) for o in outs], Tensor(img_size))
+        t0 = time.perf_counter()
         results = model.postprocess([Tensor(o) for o in outs],
                                     Tensor(img_size))
-    post_ms = (time.perf_counter() - t0) * 1e3
+        post_ms = (time.perf_counter() - t0) * 1e3
 
     _emit("yolov3_infer_images_per_sec", batch / dt, "images/s", 1.0,
           {"batch": batch, "img": img,
