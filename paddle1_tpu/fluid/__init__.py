@@ -28,11 +28,11 @@ from ..core.tensor import Tensor, to_tensor
 from ..framework.io import load as _load, save as _save
 from ..static import (Executor, Program, default_main_program,
                       default_startup_program)
-from . import (dygraph, initializer, layers, optimizer, regularizer,
-               transpiler)
+from . import (dygraph, initializer, io, layers, optimizer,
+               regularizer, transpiler)
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
-__all__ = ["layers", "dygraph", "optimizer", "initializer", "regularizer",
+__all__ = ["layers", "dygraph", "io", "optimizer", "initializer", "regularizer",
            "Executor", "Program", "CPUPlace", "CUDAPlace", "TPUPlace",
            "default_main_program", "default_startup_program",
            "data", "embedding", "save", "load", "global_scope",

@@ -1,0 +1,286 @@
+"""fluid.io — the pre-2.0 persistence + feeding surface (reference
+python/paddle/fluid/io.py).
+
+The reference walked the ProgramDesc for parameter/persistable vars and
+serialized them through the executor; here the live named-variable
+registry (the same one backing the real variable scope —
+static.global_scope) IS the set of parameters and persistable buffers,
+so the classic exe-first signatures work against real model state:
+
+    fluid.io.save_persistables(exe, "ckpt/")
+    ...
+    fluid.io.load_persistables(exe, "ckpt/")
+
+Readers: ``fluid.io.PyReader`` is the queue-backed reader
+(fluid/reader.py), ``fluid.io.DataLoader.from_generator`` wraps it with
+the 2.0-style spelling, and ``batch`` is the classic sample-batching
+decorator.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError, NotFoundError
+from ..core.tensor import Tensor
+from .reader import PyReader
+
+__all__ = ["is_parameter", "is_persistable", "save_vars", "save_params",
+           "save_persistables", "load_vars", "load_params",
+           "load_persistables", "save_inference_model",
+           "load_inference_model", "get_parameter_value",
+           "get_parameter_value_by_name", "PyReader", "DataLoader",
+           "batch"]
+
+_FILE = "__persistables__"
+
+
+def is_parameter(var) -> bool:
+    """Trainable parameter test (reference io.py:74 checked the
+    ProgramDesc var type; here: a Parameter / trainable Tensor)."""
+    from ..nn.layer_base import Parameter
+    if isinstance(var, Parameter):
+        return True
+    return isinstance(var, Tensor) and not var.stop_gradient
+
+
+def is_persistable(var) -> bool:
+    """Persistable test (reference io.py:98): parameters and named
+    persistable buffers qualify."""
+    if is_parameter(var):
+        return True
+    return bool(getattr(var, "persistable", False)) or (
+        isinstance(var, Tensor) and getattr(var, "name", None)
+        is not None)
+
+
+def _registry(main_program=None):
+    """The variable universe: the whole live registry, or — when
+    ``main_program`` is a Layer — just that model's named parameters
+    and persistable buffers (the reference scoped saves to the given
+    program's vars)."""
+    from ..nn.layer_base import Layer, _named_variables
+    if isinstance(main_program, Layer):
+        out = {}
+        for _, p in main_program.named_parameters():
+            if getattr(p, "name", None):
+                out[p.name] = p
+        # persistable buffers only (mirror state_dict's filter):
+        # named_buffers() yields non-persistable ones too
+        for lay in main_program.sublayers(include_self=True):
+            skip = lay._non_persistable_buffer_names
+            for bname, b in lay._buffers.items():
+                if (b is not None and bname not in skip
+                        and getattr(b, "name", None)):
+                    out[b.name] = b
+        return out
+    return {name: t for name, t in list(_named_variables.items())}
+
+
+def _select(vars=None, predicate: Optional[Callable] = None,
+            params_only: bool = False, main_program=None):
+    if vars is not None:
+        out = {}
+        reg = _registry(main_program)
+        for v in vars:
+            if isinstance(v, str):
+                t = reg.get(v)
+                if t is None:
+                    raise NotFoundError(
+                        f"save/load_vars: no live variable named {v!r}")
+                out[v] = t
+            elif isinstance(v, Tensor) and getattr(v, "name", None):
+                out[v.name] = v
+            else:
+                raise InvalidArgumentError(
+                    "save/load_vars expects names or named Tensors, "
+                    f"got {type(v).__name__}")
+        return out
+    reg = _registry(main_program)
+    if params_only:
+        reg = {k: t for k, t in reg.items() if is_parameter(t)}
+    if predicate is not None:
+        reg = {k: t for k, t in reg.items() if predicate(t)}
+    return reg
+
+
+def _write(dirname, filename, tensors):
+    os.makedirs(dirname, exist_ok=True)
+    payload = {k: np.asarray(t.numpy()) for k, t in tensors.items()}
+    with open(os.path.join(dirname, filename or _FILE), "wb") as f:
+        pickle.dump(payload, f)
+
+
+def _read(dirname, filename):
+    path = os.path.join(dirname, filename or _FILE)
+    if not os.path.exists(path):
+        raise NotFoundError(
+            f"load: {path} does not exist (saved with a different "
+            "filename= ?)")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Reference io.py:239 — serialize selected variables."""
+    _write(dirname, filename, _select(vars, predicate,
+                                      main_program=main_program))
+
+
+def save_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    """Reference io.py:390 — trainable parameters only."""
+    _write(dirname, filename, _select(params_only=True,
+                                      main_program=main_program))
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Reference io.py:621 — parameters + persistable buffers (the
+    whole live registry)."""
+    _write(dirname, filename, _select(main_program=main_program))
+
+
+def _restore(payload, strict_shapes=True):
+    import jax.numpy as jnp
+    reg = _registry()
+    missing = []
+    for name, arr in payload.items():
+        t = reg.get(name)
+        if t is None:
+            missing.append(name)
+            continue
+        if strict_shapes and tuple(arr.shape) != tuple(t.shape):
+            raise InvalidArgumentError(
+                f"load: saved {name} has shape {tuple(arr.shape)} but "
+                f"the live variable is {tuple(t.shape)}")
+        # preserve the LIVE dtype (a checkpoint from an amp-cast run
+        # must not silently narrow a float32 model)
+        t._data = jnp.asarray(np.asarray(arr).astype(
+            np.dtype(str(t.dtype))))
+    if missing:
+        raise NotFoundError(
+            "load: no live variables named "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''} — build "
+            "the model (same architecture/naming) before loading")
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    payload = _read(dirname, filename)
+    if vars is not None:
+        want = set(_select(vars, main_program=main_program))
+        absent = sorted(want - set(payload))
+        if absent:
+            raise NotFoundError(
+                f"load_vars: {absent[:5]} not in the saved file "
+                "(reference load_vars errors on missing var files too)")
+        payload = {k: v for k, v in payload.items() if k in want}
+    _restore(payload)
+
+
+def load_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    payload = _read(dirname, filename)
+    live_params = set(_select(params_only=True,
+                              main_program=main_program))
+    hit = {k: v for k, v in payload.items() if k in live_params}
+    if not hit:
+        raise NotFoundError(
+            "load_params: the saved file shares no parameter names "
+            "with the live model (saved from a differently-built "
+            "model?)")
+    _restore(hit)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    _restore(_read(dirname, filename))
+
+
+def save_inference_model(dirname, feeded_var_names=None,
+                         target_vars=None, executor=None,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False, *, fn=None,
+                         input_spec=None):
+    """Reference io.py:1199. The deployable artifact here is the
+    jit.save StableHLO bundle: pass the Layer/callable as ``fn=`` (or
+    ``main_program=``) with its ``input_spec=``."""
+    from .. import jit
+    target = fn if fn is not None else main_program
+    if target is None or not (callable(target)
+                              or hasattr(target, "forward")):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            "save_inference_model needs the model as a Layer/callable "
+            "(fn= or main_program=) plus input_spec= — the ProgramDesc "
+            "the reference serialized is a traced StableHLO bundle "
+            "here (paddle1_tpu.jit.save)")
+    return jit.save(target, dirname, input_spec=input_spec)
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None):
+    """Returns (layer, feed_names, fetch_names) like the reference —
+    the traced layer is directly callable."""
+    from .. import jit
+    return jit.load(dirname), [], []
+
+
+def get_parameter_value(para, executor=None):
+    """Reference io.py:1566 — the parameter's value as numpy."""
+    return np.asarray(para.numpy())
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    t = _registry().get(name)
+    if t is None:
+        raise NotFoundError(f"no live parameter named {name!r}")
+    return np.asarray(t.numpy())
+
+
+class DataLoader:
+    """The 2.0-style spellings over the queue-backed reader (reference
+    fluid/reader.py DataLoader.from_generator/from_dataset)."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64,
+                       use_double_buffer=True, iterable=True,
+                       return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        shapes = [tuple(getattr(v, "shape", ())) for v in
+                  (feed_list or [])] or None
+        dtypes = [str(getattr(v, "dtype", "float32"))
+                  .replace("paddle.", "") for v in (feed_list or [])] \
+            or None
+        return PyReader(capacity, shapes=shapes, dtypes=dtypes,
+                        use_double_buffer=use_double_buffer,
+                        iterable=iterable)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        from ..io import DataLoader as _DL
+        return _DL(dataset, drop_last=drop_last)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """The classic sample-batching decorator (reference
+    paddle.batch / fluid.io.batch): ``reader`` yields SAMPLES; the
+    result yields LISTS of ``batch_size`` samples — exactly what
+    ``PyReader.decorate_sample_list_generator`` consumes."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
