@@ -148,9 +148,29 @@ def _timed_steps(step_fn, n_steps):
 
 
 def _emit(metric, value, unit, vs_baseline, detail):
-    print(json.dumps({"metric": metric, "value": round(value, 2),
-                      "unit": unit, "vs_baseline": round(vs_baseline, 4),
-                      "detail": detail}))
+    rec = {"metric": metric, "value": round(value, 2),
+           "unit": unit, "vs_baseline": round(vs_baseline, 4),
+           "detail": detail}
+    print(json.dumps(rec))
+    return rec
+
+
+_RESULT_KEYS = ("metric", "value", "unit", "vs_baseline", "detail")
+
+
+def parse_result_line(line):
+    """Parse one bench JSON result line back into a dict, validating the
+    schema the driver (and the tier-1 harness test) rely on. Raises
+    ValueError on anything that is not a well-formed result line."""
+    rec = json.loads(line)
+    if not isinstance(rec, dict):
+        raise ValueError(f"bench line is not an object: {line!r}")
+    missing = [k for k in _RESULT_KEYS if k not in rec]
+    if missing:
+        raise ValueError(f"bench line missing keys {missing}: {line!r}")
+    if not isinstance(rec["detail"], dict):
+        raise ValueError("bench detail must be an object")
+    return rec
 
 
 def _assert_sane_mfu(mfu, detail, step_fn=None):
@@ -174,7 +194,7 @@ def _assert_sane_mfu(mfu, detail, step_fn=None):
 
 
 def bench_bert_base(on_tpu, batch_override=None, seq_override=None,
-                    steps_override=None):
+                    steps_override=None, steps_per_dispatch=1):
     import jax
     import paddle1_tpu as paddle
     from paddle1_tpu.distributed import ParallelEngine, build_mesh
@@ -209,12 +229,20 @@ def bench_bert_base(on_tpu, batch_override=None, seq_override=None,
          "mlm": rng.integers(0, v, (batch, seq)).astype(np.int32),
          "nsp": rng.integers(0, 2, (batch,)).astype(np.int32)}
 
-    _read_back(engine.step(b))  # warmup (compile) flushed to completion
+    k = max(int(steps_per_dispatch), 1)
+    if k > 1:
+        # device-resident multi-step: k optimizer steps per dispatch via
+        # ONE lax.scan executable — the per-step dispatch+readback cost
+        # this axis exists to measure away
+        step_fn = lambda: engine.step_many([b] * k)
+    else:
+        step_fn = lambda: engine.step(b)
+    _read_back(step_fn())  # warmup (compile) flushed to completion
 
     n_steps = (20 if on_tpu else 3) if steps_override is None \
         else steps_override
-    times, loss = _timed_steps(lambda: engine.step(b), n_steps)
-    dt = statistics.median(times)
+    times, loss = _timed_steps(step_fn, n_steps)
+    dt = statistics.median(times) / k  # slope is per DISPATCH; k steps each
 
     sps = batch / dt
     # FLOPs: 6 * matmul-params * tokens (fwd+bwd dense) + attention
@@ -233,15 +261,20 @@ def bench_bert_base(on_tpu, batch_override=None, seq_override=None,
     detail = {"batch": batch, "seq_len": seq, "steps": n_steps,
               "params": n_params, "mfu": round(mfu, 4),
               "step_ms_median": round(dt * 1e3, 2),   # median slope, 3 trials
-              "step_ms_min": round(min(times) * 1e3, 2),
-              "step_ms_max": round(max(times) * 1e3, 2),
+              "step_ms_min": round(min(times) / k * 1e3, 2),
+              "step_ms_max": round(max(times) / k * 1e3, 2),
               "timing": "slope+readback",
               "amp": "bfloat16" if on_tpu else "none",
               "peak_flops": _peak_flops(dev),
               "device": getattr(dev, "device_kind", dev.platform),
-              "loss": float(loss)}
-    _assert_sane_mfu(mfu, detail,
-                     step_fn=lambda: engine.step(b))
+              # optimizer steps completed per host readback barrier: k
+              # steps per dispatch times the n_steps dispatches between
+              # the slope-timing readbacks
+              "steps_per_dispatch": k,
+              "steps_per_readback": k * n_steps,
+              "compile_cache": engine.cache_stats(),
+              "loss": float(np.ravel(np.asarray(loss))[-1])}
+    _assert_sane_mfu(mfu, detail, step_fn=step_fn)
     _emit("bert_base_pretrain_samples_per_sec_per_chip", sps, "samples/s",
           mfu / 0.40, detail)
 
@@ -259,6 +292,10 @@ def main():
                     help="override the config's batch (MFU sweeps)")
     ap.add_argument("--seq", type=_pos, default=None)
     ap.add_argument("--steps", type=_pos, default=None)
+    ap.add_argument("--steps-per-dispatch", type=_pos, default=1,
+                    help="fuse k train steps into one executable "
+                         "(engine.step_many) — measures the multi-step "
+                         "amortization of dispatch + readback")
     args = ap.parse_args()
 
     if not _probe_tpu():
@@ -276,7 +313,8 @@ def main():
     if args.config == "bert_base":
         bench_bert_base(on_tpu, batch_override=args.batch,
                         seq_override=args.seq,
-                        steps_override=args.steps)
+                        steps_override=args.steps,
+                        steps_per_dispatch=args.steps_per_dispatch)
     else:
         from benches import run_config  # configs 1/2/4/5
         run_config(args.config, on_tpu, batch=args.batch)

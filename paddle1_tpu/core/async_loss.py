@@ -1,0 +1,182 @@
+"""Lazy loss handles — defer the device→host readback until the value is
+actually formatted.
+
+The bench honesty contract (bench.py header) measured ~70 ms per
+device→host round trip through the axon tunnel; a training loop that
+calls ``float(loss.item())`` every batch is therefore bounded by the
+host, not by XLA. :class:`LossFuture` keeps the loss as a device array
+and only fetches it to host memory when someone *reads* it — ``float()``,
+``.item()``, ``np.asarray`` (``__array__``), or string formatting. Until
+then the only cost is the handle itself; XLA's async dispatch runs ahead.
+
+``block()`` is the cheap synchronization point: it waits for the device
+computation WITHOUT copying the value to host (no readback). The engine
+and ``hapi.Model.fit`` use it to bound the in-flight dispatch window.
+
+A module-level readback counter is the test hook for the "no per-batch
+readback" acceptance criterion: every actual device→host materialization
+increments it exactly once per handle (the fetched value is cached).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["LossFuture", "readback_count", "reset_readback_count"]
+
+_lock = threading.Lock()
+_readbacks = 0
+
+
+def readback_count() -> int:
+    """Total LossFuture device→host materializations (test hook)."""
+    return _readbacks
+
+
+def reset_readback_count() -> None:
+    global _readbacks
+    with _lock:
+        _readbacks = 0
+
+
+def _count_readback() -> None:
+    global _readbacks
+    with _lock:
+        _readbacks += 1
+
+
+class LossFuture:
+    """A loss value still living on device. Reads materialize it.
+
+    Wraps a jax array (or Tensor); scalar losses behave like a float
+    wherever one is expected (``float()``, ``f"{loss:.4f}"``, numpy
+    coercion). ``step_many`` returns one future over the whole ``[k]``
+    loss vector — ``np.asarray(fut)`` yields the k losses in one
+    readback.
+    """
+
+    __slots__ = ("_arr", "_result")
+
+    def __init__(self, value: Any):
+        # Tensor → its backing array; plain floats/np pass through and
+        # materialize for free.
+        self._arr = value.data if hasattr(value, "data") else value
+        self._result: Optional[np.ndarray] = None
+
+    # -- device-side ------------------------------------------------------
+
+    @property
+    def data(self):
+        """The underlying (device) array — no readback."""
+        return self._arr
+
+    def block(self) -> "LossFuture":
+        """Wait for the device computation to finish WITHOUT fetching the
+        value to host (bounds in-flight dispatch; not a readback)."""
+        if self._result is None:
+            try:
+                import jax
+                jax.block_until_ready(self._arr)
+            except (ImportError, TypeError):
+                pass
+        return self
+
+    @property
+    def materialized(self) -> bool:
+        return self._result is not None
+
+    # -- host-side reads (each handle reads back at most once) -------------
+
+    def numpy(self) -> np.ndarray:
+        if self._result is None:
+            self._result = np.asarray(self._arr)
+            _count_readback()
+        return self._result
+
+    def item(self) -> float:
+        return float(np.ravel(self.numpy())[0]) if self.numpy().size == 1 \
+            else self.numpy().item()
+
+    def __float__(self) -> float:
+        return self.item()
+
+    def __int__(self) -> int:
+        return int(self.item())
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # Minimal numeric protocol so code written against the old float
+    # returns (`if loss < best:`, `total += loss`, `min(losses)`) keeps
+    # working — each coerces through item()/numpy(), i.e. materializes.
+
+    def __lt__(self, other):
+        return self.item() < other
+
+    def __le__(self, other):
+        return self.item() <= other
+
+    def __gt__(self, other):
+        return self.item() > other
+
+    def __ge__(self, other):
+        return self.item() >= other
+
+    def __eq__(self, other):
+        if isinstance(other, LossFuture):
+            other = other.item()
+        return self.item() == other
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    __hash__ = object.__hash__
+
+    def __add__(self, other):
+        return self.item() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.item() - other
+
+    def __rsub__(self, other):
+        return other - self.item()
+
+    def __mul__(self, other):
+        return self.item() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.item() / other
+
+    def __rtruediv__(self, other):
+        return other / self.item()
+
+    def __neg__(self):
+        return -self.item()
+
+    def __abs__(self):
+        return abs(self.item())
+
+    def __format__(self, spec: str) -> str:
+        a = self.numpy()
+        if a.size == 1:
+            return format(float(np.ravel(a)[0]), spec)
+        return format(a, spec)
+
+    def __repr__(self) -> str:
+        if self._result is not None:
+            return f"LossFuture({self._result!r})"
+        return "LossFuture(<pending on device>)"
+
+    def __len__(self):
+        return len(self.numpy())
+
+    def __iter__(self):
+        return iter(self.numpy())

@@ -21,7 +21,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .errors import InvalidArgumentError
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flags_guard"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flags_guard",
+           "maybe_enable_compilation_cache"]
 
 
 @dataclass
@@ -120,6 +121,50 @@ def conv_nhwc_active() -> bool:
     return flag_active("conv_nhwc")
 
 
+_compilation_cache_wired = False
+
+
+def maybe_enable_compilation_cache() -> bool:
+    """Wire the jax persistent compilation cache from the ``jit_cache_dir``
+    flag (idempotent; returns True when the cache was enabled by THIS
+    call). Called from ParallelEngine.__init__ so every compiled trainer
+    picks it up without user code; safe no-op when the flag is empty or
+    the jax build lacks the config knobs."""
+    global _compilation_cache_wired
+    with _lock:
+        if _compilation_cache_wired:
+            return False
+        cache_dir = flag("jit_cache_dir")
+        if not cache_dir:
+            # don't latch: the flag may be set later (set_flags between
+            # engine constructions must still wire the cache)
+            return False
+        _compilation_cache_wired = True
+    import warnings
+
+    import jax
+    try:
+        cache_dir = os.path.expanduser(cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(flag("jit_cache_min_compile_time_s")))
+        except AttributeError:
+            pass  # older jax: only the dir knob exists
+        try:
+            # also cache CPU executables (tests / the virtual mesh); TPU
+            # and GPU are cached by default once the dir is set
+            jax.config.update("jax_persistent_cache_enable_xla_caches",
+                              "all")
+        except AttributeError:
+            pass
+        return True
+    except Exception as e:  # never let cache plumbing break training
+        warnings.warn(f"persistent compilation cache disabled: {e}")
+        return False
+
+
 class flags_guard:
     """Context manager that temporarily overrides flags (test helper)."""
 
@@ -167,6 +212,23 @@ def _define_builtin_flags() -> None:
     # JIT
     define_flag("jit_donate_params", True,
                 "Donate parameter buffers in compiled training steps.")
+    define_flag("jit_cache_dir", "",
+                "Persistent XLA compilation-cache directory (wired into "
+                "jax.config by maybe_enable_compilation_cache, called "
+                "from ParallelEngine init). Empty disables. Amortizes "
+                "the multi-minute BERT-scale compiles across processes "
+                "— the dispatch-side half of the multi-step training "
+                "story (the per-step half is engine.step_many).")
+    define_flag("jit_cache_min_compile_time_s", 1.0,
+                "Only persist executables whose compile took at least "
+                "this many seconds (tiny kernels are cheaper to rebuild "
+                "than to deserialize).",
+                validator=lambda v: v >= 0)
+    define_flag("jit_retrace_warn", True,
+                "Warn (once per engine) when ParallelEngine.step/"
+                "step_many retraces because a batch arrived with a new "
+                "shape signature — each retrace is a full XLA recompile "
+                "that silently re-serializes the host loop.")
     define_flag("dy2static", True,
                 "Rewrite tensor-dependent Python control flow (if/while/"
                 "for-range, and/or/not) into lax.cond/while_loop under "

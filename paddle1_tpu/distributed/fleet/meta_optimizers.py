@@ -97,7 +97,9 @@ def compile_strategy(strategy: DistributedStrategy,
             "grad_accum": grad_accum,
             "amp_dtype": amp_dtype,
             "pp_microbatches": pp_microbatches,
-            "recompute": bool(conf.get("recompute"))}
+            "recompute": bool(conf.get("recompute")),
+            "train_steps_per_sync": max(
+                int(conf.get("train_steps_per_sync", 1)), 1)}
 
 
 def apply_optimizer_meta(optimizer, strategy: DistributedStrategy):

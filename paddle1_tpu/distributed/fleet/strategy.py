@@ -55,6 +55,11 @@ _DEFAULTS: Dict[str, Any] = {
     # --- gradient merge / accumulation ---
     "gradient_merge": False,
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    # --- device-resident multi-step training (TPU-native addition):
+    #     fuse k optimizer steps into ONE jitted executable
+    #     (ParallelEngine.step_many / step_stream) — k dispatches and k
+    #     loss readbacks collapse to one of each ---
+    "train_steps_per_sync": 1,
     # --- localsgd ---
     "localsgd": False,
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},

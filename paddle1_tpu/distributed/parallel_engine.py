@@ -17,10 +17,23 @@ Usage::
     for batch in loader:
         loss = engine.step(batch)      # one fused XLA executable
     engine.sync_model()                # write params back into the Layer
+
+Multi-step (device-resident) training: every ``step`` call pays one
+dispatch through the host→device tunnel (~70 ms through the axon tunnel
+per the bench honesty contract), and every eager ``float(loss)`` pays a
+device→host readback. ``step_many`` amortizes both: k optimizer steps
+run inside ONE jitted executable via ``lax.scan`` (one dispatch, one
+donation cycle), losses come back as a single lazy ``LossFuture`` over
+the ``[k]`` device array — zero intermediate readbacks::
+
+    for losses in engine.step_stream(loader):  # k steps per dispatch,
+        pass                                   # k = train_steps_per_sync
+    engine.sync_model()                        # drains in-flight work first
 """
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Any, Callable, Dict, Optional, Sequence
 
@@ -29,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import flags as core_flags
+from ..core.async_loss import LossFuture
 from ..core.generator import next_key, rng_scope
 from ..core.tensor import Tensor
 from ..autograd import engine as autograd_engine
@@ -163,6 +178,12 @@ class ParallelEngine:
     zero_stage : 0/1/2 shard optimizer state (and grads) over 'sharding';
         3 additionally shards params (reference sharding_optimizer.py).
     grad_accum : micro-batch accumulation count (GradientMergeOptimizer).
+    train_steps_per_sync : chunk size ``step_stream`` feeds to
+        ``step_many`` — k optimizer steps per dispatch (the
+        DistributedStrategy knob of the same name).
+    inflight_window : max un-synchronized dispatches outstanding before
+        ``step``/``step_many`` block on the oldest (dispatch runs ahead
+        of the device without unbounded live-buffer growth).
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
@@ -174,7 +195,10 @@ class ParallelEngine:
                  donate: bool = True,
                  amp_dtype: Optional[str] = None,
                  recompute: bool = False,
-                 pp_microbatches: Optional[int] = None):
+                 pp_microbatches: Optional[int] = None,
+                 train_steps_per_sync: int = 1,
+                 inflight_window: int = 2):
+        core_flags.maybe_enable_compilation_cache()
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else build_mesh(
@@ -274,15 +298,55 @@ class ParallelEngine:
         slot_sh = ({k: {n: ns(s) for n, s in d.items()}
                     for k, d in self.slot_specs.items()}, ns(P()))
         self._param_sh, self._slot_sh = param_sh, slot_sh
+        self._donate = donate
+
+        # Dispatch/trace accounting: one dispatch per _jit/_jit_many
+        # call, one trace per actual XLA recompile (the Python body of a
+        # jitted fn only runs while tracing — the increment is the
+        # standard trace-side-effect counter). hits = dispatches - traces
+        # is the executable-cache hit count bench.py reports.
+        self.dispatch_count = 0
+        self.trace_count = 0
+        self._seen_sigs: Dict[str, set] = {}
+        self._retrace_warned = False
+
+        def counted_step(params, opt_state, batch, key, lr):
+            self.trace_count += 1
+            return self._step_fn(params, opt_state, batch, key, lr)
 
         self._jit = jax.jit(
-            self._step_fn,
+            counted_step,
             in_shardings=(param_sh, slot_sh, None, None, None),
             out_shardings=(ns(P()), param_sh, slot_sh),
             donate_argnums=(0, 1) if donate else ())
+        self._jit_many_cache: Dict[int, Callable] = {}
 
-        # Place initial state on the mesh.
-        self.params = {k: jax.device_put(v, param_sh[k])
+        self.train_steps_per_sync = max(int(train_steps_per_sync), 1)
+        self.inflight_window = max(int(inflight_window), 1)
+        self._inflight: collections.deque = collections.deque()
+
+        # Place initial state on the mesh. The engine must OWN its param
+        # buffers: with donate=True the first step donates them, and a
+        # same-placement device_put can alias the Layer's own array —
+        # donating that deletes the model's live tensors out from under
+        # eager code / fluid.io registry saves. Aliasing is possible
+        # exactly when the leaf's current sharding is equivalent to the
+        # target (then device_put may be a no-op); detect it from
+        # sharding METADATA only — probing buffer pointers would force a
+        # per-param device sync and serialize the async placement.
+        def _owned(v, sh):
+            if isinstance(v, jax.Array):
+                cur = getattr(v, "sharding", None)
+                try:
+                    if cur is not None and cur.is_equivalent_to(
+                            sh, np.ndim(v)):
+                        return jax.device_put(jnp.array(v, copy=True),
+                                              sh)
+                except Exception:
+                    pass  # conservative: fall through to plain placement
+            return jax.device_put(v, sh)
+
+        self.params = {k: _owned(v, param_sh[k])
                        for k, v in self.params.items()}
         slots = {k: {n: jax.device_put(a, slot_sh[0][k][n])
                      for n, a in d.items()} for k, d in slots.items()}
@@ -309,14 +373,26 @@ class ParallelEngine:
             # pass-through for leaves that are already global jax Arrays
             # on this mesh (pre-staged batches re-fed to step): re-
             # sharding would be a no-op single-host but np.asarray on a
-            # non-fully-addressable Array raises multi-host
+            # non-fully-addressable Array raises multi-host. The check is
+            # mesh IDENTITY (same device array, same order), not just
+            # axis-size equality (ADVICE r5): a same-shaped mesh over
+            # different devices (or a different device order) must be
+            # re-placed, or the step consumes misplaced data.
             if isinstance(a, jax.Array) and not isinstance(
                     a, jax.core.Tracer):
                 sh = getattr(a, "sharding", None)
-                if (getattr(sh, "mesh", None) is not None
-                        and getattr(sh.mesh, "devices", None) is not None
-                        and sh.mesh.shape == self.mesh.shape):
+                m = getattr(sh, "mesh", None)
+                devs = getattr(m, "devices", None)
+                if m is not None and devs is not None and (
+                        m is self.mesh
+                        or (getattr(m, "axis_names", None)
+                            == self.mesh.axis_names
+                            and np.shape(devs)
+                            == np.shape(self.mesh.devices)
+                            and np.asarray(devs).tolist()
+                            == np.asarray(self.mesh.devices).tolist())):
                     return a
+                # different mesh → fall through and re-place the leaf
             s = spec if spec is not None else data_partition_spec(
                 tuple(ax for ax in ("dp", "sharding")
                       if ax in self.mesh.shape))
@@ -343,29 +419,176 @@ class ParallelEngine:
             axes = axes[:a.ndim]
             ndim_spec = P(*(axes + [None] * (a.ndim - len(axes))))
             sh = NamedSharding(self.mesh, ndim_spec)
-            if multi:
+            if multi and not isinstance(a, jax.Array):
                 # multi-host: each process feeds its LOCAL batch shard;
                 # assemble the global array over the coordination service
                 # (reference: each trainer feeds its own data partition)
                 return jax.make_array_from_process_local_data(sh, a)
+            # numpy single-host, or a jax.Array from a DIFFERENT mesh
+            # (device_put reshards global arrays on either topology)
             return jax.device_put(a, sh)
         return jax.tree_util.tree_map(place, arrs)
 
     # -- training -----------------------------------------------------------
 
-    def step(self, batch, lr: Optional[float] = None) -> float:
+    def _shape_sig(self, tree) -> tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (str(treedef),) + tuple(
+            (tuple(np.shape(l)), str(getattr(l, "dtype", type(l))))
+            for l in leaves)
+
+    def _guard_retrace(self, kind: str, batch) -> None:
+        """Warn once when a new batch-shape signature forces a retrace
+        (each retrace is a full XLA recompile — the silent host-loop
+        serializer the jit_retrace_warn flag exists to surface)."""
+        seen = self._seen_sigs.setdefault(kind, set())
+        sig = self._shape_sig(batch)
+        if sig in seen:
+            return
+        if seen and not self._retrace_warned \
+                and core_flags.flag("jit_retrace_warn"):
+            self._retrace_warned = True
+            import warnings
+            warnings.warn(
+                f"ParallelEngine.{kind} is retracing: batch arrived with "
+                f"a new shape signature (seen {len(seen)} before). Each "
+                "distinct shape costs a full XLA compile — pad or bucket "
+                "batches to fixed shapes (set FLAGS_jit_retrace_warn=0 "
+                "to silence).")
+        seen.add(sig)
+
+    def _push_inflight(self, fut: LossFuture) -> LossFuture:
+        self._inflight.append(fut)
+        while len(self._inflight) > self.inflight_window:
+            # bound dispatch run-ahead: wait on (don't read back) the
+            # oldest outstanding executable
+            self._inflight.popleft().block()
+        return fut
+
+    def step(self, batch, lr: Optional[float] = None) -> LossFuture:
         lr_val = jnp.asarray(lr if lr is not None else
                              self.optimizer.get_lr(), jnp.float32)
         batch = self.shard_batch(batch)
+        self._guard_retrace("step", batch)
+        self.dispatch_count += 1
         loss, self.params, self.opt_state = self._jit(
             self.params, self.opt_state, batch, next_key(), lr_val)
         sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(sched, "step"):
             sched.step()
-        return loss
+        return self._push_inflight(LossFuture(loss))
+
+    def _jit_many(self, k: int):
+        fn = self._jit_many_cache.get(k)
+        if fn is not None:
+            return fn
+        ns = lambda spec: NamedSharding(self.mesh, spec)
+
+        def multi_step(params, opt_state, batches, keys, lrs):
+            self.trace_count += 1
+
+            def body(carry, xs):
+                p, s = carry
+                b, key, lr_ = xs
+                loss, p, s = self._step_fn(p, s, b, key, lr_)
+                return (p, s), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (batches, keys, lrs))
+            return losses, params, opt_state
+
+        fn = jax.jit(
+            multi_step,
+            in_shardings=(self._param_sh, self._slot_sh, None, None, None),
+            out_shardings=(ns(P()), self._param_sh, self._slot_sh),
+            donate_argnums=(0, 1) if self._donate else ())
+        self._jit_many_cache[k] = fn
+        return fn
+
+    def step_many(self, batches: Sequence[Any],
+                  lr: Optional[float] = None) -> LossFuture:
+        """Run ``len(batches)`` optimizer steps inside ONE jitted
+        executable (``lax.scan`` over steps, composing with the
+        grad-accum inner scan): one dispatch, one donation cycle, zero
+        intermediate readbacks. Returns a lazy :class:`LossFuture` over
+        the ``[k]`` loss vector; the LR schedule advances k times, and
+        the RNG stream consumes k keys — bit-compatible with k
+        sequential ``step`` calls."""
+        k = len(batches)
+        if k == 0:
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError("step_many needs >= 1 batch")
+        if k == 1:
+            return self.step(batches[0], lr)
+        sharded = [self.shard_batch(b) for b in batches]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                         *sharded)
+        self._guard_retrace(f"step_many[k={k}]", sharded[0])
+        sched = getattr(self.optimizer, "_learning_rate", None)
+        lrs = []
+        for _ in range(k):
+            lrs.append(lr if lr is not None else self.optimizer.get_lr())
+            if hasattr(sched, "step"):
+                sched.step()
+        lrs = jnp.asarray(lrs, jnp.float32)
+        keys = jnp.stack([next_key() for _ in range(k)])
+        self.dispatch_count += 1
+        losses, self.params, self.opt_state = self._jit_many(k)(
+            self.params, self.opt_state, stacked, keys, lrs)
+        return self._push_inflight(LossFuture(losses))
+
+    def step_stream(self, batches, lr: Optional[float] = None):
+        """Drive training from any batch iterable at the engine's
+        ``train_steps_per_sync`` chunk size: full chunks dispatch through
+        ``step_many`` (pulling pre-staged device batches via the
+        iterator's ``peek_many`` when it has one — io.DataLoader's
+        buffered readers do); a short trailing chunk falls back to
+        sequential ``step`` so the remainder never compiles a fresh
+        scan. Yields one LossFuture per dispatch."""
+        k = self.train_steps_per_sync
+        it = iter(batches)
+        while True:
+            if hasattr(it, "peek_many"):
+                try:
+                    chunk = it.peek_many(k)
+                except StopIteration:
+                    return
+            else:
+                chunk = []
+                for _ in range(k):
+                    try:
+                        chunk.append(next(it))
+                    except StopIteration:
+                        break
+            if not chunk:
+                return
+            if len(chunk) == k and k > 1:
+                yield self.step_many(chunk, lr)
+            else:
+                for b in chunk:
+                    yield self.step(b, lr)
+                if len(chunk) < k:
+                    return
+
+    def drain(self) -> None:
+        """Block until every in-flight dispatched step has finished on
+        device (no readback — a sync, not a fetch). Required before
+        reading params for checkpointing/eval; ``sync_model``/
+        ``save_checkpoint`` call it."""
+        while self._inflight:
+            self._inflight.popleft().block()
+        jax.block_until_ready(self.params)
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Executable-cache accounting: every retrace is a miss, every
+        dispatch that reused a compiled executable is a hit."""
+        return {"hits": self.dispatch_count - self.trace_count,
+                "misses": self.trace_count}
 
     def sync_model(self) -> None:
-        """Write engine params back into the Layer (for save/eval)."""
+        """Write engine params back into the Layer (for save/eval).
+        Drains in-flight multi-step work first."""
+        self.drain()
         sd = self.model.state_dict()
         for k, arr in self.params.items():
             if k in sd:
@@ -376,7 +599,9 @@ class ParallelEngine:
 
     def save_checkpoint(self, path: str) -> str:
         """Save params + optimizer state shard-by-shard (each process
-        writes what it owns — no host gather, ZeRO-compatible)."""
+        writes what it owns — no host gather, ZeRO-compatible). Drains
+        in-flight multi-step work first."""
+        self.drain()
         from . import checkpoint as dckpt
         return dckpt.save_sharded(path, {"params": self.params,
                                          "opt_state": self.opt_state})

@@ -36,7 +36,14 @@ __all__ = ["is_parameter", "is_persistable", "save_vars", "save_params",
            "get_parameter_value_by_name", "PyReader", "DataLoader",
            "batch"]
 
+# Distinct default filename PER HELPER (ADVICE r5): with one shared
+# default, save_params followed by save_persistables into the same
+# dirname silently clobbered each other. The legacy shared name stays as
+# the persistables default (old checkpoints keep loading) and as a read
+# fallback for the other load_* helpers.
 _FILE = "__persistables__"
+_PARAMS_FILE = "__params__"
+_VARS_FILE = "__vars__"
 
 
 def is_parameter(var) -> bool:
@@ -108,42 +115,81 @@ def _select(vars=None, predicate: Optional[Callable] = None,
     return reg
 
 
-def _write(dirname, filename, tensors):
+# key sets of files THIS process wrote, so the periodic same-keys
+# re-save (checkpoint-as-you-train) doesn't unpickle the whole previous
+# checkpoint just to prove compatibility
+_written_keys: dict = {}
+
+
+def _write(dirname, filename, tensors, default):
     os.makedirs(dirname, exist_ok=True)
     payload = {k: np.asarray(t.numpy()) for k, t in tensors.items()}
-    with open(os.path.join(dirname, filename or _FILE), "wb") as f:
+    path = os.path.abspath(os.path.join(dirname, filename or default))
+    if os.path.exists(path) and _written_keys.get(path) != set(payload):
+        # Overwriting the same (or a grown) checkpoint as training
+        # progresses is normal; overwriting a file holding variables the
+        # new payload LACKS (another helper's output, another model, or
+        # not a checkpoint at all) silently destroys them — error
+        # instead.
+        try:
+            with open(path, "rb") as f:
+                existing = pickle.load(f)
+            compatible = (isinstance(existing, dict)
+                          and set(payload) >= set(existing))
+        except Exception:
+            compatible = False
+        if not compatible:
+            raise InvalidArgumentError(
+                f"save: {path} already exists and holds variables this "
+                "save would drop — refusing to clobber it. Pass a "
+                "distinct filename= (or remove the file) to save both.")
+    with open(path, "wb") as f:
         pickle.dump(payload, f)
+    _written_keys[path] = set(payload)
 
 
-def _read(dirname, filename):
-    path = os.path.join(dirname, filename or _FILE)
-    if not os.path.exists(path):
-        raise NotFoundError(
-            f"load: {path} does not exist (saved with a different "
-            "filename= ?)")
-    with open(path, "rb") as f:
-        return pickle.load(f)
+def _read(dirname, filename, defaults=(_FILE,)):
+    """Resolve the payload path: the explicit filename, else the first
+    existing default (each load_* tries its own helper's default first,
+    then the legacy shared file so old checkpoints keep loading)."""
+    candidates = [filename] if filename else list(defaults)
+    for name in candidates:
+        path = os.path.join(dirname, name)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+    try:
+        present = sorted(os.listdir(dirname))[:8]
+    except OSError:
+        present = []
+    raise NotFoundError(
+        f"load: none of {candidates} exist in {dirname} (found: "
+        f"{present}; saved with a different filename= or a different "
+        "save_* helper?)")
 
 
 def save_vars(executor=None, dirname=None, main_program=None, vars=None,
               predicate=None, filename=None):
     """Reference io.py:239 — serialize selected variables."""
     _write(dirname, filename, _select(vars, predicate,
-                                      main_program=main_program))
+                                      main_program=main_program),
+           default=_VARS_FILE)
 
 
 def save_params(executor=None, dirname=None, main_program=None,
                 filename=None):
     """Reference io.py:390 — trainable parameters only."""
     _write(dirname, filename, _select(params_only=True,
-                                      main_program=main_program))
+                                      main_program=main_program),
+           default=_PARAMS_FILE)
 
 
 def save_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
     """Reference io.py:621 — parameters + persistable buffers (the
     whole live registry)."""
-    _write(dirname, filename, _select(main_program=main_program))
+    _write(dirname, filename, _select(main_program=main_program),
+           default=_FILE)
 
 
 def _restore(payload, strict_shapes=True):
@@ -172,7 +218,8 @@ def _restore(payload, strict_shapes=True):
 
 def load_vars(executor=None, dirname=None, main_program=None, vars=None,
               predicate=None, filename=None):
-    payload = _read(dirname, filename)
+    payload = _read(dirname, filename,
+                    defaults=(_VARS_FILE, _FILE, _PARAMS_FILE))
     if vars is not None:
         want = set(_select(vars, main_program=main_program))
         absent = sorted(want - set(payload))
@@ -186,7 +233,8 @@ def load_vars(executor=None, dirname=None, main_program=None, vars=None,
 
 def load_params(executor=None, dirname=None, main_program=None,
                 filename=None):
-    payload = _read(dirname, filename)
+    payload = _read(dirname, filename,
+                    defaults=(_PARAMS_FILE, _FILE, _VARS_FILE))
     live_params = set(_select(params_only=True,
                               main_program=main_program))
     hit = {k: v for k, v in payload.items() if k in live_params}
@@ -200,7 +248,7 @@ def load_params(executor=None, dirname=None, main_program=None,
 
 def load_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
-    _restore(_read(dirname, filename))
+    _restore(_read(dirname, filename, defaults=(_FILE,)))
 
 
 def save_inference_model(dirname, feeded_var_names=None,

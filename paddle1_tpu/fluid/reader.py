@@ -74,6 +74,8 @@ class PyReader:
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._exhausted = False
+        self._iterable = bool(iterable)
+        self._reads_this_epoch = 0
 
     # -- decoration (reference PyReader decorate_* family) ---------------
     def decorate_sample_list_generator(self, reader, places=None):
@@ -131,6 +133,7 @@ class PyReader:
             put(tail)
         self._thread = threading.Thread(target=fill, daemon=True)
         self._thread.start()
+        self._reads_this_epoch = 0
         return self
 
     def reset(self):
@@ -142,6 +145,7 @@ class PyReader:
             self._thread = None
         self._queue = None
         self._exhausted = False
+        self._reads_this_epoch = 0
 
     # -- consumption ------------------------------------------------------
     @staticmethod
@@ -199,22 +203,38 @@ class PyReader:
                 and item[0] == "__pyreader_error__"):
             self._exhausted = True
             raise item[1]   # the decorated generator's own failure
+        self._reads_this_epoch += 1
         return self._to_tensors(item)
 
     def __iter__(self):
+        """Iterable-PyReader contract (ADVICE r5): a fresh ``for`` loop
+        gets a fresh epoch. An un-started reader starts; a PARTIALLY
+        consumed (or ended-but-unreset) epoch is reset and restarted so
+        the loop never resumes mid-epoch; a started-but-untouched epoch
+        (the reference start()-then-iterate idiom) is consumed as-is."""
         if self._queue is None:
             self.start()
-        while True:
-            try:
-                yield self.read()
-            except EOFException:
-                self.reset()
-                return
+        elif self._reads_this_epoch or self._exhausted:
+            self.reset()
+            self.start()
+        return self
+
+    def __next__(self):
+        """Python iteration protocol (both modes): epoch end is
+        ``StopIteration`` (so ``for``/``zip``/``itertools``/``next()``
+        terminate cleanly, as the old generator-based ``__iter__`` did)
+        and the reader auto-resets for the next epoch. The legacy
+        EOF-from-pop contract lives on ``read()``/``next()``."""
+        try:
+            return self.read()
+        except EOFException:
+            self.reset()
+            raise StopIteration from None
 
     def next(self):
+        # py2-style spelling: the reference's explicit-pop contract
+        # (EOFException at epoch end), NOT the iteration protocol
         return self.read()
-
-    __next__ = next
 
 
 def py_reader(capacity, shapes=None, dtypes=None, lod_levels=None,

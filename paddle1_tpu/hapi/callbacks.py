@@ -120,6 +120,12 @@ class ProgBarLogger(Callback):
 
 
 def _fmt(v):
+    from ..core.async_loss import LossFuture
+    if isinstance(v, LossFuture):
+        # formatting IS the materialization point for lazy losses: the
+        # device→host readback happens here (once per handle), not in
+        # the training loop
+        v = v.numpy()
     if isinstance(v, (list, tuple, np.ndarray)):
         return "[" + ", ".join(f"{float(x):.4f}" for x in np.ravel(v)) + "]"
     try:

@@ -8,10 +8,12 @@ train_batch via to_static when beneficial).
 
 from __future__ import annotations
 
+import collections
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..core.async_loss import LossFuture
 from ..core.tensor import Tensor, to_tensor
 from ..core.errors import InvalidArgumentError
 from ..io import DataLoader, Dataset
@@ -60,7 +62,12 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
-        loss_vals = [float(l.item()) for l in losses]
+        # Lazy handles, not floats: a blocking float(l.item()) here costs
+        # a device→host readback EVERY batch (~70 ms through the axon
+        # tunnel — bench.py honesty contract), serializing the whole loop
+        # on the host. The future reads back only when someone formats or
+        # floats it (ProgBarLogger, or an explicit .item()).
+        loss_vals = [LossFuture(l) for l in losses]
         if metrics:
             return loss_vals, metrics
         return loss_vals
@@ -127,6 +134,12 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
+        # Bounded dispatch run-ahead: keep at most `window` batches of
+        # un-synchronized loss futures outstanding, then block (device
+        # sync, NOT a readback) on the oldest — dispatch runs ahead of
+        # the device without unbounded live-buffer growth.
+        window: collections.deque = collections.deque()
+        window_size = 2
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -138,6 +151,11 @@ class Model:
                 update = (step + 1) % accumulate_grad_batches == 0
                 res = self.train_batch(ins, labs, update=update)
                 logs = _logs_from(res, self._metrics)
+                for lv in logs.get("loss", []):
+                    if isinstance(lv, LossFuture):
+                        window.append(lv)
+                while len(window) > window_size:
+                    window.popleft().block()
                 cbks.on_train_batch_end(step, logs)
                 it += 1
                 if (num_iters is not None and it >= num_iters) or \

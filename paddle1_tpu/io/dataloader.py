@@ -78,6 +78,7 @@ class _SingleProcessIter:
         self._prefetch_q: "queue.Queue" = queue.Queue(
             maxsize=loader.prefetch_factor)
         self._done = object()
+        self._finished = False
         self._err = None
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._stop = threading.Event()
@@ -91,6 +92,18 @@ class _SingleProcessIter:
             samples = [ds[i] for i in indices]
         return self._loader.collate_fn(samples)
 
+    def _put(self, item) -> bool:
+        """Stop-aware put: a consumer that broke out of its loop (queue
+        full, nobody draining) must not strand the producer thread in a
+        blocking put forever — shutdown() flips _stop and this returns."""
+        while not self._stop.is_set():
+            try:
+                self._prefetch_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _producer(self):
         try:
             if self._dataset_iter is not None:
@@ -103,18 +116,24 @@ class _SingleProcessIter:
                         break
                     batch = self._loader.collate_fn(samples)
                     batch = self._stage(batch)
-                    self._prefetch_q.put(batch)
+                    if not self._put(batch):
+                        return
             else:
                 for indices in self._batch_iter:
                     if self._stop.is_set():
                         break
                     batch = self._load_batch(indices)
                     batch = self._stage(batch)
-                    self._prefetch_q.put(batch)
+                    if not self._put(batch):
+                        return
         except BaseException as e:  # surfaced on next()
             self._err = e
         finally:
-            self._prefetch_q.put(self._done)
+            if not self._put(self._done):   # normal epoch end
+                try:                        # stopping: consumer is gone,
+                    self._prefetch_q.put_nowait(self._done)  # best effort
+                except queue.Full:
+                    pass
 
     def _stage(self, batch):
         if self._loader.device is not None:
@@ -122,14 +141,34 @@ class _SingleProcessIter:
         return batch
 
     def __next__(self):
+        if self._finished:
+            # the _done sentinel is single-shot: without this, a second
+            # next() after exhaustion blocks forever on the empty queue
+            raise StopIteration
         item = self._prefetch_q.get()
         if item is self._done:
+            self._finished = True
             if self._err is not None:
                 raise self._err
             raise StopIteration
         if not self._loader.return_list and isinstance(item, tuple):
             return list(item)
         return item
+
+    def peek_many(self, k: int):
+        """Pop up to ``k`` pre-staged (already device-resident) batches
+        for the multi-step training path (``ParallelEngine.step_many``):
+        blocks until ``k`` are available, returning fewer only at epoch
+        end. Raises StopIteration when the epoch is already over."""
+        out = []
+        for _ in range(max(int(k), 1)):
+            try:
+                out.append(next(self))
+            except StopIteration:
+                break
+        if not out:
+            raise StopIteration
+        return out
 
     def __iter__(self):
         return self
@@ -141,6 +180,9 @@ class _SingleProcessIter:
                 self._prefetch_q.get_nowait()
         except queue.Empty:
             pass
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
 
     def __del__(self):
         self.shutdown()
@@ -333,6 +375,8 @@ class _MultiProcessIter:
         if not self._loader.return_list and isinstance(batch, tuple):
             return list(batch)
         return batch
+
+    peek_many = _SingleProcessIter.peek_many
 
     def __iter__(self):
         return self

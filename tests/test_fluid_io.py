@@ -111,3 +111,81 @@ class TestReaders:
     def test_pyreader_alias(self):
         assert fluid.io.PyReader is fluid.layers.py_reader(
             capacity=1).__class__
+
+
+class TestDistinctDefaultFilenames:
+    """ADVICE r5: save_params + save_persistables into one dirname must
+    coexist (distinct default filenames), and an overwrite that would
+    DROP variables from an existing file errors instead of clobbering."""
+
+    def _net(self, seed):
+        paddle.seed(seed)
+        return paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                    paddle.nn.BatchNorm1D(8),
+                                    paddle.nn.Linear(8, 2))
+
+    def test_params_and_persistables_coexist(self, tmp_path):
+        import os
+        m = self._net(10)
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((16, 4)).astype(np.float32))
+        m.train()
+        m(x)
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        fluid.io.save_persistables(None, str(tmp_path), main_program=m)
+        names = set(os.listdir(tmp_path))
+        assert {"__params__", "__persistables__"} <= names
+        # both load from their own defaults
+        want = {k: np.asarray(v.numpy()) for k, v in m.state_dict().items()}
+        for t in m.state_dict().values():
+            t._data = t.data * 0 - 3.0
+        fluid.io.load_persistables(None, str(tmp_path))
+        got = {k: np.asarray(v.numpy()) for k, v in m.state_dict().items()}
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-6,
+                                       err_msg=k)
+        fluid.io.load_params(None, str(tmp_path))  # resolves __params__
+
+    def test_dropping_overwrite_errors(self, tmp_path):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        m = self._net(11)
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        m2 = self._net(12)
+        # same default file, DIFFERENT model → would drop m's params
+        with pytest.raises(InvalidArgumentError, match="refusing"):
+            fluid.io.save_params(None, str(tmp_path), main_program=m2)
+        # resaving the SAME var set (checkpoint-as-you-train) stays fine
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        # a non-checkpoint file at the target path is never clobbered
+        import os
+        victim = os.path.join(tmp_path, "notes.txt")
+        with open(victim, "w") as f:
+            f.write("not a checkpoint")
+        with pytest.raises(InvalidArgumentError, match="refusing"):
+            fluid.io.save_params(None, str(tmp_path), main_program=m,
+                                 filename="notes.txt")
+        assert open(victim).read() == "not a checkpoint"
+
+    def test_legacy_shared_file_still_loads(self, tmp_path):
+        # pre-fix checkpoints wrote everything to __persistables__;
+        # load_params/load_vars fall back to it
+        m = self._net(13)
+        fluid.io.save_persistables(None, str(tmp_path), main_program=m)
+        for t in m.state_dict().values():
+            t._data = t.data * 0 - 5.0
+        fluid.io.load_params(None, str(tmp_path),
+                             main_program=m)  # falls back to _FILE
+        w = np.asarray(m[0].weight.numpy())
+        assert not np.allclose(w, -5.0)
+
+    def test_cross_helper_load_falls_back(self, tmp_path):
+        # previously-working pairs: save_params → load_vars and
+        # save_vars → load_params resolve across default filenames
+        m = self._net(14)
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        name = m[0].weight.name
+        want = np.asarray(m[0].weight.numpy()).copy()
+        m[0].weight._data = m[0].weight.data * 0 - 9.0
+        fluid.io.load_vars(None, str(tmp_path), vars=[name])
+        np.testing.assert_allclose(np.asarray(m[0].weight.numpy()), want,
+                                   rtol=1e-6)

@@ -154,3 +154,66 @@ class TestDocCodegen:
         out = relu_(t)
         assert out is t  # write-back contract
         np.testing.assert_allclose(t.numpy(), [0.0, 3.0])
+
+
+class TestIterationProtocol:
+    """ADVICE r5: iterable-mode PyReader must speak the Python iteration
+    protocol — StopIteration (not EOFException) from __next__, and a
+    fresh for-loop over a partially-consumed epoch restarts it."""
+
+    def test_next_raises_stopiteration_at_epoch_end(self):
+        r = L.py_reader(capacity=2)
+        r.decorate_batch_generator(_gen(2))
+        it = iter(r)
+        next(it)
+        next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        # and the protocol-level contract: zip() terminates cleanly
+        r.decorate_batch_generator(_gen(3))
+        pairs = list(zip(r, range(10)))
+        assert len(pairs) == 3
+
+    def test_partially_consumed_epoch_restarts(self):
+        def gen():
+            for i in range(4):
+                yield (np.full((1, 1), i, np.float32),)
+
+        r = L.py_reader(capacity=2)
+        r.decorate_batch_generator(gen)
+        it = iter(r)
+        next(it)
+        next(it)                      # 2 of 4 consumed, then abandon it
+        vals = [int(x[0].numpy()[0, 0]) for x in r]   # fresh loop
+        assert vals == [0, 1, 2, 3]   # restarted, not resumed mid-epoch
+
+    def test_started_but_untouched_epoch_is_consumed_not_restarted(self):
+        consumed = {"n": 0}
+
+        def gen():
+            for i in range(3):
+                consumed["n"] += 1
+                yield (np.full((1, 1), i, np.float32),)
+
+        r = L.py_reader(capacity=2)
+        r.decorate_batch_generator(gen)
+        r.start()                     # the reference start-then-iterate idiom
+        out = list(r)
+        assert len(out) == 3
+        assert consumed["n"] == 3     # generator ran exactly one epoch
+
+    def test_read_keeps_legacy_eof_contract(self):
+        r = L.py_reader(capacity=2)
+        r.decorate_batch_generator(_gen(1))
+        r.start()
+        r.read()
+        with pytest.raises(fluid.core.EOFException):
+            r.read()
+
+    def test_noniterable_for_loop_terminates_cleanly(self):
+        r = fluid.io.PyReader(capacity=2, iterable=False)
+        r.decorate_batch_generator(_gen(2))
+        n = 0
+        for _ in r:
+            n += 1
+        assert n == 2
