@@ -279,6 +279,95 @@ def bench_bert_base(on_tpu, batch_override=None, seq_override=None,
           mfu / 0.40, detail)
 
 
+def bench_chaos_soak(on_tpu, steps_override=None):
+    """``--chaos``: fault-injection soak of the resilient runtime.
+
+    Runs the same tiny-MLP training twice — once clean, once through
+    ``ResilientTrainer`` with a poisoned batch, an injected
+    checkpoint-write failure and a simulated preemption — and reports
+    recovered throughput. ``vs_baseline`` is the recovery contract
+    itself: 1.0 iff the chaos run's final params match the clean run to
+    1e-6 AND the trainer's counters account for every injected fault.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import (ParallelEngine, ResilientTrainer,
+                                         build_mesh)
+
+    steps = steps_override or (50 if on_tpu else 12)
+    save_freq = max(steps // 6, 1)
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.standard_normal((8, 16)).astype(np.float32),
+                "y": rng.standard_normal((8, 4)).astype(np.float32)}
+               for _ in range(steps)]
+
+    def make_engine():
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 4))
+        for i, p in enumerate(model.parameters()):
+            p._data = jax.numpy.asarray(
+                np.random.default_rng(7 + i)
+                .standard_normal(p.shape).astype(np.float32) * 0.1)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        loss_fn = lambda m, b: \
+            ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+        mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+        return ParallelEngine(model, opt, loss_fn, mesh=mesh,
+                              check_finite=True)
+
+    tmp = tempfile.mkdtemp(prefix="p1t_chaos_")
+    try:
+        # clean reference run
+        chaos.reset()
+        clean = ResilientTrainer(make_engine(), os.path.join(tmp, "clean"),
+                                 save_freq=save_freq,
+                                 bad_step_policy="restore_last_good",
+                                 backoff_base_s=0.0)
+        clean.fit(lambda: list(batches), steps=steps)
+        clean_params = {k: np.asarray(v)
+                        for k, v in clean.engine.params.items()}
+
+        # chaos run: NaN batch + failed checkpoint write + preemption
+        chaos.configure(f"nan_batch@{save_freq + 1},ckpt_fail@2,"
+                        f"preempt@{min(2 * save_freq + 1, steps)}")
+        trainer = ResilientTrainer(make_engine(), os.path.join(tmp, "run"),
+                                   save_freq=save_freq,
+                                   bad_step_policy="restore_last_good",
+                                   backoff_base_s=0.0)
+        t0 = time.perf_counter()
+        report = trainer.fit(lambda: list(batches), steps=steps)
+        dt = time.perf_counter() - t0
+
+        max_err = max(
+            float(np.max(np.abs(clean_params[k] -
+                                np.asarray(trainer.engine.params[k]))))
+            for k in clean_params)
+        recovered = (max_err <= 1e-6 and report.bad_steps >= 1
+                     and report.retries >= 1 and report.preemptions >= 1
+                     and report.restores >= 2)
+        detail = dict(report.as_dict(), steps=steps, save_freq=save_freq,
+                      max_param_err=max_err, elapsed_s=round(dt, 3),
+                      device=getattr(jax.devices()[0], "device_kind",
+                                     jax.devices()[0].platform))
+        _emit("chaos_soak_recovered_steps_per_sec", steps / dt, "steps/s",
+              1.0 if recovered else 0.0, detail)
+        if not recovered:
+            raise AssertionError(
+                f"chaos soak did NOT recover: {json.dumps(detail)}")
+    finally:
+        chaos.reset()  # a failing soak must not leave faults armed
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import os
     ap = argparse.ArgumentParser()
@@ -296,6 +385,12 @@ def main():
                     help="fuse k train steps into one executable "
                          "(engine.step_many) — measures the multi-step "
                          "amortization of dispatch + readback")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-injection soak: run the ResilientTrainer "
+                         "through a poisoned batch, a failed checkpoint "
+                         "write and a simulated preemption; vs_baseline "
+                         "is 1.0 iff final params match the clean run "
+                         "to 1e-6 with accurate counters")
     args = ap.parse_args()
 
     if not _probe_tpu():
@@ -310,7 +405,9 @@ def main():
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
 
-    if args.config == "bert_base":
+    if args.chaos:
+        bench_chaos_soak(on_tpu, steps_override=args.steps)
+    elif args.config == "bert_base":
         bench_bert_base(on_tpu, batch_override=args.batch,
                         seq_override=args.seq,
                         steps_override=args.steps,

@@ -108,6 +108,13 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
+        self._last_step_skipped = False
+        # one dynamic-scale update per detected step outcome: set by
+        # unscale_/record_step, consumed by update() — so the reference
+        # usage `scaler.step(opt); scaler.update()` (step already
+        # updates internally) doesn't register a phantom good step
+        self._pending_update = False
 
     def is_enable(self):
         return self._enable
@@ -128,9 +135,15 @@ class GradScaler:
     def unscale_(self, optimizer):
         """check_finite_and_unscale (reference
         amp/check_finite_and_unscale_op.cu): divide grads by scale, flag
-        non-finite."""
+        non-finite. Calling it twice before ``step``/``update`` would
+        divide the grads by the scale twice — refuse, like the
+        reference/torch scalers do."""
         if not self._enable:
             return
+        if self._unscaled:
+            raise InvalidArgumentError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
         params = optimizer._parameter_list or []
         found = False
         inv = 1.0 / self._scale
@@ -143,13 +156,19 @@ class GradScaler:
             p.grad._data = g.astype(p.grad.data.dtype)
         self._found_inf = found
         self._unscaled = True
+        self._pending_update = True
 
     def step(self, optimizer):
+        """Unscale (if not already), skip the optimizer update when any
+        grad came back non-finite, then run the dynamic-scale update.
+        ``last_step_skipped()`` reports what happened."""
         if not self._enable:
             optimizer.step()
+            self._last_step_skipped = False
             return
-        if not getattr(self, "_unscaled", False):
+        if not self._unscaled:
             self.unscale_(optimizer)
+        self._last_step_skipped = self._found_inf
         if not self._found_inf:
             optimizer.step()
         self._unscaled = False
@@ -160,10 +179,43 @@ class GradScaler:
         # scaled loss by user; unscale, conditional step, update.
         self.step(optimizer)
 
+    def last_step_skipped(self) -> bool:
+        """Whether the most recent ``step``/``minimize`` skipped the
+        optimizer update because of non-finite grads."""
+        return self._last_step_skipped
+
+    def record_step(self, found_inf: bool) -> float:
+        """Feed one externally-detected step outcome into the dynamic
+        scaling state machine and return the (possibly updated) scale.
+
+        This is the wiring point for compiled training: the engine's
+        device-side ``check_finite`` flag (``StepFuture.bad``) already
+        says whether the step was applied or skipped on device, so the
+        host-side scaler only needs the bookkeeping half of
+        update_loss_scaling — halve on a bad step, regrow after
+        ``incr_every_n_steps`` good ones — without ever touching
+        ``p.grad``.
+        """
+        self._found_inf = bool(found_inf)
+        self._pending_update = True
+        self.update()
+        return self._scale
+
     def update(self):
         """update_loss_scaling op logic (reference
-        amp/update_loss_scaling_op.cu)."""
+        amp/update_loss_scaling_op.cu). One scale update per detected
+        step outcome: a call with nothing pending (e.g. the reference
+        pattern's external ``update()`` after ``step()`` already
+        updated) is a no-op — neither a phantom good step nor a second
+        halving."""
+        if not self._pending_update:
+            return
+        self._pending_update = False
+        # update() ends the iteration: a manual unscale_/update loop
+        # (step skipped by the caller) must be able to unscale_ again
+        self._unscaled = False
         if not self._dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -177,6 +229,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        self._found_inf = False
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

@@ -25,7 +25,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["LossFuture", "readback_count", "reset_readback_count"]
+__all__ = ["LossFuture", "StepFuture", "readback_count",
+           "reset_readback_count"]
 
 _lock = threading.Lock()
 _readbacks = 0
@@ -180,3 +181,50 @@ class LossFuture:
 
     def __iter__(self):
         return iter(self.numpy())
+
+
+class StepFuture(LossFuture):
+    """A LossFuture over a *packed* ``[..., 2]`` array of
+    ``[loss, notfinite]`` pairs — the output of a ``check_finite``
+    compiled train step.
+
+    The bad-step flag is computed on device inside the step executable
+    and packed next to the loss, so NaN/Inf detection costs no extra
+    readback: one host fetch materializes both (and the readback counter
+    increments once, same as a plain loss). All the float/format/numpy
+    protocol of :class:`LossFuture` sees only the loss column —
+    ``float(engine.step(b))`` behaves exactly as without detection —
+    while :meth:`bad`, :meth:`bad_count` and :meth:`bad_mask` expose the
+    flag side.
+    """
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, packed: Any):
+        super().__init__(packed)
+        self._raw: Optional[np.ndarray] = None
+
+    def _fetch(self) -> np.ndarray:
+        if self._raw is None:
+            self._raw = np.asarray(self._arr)
+            _count_readback()
+        return self._raw
+
+    def numpy(self) -> np.ndarray:
+        if self._result is None:
+            self._result = np.asarray(self._fetch()[..., 0])
+        return self._result
+
+    def bad_mask(self) -> np.ndarray:
+        """Per-step non-finite flags (bool; scalar for a single step,
+        ``[k]`` for a ``step_many`` chunk)."""
+        return np.asarray(self._fetch()[..., 1] > 0)
+
+    def bad_count(self) -> int:
+        return int(np.sum(self.bad_mask()))
+
+    @property
+    def bad(self) -> bool:
+        """True when any step in this dispatch saw a non-finite loss or
+        gradient (the update was skipped on device for those steps)."""
+        return bool(np.any(self.bad_mask()))

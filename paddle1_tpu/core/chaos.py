@@ -1,0 +1,230 @@
+"""Deterministic chaos injection for the fault-tolerance stack.
+
+Long multi-host runs die to a short list of causes — a NaN batch out of a
+corrupt shard, a checkpoint write killed mid-flight, a dataloader worker
+raising, a preemption notice — and the recovery code for each is exactly
+the code that never runs in a clean test environment. This module makes
+those failures *first-class, reproducible inputs*: each injection point
+carries a monotone occurrence counter, and a chaos spec arms specific
+occurrences ("the 3rd batch", "the 2nd checkpoint write"). Because the
+counters are deterministic, a failure fires exactly once per armed
+occurrence — so a retried/replayed operation comes back clean, which is
+what lets the resilience tests assert bit-parity between an interrupted
+run and an uninterrupted one.
+
+Spec syntax (comma-separated, each entry ``point@N`` with 1-based N;
+repeat a point to arm several occurrences)::
+
+    nan_batch@3,ckpt_fail@2,preempt@7,loader_raise@5
+
+Armed via :func:`configure` or the ``FLAGS_ft_chaos`` env/flag (read by
+``configure_from_flags``). All state is process-local and reset by
+:func:`reset`.
+
+Injection points
+----------------
+``nan_batch``     — :func:`maybe_poison` rewrites the first floating leaf
+                    of the batch to NaN (a corrupt input shard).
+``ckpt_fail``     — :func:`check_checkpoint_write` raises ``IOError``
+                    inside ``CheckpointManager.save`` *before* the commit
+                    rename, leaving a partial tmp dir behind (a write
+                    killed mid-flight).
+``loader_raise``  — :func:`check_loader` raises inside the DataLoader
+                    prefetch producer (a worker crash).
+``preempt``       — :func:`check_preempt` raises
+                    :class:`SimulatedPreemption` (the maintenance-event
+                    signal; also raised after :func:`request_preemption`,
+                    which is safe to call from a real signal handler).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "SimulatedPreemption", "ChaosInjectedError", "configure",
+    "configure_from_flags", "reset", "enabled", "fire", "counts",
+    "maybe_poison", "check_checkpoint_write", "check_loader",
+    "check_preempt", "request_preemption", "preemption_requested",
+    "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT",
+]
+
+POISON_BATCH = "nan_batch"
+CKPT_FAIL = "ckpt_fail"
+LOADER_RAISE = "loader_raise"
+PREEMPT = "preempt"
+
+_POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE, PREEMPT)
+
+
+class SimulatedPreemption(BaseException):
+    """A (simulated) preemption notice.
+
+    Deliberately a ``BaseException`` — like ``KeyboardInterrupt`` — so
+    that transient-failure retry wrappers written as ``except Exception``
+    can never swallow it: a preemption must unwind to the resilient
+    loop's preemption handler, not be retried in place.
+
+    ``graceful=True`` marks a real advance NOTICE (the SIGTERM grace
+    window of :func:`request_preemption`): the handler still has time
+    to checkpoint the current known-good state, losing nothing. The
+    armed ``preempt@N`` chaos point simulates the opposite — an
+    ungraceful kill with no chance to save — and restores+replays.
+    """
+
+    def __init__(self, *args, graceful: bool = False):
+        super().__init__(*args)
+        self.graceful = graceful
+
+
+class ChaosInjectedError(IOError):
+    """The error raised by armed ``ckpt_fail``/``loader_raise`` points
+    (an IOError: both model real I/O failures)."""
+
+
+_lock = threading.Lock()
+# point -> set of armed 1-based occurrence indices
+_armed: Dict[str, set] = {}
+# point -> occurrences seen so far
+_counters: Dict[str, int] = {}
+_preempt_requested = False
+
+
+def reset() -> None:
+    """Disarm every point and zero all counters (test isolation)."""
+    global _preempt_requested
+    with _lock:
+        _armed.clear()
+        _counters.clear()
+        _preempt_requested = False
+
+
+def configure(spec: Union[str, Dict[str, object], None]) -> None:
+    """Arm injection points from a spec string (``"nan_batch@3,..."``) or
+    a dict ``{point: N-or-list-of-N}``. Resets previous arming/counters."""
+    reset()
+    if not spec:
+        return
+    entries: List[Tuple[str, int]] = []
+    if isinstance(spec, str):
+        for raw in spec.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if "@" not in raw:
+                raise ValueError(
+                    f"chaos spec entry {raw!r} must be 'point@N' "
+                    f"(points: {', '.join(_POINTS)})")
+            name, _, n = raw.partition("@")
+            entries.append((name.strip(), int(n)))
+    else:
+        for name, ns in spec.items():
+            for n in (ns if isinstance(ns, (list, tuple)) else [ns]):
+                entries.append((name, int(n)))
+    with _lock:
+        for name, n in entries:
+            if name not in _POINTS:
+                raise ValueError(
+                    f"unknown chaos point {name!r} "
+                    f"(points: {', '.join(_POINTS)})")
+            if n < 1:
+                raise ValueError(f"chaos occurrence must be >= 1, got {n}")
+            _armed.setdefault(name, set()).add(n)
+
+
+def configure_from_flags() -> bool:
+    """Arm from the ``ft_chaos`` flag (set via ``FLAGS_ft_chaos`` env or
+    ``set_flags``). Returns True when anything was armed."""
+    from . import flags as core_flags
+    spec = core_flags.flag("ft_chaos")
+    if spec:
+        configure(spec)
+        return True
+    return False
+
+
+def enabled() -> bool:
+    """Whether any point is armed (fast gate for hot paths)."""
+    return bool(_armed) or _preempt_requested
+
+
+def counts() -> Dict[str, int]:
+    """Occurrence counters seen so far (diagnostics/tests)."""
+    with _lock:
+        return dict(_counters)
+
+
+def fire(point: str) -> bool:
+    """Record one occurrence of ``point``; True iff this occurrence is
+    armed. Each armed occurrence fires exactly once — a replay of the
+    same logical operation draws a fresh (higher) occurrence number and
+    comes back clean."""
+    with _lock:
+        n = _counters.get(point, 0) + 1
+        _counters[point] = n
+        return n in _armed.get(point, ())
+
+
+# -- point helpers (each a 1-2 line call at the real code site) --------------
+
+def maybe_poison(batch):
+    """``nan_batch``: on an armed occurrence, return a copy of ``batch``
+    with its first floating-point leaf filled with NaN."""
+    if not enabled() or not fire(POISON_BATCH):
+        return batch
+    import numpy as np
+
+    state = {"done": False}
+
+    def poison(leaf):
+        if state["done"]:
+            return leaf
+        arr = np.asarray(getattr(leaf, "data", leaf))
+        if np.issubdtype(arr.dtype, np.floating):
+            state["done"] = True
+            return np.full_like(arr, np.nan)
+        return leaf
+
+    import jax
+    poisoned = jax.tree_util.tree_map(poison, batch)
+    if not state["done"]:  # integer-only batch: poison is a no-op
+        return batch
+    return poisoned
+
+
+def check_checkpoint_write() -> None:
+    """``ckpt_fail``: raise on an armed checkpoint-write occurrence."""
+    if enabled() and fire(CKPT_FAIL):
+        raise ChaosInjectedError(
+            "chaos: injected checkpoint write failure")
+
+
+def check_loader() -> None:
+    """``loader_raise``: raise on an armed dataloader-batch occurrence."""
+    if enabled() and fire(LOADER_RAISE):
+        raise ChaosInjectedError("chaos: injected dataloader failure")
+
+
+def request_preemption() -> None:
+    """Flag a preemption from outside the loop (signal-handler safe: just
+    sets a bool). The next :func:`check_preempt` raises."""
+    global _preempt_requested
+    _preempt_requested = True
+
+
+def preemption_requested() -> bool:
+    return _preempt_requested
+
+
+def check_preempt() -> None:
+    """``preempt``: raise :class:`SimulatedPreemption` on an armed step
+    occurrence, or when :func:`request_preemption` was called."""
+    global _preempt_requested
+    if not enabled():
+        return
+    if _preempt_requested:
+        _preempt_requested = False
+        raise SimulatedPreemption("preemption requested", graceful=True)
+    if fire(PREEMPT):
+        raise SimulatedPreemption("chaos: simulated preemption")

@@ -284,6 +284,45 @@ def _define_builtin_flags() -> None:
                 "r5: all dq/dk/dv variants max_err=0 vs the XLA "
                 "recompute backward on TPU v5 lite).",
                 validator=lambda v: v in ("auto", "always", "never"))
+    # Fault tolerance (reference incubate/auto_checkpoint +
+    # update_loss_scaling roles; consumed by distributed.resilience and
+    # core.chaos)
+    define_flag("ft_bad_step_policy", "raise",
+                "What ResilientTrainer does when the device-side "
+                "isfinite flag (or the divergence watchdog) marks a "
+                "step bad: raise (fail loudly; params keep their last "
+                "good values because the compiled step skips non-finite "
+                "updates on device), skip (count it and move on), "
+                "restore_last_good (roll back to the last checkpoint "
+                "and replay the data stream from there).",
+                validator=lambda v: v in ("raise", "skip",
+                                          "restore_last_good"))
+    define_flag("ft_max_retries", 3,
+                "Transient-failure retries around a train step or "
+                "checkpoint write before the error propagates.",
+                validator=lambda v: v >= 0)
+    define_flag("ft_backoff_base_s", 0.5,
+                "First retry backoff; doubles per retry (capped by "
+                "ft_backoff_max_s).",
+                validator=lambda v: v >= 0)
+    define_flag("ft_backoff_max_s", 10.0,
+                "Backoff ceiling for the exponential retry schedule.",
+                validator=lambda v: v >= 0)
+    define_flag("ft_save_freq", 100,
+                "ResilientTrainer default checkpoint period in steps.",
+                validator=lambda v: v >= 1)
+    define_flag("ft_divergence_factor", 0.0,
+                "Loss-explosion watchdog: a finite loss greater than "
+                "factor * running-mean counts as a bad step (0 "
+                "disables). Costs nothing extra: the loss rides the "
+                "same packed readback as the isfinite flag.",
+                validator=lambda v: v >= 0)
+    define_flag("ft_chaos", "",
+                "Deterministic failure-injection spec armed by "
+                "core.chaos.configure_from_flags (e.g. "
+                "'nan_batch@3,ckpt_fail@2,preempt@7'). Empty disables. "
+                "Each armed occurrence fires exactly once, so retried/"
+                "replayed operations come back clean.")
     define_flag("conv_nhwc", "auto",
                 "Run NCHW-API image ops (2-D conv with HWIO weights, "
                 "max/avg pool, batch norm) internally channels-last, "

@@ -36,6 +36,8 @@ from .communicator import (AsyncCommunicator, DenseEndpoint,
                            GeoCommunicator)
 from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
+from . import resilience
+from .resilience import BadStepError, ResilienceReport, ResilientTrainer
 from . import graph_table
 from .graph_table import GraphTable
 from . import hbm_embedding
@@ -75,7 +77,8 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "ps", "SparseTable", "EmbeddingService", "DistributedEmbedding",
            "ps_server", "TableServer", "RemoteTable", "remote_service",
            "checkpoint", "CheckpointManager", "save_sharded",
-           "load_sharded", "graph_table", "GraphTable"]
+           "load_sharded", "resilience", "ResilientTrainer",
+           "ResilienceReport", "BadStepError", "graph_table", "GraphTable"]
 
 
 # -- PS-era dataset + sparse-table entry configs (reference

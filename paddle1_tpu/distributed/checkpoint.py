@@ -1,4 +1,4 @@
-"""Sharding-aware distributed checkpointing (orbax-backed).
+"""Sharding-aware distributed checkpointing (orbax-backed), hardened.
 
 Reference analog: ``fluid/io.py save_persistables`` with PS-sliced vars
 (each server saves its slice) and the trainer-side checkpoint of
@@ -11,19 +11,53 @@ the process that owns it via orbax (OCDBT format) and restores directly
 into the target sharding — the TPU-idiomatic equivalent of the
 reference's per-server slice files.
 
+Fault-tolerance contract (the auto_checkpoint role):
+
+* **Atomic commits** — ``CheckpointManager.save`` writes into a
+  ``<step>.tmp-<pid>`` sibling, stamps a ``manifest.json`` (tree
+  structure + shape/dtype digest + optional host metadata), and only
+  then renames into place. A write killed at ANY point leaves either a
+  ``.tmp-*`` dir or a manifest-less step dir; both read as
+  *uncommitted*.
+* **Manifest verification** — ``restore`` checks the saved tree spec
+  against the restore target before orbax touches the arrays, so a
+  truncated or mismatched checkpoint fails fast instead of restoring
+  garbage.
+* **Fallback** — when the newest checkpoint is corrupt or partial,
+  ``restore`` walks backward to the newest one that verifies and loads.
+* **GC hygiene** — ``latest_step``/``_gc`` parse step names defensively
+  (non-numeric entries skipped, never crashed on), count only committed
+  checkpoints toward retention (a partial dir can no longer push a good
+  checkpoint out of the window), and sweep uncommitted debris.
+
 ``paddle.save``/``paddle.load`` remain the right tool for single-host
 state dicts; use this for engine-scale state.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional
+import re
+import shutil
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
+from ..core.errors import EnforceNotMet
+
 __all__ = ["save_sharded", "load_sharded", "latest_step",
-           "CheckpointManager"]
+           "committed_steps", "CheckpointCorruptError", "CheckpointManager",
+           "MANIFEST_NAME", "write_manifest", "read_manifest",
+           "verify_manifest"]
+
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorruptError(EnforceNotMet, IOError):
+    """No checkpoint under the directory survived verification."""
 
 
 def _checkpointer():
@@ -58,17 +92,113 @@ def load_sharded(path: str, target: Dict[str, Any]):
     return _checkpointer().restore(path, _abstract(target))
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Largest numeric subdirectory (step) under ``directory``, or None."""
-    if not os.path.isdir(directory):
+# -- manifests ---------------------------------------------------------------
+
+def _tree_spec(state) -> List[Tuple[str, List[int], str]]:
+    """(path, shape, dtype) per leaf — the structural identity of a
+    checkpoint, cheap to compute and to compare."""
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    spec = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        shape = [int(s) for s in getattr(leaf, "shape", ())]
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        spec.append((key, shape, dtype))
+    return spec
+
+
+def _spec_digest(spec) -> str:
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def write_manifest(path: str, state, meta: Optional[Dict[str, Any]] = None):
+    """Stamp ``manifest.json`` into a checkpoint dir: the commit marker
+    plus the tree spec ``restore`` verifies against its target."""
+    spec = _tree_spec(state)
+    doc = {"version": 1, "tree": spec, "digest": _spec_digest(spec),
+           "meta": meta or {}}
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest of a checkpoint dir, or None when absent/unreadable
+    (both mean: not a committed checkpoint)."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or "tree" not in doc:
+            return None
+        return doc
+    except (OSError, ValueError):
         return None
-    steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
-    return max(steps) if steps else None
+
+
+def verify_manifest(path: str, target) -> Dict[str, Any]:
+    """Check a checkpoint's manifest against the restore target's tree
+    spec; returns the manifest. Raises CheckpointCorruptError on a
+    missing manifest or a structure/shape/dtype mismatch."""
+    doc = read_manifest(path)
+    if doc is None:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has no readable manifest "
+            "(partial write, or pre-manifest checkpoint)")
+    saved = [[k, [int(x) for x in s], d] for k, s, d in doc["tree"]]
+    want = [[k, list(s), d] for k, s, d in _tree_spec(target)]
+    if saved != want:
+        diff = next(((a, b) for a, b in zip(saved, want) if a != b),
+                    ("<leaf-count>", (len(saved), len(want))))
+        raise CheckpointCorruptError(
+            f"checkpoint {path} does not match the restore target "
+            f"({len(saved)} vs {len(want)} leaves; first difference: "
+            f"saved={diff[0]} target={diff[1]})")
+    return doc
+
+
+# -- step-dir bookkeeping ----------------------------------------------------
+
+_STEP_RE = re.compile(r"\d+$")
+
+
+def _step_of(name: str) -> Optional[int]:
+    """Parse a step-dir name; None for anything non-numeric (including
+    unicode digits that ``str.isdigit`` accepts but ``int`` rejects,
+    tmp dirs, and stray files)."""
+    return int(name) if _STEP_RE.fullmatch(name) else None
+
+
+def committed_steps(directory: str) -> List[int]:
+    """Ascending steps of COMMITTED checkpoints (numeric dir name +
+    readable manifest). Partial writes, tmp dirs and foreign files are
+    skipped, never crashed on."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for d in os.listdir(directory):
+        s = _step_of(d)
+        if s is None:
+            continue
+        p = os.path.join(directory, d)
+        if os.path.isdir(p) and read_manifest(p) is not None:
+            steps.append(s)
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Largest committed step under ``directory``, or None."""
+    steps = committed_steps(directory)
+    return steps[-1] if steps else None
 
 
 class CheckpointManager:
     """Step-numbered checkpoints with retention (reference
-    auto_checkpoint epoch-range semantics at engine scale)."""
+    auto_checkpoint epoch-range semantics at engine scale), atomic
+    commits, manifest verification and corrupt-checkpoint fallback."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
@@ -82,24 +212,151 @@ class CheckpointManager:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, str(int(step)))
 
-    def save(self, step: int, state: Dict[str, Any]):
-        save_sharded(self._step_dir(step), state)
-        self._gc()
-        return self._step_dir(step)
+    def save(self, step: int, state: Dict[str, Any],
+             meta: Optional[Dict[str, Any]] = None):
+        """Atomically commit ``state`` as checkpoint ``step``.
 
-    def restore(self, target: Dict[str, Any], step: Optional[int] = None):
+        Write order: orbax save into ``<step>.tmp-<pid>`` → manifest
+        stamped inside it (the commit marker) → rename over the final
+        path. A crash (or an injected ``ckpt_fail``) before the rename
+        leaves only uncommitted debris that restore/GC ignore/sweep.
+        ``meta`` (small, JSON-serializable — step counters, RNG state)
+        rides in the manifest, not in orbax arrays.
+        """
+        final = self._step_dir(step)
+        multi = jax.process_count() > 1
+        # multi-host: every process must feed orbax the SAME path (each
+        # writes only the shards it owns); single-host, a pid suffix
+        # keeps concurrent managers from clobbering each other's tmp
+        tmp = f"{final}.tmp" if multi else f"{final}.tmp-{os.getpid()}"
+        save_sharded(tmp, state)
+        commit_err: Optional[Exception] = None
+        if jax.process_index() == 0:
+            try:
+                from ..core import chaos
+                chaos.check_checkpoint_write()  # injected mid-write
+                # failure: arrays on disk, no manifest, no rename —
+                # an uncommitted partial
+                write_manifest(tmp, state, meta=meta)
+                if os.path.isdir(final):
+                    # re-saving an existing step (rollback-and-replay):
+                    # move the old commit ASIDE first, swap the new one
+                    # in, then delete — a crash mid-swap leaves either
+                    # the old commit or the new one plus uncommitted
+                    # debris, never neither
+                    old = f"{final}.old-{os.getpid()}"
+                    os.replace(final, old)
+                    os.replace(tmp, final)
+                    shutil.rmtree(old, ignore_errors=True)
+                else:
+                    os.replace(tmp, final)
+                self._gc()
+            except Exception as e:
+                # do NOT raise before the collective below: peers must
+                # learn the outcome or they'd block at the next barrier
+                # (and a caller-side retry would re-enter the orbax
+                # collective with mismatched participants)
+                commit_err = e
+        if multi:
+            # outcome broadcast doubles as the commit barrier: every
+            # process raises together on failure, so a retry re-enters
+            # the collective save in lockstep — and no process reports
+            # success for a checkpoint that was never committed
+            import numpy as _np
+            from jax.experimental import multihost_utils
+            ok = multihost_utils.broadcast_one_to_all(
+                _np.asarray(commit_err is None))
+            if not bool(ok):
+                if commit_err is not None:
+                    raise commit_err
+                raise IOError(
+                    f"checkpoint {step} commit failed on process 0")
+        elif commit_err is not None:
+            raise commit_err
+        return final
+
+    def restore(self, target: Dict[str, Any],
+                step: Optional[int] = None):
+        """Restore the newest checkpoint that verifies (or exactly
+        ``step`` when given), falling back past corrupt/partial ones.
+        Returns ``(restored_tree, step)``."""
+        if step is not None:
+            path = self._step_dir(step)
+            verify_manifest(path, target)
+            return load_sharded(path, target), int(step)
+        candidates = committed_steps(self.directory)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no committed checkpoints under {self.directory}")
+        errors = []
+        for s in reversed(candidates):
+            path = self._step_dir(s)
+            try:
+                verify_manifest(path, target)
+                return load_sharded(path, target), s
+            except Exception as e:
+                # corrupt / truncated / mismatched — fall back to the
+                # previous checkpoint rather than dying on the newest
+                errors.append((s, e))
+                warnings.warn(
+                    f"checkpoint step {s} failed to restore "
+                    f"({type(e).__name__}: {e}); falling back")
+        raise CheckpointCorruptError(
+            f"every checkpoint under {self.directory} failed to "
+            f"restore: {[(s, str(e)) for s, e in errors]}")
+
+    def read_meta(self, step: Optional[int] = None) -> \
+            Optional[Dict[str, Any]]:
+        """Host metadata stamped into a checkpoint's manifest."""
         step = self.latest_step() if step is None else int(step)
         if step is None:
-            raise FileNotFoundError(
-                f"no checkpoints under {self.directory}")
-        return load_sharded(self._step_dir(step), target), step
+            return None
+        doc = read_manifest(self._step_dir(step))
+        return None if doc is None else doc.get("meta", {})
 
     def latest_step(self) -> Optional[int]:
         return latest_step(self.directory)
 
+    def all_steps(self) -> List[int]:
+        return committed_steps(self.directory)
+
+    # debris younger than this may belong to a live writer in another
+    # process — leave it for a later sweep
+    _DEBRIS_MIN_AGE_S = 300.0
+
     def _gc(self):
-        import shutil
-        steps = sorted(int(d) for d in os.listdir(self.directory)
-                       if d.isdigit())
-        for s in steps[:-self.max_to_keep]:
+        """Retention over COMMITTED checkpoints only, plus a sweep of
+        uncommitted debris (tmp/old dirs from killed writes; numeric
+        dirs that never got their manifest). Our own just-failed tmp is
+        reaped immediately; anything that could be ANOTHER process's
+        in-flight write is only reaped once it has gone stale."""
+        import time
+        committed = set(committed_steps(self.directory))
+        for s in sorted(committed)[:-self.max_to_keep]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        own = f"-{os.getpid()}"
+        now = time.time()
+        for d in os.listdir(self.directory):
+            p = os.path.join(self.directory, d)
+            if not os.path.isdir(p):
+                continue
+            mine = d.endswith(own)
+            try:
+                stale = now - os.path.getmtime(p) > self._DEBRIS_MIN_AGE_S
+            except OSError:
+                continue  # vanished under us (concurrent GC/commit)
+            s = _step_of(d)
+            if s is not None and s not in committed:
+                # numeric but manifest-less: under the new protocol this
+                # can only be a LEGACY (pre-manifest) checkpoint or a
+                # foreign dir — the commit path never renames anything
+                # numeric into place without its manifest. Deleting
+                # could destroy a prior run's only valid checkpoints on
+                # upgrade, so PRESERVE it; it is merely excluded from
+                # latest_step/retention/restore (uncommittable).
+                continue
+            elif s is None and (".tmp" in d or ".old-" in d):
+                # possibly a peer process's in-flight write: reap only
+                # our own, or clearly abandoned (stale) debris
+                if mine or stale:
+                    shutil.rmtree(p, ignore_errors=True)

@@ -146,7 +146,8 @@ def _worker_main(worker_id, arena_name, task_q, grad_q, param_q,
             losses.append(float(loss.numpy()))
             n_batches += 1
             grad_q.put(("grads", worker_id, gdescs, losses[-1], version))
-    except BaseException as e:  # surface, don't hang the parent
+    except BaseException as e:  # noqa: broad-except — surfaced to the
+        # parent via the grad queue's error record; don't hang the join
         grad_q.put(("error", worker_id, repr(e), None, None))
         return
     finally:

@@ -78,7 +78,8 @@ class HogwildWorker(threading.Thread):
                     self._optimizer.clear_grad()
                 losses.append(float(loss.numpy()))
                 n += 1
-        except BaseException as e:
+        except BaseException as e:  # noqa: broad-except — stored and
+            # re-raised by the coordinating thread after join
             self.error = e
         self._stats[self.worker_id] = {"batches": n, "losses": losses}
 
@@ -212,7 +213,8 @@ class InferWorker(threading.Thread):
                 else:
                     outputs.append(out)
                 n += 1
-        except BaseException as e:
+        except BaseException as e:  # noqa: broad-except — stored and
+            # re-raised by the coordinating thread after join
             self.error = e
         self._stats[self.worker_id] = {"batches": n, "outputs": outputs}
 

@@ -43,7 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import flags as core_flags
-from ..core.async_loss import LossFuture
+from ..core.async_loss import LossFuture, StepFuture
 from ..core.generator import next_key, rng_scope
 from ..core.tensor import Tensor
 from ..autograd import engine as autograd_engine
@@ -71,9 +71,19 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                     clip_global_norm: Optional[float] = None,
                     amp_dtype: Optional[str] = None,
                     recompute: bool = False,
-                    grad_shardings=None):
+                    grad_shardings=None,
+                    check_finite: bool = False):
     """Build the pure train-step: (params, opt_state, batch, key, lr) →
     (loss, params, opt_state).
+
+    ``check_finite=True`` folds device-side bad-step detection into the
+    same executable: a non-finite loss or gradient (NaN batch, amp
+    overflow) flips an on-device flag, the optimizer update is *skipped*
+    via a ``where``-select back to the incoming params/opt_state (so a
+    poisoned batch can never corrupt the model, even while the host is
+    still dispatching ahead of the readback), and the step returns a
+    packed ``[loss, notfinite]`` pair instead of the bare loss — the
+    flag rides the loss's own readback, costing zero extra transfers.
 
     ``loss_fn(model, batch)`` runs the model's eager code; under trace the
     tape is off and jax.grad differentiates the pure function — eager and
@@ -139,6 +149,16 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
             loss = jnp.mean(losses)
         else:
             loss, grads = jax.value_and_grad(pure_loss)(params, batch, key)
+        finite = None
+        if check_finite:
+            # detection sits at the autodiff boundary, on the RAW grads:
+            # clipping/sharding transforms below keep NaN NaN, but the
+            # raw position is what mirrors the reference
+            # check_finite_and_unscale op (amp/check_finite_and_unscale
+            # _op.cu) and stays correct if those transforms change
+            finite = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                finite &= jnp.all(jnp.isfinite(g))
         if grad_shardings is not None:
             # Pin each grad to its ZeRO layout HERE, at the autodiff
             # boundary: the batch reduction then lowers to a
@@ -160,6 +180,17 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                 grads)
         new_params, new_state = optimizer.functional_update(
             params, grads, opt_state, lr)
+        if check_finite:
+            # bad step → keep the incoming params/slots/step-count (the
+            # reference update_loss_scaling "skip update" semantics),
+            # selected on device so run-ahead dispatches after a NaN
+            # step still consume good params
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(finite, n, o), new, old)
+            new_params = keep(new_params, params)
+            new_state = keep(new_state, opt_state)
+            packed = jnp.stack([loss, (~finite).astype(jnp.float32)])
+            return packed, new_params, new_state
         return loss, new_params, new_state
 
     return train_step
@@ -184,6 +215,13 @@ class ParallelEngine:
     inflight_window : max un-synchronized dispatches outstanding before
         ``step``/``step_many`` block on the oldest (dispatch runs ahead
         of the device without unbounded live-buffer growth).
+    check_finite : fold NaN/Inf detection into the compiled step (and
+        the ``step_many`` scan body): non-finite steps skip their
+        update on device, and ``step``/``step_many`` return a
+        :class:`~paddle1_tpu.core.async_loss.StepFuture` whose ``.bad``
+        / ``.bad_mask()`` report the flag from the same packed readback
+        as the loss. The knob behind ``ResilientTrainer``'s bad-step
+        policies.
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
@@ -197,7 +235,8 @@ class ParallelEngine:
                  recompute: bool = False,
                  pp_microbatches: Optional[int] = None,
                  train_steps_per_sync: int = 1,
-                 inflight_window: int = 2):
+                 inflight_window: int = 2,
+                 check_finite: bool = False):
         core_flags.maybe_enable_compilation_cache()
         self.model = model
         self.optimizer = optimizer
@@ -286,12 +325,14 @@ class ParallelEngine:
 
         self.batch_spec = batch_spec  # None → infer batch-dim sharding
         self.grad_accum = grad_accum
+        self.check_finite = bool(check_finite)
         self._step_fn = make_train_step(model, optimizer, loss_fn,
                                         grad_accum=grad_accum,
                                         clip_global_norm=clip_global_norm,
                                         amp_dtype=amp_dtype,
                                         recompute=recompute,
-                                        grad_shardings=self.grad_shardings)
+                                        grad_shardings=self.grad_shardings,
+                                        check_finite=self.check_finite)
 
         ns = lambda spec: NamedSharding(self.mesh, spec)
         param_sh = {k: ns(s) for k, s in self.param_specs.items()}
@@ -326,24 +367,25 @@ class ParallelEngine:
         self._inflight: collections.deque = collections.deque()
 
         # Place initial state on the mesh. The engine must OWN its param
-        # buffers: with donate=True the first step donates them, and a
-        # same-placement device_put can alias the Layer's own array —
-        # donating that deletes the model's live tensors out from under
-        # eager code / fluid.io registry saves. Aliasing is possible
-        # exactly when the leaf's current sharding is equivalent to the
-        # target (then device_put may be a no-op); detect it from
-        # sharding METADATA only — probing buffer pointers would force a
-        # per-param device sync and serialize the async placement.
+        # buffers: with donate=True the first step donates them, and
+        # device_put elides same-device copies PER SHARD — not only for
+        # equivalent shardings but also e.g. single-device → replicated-
+        # on-mesh, where the origin device's shard aliases the Layer's
+        # own array (verified by pointer probe on the CPU sim; the PR 1
+        # metadata-equivalence gate missed exactly this case and a
+        # donated step deleted a live BertModel embedding out from under
+        # the fluid.io registry). So copy UNCONDITIONALLY before
+        # placement: one async elementwise copy per param at init, no
+        # device sync (never probe buffer pointers here — that
+        # serializes the async placement, PR 1's perf lesson).
         def _owned(v, sh):
             if isinstance(v, jax.Array):
-                cur = getattr(v, "sharding", None)
                 try:
-                    if cur is not None and cur.is_equivalent_to(
-                            sh, np.ndim(v)):
-                        return jax.device_put(jnp.array(v, copy=True),
-                                              sh)
+                    return jax.device_put(jnp.array(v, copy=True), sh)
                 except Exception:
-                    pass  # conservative: fall through to plain placement
+                    pass  # exotic leaf: plain placement (donation of an
+                    # alias is then possible — but nothing reached this
+                    # in practice; numeric params always copy above)
             return jax.device_put(v, sh)
 
         self.params = {k: _owned(v, param_sh[k])
@@ -476,7 +518,8 @@ class ParallelEngine:
         sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(sched, "step"):
             sched.step()
-        return self._push_inflight(LossFuture(loss))
+        wrap = StepFuture if self.check_finite else LossFuture
+        return self._push_inflight(wrap(loss))
 
     def _jit_many(self, k: int):
         fn = self._jit_many_cache.get(k)
@@ -535,7 +578,11 @@ class ParallelEngine:
         self.dispatch_count += 1
         losses, self.params, self.opt_state = self._jit_many(k)(
             self.params, self.opt_state, stacked, keys, lrs)
-        return self._push_inflight(LossFuture(losses))
+        # check_finite: the scan body already emits packed [loss,
+        # notfinite] pairs, so `losses` is [k, 2] and the per-step flags
+        # ride the same single readback
+        wrap = StepFuture if self.check_finite else LossFuture
+        return self._push_inflight(wrap(losses))
 
     def step_stream(self, batches, lr: Optional[float] = None):
         """Drive training from any batch iterable at the engine's
@@ -587,12 +634,18 @@ class ParallelEngine:
 
     def sync_model(self) -> None:
         """Write engine params back into the Layer (for save/eval).
-        Drains in-flight multi-step work first."""
+        Drains in-flight multi-step work first. With donation on, the
+        Layer gets sharding-preserving COPIES — handing it the engine's
+        live buffers would let the next donating step delete the
+        model's tensors out from under eager code / registry saves
+        (the resume-then-continue-training pattern ResilientTrainer
+        relies on)."""
         self.drain()
         sd = self.model.state_dict()
         for k, arr in self.params.items():
             if k in sd:
-                sd[k]._data = arr
+                sd[k]._data = jnp.array(arr, copy=True) if self._donate \
+                    else arr
 
     # -- sharded checkpoint (reference save_persistables sliced-vars
     # analog; see distributed/checkpoint.py) ---------------------------------

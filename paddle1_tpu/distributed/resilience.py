@@ -1,0 +1,464 @@
+"""Fault-tolerant training runtime around :class:`ParallelEngine`.
+
+Reference analog: ``incubate/auto_checkpoint`` (trainer-side periodic
+checkpoint + resume-from-epoch on restart) and the dynamic-loss-scaling
+"skip bad step" protocol of ``update_loss_scaling_op`` — composed here
+into one loop so a long run survives the three killers of multi-host
+training: bad batches (NaN/Inf), transient step/save failures, and
+preemptions.
+
+Division of labor:
+
+* the **device** detects and neutralizes bad steps — the engine's
+  ``check_finite`` step computes an isfinite flag over loss+grads inside
+  the compiled executable and where-selects the old params when it
+  trips, so a poisoned batch can never corrupt the model even while the
+  host dispatches ahead; the flag rides the loss's packed readback
+  (:class:`~paddle1_tpu.core.async_loss.StepFuture`) at zero extra cost;
+* the **host** decides what a bad step *means* — policy ``raise`` /
+  ``skip`` / ``restore_last_good`` — feeds the outcome into
+  :class:`~paddle1_tpu.amp.GradScaler` dynamic scaling when one is
+  attached, watches for loss explosions (finite but diverging), retries
+  transient failures with bounded exponential backoff, checkpoints
+  every ``save_freq`` steps through the atomic-commit
+  :class:`CheckpointManager`, and resumes — params, optimizer state,
+  RNG stream, LR schedule, and data-iterator position — from the newest
+  checkpoint that verifies.
+
+Determinism contract: ``fit`` consumes exactly one batch of the
+(replayable) ``data`` stream per global step and one RNG key per step,
+and checkpoints carry the RNG/LR state — so a run that is preempted,
+restored and replayed is bit-compatible with an uninterrupted run. The
+chaos tests (tests/test_resilience.py) assert this to 1e-6.
+
+Usage::
+
+    engine = ParallelEngine(model, opt, loss_fn, check_finite=True)
+    trainer = ResilientTrainer(engine, "/ckpts/run7", save_freq=100,
+                               bad_step_policy="skip")
+    report = trainer.fit(lambda: loader, steps=10_000)
+    # kill -9 at any point; rerunning the same script resumes from the
+    # last committed checkpoint and reports report.resumed_from
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from ..core import chaos
+from ..core import flags as core_flags
+from ..core.errors import InvalidArgumentError
+from ..core.generator import get_rng_state, set_rng_state
+from .checkpoint import CheckpointCorruptError, CheckpointManager
+
+__all__ = ["ResilientTrainer", "ResilienceReport", "BadStepError"]
+
+POLICIES = ("raise", "skip", "restore_last_good")
+
+
+class BadStepError(FloatingPointError):
+    """A non-finite (or diverged) training step under policy 'raise'.
+    The model params are still at their last good values: the compiled
+    step skipped the poisoned update on device before the host saw the
+    flag."""
+
+
+@dataclass
+class ResilienceReport:
+    """What the resilient loop actually did (the counters the chaos
+    acceptance matrix checks)."""
+    steps_done: int = 0            # unique applied steps (net progress)
+    steps_replayed: int = 0        # applied again after a rollback
+    bad_steps: int = 0             # non-finite flags seen
+    steps_skipped: int = 0         # bad steps consumed under 'skip'
+    divergence_trips: int = 0      # finite-but-exploding losses
+    retries: int = 0               # transient-failure retries (step+save)
+    restores: int = 0              # checkpoint rollbacks (any cause)
+    preemptions: int = 0           # preemption signals handled
+    checkpoints_written: int = 0
+    checkpoint_write_failures: int = 0  # saves abandoned after retries
+    resumed_from: Optional[int] = None  # step picked up on fit() entry
+    final_step: int = 0
+    final_loss: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        from dataclasses import asdict
+        return asdict(self)
+
+
+def _flag_default(value, name):
+    return core_flags.flag(name) if value is None else value
+
+
+class ResilientTrainer:
+    """Periodic-checkpoint, resume, retry and bad-step-policy wrapper
+    around a ``check_finite`` :class:`ParallelEngine`.
+
+    Parameters
+    ----------
+    engine : ParallelEngine built with ``check_finite=True`` (the
+        device-side detection the policies depend on).
+    directory : checkpoint directory (a ``CheckpointManager`` over it).
+    save_freq : checkpoint every N applied steps (flag ``ft_save_freq``).
+    bad_step_policy : 'raise' | 'skip' | 'restore_last_good'
+        (flag ``ft_bad_step_policy``). ``skip`` counts the step and
+        moves on (the update was already skipped on device);
+        ``restore_last_good`` rolls back to the newest verified
+        checkpoint and replays the data stream from there (a poisoned
+        occurrence is injected/transient, so the replay comes back
+        clean). A *finite* loss caught by the divergence watchdog
+        cannot be skipped post-hoc (its update was applied), so under
+        both non-raise policies it restores.
+    max_retries / backoff_base_s / backoff_max_s : bounded exponential
+        backoff around transient step/save failures (``ft_*`` flags).
+        Only ``Exception`` is retried: ``KeyboardInterrupt``,
+        ``SystemExit`` and :class:`SimulatedPreemption` always unwind.
+    divergence_factor : loss > factor * running-mean ⇒ bad step
+        (0 disables; flag ``ft_divergence_factor``).
+    scaler : optional :class:`~paddle1_tpu.amp.GradScaler`; every step
+        outcome is fed to ``scaler.record_step`` so dynamic loss
+        scaling tracks device-detected overflows.
+    max_to_keep : checkpoint retention window.
+
+    Performance note: per-step policy decisions (and the watchdog)
+    require reading the packed loss+flag back every step, which costs
+    one host round trip per step — the robustness tax. Params can
+    never go bad regardless (the where-select skip happens on device),
+    so throughput-critical runs should keep using
+    ``engine.step_stream``/``step_many`` (flags still computed, read
+    per chunk) and reserve ResilientTrainer for runs where per-step
+    policy reaction and auto-restore matter; a lagged-flag mode
+    (react within ``inflight_window`` steps) is the natural extension.
+    """
+
+    def __init__(self, engine, directory: str,
+                 save_freq: Optional[int] = None,
+                 bad_step_policy: Optional[str] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
+                 divergence_factor: Optional[float] = None,
+                 scaler=None, max_to_keep: int = 3,
+                 sleep: Callable[[float], None] = time.sleep):
+        if not getattr(engine, "check_finite", False):
+            raise InvalidArgumentError(
+                "ResilientTrainer needs an engine built with "
+                "check_finite=True — bad-step policies are driven by "
+                "the device-side isfinite flag")
+        self.engine = engine
+        self.manager = CheckpointManager(directory, max_to_keep=max_to_keep)
+        self.save_freq = int(_flag_default(save_freq, "ft_save_freq"))
+        self.policy = _flag_default(bad_step_policy, "ft_bad_step_policy")
+        if self.policy not in POLICIES:
+            raise InvalidArgumentError(
+                f"bad_step_policy must be one of {POLICIES}, "
+                f"got {self.policy!r}")
+        self.max_retries = int(_flag_default(max_retries, "ft_max_retries"))
+        self.backoff_base_s = float(
+            _flag_default(backoff_base_s, "ft_backoff_base_s"))
+        self.backoff_max_s = float(
+            _flag_default(backoff_max_s, "ft_backoff_max_s"))
+        self.divergence_factor = float(
+            _flag_default(divergence_factor, "ft_divergence_factor"))
+        self.scaler = scaler
+        self._sleep = sleep
+        self.report = ResilienceReport()
+        self._loss_ema: Optional[float] = None
+        self._ema_warmup = 0
+        self._restore_streak = (None, 0)  # (global step, repeats)
+        self._last_saved: Optional[int] = None
+        chaos.configure_from_flags()  # no-op when FLAGS_ft_chaos empty
+
+    # -- engine state <-> checkpoint ------------------------------------
+
+    def _state(self):
+        return {"params": self.engine.params,
+                "opt_state": self.engine.opt_state}
+
+    def _sched(self):
+        sched = getattr(self.engine.optimizer, "_learning_rate", None)
+        return sched if hasattr(sched, "state_dict") else None
+
+    def _meta(self, step: int) -> Dict[str, Any]:
+        meta = {"step": int(step), "rng": get_rng_state(),
+                # host-side recovery state rides the checkpoint too:
+                # replayed steps would otherwise double-feed the
+                # watchdog EMA / dynamic loss scale and break the
+                # replay-parity contract
+                "watchdog": {"ema": self._loss_ema,
+                             "warmup": self._ema_warmup}}
+        if self.scaler is not None:
+            try:
+                meta["scaler"] = {
+                    k: v for k, v in self.scaler.state_dict().items()
+                    if isinstance(v, (int, float, bool))}
+            except Exception as e:
+                warnings.warn(f"GradScaler state not checkpointed: {e}")
+        sched = self._sched()
+        if sched is not None:
+            try:
+                meta["lr_sched"] = {k: float(v) if isinstance(v, (int, float))
+                                    else v
+                                    for k, v in sched.state_dict().items()}
+            except Exception as e:
+                warnings.warn(f"LR scheduler state not checkpointed: {e}")
+        return meta
+
+    def save(self, step: int) -> bool:
+        """Drain in-flight work and atomically commit a checkpoint;
+        transient write failures retry with backoff, and a save that
+        still fails is *counted and survived* (training goes on from
+        the previous checkpoint window)."""
+        self.engine.drain()
+        try:
+            self._retrying(
+                lambda: self.manager.save(step, self._state(),
+                                          meta=self._meta(step)),
+                what=f"checkpoint save (step {step})")
+        except Exception as e:
+            self.report.checkpoint_write_failures += 1
+            warnings.warn(
+                f"checkpoint at step {step} abandoned after "
+                f"{self.max_retries} retries ({e}); continuing — the "
+                f"restore window stays at step {self.manager.latest_step()}")
+            return False
+        self.report.checkpoints_written += 1
+        self._last_saved = int(step)
+        return True
+
+    def restore_latest(self) -> int:
+        """Roll engine + RNG + LR schedule + host recovery state back to
+        the newest checkpoint that verifies (falling back past corrupt
+        ones). Returns the restored global step."""
+        try:
+            restored, ckpt_step = self.manager.restore(self._state())
+        except FileNotFoundError as e:
+            # a survivable path here: every save so far was abandoned
+            # (persistent storage outage) — name the real cause instead
+            # of a bare "no checkpoints" far from it
+            raise CheckpointCorruptError(
+                "recovery needs a checkpoint but none was ever "
+                f"committed under {self.manager.directory} "
+                f"({self.report.checkpoint_write_failures} abandoned "
+                "write(s) this run — see the checkpoint-save warnings "
+                "above)") from e
+        self.engine.params = restored["params"]
+        self.engine.opt_state = restored["opt_state"]
+        self.engine.sync_model()
+        meta = self.manager.read_meta(ckpt_step) or {}
+        if "rng" in meta:
+            set_rng_state(meta["rng"])
+        wd = meta.get("watchdog")
+        if wd is not None:
+            self._loss_ema = wd.get("ema")
+            self._ema_warmup = int(wd.get("warmup", 0))
+        if self.scaler is not None and "scaler" in meta:
+            try:
+                self.scaler.load_state_dict(meta["scaler"])
+            except Exception as e:
+                warnings.warn(f"GradScaler state not restored: {e}")
+        sched = self._sched()
+        if sched is not None and "lr_sched" in meta:
+            try:
+                sched.set_state_dict(meta["lr_sched"])
+            except Exception as e:
+                warnings.warn(f"LR scheduler state not restored: {e}")
+        self.report.restores += 1
+        return int(meta.get("step", ckpt_step))
+
+    # -- retry wrapper ---------------------------------------------------
+
+    def _retrying(self, fn: Callable[[], Any], what: str):
+        """Bounded exponential backoff around a transient operation.
+        Retries ``Exception`` only — interrupts (KeyboardInterrupt,
+        SystemExit, SimulatedPreemption) always unwind to their real
+        handler."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                self.report.retries += 1
+                delay = min(self.backoff_base_s * (2.0 ** (attempt - 1)),
+                            self.backoff_max_s)
+                warnings.warn(
+                    f"{what} failed ({type(e).__name__}: {e}); "
+                    f"retry {attempt}/{self.max_retries} in {delay:.2f}s")
+                if delay:
+                    self._sleep(delay)
+
+    def _bump_restore_streak(self, step: int, why: str) -> None:
+        """Bound EVERY restore-and-replay loop: a deterministic fault
+        at one stream position (persistently bad data, a batch that
+        always kills the readback) must raise after max_retries
+        replays, not spin forever."""
+        prev, count = self._restore_streak
+        count = count + 1 if prev == step else 1
+        self._restore_streak = (step, count)
+        if count > max(self.max_retries, 1):
+            raise BadStepError(
+                f"step {step} failed {count} restore-and-replay "
+                f"attempts ({why}) — the fault is deterministic, not "
+                "transient; fix the input (or use "
+                "bad_step_policy='skip' for bad data)")
+
+    # -- divergence watchdog --------------------------------------------
+
+    def _diverged(self, loss: float) -> bool:
+        if self.divergence_factor <= 0:
+            return False
+        if self._loss_ema is None or self._ema_warmup < 5:
+            self._ema_warmup += 1
+            self._loss_ema = loss if self._loss_ema is None else \
+                0.7 * self._loss_ema + 0.3 * loss
+            return False
+        if loss > self.divergence_factor * max(abs(self._loss_ema), 1e-8):
+            return True
+        self._loss_ema = 0.9 * self._loss_ema + 0.1 * loss
+        return False
+
+    # -- the loop --------------------------------------------------------
+
+    def _data_iter(self, data_factory, start: int):
+        """Fresh iterator over the (replayable) stream, fast-forwarded
+        past the ``start`` batches the restored checkpoint already
+        consumed (one batch per global step, the resume contract)."""
+        it = iter(data_factory())
+        return itertools.islice(it, start, None) if start else it
+
+    def fit(self, data: Callable[[], Iterable], steps: int,
+            lr: Optional[float] = None) -> ResilienceReport:
+        """Run up to ``steps`` global steps with checkpoints, resume,
+        retries and bad-step policies. ``data`` is a zero-arg factory
+        returning a fresh deterministic batch iterable — required so
+        restore/resume can replay the stream from any step."""
+        if not callable(data):
+            raise InvalidArgumentError(
+                "data must be a zero-arg factory returning a fresh "
+                "batch iterable (resume/restore replay the stream); "
+                "pass `lambda: loader`, not the loader itself")
+        self.report = ResilienceReport()
+        if self.manager.latest_step() is not None:
+            step = self.restore_latest()
+            self.report.resumed_from = step
+            self.report.restores -= 1  # resume-on-entry is not a rollback
+        else:
+            step = 0
+            # a step-0 baseline guarantees restore_last_good/preemption
+            # always have a rollback target, even before the first
+            # periodic save
+            self.save(0)
+        it = self._data_iter(data, step)
+        last_loss = None
+        max_step = step  # high-water mark: steps below it are replays
+        while step < steps:
+            try:
+                chaos.check_preempt()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break  # stream exhausted before `steps`
+                if chaos.enabled():
+                    batch = chaos.maybe_poison(batch)
+
+                # Two distinct retry surfaces with different semantics:
+                # a DISPATCH failure applied nothing, so re-running the
+                # step is safe; a READBACK failure arrives after the
+                # update may already have landed on device, so only the
+                # fetch is retried (the future re-fetches on failure —
+                # it caches on success only). If the readback never
+                # succeeds the step's outcome is unknown: roll back to
+                # certainty instead of guessing.
+                fut = self._retrying(
+                    lambda: self.engine.step(batch, lr),
+                    what=f"train step {step} dispatch")
+                try:
+                    loss = self._retrying(
+                        lambda: float(fut),  # one packed fetch: loss+flag
+                        what=f"train step {step} readback")
+                except Exception as e:
+                    warnings.warn(
+                        f"step {step} outcome unknown (readback failed "
+                        f"after dispatch: {e}); restoring last good "
+                        "checkpoint")
+                    self._bump_restore_streak(
+                        step, f"readback failure ({e})")
+                    step = self.restore_latest()
+                    it = self._data_iter(data, step)
+                    continue
+                bad = fut.bad
+                diverged = False
+                if not bad and self._diverged(loss):
+                    diverged = True
+                    self.report.divergence_trips += 1
+                if bad or diverged:
+                    self.report.bad_steps += 1
+                    if self.scaler is not None:
+                        self.scaler.record_step(found_inf=True)
+                    step, it = self._handle_bad_step(
+                        step, diverged, loss, data, it)
+                else:
+                    if self.scaler is not None:
+                        self.scaler.record_step(found_inf=False)
+                    step += 1
+                    if step > max_step:
+                        max_step = step
+                        self.report.steps_done += 1
+                    else:  # re-applying work a rollback rewound past
+                        self.report.steps_replayed += 1
+                    last_loss = loss
+                # the periodic-save check sits OUTSIDE the good/bad
+                # branch: a skipped bad step that lands on a save
+                # boundary must not silently double the rollback window
+                if self.save_freq and step % self.save_freq == 0 \
+                        and 0 < step < steps \
+                        and self._last_saved != step:
+                    self.save(step)
+            except chaos.SimulatedPreemption as e:
+                self.report.preemptions += 1
+                if getattr(e, "graceful", False):
+                    # an advance NOTICE (SIGTERM grace window): the
+                    # current params are known-good — checkpoint them
+                    # NOW so the next incarnation loses nothing, then
+                    # keep training until actually killed
+                    self.save(step)
+                    continue
+                # ungraceful (simulated kill): roll back and replay
+                step = self.restore_latest()
+                it = self._data_iter(data, step)
+        self.save(step)
+        self.engine.sync_model()
+        self.report.final_step = step
+        self.report.final_loss = last_loss
+        return self.report
+
+    def _handle_bad_step(self, step: int, diverged: bool, loss: float,
+                         data, it):
+        """Apply the bad-step policy; returns the (possibly rewound)
+        (step, iterator)."""
+        kind = "diverged" if diverged else "non-finite"
+        if self.policy == "raise":
+            raise BadStepError(
+                f"{kind} training step at global step {step} "
+                f"(loss={loss}); params keep their last good values — "
+                "set bad_step_policy='skip' or 'restore_last_good' to "
+                "continue through this automatically")
+        if self.policy == "skip" and not diverged:
+            # update already skipped on device; consume the slot
+            self.report.steps_skipped += 1
+            return step + 1, it
+        # restore_last_good — and the only sound treatment of a
+        # diverged-but-finite step (its update was applied on device)
+        self._bump_restore_streak(step, f"{kind} data")
+        warnings.warn(
+            f"{kind} step at global step {step}: restoring last good "
+            "checkpoint and replaying")
+        new_step = self.restore_latest()
+        return new_step, self._data_iter(data, new_step)

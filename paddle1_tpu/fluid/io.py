@@ -123,7 +123,18 @@ _written_keys: dict = {}
 
 def _write(dirname, filename, tensors, default):
     os.makedirs(dirname, exist_ok=True)
-    payload = {k: np.asarray(t.numpy()) for k, t in tensors.items()}
+    payload = {}
+    for k, t in tensors.items():
+        try:
+            payload[k] = np.asarray(t.numpy())
+        except RuntimeError as e:
+            # a deleted backing buffer (donated by a compiled step that
+            # aliased this registry tensor) — name the variable, or the
+            # failure is undebuggable in a registry-wide save
+            raise RuntimeError(
+                f"variable {k!r} in the save set has a deleted backing "
+                f"array ({e}); it was aliased into a donating compiled "
+                "step — sync/copy before saving") from e
     path = os.path.abspath(os.path.join(dirname, filename or default))
     if os.path.exists(path) and _written_keys.get(path) != set(payload):
         # Overwriting the same (or a grown) checkpoint as training

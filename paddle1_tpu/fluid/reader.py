@@ -128,8 +128,10 @@ class PyReader:
                 for item in gen():
                     if not put(item):
                         return
-            except BaseException as e:   # surfaces in read(), not a
-                tail = ("__pyreader_error__", e)   # silent epoch end
+            except BaseException as e:   # noqa: broad-except —
+                # re-raised in read() via the error sentinel instead of
+                # a silent early epoch end
+                tail = ("__pyreader_error__", e)
             put(tail)
         self._thread = threading.Thread(target=fill, daemon=True)
         self._thread.start()

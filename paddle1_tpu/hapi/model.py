@@ -9,6 +9,7 @@ train_batch via to_static when beneficial).
 from __future__ import annotations
 
 import collections
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -116,7 +117,27 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, resume=False):
+        """Train for ``epochs`` epochs.
+
+        Fault-tolerance knobs (the reference incubate/auto_checkpoint
+        train_epoch_range role at the hapi level; engine-scale runs
+        should use :class:`paddle1_tpu.distributed.ResilientTrainer`):
+        ``save_dir`` + ``save_freq`` checkpoint network+optimizer every
+        N epochs; ``resume=True`` picks the largest epoch checkpoint
+        already under ``save_dir`` (non-numeric/partial entries are
+        skipped), loads it, and continues from the NEXT epoch.
+        """
+        start_epoch = 0
+        if resume:
+            if not save_dir:
+                raise InvalidArgumentError(
+                    "fit(resume=True) needs save_dir (the checkpoint "
+                    "directory to resume from)")
+            latest = _latest_saved_epoch(save_dir)
+            if latest is not None:
+                self.load(os.path.join(save_dir, str(latest)))
+                start_epoch = latest + 1
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -134,13 +155,16 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
+        if start_epoch >= epochs:
+            cbks.on_train_end()
+            return
         # Bounded dispatch run-ahead: keep at most `window` batches of
         # un-synchronized loss futures outstanding, then block (device
         # sync, NOT a readback) on the oldest — dispatch runs ahead of
         # the device without unbounded live-buffer growth.
         window: collections.deque = collections.deque()
         window_size = 2
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
@@ -166,7 +190,6 @@ class Model:
                 self.evaluate(eval_loader, batch_size=batch_size,
                               verbose=verbose, callbacks=callbacks)
             if save_dir and (epoch + 1) % save_freq == 0:
-                import os
                 self.save(os.path.join(save_dir, str(epoch)))
             if self.stop_training or (num_iters is not None and
                                       it >= num_iters):
@@ -220,7 +243,6 @@ class Model:
             fsave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
-        import os
         from ..framework.io import load as fload
         state = fload(path + ".pdparams")
         self.network.set_state_dict(state)
@@ -245,6 +267,23 @@ class Model:
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               drop_last=drop_last, num_workers=num_workers)
         return data  # assume iterable of batches
+
+
+def _latest_saved_epoch(save_dir):
+    """Largest N with ``<save_dir>/<N>.pdparams`` present, or None.
+    Non-numeric and partial entries (a ``.pdparams`` name that doesn't
+    parse, or files from other tooling) are skipped, mirroring the
+    hardened ``distributed.checkpoint.latest_step``."""
+    import re
+    if not os.path.isdir(save_dir):
+        return None
+    best = None
+    for name in os.listdir(save_dir):
+        m = re.fullmatch(r"(\d+)\.pdparams", name)
+        if m is not None:
+            n = int(m.group(1))
+            best = n if best is None else max(best, n)
+    return best
 
 
 def _to_tensor(x):

@@ -105,6 +105,7 @@ class _SingleProcessIter:
         return False
 
     def _producer(self):
+        from ..core import chaos
         try:
             if self._dataset_iter is not None:
                 bs = self._loader.batch_size or 1
@@ -114,6 +115,8 @@ class _SingleProcessIter:
                         break
                     if len(samples) < bs and self._loader.drop_last:
                         break
+                    if chaos.enabled():
+                        chaos.check_loader()
                     batch = self._loader.collate_fn(samples)
                     batch = self._stage(batch)
                     if not self._put(batch):
@@ -122,11 +125,22 @@ class _SingleProcessIter:
                 for indices in self._batch_iter:
                     if self._stop.is_set():
                         break
+                    if chaos.enabled():
+                        chaos.check_loader()
                     batch = self._load_batch(indices)
                     batch = self._stage(batch)
                     if not self._put(batch):
                         return
-        except BaseException as e:  # surfaced on next()
+        except BaseException as e:  # noqa: broad-except — stored and
+            # re-raised on the consumer's next(); a producer-thread error
+            # must cross the queue, not die silently with the thread
+            if isinstance(e, (StopIteration, StopAsyncIteration)):
+                # PEP 479 semantics: a StopIteration leaking out of
+                # dataset code would read as a clean (early!) epoch end
+                # in __next__ — surface it as the error it is
+                e = RuntimeError(
+                    "DataLoader worker raised StopIteration "
+                    "(dataset raised it past the epoch boundary)")
             self._err = e
         finally:
             if not self._put(self._done):   # normal epoch end
@@ -143,7 +157,11 @@ class _SingleProcessIter:
     def __next__(self):
         if self._finished:
             # the _done sentinel is single-shot: without this, a second
-            # next() after exhaustion blocks forever on the empty queue
+            # next() after exhaustion blocks forever on the empty queue.
+            # A worker error stays sticky — every subsequent next()
+            # re-raises it instead of reporting a clean epoch end.
+            if self._err is not None:
+                raise self._err
             raise StopIteration
         item = self._prefetch_q.get()
         if item is self._done:
@@ -264,9 +282,10 @@ def _mp_worker_loop(dataset, task_q, result_q, arena_name, collate_fn,
             descs = [arena.put_array(arr) for arr in leaves]
             result_q.put((seq, pickle.dumps({"descs": descs, "keys": keys})))
             produced += 1
-    except KeyboardInterrupt:
-        pass
-    except BaseException as e:
+    except KeyboardInterrupt:  # noqa: broad-except — worker process:
+        pass                   # ctrl-C belongs to the parent, die quietly
+    except BaseException as e:  # noqa: broad-except — forwarded to the
+        # parent through the result queue (seq -1 = worker error record)
         result_q.put((-1, pickle.dumps(repr(e))))
     finally:
         arena.close()

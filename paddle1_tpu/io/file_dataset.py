@@ -231,7 +231,8 @@ class QueueDataset(DatasetBase, IterableDataset):
                     for s in self._parse_file(p):
                         if not put(s):
                             return  # consumer gone: close files/pipes
-            except BaseException as e:  # propagate into the consumer
+            except BaseException as e:  # noqa: broad-except —
+                # propagated into the consumer via err[]
                 err.append(e)
             finally:
                 put(self._SENTINEL)

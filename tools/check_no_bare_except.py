@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Lint: no exception handler may swallow interrupts.
+
+The fault-tolerance stack is built on retry wrappers and
+surface-worker-errors-later queues — exactly the code shapes that, when
+written as ``except:`` or a swallowed ``except BaseException``, eat
+``KeyboardInterrupt``/``SystemExit``/``SimulatedPreemption`` and turn
+"ctrl-C the run" or "preempt the worker" into a silent hang. This
+checker enforces, over the runtime packages:
+
+* **bare ``except:``** — always an error (it is ``except BaseException``
+  in disguise);
+* **``except BaseException`` / ``except KeyboardInterrupt`` /
+  ``except SystemExit``** — an error unless the handler body contains a
+  ``raise``, or the ``except`` line carries an explicit
+  ``# noqa: broad-except`` marker documenting why the catch is sound
+  (e.g. a producer thread forwarding the error object to its consumer,
+  where it IS re-raised).
+
+Retry wrappers must catch ``Exception``, never broader.
+
+Usage: ``python tools/check_no_bare_except.py [paths...]`` — default
+paths are the runtime packages. Exit 0 clean, 1 with findings (one
+``path:line: message`` per finding).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+MARKER = "noqa: broad-except"
+DEFAULT_PATHS = ("paddle1_tpu", "tools", "bench.py", "benches.py")
+BROAD_NAMES = {"BaseException", "KeyboardInterrupt", "SystemExit",
+               "GeneratorExit"}
+
+
+def _exception_names(node: ast.expr) -> Iterator[str]:
+    """Names caught by an except clause's type expression."""
+    if isinstance(node, ast.Tuple):
+        for elt in node.elts:
+            yield from _exception_names(elt)
+    elif isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+
+
+def _contains_raise(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return True
+    return False
+
+
+def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
+    """(line, message) findings for one file's source text."""
+    findings: List[Tuple[int, str]] = []
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+
+    def marked(lineno: int) -> bool:
+        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        return MARKER in line
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            if not marked(node.lineno):
+                findings.append((
+                    node.lineno,
+                    "bare 'except:' swallows KeyboardInterrupt/"
+                    "SystemExit — catch Exception (or narrower)"))
+            continue
+        broad = [n for n in _exception_names(node.type)
+                 if n in BROAD_NAMES]
+        if broad and not _contains_raise(node) and not marked(node.lineno):
+            findings.append((
+                node.lineno,
+                f"'except {'/'.join(broad)}' without re-raise — a retry/"
+                "cleanup wrapper here can swallow interrupts; catch "
+                "Exception, re-raise, or justify with "
+                f"'# {MARKER} — <reason>'"))
+    return findings
+
+
+def iter_py_files(paths) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv or [os.path.join(repo_root, p) for p in DEFAULT_PATHS]
+    total = 0
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            print(f"{path}:0: unreadable ({e})")
+            total += 1
+            continue
+        for lineno, msg in check_source(src, path):
+            print(f"{path}:{lineno}: {msg}")
+            total += 1
+    if total:
+        print(f"check_no_bare_except: {total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
