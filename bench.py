@@ -368,6 +368,145 @@ def bench_chaos_soak(on_tpu, steps_override=None):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_ELASTIC_WORKER = '''\
+"""bench --elastic worker: deterministic tiny-MLP training through
+ResilientTrainer (checkpoints + resume), final params to npz."""
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle1_tpu as paddle
+from paddle1_tpu.core.tensor import Tensor
+from paddle1_tpu.distributed import (ParallelEngine, ResilientTrainer,
+                                     build_mesh)
+
+steps = int(os.environ["P1T_ELASTIC_STEPS"])
+save_freq = int(os.environ["P1T_ELASTIC_SAVE_FREQ"])
+paddle.seed(0)
+model = paddle.nn.Sequential(
+    paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4))
+for i, p in enumerate(model.parameters()):
+    p._data = jax.numpy.asarray(
+        np.random.default_rng(7 + i)
+        .standard_normal(p.shape).astype(np.float32) * 0.1)
+opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+loss_fn = lambda m, b: ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+engine = ParallelEngine(model, opt, loss_fn,
+                        mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+                        check_finite=True)
+rng = np.random.default_rng(0)
+batches = [{"x": rng.standard_normal((8, 16)).astype(np.float32),
+            "y": rng.standard_normal((8, 4)).astype(np.float32)}
+           for _ in range(steps)]
+trainer = ResilientTrainer(engine, os.environ["P1T_ELASTIC_CKPT"],
+                           save_freq=save_freq,
+                           bad_step_policy="restore_last_good",
+                           backoff_base_s=0.0)
+report = trainer.fit(lambda: list(batches), steps=steps)
+np.savez(os.environ["P1T_ELASTIC_OUT"],
+         **{k.replace("/", "__"): np.asarray(v)
+            for k, v in engine.params.items()})
+print(f"ELASTIC final_step={report.final_step} "
+      f"resumed_from={report.resumed_from}", flush=True)
+'''
+
+
+def bench_elastic_soak(on_tpu, steps_override=None):
+    """``--elastic``: supervised kill-and-restart soak of the launcher.
+
+    Trains the same deterministic tiny MLP twice under the Supervisor —
+    once clean, once with ``worker_kill`` chaos SIGKILLing the worker
+    mid-run (policy ``restart``: the supervisor relaunches the rank,
+    which resumes from its last committed checkpoint). ``vs_baseline``
+    is the elastic recovery contract: 1.0 iff the killed-and-restarted
+    run's final params match the clean run to 1e-6 AND exactly one
+    restart was performed.
+    """
+    import os
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    from paddle1_tpu.distributed import Supervisor
+
+    steps = steps_override or 12
+    if steps < 4:
+        raise SystemExit(
+            f"--elastic needs --steps >= 4 (got {steps}): the kill is "
+            "armed past a mid-run checkpoint commit and must land "
+            "before the run ends")
+    save_freq = max(steps // 6, 1)
+    # worker_kill counts health BEATS, and ResilientTrainer beats ~3x
+    # per step (loop + dispatch-retry + readback-retry) plus 2 per save
+    # — aim for mid-run so the kill lands PAST mid-run commits and well
+    # before the end; the resumed_from assertion below keeps this gate
+    # honest if the per-step beat count ever changes
+    kill_beat = (3 * steps + 2 * (steps // save_freq) + 2) // 2
+    tmp = tempfile.mkdtemp(prefix="p1t_elastic_")
+    worker_py = os.path.join(tmp, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(_ELASTIC_WORKER)
+
+    def run_supervised(tag, chaos_spec):
+        env = dict(os.environ)
+        env.pop("FLAGS_ft_chaos", None)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env.update({
+            # the worker script lives in the tmp dir: python puts the
+            # script's dir (not our cwd) on sys.path
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "P1T_ELASTIC_STEPS": str(steps),
+            "P1T_ELASTIC_SAVE_FREQ": str(save_freq),
+            "P1T_ELASTIC_CKPT": os.path.join(tmp, tag, "ckpts"),
+            "P1T_ELASTIC_OUT": os.path.join(tmp, tag, "params.npz"),
+        })
+        if chaos_spec:
+            env["FLAGS_ft_chaos"] = chaos_spec
+        os.makedirs(os.path.join(tmp, tag), exist_ok=True)
+        sup = Supervisor(policy="restart", max_restarts=2,
+                         heartbeat_dir=os.path.join(tmp, tag, "hb"),
+                         poll_s=0.2, grace_s=5.0)
+        sup.add_worker(0, [_sys.executable, "-u", worker_py], env=env,
+                       log_path=os.path.join(tmp, tag, "workerlog.0"))
+        rc = sup.run()
+        log = open(os.path.join(tmp, tag, "workerlog.0")).read()
+        if rc != 0:
+            raise AssertionError(
+                f"elastic soak {tag} run failed rc={rc}: {log[-2000:]}")
+        import re
+        m = re.findall(r"resumed_from=(\S+)", log)
+        resumed_from = (int(m[-1]) if m and m[-1] != "None" else None)
+        out = np.load(os.path.join(tmp, tag, "params.npz"))
+        return {k: out[k] for k in out.files}, sup.report, resumed_from
+
+    try:
+        t0 = time.perf_counter()
+        clean, _, _ = run_supervised("clean", "")
+        faulted, report, resumed_from = run_supervised(
+            "kill", f"worker_kill@{kill_beat}:0")
+        dt = time.perf_counter() - t0
+        max_err = max(float(np.max(np.abs(clean[k] - faulted[k])))
+                      for k in clean)
+        # resumed_from >= save_freq proves the restarted worker picked
+        # up a MID-RUN commit (a step-0-baseline resume replays the
+        # whole run and would pass parity trivially)
+        recovered = (max_err <= 1e-6 and report.total_restarts == 1
+                     and resumed_from is not None
+                     and resumed_from >= save_freq)
+        detail = dict(report.as_dict(), steps=steps, save_freq=save_freq,
+                      kill_beat=kill_beat, resumed_from=resumed_from,
+                      max_param_err=max_err, elapsed_s=round(dt, 3))
+        _emit("elastic_soak_recovered_steps_per_sec", steps / dt,
+              "steps/s", 1.0 if recovered else 0.0, detail)
+        if not recovered:
+            raise AssertionError(
+                f"elastic soak did NOT recover: {json.dumps(detail)}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import os
     ap = argparse.ArgumentParser()
@@ -385,6 +524,13 @@ def main():
                     help="fuse k train steps into one executable "
                          "(engine.step_many) — measures the multi-step "
                          "amortization of dispatch + readback")
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervised kill/restart soak: SIGKILL the "
+                         "worker mid-run via worker_kill chaos, let the "
+                         "Supervisor relaunch it (resume from last "
+                         "committed checkpoint); vs_baseline is 1.0 iff "
+                         "final params match the clean run to 1e-6 with "
+                         "exactly one restart")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection soak: run the ResilientTrainer "
                          "through a poisoned batch, a failed checkpoint "
@@ -405,7 +551,9 @@ def main():
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
 
-    if args.chaos:
+    if args.elastic:
+        bench_elastic_soak(on_tpu, steps_override=args.steps)
+    elif args.chaos:
         bench_chaos_soak(on_tpu, steps_override=args.steps)
     elif args.config == "bert_base":
         bench_bert_base(on_tpu, batch_override=args.batch,

@@ -17,6 +17,13 @@ repeat a point to arm several occurrences)::
 
     nan_batch@3,ckpt_fail@2,preempt@7,loader_raise@5
 
+Worker-level points take an optional rank qualifier ``point@N:R`` —
+"fire on the Nth health beat of rank R" (without ``:R`` every rank
+fires on its Nth beat; chaos state is process-local, so each worker
+counts its own beats)::
+
+    worker_kill@5:1,worker_hang@8:0
+
 Armed via :func:`configure` or the ``FLAGS_ft_chaos`` env/flag (read by
 ``configure_from_flags``). All state is process-local and reset by
 :func:`reset`.
@@ -35,6 +42,20 @@ Injection points
                     :class:`SimulatedPreemption` (the maintenance-event
                     signal; also raised after :func:`request_preemption`,
                     which is safe to call from a real signal handler).
+
+Worker-level points (checked by :func:`check_worker` from
+``core.health.beat``, i.e. once per training step of a *supervised*
+worker; incarnation 0 only, so a restarted worker replays clean):
+
+``worker_kill``      — SIGKILL self (an ungraceful worker death the
+                       Supervisor must detect via ``poll`` and restart
+                       from the last committed checkpoint).
+``worker_hang``      — stop beating and block forever (a deadlocked
+                       queue / stuck collective; the Supervisor's
+                       heartbeat ager must catch it, collect a SIGABRT
+                       stack dump, and respond per policy).
+``worker_unhealthy`` — write the explicit unhealthy marker and keep
+                       running (a worker that knows it is broken).
 """
 
 from __future__ import annotations
@@ -46,16 +67,23 @@ __all__ = [
     "SimulatedPreemption", "ChaosInjectedError", "configure",
     "configure_from_flags", "reset", "enabled", "fire", "counts",
     "maybe_poison", "check_checkpoint_write", "check_loader",
-    "check_preempt", "request_preemption", "preemption_requested",
+    "check_preempt", "check_worker", "request_preemption",
+    "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT",
+    "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
 ]
 
 POISON_BATCH = "nan_batch"
 CKPT_FAIL = "ckpt_fail"
 LOADER_RAISE = "loader_raise"
 PREEMPT = "preempt"
+WORKER_KILL = "worker_kill"
+WORKER_HANG = "worker_hang"
+WORKER_UNHEALTHY = "worker_unhealthy"
 
-_POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE, PREEMPT)
+_WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
+_POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
+           PREEMPT) + _WORKER_POINTS
 
 
 class SimulatedPreemption(BaseException):
@@ -86,6 +114,8 @@ class ChaosInjectedError(IOError):
 _lock = threading.Lock()
 # point -> set of armed 1-based occurrence indices
 _armed: Dict[str, set] = {}
+# worker point -> set of (occurrence, rank-or-None) pairs
+_armed_worker: Dict[str, set] = {}
 # point -> occurrences seen so far
 _counters: Dict[str, int] = {}
 _preempt_requested = False
@@ -96,17 +126,19 @@ def reset() -> None:
     global _preempt_requested
     with _lock:
         _armed.clear()
+        _armed_worker.clear()
         _counters.clear()
         _preempt_requested = False
 
 
 def configure(spec: Union[str, Dict[str, object], None]) -> None:
-    """Arm injection points from a spec string (``"nan_batch@3,..."``) or
-    a dict ``{point: N-or-list-of-N}``. Resets previous arming/counters."""
+    """Arm injection points from a spec string (``"nan_batch@3,..."``;
+    worker points take ``worker_kill@N:R`` = Nth beat of rank R) or a
+    dict ``{point: N-or-list-of-N}``. Resets previous arming/counters."""
     reset()
     if not spec:
         return
-    entries: List[Tuple[str, int]] = []
+    entries: List[Tuple[str, int, Optional[int]]] = []
     if isinstance(spec, str):
         for raw in spec.split(","):
             raw = raw.strip()
@@ -117,20 +149,37 @@ def configure(spec: Union[str, Dict[str, object], None]) -> None:
                     f"chaos spec entry {raw!r} must be 'point@N' "
                     f"(points: {', '.join(_POINTS)})")
             name, _, n = raw.partition("@")
-            entries.append((name.strip(), int(n)))
+            n, colon, rank = n.partition(":")
+            try:
+                entries.append((name.strip(), int(n),
+                                int(rank) if colon else None))
+            except ValueError:
+                raise ValueError(
+                    f"chaos spec entry {raw!r} must be 'point@N' (or "
+                    f"'point@N:rank' for worker points) with integer "
+                    f"N/rank") from None
     else:
         for name, ns in spec.items():
             for n in (ns if isinstance(ns, (list, tuple)) else [ns]):
-                entries.append((name, int(n)))
+                entries.append((name, int(n), None))
     with _lock:
-        for name, n in entries:
+        for name, n, rank in entries:
             if name not in _POINTS:
                 raise ValueError(
                     f"unknown chaos point {name!r} "
                     f"(points: {', '.join(_POINTS)})")
             if n < 1:
                 raise ValueError(f"chaos occurrence must be >= 1, got {n}")
-            _armed.setdefault(name, set()).add(n)
+            if rank is not None and name not in _WORKER_POINTS:
+                raise ValueError(
+                    f"rank qualifier '@{n}:{rank}' is only valid for "
+                    f"worker points ({', '.join(_WORKER_POINTS)})")
+            if rank is not None and rank < 0:
+                raise ValueError(f"chaos rank must be >= 0, got {rank}")
+            if name in _WORKER_POINTS:
+                _armed_worker.setdefault(name, set()).add((n, rank))
+            else:
+                _armed.setdefault(name, set()).add(n)
 
 
 def configure_from_flags() -> bool:
@@ -146,7 +195,7 @@ def configure_from_flags() -> bool:
 
 def enabled() -> bool:
     """Whether any point is armed (fast gate for hot paths)."""
-    return bool(_armed) or _preempt_requested
+    return bool(_armed) or bool(_armed_worker) or _preempt_requested
 
 
 def counts() -> Dict[str, int]:
@@ -215,6 +264,27 @@ def request_preemption() -> None:
 
 def preemption_requested() -> bool:
     return _preempt_requested
+
+
+def check_worker(rank: int) -> Optional[str]:
+    """Worker-level points, evaluated once per health beat of rank
+    ``rank``. All three share one beat counter (an entry ``point@N:R``
+    reads "on the Nth beat of rank R"; without ``:R`` any rank's Nth
+    beat matches). Returns the fired point name — ``WORKER_KILL`` >
+    ``WORKER_HANG`` > ``WORKER_UNHEALTHY`` when several arm the same
+    beat — or None. The *action* (SIGKILL self / block / write the
+    unhealthy marker) is performed by ``core.health``, keeping this
+    module pure bookkeeping."""
+    if not _armed_worker:
+        return None
+    with _lock:
+        n = _counters.get("worker_beat", 0) + 1
+        _counters["worker_beat"] = n
+        for point in (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY):
+            armed = _armed_worker.get(point, ())
+            if (n, None) in armed or (n, rank) in armed:
+                return point
+    return None
 
 
 def check_preempt() -> None:

@@ -317,12 +317,41 @@ def _define_builtin_flags() -> None:
                 "disables). Costs nothing extra: the loss rides the "
                 "same packed readback as the isfinite flag.",
                 validator=lambda v: v >= 0)
+    define_flag("ft_supervise", "",
+                "Elastic launcher supervision policy (empty/off disables "
+                "and keeps the plain fail-fast watch loop without "
+                "heartbeats). fail_fast: any worker death/hang/unhealthy "
+                "report kills the pod (today's semantics plus hang "
+                "DETECTION). restart: SIGKILL the failed/hung rank and "
+                "relaunch it with the same env up to "
+                "ft_max_worker_restarts times; the relaunched worker "
+                "resumes from the last committed checkpoint "
+                "(ResilientTrainer.restore_latest), which the elastic "
+                "parity gate holds to 1e-6. drain: request graceful "
+                "preemption (SIGTERM -> chaos.request_preemption), let "
+                "every worker checkpoint, then stop.",
+                validator=lambda v: v in ("", "off", "fail_fast",
+                                          "restart", "drain"))
+    define_flag("ft_hang_timeout", 60.0,
+                "Supervisor hang detector: a worker whose heartbeat "
+                "file (touched by core.health.beat every step) is older "
+                "than this many seconds is declared hung — SIGABRT for "
+                "a faulthandler stack dump, then handled per policy.",
+                validator=lambda v: v > 0)
+    define_flag("ft_max_worker_restarts", 2,
+                "Per-rank relaunch budget under ft_supervise=restart; "
+                "a rank exceeding it fails the pod (fail_fast).",
+                validator=lambda v: v >= 0)
     define_flag("ft_chaos", "",
                 "Deterministic failure-injection spec armed by "
                 "core.chaos.configure_from_flags (e.g. "
-                "'nan_batch@3,ckpt_fail@2,preempt@7'). Empty disables. "
-                "Each armed occurrence fires exactly once, so retried/"
-                "replayed operations come back clean.")
+                "'nan_batch@3,ckpt_fail@2,preempt@7'; worker-level "
+                "points take an optional rank qualifier: "
+                "'worker_kill@5:1' = rank 1's 5th health beat). Empty "
+                "disables. Each armed occurrence fires exactly once, so "
+                "retried/replayed operations come back clean, and "
+                "worker points fire in incarnation 0 only, so a "
+                "supervisor-restarted rank replays clean.")
     define_flag("conv_nhwc", "auto",
                 "Run NCHW-API image ops (2-D conv with HWIO weights, "
                 "max/avg pool, batch norm) internally channels-last, "

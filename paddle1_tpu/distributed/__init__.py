@@ -38,6 +38,8 @@ from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
 from . import resilience
 from .resilience import BadStepError, ResilienceReport, ResilientTrainer
+from . import supervisor
+from .supervisor import MpProcessHandle, Supervisor, SupervisorReport
 from . import graph_table
 from .graph_table import GraphTable
 from . import hbm_embedding

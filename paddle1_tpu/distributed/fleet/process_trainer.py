@@ -35,6 +35,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import queue as pyqueue
+import time
 from typing import Callable, Iterable, Optional
 
 import numpy as np
@@ -42,6 +43,33 @@ import numpy as np
 from ...core.errors import InvalidArgumentError
 
 __all__ = ["ProcessMultiTrainer"]
+
+
+def _orphan_checked_get(q, timeout, what):
+    """``q.get`` that notices a dead leader. Workers block on
+    ``param_q``/``task_q`` gets; if the parent died (SIGKILL skips the
+    daemon-reaping atexit hook, orphaning spawn children), the plain
+    get would hang 120s — or forever in the inner loops. Poll in short
+    slices and check parent liveness between them; raises RuntimeError
+    with the real cause instead. ``timeout=None`` blocks indefinitely
+    (while the parent lives); a finite timeout re-raises ``Empty`` at
+    its deadline, preserving the plain-get contract."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        slice_s = 2.0
+        if deadline is not None:
+            slice_s = min(slice_s, max(0.05, deadline - time.monotonic()))
+        try:
+            return q.get(timeout=slice_s)
+        except pyqueue.Empty:
+            parent = mp.parent_process()
+            if parent is not None and not parent.is_alive():
+                raise RuntimeError(
+                    f"hogwild worker orphaned: the leader process died "
+                    f"while this worker waited for {what} — exiting "
+                    "instead of hanging on the queue")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise
 
 
 # -- shm pytree transport ----------------------------------------------------
@@ -84,7 +112,7 @@ def _worker_main(worker_id, arena_name, task_q, grad_q, param_q,
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    from ...core import native
+    from ...core import health, native
     from ...core.tensor import Tensor
 
     arena = native.ShmArena(arena_name, create=False)
@@ -118,10 +146,14 @@ def _worker_main(worker_id, arena_name, task_q, grad_q, param_q,
         # adopt the master's INITIAL params before any batch: per-process
         # model inits need not agree, and queue ordering across different
         # queues is not guaranteed
-        while not adopt(param_q.get(timeout=120)):
+        while not adopt(_orphan_checked_get(param_q, 120,
+                                            "the initial params")):
             pass
         while True:
-            task = task_q.get()
+            # supervisor liveness (no-op unless this worker tree runs
+            # under a heartbeat channel) + worker-level chaos trigger
+            health.beat()
+            task = _orphan_checked_get(task_q, None, "the next task")
             if task is None:
                 break
             # adopt the newest published params (drain to latest)
@@ -216,9 +248,15 @@ class ProcessMultiTrainer:
         """Drain ``dataset`` once across ``process_num`` worker
         processes. ``optimizer_fn(model) -> optimizer`` builds the
         parent-side optimizer over the master model."""
-        from ...core import native
+        from ...core import health, native
         from ...core.tensor import Tensor
 
+        # the LEADER is the supervised process: adopt the heartbeat
+        # channel now (beat() pops the PADDLE_FT_* env vars) so the
+        # env snapshot below cannot leak it into the mp workers —
+        # grandchildren beating the leader's file would mask a leader
+        # hang from the supervisor
+        health.beat()
         if not native.available():
             raise InvalidArgumentError(
                 "ProcessMultiTrainer needs the native shm arena "
@@ -254,6 +292,13 @@ class ProcessMultiTrainer:
                  for i in range(self.process_num)]
         for p in procs:
             p.start()
+        # exit-watching via the launcher's Supervisor (fail-fast,
+        # detection only — check_failed() never takes policy action):
+        # the mp workers are adopted through the Popen-shaped adapter
+        from ..supervisor import MpProcessHandle, Supervisor
+        watchdog = Supervisor(policy="fail_fast")
+        for i, p in enumerate(procs):
+            watchdog.attach(i, MpProcessHandle(p))
 
         def publish(version):
             # write the params into the arena ONCE; extra workers share
@@ -287,12 +332,14 @@ class ProcessMultiTrainer:
                 except pyqueue.Empty:
                     if not block:
                         return False
+                    # the leader is healthy while it waits here (its own
+                    # 300s deadline tolerates slow workers) — keep the
+                    # supervisor's hang detector fed
+                    health.beat()
                     # a worker that died WITHOUT posting (unpicklable
                     # model_fn, missing __main__ guard in the caller's
                     # script, OOM-kill) would otherwise hang us forever
-                    dead = [p for p in procs
-                            if not p.is_alive() and p.exitcode not in
-                            (0, None)]
+                    dead = watchdog.check_failed()
                     if len([p for p in procs if p.is_alive()]) + exited \
                             < self.process_num or dead:
                         raise RuntimeError(
@@ -334,6 +381,7 @@ class ProcessMultiTrainer:
         try:
             publish(version)  # initial params
             while True:
+                health.beat()  # leader liveness, once per dispatch round
                 # memory barrier: drain in-flight, reset, republish
                 if arena.used() > self.arena_size * self.arena_reset_fraction:
                     draining = True

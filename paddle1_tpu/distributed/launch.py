@@ -41,6 +41,22 @@ def _parse_args(argv):
                    default=None, help="accepted for compat; TPU chips are "
                    "managed by XLA, not per-process pinning")
     p.add_argument("--log_dir", type=str, default=None)
+    # elastic supervision (ft_* flag family; see distributed/supervisor)
+    p.add_argument("--ft_supervise", type=str, default=None,
+                   choices=["off", "fail_fast", "restart", "drain"],
+                   help="supervise workers with heartbeats + hang "
+                        "detection and respond per policy: fail_fast "
+                        "(kill the pod), restart (relaunch the failed "
+                        "rank, which resumes from its last committed "
+                        "checkpoint), drain (graceful checkpoint-and-"
+                        "stop). Default: the FLAGS_ft_supervise flag "
+                        "(empty = plain fail-fast watch, no heartbeats)")
+    p.add_argument("--ft_hang_timeout", type=float, default=None,
+                   help="seconds without a worker heartbeat before it "
+                        "is declared hung (default: FLAGS_ft_hang_timeout)")
+    p.add_argument("--ft_max_worker_restarts", type=int, default=None,
+                   help="per-rank relaunch budget under restart policy "
+                        "(default: FLAGS_ft_max_worker_restarts)")
     # parameter-server mode (reference launch.py:278): the script serves
     # both roles, branching on TRAINING_ROLE
     p.add_argument("--server_num", type=int, default=0,
@@ -57,12 +73,22 @@ def _parse_args(argv):
 
 
 def launch(argv: Optional[List[str]] = None):
+    from ..core import flags as core_flags
     from .launch_utils import (get_cluster, start_local_trainers,
                                watch_local_trainers)
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     nproc = args.nproc_per_node
     host, port = (args.master.split(":") + ["6170"])[:2]
+    supervise = (args.ft_supervise if args.ft_supervise is not None
+                 else core_flags.flag("ft_supervise"))
+    supervise = "" if supervise == "off" else supervise
     if args.server_num > 0 or args.servers:
+        if supervise:
+            import warnings
+            warnings.warn(
+                "--ft_supervise is not supported in parameter-server "
+                "mode yet: PS jobs keep the legacy exit-only watch "
+                "(no heartbeats, hang detection, or restart)")
         from .launch_utils import start_ps_procs, watch_ps_procs
         n_trainers = (args.trainer_num if args.trainer_num is not None
                       else nproc)
@@ -104,8 +130,11 @@ def launch(argv: Optional[List[str]] = None):
         if rc != 0:
             sys.exit(rc)
         return
-    if args.nnodes <= 1 and nproc <= 1:
-        # single host, single process: exec in place (XLA owns all chips)
+    if args.nnodes <= 1 and nproc <= 1 and not supervise:
+        # single host, single process: exec in place (XLA owns all
+        # chips). A supervised single process can NOT exec in place —
+        # the supervisor must outlive the worker to restart it, so it
+        # falls through to the subprocess path below.
         env = dict(os.environ)
         env.setdefault("PADDLE_TRAINER_ID", "0")
         env.setdefault("PADDLE_TRAINERS_NUM", "1")
@@ -134,6 +163,35 @@ def launch(argv: Optional[List[str]] = None):
         pods = cluster.pods
     else:
         pods = [cluster.pod(args.node_rank)]
+    if supervise:
+        # the Supervisor owns spawn (heartbeat env protocol + respawn
+        # spec) and the watch loop (hang detection, policy response)
+        if supervise == "restart" and cluster.world_size() > 1:
+            import warnings
+            warnings.warn(
+                "ft_supervise=restart relaunches INDIVIDUAL ranks; a "
+                "rank participating in cross-process collectives "
+                "(jax.distributed) cannot rejoin a live job — its "
+                "peers stay stuck in the old collective and the "
+                "restarted rank burns the budget re-dialing a dead "
+                "coordinator. Use restart for independent workers "
+                "(per-rank data shards, no collectives); collective "
+                "pods want fail_fast (and an outer scheduler retry) "
+                "or drain")
+        from .supervisor import Supervisor
+        sup = Supervisor(policy=supervise,
+                         hang_timeout=args.ft_hang_timeout,
+                         max_restarts=args.ft_max_worker_restarts,
+                         log_dir=args.log_dir)
+        for pod in pods:
+            start_local_trainers(
+                cluster, pod, args.training_script,
+                args.training_script_args, log_dir=args.log_dir,
+                supervisor=sup)
+        rc = sup.run()
+        if rc != 0:
+            sys.exit(rc)
+        return
     procs = []
     for pod in pods:
         procs.extend(start_local_trainers(

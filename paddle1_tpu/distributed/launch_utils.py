@@ -99,11 +99,19 @@ def get_cluster(node_ips: List[str], nproc_per_node: int,
 def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
                          training_script_args: List[str],
                          log_dir: Optional[str] = None,
-                         extra_env: Optional[dict] = None):
+                         extra_env: Optional[dict] = None,
+                         supervisor=None):
     """Spawn this pod's trainers (reference start_local_trainers:452 —
     same env protocol: PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT/
     PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINERS_NUM, plus the coordination
-    address init_parallel_env hands to jax.distributed.initialize)."""
+    address init_parallel_env hands to jax.distributed.initialize).
+
+    With ``supervisor`` (a :class:`~.supervisor.Supervisor`), trainers
+    are *registered* instead of spawned directly — the supervisor owns
+    the processes (it stamps the heartbeat env protocol and can
+    relaunch a rank with the identical spec); returns ``[]`` and the
+    caller runs ``supervisor.run()``. Without one, spawns plain Popen
+    workers exactly as before."""
     endpoints = cluster.trainers_endpoints()
     world = cluster.world_size()
     procs = []
@@ -122,12 +130,16 @@ def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
         })
         if extra_env:
             env.update(extra_env)
-        stdout = None
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            stdout = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
         cmd = [sys.executable, "-u", training_script] + \
             list(training_script_args)
+        log_path = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"workerlog.{t.rank}")
+        if supervisor is not None:
+            supervisor.add_worker(t.rank, cmd, env=env, log_path=log_path)
+            continue
+        stdout = open(log_path, "w") if log_path else None
         procs.append(subprocess.Popen(
             cmd, env=env, stdout=stdout,
             stderr=subprocess.STDOUT if stdout else None))
@@ -149,23 +161,19 @@ def terminate_local_procs(procs) -> None:
 
 def watch_local_trainers(procs, poll_s: float = 1.0) -> int:
     """Reference watch_local_trainers:559: block until all trainers exit;
-    the FIRST nonzero exit kills the rest and becomes the return code."""
-    try:
-        while True:
-            alive = False
-            for p in procs:
-                ret = p.poll()
-                if ret is None:
-                    alive = True
-                elif ret != 0:
-                    terminate_local_procs(procs)
-                    return ret
-            if not alive:
-                return 0
-            time.sleep(poll_s)
-    except KeyboardInterrupt:
-        terminate_local_procs(procs)
-        raise
+    the FIRST nonzero exit kills the rest and becomes the return code.
+    Implemented by *adopting* the procs into a fail_fast
+    :class:`~.supervisor.Supervisor` (exit-only watching: adopted
+    processes have no heartbeat channel — ``launch --ft_supervise``
+    gets the full hang/unhealthy detection by letting the supervisor
+    own the spawn)."""
+    if not procs:
+        return 0  # nothing to watch (legacy loop fell through with 0)
+    from .supervisor import Supervisor
+    sup = Supervisor(policy="fail_fast", poll_s=poll_s)
+    for i, p in enumerate(procs):
+        sup.attach(i, p)
+    return sup.run()
 
 
 def start_ps_procs(server_endpoints: List[str], n_trainers: int,
@@ -221,26 +229,18 @@ def watch_ps_procs(server_procs, trainer_procs, poll_s: float = 1.0) -> int:
     """PS watch semantics (reference launch_utils watch for PS mode): the
     job is DONE when every trainer exits 0 (servers are then torn down);
     any nonzero exit — or a server stopping while trainers still run —
-    fails the job and kills everyone."""
-    try:
-        if not trainer_procs:
-            # server-only node: the job IS the servers — block until they
-            # exit, fail-fast on the first nonzero
-            return watch_local_trainers(server_procs, poll_s)
-        while True:
-            for p in server_procs + trainer_procs:
-                ret = p.poll()
-                if ret is not None and ret != 0:
-                    terminate_local_procs(server_procs + trainer_procs)
-                    return ret
-            if all(p.poll() is not None for p in trainer_procs):
-                terminate_local_procs(server_procs)
-                return 0
-            if any(p.poll() is not None for p in server_procs):
-                # a "successful" server exit mid-job still strands trainers
-                terminate_local_procs(server_procs + trainer_procs)
-                return 1
-            time.sleep(poll_s)
-    except KeyboardInterrupt:
-        terminate_local_procs(server_procs + trainer_procs)
-        raise
+    fails the job and kills everyone. Servers are *essential* workers of
+    the :class:`~.supervisor.Supervisor`: any exit of theirs, clean or
+    not, fails the job while trainers still run."""
+    if not trainer_procs:
+        # server-only node: the job IS the servers — block until they
+        # exit, fail-fast on the first nonzero
+        return watch_local_trainers(server_procs, poll_s)
+    from .supervisor import Supervisor
+    sup = Supervisor(policy="fail_fast", poll_s=poll_s)
+    for i, p in enumerate(trainer_procs):
+        sup.attach(i, p, role="trainer")
+    for i, p in enumerate(server_procs):
+        sup.attach(len(trainer_procs) + i, p, role="server",
+                   essential=True)
+    return sup.run()

@@ -154,7 +154,7 @@ class DenseTable:
 
     RPC_METHODS = frozenset({"pull_dense", "push_dense_grad",
                              "push_dense_delta", "set_value",
-                             "get_version"})
+                             "get_version", "bump_version"})
 
     def __init__(self, shape, initializer: Optional[Callable] = None,
                  optimizer: str = "sgd", lr: float = 0.01,
@@ -195,6 +195,15 @@ class DenseTable:
     def get_version(self) -> int:
         with self._lock:
             return self.version
+
+    def bump_version(self) -> None:
+        """Advance the version WITHOUT applying an update. A sync-mode
+        trainer whose param had no grad this round (frozen/unused)
+        posts this instead of a push, so every table's version still
+        advances by exactly ``trainers`` per round — peers' barriers
+        stay satisfiable instead of stalling to their timeout."""
+        with self._lock:
+            self.version += 1
 
     def set_value(self, value) -> None:
         value = np.asarray(value, np.float32)

@@ -51,6 +51,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 from ..core import chaos
 from ..core import flags as core_flags
+from ..core import health
 from ..core.errors import InvalidArgumentError
 from ..core.generator import get_rng_state, set_rng_state
 from .checkpoint import CheckpointCorruptError, CheckpointManager
@@ -214,6 +215,7 @@ class ResilientTrainer:
         still fails is *counted and survived* (training goes on from
         the previous checkpoint window)."""
         self.engine.drain()
+        health.beat()  # a long drain must not read as a hang
         try:
             self._retrying(
                 lambda: self.manager.save(step, self._state(),
@@ -279,6 +281,7 @@ class ResilientTrainer:
         handler."""
         attempt = 0
         while True:
+            health.beat()  # retries/backoff are liveness, not a hang
             try:
                 return fn()
             except Exception as e:
@@ -360,6 +363,10 @@ class ResilientTrainer:
         max_step = step  # high-water mark: steps below it are replays
         while step < steps:
             try:
+                # the supervisor's liveness signal: one beat per loop
+                # iteration (no-op when unsupervised). Also the trigger
+                # for worker-level chaos (worker_kill/hang/unhealthy).
+                health.beat()
                 chaos.check_preempt()
                 try:
                     batch = next(it)
@@ -427,13 +434,23 @@ class ResilientTrainer:
                     # an advance NOTICE (SIGTERM grace window): the
                     # current params are known-good — checkpoint them
                     # NOW so the next incarnation loses nothing, then
-                    # keep training until actually killed
+                    # keep training until actually killed — unless the
+                    # notice was a supervisor DRAIN, whose contract is
+                    # checkpoint-then-stop (the pod is being wound
+                    # down, not preempted out from under us)
                     self.save(step)
+                    if health.drain_requested():
+                        break
                     continue
                 # ungraceful (simulated kill): roll back and replay
                 step = self.restore_latest()
                 it = self._data_iter(data, step)
-        self.save(step)
+        if self._last_saved != step:
+            # skip when the last act WAS saving this step (drain, or a
+            # run ending on a save boundary): the rename-aside re-save
+            # would waste the drain grace window and briefly demote the
+            # committed checkpoint
+            self.save(step)
         self.engine.sync_model()
         self.report.final_step = step
         self.report.final_loss = last_loss

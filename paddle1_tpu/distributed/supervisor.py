@@ -1,0 +1,567 @@
+"""Elastic supervision for the multi-process launcher.
+
+The reference watch loop (``launch_utils.py:559 watch_local_trainers``,
+kept here as the unsupervised default) is pure fail-fast: any worker
+death kills the pod, and a worker that *hangs* — deadlocked queue,
+stuck collective, wedged host callback — is never detected at all. The
+:class:`Supervisor` is the layer the ROADMAP's production north-star
+needs above PR 2's single-process ``ResilientTrainer``: it owns the
+worker subprocesses, gives each a heartbeat channel (the worker half
+lives in :mod:`paddle1_tpu.core.health`; workers call ``health.beat()``
+every step), and detects three failure classes:
+
+* **exit** — ``poll()`` returned nonzero (or an *essential* worker,
+  e.g. a parameter server, exited at all while the job still runs);
+* **hang** — the per-rank heartbeat file is older than
+  ``ft_hang_timeout`` (before killing, the supervisor sends ``SIGABRT``
+  so the worker's registered ``faulthandler`` writes an all-threads
+  stack dump to the log dir — wedged collectives become diagnosable);
+* **unhealthy** — the worker explicitly reported itself broken via
+  ``health.report_unhealthy`` (marker file beside the heartbeat).
+
+Response is per policy (flag ``ft_supervise``):
+
+``fail_fast``
+    Today's semantics plus hang *detection*: first failure kills the
+    pod; the failure's exit code (or 1) is the return code.
+``restart``
+    SIGKILL the failed/hung rank and relaunch it with the same command
+    and env (incarnation counter bumped) up to
+    ``ft_max_worker_restarts`` times per rank; the other ranks keep
+    running. The relaunched worker resumes from the last committed
+    checkpoint (PR 2 ``ResilientTrainer.restore_latest``), so a
+    killed-and-restarted run must match the uninterrupted run to 1e-6 —
+    the elastic parity gate (``bench.py --elastic``,
+    tests/test_launch.py).
+``drain``
+    Request graceful preemption from every worker (SIGTERM → the
+    ``health`` SIGTERM handler calls ``chaos.request_preemption()`` and
+    marks a drain, so ``ResilientTrainer.fit`` checkpoints its current
+    good state and stops), wait out a grace window, then stop the pod.
+
+The supervisor also *adopts* pre-spawned processes (``attach``) so the
+legacy ``watch_local_trainers`` / ``watch_ps_procs`` surfaces — and
+``fleet.ProcessMultiTrainer``'s ``multiprocessing`` workers, via
+:class:`MpProcessHandle` — run on the same loop; adopted workers have
+no respawn spec, so ``restart`` falls back to ``fail_fast`` for them.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import flags as core_flags
+from ..core.errors import InvalidArgumentError
+from ..core.health import (HEARTBEAT_ENV, INCARNATION_ENV, STACKDUMP_ENV,
+                           UNHEALTHY_SUFFIX)
+
+__all__ = ["Supervisor", "SupervisorReport", "WorkerFailure",
+           "MpProcessHandle", "POLICIES"]
+
+POLICIES = ("fail_fast", "restart", "drain")
+
+# failure kinds
+EXIT = "exit"
+HANG = "hang"
+UNHEALTHY = "unhealthy"
+
+
+@dataclass
+class WorkerFailure:
+    """One detected failure (what check_failed()/the policy loop see)."""
+    rank: int
+    kind: str                      # exit | hang | unhealthy
+    exit_code: Optional[int] = None
+    reason: str = ""
+    stack_dump: Optional[str] = None
+    # the uncoerced returncode (an essential worker's CLEAN exit is
+    # reported with exit_code 1 but raw_exit 0 — the run loop forgives
+    # it when the trainers finished in the same sweep)
+    raw_exit: Optional[int] = None
+
+
+@dataclass
+class SupervisorReport:
+    """What the supervision loop actually did — the counters the elastic
+    acceptance matrix checks."""
+    policy: str = "fail_fast"
+    restarts: Dict[int, int] = field(default_factory=dict)  # rank -> n
+    failures: List[WorkerFailure] = field(default_factory=list)
+    hangs_detected: int = 0
+    unhealthy_reports: int = 0
+    stack_dumps: List[str] = field(default_factory=list)
+    drained: bool = False
+    exit_code: Optional[int] = None
+
+    @property
+    def total_restarts(self) -> int:
+        return sum(self.restarts.values())
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"policy": self.policy,
+                "restarts": dict(self.restarts),
+                "total_restarts": self.total_restarts,
+                "failures": [(f.rank, f.kind, f.exit_code)
+                             for f in self.failures],
+                "hangs_detected": self.hangs_detected,
+                "unhealthy_reports": self.unhealthy_reports,
+                "stack_dumps": list(self.stack_dumps),
+                "drained": self.drained,
+                "exit_code": self.exit_code}
+
+
+class MpProcessHandle:
+    """Popen-shaped adapter over a ``multiprocessing.Process`` so the
+    Supervisor can watch fleet worker processes with the same loop."""
+
+    def __init__(self, proc):
+        self._p = proc
+
+    @property
+    def pid(self):
+        return self._p.pid
+
+    def poll(self) -> Optional[int]:
+        return None if self._p.is_alive() else self._p.exitcode
+
+    def send_signal(self, sig) -> None:
+        if self._p.pid is not None and self._p.is_alive():
+            os.kill(self._p.pid, sig)
+
+    def terminate(self) -> None:
+        self._p.terminate()
+
+    def kill(self) -> None:
+        self._p.kill()
+
+    def wait(self, timeout=None) -> Optional[int]:
+        self._p.join(timeout)
+        return self._p.exitcode
+
+
+class _Worker:
+    """One supervised rank: the (re)spawn spec plus runtime state."""
+
+    def __init__(self, rank: int, cmd: Optional[List[str]] = None,
+                 env: Optional[dict] = None,
+                 log_path: Optional[str] = None, role: str = "trainer",
+                 essential: bool = False, proc=None):
+        self.rank = rank
+        self.cmd = list(cmd) if cmd is not None else None
+        self.env = dict(env) if env is not None else None
+        self.log_path = log_path
+        self.role = role
+        self.essential = essential
+        self.proc = proc
+        self.incarnation = 0
+        self.hb_file: Optional[str] = None
+        self.hb_spawn_mtime: Optional[float] = None
+        self.dump_path: Optional[str] = None
+        self.done = False            # exited 0 (role-complete)
+        self.log_fh = None
+
+    @property
+    def respawnable(self) -> bool:
+        return self.cmd is not None
+
+
+class Supervisor:
+    """Heartbeat-supervised pod of worker processes (module docstring).
+
+    Parameters default from the ``ft_*`` flag registry:
+    ``policy`` <- ``ft_supervise`` (empty flag -> ``fail_fast``;
+    enabling supervision at all is the *caller's* choice — see
+    ``launch.py --ft_supervise``), ``hang_timeout`` <-
+    ``ft_hang_timeout``, ``max_restarts`` <- ``ft_max_worker_restarts``.
+
+    ``heartbeat_dir`` holds the per-rank heartbeat + stack-dump files
+    (defaults to ``log_dir`` when given, else a mkdtemp).
+    ``startup_grace_s`` widens the hang window until a worker's FIRST
+    beat (import + XLA compile of a big model can dwarf the steady-state
+    step time; default ``5 * hang_timeout``). ``hang_timeout=None`` plus
+    no heartbeat dir (pure ``attach`` use) degrades to exit-only
+    watching — exactly the legacy semantics.
+    """
+
+    def __init__(self, policy: Optional[str] = None,
+                 hang_timeout: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 heartbeat_dir: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 poll_s: float = 0.5, grace_s: float = 10.0,
+                 dump_wait_s: float = 5.0,
+                 startup_grace_s: Optional[float] = None):
+        if policy is None:
+            policy = core_flags.flag("ft_supervise")
+        if policy in ("", "off"):
+            policy = "fail_fast"
+        if policy not in POLICIES:
+            raise InvalidArgumentError(
+                f"supervision policy must be one of {POLICIES}, "
+                f"got {policy!r}")
+        self.policy = policy
+        self.hang_timeout = float(
+            core_flags.flag("ft_hang_timeout") if hang_timeout is None
+            else hang_timeout)
+        self.max_restarts = int(
+            core_flags.flag("ft_max_worker_restarts") if max_restarts is None
+            else max_restarts)
+        self.log_dir = log_dir
+        self._hb_dir = heartbeat_dir
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.dump_wait_s = float(dump_wait_s)
+        self.startup_grace_s = (5.0 * self.hang_timeout
+                                if startup_grace_s is None
+                                else float(startup_grace_s))
+        self._workers: Dict[int, _Worker] = {}
+        self.report = SupervisorReport(policy=self.policy)
+
+    # -- registration -----------------------------------------------------
+
+    def add_worker(self, rank: int, cmd: List[str],
+                   env: Optional[dict] = None,
+                   log_path: Optional[str] = None, role: str = "trainer",
+                   essential: bool = False) -> int:
+        """Register a respawnable worker (spawned by :meth:`start`)."""
+        if rank in self._workers:
+            raise InvalidArgumentError(f"rank {rank} already registered")
+        self._workers[rank] = _Worker(rank, cmd, env, log_path, role,
+                                      essential)
+        return rank
+
+    def attach(self, rank: int, proc, role: str = "trainer",
+               essential: bool = False) -> int:
+        """Adopt an already-running process (legacy watch surfaces /
+        fleet mp workers via :class:`MpProcessHandle`). No respawn spec,
+        no heartbeat: exit-only watching; ``restart`` falls back to
+        ``fail_fast`` for these."""
+        if rank in self._workers:
+            raise InvalidArgumentError(f"rank {rank} already registered")
+        self._workers[rank] = _Worker(rank, role=role, essential=essential,
+                                      proc=proc)
+        return rank
+
+    # -- spawning ---------------------------------------------------------
+
+    def _heartbeat_dir(self) -> str:
+        if self._hb_dir is None:
+            self._hb_dir = self.log_dir or tempfile.mkdtemp(
+                prefix="p1t_supervisor_")
+        os.makedirs(self._hb_dir, exist_ok=True)
+        return self._hb_dir
+
+    def _spawn(self, w: _Worker) -> None:
+        hb_dir = self._heartbeat_dir()
+        w.hb_file = os.path.join(hb_dir, f"hb.{w.rank}")
+        # the dump file is per-INCARNATION: a re-hung restart must not
+        # read (or truncate — collected dumps stay intact in
+        # report.stack_dumps) the previous life's traceback
+        w.dump_path = os.path.join(
+            hb_dir, f"stackdump.{w.rank}" +
+            (f".r{w.incarnation}" if w.incarnation else ""))
+        # fresh channel per incarnation: a stale beat/unhealthy marker/
+        # dump left by a PREVIOUS RUN sharing this dir must not be read
+        # as this one's
+        with open(w.hb_file, "w"):
+            pass
+        with open(w.dump_path, "w"):
+            pass
+        w.hb_spawn_mtime = os.path.getmtime(w.hb_file)
+        try:
+            os.unlink(w.hb_file + UNHEALTHY_SUFFIX)
+        except OSError:
+            pass
+        env = dict(w.env if w.env is not None else os.environ)
+        env[HEARTBEAT_ENV] = w.hb_file
+        env[STACKDUMP_ENV] = w.dump_path
+        env[INCARNATION_ENV] = str(w.incarnation)
+        stdout = None
+        if w.log_path:
+            if w.log_fh is not None:  # previous incarnation's handle
+                try:
+                    w.log_fh.close()
+                except OSError:  # pragma: no cover
+                    pass
+            os.makedirs(os.path.dirname(w.log_path) or ".", exist_ok=True)
+            # incarnation 0 truncates (a re-run with the same log_dir
+            # must not concatenate onto the previous run, matching the
+            # unsupervised spawn); restarts within THIS supervisor's
+            # lifetime append so the first life's tail survives
+            w.log_fh = open(w.log_path, "a" if w.incarnation else "w")
+            if w.incarnation:
+                w.log_fh.write(f"\n--- supervisor restart "
+                               f"#{w.incarnation} ---\n")
+                w.log_fh.flush()
+            stdout = w.log_fh
+        w.proc = subprocess.Popen(
+            w.cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None)
+
+    def start(self) -> "Supervisor":
+        """Spawn every registered (not yet running) respawnable worker."""
+        for w in self._workers.values():
+            if w.proc is None:
+                if not w.respawnable:
+                    raise InvalidArgumentError(
+                        f"rank {w.rank} has neither a command nor a "
+                        "process")
+                self._spawn(w)
+        return self
+
+    # -- detection --------------------------------------------------------
+
+    def _classify(self, w: _Worker) -> Optional[WorkerFailure]:
+        """One poll of one worker; None when healthy (or already done)."""
+        if w.done or w.proc is None:
+            return None
+        ret = w.proc.poll()
+        if ret is not None:
+            if ret == 0 and not w.essential:
+                w.done = True
+                return None
+            # an essential worker (PS server) exiting AT ALL while the
+            # job runs strands everyone — treat clean exit as failure
+            code = ret if ret != 0 else 1
+            return WorkerFailure(w.rank, EXIT, exit_code=code,
+                                 reason=f"exit code {ret}", raw_exit=ret)
+        if w.hb_file is not None:
+            unhealthy = w.hb_file + UNHEALTHY_SUFFIX
+            if os.path.exists(unhealthy):
+                try:
+                    with open(unhealthy) as f:
+                        reason = f.read().strip()
+                except OSError:
+                    reason = ""
+                return WorkerFailure(w.rank, UNHEALTHY,
+                                     reason=reason or "unhealthy report")
+            try:
+                mtime = os.path.getmtime(w.hb_file)
+            except OSError:
+                mtime = w.hb_spawn_mtime or 0.0
+            age = time.time() - mtime
+            first_beat_pending = (w.hb_spawn_mtime is not None
+                                  and mtime <= w.hb_spawn_mtime)
+            limit = (max(self.startup_grace_s, self.hang_timeout)
+                     if first_beat_pending else self.hang_timeout)
+            if age > limit:
+                return WorkerFailure(
+                    w.rank, HANG,
+                    reason=f"heartbeat {age:.1f}s old (> {limit:.1f}s)")
+        return None
+
+    def check_failed(self) -> List[WorkerFailure]:
+        """One detection sweep with NO policy action — the embedding
+        surface ``fleet.ProcessMultiTrainer`` polls between queue
+        timeouts to catch workers that died without reporting."""
+        out = []
+        for w in self._workers.values():
+            f = self._classify(w)
+            if f is not None:
+                out.append(f)
+        return out
+
+    # -- actions ----------------------------------------------------------
+
+    def _collect_stack_dump(self, w: _Worker) -> Optional[str]:
+        """SIGABRT the stuck worker and wait for its faulthandler
+        (``health`` enables it on the per-rank dump file) to write the
+        all-threads traceback; returns the dump path when something
+        arrived. faulthandler's abort handler dumps and then dies, so
+        keep looking briefly after the worker exits — the dump usually
+        lands just before the death is observable."""
+        if w.proc is None or w.dump_path is None:
+            return None
+        try:
+            w.proc.send_signal(signal.SIGABRT)
+        except (OSError, ValueError):
+            return None
+        deadline = time.monotonic() + self.dump_wait_s
+        dead_since = None
+        while time.monotonic() < deadline:
+            try:
+                if os.path.getsize(w.dump_path) > 0:
+                    # one extra beat lets a mid-write dump finish
+                    time.sleep(0.1)
+                    self.report.stack_dumps.append(w.dump_path)
+                    return w.dump_path
+            except OSError:
+                pass
+            if w.proc.poll() is not None:
+                # dead with no dump: wait a moment for the filesystem,
+                # then give up (no faulthandler was enabled)
+                if dead_since is None:
+                    dead_since = time.monotonic()
+                elif time.monotonic() - dead_since > 0.5:
+                    break
+            time.sleep(0.05)
+        return None
+
+    def _kill_worker(self, w: _Worker, sig=signal.SIGKILL) -> None:
+        if w.proc is None or w.proc.poll() is not None:
+            return
+        try:
+            w.proc.send_signal(sig)
+        except (OSError, ValueError):  # pragma: no cover - already gone
+            pass
+
+    def _terminate_all(self) -> None:
+        """Reference terminate_local_procs semantics over the pod:
+        SIGTERM, bounded wait, SIGKILL stragglers."""
+        alive = [w for w in self._workers.values()
+                 if w.proc is not None and w.proc.poll() is None]
+        for w in alive:
+            self._kill_worker(w, signal.SIGTERM)
+        deadline = time.monotonic() + self.grace_s
+        for w in alive:
+            try:
+                w.proc.wait(timeout=max(0.1,
+                                        deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
+            if w.proc.poll() is None:
+                self._kill_worker(w, signal.SIGKILL)
+                try:
+                    w.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        self._close_logs()
+
+    def _close_logs(self) -> None:
+        for w in self._workers.values():
+            if w.log_fh is not None:
+                try:
+                    w.log_fh.close()
+                except OSError:  # pragma: no cover
+                    pass
+                w.log_fh = None
+
+    def _restart_worker(self, w: _Worker) -> bool:
+        """Kill + relaunch one rank (same cmd/env, incarnation+1).
+        False when the rank is out of restart budget or not
+        respawnable."""
+        used = self.report.restarts.get(w.rank, 0)
+        if not w.respawnable or used >= self.max_restarts:
+            return False
+        self._kill_worker(w, signal.SIGKILL)
+        try:
+            w.proc.wait(timeout=self.grace_s)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
+        w.incarnation += 1
+        self.report.restarts[w.rank] = used + 1
+        self._spawn(w)
+        print(f"supervisor: rank {w.rank} relaunched "
+              f"(restart {used + 1}/{self.max_restarts}, "
+              f"incarnation {w.incarnation})", file=sys.stderr)
+        return True
+
+    def _drain_all(self, grace_s: Optional[float] = None) -> None:
+        """Graceful pod stop: SIGTERM every live worker (the health
+        SIGTERM handler turns it into chaos.request_preemption + drain,
+        so resilient loops checkpoint and exit), wait out the grace
+        window, then terminate stragglers."""
+        self.report.drained = True
+        grace = self.grace_s if grace_s is None else grace_s
+        alive = [w for w in self._workers.values()
+                 if w.proc is not None and w.proc.poll() is None]
+        for w in alive:
+            self._kill_worker(w, signal.SIGTERM)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if all(w.proc.poll() is not None for w in alive):
+                break
+            time.sleep(min(self.poll_s, 0.2))
+        self._terminate_all()
+
+    # -- the loop ---------------------------------------------------------
+
+    def _on_failure(self, w: _Worker, f: WorkerFailure) -> Optional[int]:
+        """Policy dispatch for one detected failure. Returns the pod
+        exit code when the failure ends the job, None when handled."""
+        self.report.failures.append(f)
+        if f.kind == HANG:
+            self.report.hangs_detected += 1
+            f.stack_dump = self._collect_stack_dump(w)
+            dump = (f" (stack dump: {f.stack_dump})"
+                    if f.stack_dump else "")
+            print(f"supervisor: rank {w.rank} HUNG — {f.reason}{dump}",
+                  file=sys.stderr)
+        elif f.kind == UNHEALTHY:
+            self.report.unhealthy_reports += 1
+            # consume the marker so a handled report doesn't re-fire
+            try:
+                os.unlink(w.hb_file + UNHEALTHY_SUFFIX)
+            except OSError:
+                pass
+            print(f"supervisor: rank {w.rank} reported unhealthy — "
+                  f"{f.reason}", file=sys.stderr)
+        else:
+            print(f"supervisor: rank {w.rank} failed — {f.reason}",
+                  file=sys.stderr)
+
+        if self.policy == "restart":
+            if self._restart_worker(w):
+                return None
+            print(f"supervisor: rank {w.rank} out of restart budget "
+                  f"({self.max_restarts}) — failing the pod",
+                  file=sys.stderr)
+        elif self.policy == "drain":
+            self._drain_all()
+            # a drain triggered by a crash is still a failed job; one
+            # triggered by hang/unhealthy stopped gracefully with the
+            # state checkpointed
+            return f.exit_code if f.kind == EXIT else 0
+        # fail_fast (and restart fallthrough): kill the pod
+        self._terminate_all()
+        return f.exit_code if f.exit_code is not None else 1
+
+    def run(self) -> int:
+        """Supervise until the job completes (every non-essential worker
+        exited 0 — essential workers, e.g. PS servers, are then torn
+        down) or a failure ends it per policy. Returns the pod exit
+        code. KeyboardInterrupt kills the pod and re-raises (the
+        reference watch contract)."""
+        self.start()
+        trainers = [w for w in self._workers.values() if not w.essential]
+        if not trainers:
+            # essential=True means "must outlive the trainers"; with no
+            # trainers there is nothing to outlive (a server-only node
+            # watches its servers as plain workers instead)
+            raise InvalidArgumentError(
+                "Supervisor.run needs at least one non-essential worker")
+        try:
+            while True:
+                sweep = []
+                for w in list(self._workers.values()):
+                    f = self._classify(w)
+                    if f is not None:
+                        sweep.append((w, f))
+                if all(w.done for w in trainers) and all(
+                        w.essential and f.kind == EXIT and f.raw_exit == 0
+                        for w, f in sweep):
+                    # job complete — an essential worker (PS server)
+                    # that exited CLEANLY in the same sweep the last
+                    # trainer finished is a success, not a strand (the
+                    # legacy watch_ps_procs ordering)
+                    self._terminate_all()  # tear down essential workers
+                    self.report.exit_code = 0
+                    return 0
+                for w, f in sweep:
+                    rc = self._on_failure(w, f)
+                    if rc is not None:
+                        self.report.exit_code = rc
+                        return rc
+                time.sleep(self.poll_s)
+        except KeyboardInterrupt:
+            self._terminate_all()
+            raise
+        finally:
+            self._close_logs()

@@ -248,24 +248,34 @@ class TrainerProgram:
                                   for n, t in self._params.items()}
         else:
             # send ops: push grads (the server-side optimizer applies)
-            pushed = []
             for name, t in self._params.items():
                 g = t.grad
-                if g is None:
-                    continue   # frozen / unused params are never pushed
                 rt = self._remote(self._placement[name])
+                if g is None:
+                    # frozen / unused params push no grad — but in sync
+                    # mode the version must still advance, or every
+                    # OTHER trainer's barrier on this table stalls to
+                    # its timeout waiting for a push that never comes
+                    if self._sync and self._trainers > 1:
+                        rt.table_call(name, "bump_version")
+                    continue
                 rt.table_call(name, "push_dense_grad",
                               np.asarray(g.data, np.float32))
-                pushed.append(name)
             if self._sync and self._trainers > 1:
                 # sync barrier: a round is complete when every trainer's
-                # push is visible — table versions count pushes. Only
-                # tables THIS trainer pushed participate (a grad-less
-                # param's version never advances; waiting on it would
-                # deadlock every trainer)
+                # push (or grad-less version bump) is visible — table
+                # versions advance exactly `trainers` per round, so the
+                # barrier target is satisfiable for every table even
+                # when some trainer skipped a push.
+                #
+                # NOTE sync mode is SGD-EQUIVALENT ONLY: each trainer's
+                # grad applies as its own server-side optimizer step
+                # (the reference applies the aggregated grad once), so
+                # stateful optimizers (adagrad/adam) accumulate N moment
+                # updates per round and diverge from the reference.
                 target = self._round * self._trainers
                 deadline = time.time() + 60.0
-                for name in pushed:
+                for name in self._params:
                     rt = self._remote(self._placement[name])
                     while rt.table_call(name, "get_version") < target:
                         if time.time() > deadline:
@@ -280,6 +290,16 @@ class TrainerProgram:
 
 class DistributeTranspiler:
     """PS transpiler over the runtime tables (see module docstring).
+
+    Sync-mode caveat: ``sync_mode=True`` barriers each round on every
+    table's version (trainers that have no grad for a table post a
+    version bump so peers never stall), but each trainer's grad is
+    applied as a SEPARATE server-side optimizer step — equivalent to
+    the reference's aggregated update only for plain SGD (the sum of
+    per-grad SGD steps equals one summed-grad step). With adagrad/adam
+    tables the moments accumulate per push and diverge from the
+    reference; use ``optimizer="sgd"`` when reference-equivalent sync
+    training matters.
 
     Extension over the reference signature: the server-side optimizer
     is not recoverable from a ProgramDesc here, so ``transpile`` takes

@@ -25,6 +25,47 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+# id-keyed weakrefs (not instance attributes: Tensor's __slots__ has no
+# __dict__, and not a WeakSet: Tensor __eq__ is elementwise). The
+# finalizer pops the entry so a recycled id can't suppress a NEW
+# buffer's warning and the registry can't grow unboundedly.
+_warned_stat_buffers: dict = {}
+
+
+def warn_traced_stats_skipped(buffer, what: str) -> None:
+    """Warn (once per buffer) that a running-stat update was skipped
+    because the batch stats are traced values (jit/shard_map).
+
+    The reference updates running mean/var in-graph, so a migrated
+    script trained entirely under jit keeps its INIT running stats
+    (mean=0, var=1) and eval-mode forwards silently diverge. We cannot
+    assign a tracer into the buffer (it would leak into eval forwards
+    and state_dict), so the update is skipped — loudly. Workaround:
+    after (or periodically during) compiled training, run one EAGER
+    training-mode forward over a representative batch to refresh the
+    running stats, or construct the layer/call with
+    ``use_global_stats=True`` semantics in mind and load stats from a
+    checkpoint that has them."""
+    import weakref
+    key = id(buffer)
+    ref = _warned_stat_buffers.get(key)
+    if ref is not None and ref() is buffer:
+        return
+    try:
+        _warned_stat_buffers[key] = weakref.ref(
+            buffer, lambda _, k=key: _warned_stat_buffers.pop(k, None))
+    except TypeError:  # unweakrefable buffer type: warn every time
+        pass
+    import warnings
+    warnings.warn(
+        f"{what}: running mean/var update SKIPPED because the batch "
+        "stats are traced (jit/shard_map) — the buffers keep their "
+        "previous (possibly init) values, so eval-mode forwards after "
+        "compiled-only training will use stale statistics. Refresh "
+        "them with one eager training-mode forward after training "
+        "(warned once per buffer).")
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-05,
                data_format="NCHW", use_global_stats=None, name=None):
@@ -76,17 +117,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
     y, mean, var = apply("batch_norm_train", f, args, n_outputs=3)
-    if running_mean is not None and not isinstance(mean.data,
-                                                  jax.core.Tracer):
-        # eager only: under jit/shard_map the batch stats are traced
-        # values — assigning them into the buffer would leak a tracer
-        # (eval forward / state_dict would then fail). Compiled
-        # training uses the static buffers; refresh running stats with
-        # an eager pass when eval-mode stats are needed.
-        rm = _t(running_mean)
-        rv = _t(running_var)
-        rm._data = momentum * rm.data + (1 - momentum) * mean.data
-        rv._data = momentum * rv.data + (1 - momentum) * var.data
+    if running_mean is not None:
+        if isinstance(mean.data, jax.core.Tracer):
+            # under jit/shard_map the batch stats are traced values —
+            # assigning them into the buffer would leak a tracer (eval
+            # forward / state_dict would then fail), so the update is
+            # skipped. That silence cost real eval divergence (ADVICE
+            # r6 medium): warn once per buffer.
+            warn_traced_stats_skipped(running_mean, "batch_norm")
+        else:
+            rm = _t(running_mean)
+            rv = _t(running_var)
+            rm._data = momentum * rm.data + (1 - momentum) * mean.data
+            rv._data = momentum * rv.data + (1 - momentum) * var.data
     return y
 
 

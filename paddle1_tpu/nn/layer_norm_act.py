@@ -137,13 +137,17 @@ class SyncBatchNorm(_BatchNormBase):
             return _to_cf(y.astype(x.dtype)), mean, var
         y, mean, var = apply("sync_batch_norm", f,
                              (x, self.weight, self.bias), n_outputs=3)
-        if not isinstance(mean.data, jax.core.Tracer):
-            # eager SPMD only: under jit/shard_map the stats are traced
-            # values — assigning them to the buffer would leak a tracer
-            # into eval-mode forwards and state_dict. Compiled training
-            # keeps the buffers static; refresh running stats with an
-            # eager pass (or use_global_stats) when eval-mode stats are
-            # needed after jitted training.
+        if isinstance(mean.data, jax.core.Tracer):
+            # under jit/shard_map the stats are traced values —
+            # assigning them to the buffer would leak a tracer into
+            # eval-mode forwards and state_dict, so the update is
+            # skipped. Warn once per buffer (ADVICE r6: the silent
+            # skip left eval on init stats after compiled-only
+            # training); refresh with an eager training-mode pass (or
+            # use_global_stats) when eval-mode stats are needed.
+            from .functional.norm import warn_traced_stats_skipped
+            warn_traced_stats_skipped(self._mean, "SyncBatchNorm")
+        else:
             self._mean._data = (mom * self._mean.data
                                 + (1 - mom) * mean.data)
             self._variance._data = mom * self._variance.data + \

@@ -339,3 +339,57 @@ class TestAdviceR3Fixes(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestBatchNormTracedStatsWarning(unittest.TestCase):
+    """ADVICE r6 medium (nn/functional/norm.py): the silent skip of
+    running mean/var updates under jit/shard_map tracing must warn —
+    once per buffer — so eval-after-compiled-training divergence has a
+    signal."""
+
+    def test_warns_once_per_buffer_under_tracing(self):
+        import warnings
+
+        import paddle1_tpu.nn.functional as F
+
+        rm = to_tensor(np.zeros(3, np.float32))
+        rv = to_tensor(np.ones(3, np.float32))
+        x = np.random.default_rng(0).standard_normal((4, 3)).astype(
+            np.float32)
+
+        def f(xx):
+            return F.batch_norm(to_tensor(xx), rm, rv, training=True).data
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            jax.make_jaxpr(f)(x)
+        skipped = [r for r in rec if "SKIPPED" in str(r.message)]
+        self.assertEqual(len(skipped), 1, [str(r.message) for r in rec])
+
+        # once per buffer: a second trace over the SAME buffers is quiet
+        with warnings.catch_warnings(record=True) as rec2:
+            warnings.simplefilter("always")
+            jax.make_jaxpr(f)(x)
+        self.assertFalse([r for r in rec2 if "SKIPPED" in str(r.message)])
+
+        # the dedup contract itself (not trace caching): same buffer
+        # quiet, a DIFFERENT buffer still warns
+        from paddle1_tpu.nn.functional.norm import warn_traced_stats_skipped
+        with warnings.catch_warnings(record=True) as rec2b:
+            warnings.simplefilter("always")
+            warn_traced_stats_skipped(rm, "batch_norm")
+        self.assertFalse([r for r in rec2b if "SKIPPED" in str(r.message)])
+        other = to_tensor(np.zeros(3, np.float32))
+        with warnings.catch_warnings(record=True) as rec2c:
+            warnings.simplefilter("always")
+            warn_traced_stats_skipped(other, "batch_norm")
+        self.assertEqual(
+            1, len([r for r in rec2c if "SKIPPED" in str(r.message)]))
+
+        # ... and eager training still updates the stats silently
+        with warnings.catch_warnings(record=True) as rec3:
+            warnings.simplefilter("always")
+            F.batch_norm(to_tensor(x), rm, rv, training=True)
+        self.assertFalse([r for r in rec3 if "SKIPPED" in str(r.message)])
+        self.assertGreater(
+            float(np.abs(np.asarray(rm.numpy())).max()), 0.0)

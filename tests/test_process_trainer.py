@@ -230,3 +230,47 @@ class TestTrainerDesc:
         with pytest.raises(InvalidArgumentError, match="PipelineParallel"):
             fleet.create_trainer(fleet.TrainerDesc(
                 device_worker=fleet.DeviceWorkerDesc("section")))
+
+
+class TestOrphanDetection:
+    """PR 3 satellite: a worker whose leader died must exit promptly
+    with a clear error instead of hanging on its queue gets (120s on
+    the initial-param get, forever in the task loop)."""
+
+    def test_dead_parent_raises_promptly(self, monkeypatch):
+        import queue
+
+        from paddle1_tpu.distributed.fleet import process_trainer as pt
+
+        class _DeadParent:
+            def is_alive(self):
+                return False
+
+        monkeypatch.setattr(pt.mp, "parent_process", lambda: _DeadParent())
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="orphaned"):
+            # timeout=None = the task-loop get that used to block forever
+            pt._orphan_checked_get(queue.Queue(), None, "the next task")
+        assert time.monotonic() - t0 < 10
+
+    def test_finite_timeout_still_raises_empty(self):
+        import queue
+
+        from paddle1_tpu.distributed.fleet import process_trainer as pt
+
+        # in the MAIN process parent_process() is None: no orphan check
+        # applies and the plain-get timeout contract is preserved
+        t0 = time.monotonic()
+        with pytest.raises(queue.Empty):
+            pt._orphan_checked_get(queue.Queue(), 0.2, "the initial params")
+        dt = time.monotonic() - t0
+        assert 0.15 < dt < 5
+
+    def test_live_parent_delivers(self):
+        import queue
+
+        from paddle1_tpu.distributed.fleet import process_trainer as pt
+
+        q = queue.Queue()
+        q.put("payload")
+        assert pt._orphan_checked_get(q, 5, "x") == "payload"

@@ -171,6 +171,67 @@ class TestTranspilerEndToEnd:
         finally:
             ps.stop()
 
+    def test_sync_mode_tolerates_gradless_push_skip(self):
+        """ADVICE r6 low: a trainer that skips a push (grad-less param)
+        posts a version BUMP instead, so its peers' barrier on that
+        table stays satisfiable — pre-fix, trainer A stalled to the 60s
+        timeout waiting for a bias push trainer B never sends."""
+        paddle.seed(4)
+        lin_a = paddle.nn.Linear(4, 1)
+        lin_b = paddle.nn.Linear(4, 1)
+        x_np, y_np = _linreg_problem(seed=4)
+
+        def full_step(lin):
+            x, y = Tensor(x_np), Tensor(y_np)
+            return lambda: paddle.nn.functional.mse_loss(lin(x), y)
+
+        def weight_only_step(lin):
+            # the loss never touches the bias: B pushes no bias grad
+            x, y = Tensor(x_np), Tensor(y_np)
+            return lambda: paddle.nn.functional.mse_loss(
+                paddle.matmul(x, lin.weight), y)
+
+        real_ep = f"127.0.0.1:{_free_ports(1)[0]}"
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=0, program=full_step(lin_a), params=lin_a,
+                    pservers=real_ep, trainers=2, sync_mode=True, lr=0.05)
+        ps = t.get_pserver_program(real_ep)
+        ps.start()
+        try:
+            tp_a = t.get_trainer_program()
+            t2 = DistributeTranspiler()
+            t2.transpile(trainer_id=1, program=weight_only_step(lin_b),
+                         params=lin_b, pservers=real_ep, trainers=2,
+                         sync_mode=True, lr=0.05)
+            tp_b = t2.get_trainer_program()
+
+            errs = []
+
+            def drive(tp, steps=5):
+                try:
+                    exe = paddle.static.Executor()
+                    for _ in range(steps):
+                        exe.run(tp, feed={})
+                except Exception as e:
+                    errs.append(e)
+
+            tha = threading.Thread(target=drive, args=(tp_a,))
+            thb = threading.Thread(target=drive, args=(tp_b,))
+            t0 = time.time()
+            tha.start(); thb.start()
+            tha.join(timeout=50); thb.join(timeout=50)
+            assert not errs, errs
+            assert not tha.is_alive() and not thb.is_alive(), \
+                "sync barrier stalled on the grad-less table"
+            assert time.time() - t0 < 45  # nowhere near the 60s timeout
+            rt = RemoteTable(real_ep)
+            for n in rt.list_tables():
+                # every table advanced trainers-per-round: pushes from
+                # both (weight) or push+bump (bias)
+                assert rt.table_call(n, "get_version") == 10, n
+        finally:
+            ps.stop()
+
     def test_geo_mode_delta_sync(self):
         paddle.seed(3)
         lin = paddle.nn.Linear(4, 1)

@@ -15,7 +15,15 @@ checker enforces, over the runtime packages:
   ``raise``, or the ``except`` line carries an explicit
   ``# noqa: broad-except`` marker documenting why the catch is sound
   (e.g. a producer thread forwarding the error object to its consumer,
-  where it IS re-raised).
+  where it IS re-raised);
+* the marker itself must carry a **reason** (``# noqa: broad-except —
+  why``) — a bare marker is an error: the allowlist is documentation,
+  not an escape hatch;
+* **``except SimulatedPreemption``** without re-raise — an error except
+  in the designated preemption-handler files
+  (``PREEMPTION_HANDLER_FILES``): a preemption notice must unwind to
+  the resilient loop's handler (which checkpoints), and the supervisor
+  stack must never absorb one in a generic retry/cleanup wrapper.
 
 Retry wrappers must catch ``Exception``, never broader.
 
@@ -35,6 +43,12 @@ MARKER = "noqa: broad-except"
 DEFAULT_PATHS = ("paddle1_tpu", "tools", "bench.py", "benches.py")
 BROAD_NAMES = {"BaseException", "KeyboardInterrupt", "SystemExit",
                "GeneratorExit"}
+# catching the preemption notice without re-raising is only sound in
+# the loop that OWNS preemption handling (checkpoint + resume); any
+# other absorption — a supervisor retry wrapper, a cleanup path — turns
+# "preempt the worker" into a silent hang or lost progress
+PREEMPTION_NAMES = {"SimulatedPreemption"}
+PREEMPTION_HANDLER_FILES = ("distributed/resilience.py",)
 
 
 def _exception_names(node: ast.expr) -> Iterator[str]:
@@ -68,11 +82,26 @@ def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
         line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
         return MARKER in line
 
+    def marker_reason(lineno: int) -> str:
+        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        _, _, tail = line.partition(MARKER)
+        return tail.strip()
+
+    norm_path = path.replace(os.sep, "/")
+    preemption_handler = any(norm_path.endswith(suffix)
+                             for suffix in PREEMPTION_HANDLER_FILES)
+
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler):
             continue
+        has_marker = marked(node.lineno)
+        if has_marker and not marker_reason(node.lineno):
+            findings.append((
+                node.lineno,
+                f"'# {MARKER}' without a reason — the marker documents "
+                f"WHY the broad catch is sound ('# {MARKER} — <reason>')"))
         if node.type is None:
-            if not marked(node.lineno):
+            if not has_marker:
                 findings.append((
                     node.lineno,
                     "bare 'except:' swallows KeyboardInterrupt/"
@@ -80,13 +109,24 @@ def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
             continue
         broad = [n for n in _exception_names(node.type)
                  if n in BROAD_NAMES]
-        if broad and not _contains_raise(node) and not marked(node.lineno):
+        if broad and not _contains_raise(node) and not has_marker:
             findings.append((
                 node.lineno,
                 f"'except {'/'.join(broad)}' without re-raise — a retry/"
                 "cleanup wrapper here can swallow interrupts; catch "
                 "Exception, re-raise, or justify with "
                 f"'# {MARKER} — <reason>'"))
+        preempt = [n for n in _exception_names(node.type)
+                   if n in PREEMPTION_NAMES]
+        if preempt and not _contains_raise(node) and not has_marker \
+                and not preemption_handler:
+            findings.append((
+                node.lineno,
+                f"'except {'/'.join(preempt)}' without re-raise outside "
+                "the designated preemption handler "
+                f"({', '.join(PREEMPTION_HANDLER_FILES)}) — a preemption "
+                "notice must unwind to the resilient loop (which "
+                "checkpoints), not die in a retry/cleanup wrapper"))
     return findings
 
 
