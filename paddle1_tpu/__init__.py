@@ -41,6 +41,7 @@ from . import jit
 from . import static
 from . import distributed
 from . import inference
+from . import serving
 from . import utils
 from . import hub
 from . import vision

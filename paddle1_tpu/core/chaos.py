@@ -42,6 +42,11 @@ Injection points
                     :class:`SimulatedPreemption` (the maintenance-event
                     signal; also raised after :func:`request_preemption`,
                     which is safe to call from a real signal handler).
+``serve_slow_step`` — :func:`check_serve_slow` returns True on an armed
+                    serving micro-batch dispatch; the Batcher stalls
+                    that dispatch for ``serve_chaos_slow_s`` seconds (a
+                    slow/hiccuping executable — the deadline-expiry and
+                    shed paths' reproducible trigger).
 
 Worker-level points (checked by :func:`check_worker` from
 ``core.health.beat``, i.e. once per training step of a *supervised*
@@ -67,9 +72,9 @@ __all__ = [
     "SimulatedPreemption", "ChaosInjectedError", "configure",
     "configure_from_flags", "reset", "enabled", "fire", "counts",
     "maybe_poison", "check_checkpoint_write", "check_loader",
-    "check_preempt", "check_worker", "request_preemption",
-    "preemption_requested",
-    "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT",
+    "check_preempt", "check_serve_slow", "check_worker",
+    "request_preemption", "preemption_requested",
+    "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
 ]
 
@@ -77,13 +82,14 @@ POISON_BATCH = "nan_batch"
 CKPT_FAIL = "ckpt_fail"
 LOADER_RAISE = "loader_raise"
 PREEMPT = "preempt"
+SERVE_SLOW = "serve_slow_step"
 WORKER_KILL = "worker_kill"
 WORKER_HANG = "worker_hang"
 WORKER_UNHEALTHY = "worker_unhealthy"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
-           PREEMPT) + _WORKER_POINTS
+           PREEMPT, SERVE_SLOW) + _WORKER_POINTS
 
 
 class SimulatedPreemption(BaseException):
@@ -253,6 +259,14 @@ def check_loader() -> None:
     """``loader_raise``: raise on an armed dataloader-batch occurrence."""
     if enabled() and fire(LOADER_RAISE):
         raise ChaosInjectedError("chaos: injected dataloader failure")
+
+
+def check_serve_slow() -> bool:
+    """``serve_slow_step``: True on an armed serving-dispatch occurrence.
+    The *action* (sleeping ``serve_chaos_slow_s``) belongs to the
+    serving Batcher — this stays pure bookkeeping, like the worker
+    points."""
+    return enabled() and fire(SERVE_SLOW)
 
 
 def request_preemption() -> None:

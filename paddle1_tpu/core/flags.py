@@ -352,6 +352,53 @@ def _define_builtin_flags() -> None:
                 "retried/replayed operations come back clean, and "
                 "worker points fire in incarnation 0 only, so a "
                 "supervisor-restarted rank replays clean.")
+    # Serving runtime (consumed by paddle1_tpu.serving; the dynamic
+    # micro-batching analog of the reference's inference Config knobs —
+    # MIGRATING.md maps EnableMemoryOptim-era toggles onto these)
+    define_flag("serve_max_batch", 16,
+                "Serving micro-batch ceiling: the Batcher dispatches as "
+                "soon as this many request rows are queued (or the "
+                "batch timeout fires). Must be covered by the largest "
+                "shape bucket.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_batch_timeout_ms", 5.0,
+                "How long the Batcher holds an incomplete micro-batch "
+                "open for more requests before dispatching it anyway. "
+                "The latency/occupancy tradeoff dial: 0 dispatches "
+                "immediately (lowest latency, occupancy 1/bucket).",
+                validator=lambda v: v >= 0)
+    define_flag("serve_queue_depth", 256,
+                "Bound on queued (admitted, not yet dispatched) serving "
+                "requests; submissions beyond it are shed with "
+                "ServerOverloaded (admission control — an unbounded "
+                "queue converts overload into every request blowing "
+                "its deadline instead).",
+                validator=lambda v: v >= 1)
+    define_flag("serve_buckets", "",
+                "Comma-separated batch-size buckets the InferenceEngine "
+                "compiles (e.g. '1,4,16'); micro-batches pad up to the "
+                "smallest covering bucket so the executable count stays "
+                "fixed (the serving-side retrace guard). Empty = powers "
+                "of two up to serve_max_batch.")
+    define_flag("serve_deadline_ms", 0.0,
+                "Default per-request deadline: requests still queued "
+                "when it expires fail with DeadlineExceeded instead of "
+                "occupying a micro-batch (0 disables; submit() can "
+                "override per request).",
+                validator=lambda v: v >= 0)
+    define_flag("serve_chaos_slow_s", 0.25,
+                "How long the serve_slow_step chaos point stalls one "
+                "micro-batch dispatch (tests drive the deadline/shed "
+                "path with it).",
+                validator=lambda v: v >= 0)
+    # IO formats
+    define_flag("io_load_pickle", False,
+                "Allow fluid.io load_* to read LEGACY pickle payloads. "
+                "Off by default: pickle executes arbitrary code from an "
+                "untrusted checkpoint, and serving loads untrusted "
+                "artifacts — the current save_* format is np.savez "
+                "(non-executable). Enable only for trusted pre-PR-4 "
+                "files, then re-save.")
     define_flag("conv_nhwc", "auto",
                 "Run NCHW-API image ops (2-D conv with HWIO weights, "
                 "max/avg pool, batch norm) internally channels-last, "

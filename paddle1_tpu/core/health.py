@@ -55,8 +55,10 @@ except ImportError:  # pragma: no cover - only hit outside the package
     _chaos = None
 
 __all__ = ["beat", "supervised", "report_unhealthy", "request_drain",
-           "drain_requested", "reset", "HEARTBEAT_ENV", "STACKDUMP_ENV",
-           "INCARNATION_ENV", "UNHEALTHY_SUFFIX"]
+           "drain_requested", "add_drain_callback",
+           "remove_drain_callback", "reset",
+           "HEARTBEAT_ENV", "STACKDUMP_ENV", "INCARNATION_ENV",
+           "UNHEALTHY_SUFFIX"]
 
 HEARTBEAT_ENV = "PADDLE_FT_HEARTBEAT_FILE"
 STACKDUMP_ENV = "PADDLE_FT_STACKDUMP_FILE"
@@ -76,6 +78,9 @@ _beats = 0
 _drain = False
 _dump_fh = None  # keep the faulthandler file object alive
 _prev_sigterm = None  # the script's own handler, chained by _on_sigterm
+# drain subscribers (serving.Server registers one): each must be
+# signal-handler safe — set a flag/Event, never do work
+_drain_callbacks: list = []
 
 
 def _install_from_env() -> None:
@@ -124,8 +129,20 @@ def _on_sigterm(signum, frame):
     _drain = True
     if _chaos is not None:
         _chaos.request_preemption()
+    _notify_drain()
     if callable(_prev_sigterm):
         _prev_sigterm(signum, frame)
+
+
+def _notify_drain() -> None:
+    """Run registered drain subscribers (signal-context safe: they only
+    set flags). A failing subscriber must not block the others or the
+    chained handler."""
+    for cb in list(_drain_callbacks):
+        try:
+            cb()
+        except Exception:
+            pass
 
 
 def supervised() -> bool:
@@ -211,6 +228,28 @@ def request_drain() -> None:
     _drain = True
     if _chaos is not None:
         _chaos.request_preemption()
+    _notify_drain()
+
+
+def add_drain_callback(cb) -> None:
+    """Subscribe to drain requests (SIGTERM under supervision, or
+    :func:`request_drain`). The callback may fire from a signal handler:
+    it must only set a flag/Event. Duplicate registrations are
+    collapsed; unsubscribe with :func:`remove_drain_callback` (a
+    long-lived process creating servers per model reload must not
+    accumulate dead subscribers); ``reset()`` clears the list."""
+    with _lock:
+        if cb not in _drain_callbacks:
+            _drain_callbacks.append(cb)
+
+
+def remove_drain_callback(cb) -> None:
+    """Unsubscribe a drain callback (no-op if not registered)."""
+    with _lock:
+        try:
+            _drain_callbacks.remove(cb)
+        except ValueError:
+            pass
 
 
 def drain_requested() -> bool:
@@ -231,3 +270,4 @@ def reset() -> None:
         _last_beat = 0.0
         _beats = 0
         _drain = False
+        _drain_callbacks.clear()

@@ -92,6 +92,16 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
     basic_engine.cc).
     """
 
+    # Functionalized batch-norm running stats (ADVICE r5 medium): the
+    # momentum per captured buffer, recorded at trace time — a plain
+    # Python side channel, like the trace counters. The traced batch
+    # stats ride pure_loss's aux output; train_step blends them with
+    # the incoming buffer values and writes the result into the step's
+    # OUTPUT params, so compiled training keeps running stats exactly
+    # like eager training and sync_model/checkpoints see them — no
+    # extra outputs, no extra transfers.
+    stat_momentum: Dict[str, float] = {}
+
     def pure_loss(params, batch, key):
         if amp_dtype is not None:
             # bf16 autocast: compute params in bf16, masters stay f32 in
@@ -108,11 +118,29 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                 if (hasattr(a, "dtype")
                     and jnp.issubdtype(a.dtype, jnp.floating)) else a,
                 batch)
+        from ..nn.functional import norm as fnorm
         with autograd_engine.no_grad(), rng_scope(key):
             with layer.load_functional_state(params):
-                out = loss_fn(layer, batch)
+                with fnorm.collect_stat_updates() as stat_updates:
+                    out = loss_fn(layer, batch)
         out = out.data if isinstance(out, Tensor) else out
-        return out.astype(jnp.float32)
+        aux = {}
+        if stat_updates:
+            # map each captured OLD buffer array back to its params key
+            # by identity (load_functional_state swapped exactly these
+            # arrays in), and emit the raw batch stats as aux — the
+            # old/new blend happens in train_step, where composing
+            # multiple micro-steps is well-defined
+            ids = {id(v): k for k, v in params.items()}
+            for u in stat_updates:
+                for old, stat in ((u.old_mean, u.mean),
+                                  (u.old_var, u.var)):
+                    name = ids.get(id(old))
+                    if name is None:
+                        continue  # buffer not threaded through params
+                    stat_momentum[name] = float(u.momentum)
+                    aux[name] = stat.astype(jnp.float32)
+        return out.astype(jnp.float32), aux
 
     if recompute:
         # Rematerialisation must be per-BLOCK to cut peak memory
@@ -138,17 +166,19 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
             def micro(carry, xs):
                 g_acc, i = carry
                 mb, k = xs
-                l, g = jax.value_and_grad(pure_loss)(params, mb, k)
+                (l, aux), g = jax.value_and_grad(
+                    pure_loss, has_aux=True)(params, mb, k)
                 g_acc = jax.tree_util.tree_map(lambda a, b: a + b, g_acc, g)
-                return (g_acc, i + 1), l
+                return (g_acc, i + 1), (l, aux)
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
             keys = jax.random.split(key, grad_accum)
-            (grads, _), losses = jax.lax.scan(micro, (zeros, 0),
-                                              (batch, keys))
+            (grads, _), (losses, aux) = jax.lax.scan(micro, (zeros, 0),
+                                                     (batch, keys))
             grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
             loss = jnp.mean(losses)
         else:
-            loss, grads = jax.value_and_grad(pure_loss)(params, batch, key)
+            (loss, aux), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(params, batch, key)
         finite = None
         if check_finite:
             # detection sits at the autodiff boundary, on the RAW grads:
@@ -180,6 +210,22 @@ def make_train_step(layer: Layer, optimizer, loss_fn: Callable,
                 grads)
         new_params, new_state = optimizer.functional_update(
             params, grads, opt_state, lr)
+        if aux:
+            # functionalized running stats: new = m*old + (1-m)*batch
+            # (sequentially per micro-step under grad_accum, matching
+            # eager), OVERRIDING whatever zero-grad update the
+            # optimizer computed for the buffer entries. check_finite's
+            # keep-select below covers these too: a bad step keeps the
+            # old stats along with the old params.
+            for name, stat in aux.items():
+                m = stat_momentum[name]
+                cur = params[name].astype(jnp.float32)
+                if grad_accum > 1:  # stacked [accum, C] from the scan
+                    for i in range(grad_accum):
+                        cur = m * cur + (1 - m) * stat[i]
+                else:
+                    cur = m * cur + (1 - m) * stat
+                new_params[name] = cur.astype(params[name].dtype)
         if check_finite:
             # bad step → keep the incoming params/slots/step-count (the
             # reference update_loss_scaling "skip update" semantics),

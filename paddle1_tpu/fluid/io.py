@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import zipfile
 from typing import Callable, Optional
 
 import numpy as np
@@ -116,9 +117,86 @@ def _select(vars=None, predicate: Optional[Callable] = None,
 
 
 # key sets of files THIS process wrote, so the periodic same-keys
-# re-save (checkpoint-as-you-train) doesn't unpickle the whole previous
+# re-save (checkpoint-as-you-train) doesn't re-read the whole previous
 # checkpoint just to prove compatibility
 _written_keys: dict = {}
+
+
+def _load_payload(path):
+    """Read one payload file. Current format is ``np.savez`` (a zip of
+    .npy members — NON-EXECUTABLE: np.load with allow_pickle=False can
+    not run code, which matters because serving loads untrusted
+    artifacts). Legacy pre-PR-4 pickle payloads load only behind the
+    explicit ``io_load_pickle`` opt-in flag: unpickling EXECUTES
+    arbitrary code from the file (ADVICE r5)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return _decode_ext_dtypes({k: z[k] for k in z.files})
+    except (ValueError, OSError, KeyError,
+            zipfile.BadZipFile) as npz_err:
+        from ..core import flags as core_flags
+        if core_flags.flag("io_load_pickle"):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        raise InvalidArgumentError(
+            f"load: {path} is not an np.savez payload ({npz_err}). If "
+            "it is a LEGACY pickle checkpoint from an older build: "
+            "pickle executes arbitrary code from untrusted files, so "
+            "loading it needs the explicit opt-in "
+            "set_flags({'io_load_pickle': True}) (or "
+            "FLAGS_io_load_pickle=1) — only for files you trust; "
+            "re-save to migrate them to the non-executable format."
+        ) from npz_err
+
+
+def _payload_keys(path):
+    """The variable names a payload file holds, or None when unreadable
+    (unknown format and no pickle opt-in)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return {k for k in z.files
+                    if not k.startswith(_EXT_DTYPE_KEY)}
+    except (ValueError, OSError, KeyError, zipfile.BadZipFile):
+        pass
+    from ..core import flags as core_flags
+    if core_flags.flag("io_load_pickle"):
+        try:
+            with open(path, "rb") as f:
+                existing = pickle.load(f)
+            if isinstance(existing, dict):
+                return set(existing)
+        except Exception:
+            pass
+    return None
+
+
+_EXT_DTYPE_KEY = "__ext_dtype__::"
+
+
+def _ext_dtype(name):
+    """Resolve an extension dtype (bfloat16, float8_*...) by name.
+    ``np.dtype("bfloat16")`` raises even with ml_dtypes registered, so
+    fall back to the ml_dtypes attribute (jax always ships it)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _decode_ext_dtypes(payload):
+    """Undo _write's extension-dtype encoding: sidecar-keyed uint8
+    views become their true dtype again; payloads without sidecars
+    (legacy pickle, plain npz) pass through untouched."""
+    out = {}
+    for k, v in payload.items():
+        if k.startswith(_EXT_DTYPE_KEY):
+            continue
+        sidecar = payload.get(_EXT_DTYPE_KEY + k)
+        if sidecar is not None:
+            v = np.ascontiguousarray(v).view(_ext_dtype(str(sidecar)))[..., 0]
+        out[k] = v
+    return out
 
 
 def _write(dirname, filename, tensors, default):
@@ -126,7 +204,7 @@ def _write(dirname, filename, tensors, default):
     payload = {}
     for k, t in tensors.items():
         try:
-            payload[k] = np.asarray(t.numpy())
+            arr = np.asarray(t.numpy())
         except RuntimeError as e:
             # a deleted backing buffer (donated by a compiled step that
             # aliased this registry tensor) — name the variable, or the
@@ -135,27 +213,39 @@ def _write(dirname, filename, tensors, default):
                 f"variable {k!r} in the save set has a deleted backing "
                 f"array ({e}); it was aliased into a donating compiled "
                 "step — sync/copy before saving") from e
+        if arr.dtype.kind == "V":
+            # extension dtype (bfloat16/float8 via ml_dtypes): np.savez
+            # accepts it silently but np.load hands back raw void bytes,
+            # so store a lossless uint8 view plus a dtype sidecar
+            payload[_EXT_DTYPE_KEY + k] = np.array(str(arr.dtype))
+            arr = np.frombuffer(arr.tobytes(), np.uint8).reshape(
+                arr.shape + (arr.dtype.itemsize,))
+        payload[k] = arr
     path = os.path.abspath(os.path.join(dirname, filename or default))
     if os.path.exists(path) and _written_keys.get(path) != set(payload):
         # Overwriting the same (or a grown) checkpoint as training
         # progresses is normal; overwriting a file holding variables the
         # new payload LACKS (another helper's output, another model, or
         # not a checkpoint at all) silently destroys them — error
-        # instead.
-        try:
-            with open(path, "rb") as f:
-                existing = pickle.load(f)
-            compatible = (isinstance(existing, dict)
-                          and set(payload) >= set(existing))
-        except Exception:
-            compatible = False
-        if not compatible:
+        # instead. An unreadable existing file counts as incompatible
+        # (never clobber what we can't prove is a subset).
+        existing_keys = _payload_keys(path)
+        if existing_keys is None or not set(payload) >= existing_keys:
             raise InvalidArgumentError(
                 f"save: {path} already exists and holds variables this "
                 "save would drop — refusing to clobber it. Pass a "
                 "distinct filename= (or remove the file) to save both.")
-    with open(path, "wb") as f:
-        pickle.dump(payload, f)
+    # the zip of .npy members written directly (np.savez's **kwargs API
+    # chokes on a variable literally named "file", its first positional
+    # parameter); np.load reads any such zip, and allow_pickle=False on
+    # BOTH sides means the artifact can never hold or execute code
+    from numpy.lib import format as _npformat
+    with open(path, "wb") as f, \
+            zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as zf:
+        for k, v in payload.items():
+            with zf.open(k + ".npy", "w", force_zip64=True) as member:
+                _npformat.write_array(member, np.asanyarray(v),
+                                      allow_pickle=False)
     _written_keys[path] = set(payload)
 
 
@@ -167,8 +257,7 @@ def _read(dirname, filename, defaults=(_FILE,)):
     for name in candidates:
         path = os.path.join(dirname, name)
         if os.path.exists(path):
-            with open(path, "rb") as f:
-                return pickle.load(f)
+            return _load_payload(path)
     try:
         present = sorted(os.listdir(dirname))[:8]
     except OSError:
@@ -217,9 +306,10 @@ def _restore(payload, strict_shapes=True):
                 f"load: saved {name} has shape {tuple(arr.shape)} but "
                 f"the live variable is {tuple(t.shape)}")
         # preserve the LIVE dtype (a checkpoint from an amp-cast run
-        # must not silently narrow a float32 model)
-        t._data = jnp.asarray(np.asarray(arr).astype(
-            np.dtype(str(t.dtype))))
+        # must not silently narrow a float32 model); t.dtype is a real
+        # np.dtype — never round-trip it through str(), which cannot
+        # resolve extension dtypes like bfloat16
+        t._data = jnp.asarray(np.asarray(arr).astype(t.dtype))
     if missing:
         raise NotFoundError(
             "load: no live variables named "

@@ -218,9 +218,19 @@ class PredictorHandle:
 
     def copy_to_cpu(self) -> np.ndarray:
         if self._buf is None:
-            raise RuntimeError(f"handle {self.name!r}: no data (run() "
-                               "first for outputs / copy_from_cpu for "
-                               "inputs)")
+            from ..core.errors import PreconditionNotMetError
+            if self._shape is not None:
+                # reshape() was called but no data ever arrived — the
+                # classic zero-copy-API stumble (reshape only declares
+                # the expected shape; it allocates nothing)
+                raise PreconditionNotMetError(
+                    f"handle {self.name!r}: reshape({self._shape}) only "
+                    "set the expected shape — it holds no data. For an "
+                    "input handle call copy_from_cpu(array) after "
+                    "reshape(); for an output handle call run() first.")
+            raise PreconditionNotMetError(
+                f"handle {self.name!r}: no data (run() first for "
+                "outputs / copy_from_cpu for inputs)")
         return self._buf
 
     def shape(self) -> List[int]:
@@ -330,8 +340,9 @@ class Predictor:
 
     def get_input_handle(self, name: str) -> PredictorHandle:
         if name not in self._inputs:
-            raise KeyError(f"unknown input {name!r}; inputs: "
-                           f"{self.get_input_names()}")
+            from ..core.errors import NotFoundError
+            raise NotFoundError(f"unknown input {name!r}; inputs: "
+                                f"{self.get_input_names()}")
         return self._inputs[name]
 
     def get_output_names(self) -> List[str]:
@@ -349,6 +360,17 @@ class Predictor:
         """Execute. Either positional ``inputs`` or pre-filled input
         handles (zero-copy style)."""
         if inputs is None:
+            unfilled = [m["name"] for m in self._input_meta
+                        if self._inputs[m["name"]]._buf is None]
+            if unfilled:
+                from ..core.errors import PreconditionNotMetError
+                raise PreconditionNotMetError(
+                    f"Predictor.run(): input handle(s) {unfilled} were "
+                    "never filled — for each input, "
+                    "get_input_handle(name).copy_from_cpu(array) before "
+                    "run() (reshape() alone declares a shape, it does "
+                    "not provide data), or pass run([arrays...]) "
+                    "positionally.")
             inputs = [self._inputs[m["name"]].copy_to_cpu()
                       for m in self._input_meta]
         outs = self._layer(*inputs)
@@ -359,6 +381,18 @@ class Predictor:
         for i, a in enumerate(arrs):
             self.get_output_handle(f"output_{i}").copy_from_cpu(a)
         return arrs
+
+    def serve(self, **kwargs) -> "object":
+        """Wrap this predictor in a dynamic micro-batching
+        :class:`~paddle1_tpu.serving.Server` (not started — call
+        ``.start()``). Keyword args pass through (``max_batch``,
+        ``batch_timeout_ms``, ``queue_depth``, ``buckets``,
+        ``deadline_ms``, ``warmup=True`` pre-compiles every bucket from
+        the artifact's input sidecar). The serving engine threads the
+        loaded StableHLO program's params through jit — single-request
+        ``run()`` and served responses match bit-for-bit."""
+        from ..serving import Server
+        return Server(self, **kwargs)
 
     def clear_intermediate_tensor(self) -> None:
         pass  # XLA owns buffers; parity no-op

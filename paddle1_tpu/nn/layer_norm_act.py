@@ -140,13 +140,17 @@ class SyncBatchNorm(_BatchNormBase):
         if isinstance(mean.data, jax.core.Tracer):
             # under jit/shard_map the stats are traced values —
             # assigning them to the buffer would leak a tracer into
-            # eval-mode forwards and state_dict, so the update is
-            # skipped. Warn once per buffer (ADVICE r6: the silent
-            # skip left eval on init stats after compiled-only
-            # training); refresh with an eager training-mode pass (or
-            # use_global_stats) when eval-mode stats are needed.
-            from .functional.norm import warn_traced_stats_skipped
-            warn_traced_stats_skipped(self._mean, "SyncBatchNorm")
+            # eval-mode forwards and state_dict. A framework-owned
+            # compiled step functionalizes the update (collected,
+            # blended into the step's output params, assigned outside
+            # the trace); user-compiled fns warn once per buffer
+            # (ADVICE r6: the silent skip left eval on init stats
+            # after compiled-only training) — refresh with an eager
+            # training-mode pass (or use_global_stats) there.
+            from .functional.norm import _record_traced_stat_update
+            _record_traced_stat_update(self._mean, self._variance,
+                                       mean.data, var.data,
+                                       self._momentum, "SyncBatchNorm")
         else:
             self._mean._data = (mom * self._mean.data
                                 + (1 - mom) * mean.data)

@@ -1,0 +1,45 @@
+"""TPU-native serving runtime: dynamic micro-batching inference.
+
+The serving half of the framework (ISSUE 4) — the training-side lessons
+(fuse dispatches, keep data on device, never retrace in the hot path)
+applied to inference under load:
+
+* :class:`InferenceEngine` — the forward compiled once per **shape
+  bucket** (``serve_buckets``), with warmup, the warn-once retrace
+  guard, the persistent compilation cache, and per-bucket
+  compile/dispatch counters.
+* :class:`~paddle1_tpu.serving.batcher.Batcher` — drains a bounded
+  request queue into micro-batches (``serve_max_batch`` /
+  ``serve_batch_timeout_ms``), pads to the bucket, dispatches once, and
+  scatters outputs through futures sharing one lazy readback.
+* :class:`Server` — admission control (``serve_queue_depth`` →
+  :class:`ServerOverloaded`), per-request deadlines
+  (:class:`DeadlineExceeded`), live :class:`ServingMetrics`, and
+  graceful SIGTERM drain via ``core/health`` so PR 3's Supervisor
+  manages serving workers like training workers.
+
+Quickstart::
+
+    import paddle1_tpu as paddle
+    srv = paddle.serving.Server(model, max_batch=16,
+                                batch_timeout_ms=5).start()
+    fut = srv.submit(x)              # x: [1, ...] per-request inputs
+    y = fut.result()                 # batched under the hood
+    print(srv.metrics.render_text()) # QPS, p99 splits, occupancy...
+    srv.wait()                       # serve until SIGTERM → drain
+
+Or straight from a deployed artifact::
+
+    pred = paddle.inference.create_predictor(cfg)
+    srv = pred.serve(warmup=True)
+"""
+
+from .batcher import Batcher, ServeFuture
+from .engine import InferenceEngine, resolve_buckets
+from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
+from .metrics import Counter, Histogram, ServingMetrics
+from .server import Server
+
+__all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
+           "ServingMetrics", "Counter", "Histogram", "ServerOverloaded",
+           "DeadlineExceeded", "ServerClosed", "resolve_buckets"]

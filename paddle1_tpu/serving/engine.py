@@ -1,0 +1,358 @@
+"""Shape-bucketed compiled inference: the serving executable cache.
+
+The training-side lessons (PR 1) applied to the serving hot path: every
+distinct input signature jitted is a full XLA compile, so an unbucketed
+server recompiles on every new micro-batch size and the host loop
+serializes behind the compiler. :class:`InferenceEngine` therefore
+compiles the forward once per **shape bucket** — a fixed, configurable
+list of batch sizes (``serve_buckets`` flag) — and every micro-batch is
+padded up to the smallest covering bucket, so steady-state serving runs
+a small, warm set of executables (Clipper/NSDI'17 adaptive batching,
+compiled-runtime form).
+
+Accounting mirrors ``ParallelEngine``: ``compile_counts``/
+``dispatch_counts`` (per bucket) are trace-side-effect counters — the
+"exactly one compile per bucket" acceptance gate reads them — and the
+warn-once retrace guard reuses the ``jit_retrace_warn`` flag, keyed on
+the *inner* signature (dims past the batch axis + dtypes): a new bucket
+is an expected, bounded compile; a new inner shape is the unbounded
+retrace hazard buckets exist to prevent. The persistent compilation
+cache (``jit_cache_dir``) is wired exactly as in training, so serving
+workers restarted by the Supervisor skip the recompile storm.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import flags as core_flags
+from ..core.errors import InvalidArgumentError, UnimplementedError
+
+__all__ = ["InferenceEngine", "resolve_buckets"]
+
+# numpy's dtype.__str__ walks the dtype registry every call (~10us);
+# submit() needs it per request, so cache per dtype object (builtin
+# dtypes are singletons)
+_DTYPE_STR: Dict[Any, str] = {}
+
+
+def _dtype_str(dt) -> str:
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR.setdefault(dt, str(dt))
+    return s
+
+
+def resolve_buckets(buckets=None, max_batch: Optional[int] = None
+                    ) -> Tuple[int, ...]:
+    """Normalize the bucket list: explicit sequence > ``serve_buckets``
+    flag > powers of two up to ``max_batch`` (``serve_max_batch`` flag).
+    Always sorted, deduped, and covering ``max_batch``."""
+    explicit_max = max_batch is not None
+    if max_batch is None:
+        max_batch = int(core_flags.flag("serve_max_batch"))
+    if buckets is None:
+        spec = core_flags.flag("serve_buckets")
+        if spec:
+            try:
+                buckets = [int(b) for b in str(spec).split(",") if
+                           b.strip()]
+            except ValueError:
+                raise InvalidArgumentError(
+                    f"serve_buckets must be comma-separated ints, got "
+                    f"{spec!r}") from None
+    if buckets is None:
+        buckets, b = [], 1
+        while b < max_batch:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_batch)
+    out = sorted({int(b) for b in buckets})
+    if not out or out[0] < 1:
+        raise InvalidArgumentError(f"buckets must be >= 1, got {out}")
+    if explicit_max and out[-1] < max_batch:
+        out.append(max_batch)  # the requested ceiling must dispatch
+    return tuple(out)
+
+
+class InferenceEngine:
+    """Compiled eval-mode forward with bucket-padded dispatch.
+
+    Parameters
+    ----------
+    model : one of
+        * ``nn.Layer`` — served via its functional state (params ride as
+          jit arguments, not baked constants); forced into eval mode.
+        * ``jit.TranslatedLayer`` / ``inference.Predictor`` — the
+          deserialized StableHLO artifact is called with its restored
+          params threaded through jit.
+        * plain callable ``fn(*arrays) -> array(s)`` — already pure.
+    buckets : batch-size buckets (see :func:`resolve_buckets`).
+    input_specs : optional ``[(shape_without_batch, dtype), ...]`` —
+        enables :meth:`warm_up` without example data. Derived from the
+        Predictor's ``.pdconfig`` sidecar automatically.
+    metrics : optional ServingMetrics to mirror compile counts into.
+    """
+
+    def __init__(self, model, buckets=None, max_batch: Optional[int] =
+                 None, input_specs=None, metrics=None):
+        core_flags.maybe_enable_compilation_cache()
+        import jax
+        self.metrics = metrics
+        self.compile_counts: Dict[int, int] = {}
+        self.dispatch_counts: Dict[int, int] = {}
+        self._seen_inner_sigs: set = set()
+        self._retrace_warned = False
+        self._lock = threading.Lock()
+        self._pure, self._params, specs, fixed_batch = \
+            self._build_pure(model)
+        self.input_specs = input_specs if input_specs is not None else \
+            specs
+        if fixed_batch is not None:
+            # a jit.save artifact is exported at ONE batch size — the
+            # StableHLO program has concrete shapes — so the only legal
+            # bucket is the exported batch: every micro-batch pads up
+            # to it (export at batch = intended max_batch to serve).
+            # Explicit conflicting buckets would compile fine here and
+            # then die deep inside jax.export at first dispatch — catch
+            # them typed at construction instead.
+            fb = (int(fixed_batch),)
+            asked = None
+            if buckets is not None:
+                asked = resolve_buckets(buckets, None)
+            elif max_batch is not None and int(max_batch) != fb[0]:
+                asked = (int(max_batch),)
+            if asked is not None and asked != fb:
+                raise InvalidArgumentError(
+                    f"this artifact was exported at batch "
+                    f"{fixed_batch} (concrete StableHLO shapes) — "
+                    f"the only legal bucket is {fb}, got {asked}; "
+                    "drop the buckets/max_batch override or "
+                    "re-export at the batch you want to serve")
+            self.buckets = fb
+        else:
+            self.buckets = resolve_buckets(buckets, max_batch)
+
+        def counted(params, inputs):
+            # runs only while TRACING (the standard trace-side-effect
+            # counter): one increment per (bucket, inner-sig) compile
+            bucket = int(np.shape(inputs[0])[0]) if inputs else 0
+            with self._lock:
+                self.compile_counts[bucket] = \
+                    self.compile_counts.get(bucket, 0) + 1
+            if self.metrics is not None:
+                self.metrics.counter("compiles_total").inc()
+                self.metrics.counter(f"compiles_bucket_{bucket}").inc()
+            out = self._pure(params, inputs)
+            if not isinstance(out, (list, tuple)):
+                out = (out,)
+            return tuple(out)
+
+        self._jit = jax.jit(counted)
+
+    # -- model → pure fn ----------------------------------------------------
+
+    def _build_pure(self, model):
+        """Resolve (pure_fn(params, inputs) -> outputs, params, specs,
+        fixed_batch). ``fixed_batch`` is non-None for exported
+        (StableHLO) artifacts, whose shapes are concrete."""
+        from ..nn.layer_base import Layer
+        from ..jit import TranslatedLayer
+
+        specs = None
+        fixed_batch = None
+        # Predictor adapter: unwrap the loaded artifact; the sidecar
+        # metadata supplies warmup specs and the exported batch size.
+        # Lazy import (serving ← inference only here, inference →
+        # serving only inside Predictor.serve) and isinstance, not a
+        # class-name string — subclasses must route through the adapter
+        from ..inference import Predictor
+        if isinstance(model, Predictor) and hasattr(model, "_layer"):
+            metas = getattr(model, "_input_meta", [])
+            specs = [(tuple((m.get("shape") or [1, 1])[1:]),
+                      m.get("dtype") or "float32")
+                     for m in metas] or None
+            shapes = [m.get("shape") for m in metas if m.get("shape")]
+            if shapes:
+                fixed_batch = int(shapes[0][0])
+            model = model._layer
+        if type(model).__name__ == "_QuantRunner":
+            raise UnimplementedError(
+                "serving a quantized Predictor is not supported yet — "
+                "its dequant wrapper materializes inputs with "
+                "np.asarray, which cannot trace. Serve the fp32 "
+                "artifact (quantize at export instead).")
+
+        if isinstance(model, TranslatedLayer):
+            exported = model._exported
+            params = {p.name: p.data for p in model.parameters()}
+
+            def pure(p, inputs):
+                return exported.call(p, *inputs)
+            return pure, params, specs, fixed_batch
+
+        if isinstance(model, Layer):
+            model.eval()  # serving is eval mode: dropout off, BN stats
+            params = model.functional_state()
+            from ..autograd import engine as autograd_engine
+            from ..core.generator import rng_scope
+            from ..core.tensor import Tensor
+
+            def pure(p, inputs):
+                import jax
+                with autograd_engine.no_grad(), \
+                        rng_scope(jax.random.key(0)):
+                    with model.load_functional_state(p):
+                        out = model(*[Tensor(a, stop_gradient=True)
+                                      for a in inputs])
+
+                def unwrap(o):
+                    if isinstance(o, (list, tuple)):
+                        return type(o)(unwrap(x) for x in o)
+                    return o.data if isinstance(o, Tensor) else o
+                return unwrap(out)
+            return pure, params, specs, None
+
+        if callable(model):
+            return (lambda p, inputs: model(*inputs)), {}, specs, None
+        raise InvalidArgumentError(
+            f"InferenceEngine needs a Layer, TranslatedLayer, Predictor "
+            f"or callable, got {type(model).__name__}")
+
+    # -- bucketing ----------------------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int) -> int:
+        """Smallest bucket covering ``rows``."""
+        if rows < 1:
+            raise InvalidArgumentError(f"need >= 1 row, got {rows}")
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        raise InvalidArgumentError(
+            f"{rows} rows exceed the largest bucket {self.buckets[-1]} "
+            f"(buckets {list(self.buckets)}) — raise serve_max_batch/"
+            "serve_buckets or split the request")
+
+    def _inner_sig(self, arrays) -> tuple:
+        # on the per-request admission path: keep it allocation-light
+        out = []
+        for a in arrays:
+            if not isinstance(a, np.ndarray):
+                a = np.asarray(a)
+            out.append((a.shape[1:], _dtype_str(a.dtype)))
+        return tuple(out)
+
+    def _guard_retrace(self, sig) -> None:
+        if sig in self._seen_inner_sigs:
+            return
+        if self._seen_inner_sigs and not self._retrace_warned \
+                and core_flags.flag("jit_retrace_warn"):
+            self._retrace_warned = True
+            import warnings
+            warnings.warn(
+                "InferenceEngine is retracing: a request arrived with a "
+                f"new non-batch signature {sig} (seen "
+                f"{len(self._seen_inner_sigs)} before). Batch-size "
+                "variation is absorbed by the buckets, but every "
+                "distinct inner shape/dtype costs a full XLA compile "
+                "per bucket — pad sequence dims to fixed lengths (set "
+                "FLAGS_jit_retrace_warn=0 to silence).")
+        self._seen_inner_sigs.add(sig)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def pad_to_bucket(self, arrays: Sequence[np.ndarray]
+                      ) -> Tuple[List[np.ndarray], int, int]:
+        """Zero-pad the batch axis up to the covering bucket; returns
+        (padded, rows, bucket)."""
+        rows = int(np.shape(arrays[0])[0])
+        for a in arrays[1:]:
+            if int(np.shape(a)[0]) != rows:
+                raise InvalidArgumentError(
+                    "all inputs of one request batch must share the "
+                    f"batch dim; got {[np.shape(a) for a in arrays]}")
+        bucket = self.bucket_for(rows)
+        if bucket == rows:
+            return list(arrays), rows, bucket
+        padded = []
+        for a in arrays:
+            a = np.asarray(a)
+            pad = np.zeros((bucket - rows,) + a.shape[1:], a.dtype)
+            padded.append(np.concatenate([a, pad], axis=0))
+        return padded, rows, bucket
+
+    def dispatch_padded(self, padded: Sequence[np.ndarray],
+                        bucket: Optional[int] = None):
+        """Run the bucket executable on already-padded inputs (the
+        Batcher path, which pads itself to time the pad separately).
+        Returns the device output tuple WITHOUT reading back — the
+        caller decides when to pay the device→host fetch (the Batcher
+        shares one readback across a whole micro-batch)."""
+        if bucket is None:
+            bucket = int(np.shape(padded[0])[0])
+        self._guard_retrace(self._inner_sig(padded))
+        with self._lock:
+            self.dispatch_counts[bucket] = \
+                self.dispatch_counts.get(bucket, 0) + 1
+        return self._jit(self._params, tuple(padded))
+
+    def dispatch(self, arrays: Sequence[np.ndarray]):
+        """Pad + run. Returns (device outputs tuple, rows, bucket)."""
+        padded, rows, bucket = self.pad_to_bucket(arrays)
+        return self.dispatch_padded(padded, bucket), rows, bucket
+
+    def infer(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Synchronous single-shot convenience: dispatch + read back +
+        slice the padding off. One device→host round trip per call —
+        the cost the Batcher exists to amortize."""
+        outs, rows, _ = self.dispatch(arrays)
+        return [np.asarray(o)[:rows] for o in outs]
+
+    # -- warmup / accounting ------------------------------------------------
+
+    def warm_up(self, example: Optional[Sequence[np.ndarray]] = None
+                ) -> int:
+        """Pre-compile every bucket at startup (the anti-cold-start
+        knob: first-request latency stops including XLA compiles).
+        Needs ``input_specs`` or one ``example`` request to synthesize
+        shapes from. Returns the number of buckets compiled."""
+        if example is not None:
+            specs = [(tuple(np.shape(a)[1:]),
+                      str(np.asarray(a).dtype)) for a in example]
+        elif self.input_specs:
+            # normalize the dtype spelling through np.dtype so the
+            # recorded signature matches _inner_sig's form even when the
+            # spec was given as e.g. np.float32 (str() of a dtype CLASS
+            # would record "<class ...>" and misfire the retrace warning
+            # on the first real request)
+            specs = [(tuple(s), _dtype_str(np.dtype(d)))
+                     for s, d in self.input_specs]
+        else:
+            raise InvalidArgumentError(
+                "warm_up needs input_specs=[(shape_without_batch, "
+                "dtype), ...] or an example request")
+        import jax
+        done = 0
+        for b in self.buckets:
+            outs = self._jit(self._params, tuple(
+                np.zeros((b,) + tuple(shape), np.dtype(dt))
+                for shape, dt in specs))
+            jax.block_until_ready(outs)
+            done += 1
+        self._seen_inner_sigs.add(tuple(specs))
+        return done
+
+    def cache_stats(self) -> Dict[str, int]:
+        """hits/misses across all buckets (the ParallelEngine idiom)."""
+        with self._lock:
+            compiles = sum(self.compile_counts.values())
+            dispatches = sum(self.dispatch_counts.values())
+        return {"hits": dispatches - min(compiles, dispatches),
+                "misses": compiles}

@@ -1,0 +1,25 @@
+"""Typed serving errors (extending the core enforce hierarchy): callers
+distinguish *shed* (back off / retry elsewhere), *expired* (the answer
+is late, not wrong) and *closed* (the worker is draining) without
+string-matching messages — the load-balancer contract."""
+
+from __future__ import annotations
+
+from ..core.errors import (ExecutionTimeoutError, ResourceExhaustedError,
+                           UnavailableError)
+
+__all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed"]
+
+
+class ServerOverloaded(ResourceExhaustedError):
+    """Admission control shed the request: the bounded queue is full.
+    Raised synchronously by ``Server.submit`` — nothing was enqueued."""
+
+
+class DeadlineExceeded(ExecutionTimeoutError):
+    """The request's deadline expired while it was still queued; it was
+    never dispatched. Delivered through the request's future."""
+
+
+class ServerClosed(UnavailableError):
+    """The server is draining or stopped and no longer admits work."""

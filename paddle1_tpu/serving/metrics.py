@@ -1,0 +1,178 @@
+"""Serving metrics: counters + latency histograms with a plain-text dump.
+
+The observability half of the serving runtime (ISSUE 4): every number a
+load balancer / autoscaler / on-call needs to reason about a serving
+worker — QPS, queue/pad/dispatch/readback latency quantiles, batch
+occupancy, shed and deadline counts, per-bucket compile counts — lives
+in one :class:`ServingMetrics` registry. ``snapshot()`` returns it as a
+plain dict (JSON-able; the test/bench surface), ``render_text()`` emits
+a Prometheus-style exposition for scraping.
+
+Deliberately dependency-free and cheap: counters are a locked int,
+histograms keep exact count/sum plus a bounded reservoir of recent
+observations for quantiles (serving latency distributions are what the
+last few thousand requests say, not what the process saw at boot). A
+registry is instantiated per :class:`~paddle1_tpu.serving.Server`, so
+two servers in one process (A/B models) never mix their numbers.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["Counter", "Histogram", "ServingMetrics"]
+
+# reservoir size per histogram: large enough for a stable p99 (the
+# quantile of the last ~4k observations), small enough to sort per
+# snapshot without showing up in a profile
+_RESERVOIR = 4096
+# QPS window: rate over the last N responses' timestamps
+_QPS_WINDOW = 512
+
+
+class Counter:
+    """Monotone counter (requests, sheds, compiles...)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Histogram:
+    """Latency/occupancy histogram: exact count+sum, reservoir quantiles."""
+
+    __slots__ = ("name", "_lock", "count", "sum", "max", "_recent")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self._recent: collections.deque = collections.deque(
+            maxlen=_RESERVOIR)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v > self.max:
+                self.max = v
+            self._recent.append(v)
+
+    def percentile(self, p: float) -> float:
+        """Quantile over the reservoir (nearest-rank); 0.0 when empty."""
+        with self._lock:
+            data = sorted(self._recent)
+        if not data:
+            return 0.0
+        idx = min(len(data) - 1, max(0, int(round(
+            (p / 100.0) * (len(data) - 1)))))
+        return data[idx]
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            data = sorted(self._recent)
+            count, total, mx = self.count, self.sum, self.max
+        def q(p):
+            if not data:
+                return 0.0
+            return data[min(len(data) - 1,
+                            max(0, int(round((p / 100.0)
+                                             * (len(data) - 1)))))]
+        return {"count": count, "sum": round(total, 4),
+                "mean": round(total / count, 4) if count else 0.0,
+                "p50": round(q(50), 4), "p95": round(q(95), 4),
+                "p99": round(q(99), 4), "max": round(mx, 4)}
+
+
+class ServingMetrics:
+    """The per-server registry. Counters and histograms are created on
+    first touch, so instrumentation points never need registration
+    boilerplate and ``snapshot()`` only reports what actually fired."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._resp_times: collections.deque = collections.deque(
+            maxlen=_QPS_WINDOW)
+        self._started = time.monotonic()
+
+    # -- instrumentation surface -------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name))
+        return h
+
+    def record_response(self, n: int = 1) -> None:
+        """Feed the QPS window (called once per completed request)."""
+        now = time.monotonic()
+        with self._lock:
+            for _ in range(n):
+                self._resp_times.append(now)
+
+    def qps(self) -> float:
+        """Responses/second over the recent-response window."""
+        with self._lock:
+            if len(self._resp_times) < 2:
+                return 0.0
+            span = self._resp_times[-1] - self._resp_times[0]
+            n = len(self._resp_times) - 1
+        if span <= 0:
+            # burst faster than the clock tick: rate over process life
+            span = max(time.monotonic() - self._started, 1e-6)
+            n += 1
+        return n / span
+
+    # -- export surface -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """The whole registry as one JSON-able dict."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            hists = list(self._histograms.values())
+        return {
+            "qps": round(self.qps(), 2),
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "counters": counters,
+            "histograms": {h.name: h.summary() for h in hists},
+        }
+
+    def render_text(self) -> str:
+        """Prometheus-style plain-text exposition (one scrape page)."""
+        snap = self.snapshot()
+        lines = [f"p1t_serving_qps {snap['qps']}",
+                 f"p1t_serving_uptime_seconds {snap['uptime_s']}"]
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"p1t_serving_{name} {v}")
+        for name, s in sorted(snap["histograms"].items()):
+            for stat in ("count", "sum", "mean", "p50", "p95", "p99",
+                         "max"):
+                lines.append(f"p1t_serving_{name}_{stat} {s[stat]}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,335 @@
+"""Serving front end: admission control, deadlines, graceful drain.
+
+The :class:`Server` is the piece a serving *worker process* wraps around
+an :class:`~paddle1_tpu.serving.engine.InferenceEngine`: clients
+``submit()`` requests and get futures back; a bounded queue sheds
+overload with the typed :class:`ServerOverloaded` (fail fast at the
+door — an unbounded queue converts overload into every request blowing
+its deadline); per-request deadlines fail late requests with
+:class:`DeadlineExceeded`; and SIGTERM (or
+``core.health.request_drain()``) triggers the graceful-drain protocol —
+stop admitting, flush everything already accepted, report — wired
+through the same ``core/health`` channel PR 3's Supervisor speaks, so a
+serving worker is supervised (heartbeats, hang detection, restart,
+drain) exactly like a training worker.
+
+Accounting invariant (the no-silent-drops contract, asserted by the
+drain tests): every accepted request resolves — success, typed deadline
+failure, or typed error. ``drain()`` returns a report proving it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import flags as core_flags
+from ..core import health as core_health
+from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from .batcher import Batcher, ServeFuture, _Request
+from .engine import InferenceEngine
+from .errors import ServerClosed, ServerOverloaded
+from .metrics import ServingMetrics
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Micro-batching inference server over one model.
+
+    Parameters (``None`` → the ``serve_*`` flag defaults)
+    ----------------------------------------------------
+    model : anything :class:`InferenceEngine` accepts (Layer,
+        Predictor/TranslatedLayer, plain callable) or a pre-built
+        engine.
+    max_batch : micro-batch row ceiling (≤ the engine's largest bucket).
+    batch_timeout_ms : how long an incomplete batch waits for company.
+    queue_depth : admitted-but-undispatched request bound (admission
+        control; beyond it ``submit`` sheds with ``ServerOverloaded``).
+    deadline_ms : default per-request deadline (0/None → none).
+    warmup : pre-compile every bucket in ``start()`` (needs
+        ``input_specs`` — automatic for Predictor artifacts).
+    """
+
+    def __init__(self, model, max_batch: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 buckets=None, input_specs=None,
+                 deadline_ms: Optional[float] = None,
+                 warmup: bool = False,
+                 metrics: Optional[ServingMetrics] = None):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        if isinstance(model, InferenceEngine):
+            if buckets is not None or input_specs is not None:
+                raise InvalidArgumentError(
+                    "buckets/input_specs cannot be applied to a "
+                    "pre-built InferenceEngine (its executables are "
+                    "already keyed) — pass them to InferenceEngine(), "
+                    "or hand Server the raw model")
+            self.engine = model
+            # latest-wins: the server currently serving the engine owns
+            # the compile/warmup mirroring (a reused engine would
+            # otherwise report into the first, long-discarded registry)
+            self.engine.metrics = self.metrics
+        else:
+            self.engine = InferenceEngine(
+                model, buckets=buckets, max_batch=max_batch,
+                input_specs=input_specs, metrics=self.metrics)
+        if max_batch is None:
+            # default clamps to the engine's top bucket, so explicit
+            # buckets (1,4) aren't tripped up by the flag's 16 default
+            self.max_batch = min(
+                int(core_flags.flag("serve_max_batch")),
+                self.engine.max_batch)
+        else:
+            self.max_batch = int(max_batch)
+        if self.max_batch > self.engine.max_batch:
+            raise InvalidArgumentError(
+                f"max_batch={self.max_batch} exceeds the engine's "
+                f"largest bucket {self.engine.max_batch} — a full "
+                "micro-batch would be undispatchable")
+        self.batch_timeout_ms = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else core_flags.flag("serve_batch_timeout_ms"))
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else core_flags.flag("serve_queue_depth"))
+        dl = deadline_ms if deadline_ms is not None \
+            else core_flags.flag("serve_deadline_ms")
+        self.default_deadline_ms = float(dl) if dl else None
+        self._warmup = bool(warmup)
+        self._q: "queue.Queue[_Request]" = queue.Queue(self.queue_depth)
+        self._drain_event = threading.Event()
+        self._accepting = False
+        # makes {accepting-check → requests_total → enqueue} atomic
+        # against drain()'s accepting-flip: without it a drain landing
+        # between the count and the put snapshots accepted=completed+1
+        # and reports unaccounted=1 for a request that resolves typed a
+        # beat later (uncontended acquire is ~100ns — no convoy)
+        self._admit_lock = threading.Lock()
+        self._batcher: Optional[Batcher] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Server":
+        """Start the batcher thread (idempotent). Call from the main
+        thread: adopting the Supervisor's health channel installs the
+        SIGTERM→drain handler, which Python only allows there."""
+        if self._batcher is not None and self._batcher.is_alive():
+            return self
+        # restart-after-drain reopens the server: clear the stale drain
+        # latch BEFORE resubscribing, or the fresh batcher below would
+        # exit on its first pass and every submit would see ServerClosed.
+        # A process-level drain (SIGTERM) pending right now is re-latched
+        # by the drain_requested() check just after.
+        self._drain_event.clear()
+        # adopt the supervisor heartbeat channel (no-op unsupervised)
+        # and subscribe this server to drain requests — a SIGTERM while
+        # loaded stops admission and flushes, it never drops work
+        supervised = core_health.supervised()
+        core_health.beat()
+        core_health.add_drain_callback(self._drain_event.set)
+        if core_health.drain_requested():
+            self._drain_event.set()
+        if not supervised and threading.current_thread() is \
+                threading.main_thread():
+            # standalone worker (no Supervisor → health installed no
+            # handler): SIGTERM must still mean "drain", not "die with
+            # the queue full". Chain whatever the script installed.
+            import signal
+            prev = signal.getsignal(signal.SIGTERM)
+            if not getattr(prev, "_p1_serving_drain", False):
+                # install once per process: a restart-after-drain loop
+                # must not wrap our own handler in a fresh closure each
+                # cycle (an N-deep chain re-running request_drain N
+                # times per SIGTERM)
+
+                def _on_sigterm(signum, frame, _prev=prev):
+                    core_health.request_drain()  # fans out to subscribers
+                    if callable(_prev):
+                        _prev(signum, frame)
+                _on_sigterm._p1_serving_drain = True
+                try:
+                    signal.signal(signal.SIGTERM, _on_sigterm)
+                except (OSError, ValueError):  # pragma: no cover
+                    pass  # exotic host; drain() still works
+                    # programmatically
+        if self._warmup:
+            n = self.engine.warm_up()
+            self.metrics.counter("warmup_buckets_total").inc(n)
+        self._batcher = Batcher(self.engine, self._q, self.max_batch,
+                                self.batch_timeout_ms, self.metrics,
+                                self._drain_event)
+        self._batcher.start()
+        self._accepting = True
+        return self
+
+    @property
+    def running(self) -> bool:
+        return (self._batcher is not None and self._batcher.is_alive()
+                and self._accepting)
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, *inputs, deadline_ms: Optional[float] = None
+               ) -> ServeFuture:
+        """Enqueue one request (each input carries a leading batch dim;
+        a plain sample may be 1-row). Returns a future; raises
+        ``ServerOverloaded`` (queue full) or ``ServerClosed``
+        (draining/stopped) synchronously."""
+        if not self._accepting or self._drain_event.is_set():
+            raise ServerClosed(
+                "server is draining/stopped — not admitting requests")
+        if self._batcher is None or not self._batcher.is_alive():
+            raise ServerClosed(
+                "server not started (or its batcher died: "
+                f"{self._batcher.fatal!r})" if self._batcher is not None
+                else "server not started — call start()")
+        if not inputs:
+            raise InvalidArgumentError("submit needs >= 1 input array")
+        arrays = [np.asarray(getattr(a, "data", a)) for a in inputs]
+        rows = int(np.shape(arrays[0])[0]) if np.ndim(arrays[0]) else 0
+        if rows < 1:
+            raise InvalidArgumentError(
+                "request inputs need a leading batch dim (reshape a "
+                "single sample to [1, ...])")
+        if rows > self.max_batch:
+            raise InvalidArgumentError(
+                f"request has {rows} rows > max_batch={self.max_batch} "
+                "— split it client-side")
+        # every input must agree on the batch dim HERE, before enqueue:
+        # a mismatched request that reached the Batcher would fail
+        # pad_to_bucket at dispatch and take every innocent request
+        # co-batched with it down too
+        for i, a in enumerate(arrays[1:], start=1):
+            if np.ndim(a) < 1 or int(np.shape(a)[0]) != rows:
+                raise InvalidArgumentError(
+                    f"input {i} has leading dim "
+                    f"{np.shape(a)[0] if np.ndim(a) else '<scalar>'} but "
+                    f"input 0 has {rows} — all inputs of one request "
+                    "must share the batch dim")
+        dl = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        req = _Request(arrays, self.engine._inner_sig(arrays),
+                       dl / 1e3 if dl else None)
+        # counted BEFORE the enqueue: were it counted after, the batcher
+        # could complete the request before it registered as accepted
+        # and a concurrent snapshot would read unaccounted < 0. Sheds
+        # increment shed_total, so accepted = requests - sheds stays
+        # exact either way. The lock pairs the count with the enqueue
+        # so a drain() can never snapshot between them; the accepting
+        # re-check inside it closes the admission race for good.
+        with self._admit_lock:
+            if not self._accepting or self._drain_event.is_set():
+                raise ServerClosed(
+                    "server is draining/stopped — not admitting "
+                    "requests")
+            self.metrics.counter("requests_total").inc()
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.metrics.counter("shed_total").inc()
+                raise ServerOverloaded(
+                    f"queue depth {self.queue_depth} exhausted — "
+                    "request shed (scale out, raise serve_queue_depth, "
+                    "or slow the client)") from None
+        b = self._batcher
+        if self._drain_event.is_set() and b is not None \
+                and b.drained.is_set():
+            # lost the admission race: the lock serializes against
+            # drain(), but a SIGTERM/health callback sets _drain_event
+            # WITHOUT it — the batcher can flush and exit between the
+            # locked re-check and here, leaving this request in a queue
+            # nothing reads. Fail it typed rather than leave the future
+            # unresolved (errors_total keeps it accounted).
+            b._fail_queued(ServerClosed(
+                "server drained while the request was being admitted"),
+                wrap=False)
+        return req.future
+
+    def infer(self, *inputs, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(*inputs,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # -- drain / shutdown ---------------------------------------------------
+
+    def wait(self, poll_s: float = 0.1,
+             timeout: Optional[float] = None) -> dict:
+        """Serve until a drain is requested (SIGTERM under the
+        Supervisor, ``core.health.request_drain()``, or ``timeout``),
+        then drain and return the report — the serving worker's
+        main-loop idiom."""
+        t0 = time.monotonic()
+        while not self._drain_event.is_set():
+            if timeout is not None and time.monotonic() - t0 >= timeout:
+                break
+            core_health.beat()
+            time.sleep(poll_s)
+        return self.drain()
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful shutdown: stop admitting, flush every accepted
+        request (complete or fail typed), join the batcher, report."""
+        with self._admit_lock:
+            # any submit mid-admission finishes its count+enqueue first;
+            # everything counted from here on is in the queue, so the
+            # sweeps below account for all of it
+            self._accepting = False
+            self._drain_event.set()
+        drained = True
+        if self._batcher is not None:
+            drained = self._batcher.drained.wait(timeout)
+            self._batcher.join(timeout=max(timeout, 1.0))
+            if not drained:
+                # flush stalled (a wedged executable): fail what's left
+                # loudly rather than drop it silently — BOTH the
+                # still-queued requests and the ones the batcher already
+                # popped (mid-assembly or stuck inside the dispatch);
+                # first-wins resolution means a dispatch that un-wedges
+                # later can't overwrite these typed failures
+                exc = PreconditionNotMetError(
+                    f"drain timed out after {timeout}s")
+                self._batcher._fail_queued(exc, wrap=False)
+                self._batcher.fail_inflight(exc)
+            # ALWAYS sweep once more after the batcher exited (no-op on
+            # an empty queue): a submit() racing this drain can enqueue
+            # after the batcher's final flush, and its future must
+            # resolve typed, not hang
+            self._batcher._fail_queued(ServerClosed(
+                "server drained while the request was being admitted"),
+                wrap=False)
+        core_health.remove_drain_callback(self._drain_event.set)
+        snap = self.metrics.snapshot()
+        c = snap["counters"]
+        report = {
+            "drained": bool(drained),
+            "fatal": (repr(self._batcher.fatal)
+                      if self._batcher is not None
+                      and self._batcher.fatal is not None else None),
+            "accepted": (c.get("requests_total", 0)
+                         - c.get("shed_total", 0)),
+            "completed": c.get("responses_total", 0),
+            "deadline_failed": c.get("deadline_expired_total", 0),
+            "errors": c.get("errors_total", 0),
+            "shed": c.get("shed_total", 0),
+            "batches": c.get("batches_total", 0),
+            "compile_counts": dict(self.engine.compile_counts),
+        }
+        report["unaccounted"] = (report["accepted"] - report["completed"]
+                                 - report["deadline_failed"]
+                                 - report["errors"])
+        return report
+
+    stop = drain

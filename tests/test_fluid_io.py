@@ -41,10 +41,13 @@ class TestSaveLoad:
 
     def test_params_excludes_buffers(self, tmp_path):
         m = self._net(1)
-        fluid.io.save_params(None, str(tmp_path), filename="p.pkl")
-        import pickle, os
-        payload = pickle.load(open(os.path.join(tmp_path, "p.pkl"),
-                                   "rb"))
+        fluid.io.save_params(None, str(tmp_path), filename="p.npz")
+        import os
+        # the payload is np.savez — readable with allow_pickle=False,
+        # i.e. the non-executable format (ADVICE r5)
+        with np.load(os.path.join(tmp_path, "p.npz"),
+                     allow_pickle=False) as z:
+            payload = set(z.files)
         assert any(k.endswith("weight") for k in payload)
         assert not any("_mean" in k or "_variance" in k for k in payload)
 
@@ -64,15 +67,16 @@ class TestSaveLoad:
 
     def test_shape_mismatch_and_missing_are_loud(self, tmp_path):
         import os
-        import pickle
         m = self._net(3)
         fluid.io.save_persistables(None, str(tmp_path), filename="c")
         # corrupt one entry's shape in the checkpoint
         path = os.path.join(tmp_path, "c")
-        payload = pickle.load(open(path, "rb"))
+        with np.load(path, allow_pickle=False) as z:
+            payload = {k: z[k] for k in z.files}
         wname = m[0].weight.name
         payload[wname] = np.zeros((9, 9), np.float32)
-        pickle.dump(payload, open(path, "wb"))
+        with open(path, "wb") as f:
+            np.savez(f, **payload)
         from paddle1_tpu.core.errors import (InvalidArgumentError,
                                              NotFoundError)
         with pytest.raises(InvalidArgumentError, match="shape"):
@@ -87,9 +91,109 @@ class TestSaveLoad:
                                filename="one")
         # a checkpoint sharing no names with the model teaches
         with pytest.raises(NotFoundError, match="no parameter names"):
-            pickle.dump({"ghost": np.zeros(2, np.float32)},
-                        open(path, "wb"))
+            with open(path, "wb") as f:
+                np.savez(f, ghost=np.zeros(2, np.float32))
             fluid.io.load_params(None, str(tmp_path), filename="c")
+
+    def test_legacy_pickle_needs_opt_in(self, tmp_path):
+        """ADVICE r5: pickle executes arbitrary code from untrusted
+        checkpoints, so legacy pickle payloads load only behind the
+        explicit io_load_pickle flag; the current format is np.savez."""
+        import os
+        import pickle
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        from paddle1_tpu.core.flags import flags_guard
+        m = self._net(4)
+        # registry-named payload, exactly what the old pickle writer
+        # produced
+        want = {v.name: np.asarray(v.numpy())
+                for v in m.state_dict().values()
+                if getattr(v, "name", None)}
+        with open(os.path.join(tmp_path, "legacy"), "wb") as f:
+            pickle.dump(want, f)  # a pre-PR-4 checkpoint
+        with pytest.raises(InvalidArgumentError, match="io_load_pickle"):
+            fluid.io.load_persistables(None, str(tmp_path),
+                                       filename="legacy")
+        for t in m.state_dict().values():
+            t._data = t.data * 0 - 2.0
+        with flags_guard(io_load_pickle=True):
+            fluid.io.load_persistables(None, str(tmp_path),
+                                       filename="legacy")
+        got = {v.name: np.asarray(v.numpy())
+               for v in m.state_dict().values()
+               if getattr(v, "name", None)}
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-6)
+
+    def test_corrupt_payload_fails_typed(self, tmp_path):
+        """A truncated/corrupt checkpoint (save killed mid-stream)
+        raises zipfile.BadZipFile from np.load — both read paths must
+        convert that to their typed contract: load_* teaches about the
+        format, and the clobber guard refuses to overwrite what it
+        can't prove is a subset."""
+        import os
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        m = self._net(8)
+        path = os.path.join(str(tmp_path), "__params__")
+        with open(path, "wb") as f:
+            f.write(b"PK\x03\x04 truncated garbage")
+        with pytest.raises(InvalidArgumentError, match="io_load_pickle"):
+            fluid.io.load_params(None, str(tmp_path), main_program=m)
+        with pytest.raises(InvalidArgumentError, match="clobber"):
+            fluid.io.save_params(None, str(tmp_path), main_program=m)
+
+    def test_variable_named_file_roundtrips(self, tmp_path):
+        """np.savez's **kwargs API chokes on a member literally named
+        "file" (its first positional parameter) — the writer streams
+        the zip members itself, so any registry name saves."""
+        paddle.seed(9)
+        m = paddle.nn.Linear(3, 2,
+                             weight_attr=paddle.ParamAttr(name="file"))
+        assert m.weight.name == "file"
+        want = np.asarray(m.weight.numpy()).copy()
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        m.weight._data = m.weight.data * 0
+        fluid.io.load_params(None, str(tmp_path), main_program=m)
+        np.testing.assert_array_equal(np.asarray(m.weight.numpy()), want)
+
+    def test_bfloat16_roundtrips_through_npz(self, tmp_path):
+        """Extension dtypes (bfloat16 etc.) have no native npz encoding
+        — np.savez writes them silently but np.load hands back raw void
+        bytes. The writer must sidecar-encode them so a bf16 checkpoint
+        from a TPU run is loadable, bit-exact, with the live dtype
+        preserved."""
+        import jax.numpy as jnp
+        m = self._net(6)
+        w = m[0].weight
+        b = m[0].bias
+        w._data = w.data.astype(jnp.bfloat16)
+        want_w = np.asarray(w.numpy()).copy()
+        want_b = np.asarray(b.numpy()).copy()  # f32 neighbors unharmed
+        fluid.io.save_persistables(None, str(tmp_path))
+        w._data = (w.data * 0 - 3).astype(jnp.bfloat16)
+        b._data = b.data * 0 - 3.0
+        fluid.io.load_persistables(None, str(tmp_path))
+        assert w.dtype == np.asarray(want_w).dtype  # still bfloat16
+        np.testing.assert_array_equal(np.asarray(w.numpy()), want_w)
+        np.testing.assert_array_equal(np.asarray(b.numpy()), want_b)
+        # the payload is still the non-executable format
+        with np.load(str(tmp_path / "__persistables__"),
+                     allow_pickle=False) as z:
+            assert any(k.startswith("__ext_dtype__::") for k in z.files)
+
+    def test_saved_payload_is_not_executable(self, tmp_path):
+        """The r5 threat model, asserted: the written file parses as a
+        zip of .npy members under allow_pickle=False (np.load of such a
+        payload cannot execute code)."""
+        import os
+        import zipfile
+        m = self._net(5)
+        fluid.io.save_params(None, str(tmp_path), main_program=m)
+        path = os.path.join(tmp_path, "__params__")
+        assert zipfile.is_zipfile(path)
+        with np.load(path, allow_pickle=False) as z:
+            assert len(z.files) == len(
+                [p for p in m.parameters()])
 
 
 class TestReaders:
