@@ -507,6 +507,153 @@ def bench_elastic_soak(on_tpu, steps_override=None):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_loader_chaos(on_tpu, steps_override=None):
+    """``--loader-chaos``: fault-injection soak of the input pipeline.
+
+    Trains the same deterministic tiny MLP twice through
+    ``ResilientTrainer`` over a ``num_workers=2`` DataLoader:
+
+    * **faulted** — ``loader_worker_kill`` SIGKILLs worker 0 mid-epoch
+      (recovered by re-spawn + task re-dispatch), ``corrupt_sample``
+      poisons one of worker 1's sample fetches (quarantined under the
+      ``quarantine`` policy), and a simulated preemption forces a
+      mid-run rollback whose data stream comes back via the O(1)
+      checkpointable-loader state restore;
+    * **clean reference** — no chaos, but its dataset pre-excludes
+      exactly the indices the faulted run quarantined (raising on them
+      under the same policy), so both runs see the identical batch
+      sequence IFF the faulted run skipped exactly what it logged.
+
+    ``vs_baseline`` is the recovery contract: 1.0 iff final params
+    match to 1e-6, every quarantined index appears exactly once, the
+    worker restart/stall/preemption counters account for each injected
+    fault, and the resume was a state restore (consumed-batch counter
+    bounded by steps + save_freq — a replay fast-forward would consume
+    ~steps + preempt_step)."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import (ParallelEngine, ResilientTrainer,
+                                         build_mesh)
+    from paddle1_tpu.io import DataLoader
+
+    steps = steps_override or 18
+    if steps < 12:
+        raise SystemExit(
+            f"--loader-chaos needs --steps >= 12 (got {steps}): the "
+            "kill/corrupt/preempt points are spread across the run and "
+            "must all land before it ends")
+    save_freq = max(steps // 3, 1)
+    batch = 8
+    n_samples = steps * batch  # exactly one epoch of data
+
+    class _DetDS(paddle.io.Dataset):
+        """Deterministic per-index samples; raises on ``bad`` indices
+        (the clean reference's stand-in for the faulted run's
+        quarantined records)."""
+
+        def __init__(self, bad=()):
+            self.bad = frozenset(int(b) for b in bad)
+
+        def __len__(self):
+            return n_samples
+
+        def __getitem__(self, i):
+            if i in self.bad:
+                raise ValueError(f"pre-excluded corrupt record {i}")
+            rng = np.random.default_rng(1000 + i)
+            return (rng.standard_normal(16).astype(np.float32),
+                    rng.standard_normal(4).astype(np.float32))
+
+    def make_engine():
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 4))
+        for i, p in enumerate(model.parameters()):
+            p._data = jax.numpy.asarray(
+                np.random.default_rng(7 + i)
+                .standard_normal(p.shape).astype(np.float32) * 0.1)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        loss_fn = lambda m, b: \
+            ((m(Tensor(b[0])) - Tensor(b[1])) ** 2).mean()
+        mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+        return ParallelEngine(model, opt, loss_fn, mesh=mesh,
+                              check_finite=True)
+
+    def run(tag, tmp, bad, spec):
+        chaos.reset()
+        if spec:
+            chaos.configure(spec)
+        dl = DataLoader(_DetDS(bad), batch_size=batch, num_workers=2,
+                        bad_sample_policy="quarantine",
+                        stall_timeout_s=30)
+        trainer = ResilientTrainer(make_engine(), os.path.join(tmp, tag),
+                                   save_freq=save_freq,
+                                   bad_step_policy="restore_last_good",
+                                   backoff_base_s=0.0)
+        report = trainer.fit(lambda: dl, steps=steps)
+        params = {k: np.asarray(v)
+                  for k, v in trainer.engine.params.items()}
+        return params, report, dl
+
+    tmp = tempfile.mkdtemp(prefix="p1t_loaderchaos_")
+    try:
+        t0 = time.perf_counter()
+        # corrupt fires on worker 1's 5th sample fetch (an early batch,
+        # safely BELOW the first checkpoint so the preemption rollback
+        # can never replay it); the kill hits worker 0 mid-epoch; the
+        # preemption lands a few steps past a mid-run checkpoint commit
+        spec = (f"corrupt_sample@5:1,loader_worker_kill@4:0,"
+                f"preempt@{steps - 3}")
+        faulted, report, fdl = run("faulted", tmp, (), spec)
+        quarantined = [rec["index"] for rec in fdl.quarantine]
+        clean, clean_report, cdl = run("clean", tmp, quarantined, "")
+        dt = time.perf_counter() - t0
+
+        max_err = max(float(np.max(np.abs(clean[k] - faulted[k])))
+                      for k in clean)
+        # exactly-once accounting: no index quarantined twice (a
+        # re-dispatched in-flight task must not double-log), and the
+        # clean reference quarantined the same records
+        exactly_once = (len(set(quarantined)) == len(quarantined)
+                        and len(quarantined) >= 1)
+        clean_q = [rec["index"] for rec in cdl.quarantine]
+        recovered = (
+            max_err <= 1e-6 and exactly_once
+            and sorted(clean_q) == sorted(quarantined)
+            and report.loader_worker_restarts == 1
+            and report.bad_samples == len(quarantined)
+            and report.samples_quarantined == len(quarantined)
+            and report.preemptions == 1
+            and report.loader_state_restores >= 1
+            and report.loader_resume == "state"
+            # the O(1)-resume contract: a replay fast-forward would
+            # consume ~steps + preempt_step batches
+            and fdl.batches_consumed <= steps + save_freq + 2
+            and cdl.batches_consumed == steps)
+        detail = dict(report.as_dict(), steps=steps, save_freq=save_freq,
+                      chaos=spec, quarantined=quarantined,
+                      clean_quarantined=clean_q,
+                      batches_consumed=fdl.batches_consumed,
+                      clean_batches_consumed=cdl.batches_consumed,
+                      max_param_err=max_err, elapsed_s=round(dt, 3))
+        _emit("loader_chaos_recovered_steps_per_sec", steps / dt,
+              "steps/s", 1.0 if recovered else 0.0, detail)
+        if not recovered:
+            raise AssertionError(
+                f"loader-chaos soak did NOT recover: {json.dumps(detail)}")
+    finally:
+        chaos.reset()  # a failing soak must not leave faults armed
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serving(on_tpu, steps_override=None):
     """``--serving``: dynamic micro-batching throughput vs single-request
     dispatch.
@@ -653,6 +800,13 @@ def main():
                          "write and a simulated preemption; vs_baseline "
                          "is 1.0 iff final params match the clean run "
                          "to 1e-6 with accurate counters")
+    ap.add_argument("--loader-chaos", action="store_true",
+                    help="input-pipeline soak: train through a SIGKILLed "
+                         "loader worker, a quarantined corrupt sample "
+                         "and a preemption resumed via O(1) loader-state "
+                         "restore; vs_baseline is 1.0 iff final params "
+                         "match a clean run that pre-excludes exactly "
+                         "the quarantined indices, to 1e-6")
     args = ap.parse_args()
 
     if not _probe_tpu():
@@ -673,6 +827,8 @@ def main():
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.chaos:
         bench_chaos_soak(on_tpu, steps_override=args.steps)
+    elif args.loader_chaos:
+        bench_loader_chaos(on_tpu, steps_override=args.steps)
     elif args.config == "bert_base":
         bench_bert_base(on_tpu, batch_override=args.batch,
                         seq_override=args.seq,

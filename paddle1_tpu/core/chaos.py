@@ -24,6 +24,15 @@ counts its own beats)::
 
     worker_kill@5:1,worker_hang@8:0
 
+Dataloader-level points use the same qualifier with a *worker id*
+(``loader_worker_kill@3:0`` = worker 0's 3rd task; the single-process
+loader path counts as worker 0). They are armed in the loader worker
+PROCESS (the parent forwards :func:`active_spec` at spawn) and only in
+loader-worker incarnation 0, so a recovered/re-spawned worker replays
+clean — the same fire-once contract as the PR 3 supervisor points::
+
+    loader_worker_kill@4:0,corrupt_sample@3:1,loader_stall@2:0
+
 Armed via :func:`configure` or the ``FLAGS_ft_chaos`` env/flag (read by
 ``configure_from_flags``). All state is process-local and reset by
 :func:`reset`.
@@ -61,6 +70,22 @@ worker; incarnation 0 only, so a restarted worker replays clean):
                        stack dump, and respond per policy).
 ``worker_unhealthy`` — write the explicit unhealthy marker and keep
                        running (a worker that knows it is broken).
+
+Dataloader-level points (checked inside the input pipeline; all pure
+bookkeeping — the loader performs the kill/sleep/raise):
+
+``loader_worker_kill`` — :func:`check_loader_worker_kill` on the Nth
+                       task a loader worker picks up; the worker
+                       SIGKILLs itself (an OOM-killed decode process
+                       the parent must detect and re-spawn).
+``corrupt_sample``   — :func:`check_sample` raises
+                       :class:`ChaosInjectedError` on the Nth sample
+                       fetch (a corrupt record; drives the
+                       ``loader_bad_sample`` skip/quarantine policy).
+``loader_stall``     — :func:`check_loader_stall` True on the Nth
+                       task/batch; the loader sleeps
+                       ``loader_chaos_stall_s`` (a wedged reader the
+                       input-stall watchdog must catch).
 """
 
 from __future__ import annotations
@@ -71,11 +96,14 @@ from typing import Dict, List, Optional, Tuple, Union
 __all__ = [
     "SimulatedPreemption", "ChaosInjectedError", "configure",
     "configure_from_flags", "reset", "enabled", "fire", "counts",
+    "active_spec",
     "maybe_poison", "check_checkpoint_write", "check_loader",
     "check_preempt", "check_serve_slow", "check_worker",
+    "check_sample", "check_loader_worker_kill", "check_loader_stall",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
+    "LOADER_WORKER_KILL", "CORRUPT_SAMPLE", "LOADER_STALL",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -86,10 +114,17 @@ SERVE_SLOW = "serve_slow_step"
 WORKER_KILL = "worker_kill"
 WORKER_HANG = "worker_hang"
 WORKER_UNHEALTHY = "worker_unhealthy"
+LOADER_WORKER_KILL = "loader_worker_kill"
+CORRUPT_SAMPLE = "corrupt_sample"
+LOADER_STALL = "loader_stall"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
+# loader points share the worker points' ":qualifier" grammar, but the
+# qualifier is a LOADER worker id, not a trainer rank
+_LOADER_POINTS = (LOADER_WORKER_KILL, CORRUPT_SAMPLE, LOADER_STALL)
+_QUALIFIED_POINTS = _WORKER_POINTS + _LOADER_POINTS
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
-           PREEMPT, SERVE_SLOW) + _WORKER_POINTS
+           PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
 
 class SimulatedPreemption(BaseException):
@@ -120,21 +155,26 @@ class ChaosInjectedError(IOError):
 _lock = threading.Lock()
 # point -> set of armed 1-based occurrence indices
 _armed: Dict[str, set] = {}
-# worker point -> set of (occurrence, rank-or-None) pairs
+# worker/loader point -> set of (occurrence, qualifier-or-None) pairs
 _armed_worker: Dict[str, set] = {}
 # point -> occurrences seen so far
 _counters: Dict[str, int] = {}
 _preempt_requested = False
+# the spec string this process was armed with (canonical form) — what a
+# parent forwards to spawned dataloader workers so they can arm their
+# own process-local counters
+_spec_str: str = ""
 
 
 def reset() -> None:
     """Disarm every point and zero all counters (test isolation)."""
-    global _preempt_requested
+    global _preempt_requested, _spec_str
     with _lock:
         _armed.clear()
         _armed_worker.clear()
         _counters.clear()
         _preempt_requested = False
+        _spec_str = ""
 
 
 def configure(spec: Union[str, Dict[str, object], None]) -> None:
@@ -168,6 +208,7 @@ def configure(spec: Union[str, Dict[str, object], None]) -> None:
         for name, ns in spec.items():
             for n in (ns if isinstance(ns, (list, tuple)) else [ns]):
                 entries.append((name, int(n), None))
+    global _spec_str
     with _lock:
         for name, n, rank in entries:
             if name not in _POINTS:
@@ -176,16 +217,21 @@ def configure(spec: Union[str, Dict[str, object], None]) -> None:
                     f"(points: {', '.join(_POINTS)})")
             if n < 1:
                 raise ValueError(f"chaos occurrence must be >= 1, got {n}")
-            if rank is not None and name not in _WORKER_POINTS:
+            if rank is not None and name not in _QUALIFIED_POINTS:
                 raise ValueError(
                     f"rank qualifier '@{n}:{rank}' is only valid for "
-                    f"worker points ({', '.join(_WORKER_POINTS)})")
+                    f"worker/loader points ({', '.join(_QUALIFIED_POINTS)})")
             if rank is not None and rank < 0:
                 raise ValueError(f"chaos rank must be >= 0, got {rank}")
-            if name in _WORKER_POINTS:
+            if name in _QUALIFIED_POINTS:
                 _armed_worker.setdefault(name, set()).add((n, rank))
             else:
                 _armed.setdefault(name, set()).add(n)
+        # configure() is reset-then-arm (see docstring), so the armed
+        # set and the forwarded spec string stay in lockstep
+        _spec_str = ",".join(
+            f"{name}@{n}" + (f":{rank}" if rank is not None else "")
+            for name, n, rank in entries)
 
 
 def configure_from_flags() -> bool:
@@ -208,6 +254,14 @@ def counts() -> Dict[str, int]:
     """Occurrence counters seen so far (diagnostics/tests)."""
     with _lock:
         return dict(_counters)
+
+
+def active_spec() -> str:
+    """The canonical spec string this process is armed with ('' when
+    nothing is armed). The DataLoader forwards it to spawned worker
+    processes so loader-level points count occurrences in the process
+    where the work actually happens."""
+    return _spec_str
 
 
 def fire(point: str) -> bool:
@@ -299,6 +353,46 @@ def check_worker(rank: int) -> Optional[str]:
             if (n, None) in armed or (n, rank) in armed:
                 return point
     return None
+
+
+def _fire_qualified(point: str, qualifier: int) -> bool:
+    """Record one occurrence of a qualified (worker/loader) point on its
+    own counter; True iff this occurrence is armed for ``qualifier`` (or
+    unqualified)."""
+    if not _armed_worker:
+        return False
+    with _lock:
+        if point not in _armed_worker:
+            return False
+        n = _counters.get(point, 0) + 1
+        _counters[point] = n
+        armed = _armed_worker[point]
+        return (n, None) in armed or (n, qualifier) in armed
+
+
+def check_sample(worker: Optional[int] = None) -> None:
+    """``corrupt_sample``: raise :class:`ChaosInjectedError` on an armed
+    sample-fetch occurrence (the Nth ``dataset[i]`` / reader item of
+    loader worker ``worker``; the single-process path is worker 0). The
+    ``loader_bad_sample`` policy then treats it like any real corrupt
+    record."""
+    if enabled() and _fire_qualified(CORRUPT_SAMPLE,
+                                     0 if worker is None else worker):
+        raise ChaosInjectedError("chaos: corrupt sample record")
+
+
+def check_loader_worker_kill(worker: int) -> bool:
+    """``loader_worker_kill``: True on an armed task occurrence for
+    loader worker ``worker``. The *action* (SIGKILL self) belongs to the
+    worker loop — this stays pure bookkeeping."""
+    return enabled() and _fire_qualified(LOADER_WORKER_KILL, worker)
+
+
+def check_loader_stall(worker: int) -> bool:
+    """``loader_stall``: True on an armed task/batch occurrence; the
+    loader sleeps ``loader_chaos_stall_s`` (the input-stall watchdog's
+    reproducible trigger)."""
+    return enabled() and _fire_qualified(LOADER_STALL, worker)
 
 
 def check_preempt() -> None:

@@ -352,6 +352,46 @@ def _define_builtin_flags() -> None:
                 "retried/replayed operations come back clean, and "
                 "worker points fire in incarnation 0 only, so a "
                 "supervisor-restarted rank replays clean.")
+    # Input pipeline resilience (consumed by io.DataLoader /
+    # fluid.PyReader and surfaced through ResilienceReport)
+    define_flag("loader_bad_sample", "raise",
+                "What the input pipeline does when one sample fetch "
+                "fails (dataset __getitem__ raises, a reader item "
+                "won't convert, or an armed corrupt_sample chaos "
+                "point): raise (fail the epoch — today's semantics, "
+                "the default), skip (drop the sample, count it), "
+                "quarantine (drop + append {index, error, worker} to "
+                "the loader's quarantine log and, when "
+                "loader_quarantine_file is set, to that JSONL file).",
+                validator=lambda v: v in ("raise", "skip", "quarantine"))
+    define_flag("loader_max_worker_restarts", 2,
+                "Per-worker re-spawn budget when a DataLoader worker "
+                "process dies (OOM-kill, segfault) or is restarted by "
+                "the input-stall watchdog; a worker exceeding it fails "
+                "the epoch with the legacy sticky RuntimeError (or "
+                "DataLoaderStalled for a stall).",
+                validator=lambda v: v >= 0)
+    define_flag("loader_stall_timeout_s", 0.0,
+                "Input-stall watchdog: if no batch arrives within this "
+                "many seconds the loader dumps worker liveness + the "
+                "pending task map, then restarts the stalled worker "
+                "(multi-process path, within the restart budget) or "
+                "raises DataLoaderStalled. 0 disables (the default — "
+                "a legitimately slow first batch must not be killed). "
+                "While waiting, the loader calls health.beat() so the "
+                "Supervisor doesn't mistake a slow loader for a hung "
+                "trainer.",
+                validator=lambda v: v >= 0)
+    define_flag("loader_chaos_stall_s", 1.0,
+                "How long the loader_stall chaos point wedges one "
+                "batch/task (must exceed the loader_stall_timeout_s "
+                "under test for the watchdog to trip).",
+                validator=lambda v: v >= 0)
+    define_flag("loader_quarantine_file", "",
+                "Optional JSONL file the quarantine policy appends "
+                "{index, error, worker} records to (the in-memory "
+                "loader.quarantine list is always kept). Empty "
+                "disables the file sink.")
     # Serving runtime (consumed by paddle1_tpu.serving; the dynamic
     # micro-batching analog of the reference's inference Config knobs —
     # MIGRATING.md maps EnableMemoryOptim-era toggles onto these)

@@ -112,12 +112,46 @@ def _spec_digest(spec) -> str:
     return hashlib.sha256(blob).hexdigest()
 
 
+def _json_safe_meta(obj, keypath="meta"):
+    """Coerce checkpoint meta to plain JSON types, naming the offending
+    key on anything that can't ride the manifest. Numpy scalars (an
+    easy accident in sampler/loader state: seeds, cursors) are narrowed
+    to their Python equivalents instead of failing mid-write — a
+    TypeError out of ``json.dump`` half-way through the manifest names
+    neither the key nor the caller."""
+    import numpy as _np
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, _np.bool_):
+        return bool(obj)
+    if isinstance(obj, _np.integer):
+        return int(obj)
+    if isinstance(obj, _np.floating):
+        return float(obj)
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise CheckpointCorruptError(
+                    f"checkpoint meta key {keypath}[{k!r}] is not a "
+                    "string — manifest meta must be JSON-serializable")
+            out[k] = _json_safe_meta(v, f"{keypath}.{k}")
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe_meta(v, f"{keypath}[{i}]")
+                for i, v in enumerate(obj)]
+    raise CheckpointCorruptError(
+        f"checkpoint meta value at {keypath} "
+        f"({type(obj).__name__}) is not JSON-serializable — manifest "
+        "meta carries small host state only (steps, seeds, cursors)")
+
+
 def write_manifest(path: str, state, meta: Optional[Dict[str, Any]] = None):
     """Stamp ``manifest.json`` into a checkpoint dir: the commit marker
     plus the tree spec ``restore`` verifies against its target."""
     spec = _tree_spec(state)
     doc = {"version": 1, "tree": spec, "digest": _spec_digest(spec),
-           "meta": meta or {}}
+           "meta": _json_safe_meta(meta or {})}
     tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
     with open(tmp, "w") as f:
         json.dump(doc, f)

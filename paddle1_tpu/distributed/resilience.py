@@ -85,6 +85,17 @@ class ResilienceReport:
     resumed_from: Optional[int] = None  # step picked up on fit() entry
     final_step: int = 0
     final_loss: Optional[float] = None
+    # input-pipeline counters (aggregated over every DataLoader the data
+    # factory handed this fit — the loader-side half of the fault matrix)
+    bad_samples: int = 0           # sample fetches dropped (skip+quarantine)
+    samples_quarantined: int = 0   # of those, logged under 'quarantine'
+    loader_worker_restarts: int = 0  # dead/stalled worker re-spawns
+    loader_stalls: int = 0         # input-stall watchdog trips
+    # how the data stream was repositioned after restore/resume:
+    # 'state' = O(1) checkpointable-loader restore, 'replay' = legacy
+    # O(steps) fast-forward, None = never repositioned
+    loader_resume: Optional[str] = None
+    loader_state_restores: int = 0  # O(1) restores performed
 
     def as_dict(self) -> Dict[str, Any]:
         from dataclasses import asdict
@@ -93,6 +104,13 @@ class ResilienceReport:
 
 def _flag_default(value, name):
     return core_flags.flag(name) if value is None else value
+
+
+def _is_dataloader(obj) -> bool:
+    # lazy: resilience must stay importable without dragging the io
+    # package (and its device probing) into every distributed import
+    from ..io.dataloader import DataLoader
+    return isinstance(obj, DataLoader)
 
 
 class ResilientTrainer:
@@ -172,6 +190,10 @@ class ResilientTrainer:
         self._ema_warmup = 0
         self._restore_streak = (None, 0)  # (global step, repeats)
         self._last_saved: Optional[int] = None
+        self._active_loader = None        # checkpointable DataLoader in use
+        self._seen_loaders: list = []     # every loader this fit touched
+        self._restored_loader_state = None  # meta['loader'] of last restore
+        self._replay_warned = False
         chaos.configure_from_flags()  # no-op when FLAGS_ft_chaos empty
 
     # -- engine state <-> checkpoint ------------------------------------
@@ -207,6 +229,15 @@ class ResilientTrainer:
                                     for k, v in sched.state_dict().items()}
             except Exception as e:
                 warnings.warn(f"LR scheduler state not checkpointed: {e}")
+        if self._active_loader is not None:
+            # (epoch, cursor, shuffle state) — what makes resume O(1):
+            # the restored loader skips `cursor` index-batches without
+            # loading a sample, instead of replaying the stream
+            try:
+                meta["loader"] = self._active_loader.state_dict()
+            except Exception as e:
+                warnings.warn(f"loader state not checkpointed ({e}); "
+                              "resume will replay the stream")
         return meta
 
     def save(self, step: int) -> bool:
@@ -269,6 +300,9 @@ class ResilientTrainer:
                 sched.set_state_dict(meta["lr_sched"])
             except Exception as e:
                 warnings.warn(f"LR scheduler state not restored: {e}")
+        # stashed for the next _data_iter (the caller rebuilds the
+        # iterator right after a restore)
+        self._restored_loader_state = meta.get("loader")
         self.report.restores += 1
         return int(meta.get("step", ckpt_step))
 
@@ -330,11 +364,78 @@ class ResilientTrainer:
     # -- the loop --------------------------------------------------------
 
     def _data_iter(self, data_factory, start: int):
-        """Fresh iterator over the (replayable) stream, fast-forwarded
-        past the ``start`` batches the restored checkpoint already
-        consumed (one batch per global step, the resume contract)."""
-        it = iter(data_factory())
-        return itertools.islice(it, start, None) if start else it
+        """Fresh iterator over the data stream, repositioned past the
+        ``start`` batches the restored checkpoint already consumed (one
+        batch per global step, the resume contract).
+
+        Two repositioning paths:
+
+        * **O(1) state restore** — the factory handed back a
+          checkpointable :class:`~paddle1_tpu.io.DataLoader` and the
+          checkpoint carried its ``state_dict``: the loader re-applies
+          (epoch, cursor, shuffle state) and skips ``cursor``
+          *index-batches* without loading a single sample;
+        * **legacy replay fast-forward** — any other iterable (or a
+          checkpoint written before loader state existed): the stream
+          is replayed and ``start`` batches discarded — O(steps), and
+          only correct under the zero-arg-deterministic-factory
+          contract. Warned once so the cost is visible.
+        """
+        src = data_factory()
+        loader = src if _is_dataloader(src) else None
+        if loader is not None:
+            self._track_loader(loader)
+        state = self._restored_loader_state
+        self._restored_loader_state = None
+        if loader is not None and loader.checkpointable():
+            self._active_loader = loader
+            if state is not None:
+                # even at start 0 this matters: the rolled-back epoch's
+                # shuffle seed must be re-applied, not re-drawn
+                loader.set_state_dict(state)
+                self.report.loader_state_restores += 1
+                if start:
+                    self.report.loader_resume = "state"
+                return iter(loader)
+            if start == 0:
+                return iter(loader)
+            # checkpoint predates loader state (or its snapshot failed):
+            # fall through to the replay fast-forward
+        else:
+            self._active_loader = None
+        it = iter(src)
+        if not start:
+            return it
+        self.report.loader_resume = "replay"
+        if not self._replay_warned:
+            self._replay_warned = True
+            warnings.warn(
+                f"resume is replaying {start} batch(es) to reposition "
+                "the data stream — the O(steps) fast-forward under the "
+                "zero-arg-deterministic-factory contract; hand fit() a "
+                "factory returning a checkpointable io.DataLoader for "
+                "O(1) state restore")
+        return itertools.islice(it, start, None)
+
+    def _track_loader(self, loader) -> None:
+        """Baseline a loader's resilience counters the first time this
+        fit sees it, so the report aggregates per-fit DELTAS (the same
+        loader object is typically handed back by every factory call,
+        and may outlive several fits)."""
+        for rec in self._seen_loaders:
+            if rec[0] is loader:
+                return
+        self._seen_loaders.append(
+            (loader, loader.bad_sample_count, len(loader.quarantine),
+             loader.worker_restart_count, loader.stall_events))
+
+    def _collect_loader_counters(self) -> None:
+        for ld, bad0, quar0, rst0, stall0 in self._seen_loaders:
+            self.report.bad_samples += ld.bad_sample_count - bad0
+            self.report.samples_quarantined += len(ld.quarantine) - quar0
+            self.report.loader_worker_restarts += \
+                ld.worker_restart_count - rst0
+            self.report.loader_stalls += ld.stall_events - stall0
 
     def fit(self, data: Callable[[], Iterable], steps: int,
             lr: Optional[float] = None) -> ResilienceReport:
@@ -348,17 +449,31 @@ class ResilientTrainer:
                 "batch iterable (resume/restore replay the stream); "
                 "pass `lambda: loader`, not the loader itself")
         self.report = ResilienceReport()
+        self._seen_loaders = []
+        self._restored_loader_state = None
         if self.manager.latest_step() is not None:
             step = self.restore_latest()
             self.report.resumed_from = step
             self.report.restores -= 1  # resume-on-entry is not a rollback
+            it = self._data_iter(data, step)
         else:
             step = 0
+            # iterator FIRST, then the baseline: building the epoch's
+            # iterator draws the shuffle seed, so the step-0 checkpoint
+            # captures loader state a rollback-to-0 can replay exactly
+            it = self._data_iter(data, 0)
             # a step-0 baseline guarantees restore_last_good/preemption
             # always have a rollback target, even before the first
             # periodic save
             self.save(0)
-        it = self._data_iter(data, step)
+        try:
+            return self._fit_loop(data, steps, lr, step, it)
+        finally:
+            # even when a policy raises (BadStepError, DataLoaderStalled)
+            # the report the caller inspects carries the loader counters
+            self._collect_loader_counters()
+
+    def _fit_loop(self, data, steps, lr, step, it) -> ResilienceReport:
         last_loss = None
         max_step = step  # high-water mark: steps below it are replays
         while step < steps:

@@ -58,13 +58,26 @@ _STOP = object()
 
 class PyReader:
     """Bounded queue fed by a background thread from the decorated
-    generator; ``read()`` pops one batch as Tensors."""
+    generator; ``read()`` pops one batch as Tensors.
+
+    The legacy reader speaks the same ``loader_bad_sample`` policy as
+    ``io.DataLoader`` (via the shared :mod:`paddle1_tpu.io.bad_samples`
+    helper): under ``skip``/``quarantine`` a corrupt item — an armed
+    ``corrupt_sample`` chaos occurrence in the feeding thread, or an
+    item that fails Tensor conversion in ``read()`` — is dropped and
+    counted (``bad_sample_count`` / ``quarantine``) instead of killing
+    the epoch. ``raise`` (the default) keeps today's behavior."""
 
     def __init__(self, capacity: int, shapes=None, dtypes=None,
                  lod_levels=None, name=None, use_double_buffer=True,
-                 iterable=True):
+                 iterable=True, bad_sample_policy=None):
         if capacity <= 0:
             raise InvalidArgumentError("py_reader capacity must be > 0")
+        from ..io.bad_samples import BadSampleLog, resolve_policy
+        if bad_sample_policy is not None:
+            resolve_policy(bad_sample_policy)  # validate eagerly
+        self._bad_sample_policy = bad_sample_policy
+        self._bad_log = BadSampleLog()
         self._capacity = int(capacity)
         self._shapes = shapes
         self._dtypes = list(dtypes) if dtypes else None
@@ -76,6 +89,30 @@ class PyReader:
         self._exhausted = False
         self._iterable = bool(iterable)
         self._reads_this_epoch = 0
+
+    # -- bad-sample policy (shared with io.DataLoader) -------------------
+    @property
+    def bad_sample_policy(self) -> str:
+        from ..io.bad_samples import resolve_policy
+        return resolve_policy(self._bad_sample_policy)
+
+    @property
+    def bad_sample_count(self) -> int:
+        return self._bad_log.count
+
+    @property
+    def quarantine(self):
+        """Quarantine records ({index, error, worker}) under
+        ``bad_sample_policy='quarantine'`` — index is the item's ordinal
+        within its epoch."""
+        return self._bad_log.records
+
+    def _absorb_bad_sample(self, ordinal, exc) -> None:
+        from ..core import flags as core_flags
+        from ..io.bad_samples import bad_sample_record
+        self._bad_log.absorb([bad_sample_record(ordinal, exc, worker=None)],
+                             self.bad_sample_policy,
+                             core_flags.flag("loader_quarantine_file"))
 
     # -- decoration (reference PyReader decorate_* family) ---------------
     def decorate_sample_list_generator(self, reader, places=None):
@@ -114,7 +151,9 @@ class PyReader:
         self._exhausted = False
 
         def fill(gen=self._gen, q=self._queue, stop=self._stop_evt):
+            from ..core import chaos
             tail = _STOP
+            ordinal = 0
 
             def put(x):
                 while not stop.is_set():
@@ -126,7 +165,20 @@ class PyReader:
                 return False
             try:
                 for item in gen():
-                    if not put(item):
+                    # the corrupt-record injection point: a real stream
+                    # surfaces corruption as the item itself, chaos
+                    # models it by raising here
+                    try:
+                        if chaos.enabled():
+                            chaos.check_sample(0)
+                    except Exception as e:
+                        if self.bad_sample_policy == "raise":
+                            raise
+                        self._absorb_bad_sample(ordinal, e)
+                        ordinal += 1
+                        continue
+                    ordinal += 1
+                    if not put((item, ordinal - 1)):
                         return
             except BaseException as e:   # noqa: broad-except —
                 # re-raised in read() via the error sentinel instead of
@@ -140,14 +192,25 @@ class PyReader:
 
     def reset(self):
         """Stop the feeding thread and drop queued batches (the
-        reference's post-EOF reset)."""
-        self._stop_evt.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        reference's post-EOF reset). Safe on a reader whose producer
+        thread never started (or whose __init__ died early): teardown
+        — including interpreter-exit ``__del__`` — must never raise."""
+        stop = getattr(self, "_stop_evt", None)
+        if stop is not None:
+            stop.set()
+        thread = getattr(self, "_thread", None)
+        if thread is not None:
+            thread.join(timeout=5)
             self._thread = None
         self._queue = None
         self._exhausted = False
         self._reads_this_epoch = 0
+
+    def __del__(self):
+        try:
+            self.reset()
+        except Exception:  # interpreter teardown: modules/attrs may
+            pass           # already be gone — never raise in __del__
 
     # -- consumption ------------------------------------------------------
     @staticmethod
@@ -186,7 +249,10 @@ class PyReader:
 
     def read(self):
         """Pop one batch (the read_file op); EOFException at epoch
-        end (and on every further read until reset())."""
+        end (and on every further read until reset()). An item that
+        fails Tensor conversion follows the bad-sample policy: under
+        ``skip``/``quarantine`` it is dropped (and counted) and the
+        next item is popped instead."""
         if self._queue is None:
             raise PreconditionNotMetError(
                 "py_reader not started: call start() (or iterate the "
@@ -195,18 +261,28 @@ class PyReader:
             raise EOFException(
                 "py_reader epoch already ended — reset() then start() "
                 "for the next epoch")
-        item = self._queue.get()
-        if item is _STOP:
-            self._exhausted = True
-            raise EOFException("py_reader epoch ended (reset() then "
-                               "start() for the next epoch)")
-        if (isinstance(item, tuple) and len(item) == 2
-                and isinstance(item[0], str)
-                and item[0] == "__pyreader_error__"):
-            self._exhausted = True
-            raise item[1]   # the decorated generator's own failure
-        self._reads_this_epoch += 1
-        return self._to_tensors(item)
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                self._exhausted = True
+                raise EOFException("py_reader epoch ended (reset() then "
+                                   "start() for the next epoch)")
+            if (isinstance(item, tuple) and len(item) == 2
+                    and isinstance(item[0], str)
+                    and item[0] == "__pyreader_error__"):
+                self._exhausted = True
+                raise item[1]   # the decorated generator's own failure
+            payload, ordinal = item
+            self._reads_this_epoch += 1
+            try:
+                out = self._to_tensors(payload)
+            except Exception as e:  # interrupts propagate (policy is
+                # never an excuse to eat a KeyboardInterrupt)
+                if self.bad_sample_policy == "raise":
+                    raise
+                self._absorb_bad_sample(ordinal, e)
+                continue
+            return out
 
     def __iter__(self):
         """Iterable-PyReader contract (ADVICE r5): a fresh ``for`` loop

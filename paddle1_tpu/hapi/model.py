@@ -126,9 +126,17 @@ class Model:
         ``save_dir`` + ``save_freq`` checkpoint network+optimizer every
         N epochs; ``resume=True`` picks the largest epoch checkpoint
         already under ``save_dir`` (non-numeric/partial entries are
-        skipped), loads it, and continues from the NEXT epoch.
+        skipped), loads it, and continues from the NEXT epoch. When the
+        train loader is a checkpointable :class:`~paddle1_tpu.io.
+        DataLoader`, each epoch checkpoint also writes an
+        ``<epoch>.pdloader`` sidecar (loader state + RNG stream) and
+        ``resume=True`` restores it, so the resumed run's epoch
+        ordering continues exactly where the interrupted run's would
+        have — otherwise a one-time warning notes that ordering
+        restarts.
         """
         start_epoch = 0
+        latest = None
         if resume:
             if not save_dir:
                 raise InvalidArgumentError(
@@ -140,6 +148,8 @@ class Model:
                 start_epoch = latest + 1
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
+        if latest is not None:
+            _restore_loader_state(save_dir, latest, train_loader)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
                                       num_workers) if eval_data is not None \
             else None
@@ -191,6 +201,7 @@ class Model:
                               verbose=verbose, callbacks=callbacks)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, str(epoch)))
+                _save_loader_state(save_dir, epoch, train_loader)
             if self.stop_training or (num_iters is not None and
                                       it >= num_iters):
                 break
@@ -267,6 +278,78 @@ class Model:
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               drop_last=drop_last, num_workers=num_workers)
         return data  # assume iterable of batches
+
+
+def _loader_sidecar(save_dir, epoch):
+    return os.path.join(save_dir, f"{epoch}.pdloader")
+
+
+def _save_loader_state(save_dir, epoch, loader):
+    """Write the ``<epoch>.pdloader`` sidecar: loader position + the
+    global RNG stream (the next epoch's shuffle seed is drawn from it,
+    so ordering parity needs both). Checkpointing must never fail the
+    epoch that just trained — problems degrade to a warning."""
+    import json
+    import warnings
+    from ..io import DataLoader
+    if not isinstance(loader, DataLoader) or not loader.checkpointable():
+        return
+    from ..core.generator import get_rng_state
+    try:
+        doc = {"version": 1, "loader": loader.state_dict(),
+               "rng": get_rng_state()}
+        tmp = _loader_sidecar(save_dir, epoch) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, _loader_sidecar(save_dir, epoch))
+    except Exception as e:
+        warnings.warn(f"loader state sidecar not written ({e}); "
+                      "resume will restart epoch ordering")
+
+
+_FALLBACK_WARNED = set()
+
+
+def _restore_loader_state(save_dir, epoch, loader):
+    """Apply the ``<epoch>.pdloader`` sidecar to a resumed fit's
+    loader; warns ONCE per save_dir when it must fall back (missing
+    sidecar / non-checkpointable loader) so the user knows the resumed
+    run's data order restarts instead of continuing."""
+    import json
+    import warnings
+    from ..io import DataLoader
+
+    def fallback(why):
+        if save_dir not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(save_dir)
+            warnings.warn(
+                f"fit(resume=True): loader state not restored ({why}); "
+                "epoch ordering restarts from scratch — pass a "
+                "checkpointable io.DataLoader (built-in samplers) to "
+                "resume the data stream exactly")
+
+    path = _loader_sidecar(save_dir, epoch)
+    if not isinstance(loader, DataLoader) or not loader.checkpointable():
+        if os.path.exists(path):
+            fallback("train loader is not checkpointable")
+        return
+    if not os.path.exists(path):
+        fallback(f"no {os.path.basename(path)} sidecar — checkpoint "
+                 "predates loader-state support")
+        return
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        # loader state FIRST: it validates eagerly, so a corrupt
+        # sidecar fails before the global RNG is touched — the
+        # fallback's "ordering restarts from scratch" promise must
+        # describe a process whose RNG stream really is untouched
+        loader.set_state_dict(doc["loader"])
+        from ..core.generator import set_rng_state
+        if "rng" in doc:
+            set_rng_state(doc["rng"])
+    except Exception as e:
+        fallback(f"unreadable sidecar: {e}")
 
 
 def _latest_saved_epoch(save_dir):

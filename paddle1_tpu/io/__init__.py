@@ -1,6 +1,7 @@
 """paddle1_tpu.io — datasets + dataloader (reference paddle.io analog)."""
 
-from .dataloader import DataLoader, default_collate_fn
+from .bad_samples import BadSampleLog
+from .dataloader import DataLoader, DataLoaderStalled, default_collate_fn
 from .dataset import (BatchSampler, ChainDataset, ComposeDataset, Dataset,
                       DistributedBatchSampler, IterableDataset,
                       RandomSampler, Sampler, SequenceSampler, Subset,

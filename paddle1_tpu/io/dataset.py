@@ -135,12 +135,52 @@ class Sampler:
         return len(self.data_source)
 
 
+class _EpochSeedMixin:
+    """Checkpointable shuffle state shared by the stochastic samplers.
+
+    Each epoch's randomness is one 31-bit seed drawn from the global
+    generator *eagerly* when ``__iter__`` is called — so a loader-state
+    snapshot taken any time after the epoch's iterator exists captures
+    the seed that produced (and can bit-exactly regenerate) the epoch's
+    index sequence. ``set_state_dict`` forces that seed onto the NEXT
+    ``__iter__`` (consumed once), which is how a resumed process replays
+    the interrupted epoch's order instead of drawing a fresh one.
+    """
+
+    _last_seed: Optional[int] = None
+    _forced_seed: Optional[int] = None
+
+    def _epoch_seed(self, generator=None) -> int:
+        if self._forced_seed is not None:
+            seed, self._forced_seed = self._forced_seed, None
+        else:
+            gen = generator or default_generator
+            seed = gen.random() % (2 ** 31)
+        self._last_seed = int(seed)
+        return self._last_seed
+
+    def state_dict(self):
+        """Shuffle state of the current (last-started) epoch."""
+        return {"seed": self._last_seed}
+
+    def set_state_dict(self, state):
+        seed = (state or {}).get("seed")
+        self._forced_seed = None if seed is None else int(seed)
+
+
 class SequenceSampler(Sampler):
     def __iter__(self):
         return iter(range(len(self.data_source)))
 
+    # deterministic: checkpointable with no state of its own
+    def state_dict(self):
+        return {}
 
-class RandomSampler(Sampler):
+    def set_state_dict(self, state):
+        pass
+
+
+class RandomSampler(_EpochSeedMixin, Sampler):
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
@@ -153,19 +193,22 @@ class RandomSampler(Sampler):
         return self._num_samples or len(self.data_source)
 
     def __iter__(self):
+        # eager (not a generator): the epoch seed must be drawn — and
+        # the index sequence fixed — the moment the iterator is built,
+        # or a checkpoint taken before the first batch would miss it
         n = len(self.data_source)
-        gen = self.generator or default_generator
-        rng = np.random.RandomState(gen.random() % (2 ** 31))
+        rng = np.random.RandomState(self._epoch_seed(self.generator))
         if self.replacement:
-            yield from rng.randint(0, n, self.num_samples).tolist()
+            idx = rng.randint(0, n, self.num_samples).tolist()
         else:
-            yield from rng.permutation(n)[:self.num_samples].tolist()
+            idx = rng.permutation(n)[:self.num_samples].tolist()
+        return iter(idx)
 
     def __len__(self):
         return self.num_samples
 
 
-class WeightedRandomSampler(Sampler):
+class WeightedRandomSampler(_EpochSeedMixin, Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, np.float64)
         self.num_samples = num_samples
@@ -173,10 +216,10 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        rng = np.random.RandomState(default_generator.random() % (2 ** 31))
+        rng = np.random.RandomState(self._epoch_seed())
         idx = rng.choice(len(self.weights), self.num_samples,
                          replace=self.replacement, p=p)
-        yield from idx.tolist()
+        return iter(idx.tolist())
 
     def __len__(self):
         return self.num_samples
@@ -199,20 +242,44 @@ class BatchSampler(Sampler):
         self.drop_last = drop_last
 
     def __iter__(self):
-        batch = []
-        for idx in self.sampler:
-            batch.append(idx)
-            if len(batch) == self.batch_size:
+        # iter(self.sampler) EAGERLY: the inner sampler draws its epoch
+        # seed here, so checkpointable-loader state capture works before
+        # the first batch (see _EpochSeedMixin)
+        it = iter(self.sampler)
+
+        def gen():
+            batch = []
+            for idx in it:
+                batch.append(idx)
+                if len(batch) == self.batch_size:
+                    yield batch
+                    batch = []
+            if batch and not self.drop_last:
                 yield batch
-                batch = []
-        if batch and not self.drop_last:
-            yield batch
+        return gen()
 
     def __len__(self):
         n = len(self.sampler)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+    # -- checkpointable-loader protocol ---------------------------------
+    # The cursor (batches already consumed this epoch) is tracked by the
+    # DataLoader; the sampler contributes only what regenerates the same
+    # index SEQUENCE — its shuffle state. A custom inner sampler without
+    # the protocol makes the whole loader non-checkpointable (the
+    # DataLoader then falls back to the legacy replay fast-forward).
+
+    def checkpointable(self) -> bool:
+        return hasattr(self.sampler, "state_dict") and \
+            hasattr(self.sampler, "set_state_dict")
+
+    def state_dict(self):
+        return {"sampler": self.sampler.state_dict()}
+
+    def set_state_dict(self, state):
+        self.sampler.set_state_dict((state or {}).get("sampler"))
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -262,3 +329,14 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    # checkpointable: the index sequence is a pure function of
+    # (epoch, rank, world) — epoch is the whole shuffle state
+    def checkpointable(self) -> bool:
+        return True
+
+    def state_dict(self):
+        return {"epoch": int(self.epoch)}
+
+    def set_state_dict(self, state):
+        self.epoch = int((state or {}).get("epoch", self.epoch))

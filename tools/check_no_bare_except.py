@@ -24,6 +24,16 @@ checker enforces, over the runtime packages:
   (``PREEMPTION_HANDLER_FILES``): a preemption notice must unwind to
   the resilient loop's handler (which checkpoints), and the supervisor
   stack must never absorb one in a generic retry/cleanup wrapper.
+* **error-forwarding allowlist** (``ERROR_FORWARDING_FILES``): in the
+  producer/worker loops of the input pipeline, ``except BaseException
+  as e`` is sound *without* a marker when the handler demonstrably
+  FORWARDS the caught object to its consumer — assigns it (``self._err
+  = e``) or ships it through a queue ``put``/``put_nowait`` — where it
+  is re-raised on the consumer's next ``next()``/``read()``. This is
+  checked structurally (the bound name must appear as an assignment
+  value or a ``put`` argument), so the allowlist cannot silently decay
+  into a blanket exemption; a broad catch in those files that does
+  *not* forward is still an error.
 
 Retry wrappers must catch ``Exception``, never broader.
 
@@ -49,6 +59,43 @@ BROAD_NAMES = {"BaseException", "KeyboardInterrupt", "SystemExit",
 # "preempt the worker" into a silent hang or lost progress
 PREEMPTION_NAMES = {"SimulatedPreemption"}
 PREEMPTION_HANDLER_FILES = ("distributed/resilience.py",)
+# files whose producer/worker loops may catch BaseException WITHOUT a
+# marker IF the handler structurally forwards the exception object to
+# its consumer (assignment or queue put — see module docstring); the
+# consumer re-raises it, so the interrupt is delayed one queue hop, not
+# swallowed
+ERROR_FORWARDING_FILES = ("io/dataloader.py", "fluid/reader.py")
+
+
+def _forwards_exception(handler: ast.ExceptHandler) -> bool:
+    """True iff the handler's body forwards the caught exception object
+    to a CONSUMER-VISIBLE sink: the bound name (``except ... as e``) is
+    assigned to an *attribute* (``self._err = e`` — re-raised on the
+    consumer's next ``next()``) or appears in the arguments of a
+    ``put``/``put_nowait`` call (shipped through a queue). A plain
+    local binding (``msg = f"ignoring {e}"``) does NOT count — that is
+    the decay-into-swallowing shape this check exists to reject; a
+    handler that re-binds ``e`` to a wrapper and then sinks the new
+    object still passes via the same two sinks."""
+    name = handler.name
+    if not name:
+        return False
+
+    def mentions(node: ast.AST) -> bool:
+        return any(isinstance(sub, ast.Name) and sub.id == name
+                   for sub in ast.walk(node))
+
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Assign) and mentions(sub.value) and \
+                any(isinstance(t, ast.Attribute) for t in sub.targets):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("put", "put_nowait") and \
+                    any(mentions(a) for a in sub.args):
+                return True
+    return False
 
 
 def _exception_names(node: ast.expr) -> Iterator[str]:
@@ -90,6 +137,8 @@ def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
     norm_path = path.replace(os.sep, "/")
     preemption_handler = any(norm_path.endswith(suffix)
                              for suffix in PREEMPTION_HANDLER_FILES)
+    error_forwarder = any(norm_path.endswith(suffix)
+                          for suffix in ERROR_FORWARDING_FILES)
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler):
@@ -109,6 +158,8 @@ def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
             continue
         broad = [n for n in _exception_names(node.type)
                  if n in BROAD_NAMES]
+        if broad and error_forwarder and _forwards_exception(node):
+            broad = []  # forwarded to the consumer, re-raised there
         if broad and not _contains_raise(node) and not has_marker:
             findings.append((
                 node.lineno,
