@@ -507,6 +507,264 @@ def bench_elastic_soak(on_tpu, steps_override=None):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_RESIZE_WORKER = '''\
+"""bench --elastic-resize worker: a single-controller fleet — one host
+process driving a W-virtual-device CPU mesh, W handed down by the
+Supervisor's resize env overlay (PADDLE_ELASTIC_WORLD). Each life
+recomputes its mesh from the latest checkpoint's manifest descriptor
+via topology.plan_resize, so param/optimizer state arrives through the
+manifest-driven resharding load path."""
+import os
+import time
+
+W = int(os.environ["PADDLE_ELASTIC_WORLD"])
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={W}")  # before jax import
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle1_tpu as paddle
+from paddle1_tpu.core.tensor import Tensor
+from paddle1_tpu.distributed import (ParallelEngine, ResilientTrainer,
+                                     build_mesh, plan_resize)
+from paddle1_tpu.distributed import checkpoint as ckpt_mod
+from paddle1_tpu.io import DataLoader, Dataset, DistributedBatchSampler
+
+steps = int(os.environ["P1T_RESIZE_STEPS"])
+save_freq = int(os.environ["P1T_RESIZE_SAVE_FREQ"])
+G = int(os.environ["P1T_RESIZE_GLOBAL_BATCH"])
+ck_dir = os.environ["P1T_RESIZE_CKPT"]
+pace_s = float(os.environ.get("P1T_RESIZE_PACE_S", "0"))
+inc = int(os.environ.get("PADDLE_FT_WORKER_INCARNATION", "0"))
+assert len(jax.devices()) == W, (W, jax.devices())
+
+paddle.seed(0)
+model = paddle.nn.Sequential(
+    paddle.nn.Linear(16, 48), paddle.nn.ReLU(), paddle.nn.Linear(48, 4))
+for i, p in enumerate(model.parameters()):
+    p._data = jax.numpy.asarray(
+        np.random.default_rng(7 + i)
+        .standard_normal(p.shape).astype(np.float32) * 0.1)
+opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+loss_fn = lambda m, b: ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+
+# the elastic mesh: recomputed from the LATEST commit's manifest
+# descriptor — the saved dp/sharding degrees remap onto the new world
+latest = ckpt_mod.latest_step(ck_dir)
+saved_mesh = (ckpt_mod.manifest_mesh(os.path.join(ck_dir, str(latest)))
+              if latest is not None else None)
+degrees = (plan_resize(saved_mesh, W) if saved_mesh is not None
+           else {"sharding": W})
+engine = ParallelEngine(model, opt, loss_fn, mesh=build_mesh(**degrees),
+                        zero_stage=3, check_finite=True)
+
+
+class _Synth(Dataset):
+    """sample i -> deterministic (x, y); the sleep paces the run so
+    mid-run membership events land deterministically."""
+
+    def __len__(self):
+        return (steps + 4) * G
+
+    def __getitem__(self, i):
+        if pace_s:
+            time.sleep(pace_s)
+        r = np.random.default_rng(1000 + i)
+        return {"x": r.standard_normal(16).astype(np.float32),
+                "y": r.standard_normal(4).astype(np.float32)}
+
+
+ds = _Synth()
+# world-invariant global stream: batch-major elastic layout, this host
+# drives every mesh device so it consumes the whole global batch
+sampler = DistributedBatchSampler(ds, batch_size=G // W, num_replicas=W,
+                                  rank="all", shuffle=True, elastic=True)
+loader = DataLoader(ds, batch_sampler=sampler)
+trainer = ResilientTrainer(engine, ck_dir, save_freq=save_freq,
+                           bad_step_policy="restore_last_good",
+                           backoff_base_s=0.0)
+report = trainer.fit(lambda: loader, steps=steps)
+np.savez(os.environ["P1T_RESIZE_OUT"],
+         **{k.replace("/", "__"): np.asarray(v)
+            for k, v in engine.params.items()})
+print(f"RESIZE life={inc} world={W} final_step={report.final_step} "
+      f"resumed_from={report.resumed_from} "
+      f"resharded={report.resharded_restores} "
+      f"loader_resume={report.loader_resume} "
+      f"consumed={loader.batches_consumed}", flush=True)
+'''
+
+
+def bench_elastic_resize(on_tpu, steps_override=None):
+    """``--elastic-resize``: live 8→6→8 world-resize soak.
+
+    Trains the same deterministic MLP twice under a ``resize``-policy
+    Supervisor over an elastic single-controller fleet (one process
+    driving a W-device CPU mesh, params + AdamW moments ZeRO-3-sharded
+    W ways):
+
+    * **clean** — fixed world 8, uninterrupted;
+    * **resize** — ``worker_kill`` chaos SIGKILLs the fleet mid-run
+      (an ungraceful preemption of 2 of the 8 "hosts"): the Supervisor
+      shrinks to 6 — the relaunched life recomputes its mesh via
+      ``plan_resize`` from the checkpoint manifest and restores through
+      the resharding load path, resuming from a mid-run commit. Once
+      the shrunken world commits past the grow mark, the bench calls
+      ``request_resize(8)`` ("capacity returned"): survivors drain
+      (graceful final commit), and the grown life reshards 6→8 and
+      finishes.
+
+    ``vs_baseline`` is the elasticity contract: 1.0 iff final params
+    match the clean run to 1e-6 (the global batch is fixed, so the
+    optimizer trajectory is world-size-invariant), both resized lives
+    restored via the RESHARDING path, the kill resumed from a commit
+    ``>= save_freq``, and sample accounting is exactly-once across the
+    graceful resize (the grown life resumes at exactly the step the
+    drained life committed, through the O(1) loader-state restore).
+    """
+    import os
+    import re
+    import shutil
+    import sys as _sys
+    import tempfile
+    import threading
+
+    from paddle1_tpu.distributed import Supervisor
+    from paddle1_tpu.distributed import checkpoint as ckpt_mod
+
+    steps = steps_override or 30
+    if steps < 12:
+        raise SystemExit(
+            f"--elastic-resize needs --steps >= 12 (got {steps}): the "
+            "kill, the shrunken-world commits and the grow must all "
+            "land inside the run")
+    save_freq = max(steps // 6, 1)
+    grow_step = (2 * steps // 3) // save_freq * save_freq
+    # worker_kill counts health BEATS (~3/step + 2/save); land the kill
+    # around steps//3 — past mid-run commits, well before grow_step
+    kill_step = max(steps // 3, save_freq + 1)
+    kill_beat = 3 * kill_step + 2 * (kill_step // save_freq) + 2
+    world, shrink_by = 8, 2
+    tmp = tempfile.mkdtemp(prefix="p1t_resize_")
+    worker_py = os.path.join(tmp, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(_RESIZE_WORKER)
+
+    def run_supervised(tag, chaos_spec, with_grow):
+        env = dict(os.environ)
+        env.pop("FLAGS_ft_chaos", None)
+        env.pop("XLA_FLAGS", None)  # the worker pins its own device count
+        repo = os.path.dirname(os.path.abspath(__file__))
+        ck_dir = os.path.join(tmp, tag, "ckpts")
+        env.update({
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_ELASTIC_WORLD": str(world),
+            "P1T_RESIZE_STEPS": str(steps),
+            "P1T_RESIZE_SAVE_FREQ": str(save_freq),
+            "P1T_RESIZE_GLOBAL_BATCH": "48",
+            "P1T_RESIZE_CKPT": ck_dir,
+            "P1T_RESIZE_OUT": os.path.join(tmp, tag, "params.npz"),
+            "P1T_RESIZE_PACE_S": "0.004",
+            # share one XLA cache across lives: a resized life pays the
+            # retrace, a re-grown life hits the original world's cache
+            "FLAGS_jit_cache_dir": os.path.join(tmp, "jitcache"),
+        })
+        if chaos_spec:
+            env["FLAGS_ft_chaos"] = chaos_spec
+        os.makedirs(os.path.join(tmp, tag), exist_ok=True)
+        sup = Supervisor(policy="resize", world_size=world,
+                         min_world=2, max_resizes=4,
+                         shrink_target=lambda w, fails: w - shrink_by,
+                         heartbeat_dir=os.path.join(tmp, tag, "hb"),
+                         poll_s=0.05, grace_s=5.0, resize_grace_s=30.0)
+        log_path = os.path.join(tmp, tag, "workerlog.0")
+        sup.add_worker(0, [_sys.executable, "-u", worker_py], env=env,
+                       log_path=log_path)
+        rc_box = {}
+        runner = threading.Thread(
+            target=lambda: rc_box.update(rc=sup.run()), daemon=True)
+        runner.start()
+        if with_grow:
+            # grow back once the SHRUNKEN world has committed past the
+            # grow mark — "the preempted capacity came back"
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if rc_box:  # completed before the grow could land
+                    break
+                if sup.report.resizes and \
+                        (ckpt_mod.latest_step(ck_dir) or 0) >= grow_step:
+                    sup.request_resize(world, "capacity restored")
+                    break
+                time.sleep(0.02)
+        runner.join(timeout=300)
+        if runner.is_alive():
+            raise AssertionError(f"elastic-resize {tag} run wedged")
+        rc = rc_box.get("rc")
+        log = open(log_path).read()
+        if rc != 0:
+            raise AssertionError(
+                f"elastic-resize {tag} run failed rc={rc}: {log[-2000:]}")
+        lives = []
+        for m in re.finditer(
+                r"RESIZE life=(\d+) world=(\d+) final_step=(\d+) "
+                r"resumed_from=(\S+) resharded=(\d+) "
+                r"loader_resume=(\S+) consumed=(\d+)", log):
+            lives.append({
+                "life": int(m.group(1)), "world": int(m.group(2)),
+                "final_step": int(m.group(3)),
+                "resumed_from": (None if m.group(4) == "None"
+                                 else int(m.group(4))),
+                "resharded": int(m.group(5)),
+                "loader_resume": m.group(6),
+                "consumed": int(m.group(7))})
+        out = np.load(os.path.join(tmp, tag, "params.npz"))
+        return {k: out[k] for k in out.files}, sup.report, lives
+
+    try:
+        t0 = time.perf_counter()
+        clean, _, _ = run_supervised("clean", "", with_grow=False)
+        faulted, report, lives = run_supervised(
+            "resize", f"worker_kill@{kill_beat}:0", with_grow=True)
+        dt = time.perf_counter() - t0
+        max_err = max(float(np.max(np.abs(clean[k] - faulted[k])))
+                      for k in clean)
+        sizes = [(r["from"], r["to"]) for r in report.resizes]
+        kill_life = next((l for l in lives if l["world"] == world -
+                          shrink_by), None)
+        grow_life = next((l for l in lives
+                          if l["world"] == world and l["life"] > 0), None)
+        recovered = (
+            max_err <= 1e-6
+            and sizes == [(world, world - shrink_by),
+                          (world - shrink_by, world)]
+            and kill_life is not None and grow_life is not None
+            # the ungraceful kill resumed from a MID-RUN commit through
+            # the 8→6 resharding load path
+            and kill_life["resumed_from"] is not None
+            and kill_life["resumed_from"] >= save_freq
+            and kill_life["resharded"] >= 1
+            # exactly-once across the graceful resize: the grown life
+            # resumes at exactly the step the drained life committed,
+            # via the O(1) loader-state restore (no replay, no gap)
+            and grow_life["resumed_from"] == kill_life["final_step"]
+            and grow_life["resharded"] >= 1
+            and grow_life["loader_resume"] == "state"
+            and grow_life["final_step"] == steps)
+        detail = dict(report.as_dict(), steps=steps, save_freq=save_freq,
+                      kill_beat=kill_beat, grow_step=grow_step,
+                      lives=lives, max_param_err=max_err,
+                      elapsed_s=round(dt, 3))
+        _emit("elastic_resize_recovered_steps_per_sec", steps / dt,
+              "steps/s", 1.0 if recovered else 0.0, detail)
+        if not recovered:
+            raise AssertionError(
+                f"elastic resize did NOT recover: {json.dumps(detail)}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_loader_chaos(on_tpu, steps_override=None):
     """``--loader-chaos``: fault-injection soak of the input pipeline.
 
@@ -787,6 +1045,15 @@ def main():
                          "committed checkpoint); vs_baseline is 1.0 iff "
                          "final params match the clean run to 1e-6 with "
                          "exactly one restart")
+    ap.add_argument("--elastic-resize", dest="elastic_resize",
+                    action="store_true",
+                    help="live world-resize soak: SIGKILL the fleet "
+                         "mid-run (worker_kill chaos), shrink 8→6 with "
+                         "a checkpoint-resharding resume, grow back to "
+                         "8 on request; vs_baseline is 1.0 iff final "
+                         "params match the uninterrupted fixed-global-"
+                         "batch run to 1e-6 with exactly-once sample "
+                         "accounting across the resize")
     ap.add_argument("--serving", action="store_true",
                     help="dynamic micro-batching soak: serve N requests "
                          "sequentially and through the Batcher at batch "
@@ -823,6 +1090,8 @@ def main():
 
     if args.elastic:
         bench_elastic_soak(on_tpu, steps_override=args.steps)
+    elif args.elastic_resize:
+        bench_elastic_resize(on_tpu, steps_override=args.steps)
     elif args.serving:
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.chaos:

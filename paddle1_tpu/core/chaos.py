@@ -63,7 +63,11 @@ worker; incarnation 0 only, so a restarted worker replays clean):
 
 ``worker_kill``      — SIGKILL self (an ungraceful worker death the
                        Supervisor must detect via ``poll`` and restart
-                       from the last committed checkpoint).
+                       from the last committed checkpoint — or, under
+                       the elastic ``resize`` policy, answer with a
+                       shrink-and-continue into a smaller world; the
+                       incarnation-0 gate below is what keeps the kill
+                       from re-firing in every resized life).
 ``worker_hang``      — stop beating and block forever (a deadlocked
                        queue / stuck collective; the Supervisor's
                        heartbeat ager must catch it, collect a SIGABRT

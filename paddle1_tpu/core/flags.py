@@ -327,11 +327,21 @@ def _define_builtin_flags() -> None:
                 "ft_max_worker_restarts times; the relaunched worker "
                 "resumes from the last committed checkpoint "
                 "(ResilientTrainer.restore_latest), which the elastic "
-                "parity gate holds to 1e-6. drain: request graceful "
-                "preemption (SIGTERM -> chaos.request_preemption), let "
-                "every worker checkpoint, then stop.",
+                "parity gate holds to 1e-6 — in a multi-worker world a "
+                "failed rank instead routes into the RESIZE path "
+                "(shrink-and-continue; see 'resize'). drain: request "
+                "graceful preemption (SIGTERM -> "
+                "chaos.request_preemption), let every worker "
+                "checkpoint, then stop. resize: membership change is a "
+                "recoverable event — on worker loss (or an explicit "
+                "Supervisor.request_resize) the surviving ranks are "
+                "drained so each commits a final checkpoint, the "
+                "dp/sharding mesh is recomputed for the new world size, "
+                "param/optimizer state reshards via the manifest-driven "
+                "remap, and the fleet relaunches at the new size with "
+                "resume-from-latest.",
                 validator=lambda v: v in ("", "off", "fail_fast",
-                                          "restart", "drain"))
+                                          "restart", "drain", "resize"))
     define_flag("ft_hang_timeout", 60.0,
                 "Supervisor hang detector: a worker whose heartbeat "
                 "file (touched by core.health.beat every step) is older "
@@ -341,6 +351,18 @@ def _define_builtin_flags() -> None:
     define_flag("ft_max_worker_restarts", 2,
                 "Per-rank relaunch budget under ft_supervise=restart; "
                 "a rank exceeding it fails the pod (fail_fast).",
+                validator=lambda v: v >= 0)
+    define_flag("ft_elastic_min_world", 1,
+                "Smallest world size an elastic resize may shrink to: "
+                "losing enough workers to fall below this fails the pod "
+                "instead of limping on (capacity floor for preemptible "
+                "fleets).",
+                validator=lambda v: v >= 1)
+    define_flag("ft_max_resizes", 8,
+                "Total world-resize budget per supervised job (shrinks "
+                "+ grows + explicit requests); exceeding it fails the "
+                "pod — a fleet that resizes forever is churning, not "
+                "training.",
                 validator=lambda v: v >= 0)
     define_flag("ft_chaos", "",
                 "Deterministic failure-injection spec armed by "

@@ -17,7 +17,9 @@ from .collective import (ReduceOp, Group, all_gather, all_gather_object,
                          reduce, reduce_scatter, scatter, send, split, wait)
 from .parallel import (DataParallel, ParallelEnv, init_parallel_env)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
-                       build_mesh, get_hybrid_communicate_group,
+                       MeshDescriptor, ReshardError, build_mesh,
+                       ensure_reshardable, get_hybrid_communicate_group,
+                       mesh_descriptor, plan_resize,
                        set_hybrid_communicate_group)
 from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params

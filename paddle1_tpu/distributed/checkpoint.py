@@ -51,7 +51,7 @@ from ..core.errors import EnforceNotMet
 __all__ = ["save_sharded", "load_sharded", "latest_step",
            "committed_steps", "CheckpointCorruptError", "CheckpointManager",
            "MANIFEST_NAME", "write_manifest", "read_manifest",
-           "verify_manifest"]
+           "verify_manifest", "tree_mesh_descriptor", "manifest_mesh"]
 
 MANIFEST_NAME = "manifest.json"
 
@@ -85,10 +85,63 @@ def save_sharded(path: str, state: Dict[str, Any], *, force: bool = True):
     return path
 
 
-def load_sharded(path: str, target: Dict[str, Any]):
+def tree_mesh_descriptor(tree):
+    """MeshDescriptor of the mesh the tree's arrays live on (first
+    mesh-sharded leaf wins — one engine state tree has one mesh), or
+    None for host-only/abstract-unsharded trees."""
+    from .topology import mesh_descriptor
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "devices", None) is not None:
+            return mesh_descriptor(mesh)
+    return None
+
+
+def manifest_mesh(path: str):
+    """The MeshDescriptor stamped into a checkpoint's manifest meta, or
+    None (pre-elastic checkpoint / no manifest)."""
+    from .topology import MeshDescriptor
+    doc = read_manifest(path)
+    if doc is None:
+        return None
+    return MeshDescriptor.from_meta((doc.get("meta") or {}).get("mesh"))
+
+
+# "not provided" sentinel for load_sharded's saved_mesh: None is a
+# meaningful value (known pre-elastic checkpoint — skip the manifest
+# re-read a caller who already parsed it would otherwise pay)
+_MESH_UNKNOWN = object()
+
+
+def load_sharded(path: str, target: Dict[str, Any], *,
+                 saved_mesh=_MESH_UNKNOWN):
     """Restore into the shardings of ``target`` (a live or abstract state
-    tree). Returns the restored pytree."""
+    tree). Returns the restored pytree.
+
+    Resharding load path: when the checkpoint was written on a
+    *different* mesh than ``target``'s arrays live on (``saved_mesh``,
+    normally read from the manifest — :func:`manifest_mesh` — by the
+    caller; read from the manifest beside ``path`` here when omitted),
+    the old-shard → new-shard slice remap is validated first
+    (:func:`~.topology.ensure_reshardable`: only the data axes
+    dp/sharding may change degree) and then performed by orbax against
+    the target shardings directly — each process reads exactly the byte
+    ranges its new shards cover, so a grown or shrunk world never
+    materializes the full tree on one host.
+    """
     path = os.path.abspath(path)
+    if saved_mesh is _MESH_UNKNOWN:
+        saved_mesh = manifest_mesh(path)
+    tgt_mesh = tree_mesh_descriptor(target)
+    if tgt_mesh is not None:
+        from .topology import ensure_reshardable
+        if ensure_reshardable(saved_mesh, tgt_mesh):
+            warnings.warn(
+                f"resharding restore: checkpoint {os.path.basename(path)} "
+                f"was saved on {saved_mesh.device_count} device(s) "
+                f"{dict(saved_mesh.axes)}, restoring onto "
+                f"{tgt_mesh.device_count} device(s) {dict(tgt_mesh.axes)}")
     return _checkpointer().restore(path, _abstract(target))
 
 
@@ -122,6 +175,12 @@ def _json_safe_meta(obj, keypath="meta"):
     import numpy as _np
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    # typed host metadata the manifest knows how to flatten: the mesh/
+    # topology descriptor rides every elastic checkpoint (resharding
+    # restores need it to validate the resize before touching arrays)
+    from .topology import MeshDescriptor
+    if isinstance(obj, MeshDescriptor):
+        return obj.as_meta()
     if isinstance(obj, _np.bool_):
         return bool(obj)
     if isinstance(obj, _np.integer):
@@ -314,10 +373,19 @@ class CheckpointManager:
         """Restore the newest checkpoint that verifies (or exactly
         ``step`` when given), falling back past corrupt/partial ones.
         Returns ``(restored_tree, step)``."""
+        from .topology import MeshDescriptor, ReshardError
+
+        def _load(path):
+            # reuse the just-verified manifest's mesh — restore is the
+            # recovery hot path, no point parsing manifest.json twice
+            doc = verify_manifest(path, target)
+            mesh = MeshDescriptor.from_meta(
+                (doc.get("meta") or {}).get("mesh"))
+            return load_sharded(path, target, saved_mesh=mesh)
+
         if step is not None:
             path = self._step_dir(step)
-            verify_manifest(path, target)
-            return load_sharded(path, target), int(step)
+            return _load(path), int(step)
         candidates = committed_steps(self.directory)
         if not candidates:
             raise FileNotFoundError(
@@ -326,8 +394,13 @@ class CheckpointManager:
         for s in reversed(candidates):
             path = self._step_dir(s)
             try:
-                verify_manifest(path, target)
-                return load_sharded(path, target), s
+                return _load(path), s
+            except ReshardError:
+                # a configuration error, not corruption: every older
+                # checkpoint of this run shares the mesh, so falling
+                # back would just repeat the failure — surface the
+                # teaching message immediately
+                raise
             except Exception as e:
                 # corrupt / truncated / mismatched — fall back to the
                 # previous checkpoint rather than dying on the newest

@@ -96,6 +96,26 @@ def get_cluster(node_ips: List[str], nproc_per_node: int,
     return cluster
 
 
+def trainer_env(cluster: Cluster, pod: Pod, trainer) -> dict:
+    """The per-rank trainer env block (reference launch_utils env
+    protocol) — the ONE place it is defined: the initial spawn
+    (``start_local_trainers``) and the elastic resize relaunch
+    (``launch.py``'s ``resize_env_hook``) both stamp exactly this."""
+    endpoints = cluster.trainers_endpoints()
+    world = cluster.world_size()
+    return {
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_NODE_RANK": str(pod.rank),
+        "PADDLE_NNODES": str(len(cluster.pods)),
+        "RANK": str(trainer.rank),
+        "WORLD_SIZE": str(world),
+        "FLAGS_selected_tpus": str(trainer.rank),
+    }
+
+
 def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
                          training_script_args: List[str],
                          log_dir: Optional[str] = None,
@@ -112,22 +132,10 @@ def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
     relaunch a rank with the identical spec); returns ``[]`` and the
     caller runs ``supervisor.run()``. Without one, spawns plain Popen
     workers exactly as before."""
-    endpoints = cluster.trainers_endpoints()
-    world = cluster.world_size()
     procs = []
     for t in pod.trainers:
         env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(t.rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_CURRENT_ENDPOINT": t.endpoint,
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_NODE_RANK": str(pod.rank),
-            "PADDLE_NNODES": str(len(cluster.pods)),
-            "RANK": str(t.rank),
-            "WORLD_SIZE": str(world),
-            "FLAGS_selected_tpus": str(t.rank),
-        })
+        env.update(trainer_env(cluster, pod, t))
         if extra_env:
             env.update(extra_env)
         cmd = [sys.executable, "-u", training_script] + \

@@ -96,6 +96,10 @@ class ResilienceReport:
     # O(steps) fast-forward, None = never repositioned
     loader_resume: Optional[str] = None
     loader_state_restores: int = 0  # O(1) restores performed
+    # restores whose checkpoint was written on a DIFFERENT mesh (an
+    # elastic world-resize): params/opt state arrived via the
+    # manifest-driven shard remap, not a same-layout load
+    resharded_restores: int = 0
 
     def as_dict(self) -> Dict[str, Any]:
         from dataclasses import asdict
@@ -206,6 +210,13 @@ class ResilientTrainer:
         sched = getattr(self.engine.optimizer, "_learning_rate", None)
         return sched if hasattr(sched, "state_dict") else None
 
+    def _mesh_descriptor(self):
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is None:
+            return None
+        from .topology import mesh_descriptor
+        return mesh_descriptor(mesh)
+
     def _meta(self, step: int) -> Dict[str, Any]:
         meta = {"step": int(step), "rng": get_rng_state(),
                 # host-side recovery state rides the checkpoint too:
@@ -214,6 +225,13 @@ class ResilientTrainer:
                 # replay-parity contract
                 "watchdog": {"ema": self._loss_ema,
                              "warmup": self._ema_warmup}}
+        # the mesh/topology descriptor makes the checkpoint ELASTIC: a
+        # restore onto a different world size detects the mismatch,
+        # validates the resize (data axes only) and reshards — see
+        # checkpoint.load_sharded's resharding load path
+        desc = self._mesh_descriptor()
+        if desc is not None:
+            meta["mesh"] = desc
         if self.scaler is not None:
             try:
                 meta["scaler"] = {
@@ -283,6 +301,16 @@ class ResilientTrainer:
         self.engine.opt_state = restored["opt_state"]
         self.engine.sync_model()
         meta = self.manager.read_meta(ckpt_step) or {}
+        cur_mesh = self._mesh_descriptor()
+        if cur_mesh is not None and "mesh" in meta:
+            from .topology import MeshDescriptor
+            saved_mesh = MeshDescriptor.from_meta(meta["mesh"])
+            if saved_mesh is not None and saved_mesh != cur_mesh:
+                # the load above already validated + performed the
+                # old-shard → new-shard remap; count it so the elastic
+                # acceptance matrix can assert the resize really took
+                # the resharding path
+                self.report.resharded_restores += 1
         if "rng" in meta:
             set_rng_state(meta["rng"])
         wd = meta.get("watchdog")

@@ -18,7 +18,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..nn.layer_base import Layer
 
 __all__ = ["param_partition_specs", "named_shardings", "zero_shard_spec",
-           "data_partition_spec"]
+           "data_partition_spec", "describe_layout"]
+
+
+def describe_layout(tree) -> Dict[str, str]:
+    """{leaf path: partition spec} of a live (or abstract-with-sharding)
+    state tree — how the state is actually laid out over the mesh.
+
+    The elastic-resize surface: after a resharding restore
+    (``checkpoint.load_sharded`` onto a new world size) this is the
+    quick way to see — and, in the tests, assert — which leaves landed
+    sharded and which fell back to replicated (a dim the new degree no
+    longer divides). Host-only leaves are skipped.
+    """
+    out: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is not None:
+            out[jax.tree_util.keystr(path)] = str(spec)
+    return out
 
 
 def param_partition_specs(layer: Layer,

@@ -32,18 +32,44 @@ Response is per policy (flag ``ft_supervise``):
     checkpoint (PR 2 ``ResilientTrainer.restore_latest``), so a
     killed-and-restarted run must match the uninterrupted run to 1e-6 —
     the elastic parity gate (``bench.py --elastic``,
-    tests/test_launch.py).
+    tests/test_launch.py). In a **multi-worker world** a dead rank
+    cannot rejoin live collectives, so ``restart`` routes the failure
+    into the *resize* path below (shrink-and-continue) instead of
+    relaunching the lone rank into a job that can no longer hear it.
 ``drain``
     Request graceful preemption from every worker (SIGTERM → the
     ``health`` SIGTERM handler calls ``chaos.request_preemption()`` and
     marks a drain, so ``ResilientTrainer.fit`` checkpoints its current
     good state and stops), wait out a grace window, then stop the pod.
+``resize``
+    Membership change is a *recoverable event*, not a fatal one. On
+    worker loss (or an explicit :meth:`Supervisor.request_resize`) the
+    surviving ranks are drained (SIGTERM → each ``ResilientTrainer``
+    commits a final atomic checkpoint, whose manifest carries the
+    mesh/topology descriptor), the world size is recomputed, and the
+    fleet relaunches at the new size with resume-from-latest: each new
+    worker rebuilds its mesh via ``topology.plan_resize`` and the
+    restore reshards param/optimizer state through the manifest-driven
+    old-shard → new-shard remap (``checkpoint.load_sharded``). Budgets:
+    ``ft_elastic_min_world`` is the shrink floor, ``ft_max_resizes``
+    bounds total membership churn. ``bench.py --elastic-resize`` is the
+    8→6→8 parity gate.
+
+Policy × failure matrix (adopted = ``attach``'d, no respawn spec)::
+
+    policy     exit/hang/unhealthy rank      essential worker   adopted
+    fail_fast  kill pod                      kill pod           kill pod
+    restart    world=1: relaunch rank        kill pod           kill pod
+               world>1: resize (shrink)
+    drain      checkpoint all, stop pod      kill pod           drain
+    resize     shrink-and-continue           kill pod           kill pod
 
 The supervisor also *adopts* pre-spawned processes (``attach``) so the
 legacy ``watch_local_trainers`` / ``watch_ps_procs`` surfaces — and
 ``fleet.ProcessMultiTrainer``'s ``multiprocessing`` workers, via
 :class:`MpProcessHandle` — run on the same loop; adopted workers have
-no respawn spec, so ``restart`` falls back to ``fail_fast`` for them.
+no respawn spec, so ``restart``/``resize`` fall back to ``fail_fast``
+for them.
 """
 
 from __future__ import annotations
@@ -65,7 +91,7 @@ from ..core.health import (HEARTBEAT_ENV, INCARNATION_ENV, STACKDUMP_ENV,
 __all__ = ["Supervisor", "SupervisorReport", "WorkerFailure",
            "MpProcessHandle", "POLICIES"]
 
-POLICIES = ("fail_fast", "restart", "drain")
+POLICIES = ("fail_fast", "restart", "drain", "resize")
 
 # failure kinds
 EXIT = "exit"
@@ -99,6 +125,9 @@ class SupervisorReport:
     stack_dumps: List[str] = field(default_factory=list)
     drained: bool = False
     exit_code: Optional[int] = None
+    # elastic membership changes: [{"from", "to", "reason"}] in order
+    resizes: List[Dict[str, Any]] = field(default_factory=list)
+    world_size: Optional[int] = None  # current logical world
 
     @property
     def total_restarts(self) -> int:
@@ -114,6 +143,8 @@ class SupervisorReport:
                 "unhealthy_reports": self.unhealthy_reports,
                 "stack_dumps": list(self.stack_dumps),
                 "drained": self.drained,
+                "resizes": [dict(r) for r in self.resizes],
+                "world_size": self.world_size,
                 "exit_code": self.exit_code}
 
 
@@ -155,6 +186,10 @@ class _Worker:
                  essential: bool = False, proc=None):
         self.rank = rank
         self.cmd = list(cmd) if cmd is not None else None
+        # base_env is the REGISTERED env; env is what the next spawn
+        # uses (resize overlays world coordinates onto a fresh copy of
+        # base_env each time, so overlays never stack)
+        self.base_env = dict(env) if env is not None else None
         self.env = dict(env) if env is not None else None
         self.log_path = log_path
         self.role = role
@@ -188,6 +223,42 @@ class Supervisor:
     step time; default ``5 * hang_timeout``). ``hang_timeout=None`` plus
     no heartbeat dir (pure ``attach`` use) degrades to exit-only
     watching — exactly the legacy semantics.
+
+    Elastic (policy ``resize``, and multi-worker ``restart``) knobs:
+
+    ``world_size``
+        The job's *logical* world. Defaults to the number of
+        respawnable trainers, the one-process-per-rank fleet; a
+        single-controller fleet (one host process driving a W-device
+        mesh) registers one worker and passes ``world_size=W`` — a
+        resize then relaunches the same process count with new world
+        coordinates instead of changing it.
+    ``min_world`` / ``max_resizes``
+        Shrink floor (flag ``ft_elastic_min_world``) and total
+        membership-churn budget (flag ``ft_max_resizes``).
+    ``resize_env_hook``
+        ``fn(rank, new_world) -> {env}`` merged over the worker's
+        registered env at every (re)spawn after a resize — the caller's
+        chance to recompute endpoints / device topology (e.g. the CPU
+        sim's ``XLA_FLAGS`` device count). The supervisor itself always
+        sets ``PADDLE_ELASTIC_WORLD`` and, for per-rank fleets,
+        ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM``.
+    ``shrink_target``
+        ``fn(current_world, failures) -> new_world`` policy for how far
+        a failure shrinks the world (default: one per failed rank).
+    ``resize_grace_s``
+        Drain window for survivors to commit their final checkpoint
+        before relaunch (defaults to ``grace_s``); stragglers are
+        SIGKILLed — their last *periodic* commit is then the resume
+        point, which the atomic-manifest protocol makes safe.
+    ``elastic``
+        Override for the failure→resize routing. ``None`` (default)
+        = auto: policy ``resize``, or ``restart`` in a multi-worker
+        world. ``False`` forces per-rank semantics — what a MULTI-NODE
+        launcher must pass, because a per-node supervisor owns only its
+        own pod's (global) ranks and must not rebuild a world it
+        cannot see (launch.py does this; elastic resize assumes ONE
+        supervisor owning every rank, numbered 0..world-1).
     """
 
     def __init__(self, policy: Optional[str] = None,
@@ -197,7 +268,13 @@ class Supervisor:
                  log_dir: Optional[str] = None,
                  poll_s: float = 0.5, grace_s: float = 10.0,
                  dump_wait_s: float = 5.0,
-                 startup_grace_s: Optional[float] = None):
+                 startup_grace_s: Optional[float] = None,
+                 world_size: Optional[int] = None,
+                 min_world: Optional[int] = None,
+                 max_resizes: Optional[int] = None,
+                 resize_env_hook=None, shrink_target=None,
+                 resize_grace_s: Optional[float] = None,
+                 elastic: Optional[bool] = None):
         if policy is None:
             policy = core_flags.flag("ft_supervise")
         if policy in ("", "off"):
@@ -221,6 +298,20 @@ class Supervisor:
         self.startup_grace_s = (5.0 * self.hang_timeout
                                 if startup_grace_s is None
                                 else float(startup_grace_s))
+        self.world_size = None if world_size is None else int(world_size)
+        self.min_world = int(
+            core_flags.flag("ft_elastic_min_world") if min_world is None
+            else min_world)
+        self.max_resizes = int(
+            core_flags.flag("ft_max_resizes") if max_resizes is None
+            else max_resizes)
+        self.resize_env_hook = resize_env_hook
+        self.shrink_target = shrink_target
+        self.resize_grace_s = (self.grace_s if resize_grace_s is None
+                               else float(resize_grace_s))
+        self._resize_request: Optional[Tuple[int, str]] = None
+        self._elastic_override = elastic
+        self._procs_track_world = True
         self._workers: Dict[int, _Worker] = {}
         self.report = SupervisorReport(policy=self.policy)
 
@@ -463,29 +554,88 @@ class Supervisor:
               f"incarnation {w.incarnation})", file=sys.stderr)
         return True
 
+    def _graceful_stop(self, workers, grace_s: float,
+                       straggler_note: str = "",
+                       kill_stragglers: bool = True) -> None:
+        """The shared drain primitive (policy ``drain`` and elastic
+        resize): SIGTERM → bounded wait → optionally SIGKILL
+        stragglers. The SIGTERM side is what lets a ResilientTrainer
+        commit its final checkpoint; a SIGKILLed straggler resumes from
+        its last periodic commit instead (atomic manifests make that
+        safe). ``kill_stragglers=False`` leaves stragglers to the
+        caller (policy drain hands them to ``_terminate_all``, whose
+        own TERM-grace-KILL ladder gives them a second window)."""
+        workers = [w for w in workers
+                   if w.proc is not None and w.proc.poll() is None]
+        for w in workers:
+            self._kill_worker(w, signal.SIGTERM)
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            if all(w.proc.poll() is not None for w in workers):
+                break
+            time.sleep(min(self.poll_s, 0.2))
+        if not kill_stragglers:
+            return
+        for w in workers:
+            if w.proc.poll() is None:
+                if straggler_note:
+                    print(f"supervisor: rank {w.rank} {straggler_note}",
+                          file=sys.stderr)
+                self._kill_worker(w, signal.SIGKILL)
+                try:
+                    w.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+
     def _drain_all(self, grace_s: Optional[float] = None) -> None:
         """Graceful pod stop: SIGTERM every live worker (the health
         SIGTERM handler turns it into chaos.request_preemption + drain,
         so resilient loops checkpoint and exit), wait out the grace
         window, then terminate stragglers."""
         self.report.drained = True
-        grace = self.grace_s if grace_s is None else grace_s
-        alive = [w for w in self._workers.values()
-                 if w.proc is not None and w.proc.poll() is None]
-        for w in alive:
-            self._kill_worker(w, signal.SIGTERM)
-        deadline = time.monotonic() + grace
-        while time.monotonic() < deadline:
-            if all(w.proc.poll() is not None for w in alive):
-                break
-            time.sleep(min(self.poll_s, 0.2))
+        self._graceful_stop(list(self._workers.values()),
+                            self.grace_s if grace_s is None else grace_s,
+                            kill_stragglers=False)
         self._terminate_all()
 
-    # -- the loop ---------------------------------------------------------
+    # -- elastic world-resize ---------------------------------------------
 
-    def _on_failure(self, w: _Worker, f: WorkerFailure) -> Optional[int]:
-        """Policy dispatch for one detected failure. Returns the pod
-        exit code when the failure ends the job, None when handled."""
+    def _trainers(self) -> List[_Worker]:
+        return [w for w in self._workers.values() if not w.essential]
+
+    def _elastic_workers(self) -> List[_Worker]:
+        """The ranks a resize may drain/relaunch: respawnable trainers."""
+        return [w for w in self._trainers() if w.respawnable]
+
+    def _elastic_routing(self) -> bool:
+        """Whether failures route into the resize path: explicit
+        ``resize`` policy, or ``restart`` in a multi-worker world (a
+        dead rank cannot rejoin live collectives — relaunching it alone
+        would strand its peers, the PR 3 dead end). The ``elastic=False``
+        override wins: a per-node supervisor of a multi-NODE pod owns
+        only its slice of the global ranks and must never resize."""
+        if self._elastic_override is False:
+            return False
+        if self.policy == "resize":
+            return True
+        return self.policy == "restart" and \
+            (self.world_size or 0) > 1 and len(self._elastic_workers()) > 1
+
+    def request_resize(self, new_world: int, reason: str = "requested"
+                       ) -> None:
+        """Ask the supervision loop to resize the world at its next
+        sweep (thread-safe: callable from another thread, e.g. a
+        cluster-capacity watcher that just got preemption notices or
+        freed machines back). Growth and shrink both route through the
+        same drain → recompute-mesh → reshard → relaunch path."""
+        if int(new_world) < 1:
+            raise InvalidArgumentError(
+                f"cannot resize to world size {new_world}")
+        self._resize_request = (int(new_world), reason)
+
+    def _record_failure(self, w: _Worker, f: WorkerFailure) -> None:
+        """Bookkeeping common to policy handling and resize routing:
+        counters, stack dump for hangs, marker consumption."""
         self.report.failures.append(f)
         if f.kind == HANG:
             self.report.hangs_detected += 1
@@ -506,6 +656,126 @@ class Supervisor:
         else:
             print(f"supervisor: rank {w.rank} failed — {f.reason}",
                   file=sys.stderr)
+
+    def _clone_worker(self, template: _Worker, rank: int) -> _Worker:
+        """A grow beyond the registered fleet clones the lowest-rank
+        spec; world coordinates are overlaid at spawn. Incarnation
+        starts at 1 via the respawn loop's bump, so rank-qualified
+        chaos (incarnation 0 only) can never fire in a grown rank."""
+        log_path = template.log_path
+        if log_path:
+            import re as _re
+            log_path = _re.sub(rf"\.{template.rank}(?=$|\.log$)",
+                               f".{rank}", log_path)
+        return _Worker(rank, template.cmd, template.base_env, log_path,
+                       template.role, template.essential)
+
+    def _resize(self, new_world: int, reason: str,
+                failed: Tuple[_Worker, ...] = (),
+                fail_code: int = 1, strict: bool = True) -> Optional[int]:
+        """Drain → recompute → relaunch the fleet at ``new_world``.
+        Returns None when the resize succeeded (the loop continues) or
+        the pod exit code when it cannot (below the floor / out of
+        budget): elasticity has limits, and hitting one after losing a
+        rank is a failed job, not an infinite shrink. ``failed`` ranks
+        (already dead or wedged) are hard-killed, never drained;
+        ``fail_code`` is the pod exit code when a strict resize is
+        refused. ``strict=False`` (explicit requests on a HEALTHY
+        world) refuses politely instead of killing the job."""
+        old_world = self.world_size or len(self._elastic_workers())
+        new_world = int(new_world)
+        if new_world == old_world and not failed:
+            return None  # no-op request
+        if new_world < max(1, self.min_world):
+            print(f"supervisor: resize to {new_world} is below the "
+                  f"world floor ({max(1, self.min_world)}) — "
+                  + ("failing the pod" if strict else "request refused"),
+                  file=sys.stderr)
+            if not strict:
+                return None
+            self._terminate_all()
+            return fail_code
+        if len(self.report.resizes) >= self.max_resizes:
+            print(f"supervisor: resize budget exhausted "
+                  f"({self.max_resizes}) — "
+                  + ("failing the pod" if strict else "request refused"),
+                  file=sys.stderr)
+            if not strict:
+                return None
+            self._terminate_all()
+            return fail_code
+        print(f"supervisor: resizing world {old_world} -> {new_world} "
+              f"({reason})", file=sys.stderr)
+        # 1. put down the failed ranks (dead or wedged — never drained)
+        for w in failed:
+            self._kill_worker(w, signal.SIGKILL)
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=self.grace_s)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        # 2. drain survivors: SIGTERM → ResilientTrainer commits a
+        # final checkpoint (manifest carries the mesh descriptor) and
+        # exits; stragglers resume from their last periodic commit
+        failed_ids = {id(w) for w in failed}
+        self._graceful_stop(
+            [w for w in self._elastic_workers()
+             if id(w) not in failed_ids],
+            self.resize_grace_s,
+            straggler_note=(f"did not drain within "
+                            f"{self.resize_grace_s:.0f}s — SIGKILL "
+                            "(resumes from its last periodic "
+                            "checkpoint)"))
+        # 3. recompute the worker table for the new world
+        elastic = sorted(self._elastic_workers(), key=lambda w: w.rank)
+        if self._procs_track_world:
+            template = elastic[0]
+            for w in elastic:
+                if w.rank >= new_world:
+                    if w.log_fh is not None:
+                        try:
+                            w.log_fh.close()
+                        except OSError:  # pragma: no cover
+                            pass
+                        w.log_fh = None
+                    del self._workers[w.rank]
+            for rank in range(new_world):
+                if rank not in self._workers:
+                    self._workers[rank] = self._clone_worker(template,
+                                                             rank)
+            targets = [self._workers[r] for r in range(new_world)]
+        else:
+            targets = elastic  # single-controller: env-only resize
+        # 4. relaunch with the new world coordinates
+        self.report.resizes.append({"from": old_world, "to": new_world,
+                                    "reason": reason})
+        self.report.world_size = new_world
+        self.world_size = new_world
+        for w in targets:
+            w.done = False
+            w.incarnation += 1
+            env = dict(w.base_env if w.base_env is not None
+                       else os.environ)
+            env["PADDLE_ELASTIC_WORLD"] = str(new_world)
+            if self._procs_track_world:
+                env["PADDLE_TRAINER_ID"] = str(w.rank)
+                env["PADDLE_TRAINERS_NUM"] = str(new_world)
+            if self.resize_env_hook is not None:
+                env.update({str(k): str(v) for k, v in
+                            (self.resize_env_hook(w.rank, new_world)
+                             or {}).items()})
+            w.env = env
+            self._spawn(w)
+        return None
+
+    # -- the loop ---------------------------------------------------------
+
+    def _on_failure(self, w: _Worker, f: WorkerFailure) -> Optional[int]:
+        """Policy dispatch for one detected failure. Returns the pod
+        exit code when the failure ends the job, None when handled.
+        Resize-eligible failures never reach here — the run loop routes
+        them into :meth:`_resize` as a batch."""
+        self._record_failure(w, f)
 
         if self.policy == "restart":
             if self._restart_worker(w):
@@ -530,13 +800,20 @@ class Supervisor:
         code. KeyboardInterrupt kills the pod and re-raises (the
         reference watch contract)."""
         self.start()
-        trainers = [w for w in self._workers.values() if not w.essential]
-        if not trainers:
+        if not self._trainers():
             # essential=True means "must outlive the trainers"; with no
             # trainers there is nothing to outlive (a server-only node
             # watches its servers as plain workers instead)
             raise InvalidArgumentError(
                 "Supervisor.run needs at least one non-essential worker")
+        if self.world_size is None:
+            self.world_size = len(self._elastic_workers()) or \
+                len(self._trainers())
+        self.report.world_size = self.world_size
+        # one process per rank (resize scales the process count) vs a
+        # single-controller fleet (resize rewrites world coordinates)
+        self._procs_track_world = (
+            len(self._elastic_workers()) == self.world_size)
         try:
             while True:
                 sweep = []
@@ -544,21 +821,63 @@ class Supervisor:
                     f = self._classify(w)
                     if f is not None:
                         sweep.append((w, f))
-                if all(w.done for w in trainers) and all(
+                if all(w.done for w in self._trainers()) and all(
                         w.essential and f.kind == EXIT and f.raw_exit == 0
                         for w, f in sweep):
                     # job complete — an essential worker (PS server)
                     # that exited CLEANLY in the same sweep the last
                     # trainer finished is a success, not a strand (the
-                    # legacy watch_ps_procs ordering)
+                    # legacy watch_ps_procs ordering). Checked BEFORE
+                    # any pending resize request: a grow racing the
+                    # last trainer's exit must not respawn a finished
+                    # fleet
                     self._terminate_all()  # tear down essential workers
                     self.report.exit_code = 0
                     return 0
-                for w, f in sweep:
-                    rc = self._on_failure(w, f)
-                    if rc is not None:
-                        self.report.exit_code = rc
-                        return rc
+                if self._resize_request is not None:
+                    req, self._resize_request = self._resize_request, None
+                    # strict=False: a refused operator request (floor/
+                    # budget) is logged, never fatal to a healthy pod
+                    self._resize(req[0], req[1], strict=False)
+                    continue  # re-sweep the fresh fleet
+                if self._elastic_routing():
+                    # membership change: handle every resize-eligible
+                    # failure of this sweep as ONE shrink (preempting 2
+                    # of 8 hosts is one event, not two relaunch cycles)
+                    eligible = [(w, f) for w, f in sweep
+                                if w.respawnable and not w.essential]
+                    rest = [(w, f) for w, f in sweep
+                            if not (w.respawnable and not w.essential)]
+                    for w, f in rest:
+                        rc = self._on_failure(w, f)
+                        if rc is not None:
+                            self.report.exit_code = rc
+                            return rc
+                    if eligible:
+                        for w, f in eligible:
+                            self._record_failure(w, f)
+                        fails = [f for _, f in eligible]
+                        if self.shrink_target is not None:
+                            target = int(self.shrink_target(
+                                self.world_size, fails))
+                        else:
+                            target = self.world_size - len(eligible)
+                        code = next((f.exit_code for f in fails
+                                     if f.exit_code), 1)
+                        rc = self._resize(
+                            target,
+                            f"worker loss ({[f.rank for f in fails]})",
+                            failed=tuple(w for w, _ in eligible),
+                            fail_code=code)
+                        if rc is not None:
+                            self.report.exit_code = rc
+                            return rc
+                else:
+                    for w, f in sweep:
+                        rc = self._on_failure(w, f)
+                        if rc is not None:
+                            self.report.exit_code = rc
+                            return rc
                 time.sleep(self.poll_s)
         except KeyboardInterrupt:
             self._terminate_all()

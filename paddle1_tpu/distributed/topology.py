@@ -17,6 +17,9 @@ fastest links, so they are innermost.
 
 from __future__ import annotations
 
+import hashlib
+import json
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,7 +30,9 @@ from jax.sharding import Mesh
 from ..core.errors import InvalidArgumentError
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
-           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+           "MeshDescriptor", "mesh_descriptor", "plan_resize",
+           "ensure_reshardable", "ReshardError"]
 
 # Canonical axis order. pp outermost (stages talk rarely, point-to-point),
 # then dp, sharding, mp, sp innermost (tightest collectives).
@@ -48,6 +53,151 @@ def build_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     shape = tuple(degrees[a] for a in _AXIS_ORDER)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, _AXIS_ORDER)
+
+
+class ReshardError(InvalidArgumentError):
+    """A checkpoint's mesh cannot be resharded to the requested world
+    size/topology (elastic resize). The message teaches the fix — these
+    are configuration errors, never data corruption."""
+
+
+@dataclass
+class MeshDescriptor:
+    """JSON-serializable identity of a hybrid mesh: the axis degrees in
+    canonical order plus the device count. This is what a checkpoint
+    manifest records (``meta["mesh"]``) so a restore into a *different*
+    world size can (a) detect that it is a resharding restore and
+    (b) validate the resize is expressible before orbax touches any
+    array. Pure host metadata — no device objects.
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+    device_count: int = 1
+
+    def degree(self, axis: str) -> int:
+        return int(self.axes.get(axis, 1))
+
+    @property
+    def data_degree(self) -> int:
+        """Combined degree of the data axes (dp × sharding) — the axes
+        an elastic resize is allowed to scale."""
+        return self.degree("dp") * self.degree("sharding")
+
+    @property
+    def model_degree(self) -> int:
+        """Combined degree of the non-resizable axes (mp × pp × sp):
+        resizing these would change which tensor dims are sharded, not
+        just how many ways — out of scope for elastic resize."""
+        return self.degree("mp") * self.degree("pp") * self.degree("sp")
+
+    def digest(self) -> str:
+        blob = json.dumps({"axes": {k: int(v) for k, v in
+                                    sorted(self.axes.items())},
+                           "devices": int(self.device_count)},
+                          sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def as_meta(self) -> Dict[str, object]:
+        """Plain-JSON form for the checkpoint manifest."""
+        return {"axes": {k: int(v) for k, v in self.axes.items()},
+                "device_count": int(self.device_count),
+                "digest": self.digest()}
+
+    @classmethod
+    def from_meta(cls, doc) -> Optional["MeshDescriptor"]:
+        """Rebuild from manifest meta; None for absent/foreign shapes
+        (pre-elastic checkpoints have no mesh meta)."""
+        if isinstance(doc, MeshDescriptor):
+            return doc
+        if not isinstance(doc, dict) or "axes" not in doc:
+            return None
+        return cls(axes={str(k): int(v)
+                         for k, v in dict(doc["axes"]).items()},
+                   device_count=int(doc.get("device_count",
+                                            int(np.prod([int(v) for v in
+                                                dict(doc["axes"]).values()]
+                                                or [1])))))
+
+    def __eq__(self, other):
+        if not isinstance(other, MeshDescriptor):
+            return NotImplemented
+        return (self.device_count == other.device_count and
+                {k: v for k, v in self.axes.items() if v != 1} ==
+                {k: v for k, v in other.axes.items() if v != 1})
+
+
+def mesh_descriptor(mesh: Mesh) -> MeshDescriptor:
+    """The :class:`MeshDescriptor` of a live mesh."""
+    axes = {str(name): int(size) for name, size in mesh.shape.items()}
+    return MeshDescriptor(axes=axes, device_count=int(mesh.devices.size))
+
+
+def plan_resize(old: MeshDescriptor, new_device_count: int
+                ) -> Dict[str, int]:
+    """Degrees for the resized mesh: ``build_mesh(**plan_resize(...))``.
+
+    Elastic policy — only the *data* axes scale: ``mp``/``pp``/``sp``
+    shard tensor dims and must keep their degrees (resizing them changes
+    the sharded shape arithmetic, which checkpoint resharding cannot
+    express without re-deciding layouts); ``dp`` and ``sharding`` absorb
+    the change. Within the data axes: a degree-1 axis stays 1, and when
+    both were active the ``sharding`` degree is preserved and ``dp``
+    scales (ZeRO shard count is a memory contract; dp is throughput).
+    Raises :class:`ReshardError` with the teaching message when the new
+    world size cannot express the preserved axes.
+    """
+    new_device_count = int(new_device_count)
+    if new_device_count < 1:
+        raise ReshardError(
+            f"cannot resize to a world of {new_device_count} devices")
+    fixed = old.model_degree
+    if new_device_count % fixed:
+        raise ReshardError(
+            f"world size {new_device_count} cannot carry the "
+            f"checkpoint's model-parallel topology (mp={old.degree('mp')}"
+            f" x pp={old.degree('pp')} x sp={old.degree('sp')} = {fixed} "
+            f"does not divide {new_device_count}): elastic resize scales "
+            "the data axes (dp/sharding) only — pick a world size that "
+            f"is a multiple of {fixed}, or retrain/export the checkpoint "
+            "at the new model-parallel degrees")
+    data = new_device_count // fixed
+    degrees = {"mp": old.degree("mp"), "pp": old.degree("pp"),
+               "sp": old.degree("sp")}
+    old_dp, old_shard = old.degree("dp"), old.degree("sharding")
+    if old_shard == 1:
+        degrees["dp"], degrees["sharding"] = data, 1
+    elif old_dp == 1:
+        degrees["dp"], degrees["sharding"] = 1, data
+    else:
+        if data % old_shard:
+            raise ReshardError(
+                f"world size {new_device_count} cannot keep the "
+                f"checkpoint's ZeRO sharding degree {old_shard} "
+                f"(data capacity {data} is not a multiple of it): pick "
+                f"a multiple of {fixed * old_shard}, or rebuild the "
+                "engine with sharding=1 to let dp absorb the resize")
+        degrees["dp"], degrees["sharding"] = data // old_shard, old_shard
+    return degrees
+
+
+def ensure_reshardable(saved: Optional[MeshDescriptor],
+                       target: MeshDescriptor) -> bool:
+    """Validate that a checkpoint saved on ``saved`` can restore onto
+    ``target`` (True = this IS a resharding restore; False = same mesh).
+    Raises :class:`ReshardError` when the target changes a model axis —
+    the one resize class the manifest-driven shard remap refuses."""
+    if saved is None or saved == target:
+        return False
+    for axis in ("mp", "pp", "sp"):
+        if saved.degree(axis) != target.degree(axis):
+            raise ReshardError(
+                f"checkpoint was saved on a mesh with {axis}="
+                f"{saved.degree(axis)} but the restore target has "
+                f"{axis}={target.degree(axis)}: elastic resize scales "
+                "the data axes (dp/sharding) only. Rebuild the target "
+                f"mesh with {axis}={saved.degree(axis)} (plan_resize() "
+                "computes the degrees for a new world size)")
+    return True
 
 
 class CommunicateTopology:

@@ -286,43 +286,113 @@ class DistributedBatchSampler(BatchSampler):
     """Per-rank shard of the index space (reference
     distributed/fleet/dataset?  python/paddle/io DistributedBatchSampler):
     pads to equal length so every rank sees the same number of batches —
-    required for lockstep SPMD on TPU."""
+    required for lockstep SPMD on TPU.
+
+    Two shard layouts:
+
+    * **strided** (default, the reference layout): rank ``r`` takes every
+      ``nranks``-th index of the whole epoch. Simple, but the set of
+      samples a rank has consumed after ``c`` batches is spread over the
+      entire epoch — there is NO world-size-invariant notion of "where
+      the job is", so loader state written at one world size cannot be
+      restored at another (``set_state_dict`` raises the teaching error).
+    * **elastic** (``elastic=True``): batch-major — global batch ``j`` is
+      the contiguous slice ``order[j*G:(j+1)*G]`` of the epoch order
+      (``G = batch_size * nranks``, the *global* batch), and rank ``r``
+      takes its contiguous ``batch_size`` chunk of it. The global stream
+      is a pure function of (epoch, global batch size): after ``c``
+      batches the job has consumed exactly the first ``c*G`` positions
+      *for any world size*, so a live resize (8→6 ranks) resumes by
+      keeping the cursor and re-slicing — no sample dropped or consumed
+      twice. ``rank="all"`` yields the whole global batch in epoch order
+      (the single-controller mode: one host process feeding every mesh
+      device); per-rank chunks concatenate to exactly that stream.
+
+    An elastic resize must keep the global batch fixed:
+    ``new_nranks * new_batch_size == old_nranks * old_batch_size``
+    (``set_state_dict`` verifies and teaches otherwise).
+    """
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
+                 shuffle=False, drop_last=False, elastic=False):
         from ..distributed import env
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.nranks = num_replicas if num_replicas is not None \
             else env.get_world_size()
-        self.local_rank = rank if rank is not None else env.get_rank()
+        self.elastic = bool(elastic)
+        if rank == "all":
+            if not self.elastic:
+                raise InvalidArgumentError(
+                    'rank="all" (global-batch mode) requires '
+                    "elastic=True: the strided layout has no "
+                    "world-invariant global stream to yield")
+            self.local_rank = "all"
+        else:
+            self.local_rank = rank if rank is not None else env.get_rank()
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
-        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
-        self.total_size = self.num_samples * self.nranks
+        if self.elastic:
+            g = self.batch_size * self.nranks
+            nb = (len(dataset) // g if drop_last
+                  else int(math.ceil(len(dataset) / g)))
+            self.num_samples = nb * self.batch_size
+            self.total_size = nb * g
+        else:
+            self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+            self.total_size = self.num_samples * self.nranks
 
-    def __iter__(self):
+    def _epoch_order(self):
         n = len(self.dataset)
         indices = np.arange(n)
         if self.shuffle:
             rng = np.random.RandomState(self.epoch)
             indices = rng.permutation(n)
+        return indices
+
+    def __iter__(self):
+        if self.elastic:
+            return self._iter_elastic()
+        indices = self._epoch_order()
+        n = len(indices)
         # pad to make divisible
         pad = self.total_size - n
         if pad > 0:
             indices = np.concatenate([indices, indices[:pad]])
         local = indices[self.local_rank:self.total_size:self.nranks]
-        batch = []
-        for idx in local.tolist():
-            batch.append(idx)
-            if len(batch) == self.batch_size:
+
+        def gen():
+            batch = []
+            for idx in local.tolist():
+                batch.append(idx)
+                if len(batch) == self.batch_size:
+                    yield batch
+                    batch = []
+            if batch and not self.drop_last:
                 yield batch
-                batch = []
-        if batch and not self.drop_last:
-            yield batch
+        return gen()
+
+    def _iter_elastic(self):
+        indices = self._epoch_order()
+        g = self.batch_size * self.nranks
+        if self.total_size > len(indices):  # wrap-pad the final batch
+            indices = np.concatenate(
+                [indices, indices[:self.total_size - len(indices)]])
+        else:
+            indices = indices[:self.total_size]
+        for j in range(self.total_size // g):
+            chunk = indices[j * g:(j + 1) * g]
+            if self.local_rank == "all":
+                yield chunk.tolist()
+            else:
+                r = self.local_rank
+                yield chunk[r * self.batch_size:
+                            (r + 1) * self.batch_size].tolist()
 
     def __len__(self):
+        if self.elastic:
+            return self.total_size // (self.batch_size * self.nranks)
         if self.drop_last:
             return self.num_samples // self.batch_size
         return (self.num_samples + self.batch_size - 1) // self.batch_size
@@ -331,12 +401,54 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
     # checkpointable: the index sequence is a pure function of
-    # (epoch, rank, world) — epoch is the whole shuffle state
+    # (epoch, rank, world) — epoch is the whole shuffle state; the
+    # layout fields ride along so a restore at a DIFFERENT world size
+    # is either remapped (elastic) or refused with the reason (strided)
     def checkpointable(self) -> bool:
         return True
 
     def state_dict(self):
-        return {"epoch": int(self.epoch)}
+        return {"epoch": int(self.epoch), "nranks": int(self.nranks),
+                "batch_size": int(self.batch_size),
+                "elastic": bool(self.elastic)}
 
     def set_state_dict(self, state):
-        self.epoch = int((state or {}).get("epoch", self.epoch))
+        st = state or {}
+        old_elastic = st.get("elastic")
+        if old_elastic is not None and bool(old_elastic) != self.elastic:
+            old_l, new_l = (("batch-major (elastic)", "strided")
+                            if old_elastic else
+                            ("strided", "batch-major (elastic)"))
+            raise InvalidArgumentError(
+                f"DistributedBatchSampler state was written by a "
+                f"{old_l} sampler but this sampler is {new_l}: the two "
+                "layouts order samples differently, so restoring "
+                "across them would drop and double-consume samples "
+                "even at the same world size — rebuild the sampler "
+                f"with elastic={bool(old_elastic)}")
+        old_n = st.get("nranks")
+        old_b = st.get("batch_size")
+        if old_n is not None and old_b is not None:
+            old_n, old_b = int(old_n), int(old_b)
+            if self.elastic:
+                if old_n * old_b != self.nranks * self.batch_size:
+                    raise InvalidArgumentError(
+                        "elastic resume requires a FIXED global batch: "
+                        f"checkpoint was written at {old_n} rank(s) x "
+                        f"batch_size {old_b} = global {old_n * old_b}, "
+                        f"this sampler is {self.nranks} rank(s) x "
+                        f"{self.batch_size} = global "
+                        f"{self.nranks * self.batch_size}. Resize by "
+                        "scaling batch_size inversely with the world "
+                        "size (global_batch // nranks)")
+            elif old_n != self.nranks or old_b != self.batch_size:
+                raise InvalidArgumentError(
+                    "DistributedBatchSampler state was written at "
+                    f"{old_n} rank(s) x batch_size {old_b} but this "
+                    f"sampler is {self.nranks} x {self.batch_size}: the "
+                    "strided per-epoch layout has no world-size-"
+                    "invariant cursor, so its state cannot be remapped "
+                    "across a resize — construct the sampler with "
+                    "elastic=True (batch-major layout) to make loader "
+                    "state portable across world sizes")
+        self.epoch = int(st.get("epoch", self.epoch))

@@ -77,6 +77,8 @@ class TestLinearChainCRF:
         np.testing.assert_allclose(np.exp(ll[:, 0]).sum(), 1.0,
                                    rtol=1e-3)
 
+    @pytest.mark.slow  # ~45s convergence soak; the decode/likelihood
+    # cases above keep the CRF math covered in-tier (CI heavy step)
     def test_trains_toward_labels(self, problem):
         e, w = problem
         B, T, N = e.shape

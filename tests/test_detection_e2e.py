@@ -133,6 +133,9 @@ def _train_step(model, img, gt, gtc, info, masks, anchors):
         + mask_loss
 
 
+@pytest.mark.slow  # ~55s of convergence soaks; the per-op detection
+# suites (test_detection_ops/test_detection_train) keep the stage math
+# covered in-tier (CI heavy step)
 class TestTwoStageE2E:
     def test_pipeline_trains(self, scene):
         img, gt, gtc, info, masks = scene

@@ -1,0 +1,497 @@
+"""Live world-resize with checkpoint resharding (PR 6).
+
+Three layers under test, cheapest first:
+
+* **topology** — MeshDescriptor round-trip, plan_resize's
+  data-axes-only policy and its teaching errors;
+* **checkpoint** — the manifest-driven shard remap: save on one mesh,
+  restore onto a bigger/smaller one (shrink AND grow, uneven divisors,
+  optimizer-moment trees, scalar/replicated leaves), typed ReshardError
+  when the saved topology cannot be expressed at the new world size;
+* **sampler/loader** — the elastic DistributedBatchSampler's
+  world-size-invariant global stream and cursor remap across a resize;
+* **supervisor** — shrink-and-continue on worker loss, grow on
+  request_resize, floors/budgets (plain-stdlib beater workers, same
+  pattern as test_launch).
+
+The end-to-end 8→6→8 chaos parity gate is ``bench.py --elastic-resize``
+(CI); the fast cases here keep the tier-1 suite honest without paying a
+jax-subprocess import per test.
+"""
+
+import os
+import signal
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle1_tpu.distributed import checkpoint as ckpt_mod
+from paddle1_tpu.distributed.checkpoint import (CheckpointManager,
+                                                CheckpointCorruptError,
+                                                tree_mesh_descriptor)
+from paddle1_tpu.distributed.topology import (MeshDescriptor, ReshardError,
+                                              build_mesh,
+                                              ensure_reshardable,
+                                              mesh_descriptor, plan_resize)
+from paddle1_tpu.io import DataLoader, DistributedBatchSampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(n, **degrees):
+    degrees = degrees or {"sharding": n}
+    return build_mesh(devices=jax.devices()[:n], **degrees)
+
+
+def _sharded(mesh, arr, *axes):
+    return jax.device_put(arr, NamedSharding(mesh, P(*axes)))
+
+
+class TestMeshDescriptor:
+    def test_round_trip_and_digest(self):
+        m = _mesh(8)
+        d = mesh_descriptor(m)
+        assert d.device_count == 8
+        assert d.degree("sharding") == 8 and d.degree("mp") == 1
+        back = MeshDescriptor.from_meta(d.as_meta())
+        assert back == d
+        assert back.digest() == d.digest()
+
+    def test_equality_ignores_size_one_axes(self):
+        a = MeshDescriptor(axes={"dp": 4, "mp": 1}, device_count=4)
+        b = MeshDescriptor(axes={"dp": 4}, device_count=4)
+        assert a == b
+        assert MeshDescriptor.from_meta({"bogus": 1}) is None
+        assert MeshDescriptor.from_meta(None) is None
+
+    def test_manifest_meta_round_trip(self, tmp_path):
+        """The PR 5 meta sanitizer learns the descriptor type: topology
+        meta rides the manifest without the typed-key-path error."""
+        state = {"w": np.arange(6, dtype=np.float32)}
+        d = str(tmp_path / "ck")
+        os.makedirs(d)
+        ckpt_mod.write_manifest(d, state,
+                                meta={"mesh": mesh_descriptor(_mesh(8)),
+                                      "step": 3})
+        doc = ckpt_mod.read_manifest(d)
+        back = MeshDescriptor.from_meta(doc["meta"]["mesh"])
+        assert back == mesh_descriptor(_mesh(8))
+        assert ckpt_mod.manifest_mesh(d) == back
+
+    def test_sanitizer_still_rejects_foreign_types(self, tmp_path):
+        d = str(tmp_path / "ck")
+        os.makedirs(d)
+        with pytest.raises(CheckpointCorruptError, match=r"meta\.bad"):
+            ckpt_mod.write_manifest(d, {"w": np.zeros(2)},
+                                    meta={"bad": object()})
+
+
+class TestPlanResize:
+    def test_dp_scales(self):
+        d = MeshDescriptor(axes={"dp": 8}, device_count=8)
+        assert plan_resize(d, 6)["dp"] == 6
+        assert plan_resize(d, 6)["sharding"] == 1
+
+    def test_sharding_scales_when_dp_one(self):
+        d = MeshDescriptor(axes={"sharding": 8}, device_count=8)
+        got = plan_resize(d, 6)
+        assert got["sharding"] == 6 and got["dp"] == 1
+
+    def test_model_axes_preserved(self):
+        d = MeshDescriptor(axes={"dp": 4, "mp": 2}, device_count=8)
+        got = plan_resize(d, 6)
+        assert got == {"dp": 3, "sharding": 1, "mp": 2, "pp": 1, "sp": 1}
+
+    def test_mp_not_divisible_teaches(self):
+        d = MeshDescriptor(axes={"dp": 2, "mp": 4}, device_count=8)
+        with pytest.raises(ReshardError, match="multiple of 4"):
+            plan_resize(d, 6)
+
+    def test_both_data_axes_keep_zero_degree(self):
+        d = MeshDescriptor(axes={"dp": 2, "sharding": 2}, device_count=4)
+        got = plan_resize(d, 8)
+        assert got["sharding"] == 2 and got["dp"] == 4
+        with pytest.raises(ReshardError, match="multiple of"):
+            plan_resize(d, 3)
+
+    def test_ensure_reshardable(self):
+        eight = mesh_descriptor(_mesh(8))
+        six = mesh_descriptor(_mesh(6))
+        assert ensure_reshardable(eight, eight) is False
+        assert ensure_reshardable(None, six) is False  # pre-elastic ckpt
+        assert ensure_reshardable(eight, six) is True
+        mp2 = mesh_descriptor(_mesh(8, mp=2, sharding=4))
+        with pytest.raises(ReshardError, match="mp="):
+            ensure_reshardable(mp2, six)
+
+
+class TestShardRemap:
+    """save_sharded/load_sharded's resharding load path: old-shard →
+    new-shard slices through orbax against the target shardings."""
+
+    def _state(self, mesh):
+        # params + an AdamW-shaped slot tree: moments shard like their
+        # param, plus a replicated bias and a scalar step count
+        w = np.arange(48 * 16, dtype=np.float32).reshape(48, 16)
+        b = np.arange(4, dtype=np.float32)
+        return {
+            "params": {"w": _sharded(mesh, w, "sharding"),
+                       "b": _sharded(mesh, b)},
+            "opt": {"m": _sharded(mesh, w * 0.5, "sharding"),
+                    "v": _sharded(mesh, w * 0.25, "sharding"),
+                    "count": _sharded(mesh, np.float32(7))},
+        }
+
+    def _roundtrip(self, tmp_path, n_from, n_to):
+        from paddle1_tpu.distributed.sharding_specs import describe_layout
+        src = self._state(_mesh(n_from))
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(5, src, meta={"mesh": mesh_descriptor(_mesh(n_from))})
+        target = self._state(_mesh(n_to))
+        restored, step = mgr.restore(target)
+        assert step == 5
+        for path in (("params", "w"), ("params", "b"), ("opt", "m"),
+                     ("opt", "v"), ("opt", "count")):
+            want = src[path[0]][path[1]]
+            got = restored[path[0]][path[1]]
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+            # the restored leaf LANDS in the new world's sharding
+            assert got.sharding.mesh.devices.size == n_to
+        # the layouts really changed world: params + both AdamW moments
+        # are sharded over the new degree, scalars stay replicated
+        layout = describe_layout(restored)
+        for key in ("['params']['w']", "['opt']['m']", "['opt']['v']"):
+            assert "sharding" in layout[key], layout
+        assert layout["['opt']['count']"] == "PartitionSpec()"
+        return restored
+
+    def test_shrink_8_to_6(self, tmp_path):
+        self._roundtrip(tmp_path, 8, 6)
+
+    def test_grow_6_to_8(self, tmp_path):
+        self._roundtrip(tmp_path, 6, 8)
+
+    def test_uneven_divisor_falls_back_to_replicated(self, tmp_path):
+        """48 % 5 != 0: at the new world the spec machinery
+        (zero_shard_spec) leaves a non-divisible dim replicated — the
+        remap must deliver a SHARDED-at-8 leaf into a REPLICATED-at-5
+        target (and the reverse) bit-identically."""
+        w = np.arange(48 * 16, dtype=np.float32).reshape(48, 16)
+        src = {"w": _sharded(_mesh(8), w, "sharding")}
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(1, src, meta={"mesh": mesh_descriptor(_mesh(8))})
+        target = {"w": _sharded(_mesh(5), np.zeros_like(w))}  # replicated
+        restored, _ = mgr.restore(target)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+        assert restored["w"].sharding.mesh.devices.size == 5
+
+        # and back up: replicated-at-5 → sharded-at-6
+        mgr2 = CheckpointManager(str(tmp_path / "ck2"))
+        mgr2.save(1, {"w": _sharded(_mesh(5), w)},
+                  meta={"mesh": mesh_descriptor(_mesh(5))})
+        target = {"w": _sharded(_mesh(6), np.zeros_like(w), "sharding")}
+        restored, _ = mgr2.restore(target)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+
+    def test_mp_resize_refused_with_teaching_error(self, tmp_path):
+        mesh_mp = _mesh(8, mp=2, sharding=4)
+        src = self._state(mesh_mp)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(3, src, meta={"mesh": mesh_descriptor(mesh_mp)})
+        target = self._state(_mesh(6))
+        with pytest.raises(ReshardError, match="mp="):
+            mgr.restore(target)
+
+    def test_pre_elastic_checkpoint_still_restores(self, tmp_path):
+        """No mesh meta (pre-PR6 checkpoint): the remap is skipped, the
+        plain orbax restore still lands in the target shardings."""
+        src = self._state(_mesh(8))
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(2, src)  # no meta["mesh"]
+        restored, _ = mgr.restore(self._state(_mesh(6)))
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(src["params"]["w"]))
+
+    def test_tree_mesh_descriptor(self):
+        st = self._state(_mesh(6))
+        assert tree_mesh_descriptor(st) == mesh_descriptor(_mesh(6))
+        assert tree_mesh_descriptor({"x": 3}) is None
+
+
+class _Range:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.float32)
+
+
+class TestElasticSampler:
+    def test_global_stream_invariant_across_worlds(self):
+        ds = _Range(96)
+        streams = {}
+        for w in (1, 2, 4, 8):
+            s = DistributedBatchSampler(ds, batch_size=48 // w,
+                                        num_replicas=w, rank="all",
+                                        shuffle=True, elastic=True)
+            streams[w] = list(s)
+        for w in (2, 4, 8):
+            assert streams[w] == streams[1]
+
+    def test_rank_chunks_concatenate_to_global(self):
+        ds = _Range(96)
+        world = 4
+        ranks = [list(DistributedBatchSampler(
+            ds, batch_size=12, num_replicas=world, rank=r,
+            shuffle=True, elastic=True)) for r in range(world)]
+        glob = list(DistributedBatchSampler(
+            ds, batch_size=12, num_replicas=world, rank="all",
+            shuffle=True, elastic=True))
+        for j, gb in enumerate(glob):
+            assert sum((ranks[r][j] for r in range(world)), []) == gb
+
+    def test_strided_default_layout_unchanged(self):
+        ds = _Range(20)
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                    rank=1, shuffle=False)
+        assert list(s) == [[1, 3], [5, 7], [9, 11], [13, 15], [17, 19]]
+
+    def test_rank_all_requires_elastic(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="elastic"):
+            DistributedBatchSampler(_Range(8), batch_size=2,
+                                    num_replicas=2, rank="all")
+
+    def test_strided_state_refuses_world_change(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        old = DistributedBatchSampler(_Range(32), batch_size=4,
+                                      num_replicas=8, rank=0)
+        new = DistributedBatchSampler(_Range(32), batch_size=4,
+                                      num_replicas=4, rank=0)
+        with pytest.raises(InvalidArgumentError, match="elastic=True"):
+            new.set_state_dict(old.state_dict())
+
+    def test_layout_mismatch_refused_even_at_same_world(self):
+        """elastic and strided order samples differently, so state must
+        never cross layouts — even when the rank/batch arithmetic
+        matches (8x6 == 8x6)."""
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        el = DistributedBatchSampler(_Range(96), batch_size=6,
+                                     num_replicas=8, rank=0, elastic=True)
+        st = DistributedBatchSampler(_Range(96), batch_size=6,
+                                     num_replicas=8, rank=0)
+        with pytest.raises(InvalidArgumentError, match="elastic=True"):
+            st.set_state_dict(el.state_dict())
+        with pytest.raises(InvalidArgumentError, match="elastic=False"):
+            el.set_state_dict(st.state_dict())
+
+    def test_elastic_state_requires_fixed_global_batch(self):
+        from paddle1_tpu.core.errors import InvalidArgumentError
+        old = DistributedBatchSampler(_Range(96), batch_size=6,
+                                      num_replicas=8, rank="all",
+                                      elastic=True)
+        bad = DistributedBatchSampler(_Range(96), batch_size=6,
+                                      num_replicas=6, rank="all",
+                                      elastic=True)
+        with pytest.raises(InvalidArgumentError, match="global"):
+            bad.set_state_dict(old.state_dict())
+
+    def test_loader_cursor_remaps_across_resize(self):
+        """The tentpole data contract: consume c global batches at
+        world 8, checkpoint the loader, restore at world 6 — the stream
+        continues exactly where it left off (no sample dropped or
+        consumed twice), because the cursor counts GLOBAL batches."""
+        ds = _Range(30 * 48)
+
+        def make_loader(w):
+            s = DistributedBatchSampler(ds, batch_size=48 // w,
+                                        num_replicas=w, rank="all",
+                                        shuffle=True, elastic=True)
+            return DataLoader(ds, batch_sampler=s)
+
+        ref = [np.asarray(b.data).tolist()
+               for b in list(make_loader(8))[:10]]
+
+        loader8 = make_loader(8)
+        it = iter(loader8)
+        first4 = [np.asarray(next(it).data).tolist() for _ in range(4)]
+        state = loader8.state_dict()
+        assert first4 == ref[:4]
+
+        loader6 = make_loader(6)
+        loader6.set_state_dict(state)
+        it6 = iter(loader6)
+        rest = [np.asarray(next(it6).data).tolist() for _ in range(6)]
+        assert rest == ref[4:10]
+
+    def test_epoch_seed_world_invariant(self):
+        ds = _Range(96)
+        a = DistributedBatchSampler(ds, batch_size=12, num_replicas=4,
+                                    rank="all", shuffle=True, elastic=True)
+        b = DistributedBatchSampler(ds, batch_size=24, num_replicas=2,
+                                    rank="all", shuffle=True, elastic=True)
+        a.set_epoch(3), b.set_epoch(3)
+        assert list(a) == list(b)
+        b.set_epoch(4)
+        assert list(a) != list(b)
+
+
+# -- supervisor resize (plain-stdlib beater workers) -------------------------
+
+ELASTIC_BEATER = textwrap.dedent("""
+    import os, signal, sys, time
+    hb = os.environ["PADDLE_FT_HEARTBEAT_FILE"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                os.environ.get("PADDLE_ELASTIC_WORLD", "1")))
+    inc = int(os.environ["PADDLE_FT_WORKER_INCARNATION"])
+    trace = os.environ["TRACE_FILE"]
+    def note(kind):
+        with open(trace, "a") as f:
+            f.write(f"{kind} rank={rank} world={world} inc={inc}\\n")
+    note("spawn")
+    def on_term(s, fr):   # the drain: "checkpoint" and exit clean
+        note("drain")
+        sys.exit(0)
+    signal.signal(signal.SIGTERM, on_term)
+    die = os.environ.get("DIE_RANK")
+    for i in range(400):
+        os.utime(hb, None)
+        if die is not None and rank == int(die) and inc == 0 and i == 5:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if inc > 0 and i >= 10:
+            break    # post-resize lives finish quickly
+        time.sleep(0.02)
+    note("done")
+""")
+
+
+def _resize_sup(tmp_path, nworkers, **kw):
+    from paddle1_tpu.distributed import Supervisor
+    kw.setdefault("policy", "resize")
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 3.0)
+    kw.setdefault("hang_timeout", 10.0)
+    kw.setdefault("heartbeat_dir", str(tmp_path / "hb"))
+    extra_env = kw.pop("extra_env", {})
+    sup = Supervisor(**kw)
+    w = tmp_path / "worker.py"
+    w.write_text(ELASTIC_BEATER)
+    for r in range(nworkers):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                   PADDLE_TRAINERS_NUM=str(nworkers),
+                   TRACE_FILE=str(tmp_path / "trace"), **extra_env)
+        sup.add_worker(r, [sys.executable, "-u", str(w)], env=env)
+    return sup
+
+
+def _trace(tmp_path):
+    p = tmp_path / "trace"
+    return p.read_text().splitlines() if p.exists() else []
+
+
+class TestSupervisorResize:
+    def test_worker_loss_shrinks_and_continues(self, tmp_path):
+        """The tentpole: losing a rank of a 3-worker world drains the
+        survivors and relaunches the fleet at world 2 with rewritten
+        coordinates; the job then completes (rc 0)."""
+        sup = _resize_sup(tmp_path, 3, extra_env={"DIE_RANK": "1"})
+        assert sup.run() == 0
+        assert [(r["from"], r["to"]) for r in sup.report.resizes] == \
+            [(3, 2)]
+        assert sup.report.world_size == 2
+        tr = _trace(tmp_path)
+        # survivors drained before relaunch
+        assert any(t.startswith("drain rank=0 world=3") for t in tr)
+        assert any(t.startswith("drain rank=2 world=3") for t in tr)
+        # relaunched fleet: ranks 0..1 at world 2, incarnation 1, and
+        # the dropped rank 2 never spawns again
+        assert any(t == "spawn rank=0 world=2 inc=1" for t in tr)
+        assert any(t == "spawn rank=1 world=2 inc=1" for t in tr)
+        assert not any(t.startswith("spawn rank=2 world=2") for t in tr)
+
+    def test_restart_policy_multiworld_routes_to_resize(self, tmp_path):
+        """The PR 3 dead end, replaced: ft_supervise=restart with a
+        multi-worker world no longer warns-and-relaunches a rank that
+        cannot rejoin — it shrinks-and-continues."""
+        sup = _resize_sup(tmp_path, 2, policy="restart",
+                          extra_env={"DIE_RANK": "0"})
+        assert sup.run() == 0
+        assert [(r["from"], r["to"]) for r in sup.report.resizes] == \
+            [(2, 1)]
+        assert sup.report.total_restarts == 0  # resize, not restart
+
+    @pytest.mark.slow  # tier-1 budget: the two cases above cover the
+    # shrink paths; these variants ride the CI elastic-resize step
+    def test_grow_on_request_clones_new_ranks(self, tmp_path):
+        sup = _resize_sup(tmp_path, 2)
+        rc_box = {}
+        t = threading.Thread(target=lambda: rc_box.update(rc=sup.run()))
+        t.start()
+        time.sleep(0.4)  # let the fleet spawn and beat
+        sup.request_resize(3, "capacity added")
+        t.join(timeout=30)
+        assert not t.is_alive() and rc_box["rc"] == 0
+        assert [(r["from"], r["to"]) for r in sup.report.resizes] == \
+            [(2, 3)]
+        tr = _trace(tmp_path)
+        assert any(t_ == "spawn rank=2 world=3 inc=1" for t_ in tr)
+
+    @pytest.mark.slow  # see test_grow_on_request_clones_new_ranks
+    def test_shrink_below_min_world_fails_pod(self, tmp_path):
+        sup = _resize_sup(tmp_path, 2, min_world=2,
+                          extra_env={"DIE_RANK": "1"})
+        assert sup.run() != 0
+        assert sup.report.resizes == []
+
+    @pytest.mark.slow  # see test_grow_on_request_clones_new_ranks
+    def test_resize_budget_exhausted_fails_pod(self, tmp_path):
+        sup = _resize_sup(tmp_path, 3, max_resizes=0,
+                          extra_env={"DIE_RANK": "1"})
+        assert sup.run() != 0
+        assert sup.report.resizes == []
+
+    @pytest.mark.slow  # see test_grow_on_request_clones_new_ranks
+    def test_explicit_request_below_floor_is_refused_not_fatal(
+            self, tmp_path):
+        sup = _resize_sup(tmp_path, 2, min_world=2)
+        rc_box = {}
+        t = threading.Thread(target=lambda: rc_box.update(rc=sup.run()))
+        t.start()
+        time.sleep(0.3)
+        sup.request_resize(1, "operator fat-finger")
+        # the request is refused; the healthy fleet must still finish
+        deadline = time.time() + 30
+        while time.time() < deadline and t.is_alive():
+            time.sleep(0.1)
+        # workers at inc 0 run ~8s; drain them to finish the test fast
+        if t.is_alive():
+            sup.request_resize(2, "noop")
+            t.join(timeout=30)
+        assert rc_box.get("rc") == 0
+        assert all((r["from"], r["to"]) != (2, 1)
+                   for r in sup.report.resizes)
+
+
+@pytest.mark.slow
+class TestElasticResizeParity:
+    def test_live_resize_8_6_8_parity(self):
+        """The acceptance gate: 8→6→8 mid-run under worker_kill chaos,
+        1e-6 final-param parity vs the uninterrupted fixed-global-batch
+        run, resharding restores in both resized lives, exactly-once
+        accounting across the graceful resize."""
+        sys.path.insert(0, REPO)
+        from bench import bench_elastic_resize
+        bench_elastic_resize(on_tpu=False)

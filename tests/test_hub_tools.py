@@ -86,6 +86,8 @@ class TestProgramIntrospection:
 
 
 class TestTools:
+    @pytest.mark.slow  # ~14s subprocess; CI runs the op microbench
+    # smoke as its own step, so in-tier duplication buys nothing
     def test_op_benchmark_single(self):
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "op_benchmark.py"),

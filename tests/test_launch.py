@@ -157,6 +157,9 @@ class TestLauncher:
         # distinct endpoints per rank
         assert f":{port}" in logs[0] and f":{port + 1}" in logs[1]
 
+    @pytest.mark.slow  # ~18s of double jax.distributed rendezvous; the
+    # allreduce rendezvous test above keeps the two-node path in-tier
+    # (CI heavy step runs this full training variant)
     def test_engine_dp_training_across_processes(self, tmp_path):
         """Full multi-host TRAINING path: 2 processes, each feeding its
         local dp shard into one ParallelEngine step over the global mesh;

@@ -4,8 +4,13 @@ test_imperative_resnet.py, book/ e2e tests)."""
 import unittest
 
 import numpy as np
+import pytest
 
 import paddle1_tpu as paddle
+
+# tier-1 wall-time: the heaviest zoo builds/convergence cases are
+# slow-marked (they ride the CI heavy-model step); the in-tier set keeps
+# one forward per family (resnet18) + bert so the zoo stays covered.
 
 
 class TestVisionModels(unittest.TestCase):
@@ -20,11 +25,13 @@ class TestVisionModels(unittest.TestCase):
         y = self._fwd(resnet18(num_classes=10), 64)
         self.assertEqual(y.shape, [2, 10])
 
+    @pytest.mark.slow  # ~12s build; resnet18_forward covers the family
     def test_resnet50_forward(self):
         from paddle1_tpu.vision.models import resnet50
         y = self._fwd(resnet50(num_classes=10), 64)
         self.assertEqual(y.shape, [2, 10])
 
+    @pytest.mark.slow  # ~60s (two full builds + forwards); CI heavy step
     def test_mobilenets(self):
         from paddle1_tpu.vision.models import mobilenet_v1, mobilenet_v2
         self.assertEqual(self._fwd(mobilenet_v1(num_classes=7), 64).shape,
@@ -32,6 +39,8 @@ class TestVisionModels(unittest.TestCase):
         self.assertEqual(self._fwd(mobilenet_v2(num_classes=7), 64).shape,
                          [2, 7])
 
+    @pytest.mark.slow  # ~28s; eager train-step mechanics are covered by
+    # test_training_e2e's in-tier cases and the engine suites
     def test_resnet_train_step(self):
         from paddle1_tpu.vision.models import resnet18
         m = resnet18(num_classes=4)
@@ -51,6 +60,8 @@ class TestVisionModels(unittest.TestCase):
 
 
 class TestYolo(unittest.TestCase):
+    @pytest.mark.slow  # ~68s, the single heaviest in-tier test; the
+    # yolo_loss op parity cases in test_api_parity stay in-tier
     def test_forward_postprocess_loss_grad(self):
         from paddle1_tpu.vision.models import YOLOv3, yolov3_loss
         m = YOLOv3(num_classes=4)

@@ -3,6 +3,7 @@
 import unittest
 
 import numpy as np
+import pytest
 
 import paddle1_tpu as paddle
 from paddle1_tpu.quantization import QAT, PTQ, fake_quant
@@ -23,6 +24,8 @@ class TestQuant(unittest.TestCase):
         # inside range → grad 1; clipped (|x|>scale) → grad 0
         np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0], atol=1e-6)
 
+    @pytest.mark.slow  # ~21s train soak; layer-swap + fake-quant math
+    # stay covered in-tier by the ptq/fake_quant cases (CI heavy step)
     def test_qat_swaps_and_trains(self):
         from paddle1_tpu.vision.models import LeNet
         m = LeNet()

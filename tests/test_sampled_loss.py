@@ -84,6 +84,8 @@ class TestNCE:
                                    rtol=2e-4, atol=2e-5)
         assert float(np.asarray(cost.numpy())[3, 0]) == 0.0
 
+    @pytest.mark.slow  # ~22s convergence soak; the NCE cost-parity
+    # cases above stay in-tier (CI heavy step)
     def test_trains_word2vec_style(self):
         """The defining use: large-vocab binary logistic training —
         loss decreases and the gradient reaches input and weight."""

@@ -6,6 +6,7 @@ import os
 import tempfile
 
 import numpy as np
+import pytest
 
 import paddle1_tpu as paddle
 from paddle1_tpu import nn
@@ -30,6 +31,9 @@ class SyntheticMNIST(Dataset):
         return len(self.labels)
 
 
+@pytest.mark.slow  # ~28s convergence soak (CI heavy step); the fit/
+# engine mechanics stay covered in-tier by test_hapi_model_fit and the
+# parallel-engine suites
 def test_lenet_learns():
     paddle.seed(0)
     net = paddle.vision.models.LeNet()
