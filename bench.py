@@ -863,17 +863,26 @@ def bench_loader_chaos(on_tpu, steps_override=None):
 
     tmp = tempfile.mkdtemp(prefix="p1t_loaderchaos_")
     try:
-        t0 = time.perf_counter()
         # corrupt fires on worker 1's 5th sample fetch (an early batch,
         # safely BELOW the first checkpoint so the preemption rollback
         # can never replay it); the kill hits worker 0 mid-epoch; the
         # preemption lands a few steps past a mid-run checkpoint commit
         spec = (f"corrupt_sample@5:1,loader_worker_kill@4:0,"
                 f"preempt@{steps - 3}")
-        faulted, report, fdl = run("faulted", tmp, (), spec)
-        quarantined = [rec["index"] for rec in fdl.quarantine]
-        clean, clean_report, cdl = run("clean", tmp, quarantined, "")
-        dt = time.perf_counter() - t0
+
+        def soak():
+            faulted, report, fdl = run("faulted", tmp, (), spec)
+            quarantined = [rec["index"] for rec in fdl.quarantine]
+            clean, clean_report, cdl = run("clean", tmp, quarantined, "")
+            return faulted, report, fdl, quarantined, clean, cdl
+
+        from bench_utils import best_of
+        # n=1: this soak's gate is recovery PARITY, not speed — best_of
+        # is the shared timing plumbing (and the knob to repeat the
+        # whole faulted+clean pair when diagnosing a flake)
+        (bo,) = best_of(1, soak)
+        faulted, report, fdl, quarantined, clean, cdl = bo.best_result
+        dt = bo.best_s
 
         max_err = max(float(np.max(np.abs(clean[k] - faulted[k])))
                       for k in clean)
@@ -920,16 +929,18 @@ def bench_serving(on_tpu, steps_override=None):
     through the bucketed engine (each request pays a full dispatch +
     readback), once through the Server's Batcher at ``max_batch`` 16 —
     and reports batched QPS. The two phases are INTERLEAVED for
-    ``repeats`` rounds and the fastest run of each is scored: the gate
-    compares serving designs, and on a shared box multi-ms scheduler
-    stalls arrive in bursts (observed: an 86ms stall inside one 0.4ms
-    dispatch, and whole seconds-long slow windows) — interleaving makes
-    both phases sample the same noise windows, and best-of-N dodges the
-    bursts. ``vs_baseline`` is speedup/3.0: the acceptance gate asserts
-    batched >= 3x sequential at batch 16 on CPU, batched outputs ==
-    sequential outputs to 1e-6 on EVERY round, and exactly one compile
-    per shape bucket (the engine's trace counters)."""
+    ``repeats`` rounds via ``bench_utils.best_of`` and the fastest run
+    of each is scored: the gate compares serving designs, and on a
+    shared box multi-ms scheduler stalls arrive in bursts (observed: an
+    86ms stall inside one 0.4ms dispatch, and whole seconds-long slow
+    windows) — interleaving makes both phases sample the same noise
+    windows, and best-of-N dodges the bursts. ``vs_baseline`` is
+    speedup/3.0: the acceptance gate asserts batched >= 3x sequential
+    at batch 16 on CPU, batched outputs == sequential outputs to 1e-6
+    on EVERY round, and exactly one compile per shape bucket (the
+    engine's trace counters)."""
     import paddle1_tpu as paddle
+    from bench_utils import SelfTimed, best_of
     from paddle1_tpu.serving import InferenceEngine, Server
 
     n_req = steps_override or 256
@@ -963,38 +974,44 @@ def bench_serving(on_tpu, steps_override=None):
     reqs = [rng.standard_normal((1, 512)).astype(np.float32)
             for _ in range(n_req)]
 
-    rounds = []  # (t_seq, t_bat) pairs
-    max_err = 0.0
-    for _ in range(repeats):
-        # sequential: one dispatch + one readback per request
-        t0 = time.perf_counter()
-        seq_out = [engine.infer([r])[0] for r in reqs]
-        t_seq = time.perf_counter() - t0
+    state = {}
 
+    def seq_phase():
+        # sequential: one dispatch + one readback per request (the
+        # whole call is the critical section — plain external timing)
+        return [engine.infer([r])[0] for r in reqs]
+
+    def bat_phase():
         # batched: the same requests through the micro-batcher (a fresh
         # Server per round — its metrics/drain report must cover exactly
-        # one pass; the engine and its compiled buckets are shared)
+        # one pass; the engine and its compiled buckets are shared).
+        # SelfTimed: construction/drain are per-round setup, the timed
+        # section is submit -> result, matching the sequential phase.
         srv = Server(engine, max_batch=max_batch, batch_timeout_ms=50,
                      queue_depth=n_req + max_batch)
         srv.start()
         t0 = time.perf_counter()
         futs = [srv.submit(r) for r in reqs]
         bat_out = [f.result(timeout=120) for f in futs]
-        t_bat = time.perf_counter() - t0
-        report = srv.drain()
-        rounds.append((t_seq, t_bat))
-        max_err = max(max_err,
-                      max(float(np.max(np.abs(s - b)))
-                          for s, b in zip(seq_out, bat_out)))
-        if report["unaccounted"]:
-            break  # fail below with this round's report
+        dt = time.perf_counter() - t0
+        state["srv"] = srv
+        return SelfTimed(dt, (bat_out, srv.drain()))
 
     # best-of-N per phase, exactly as the docstring sells it: stalls on
     # this box arrive in bursts, so the fastest round of each phase is
     # the serving-design signal and anything slower is scheduler noise
-    t_seq = min(ts for ts, _ in rounds)
-    t_bat = min(tb for _, tb in rounds)
+    seq_bo, bat_bo = best_of(repeats, seq_phase, bat_phase)
+    max_err = max(
+        float(np.max(np.abs(s - b)))
+        for seq_out, (bat_out, _) in zip(seq_bo.results, bat_bo.results)
+        for s, b in zip(seq_out, bat_out))
+    # accounting must hold on EVERY round, not just the fastest
+    report = next((rep for _, rep in bat_bo.results
+                   if rep["unaccounted"]), bat_bo.results[-1][1])
+    t_seq = seq_bo.best_s
+    t_bat = bat_bo.best_s
     speedup = t_seq / t_bat
+    srv = state["srv"]
     occupancy = srv.metrics.histogram("batch_occupancy").summary()
     detail = {"requests": n_req, "max_batch": max_batch,
               "seq_qps": round(n_req / t_seq, 1),
@@ -1019,6 +1036,208 @@ def bench_serving(on_tpu, steps_override=None):
         raise AssertionError(
             f"serving gate failed (need speedup>=3x, parity<=1e-6, one "
             f"compile per bucket, zero drops): {json.dumps(detail)}")
+
+
+_FLEET_FACTORY = '''
+"""bench --serving-fleet replica model: a deterministic MLP whose
+weights are a pure function of the seed, so every replica process —
+and the in-process reference engines — build bit-identical versions.
+arg "v2" scales the output (a real model change the version-tag parity
+check can see); arg "boom" raises (the failed-canary artifact)."""
+
+
+def make_model(arg):
+    import numpy as np
+    import jax.numpy as jnp
+    if arg == "boom":
+        raise RuntimeError("broken artifact (failed-canary bench case)")
+    rng = np.random.default_rng(0)
+    W1 = (rng.standard_normal((32, 64)) * 0.1).astype(np.float32)
+    b1 = np.zeros(64, np.float32)
+    W2 = (rng.standard_normal((64, 8)) * 0.1).astype(np.float32)
+    b2 = np.zeros(8, np.float32)
+    scale = 2.0 if arg == "v2" else 1.0
+
+    def fwd(x):
+        h = jnp.maximum(x @ W1 + b1, 0)
+        return (h @ W2 + b2) * scale
+    return fwd
+'''
+
+
+def bench_serving_fleet(on_tpu, steps_override=None):
+    """``--serving-fleet``: chaos soak of the multi-replica HA layer.
+
+    Three replica Server subprocesses under the fleet's Supervisor,
+    then the ISSUE 7 acceptance matrix in one run:
+
+    * **kill failover** — ``replica_kill`` SIGKILLs replica 1 mid-soak;
+      every accepted request still resolves *successfully* (the
+      failover retries absorb the kill — zero client-visible failures,
+      typed or not), and the Supervisor relaunches the rank.
+    * **hot-swap under load** — a mid-soak ``deploy`` to model version
+      v2 (canary + rolling swap) drops zero requests; every response is
+      checked against the single-process InferenceEngine of the version
+      its tag names, at 1e-6 — both populations of the mixed-version
+      window verify.
+    * **failed canary** — deploying a broken artifact raises typed
+      DeployFailed, rolls back, and the fleet keeps serving.
+    * **accounting** — the drain report proves unaccounted == 0 across
+      the kill, the failovers, and the swap.
+
+    ``vs_baseline`` is 1.0 iff every gate holds; the metric is fleet
+    QPS (best-of-2 via ``bench_utils.best_of`` — shared-box noise
+    policy)."""
+    import importlib.util
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from bench_utils import best_of
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.serving import (DeployFailed, InferenceEngine,
+                                     ServingFleet)
+
+    n_req = steps_override or 300
+    if n_req < 60:
+        raise SystemExit(
+            f"--serving-fleet needs --steps >= 60 (got {n_req}): the "
+            "replica_kill lands on replica 1's 10th request and must "
+            "hit while the soak is still in flight")
+    tmp = tempfile.mkdtemp(prefix="p1t_fleetbench_")
+    try:
+        factory = os.path.join(tmp, "factory.py")
+        with open(factory, "w") as f:
+            f.write(_FLEET_FACTORY)
+
+        # in-process reference engines: the acceptance wording is
+        # "outputs match their single-process engines at 1e-6"
+        spec = importlib.util.spec_from_file_location("_fleet_fac",
+                                                      factory)
+        fac = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fac)
+        refs = {"v1": InferenceEngine(fac.make_model("v1"),
+                                      buckets=(1, 8)),
+                "v2": InferenceEngine(fac.make_model("v2"),
+                                      buckets=(1, 8))}
+        rng = np.random.default_rng(0)
+        reqs = [rng.standard_normal((1, 32)).astype(np.float32)
+                for _ in range(n_req)]
+        expected = {v: [e.infer([x])[0] for x in reqs]
+                    for v, e in refs.items()}
+
+        chaos.reset()
+        chaos.configure("replica_kill@10:1")  # replica 1's 10th request
+        fleet = ServingFleet(
+            f"{factory}:make_model", replicas=3, version="v1",
+            model_arg="v1", max_batch=8, buckets=(1, 8),
+            batch_timeout_ms=2, input_specs=[((32,), "float32")],
+            warmup=True, retry_max=3, hang_timeout=30.0, poll_s=0.1,
+            replica_timeout_ms=60000,
+            # small in-flight cap: the burst must spread across all 3
+            # replicas so the rank-qualified kill deterministically
+            # sees replica 1's 10th request
+            inflight_per_replica=8,
+            env={"JAX_PLATFORMS": "cpu"},
+            work_dir=os.path.join(tmp, "fleet"))
+        fleet.start()
+
+        def check(i, fut, out):
+            ref = expected[fut.version][i]
+            return float(np.max(np.abs(ref - out)))
+
+        # phase 1: kill soak — the burst keeps all 3 replicas loaded
+        # while the armed kill fires on replica 1
+        futs = [fleet.submit(x) for x in reqs]
+        outs = [f.result(timeout=300) for f in futs]
+        kill_err = max(check(i, f, o)
+                       for i, (f, o) in enumerate(zip(futs, outs)))
+
+        # phase 2: steady-state throughput metric (best-of-2)
+        def pump():
+            fs = [fleet.submit(x) for x in reqs]
+            return [f.result(timeout=300) for f in fs]
+        (qps_bo,) = best_of(2, pump)
+        qps = n_req / qps_bo.best_s
+
+        # phase 3: hot-swap under load, mixed-version parity
+        stop = threading.Event()
+        swap: dict = {"pairs": [], "failures": []}
+
+        def bg_pump():
+            i = 0
+            while not stop.is_set():
+                i = (i + 1) % n_req
+                try:
+                    fut = fleet.submit(reqs[i])
+                    out = fut.result(timeout=300)
+                    swap["pairs"].append((i, fut, out))
+                except Exception as e:  # noqa: broad-except — ANY
+                    # failure during the swap (typed or not) fails the
+                    # zero-drops gate below
+                    swap["failures"].append(repr(e))
+        bg = threading.Thread(target=bg_pump)
+        bg.start()
+        fleet.deploy(f"{factory}:make_model", "v2", model_arg="v2",
+                     canary=[np.zeros((1, 32), np.float32)])
+        stop.set()
+        bg.join(timeout=300)
+        swap_err = max((check(i, f, o) for i, f, o in swap["pairs"]),
+                       default=0.0)
+        swap_versions = sorted({f.version for _, f, _ in swap["pairs"]})
+        post = fleet.submit(reqs[0])
+        post_out = post.result(timeout=300)
+        post_v2 = (post.version == "v2"
+                   and check(0, post, post_out) <= 1e-6)
+
+        # phase 4: failed canary rolls back, fleet still serving
+        canary_failed = False
+        try:
+            fleet.deploy(f"{factory}:make_model", "v3",
+                         model_arg="boom", ready_timeout_s=60)
+        except DeployFailed:
+            canary_failed = True
+        still = fleet.submit(reqs[1])
+        still_ok = (float(np.max(np.abs(
+            expected["v2"][1] - still.result(timeout=300)))) <= 1e-6)
+
+        report = fleet.drain()
+        detail = {
+            "requests": n_req, "replicas": 3,
+            "fleet_qps": round(qps, 1),
+            "kill_max_err": kill_err,
+            "swap_max_err": swap_err,
+            "swap_requests": len(swap["pairs"]),
+            "swap_failures": swap["failures"][:3],
+            "swap_versions": swap_versions,
+            "post_swap_v2": post_v2,
+            "canary_failed_typed": canary_failed,
+            "serving_after_rollback": still_ok,
+            "restarts": report["replica_restarts"],
+            "retries": report["retries"],
+            "failovers": report["failovers"],
+            "rollbacks": report["rollbacks"],
+            "unaccounted": report["unaccounted"],
+            "accepted": report["accepted"],
+            "completed": report["completed"],
+        }
+        ok = (report["unaccounted"] == 0
+              and report["replica_restarts"] >= 1
+              and kill_err <= 1e-6 and swap_err <= 1e-6
+              and not swap["failures"]
+              and len(swap["pairs"]) >= 1
+              and post_v2 and canary_failed and still_ok
+              and report["errors"] == 0
+              and report["rollbacks"] == 1)
+        _emit("serving_fleet_qps", qps, "req/s",
+              1.0 if ok else 0.0, detail)
+        if not ok:
+            raise AssertionError(
+                f"serving-fleet gate failed: {json.dumps(detail)}")
+    finally:
+        chaos.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main():
@@ -1054,6 +1273,15 @@ def main():
                          "params match the uninterrupted fixed-global-"
                          "batch run to 1e-6 with exactly-once sample "
                          "accounting across the resize")
+    ap.add_argument("--serving-fleet", dest="serving_fleet",
+                    action="store_true",
+                    help="multi-replica HA soak: 3 supervised replicas "
+                         "under load through a replica_kill failover, "
+                         "a mid-soak hot-swap to a second model "
+                         "version (per-version parity 1e-6 vs the "
+                         "single-process engines), and a failed-canary "
+                         "rollback; vs_baseline is 1.0 iff zero "
+                         "client-visible failures and unaccounted==0")
     ap.add_argument("--serving", action="store_true",
                     help="dynamic micro-batching soak: serve N requests "
                          "sequentially and through the Batcher at batch "
@@ -1092,6 +1320,8 @@ def main():
         bench_elastic_soak(on_tpu, steps_override=args.steps)
     elif args.elastic_resize:
         bench_elastic_resize(on_tpu, steps_override=args.steps)
+    elif args.serving_fleet:
+        bench_serving_fleet(on_tpu, steps_override=args.steps)
     elif args.serving:
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.chaos:

@@ -90,6 +90,26 @@ bookkeeping — the loader performs the kill/sleep/raise):
                        task/batch; the loader sleeps
                        ``loader_chaos_stall_s`` (a wedged reader the
                        input-stall watchdog must catch).
+
+Serving-replica points (checked by :func:`check_replica` from the
+replica worker's request loop — one shared counter per replica process,
+qualifier = the replica's fleet rank; armed in the replica PROCESS via
+the spec the fleet forwards at spawn, and only in incarnation 0 so a
+supervisor-restarted replica replays clean):
+
+``replica_kill``     — SIGKILL self mid-request (an OOM-killed serving
+                       worker; the fleet must fail over its in-flight
+                       requests to a healthy replica and the Supervisor
+                       must relaunch it).
+``replica_hang``     — stop reading the fleet connection and block
+                       forever (a wedged RPC plane; the fleet's
+                       per-request transport timeout + circuit breaker
+                       is the detector — the replica's Batcher keeps
+                       heartbeating, so Popen/heartbeat watching alone
+                       would never notice).
+``replica_slow``     — handle this request only after sleeping
+                       ``serve_chaos_slow_s`` (a hiccuping replica —
+                       drives the adaptive-admission overload path).
 """
 
 from __future__ import annotations
@@ -104,10 +124,12 @@ __all__ = [
     "maybe_poison", "check_checkpoint_write", "check_loader",
     "check_preempt", "check_serve_slow", "check_worker",
     "check_sample", "check_loader_worker_kill", "check_loader_stall",
+    "check_replica",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
     "LOADER_WORKER_KILL", "CORRUPT_SAMPLE", "LOADER_STALL",
+    "REPLICA_KILL", "REPLICA_HANG", "REPLICA_SLOW",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -121,12 +143,17 @@ WORKER_UNHEALTHY = "worker_unhealthy"
 LOADER_WORKER_KILL = "loader_worker_kill"
 CORRUPT_SAMPLE = "corrupt_sample"
 LOADER_STALL = "loader_stall"
+REPLICA_KILL = "replica_kill"
+REPLICA_HANG = "replica_hang"
+REPLICA_SLOW = "replica_slow"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 # loader points share the worker points' ":qualifier" grammar, but the
 # qualifier is a LOADER worker id, not a trainer rank
 _LOADER_POINTS = (LOADER_WORKER_KILL, CORRUPT_SAMPLE, LOADER_STALL)
-_QUALIFIED_POINTS = _WORKER_POINTS + _LOADER_POINTS
+# serving-replica points: the qualifier is the REPLICA rank in its fleet
+_REPLICA_POINTS = (REPLICA_KILL, REPLICA_HANG, REPLICA_SLOW)
+_QUALIFIED_POINTS = _WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
            PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
@@ -353,6 +380,28 @@ def check_worker(rank: int) -> Optional[str]:
         n = _counters.get("worker_beat", 0) + 1
         _counters["worker_beat"] = n
         for point in (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY):
+            armed = _armed_worker.get(point, ())
+            if (n, None) in armed or (n, rank) in armed:
+                return point
+    return None
+
+
+def check_replica(rank: int) -> Optional[str]:
+    """Serving-replica points, evaluated once per inference request the
+    replica worker ``rank`` handles. The three points share one request
+    counter (an entry ``point@N:R`` reads "on the Nth request of
+    replica R"; without ``:R`` any replica's Nth request matches), and
+    priority is ``REPLICA_KILL`` > ``REPLICA_HANG`` > ``REPLICA_SLOW``
+    when several arm the same request. The *action* (SIGKILL self /
+    stop reading / sleep ``serve_chaos_slow_s``) is performed by
+    ``serving.replica`` — this stays pure bookkeeping, like the
+    worker points."""
+    if not _armed_worker:
+        return None
+    with _lock:
+        n = _counters.get("replica_req", 0) + 1
+        _counters["replica_req"] = n
+        for point in _REPLICA_POINTS:
             armed = _armed_worker.get(point, ())
             if (n, None) in armed or (n, rank) in armed:
                 return point

@@ -450,9 +450,63 @@ def _define_builtin_flags() -> None:
                 validator=lambda v: v >= 0)
     define_flag("serve_chaos_slow_s", 0.25,
                 "How long the serve_slow_step chaos point stalls one "
-                "micro-batch dispatch (tests drive the deadline/shed "
-                "path with it).",
+                "micro-batch dispatch — and the replica_slow point one "
+                "replica request (tests drive the deadline/shed and "
+                "overload-degradation paths with it).",
                 validator=lambda v: v >= 0)
+    # Serving fleet (consumed by paddle1_tpu.serving.fleet — the
+    # multi-replica HA layer over the Server; MIGRATING.md maps the
+    # reference Paddle Serving replica/timeout/retry knobs onto these)
+    define_flag("serve_replicas", 2,
+                "How many replica Server subprocesses a ServingFleet "
+                "runs (the reference Paddle Serving '--replica num' "
+                "analog). Each replica is a Supervisor-managed worker: "
+                "heartbeats, hang detection, restart budgets.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_retry_max", 2,
+                "How many times the fleet re-dispatches one request "
+                "onto a different replica after the one holding it "
+                "died or wedged (idempotent pure-forward inference "
+                "makes the retry safe); exhausting the budget fails "
+                "the request with typed ReplicaFailed.",
+                validator=lambda v: v >= 0)
+    define_flag("serve_replica_timeout_ms", 30000.0,
+                "Fleet-side per-request transport deadline: a request "
+                "in flight on one replica longer than this is treated "
+                "as a wedged replica (circuit-break, restart, retry "
+                "elsewhere) — the detector for replicas that hang "
+                "while their heartbeat keeps beating.",
+                validator=lambda v: v > 0)
+    define_flag("serve_breaker_failures", 3,
+                "Consecutive unexpected failures (transport timeouts, "
+                "engine errors — not client-typed deadlines/sheds) "
+                "that trip one replica's circuit breaker: the replica "
+                "is drained out of rotation and relaunched.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_fleet_queue_depth", 512,
+                "Bound on fleet-queued (admitted, not yet sent to a "
+                "replica) requests; beyond it submissions shed with "
+                "ServerOverloaded, and the adaptive-admission EWMA "
+                "is measured against it.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_shed_start", 0.5,
+                "Queue-depth EWMA fraction (of serve_fleet_queue_depth) "
+                "where adaptive admission starts shedding: overload "
+                "ramps 0→1 between this fraction and a full queue, "
+                "progressively shedding lowest-priority/longest-"
+                "deadline work first so admitted p99 stays bounded.",
+                validator=lambda v: 0 < v < 1)
+    define_flag("serve_priority_levels", 4,
+                "Priority classes for fleet admission (0 = highest, "
+                "never adaptively shed; levels-1 = lowest, shed "
+                "first under overload).",
+                validator=lambda v: v >= 2)
+    define_flag("serve_ready_timeout_s", 120.0,
+                "How long the fleet waits for a (re)spawned replica to "
+                "publish its endpoint and pass the ready handshake "
+                "(covers import + per-bucket XLA warmup) before "
+                "treating the launch — or a deploy canary — as failed.",
+                validator=lambda v: v > 0)
     # IO formats
     define_flag("io_load_pickle", False,
                 "Allow fluid.io load_* to read LEGACY pickle payloads. "

@@ -54,8 +54,8 @@ try:  # standalone import (tests load this file directly) lacks a package
 except ImportError:  # pragma: no cover - only hit outside the package
     _chaos = None
 
-__all__ = ["beat", "supervised", "report_unhealthy", "request_drain",
-           "drain_requested", "add_drain_callback",
+__all__ = ["beat", "supervised", "incarnation", "report_unhealthy",
+           "request_drain", "drain_requested", "add_drain_callback",
            "remove_drain_callback", "reset",
            "HEARTBEAT_ENV", "STACKDUMP_ENV", "INCARNATION_ENV",
            "UNHEALTHY_SUFFIX"]
@@ -151,6 +151,17 @@ def supervised() -> bool:
         if not _installed:
             _install_from_env()
         return _hb_file is not None
+
+
+def incarnation() -> int:
+    """This worker's restart incarnation under its Supervisor (0 for
+    the first launch, +1 per relaunch; 0 when unsupervised). Chaos
+    arming gates on it: worker/replica points fire in incarnation 0
+    only, so a restarted life replays clean."""
+    with _lock:
+        if not _installed:
+            _install_from_env()
+        return _incarnation
 
 
 def beat() -> None:

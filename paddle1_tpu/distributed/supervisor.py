@@ -70,6 +70,22 @@ legacy ``watch_local_trainers`` / ``watch_ps_procs`` surfaces — and
 :class:`MpProcessHandle` — run on the same loop; adopted workers have
 no respawn spec, so ``restart``/``resize`` fall back to ``fail_fast``
 for them.
+
+Non-trainer adoption (the serving fleet): :meth:`run`'s loop is shaped
+around a *job that finishes* — every trainer exits 0 and the pod is
+done. Long-lived worker pools (serving replicas) instead EMBED the
+supervisor: register respawnable workers, then call
+:meth:`supervise_once` from their own loop — one detection sweep that
+applies the per-rank ``restart`` policy (heartbeat hang detection,
+stack dumps, restart budgets, all identical to the trainer path) but
+never decides the pod is finished or failed; it returns
+:class:`SupervisionEvent` records and the embedding owner
+(``serving.fleet.ServingFleet``) decides what a permanent failure
+means. :meth:`spawn_worker` / :meth:`restart_rank` / :meth:`retire`
+give that owner explicit lifecycle control (a model hot-swap retires
+old replicas and spawns new ones mid-flight), and per-worker
+``max_restarts`` overrides let a deploy canary run with a zero budget
+while the standing fleet keeps the full one.
 """
 
 from __future__ import annotations
@@ -79,6 +95,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -89,7 +106,7 @@ from ..core.health import (HEARTBEAT_ENV, INCARNATION_ENV, STACKDUMP_ENV,
                            UNHEALTHY_SUFFIX)
 
 __all__ = ["Supervisor", "SupervisorReport", "WorkerFailure",
-           "MpProcessHandle", "POLICIES"]
+           "SupervisionEvent", "MpProcessHandle", "POLICIES"]
 
 POLICIES = ("fail_fast", "restart", "drain", "resize")
 
@@ -111,6 +128,24 @@ class WorkerFailure:
     # reported with exit_code 1 but raw_exit 0 — the run loop forgives
     # it when the trainers finished in the same sweep)
     raw_exit: Optional[int] = None
+
+
+@dataclass
+class SupervisionEvent:
+    """One :meth:`Supervisor.supervise_once` outcome: what was detected
+    and what the sweep did about it."""
+    failure: WorkerFailure
+    # "restarted"         — restart policy relaunched the rank in place
+    # "restart_exhausted" — out of budget; the rank stays down (and a
+    #                       wedged-but-alive process was SIGKILLed) —
+    #                       the embedding owner decides what that means
+    # "detected"          — no automatic response applies (policy is not
+    #                       restart, or the worker has no respawn spec)
+    action: str = "detected"
+
+    @property
+    def rank(self) -> int:
+        return self.failure.rank
 
 
 @dataclass
@@ -183,7 +218,8 @@ class _Worker:
     def __init__(self, rank: int, cmd: Optional[List[str]] = None,
                  env: Optional[dict] = None,
                  log_path: Optional[str] = None, role: str = "trainer",
-                 essential: bool = False, proc=None):
+                 essential: bool = False, proc=None,
+                 max_restarts: Optional[int] = None):
         self.rank = rank
         self.cmd = list(cmd) if cmd is not None else None
         # base_env is the REGISTERED env; env is what the next spawn
@@ -200,6 +236,13 @@ class _Worker:
         self.hb_spawn_mtime: Optional[float] = None
         self.dump_path: Optional[str] = None
         self.done = False            # exited 0 (role-complete)
+        # a permanent failure supervise_once already reported: the
+        # corpse must not re-classify (and re-report) every sweep
+        self.abandoned = False
+        # per-worker restart-budget override (None -> the supervisor's
+        # max_restarts); a deploy canary runs with 0 while the standing
+        # fleet keeps the full budget
+        self.max_restarts = max_restarts
         self.log_fh = None
 
     @property
@@ -313,6 +356,11 @@ class Supervisor:
         self._elastic_override = elastic
         self._procs_track_world = True
         self._workers: Dict[int, _Worker] = {}
+        # serializes worker-table mutation against the embedding
+        # surface: a fleet's deploy thread (add_worker/retire/spawn)
+        # runs concurrently with its sweep thread (supervise_once) —
+        # run()'s single-threaded trainer loop never contends on it
+        self._table_lock = threading.Lock()
         self.report = SupervisorReport(policy=self.policy)
 
     # -- registration -----------------------------------------------------
@@ -320,12 +368,19 @@ class Supervisor:
     def add_worker(self, rank: int, cmd: List[str],
                    env: Optional[dict] = None,
                    log_path: Optional[str] = None, role: str = "trainer",
-                   essential: bool = False) -> int:
-        """Register a respawnable worker (spawned by :meth:`start`)."""
-        if rank in self._workers:
-            raise InvalidArgumentError(f"rank {rank} already registered")
-        self._workers[rank] = _Worker(rank, cmd, env, log_path, role,
-                                      essential)
+                   essential: bool = False,
+                   max_restarts: Optional[int] = None) -> int:
+        """Register a respawnable worker (spawned by :meth:`start` or
+        :meth:`spawn_worker`). ``max_restarts`` overrides the
+        supervisor-wide budget for this rank only (0 = never restart —
+        the deploy-canary setting)."""
+        with self._table_lock:
+            if rank in self._workers:
+                raise InvalidArgumentError(
+                    f"rank {rank} already registered")
+            self._workers[rank] = _Worker(rank, cmd, env, log_path,
+                                          role, essential,
+                                          max_restarts=max_restarts)
         return rank
 
     def attach(self, rank: int, proc, role: str = "trainer",
@@ -411,7 +466,7 @@ class Supervisor:
 
     def _classify(self, w: _Worker) -> Optional[WorkerFailure]:
         """One poll of one worker; None when healthy (or already done)."""
-        if w.done or w.proc is None:
+        if w.done or w.abandoned or w.proc is None:
             return None
         ret = w.proc.poll()
         if ret is not None:
@@ -458,6 +513,142 @@ class Supervisor:
             if f is not None:
                 out.append(f)
         return out
+
+    # -- embedding surface (non-trainer worker pools) ---------------------
+
+    def supervise_once(self) -> List[SupervisionEvent]:
+        """One detection **and response** sweep for an embedding caller
+        (a serving fleet supervising long-lived replicas): classify
+        every worker, record each failure (hang stack dumps, unhealthy
+        markers — the trainer path's bookkeeping), apply the per-rank
+        ``restart`` policy where it applies, and return what happened.
+        Unlike :meth:`run` this never terminates the pod: a permanent
+        failure is an event (``restart_exhausted`` / ``detected``), and
+        the owner decides what it means. Clean exits of non-essential
+        workers just mark the rank done (see :meth:`worker_done`)."""
+        with self._table_lock:
+            workers = list(self._workers.values())
+        events = []
+        for w in workers:
+            f = self._classify(w)
+            if f is None:
+                continue
+            self._record_failure(w, f)
+            if self.policy == "restart" and w.respawnable \
+                    and not w.essential:
+                if self._restart_worker(w):
+                    events.append(SupervisionEvent(f, "restarted"))
+                    continue
+                # out of budget: a wedged-but-alive process (hang /
+                # unhealthy) must still be put down — the owner's
+                # replacement decision starts from a dead rank, and a
+                # half-alive one would keep holding its sockets
+                self._kill_worker(w, signal.SIGKILL)
+                w.abandoned = True
+                events.append(SupervisionEvent(f, "restart_exhausted"))
+            else:
+                if f.kind == EXIT:
+                    w.abandoned = True  # the corpse re-reports otherwise
+                events.append(SupervisionEvent(f, "detected"))
+        return events
+
+    def _get_worker(self, rank: int) -> _Worker:
+        """Typed lookup for the embedding accessors: an unknown (e.g.
+        already-retired) rank raises the module's InvalidArgumentError,
+        not a raw KeyError — a fleet sweep racing a deploy's retire()
+        must get a catchable, documented condition."""
+        with self._table_lock:
+            w = self._workers.get(rank)
+        if w is None:
+            raise InvalidArgumentError(
+                f"rank {rank} is not registered (retired, or never "
+                "added)")
+        return w
+
+    def spawn_worker(self, rank: int) -> None:
+        """Spawn one registered, not-yet-running worker (a deploy adds
+        a replica mid-flight and must not touch the rest of the pod the
+        way :meth:`start` would)."""
+        w = self._get_worker(rank)
+        if w.proc is not None and w.proc.poll() is None:
+            raise InvalidArgumentError(f"rank {rank} is already running")
+        if not w.respawnable:
+            raise InvalidArgumentError(
+                f"rank {rank} has no command to spawn")
+        self._spawn(w)
+
+    def restart_rank(self, rank: int) -> bool:
+        """Kill + relaunch one rank within its budget (the embedding
+        owner's explicit lever — e.g. a fleet whose circuit breaker
+        tripped on a replica the heartbeat still calls healthy). False
+        when out of budget or not respawnable."""
+        return self._restart_worker(self._get_worker(rank))
+
+    def retire(self, rank: int,
+               grace_s: Optional[float] = None) -> Optional[int]:
+        """Deregister one rank for good: SIGTERM (the graceful-drain
+        signal), bounded wait, SIGKILL stragglers, close its log.
+        Returns the exit code (None if it never ran). A hot-swap
+        retires the old replica after the new one took its place — the
+        exit must NOT count as a failure, so the worker leaves the
+        table before any sweep can classify it."""
+        with self._table_lock:
+            w = self._workers.pop(rank, None)
+        if w is None:
+            return None
+        # a sweep that snapshotted the table BEFORE the pop still holds
+        # this object: abandon it first so a straggler SIGKILL during
+        # the grace window below can't be classified as a failure and
+        # respawned into an untracked zombie
+        w.abandoned = True
+        rc = None
+        if w.proc is not None:
+            if w.proc.poll() is None:
+                self._graceful_stop(
+                    [w], self.grace_s if grace_s is None else grace_s,
+                    straggler_note="did not drain on retire — SIGKILL")
+            rc = w.proc.poll()
+        if w.log_fh is not None:
+            try:
+                w.log_fh.close()
+            except OSError:  # pragma: no cover
+                pass
+            w.log_fh = None
+        return rc
+
+    def kill_worker(self, rank: int) -> None:
+        """SIGKILL one rank and abandon it (no relaunch, no further
+        classification) — the embedding owner's terminal put-down for
+        a wedged-but-alive replica whose restart budget is spent (a
+        half-alive process would keep holding its port, memory, and
+        heartbeat file). No-op for an already-retired rank."""
+        with self._table_lock:
+            w = self._workers.get(rank)
+        if w is None:
+            return
+        w.abandoned = True
+        self._kill_worker(w, signal.SIGKILL)
+
+    def incarnation(self, rank: int) -> int:
+        return self._get_worker(rank).incarnation
+
+    def restarts_used(self, rank: int) -> int:
+        return self.report.restarts.get(rank, 0)
+
+    def set_restart_budget(self, rank: int,
+                           max_restarts: Optional[int]) -> None:
+        """Adjust one rank's restart-budget override (None restores the
+        supervisor-wide budget) — a canary promoted into rotation earns
+        the standing fleet's budget."""
+        self._get_worker(rank).max_restarts = max_restarts
+
+    def worker_done(self, rank: int) -> bool:
+        """Whether the rank exited 0 (role-complete)."""
+        w = self._workers.get(rank)
+        return bool(w is not None and w.done)
+
+    def worker_ranks(self) -> List[int]:
+        return sorted(self._workers)
 
     # -- actions ----------------------------------------------------------
 
@@ -539,7 +730,9 @@ class Supervisor:
         False when the rank is out of restart budget or not
         respawnable."""
         used = self.report.restarts.get(w.rank, 0)
-        if not w.respawnable or used >= self.max_restarts:
+        budget = (self.max_restarts if w.max_restarts is None
+                  else w.max_restarts)
+        if not w.respawnable or used >= budget:
             return False
         self._kill_worker(w, signal.SIGKILL)
         try:
@@ -550,7 +743,7 @@ class Supervisor:
         self.report.restarts[w.rank] = used + 1
         self._spawn(w)
         print(f"supervisor: rank {w.rank} relaunched "
-              f"(restart {used + 1}/{self.max_restarts}, "
+              f"(restart {used + 1}/{budget}, "
               f"incarnation {w.incarnation})", file=sys.stderr)
         return True
 

@@ -17,6 +17,13 @@ applied to inference under load:
   (:class:`DeadlineExceeded`), live :class:`ServingMetrics`, and
   graceful SIGTERM drain via ``core/health`` so PR 3's Supervisor
   manages serving workers like training workers.
+* :class:`~paddle1_tpu.serving.fleet.ServingFleet` — the HA layer
+  (ISSUE 7): N replica Servers as Supervisor-managed subprocesses with
+  health-gated routing and at-most-N failover retry
+  (:class:`ReplicaFailed` only when the budget exhausts), zero-downtime
+  rolling model hot-swap with canary rollback (:class:`DeployFailed`),
+  and adaptive admission that sheds lowest-priority/longest-deadline
+  work first under sustained overload.
 
 Quickstart::
 
@@ -36,10 +43,16 @@ Or straight from a deployed artifact::
 
 from .batcher import Batcher, ServeFuture
 from .engine import InferenceEngine, resolve_buckets
-from .errors import DeadlineExceeded, ServerClosed, ServerOverloaded
-from .metrics import Counter, Histogram, ServingMetrics
+from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
+                     ServerClosed, ServerOverloaded)
+from .fleet import AdaptiveAdmission, FleetFuture, ServingFleet
+from .metrics import (Counter, Histogram, MetricsGroup, ServingMetrics,
+                      merge_snapshots)
 from .server import Server
 
 __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
-           "ServingMetrics", "Counter", "Histogram", "ServerOverloaded",
-           "DeadlineExceeded", "ServerClosed", "resolve_buckets"]
+           "ServingMetrics", "Counter", "Histogram", "MetricsGroup",
+           "merge_snapshots", "ServerOverloaded", "DeadlineExceeded",
+           "ServerClosed", "ReplicaFailed", "DeployFailed",
+           "ServingFleet", "FleetFuture", "AdaptiveAdmission",
+           "resolve_buckets"]

@@ -38,7 +38,6 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
-from ..core.errors import ExecutionTimeoutError
 from .errors import DeadlineExceeded
 
 __all__ = ["Batcher", "ServeFuture"]
@@ -141,9 +140,18 @@ class ServeFuture:
 
     def exception(self, timeout: Optional[float] = None
                   ) -> Optional[BaseException]:
+        """Block up to ``timeout`` for resolution and return the
+        request's exception (None on success). A reader timing out on a
+        still-unresolved future — a wedged batch — raises the typed
+        :class:`DeadlineExceeded` instead of waiting forever; the
+        request itself stays in flight and may still resolve (first-
+        wins), so the timeout is purely the READER's deadline and the
+        server's accounting is untouched."""
         if not self._wait(timeout):
-            raise ExecutionTimeoutError(
-                f"serving future not resolved within {timeout}s")
+            raise DeadlineExceeded(
+                f"serving future not resolved within {timeout}s — the "
+                "request is still in flight (a wedged or slow batch); "
+                "it stays accounted and may yet complete")
         return self._exc
 
     def result(self, timeout: Optional[float] = None):

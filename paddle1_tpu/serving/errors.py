@@ -5,21 +5,42 @@ string-matching messages — the load-balancer contract."""
 
 from __future__ import annotations
 
-from ..core.errors import (ExecutionTimeoutError, ResourceExhaustedError,
-                           UnavailableError)
+from ..core.errors import (ExecutionTimeoutError, PreconditionNotMetError,
+                           ResourceExhaustedError, UnavailableError)
 
-__all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed"]
+__all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed",
+           "ReplicaFailed", "DeployFailed"]
 
 
 class ServerOverloaded(ResourceExhaustedError):
-    """Admission control shed the request: the bounded queue is full.
-    Raised synchronously by ``Server.submit`` — nothing was enqueued."""
+    """Admission control shed the request: the bounded queue is full
+    (or, at the fleet front end, adaptive admission shed it under
+    sustained overload). Raised synchronously by ``submit`` — nothing
+    was enqueued."""
 
 
 class DeadlineExceeded(ExecutionTimeoutError):
-    """The request's deadline expired while it was still queued; it was
-    never dispatched. Delivered through the request's future."""
+    """The request's deadline expired while it was still queued (it was
+    never dispatched — delivered through the request's future), or a
+    reader's ``result(timeout=...)`` ran out while the future was still
+    unresolved (the request itself may yet complete; first-wins
+    resolution keeps the accounting straight either way)."""
 
 
 class ServerClosed(UnavailableError):
     """The server is draining or stopped and no longer admits work."""
+
+
+class ReplicaFailed(UnavailableError):
+    """Every failover retry for this request exhausted: the replica
+    holding it died or wedged, and ``serve_retry_max`` re-dispatches
+    onto other replicas failed too (or none were healthy). Delivered
+    through the request's future — the client-visible form of a fleet
+    that genuinely could not serve this request."""
+
+
+class DeployFailed(PreconditionNotMetError):
+    """A model hot-swap's canary replica never became healthy (spawn
+    failure, ready-handshake timeout, or a failed canary inference);
+    the deploy was rolled back and the fleet keeps serving the old
+    version."""

@@ -1,0 +1,1287 @@
+"""Resilient serving fleet: supervised replicas, health-gated routing,
+zero-downtime hot-swap, graceful overload degradation (ISSUE 7).
+
+One :class:`~paddle1_tpu.serving.Server` process is a single point of
+failure: an engine crash, a wedged dispatch, or a model update takes
+the whole service down. :class:`ServingFleet` is the HA layer over it —
+the serving analog of what PR 3's Supervisor + PR 2's ResilientTrainer
+are to training, built FROM those pieces instead of duplicating them:
+
+* **Supervised replicas.** N replica workers
+  (:mod:`paddle1_tpu.serving.replica` subprocesses, each a full Server)
+  run under a :class:`~paddle1_tpu.distributed.supervisor.Supervisor`
+  in ``restart`` policy — heartbeats (the replica's Batcher beats),
+  hang detection with SIGABRT stack dumps, and per-rank restart
+  budgets, all the PR 3 machinery. Serving replicas are *independent*
+  workers (no collectives), exactly the case per-rank restart was built
+  for; the fleet embeds the supervisor via ``supervise_once`` rather
+  than its trainer-shaped ``run`` loop.
+
+* **Health-gated routing.** Requests flow through a shared queue that
+  only *in-rotation* replicas pull from. A replica leaves rotation the
+  moment its transport drops (EOF — it died), a request ages past
+  ``serve_replica_timeout_ms`` in flight (it wedged while its heartbeat
+  kept beating — the hang class Popen-watching can't see), or its
+  consecutive-failure circuit breaker (``serve_breaker_failures``)
+  trips; the survivors absorb the traffic while the Supervisor
+  relaunches it. In-flight requests on a lost replica are re-dispatched
+  onto healthy ones at most ``serve_retry_max`` times — pure-forward
+  inference is idempotent, so the retry is safe — and the typed
+  :class:`~paddle1_tpu.serving.errors.ReplicaFailed` surfaces only when
+  the budget exhausts. Future resolution is FIRST-WINS (the PR 4
+  contract): a late response from a replica we gave up on can't clobber
+  a retry's answer or double-count, and ``drain()`` proves
+  ``unaccounted == 0`` across any number of failovers.
+
+* **Zero-downtime hot-swap.** :meth:`deploy` rolls replicas one at a
+  time: spawn the new version OFF-rotation, let it warm its executable
+  buckets, health-check it (endpoint + ping handshake, plus an optional
+  canary inference), swap it into rotation, then gracefully drain the
+  old replica (SIGTERM → its Server flushes → exit 0 → retired, never a
+  "failure"). The first new replica is the *canary*: if it never comes
+  healthy the deploy aborts with typed
+  :class:`~paddle1_tpu.serving.errors.DeployFailed` and the old fleet
+  keeps serving untouched; a failure later in the roll swaps the
+  already-promoted slots back (rollback). Every response carries its
+  replica's version tag and metrics split per version
+  (:class:`~paddle1_tpu.serving.metrics.MetricsGroup`), so a rolling
+  deploy's two populations never mix.
+
+* **Graceful overload degradation.** Admission is adaptive: a
+  queue-depth EWMA against ``serve_fleet_queue_depth`` ramps an
+  overload level from ``serve_shed_start`` to a full queue, and
+  requests are shed lowest-priority-first (then longest-deadline-first
+  within the marginal class) with the same typed ``ServerOverloaded``
+  — so when a replica is down and capacity halves, p99 for *admitted*
+  traffic stays bounded instead of every request degrading together.
+  Priority 0 is never adaptively shed (only a hard-full queue sheds
+  it).
+
+Quickstart::
+
+    fleet = ServingFleet("models/factory.py:make", replicas=3,
+                         version="v1", max_batch=16,
+                         input_specs=[((512,), "float32")],
+                         warmup=True).start()
+    fut = fleet.submit(x, priority=0)
+    y = fut.result(timeout=30);  fut.version   # "v1"
+    fleet.deploy("models/factory.py:make", "v2", model_arg="v2")
+    report = fleet.drain()                     # unaccounted == 0
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import chaos as core_chaos
+from ..core import flags as core_flags
+from ..core import health as core_health
+from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from . import wire
+from .batcher import ServeFuture
+from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
+                     ServerClosed, ServerOverloaded)
+from .metrics import MetricsGroup, ServingMetrics, merge_snapshots
+
+__all__ = ["ServingFleet", "FleetFuture", "AdaptiveAdmission"]
+
+# replica-reported errors the fleet may transparently re-dispatch
+# elsewhere: the replica never accepted the work (shed/draining), so a
+# retry cannot double-execute anything
+_RETRYABLE_ETYPES = frozenset({"ServerOverloaded", "ServerClosed"})
+# replica-reported errors that are the CLIENT's outcome, not evidence
+# the replica is broken (they must not feed the circuit breaker)
+_CLIENT_ETYPES = frozenset({"DeadlineExceeded", "ServerOverloaded",
+                            "ServerClosed", "InvalidArgumentError"})
+
+
+class FleetFuture(ServeFuture):
+    """Per-request response handle — ServeFuture's first-wins, lazy-
+    event machinery (one concurrency-sensitive implementation, not
+    two) with a direct value payload instead of a batch slice, plus the
+    ``version`` tag of the replica that answered. First-wins matters
+    here doubly: with failover a request can briefly be in flight on
+    two replicas, and whichever answer lands first sticks — the
+    loser's setter returns False so nothing double-counts."""
+
+    __slots__ = ("_outs", "version")
+
+    def __init__(self):
+        super().__init__()
+        self._outs: Optional[List[np.ndarray]] = None
+        self.version: Optional[str] = None
+
+    def _set_value(self, outs: List[np.ndarray],
+                   version: Optional[str]) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._outs = outs
+            self.version = version
+            self._done = True
+            ev = self._event
+        if ev is not None:
+            ev.set()
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        exc = self.exception(timeout)  # reader timeout -> typed
+        if exc is not None:            # DeadlineExceeded (inherited)
+            raise exc
+        outs = self._outs
+        return outs[0] if len(outs) == 1 else outs
+
+
+class AdaptiveAdmission:
+    """Queue-depth-EWMA admission policy (pure logic, directly tested).
+
+    ``observe(qlen)`` feeds the EWMA; :meth:`overload` maps it to a
+    level in [0, 1] ramping from ``shed_start * depth`` (no shedding)
+    to a full queue (shed everything sheddable). :meth:`should_shed`
+    ranks a request's *sacrifice score* — priority dominates (weight
+    0.75), deadline slack breaks ties within the marginal class (a
+    request with a long or absent deadline tolerates a typed shed +
+    client retry better than one that needed an answer now) — and
+    sheds it when the score exceeds ``1 - overload``. Priority 0 is
+    never adaptively shed."""
+
+    _PRIORITY_WEIGHT = 0.75
+
+    def __init__(self, depth: int, shed_start: Optional[float] = None,
+                 levels: Optional[int] = None, alpha: float = 0.2):
+        self.depth = max(1, int(depth))
+        self.shed_start = float(
+            core_flags.flag("serve_shed_start") if shed_start is None
+            else shed_start)
+        self.levels = int(
+            core_flags.flag("serve_priority_levels") if levels is None
+            else levels)
+        self.alpha = float(alpha)
+        self._ewma = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, qlen: int) -> None:
+        with self._lock:
+            self._ewma += self.alpha * (float(qlen) - self._ewma)
+
+    @property
+    def ewma(self) -> float:
+        return self._ewma
+
+    def overload(self) -> float:
+        load = self._ewma / self.depth
+        if load <= self.shed_start:
+            return 0.0
+        return min(1.0, (load - self.shed_start)
+                   / (1.0 - self.shed_start))
+
+    def should_shed(self, priority: int,
+                    deadline_ms: Optional[float],
+                    deadline_scale_ms: float = 30000.0) -> bool:
+        if priority <= 0:
+            return False  # top class: only a hard-full queue sheds it
+        ov = self.overload()
+        if ov <= 0.0:
+            return False
+        prio_rank = min(1.0, priority / max(1, self.levels - 1))
+        dl_rank = (1.0 if deadline_ms is None else
+                   min(1.0, float(deadline_ms)
+                       / max(deadline_scale_ms, 1.0)))
+        score = (self._PRIORITY_WEIGHT * prio_rank
+                 + (1.0 - self._PRIORITY_WEIGHT) * dl_rank)
+        return score > 1.0 - ov
+
+
+class _FleetRequest:
+    __slots__ = ("id", "arrays", "priority", "deadline", "deadline_ms",
+                 "future", "t_enq", "retries", "pinned")
+
+    def __init__(self, rid: int, arrays: List[np.ndarray],
+                 priority: int, deadline_s: Optional[float],
+                 deadline_ms: Optional[float], pinned: bool = False):
+        self.id = rid
+        self.arrays = arrays
+        self.priority = priority
+        self.t_enq = time.monotonic()
+        self.deadline = (self.t_enq + deadline_s
+                         if deadline_s is not None else None)
+        self.deadline_ms = deadline_ms
+        self.future = FleetFuture()
+        self.retries = 0
+        # pinned = must be answered by the replica it was sent to — a
+        # deploy canary re-routed to the standing fleet would "pass"
+        # without the candidate ever answering. Pinned requests fail
+        # typed instead of failing over (still fully accounted).
+        self.pinned = pinned
+
+
+# replica client states
+_STARTING = "starting"    # waiting for endpoint / connect / handshake
+_STANDBY = "standby"      # connected, held out of rotation (probation)
+_READY = "ready"          # in rotation, pulling work
+_DRAINING = "draining"    # hot-swap retire: no new work
+_FAILED = "failed"        # permanent (restart budget exhausted)
+_RETIRED = "retired"      # removed by a deploy
+
+
+class _ReplicaClient:
+    """Fleet-side handle to one replica subprocess: the connection, the
+    in-flight ledger, the circuit breaker, and the puller/receiver
+    threads. State transitions are driven by the puller (connects), the
+    receiver (transport loss), and the fleet's sweep thread
+    (supervisor events, timeouts, breaker restarts)."""
+
+    def __init__(self, fleet: "ServingFleet", rank: int, version: str,
+                 endpoint_path: str, probation: bool = False):
+        self.fleet = fleet
+        self.rank = rank
+        self.version = version
+        self.endpoint_path = endpoint_path
+        self.expected_incarnation = 0
+        self.probation = probation
+        self.state = _STARTING
+        self.conn: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        # id -> (request, t_sent): what this replica owes us
+        self.inflight: Dict[int, Tuple[_FleetRequest, float]] = {}
+        self.consecutive_failures = 0
+        self.needs_restart = False
+        self._recv_gen = 0  # invalidates a stale receiver thread
+        self.puller = threading.Thread(
+            target=self._puller_loop, daemon=True,
+            name=f"p1t-fleet-pull-{rank}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.puller.start()
+
+    def pullable(self) -> bool:
+        return self.state == _READY and self.conn is not None
+
+    def set_state(self, state: str) -> None:
+        with self.cond:
+            self.state = state
+            self.cond.notify_all()
+
+    def wait_connected(self, timeout: float) -> bool:
+        """Block until the handshake completed (states standby/ready)."""
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.state not in (_STANDBY, _READY):
+                if self.state in (_FAILED, _RETIRED):
+                    return False
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self.cond.wait(min(rem, 0.1))
+            return True
+
+    def enter_rotation(self) -> None:
+        self.probation = False
+        self.set_state(_READY)
+        self.fleet._notify_queue()
+
+    # -- connect / handshake ----------------------------------------------
+
+    def _try_connect(self) -> bool:
+        try:
+            with open(self.endpoint_path) as f:
+                ep = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if int(ep.get("incarnation", -1)) != self.expected_incarnation:
+            return False  # stale endpoint from a previous life
+        try:
+            conn = socket.create_connection(
+                ("127.0.0.1", int(ep["port"])), timeout=2.0)
+        except OSError:
+            return False
+        try:
+            conn.settimeout(5.0)
+            wire.send_msg(conn, {"kind": "ping", "id": -1})
+            header, _ = wire.recv_msg(conn)
+            if header.get("kind") != "pong":
+                conn.close()
+                return False
+            self.version = header.get("version", self.version)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+        conn.settimeout(0.25)
+        with self.lock:
+            self.conn = conn
+            self.consecutive_failures = 0
+            self._recv_gen += 1
+            gen = self._recv_gen
+        threading.Thread(target=self._receiver_loop, args=(conn, gen),
+                         daemon=True,
+                         name=f"p1t-fleet-recv-{self.rank}").start()
+        self.set_state(_STANDBY if self.probation else _READY)
+        self.fleet._notify_queue()
+        return True
+
+    # -- puller ------------------------------------------------------------
+
+    def _puller_loop(self) -> None:
+        fleet = self.fleet
+        while not fleet._stop:
+            state = self.state
+            if state == _STARTING:
+                if not self._try_connect():
+                    time.sleep(0.05)
+                continue
+            if state in (_FAILED, _RETIRED):
+                return
+            if state != _READY or self.conn is None:
+                time.sleep(0.02)
+                continue
+            with self.cond:
+                if len(self.inflight) >= fleet.inflight_per_replica:
+                    # window full: wait for a response/loss to open a
+                    # slot (_pop_inflight/_on_transport_loss notify) —
+                    # a 1ms poll here burns CPU for the whole overload
+                    # window, exactly when the host needs it most
+                    self.cond.wait(0.05)
+                    continue
+            req = fleet._next_request()
+            if req is None:
+                continue
+            self._send_request(req)
+
+    def _send_request(self, req: _FleetRequest) -> None:
+        conn = self.conn
+        if conn is None:
+            if req.pinned:  # a canary never re-routes (see _FleetRequest)
+                self.fleet._retry_or_fail(
+                    req, f"replica {self.rank} connection lost")
+                return
+            # lost the connection between popping and sending: the
+            # request never reached a replica, so it goes straight back
+            # to the front of the queue — not a failover, no retry
+            # budget spent
+            with self.fleet._queue_cond:
+                self.fleet._queue.appendleft(req)
+                self.fleet._queue_cond.notify()
+            return
+        now = time.monotonic()
+        remaining_ms = None
+        if req.deadline is not None:
+            remaining_ms = (req.deadline - now) * 1e3
+            if remaining_ms <= 0.0:
+                # expired between the queue pop and here: 0 on the wire
+                # would read as NO deadline on the replica (Server's
+                # falsy-disables contract) — fail it typed instead
+                self.fleet._resolve_deadline(
+                    req, "expired before dispatch")
+                return
+        with self.lock:
+            self.inflight[req.id] = (req, now)
+        try:
+            with self.send_lock:
+                wire.send_msg(conn, {"kind": "infer", "id": req.id,
+                                     "deadline_ms": remaining_ms},
+                              req.arrays)
+        except (OSError, ConnectionError):
+            self._on_transport_loss("send failed")
+
+    # -- receiver ----------------------------------------------------------
+
+    def _receiver_loop(self, conn: socket.socket, gen: int) -> None:
+        fleet = self.fleet
+
+        def idle():
+            if fleet._stop or self._recv_gen != gen:
+                raise ConnectionError("receiver superseded")
+
+        while True:
+            try:
+                header, arrays = wire.recv_msg(conn, idle=idle)
+            except (ConnectionError, OSError):
+                if self._recv_gen == gen and not fleet._stop:
+                    self._on_transport_loss("connection lost")
+                return
+            kind = header.get("kind")
+            if kind == "result":
+                self._on_result(header, arrays)
+            elif kind == "error":
+                self._on_error(header)
+            elif kind in ("pong", "metrics_result"):
+                fleet._resolve_rpc(self, header)
+
+    def _pop_inflight(self, rid) -> Optional[_FleetRequest]:
+        with self.cond:
+            entry = self.inflight.pop(rid, None)
+            if entry is not None:
+                self.cond.notify()  # a window slot opened
+        return entry[0] if entry is not None else None
+
+    def _on_result(self, header, arrays) -> None:
+        req = self._pop_inflight(header.get("id"))
+        with self.lock:
+            self.consecutive_failures = 0
+        if req is not None:
+            self.fleet._resolve_value(req, arrays,
+                                      header.get("version"), self)
+
+    def _on_error(self, header) -> None:
+        req = self._pop_inflight(header.get("id"))
+        if req is None:
+            return
+        etype = header.get("etype", "RuntimeError")
+        msg = header.get("msg", "")
+        if etype in _RETRYABLE_ETYPES:
+            # the replica never accepted it (shed / draining) — safe
+            # to place elsewhere, no evidence this replica is broken
+            self.fleet._retry_or_fail(
+                req, f"replica {self.rank} refused: {etype}: {msg}")
+            return
+        if etype not in _CLIENT_ETYPES:
+            with self.lock:
+                self.consecutive_failures += 1
+                tripped = (self.consecutive_failures
+                           >= self.fleet.breaker_failures)
+            if tripped:
+                self.needs_restart = True
+        self.fleet._resolve_error(req, etype, msg, self)
+
+    # -- failure handling --------------------------------------------------
+
+    def _on_transport_loss(self, reason: str) -> None:
+        """The replica died or its connection broke: fail over every
+        in-flight request and go back to connecting (the supervisor
+        sweep restarts the process; a stale endpoint can't be re-read
+        because the incarnation must match)."""
+        with self.cond:
+            conn, self.conn = self.conn, None
+            self._recv_gen += 1  # detach the old receiver
+            lost = [req for req, _ in self.inflight.values()]
+            self.inflight.clear()
+            self.cond.notify_all()  # the window emptied
+            if conn is not None:
+                # close INSIDE the lock: a puller that captured this
+                # conn before the loss must get a deterministic send
+                # error — closed after the lock, its sendall could win
+                # the race into the kernel buffer and strand the
+                # request in inflight until the 30s timeout sweep
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        if self.state in (_READY, _STANDBY, _STARTING):
+            self.set_state(_STARTING)
+        if lost:
+            self.fleet.metrics.counter("failovers_total").inc()
+        for req in lost:
+            self.fleet._retry_or_fail(
+                req, f"replica {self.rank} {reason}")
+
+    def sweep_timeouts(self, now: float, timeout_s: float) -> bool:
+        """Transport-deadline check: any request in flight here longer
+        than ``timeout_s`` marks this replica wedged (heartbeats
+        notwithstanding) — fail over everything and ask for a restart.
+        Returns True when it tripped."""
+        with self.lock:
+            aged = any(now - t0 > timeout_s
+                       for _, t0 in self.inflight.values())
+        if not aged:
+            return False
+        self.needs_restart = True
+        self._on_transport_loss(
+            f"wedged: request in flight > {timeout_s:.1f}s")
+        return True
+
+    def on_process_restart(self, new_incarnation: int) -> None:
+        self.expected_incarnation = int(new_incarnation)
+        self.needs_restart = False
+        self._on_transport_loss("restarted by supervisor")
+        if self.state not in (_FAILED, _RETIRED):
+            self.set_state(_STARTING)
+
+    def mark_failed(self) -> None:
+        # FAILED first: _on_transport_loss only resets live states back
+        # to STARTING, so the terminal state sticks
+        self.set_state(_FAILED)
+        self._on_transport_loss("restart budget exhausted")
+
+
+class ServingFleet:
+    """Multi-replica HA front end over :class:`serving.Server` workers
+    (module docstring). Parameters default from the ``serve_*`` flags;
+    ``model`` is a replica model spec — ``'file.py:factory'``,
+    ``'module:factory'`` (called with ``model_arg``), or
+    ``'artifact:/path'`` (a saved inference artifact)."""
+
+    def __init__(self, model: str, replicas: Optional[int] = None,
+                 version: str = "v1", model_arg: str = "",
+                 max_batch: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 buckets=None, input_specs=None,
+                 deadline_ms: Optional[float] = None,
+                 warmup: bool = False,
+                 retry_max: Optional[int] = None,
+                 replica_timeout_ms: Optional[float] = None,
+                 breaker_failures: Optional[int] = None,
+                 fleet_queue_depth: Optional[int] = None,
+                 shed_start: Optional[float] = None,
+                 priority_levels: Optional[int] = None,
+                 ready_timeout_s: Optional[float] = None,
+                 hang_timeout: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 env: Optional[dict] = None,
+                 work_dir: Optional[str] = None,
+                 chaos_spec: Optional[str] = None,
+                 poll_s: float = 0.2,
+                 inflight_per_replica: int = 64):
+        self.model_spec = str(model)
+        self.model_arg = str(model_arg)
+        self.version = str(version)
+        self.replica_count = int(
+            core_flags.flag("serve_replicas") if replicas is None
+            else replicas)
+        if self.replica_count < 1:
+            raise InvalidArgumentError("a fleet needs >= 1 replica")
+        self.retry_max = int(
+            core_flags.flag("serve_retry_max") if retry_max is None
+            else retry_max)
+        self.replica_timeout_s = float(
+            core_flags.flag("serve_replica_timeout_ms")
+            if replica_timeout_ms is None else replica_timeout_ms) / 1e3
+        self.breaker_failures = int(
+            core_flags.flag("serve_breaker_failures")
+            if breaker_failures is None else breaker_failures)
+        self.queue_depth = int(
+            core_flags.flag("serve_fleet_queue_depth")
+            if fleet_queue_depth is None else fleet_queue_depth)
+        self.ready_timeout_s = float(
+            core_flags.flag("serve_ready_timeout_s")
+            if ready_timeout_s is None else ready_timeout_s)
+        dl = deadline_ms if deadline_ms is not None \
+            else core_flags.flag("serve_deadline_ms")
+        self.default_deadline_ms = float(dl) if dl else None
+        self.admission = AdaptiveAdmission(self.queue_depth, shed_start,
+                                           priority_levels)
+        self.inflight_per_replica = int(inflight_per_replica)
+        self.poll_s = float(poll_s)
+        self.hang_timeout = hang_timeout
+        self.max_restarts = max_restarts
+        self._user_env = dict(env) if env else {}
+        self._work_dir = work_dir
+        # chaos forwarded to replica processes (the loader-worker
+        # pattern: points fire where the work happens); default = what
+        # THIS process has armed
+        self._chaos_spec = (core_chaos.active_spec()
+                            if chaos_spec is None else chaos_spec)
+        self._server_cfg = {}
+        for k, v in (("max_batch", max_batch),
+                     ("batch_timeout_ms", batch_timeout_ms),
+                     ("queue_depth", queue_depth),
+                     ("buckets", list(buckets) if buckets else None),
+                     ("input_specs",
+                      [list((list(s), d)) for s, d in input_specs]
+                      if input_specs else None),
+                     ("warmup", warmup or None)):
+            if v is not None:
+                self._server_cfg[k] = v
+
+        self.metrics = ServingMetrics()
+        self.version_metrics = MetricsGroup("version")
+        self.replica_metrics = MetricsGroup("replica")
+
+        self.healthy = True
+        self._sup = None
+        self._clients: Dict[int, _ReplicaClient] = {}
+        self._next_rank = 0
+        self._rid = 0
+        self._lock = threading.Lock()
+        self._queue_cond = threading.Condition(self._lock)
+        self._queue: "collections.deque[_FleetRequest]" = \
+            collections.deque()
+        self._live: Dict[int, _FleetRequest] = {}
+        self._rpc_waiters: Dict[int, dict] = {}
+        self._accepting = False
+        self._stop = False
+        self._started = False
+        self._drained = False
+        self._deploy_lock = threading.Lock()
+        self._sweeper: Optional[threading.Thread] = None
+        self.deploys = 0
+        self.rollbacks = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingFleet":
+        if self._started:
+            return self
+        from ..distributed.supervisor import Supervisor
+        core_health.beat()  # adopt an outer supervisor's channel first
+        if self._work_dir is None:
+            self._work_dir = tempfile.mkdtemp(prefix="p1t_fleet_")
+        os.makedirs(self._work_dir, exist_ok=True)
+        kw = {}
+        if self.hang_timeout is not None:
+            kw["hang_timeout"] = self.hang_timeout
+        if self.max_restarts is not None:
+            kw["max_restarts"] = self.max_restarts
+        self._sup = Supervisor(policy="restart", elastic=False,
+                               heartbeat_dir=os.path.join(
+                                   self._work_dir, "hb"),
+                               log_dir=self._work_dir,
+                               poll_s=min(self.poll_s, 0.5),
+                               grace_s=10.0, **kw)
+        for _ in range(self.replica_count):
+            self._add_replica(self.version, self.model_arg)
+        self._sup.start()
+        for c in self._clients.values():
+            c.start()
+        self._accepting = True
+        self._started = True
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         daemon=True,
+                                         name="p1t-fleet-sweep")
+        self._sweeper.start()
+        return self
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    def _replica_cmd(self, rank: int, version: str,
+                     model_arg: str) -> List[str]:
+        ep = os.path.join(self._work_dir, f"replica.{rank}.json")
+        cmd = [sys.executable, "-u", "-m", "paddle1_tpu.serving.replica",
+               "--endpoint-file", ep, "--model", self.model_spec,
+               "--model-arg", model_arg, "--version", version,
+               "--rank", str(rank),
+               "--server-config", json.dumps(self._server_cfg)]
+        if self._chaos_spec:
+            cmd += ["--chaos", self._chaos_spec]
+        return cmd
+
+    def _replica_env(self) -> dict:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("PADDLE_FT_")}
+        # the replica runs `-m paddle1_tpu...`: make sure the package
+        # root is importable regardless of the parent's cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + (os.pathsep + pp if pp
+                                             else ""))
+        env.update(self._user_env)
+        return env
+
+    def _add_replica(self, version: str, model_arg: str,
+                     probation: bool = False,
+                     max_restarts: Optional[int] = None
+                     ) -> _ReplicaClient:
+        rank = self._next_rank
+        self._next_rank += 1
+        ep = os.path.join(self._work_dir, f"replica.{rank}.json")
+        try:  # a stale endpoint from a previous rank must never match
+            os.unlink(ep)
+        except OSError:
+            pass
+        self._sup.add_worker(
+            rank, self._replica_cmd(rank, version, model_arg),
+            env=self._replica_env(),
+            log_path=os.path.join(self._work_dir, f"replica.{rank}.log"),
+            role="replica", max_restarts=max_restarts)
+        client = _ReplicaClient(self, rank, version, ep,
+                                probation=probation)
+        with self._lock:
+            self._clients[rank] = client
+        return client
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, *inputs, deadline_ms: Optional[float] = None,
+               priority: int = 0) -> FleetFuture:
+        """Enqueue one request (each input carries a leading batch
+        dim). ``priority`` 0 (default) is the highest class; under
+        overload higher numbers shed first. Raises ``ServerOverloaded``
+        (hard-full queue, or adaptive admission under overload) or
+        ``ServerClosed`` synchronously."""
+        if not self._accepting:
+            raise ServerClosed(
+                "fleet is draining/stopped — not admitting requests")
+        if not inputs:
+            raise InvalidArgumentError("submit needs >= 1 input array")
+        arrays = [np.asarray(getattr(a, "data", a)) for a in inputs]
+        rows = int(np.shape(arrays[0])[0]) if np.ndim(arrays[0]) else 0
+        if rows < 1:
+            raise InvalidArgumentError(
+                "request inputs need a leading batch dim (reshape a "
+                "single sample to [1, ...])")
+        for i, a in enumerate(arrays[1:], start=1):
+            if np.ndim(a) < 1 or int(np.shape(a)[0]) != rows:
+                raise InvalidArgumentError(
+                    f"input {i} leading dim != input 0's {rows} — all "
+                    "inputs of one request must share the batch dim")
+        dl = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        scale = self.replica_timeout_s * 1e3
+        with self._queue_cond:
+            if not self._accepting:
+                raise ServerClosed(
+                    "fleet is draining/stopped — not admitting requests")
+            qlen = len(self._queue)
+            self.admission.observe(qlen)
+            # counted before the shed decision, exactly like Server:
+            # accepted = requests_total - shed_total stays exact
+            self.metrics.counter("requests_total").inc()
+            if qlen >= self.queue_depth:
+                self.metrics.counter("shed_total").inc()
+                raise ServerOverloaded(
+                    f"fleet queue depth {self.queue_depth} exhausted — "
+                    "request shed (add replicas, raise "
+                    "serve_fleet_queue_depth, or slow the client)")
+            if self.admission.should_shed(priority, dl, scale):
+                self.metrics.counter("shed_total").inc()
+                self.metrics.counter("shed_adaptive_total").inc()
+                self.metrics.counter(
+                    f"shed_priority_{int(priority)}").inc()
+                raise ServerOverloaded(
+                    f"adaptive admission shed priority-{priority} "
+                    f"request at overload "
+                    f"{self.admission.overload():.2f} — admitted "
+                    "traffic keeps its p99 while capacity recovers")
+            self._rid += 1
+            req = _FleetRequest(self._rid, arrays, int(priority),
+                                dl / 1e3 if dl else None, dl)
+            self._live[req.id] = req
+            self._queue.append(req)
+            self._queue_cond.notify()
+        return req.future
+
+    def infer(self, *inputs, deadline_ms: Optional[float] = None,
+              priority: int = 0, timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(*inputs, deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
+
+    def _notify_queue(self) -> None:
+        with self._queue_cond:
+            self._queue_cond.notify_all()
+
+    def _next_request(self) -> Optional[_FleetRequest]:
+        """Pop the next dispatchable request (pullers call this).
+        Deadline-expired requests resolve typed on the way out."""
+        with self._queue_cond:
+            if not self._queue:
+                self._queue_cond.wait(0.05)
+            if not self._queue:
+                return None
+            req = self._queue.popleft()
+        if req.future.done():  # failed by a sweep while queued
+            return None
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            self._resolve_deadline(req, "expired in the fleet queue")
+            return None
+        return req
+
+    # -- resolution / retry ------------------------------------------------
+
+    def _unlive(self, req: _FleetRequest) -> None:
+        with self._lock:
+            self._live.pop(req.id, None)
+
+    def _resolve_value(self, req: _FleetRequest, arrays, version,
+                       client: _ReplicaClient) -> None:
+        if req.future._set_value(list(arrays), version):
+            self._unlive(req)
+            now = time.monotonic()
+            e2e = (now - req.t_enq) * 1e3
+            self.metrics.counter("responses_total").inc()
+            self.metrics.histogram("e2e_ms").observe(e2e)
+            self.metrics.record_response()
+            if version:
+                vm = self.version_metrics.child(version)
+                vm.counter("responses_total").inc()
+                vm.histogram("e2e_ms").observe(e2e)
+                vm.record_response()
+            rm = self.replica_metrics.child(client.rank)
+            rm.counter("responses_total").inc()
+            rm.histogram("e2e_ms").observe(e2e)
+
+    def _resolve_deadline(self, req: _FleetRequest, where: str) -> None:
+        if req.future._set_exception(DeadlineExceeded(
+                f"request {where} after "
+                f"{(time.monotonic() - req.t_enq) * 1e3:.1f}ms "
+                f"(deadline {req.deadline_ms}ms)")):
+            self._unlive(req)
+            self.metrics.counter("deadline_expired_total").inc()
+
+    def _resolve_error(self, req: _FleetRequest, etype: str, msg: str,
+                       client: Optional[_ReplicaClient]) -> None:
+        if etype == "DeadlineExceeded":
+            if req.future._set_exception(DeadlineExceeded(msg)):
+                self._unlive(req)
+                self.metrics.counter("deadline_expired_total").inc()
+            return
+        if etype == "InvalidArgumentError":
+            exc: BaseException = InvalidArgumentError(msg)
+        else:
+            exc = RuntimeError(
+                f"replica {client.rank if client else '?'} error "
+                f"[{etype}]: {msg}")
+        if req.future._set_exception(exc):
+            self._unlive(req)
+            self.metrics.counter("errors_total").inc()
+            if client is not None:
+                self.replica_metrics.child(client.rank) \
+                    .counter("errors_total").inc()
+
+    def _retry_or_fail(self, req: _FleetRequest, reason: str) -> None:
+        """Failover: re-dispatch at most ``retry_max`` times, then the
+        typed ReplicaFailed. Re-enqueued FIRST (appendleft) — a retried
+        request already paid its queue time once."""
+        if req.future.done():
+            self._unlive(req)
+            return
+        if req.pinned:
+            # a canary must be answered by its candidate — never by a
+            # healthy standing replica absorbing the retry
+            if req.future._set_exception(ReplicaFailed(
+                    f"pinned request's replica failed: {reason}")):
+                self._unlive(req)
+                self.metrics.counter("errors_total").inc()
+                self.metrics.counter("replica_failed_total").inc()
+            return
+        req.retries += 1
+        if req.retries > self.retry_max:
+            if req.future._set_exception(ReplicaFailed(
+                    f"request failed over {req.retries - 1} times "
+                    f"(serve_retry_max={self.retry_max}); last: "
+                    f"{reason}")):
+                self._unlive(req)
+                self.metrics.counter("errors_total").inc()
+                self.metrics.counter("replica_failed_total").inc()
+            return
+        self.metrics.counter("retries_total").inc()
+        with self._queue_cond:
+            self._queue.appendleft(req)
+            self._queue_cond.notify()
+
+    # -- supervision sweep -------------------------------------------------
+
+    def _sweep_loop(self) -> None:
+        while not self._stop:
+            try:
+                self._sweep_once()
+            except Exception as e:  # noqa: broad-except — the sweep
+                # thread must survive transient errors (a mid-teardown
+                # race must not kill supervision for good)
+                print(f"fleet sweep error: {e!r}", file=sys.stderr)
+            time.sleep(self.poll_s)
+
+    def _sweep_once(self) -> None:
+        core_health.beat()
+        if core_health.drain_requested() and self._accepting:
+            # an outer supervisor's SIGTERM (or request_drain): the
+            # fleet unwinds through its own graceful drain — flush,
+            # typed failures for anything wedged, replicas retired
+            self.drain()
+            return
+        now = time.monotonic()
+        for ev in self._sup.supervise_once():
+            client = self._clients.get(ev.rank)
+            if client is None:
+                continue
+            if ev.action == "restarted":
+                self.metrics.counter("replica_restarts_total").inc()
+                try:
+                    inc = self._sup.incarnation(ev.rank)
+                except InvalidArgumentError:
+                    continue  # retired by a concurrent deploy
+                client.on_process_restart(inc)
+            elif ev.action == "restart_exhausted":
+                self._on_replica_exhausted(client, ev)
+        with self._lock:
+            clients = list(self._clients.values())
+        for client in clients:
+            if client.state in (_FAILED, _RETIRED, _DRAINING):
+                # DRAINING: a deploy is retiring this rank on its own
+                # schedule (with its own wedge deadline) — the sweep
+                # restarting it mid-retire would resurrect the replica
+                # the deploy is removing
+                continue
+            if client.sweep_timeouts(now, self.replica_timeout_s):
+                self.metrics.counter("replica_wedged_total").inc()
+            if client.needs_restart:
+                client.needs_restart = False
+                if client.state not in (_FAILED, _RETIRED, _DRAINING):
+                    try:
+                        restarted = self._sup.restart_rank(client.rank)
+                        inc = (self._sup.incarnation(client.rank)
+                               if restarted else 0)
+                    except InvalidArgumentError:
+                        # a concurrent deploy retired the rank between
+                        # the state check and here — nothing to restart
+                        continue
+                    if restarted:
+                        self.metrics.counter(
+                            "replica_restarts_total").inc()
+                        client.on_process_restart(inc)
+                    else:
+                        self._on_replica_exhausted(client, None)
+        # queued requests whose deadline passed while no replica pulled
+        expired = []
+        with self._queue_cond:
+            if self._queue:
+                keep = collections.deque()
+                for req in self._queue:
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                    else:
+                        keep.append(req)
+                self._queue = keep
+        for req in expired:
+            self._resolve_deadline(req, "expired in the fleet queue")
+        # feed the EWMA between submits too, so an idle fleet decays
+        # back below the shed threshold
+        with self._queue_cond:
+            self.admission.observe(len(self._queue))
+        if not any(c.state in (_STARTING, _STANDBY, _READY, _DRAINING)
+                   for c in clients):
+            self._fail_all_pending(
+                ReplicaFailed("no replicas left in the fleet (restart "
+                              "budgets exhausted)"),
+                replica_failed=True)
+
+    def _on_replica_exhausted(self, client: _ReplicaClient, ev) -> None:
+        """Restart budget exhausted: the fleet is degraded for good —
+        latch unhealthy (an outer Supervisor can respond per policy)
+        while any survivors keep serving."""
+        client.mark_failed()
+        # put the process down for good: supervise_once's exhausted
+        # path already SIGKILLed its corpse, but the breaker/wedge
+        # route (restart_rank returning False on a replica that still
+        # heartbeats) reaches here with the process ALIVE — left
+        # running it would hold its port, memory, and heartbeat file
+        # for the fleet's lifetime
+        if self._sup is not None:
+            self._sup.kill_worker(client.rank)
+        if client.probation:
+            # a deploy candidate dying is a DEPLOY failure — deploy()
+            # surfaces it typed as DeployFailed (mark_failed above
+            # unblocks its wait_connected immediately); the standing
+            # fleet is intact and stays healthy
+            return
+        self.healthy = False
+        self.metrics.counter("replica_exhausted_total").inc()
+        reason = (f"serving fleet: replica {client.rank} out of "
+                  f"restart budget"
+                  + (f" ({ev.failure.kind}: {ev.failure.reason})"
+                     if ev is not None else ""))
+        print(reason, file=sys.stderr)
+        core_health.report_unhealthy(reason)
+
+    def _fail_all_pending(self, exc: BaseException,
+                          replica_failed: bool = False) -> None:
+        """Fail every queued + in-flight request typed (first-wins per
+        future; fully accounted). ``replica_failed`` also bumps the
+        replica_failed counter — the no-replicas-left path."""
+        with self._queue_cond:
+            pending = list(self._queue)
+            self._queue.clear()
+            live = list(self._live.values())
+        for req in pending + live:
+            if req.future._set_exception(exc):
+                self._unlive(req)
+                self.metrics.counter("errors_total").inc()
+                if replica_failed:
+                    self.metrics.counter("replica_failed_total").inc()
+
+    # -- replica RPC -------------------------------------------------------
+
+    def _rpc(self, client: _ReplicaClient, kind: str,
+             timeout: float = 10.0) -> Optional[dict]:
+        """Out-of-band request/response on a client's live connection
+        (metrics scrape, explicit health ping)."""
+        conn = client.conn
+        if conn is None:
+            return None
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            waiter = {"event": threading.Event(), "header": None}
+            self._rpc_waiters[rid] = waiter
+        try:
+            with client.send_lock:
+                wire.send_msg(conn, {"kind": kind, "id": rid})
+        except (OSError, ConnectionError):
+            with self._lock:
+                self._rpc_waiters.pop(rid, None)
+            return None
+        if not waiter["event"].wait(timeout):
+            with self._lock:
+                self._rpc_waiters.pop(rid, None)
+            return None
+        return waiter["header"]
+
+    def _resolve_rpc(self, client: _ReplicaClient, header) -> None:
+        with self._lock:
+            waiter = self._rpc_waiters.pop(header.get("id"), None)
+        if waiter is not None:
+            waiter["header"] = header
+            waiter["event"].set()
+
+    def replica_snapshot(self, rank: int,
+                         timeout: float = 10.0) -> Optional[dict]:
+        """One replica's own ServingMetrics snapshot, over the wire."""
+        client = self._clients.get(rank)
+        if client is None:
+            return None
+        header = self._rpc(client, "metrics", timeout)
+        return header.get("snapshot") if header else None
+
+    def fleet_snapshot(self, include_replicas: bool = False) -> dict:
+        """Fleet-level snapshot; with ``include_replicas`` also scrapes
+        every live replica and merges (conservative quantile merge —
+        see :func:`serving.metrics.merge_snapshots`)."""
+        with self._lock:  # deploy mutates _clients under this lock
+            states = {r: c.state for r, c in self._clients.items()}
+        snap = {
+            "fleet": self.metrics.snapshot(),
+            "by_version": self.version_metrics.snapshot(),
+            "by_replica": self.replica_metrics.snapshot(),
+            "healthy": self.healthy,
+            "replicas": states,
+        }
+        if include_replicas:
+            reps = {}
+            for rank in list(states):
+                s = self.replica_snapshot(rank)
+                if s is not None:
+                    reps[rank] = s
+            snap["replica_servers"] = reps
+            snap["replica_aggregate"] = merge_snapshots(reps.values())
+        return snap
+
+    # -- hot swap ----------------------------------------------------------
+
+    def deploy(self, model: str, version: str, model_arg: str = "",
+               canary: Optional[Sequence[np.ndarray]] = None,
+               ready_timeout_s: Optional[float] = None) -> dict:
+        """Zero-downtime rolling model swap (module docstring). Blocks
+        until the roll completes; raises :class:`DeployFailed` (fleet
+        untouched when the canary — the first new replica — fails;
+        already-promoted slots are rolled back on a later failure).
+        ``canary`` arrays, when given, must infer successfully on the
+        new version before it enters rotation."""
+        timeout = (self.ready_timeout_s if ready_timeout_s is None
+                   else float(ready_timeout_s))
+        with self._deploy_lock:
+            if not self._started or self._stop:
+                raise PreconditionNotMetError(
+                    "fleet is not running — nothing to deploy onto")
+            old_spec, old_arg, old_version = (
+                self.model_spec, self.model_arg, self.version)
+            with self._lock:
+                old_ranks = [r for r, c in self._clients.items()
+                             if c.state in (_STARTING, _READY)]
+            if not old_ranks:
+                raise PreconditionNotMetError(
+                    "no serving replicas to roll")
+            self.model_spec = str(model)
+            self.model_arg = str(model_arg)
+            swapped: List[int] = []  # new ranks promoted so far
+            try:
+                for i, old_rank in enumerate(sorted(old_ranks)):
+                    new = self._swap_in(version, model_arg, canary,
+                                        timeout,
+                                        canary_slot=(i == 0))
+                    self._retire_replica(old_rank)
+                    swapped.append(new.rank)
+            except DeployFailed:
+                self.rollbacks += 1
+                self.metrics.counter("rollbacks_total").inc()
+                if swapped:
+                    # late-roll failure: put the old version back on
+                    # the already-swapped slots (same machinery, old
+                    # artifact) — the fleet must end the deploy
+                    # serving SOMETHING everywhere
+                    self.model_spec, self.model_arg = old_spec, old_arg
+                    for new_rank in swapped:
+                        try:
+                            self._swap_in(old_version, old_arg,
+                                          None, timeout,
+                                          canary_slot=False)
+                            self._retire_replica(new_rank)
+                        except DeployFailed:  # pragma: no cover -
+                            # rollback spawn failing too: survivors
+                            # keep serving; the deploy error below
+                            # still surfaces
+                            break
+                else:
+                    self.model_spec, self.model_arg = old_spec, old_arg
+                raise
+            self.version = str(version)
+            self.deploys += 1
+            self.metrics.counter("deploys_total").inc()
+            return {"version": version, "replicas": swapped,
+                    "rolled": len(swapped)}
+
+    def _swap_in(self, version: str, model_arg: str, canary,
+                 timeout: float, canary_slot: bool) -> _ReplicaClient:
+        """Spawn one new-version replica off-rotation, health-check it,
+        promote it. The canary slot runs with a ZERO restart budget —
+        a broken artifact must fail the deploy, not spin the
+        supervisor's relaunch loop."""
+        client = self._add_replica(version, model_arg, probation=True,
+                                   max_restarts=0 if canary_slot
+                                   else None)
+        self._sup.spawn_worker(client.rank)
+        client.start()
+        ok = client.wait_connected(timeout)
+        if ok and canary is not None:
+            ok = self._canary_infer(client, canary, timeout)
+        if not ok:
+            self._abort_spawn(client)
+            raise DeployFailed(
+                f"replica for version {version!r} never became healthy "
+                f"within {timeout:.0f}s"
+                + (" (canary)" if canary_slot else "")
+                + " — deploy aborted, fleet keeps serving "
+                  "the previous version")
+        # promoted: the standing fleet's restart budget applies now
+        self._sup.set_restart_budget(client.rank, self.max_restarts)
+        client.enter_rotation()
+        return client
+
+    def _abort_spawn(self, client: _ReplicaClient) -> None:
+        """Put down a candidate that never became healthy: its canary
+        request (if any) fails over to the standing fleet, the process
+        is retired (a corpse retires instantly), and the rank leaves
+        both tables."""
+        client.set_state(_RETIRED)
+        client._on_transport_loss("deploy aborted")
+        self._sup.retire(client.rank, grace_s=2.0)
+        with self._lock:
+            self._clients.pop(client.rank, None)
+
+    def _canary_infer(self, client: _ReplicaClient, canary,
+                      timeout: float) -> bool:
+        """One direct inference on the off-rotation candidate, through
+        the normal request/accounting path (it registers in ``_live``
+        and resolves like any request — unaccounted stays 0)."""
+        arrays = [np.asarray(a) for a in canary]
+        with self._queue_cond:
+            self.metrics.counter("requests_total").inc()
+            self._rid += 1
+            req = _FleetRequest(self._rid, arrays, 0, None, None,
+                                pinned=True)
+            self._live[req.id] = req
+        client._send_request(req)
+        try:
+            req.future.result(timeout=timeout)
+        except Exception:  # noqa: broad-except — ANY canary failure
+            # (typed or not) means "do not promote"; the error itself
+            # rides the DeployFailed message path
+            return False
+        # belt + braces on top of the pin: the ANSWER must carry the
+        # candidate's version tag — a response from anything else
+        # (however it got there) proves nothing about the new model
+        return req.future.version == client.version
+
+    def _retire_replica(self, rank: int) -> None:
+        """Drain one replica out of the fleet: out of rotation, wait
+        for its in-flight responses, then a supervised graceful stop
+        (SIGTERM → its Server flushes → exit 0 — retired before any
+        sweep could classify the exit)."""
+        client = self._clients.get(rank)
+        if client is None:
+            return
+        client.set_state(_DRAINING)
+        deadline = time.monotonic() + self.replica_timeout_s
+        while time.monotonic() < deadline:
+            with client.lock:
+                if not client.inflight:
+                    break
+            time.sleep(0.01)
+        else:
+            # wedged mid-retire: its in-flight work fails over — the
+            # no-silent-drop contract beats the graceful exit
+            client._on_transport_loss("drained while wedged")
+        client.set_state(_RETIRED)  # terminal: loss below can't reset it
+        self._sup.retire(rank)
+        client._on_transport_loss("retired")  # close conn (in-flight is
+        # empty or already failed over above — nothing left to retry)
+        with self._lock:
+            self._clients.pop(rank, None)
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Stop admitting, flush everything accepted (complete or fail
+        typed), stop replicas gracefully, report — with the Server's
+        exact accounting identity: ``unaccounted == 0``."""
+        with self._queue_cond:
+            already = self._drained
+            self._accepting = False
+        if not already and self._started:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._live:
+                        break
+                time.sleep(0.02)
+            # anything still unresolved fails typed, never silently
+            self._fail_all_pending(PreconditionNotMetError(
+                f"fleet drain timed out after {timeout}s"))
+        self._stop = True
+        self._notify_queue()
+        if self._sup is not None and not already:
+            for rank in list(self._clients):
+                self._sup.retire(rank, grace_s=10.0)
+        self._drained = True
+        snap = self.metrics.snapshot()
+        c = snap["counters"]
+        report = {
+            "drained": True,
+            "healthy": self.healthy,
+            "accepted": (c.get("requests_total", 0)
+                         - c.get("shed_total", 0)),
+            "completed": c.get("responses_total", 0),
+            "deadline_failed": c.get("deadline_expired_total", 0),
+            "errors": c.get("errors_total", 0),
+            "shed": c.get("shed_total", 0),
+            "shed_adaptive": c.get("shed_adaptive_total", 0),
+            "retries": c.get("retries_total", 0),
+            "failovers": c.get("failovers_total", 0),
+            "replica_restarts": c.get("replica_restarts_total", 0),
+            "replica_failed": c.get("replica_failed_total", 0),
+            "deploys": self.deploys,
+            "rollbacks": self.rollbacks,
+            "versions": self.version_metrics.labels(),
+            "supervisor": (self._sup.report.as_dict()
+                           if self._sup is not None else None),
+        }
+        report["unaccounted"] = (report["accepted"]
+                                 - report["completed"]
+                                 - report["deadline_failed"]
+                                 - report["errors"])
+        return report
+
+    stop = drain

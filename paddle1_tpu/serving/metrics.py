@@ -14,6 +14,14 @@ observations for quantiles (serving latency distributions are what the
 last few thousand requests say, not what the process saw at boot). A
 registry is instantiated per :class:`~paddle1_tpu.serving.Server`, so
 two servers in one process (A/B models) never mix their numbers.
+
+The fleet layer (ISSUE 7) adds two multi-registry shapes on top:
+:class:`MetricsGroup` keys child registries by a label (per model
+version, per replica) so a rolling deploy's two versions never mix
+their latencies, and :func:`merge_snapshots` folds many snapshots —
+including ones shipped over the wire from replica subprocesses — into
+one fleet-wide aggregate (counters/count/sum add exactly; quantiles
+take the worst child, the conservative merge for an SLO read).
 """
 
 from __future__ import annotations
@@ -21,9 +29,10 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["Counter", "Histogram", "ServingMetrics"]
+__all__ = ["Counter", "Histogram", "ServingMetrics", "MetricsGroup",
+           "merge_snapshots"]
 
 # reservoir size per histogram: large enough for a stable p99 (the
 # quantile of the last ~4k observations), small enough to sort per
@@ -84,6 +93,13 @@ class Histogram:
         idx = min(len(data) - 1, max(0, int(round(
             (p / 100.0) * (len(data) - 1)))))
         return data[idx]
+
+    def totals(self) -> Tuple[int, float]:
+        """Raw (count, sum) — unrounded, for the Prometheus ``_sum`` /
+        ``_count`` series a ``rate()`` is computed from (the rounded
+        ``summary()`` values drift a rate by up to 5e-5 per scrape)."""
+        with self._lock:
+            return self.count, self.sum
 
     def summary(self) -> Dict[str, float]:
         with self._lock:
@@ -164,15 +180,128 @@ class ServingMetrics:
             "histograms": {h.name: h.summary() for h in hists},
         }
 
-    def render_text(self) -> str:
-        """Prometheus-style plain-text exposition (one scrape page)."""
-        snap = self.snapshot()
-        lines = [f"p1t_serving_qps {snap['qps']}",
-                 f"p1t_serving_uptime_seconds {snap['uptime_s']}"]
-        for name, v in sorted(snap["counters"].items()):
-            lines.append(f"p1t_serving_{name} {v}")
-        for name, s in sorted(snap["histograms"].items()):
-            for stat in ("count", "sum", "mean", "p50", "p95", "p99",
-                         "max"):
-                lines.append(f"p1t_serving_{name}_{stat} {s[stat]}")
+    def render_text(self, label: Optional[Tuple[str, str]] = None,
+                    type_headers: bool = True) -> str:
+        """Prometheus-style plain-text exposition (one scrape page).
+
+        Histograms are emitted as Prometheus *summaries*: a ``# TYPE``
+        header, quantile-labeled gauges, and RAW (unrounded) monotone
+        ``_sum``/``_count`` series — the pair ``rate()`` needs, so
+        ``rate(..._sum[1m]) / rate(..._count[1m])`` yields a true
+        rolling mean (the rounded summary values would drift it).
+        The legacy ``_mean``/``_max``/``_p50``/``_p95``/``_p99`` gauge
+        lines are kept for existing scrapers. ``label`` tags every
+        sample with one extra ``key="value"`` pair — the
+        :class:`MetricsGroup` per-version/per-replica pages, which pass
+        ``type_headers=False``: the text format allows one TYPE line
+        per metric family per page, so a multi-child page emits the
+        labeled samples untyped rather than a duplicate header per
+        child (untyped samples parse fine; duplicate TYPE lines do
+        not)."""
+        def line(name, value, *pairs):
+            pairs = [p for p in pairs if p is not None]
+            if label is not None:
+                pairs.append(label)
+            if pairs:
+                lab = ",".join(f'{k}="{v}"' for k, v in pairs)
+                return f"{name}{{{lab}}} {value}"
+            return f"{name} {value}"
+
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            hists = list(self._histograms.values())
+        lines = [line("p1t_serving_qps", round(self.qps(), 2)),
+                 line("p1t_serving_uptime_seconds",
+                      round(time.monotonic() - self._started, 3))]
+        for name, v in sorted(counters.items()):
+            lines.append(line(f"p1t_serving_{name}", v))
+        for h in sorted(hists, key=lambda h: h.name):
+            base = f"p1t_serving_{h.name}"
+            s = h.summary()
+            count, total = h.totals()
+            if type_headers:
+                lines.append(f"# TYPE {base} summary")
+            for q, stat in (("0.5", "p50"), ("0.95", "p95"),
+                            ("0.99", "p99")):
+                lines.append(line(base, s[stat], ("quantile", q)))
+            lines.append(line(base + "_sum", repr(float(total))))
+            lines.append(line(base + "_count", count))
+            for stat in ("mean", "p50", "p95", "p99", "max"):
+                lines.append(line(f"{base}_{stat}", s[stat]))
         return "\n".join(lines) + "\n"
+
+
+class MetricsGroup:
+    """A labeled family of :class:`ServingMetrics` registries — the
+    fleet's per-model-version and per-replica split (a rolling deploy
+    serves two versions at once; mixing their latency histograms would
+    hide a regression in the new one behind the old one's volume).
+    Children are created on first touch, like the registry's own
+    counters; :meth:`aggregate` folds them into one fleet-wide view."""
+
+    def __init__(self, label_key: str):
+        self.label_key = label_key
+        self._lock = threading.Lock()
+        self._children: Dict[str, ServingMetrics] = {}
+
+    def child(self, label) -> ServingMetrics:
+        label = str(label)
+        m = self._children.get(label)
+        if m is None:
+            with self._lock:
+                m = self._children.setdefault(label, ServingMetrics())
+        return m
+
+    def labels(self) -> List[str]:
+        with self._lock:
+            return sorted(self._children)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            kids = dict(self._children)
+        return {label: m.snapshot() for label, m in sorted(kids.items())}
+
+    def aggregate(self) -> Dict[str, object]:
+        return merge_snapshots(self.snapshot().values())
+
+    def render_text(self) -> str:
+        with self._lock:
+            kids = dict(self._children)
+        return "".join(
+            m.render_text(label=(self.label_key, label),
+                          type_headers=False)
+            for label, m in sorted(kids.items()))
+
+
+def merge_snapshots(snaps: Iterable[Dict[str, object]]
+                    ) -> Dict[str, object]:
+    """Fold many ``ServingMetrics.snapshot()`` dicts into one aggregate
+    (across a MetricsGroup's children, or across replica subprocesses'
+    wire-shipped snapshots). Counters, histogram counts and sums add
+    exactly; quantiles/max take the WORST child — reservoir quantiles
+    cannot be merged without the raw observations, and for an SLO read
+    the conservative bound is the useful one (documented on the line a
+    dashboard reads: an aggregate p99 here is "no child was worse")."""
+    counters: Dict[str, int] = {}
+    hists: Dict[str, Dict[str, float]] = {}
+    qps = 0.0
+    uptime = 0.0
+    for s in snaps:
+        qps += float(s.get("qps", 0.0) or 0.0)
+        uptime = max(uptime, float(s.get("uptime_s", 0.0) or 0.0))
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for name, h in (s.get("histograms") or {}).items():
+            m = hists.setdefault(name, {
+                "count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
+                "p95": 0.0, "p99": 0.0, "max": 0.0})
+            m["count"] += h["count"]
+            m["sum"] += h["sum"]
+            for q in ("p50", "p95", "p99", "max"):
+                m[q] = max(m[q], h[q])
+    for m in hists.values():
+        m["mean"] = (round(m["sum"] / m["count"], 4) if m["count"]
+                     else 0.0)
+        m["sum"] = round(m["sum"], 4)
+    return {"qps": round(qps, 2), "uptime_s": uptime,
+            "counters": counters, "histograms": hists}

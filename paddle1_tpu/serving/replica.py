@@ -1,0 +1,270 @@
+"""Serving replica worker: the subprocess half of the ServingFleet.
+
+``python -m paddle1_tpu.serving.replica`` is what the fleet's
+Supervisor spawns per replica rank: it loads one model, wraps it in a
+:class:`~paddle1_tpu.serving.Server` (micro-batching, admission
+control, deadlines — the whole PR 4 stack), binds a loopback socket,
+publishes its endpoint, and serves framed requests from the fleet
+dispatcher until a drain is requested.
+
+Order of operations matters and is load-bearing:
+
+1. ``health.beat()`` runs FIRST — it adopts the Supervisor's heartbeat
+   channel and **pops** the ``PADDLE_FT_*`` env vars, so nothing this
+   process later spawns (XLA compile helpers, user model code shelling
+   out) can inherit the channel and mask a replica hang by beating its
+   file (the PR 3 grandchild gotcha, re-tested for replicas).
+2. Chaos arms only in incarnation 0: a Supervisor-restarted replica
+   replays clean — the same fire-once contract as every other point.
+3. The endpoint file is written AFTER the server started (and warmed,
+   when configured): publishing the port is the ready signal, so the
+   fleet's ready-handshake doubles as a health gate — a replica that
+   dies in import/compile never publishes and the spawn times out
+   typed.
+
+SIGTERM (Supervisor drain/retire) unwinds through the Server's drain
+protocol: stop admitting, flush every accepted request (complete or
+typed), answer everything still owed on the wire, exit 0 — the fleet
+sees a clean exit, never a failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = ["main", "load_model"]
+
+# resolver threads turning resolved ServeFutures into response frames;
+# the Batcher does the real batching, so a small pool just overlaps the
+# per-batch readback with frame writes
+_RESOLVERS = 4
+
+
+def load_model(spec: str, arg: str = ""):
+    """Resolve a model spec into something InferenceEngine accepts.
+
+    * ``/path/to/factory.py:fn`` — load the file as a module, call
+      ``fn(arg)`` (how tests/bench ship deterministic toy models).
+    * ``package.module:fn`` — import and call ``fn(arg)``.
+    * ``artifact:/path/prefix`` — ``jit.load`` a saved inference
+      artifact (the deployment path; ``arg`` is ignored).
+    """
+    if spec.startswith("artifact:"):
+        from ..jit import load as jit_load
+        return jit_load(spec[len("artifact:"):])
+    mod_spec, sep, attr = spec.rpartition(":")
+    if not sep:
+        raise ValueError(
+            f"model spec {spec!r} must be 'file.py:factory', "
+            "'module:factory', or 'artifact:/path'")
+    if mod_spec.endswith(".py"):
+        modname = "_p1t_replica_model"
+        m_spec = importlib.util.spec_from_file_location(modname, mod_spec)
+        if m_spec is None or m_spec.loader is None:
+            raise ValueError(f"cannot load model file {mod_spec!r}")
+        module = importlib.util.module_from_spec(m_spec)
+        sys.modules[modname] = module
+        m_spec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(mod_spec)
+    return getattr(module, attr)(arg)
+
+
+def _write_endpoint(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic: the fleet never reads a torn file
+
+
+class _DrainRequested(Exception):
+    """Internal: aborts a blocking frame read when a drain arrived."""
+
+
+def _resolver_loop(q: "queue.Queue", version: str) -> None:
+    from . import wire
+    while True:
+        item = q.get()
+        try:
+            if item is None:
+                return
+            rid, fut, conn, send_lock = item
+            try:
+                outs = fut.result()
+            except Exception as e:  # noqa: broad-except — every owed
+                # response must go back on the wire typed; the fleet
+                # maps the error name back to its class
+                header = {"kind": "error", "id": rid, "version": version,
+                          "etype": type(e).__name__, "msg": str(e)}
+                arrays = []
+            else:
+                arrays = outs if isinstance(outs, list) else [outs]
+                header = {"kind": "result", "id": rid, "version": version}
+            try:
+                with send_lock:
+                    wire.send_msg(conn, header, arrays)
+            except (OSError, ConnectionError):
+                pass  # fleet connection died; its failover retries this
+        finally:
+            q.task_done()
+
+
+def _serve_conn(conn: socket.socket, srv, args, resolver_q,
+                core_chaos, core_flags, core_health) -> None:
+    """Pump one fleet connection until EOF or drain."""
+    from . import wire
+    conn.settimeout(0.25)
+    send_lock = threading.Lock()
+
+    def idle():
+        core_health.beat()
+        if core_health.drain_requested():
+            raise _DrainRequested
+
+    while True:
+        try:
+            header, arrays = wire.recv_msg(conn, idle=idle)
+        except (ConnectionError, OSError):
+            return  # fleet reconnects (or is gone for good)
+        kind = header.get("kind")
+        if kind == "ping":
+            with send_lock:
+                wire.send_msg(conn, {
+                    "kind": "pong", "id": header.get("id"),
+                    "version": args.version,
+                    "warm_buckets": sorted(srv.engine.compile_counts)})
+        elif kind == "metrics":
+            with send_lock:
+                wire.send_msg(conn, {
+                    "kind": "metrics_result", "id": header.get("id"),
+                    "version": args.version,
+                    "snapshot": srv.metrics.snapshot()})
+        elif kind == "infer":
+            if core_chaos.enabled():
+                point = core_chaos.check_replica(args.rank)
+                if point == core_chaos.REPLICA_KILL:
+                    # an ungraceful death mid-request: no cleanup —
+                    # the fleet must fail over the in-flight work
+                    os.kill(os.getpid(), signal.SIGKILL)
+                elif point == core_chaos.REPLICA_HANG:
+                    # wedged RPC plane: stop reading forever while the
+                    # Batcher keeps heartbeating — only the fleet's
+                    # transport timeout + breaker can catch this
+                    while True:  # pragma: no cover - exits via SIGKILL
+                        time.sleep(3600)
+                elif point == core_chaos.REPLICA_SLOW:
+                    time.sleep(float(
+                        core_flags.flag("serve_chaos_slow_s")))
+            try:
+                fut = srv.submit(*arrays,
+                                 deadline_ms=header.get("deadline_ms"))
+            except Exception as e:  # noqa: broad-except — admission
+                # errors (shed/closed/invalid) go back typed so the
+                # fleet can retry elsewhere or surface them
+                with send_lock:
+                    wire.send_msg(conn, {
+                        "kind": "error", "id": header.get("id"),
+                        "version": args.version,
+                        "etype": type(e).__name__, "msg": str(e)})
+                continue
+            resolver_q.put((header.get("id"), fut, conn, send_lock))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="paddle1_tpu serving replica worker")
+    ap.add_argument("--endpoint-file", required=True)
+    ap.add_argument("--model", required=True,
+                    help="'file.py:factory', 'module:factory', or "
+                         "'artifact:/path'")
+    ap.add_argument("--model-arg", default="")
+    ap.add_argument("--version", default="v0")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--chaos", default="",
+                    help="chaos spec armed in THIS process "
+                         "(incarnation 0 only)")
+    ap.add_argument("--server-config", default="{}",
+                    help="JSON kwargs for serving.Server")
+    args = ap.parse_args(argv)
+
+    from ..core import chaos as core_chaos
+    from ..core import flags as core_flags
+    from ..core import health as core_health
+
+    # 1. adopt the heartbeat channel (pops PADDLE_FT_* before anything
+    #    else can snapshot the env for grandchildren)
+    core_health.beat()
+    # 2. chaos replays clean in restarted lives
+    if args.chaos and core_health.incarnation() == 0:
+        core_chaos.configure(args.chaos)
+
+    from .server import Server
+
+    model = load_model(args.model, args.model_arg)
+    cfg = json.loads(args.server_config or "{}")
+    if cfg.get("input_specs"):
+        cfg["input_specs"] = [(tuple(s), d) for s, d in
+                              cfg["input_specs"]]
+    srv = Server(model, **cfg)
+    srv.start()
+
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    lst.settimeout(0.25)
+    port = lst.getsockname()[1]
+    # 3. publishing the endpoint IS the ready signal: server started
+    #    (and warmed when configured) before the fleet can route here
+    _write_endpoint(args.endpoint_file, {
+        "port": port, "pid": os.getpid(), "rank": args.rank,
+        "version": args.version,
+        "incarnation": core_health.incarnation()})
+    print(f"replica rank={args.rank} version={args.version} "
+          f"serving on 127.0.0.1:{port}", flush=True)
+
+    resolver_q: "queue.Queue" = queue.Queue()
+    resolvers = [threading.Thread(target=_resolver_loop,
+                                  args=(resolver_q, args.version),
+                                  daemon=True, name=f"p1t-resolver-{i}")
+                 for i in range(_RESOLVERS)]
+    for t in resolvers:
+        t.start()
+
+    try:
+        while not core_health.drain_requested():
+            core_health.beat()
+            try:
+                conn, _ = lst.accept()
+            except socket.timeout:
+                continue
+            try:
+                _serve_conn(conn, srv, args, resolver_q, core_chaos,
+                            core_flags, core_health)
+            except _DrainRequested:
+                break
+    finally:
+        lst.close()
+    # graceful drain: flush every accepted request (Server.drain fails
+    # anything wedged typed after its timeout, so the resolvers below
+    # always terminate), answer everything owed, exit clean
+    report = srv.drain()
+    resolver_q.join()
+    print(f"replica rank={args.rank} drained: "
+          f"{json.dumps({k: v for k, v in report.items() if k != 'compile_counts'})}",
+          flush=True)
+    return 0 if report["unaccounted"] == 0 else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

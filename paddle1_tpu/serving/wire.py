@@ -1,0 +1,113 @@
+"""Framed numpy-over-socket wire protocol for the serving fleet.
+
+The :class:`~paddle1_tpu.serving.fleet.ServingFleet` front end and its
+replica subprocesses speak length-prefixed frames over a loopback TCP
+connection::
+
+    u32 header_len | UTF-8 JSON header | per array: u32 npy_len | npy
+
+Arrays ride as ``numpy.lib.format`` payloads with ``allow_pickle=False``
+on BOTH ends — the same no-executable-payloads rule ``fluid.io`` adopted
+for checkpoints (PR 4): a serving fleet is long-lived infrastructure and
+its IPC plane must not be a pickle deserializer, even on loopback. The
+JSON header carries everything else (request id, kind, version tag,
+deadline, error type/message).
+
+Reads are restartable across socket timeouts: :func:`recv_msg` keeps
+its partial buffer while the caller's ``idle`` hook runs (the replica
+checks for a drain request there; the fleet receiver checks for
+shutdown), so a timeout can never desynchronize the frame stream — only
+a peer close (``ConnectionError``) or the hook raising aborts a read.
+With NO ``idle`` hook a socket timeout propagates (``socket.timeout``
+is an ``OSError``): the socket's own timeout is then the caller's read
+deadline — the fleet's connect handshake relies on this to bound a
+ping against a replica that accepted the connection but never answers.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["send_msg", "recv_msg"]
+
+_U32 = struct.Struct("<I")
+# a header is a small JSON dict; anything bigger is a desynced stream,
+# not a real frame — fail loudly instead of allocating garbage lengths
+_MAX_HEADER = 1 << 20
+# per-array bound for the same reason: 4 garbage bytes landing on an
+# array-length slot must raise, not pre-allocate a ~4 GiB recv buffer
+# (1 GiB comfortably covers any real request batch)
+_MAX_ARRAY = 1 << 30
+
+
+def send_msg(sock: socket.socket, header: Dict[str, object],
+             arrays: Sequence[np.ndarray] = ()) -> None:
+    """Write one frame (header dict + arrays). The caller serializes
+    concurrent senders (a per-connection send lock): ``sendall`` of one
+    pre-assembled buffer keeps the frame atomic on the wire."""
+    blobs: List[bytes] = []
+    for a in arrays:
+        buf = io.BytesIO()
+        np.lib.format.write_array(buf, np.ascontiguousarray(a),
+                                  allow_pickle=False)
+        blobs.append(buf.getvalue())
+    h = dict(header)
+    h["n"] = len(blobs)
+    hb = json.dumps(h, separators=(",", ":")).encode("utf-8")
+    out = bytearray(_U32.pack(len(hb)))
+    out += hb
+    for b in blobs:
+        out += _U32.pack(len(b))
+        out += b
+    sock.sendall(bytes(out))
+
+
+def _recv_exact(sock: socket.socket, n: int, idle=None) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            # no hook: the socket timeout IS the caller's deadline —
+            # propagate rather than spin forever on a silent peer
+            if idle is None:
+                raise
+            # partial frame preserved in ``buf`` — the hook may raise
+            # (drain/shutdown) to abort, else we keep waiting
+            idle()
+            continue
+        if not chunk:
+            raise ConnectionError(
+                "peer closed mid-frame" if buf else "peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket, idle=None
+             ) -> Tuple[Dict[str, object], List[np.ndarray]]:
+    """Read one frame; returns ``(header, arrays)``. Raises
+    ``ConnectionError`` when the peer closed (mid-frame or between
+    frames); ``idle()`` runs on every socket timeout and may raise to
+    abort the read."""
+    (hlen,) = _U32.unpack(_recv_exact(sock, 4, idle))
+    if hlen > _MAX_HEADER:
+        raise ConnectionError(
+            f"frame header claims {hlen} bytes — desynchronized stream")
+    header = json.loads(_recv_exact(sock, hlen, idle).decode("utf-8"))
+    arrays: List[np.ndarray] = []
+    for _ in range(int(header.get("n", 0))):
+        (alen,) = _U32.unpack(_recv_exact(sock, 4, idle))
+        if alen > _MAX_ARRAY:
+            raise ConnectionError(
+                f"frame array claims {alen} bytes — desynchronized "
+                "stream")
+        arrays.append(np.lib.format.read_array(
+            io.BytesIO(_recv_exact(sock, alen, idle)),
+            allow_pickle=False))
+    return header, arrays

@@ -119,9 +119,9 @@ class TestProcessTrainerCorrectness:
 
 
 class TestProcessTrainerThroughput:
-    @pytest.mark.slow  # ~30s and load-sensitive (the tier-1 suite's one
-    # chronic flake under host contention); the scaling assertion runs
-    # on the CI heavy step where the box is dedicated
+    @pytest.mark.slow  # ~90s (3 interleaved rounds) and load-sensitive;
+    # the scaling assertion runs on the CI heavy step where the box is
+    # dedicated
     @pytest.mark.skipif(
         len(__import__("os").sched_getaffinity(0)) < 2,
         reason="throughput scaling needs >=2 CPU cores (this host has 1; "
@@ -129,24 +129,31 @@ class TestProcessTrainerThroughput:
                "scaling assertion runs on multi-core CI)")
     def test_two_processes_beat_one_on_slot_workload(self):
         """The point of process workers: GIL-bound slot parsing scales
-        with processes (VERDICT r4 item 6 'done' criterion)."""
+        with processes (VERDICT r4 item 6 'done' criterion).
+
+        Scored as a best-of-N RATIO via ``bench_utils.best_of`` — this
+        was the tier-1 suite's one chronic flake as a single-run
+        wall-clock comparison: one multi-second scheduler stall landing
+        on the 2-process run flipped the ratio. Interleaved rounds make
+        both arms sample the same noise windows and the fastest round
+        of each is the scaling signal."""
+        from bench_utils import best_of
         from paddle1_tpu.distributed.fleet.process_trainer import (
             ProcessMultiTrainer)
         batches = _make_slot_batches(40)
 
         def run(n):
-            tr = ProcessMultiTrainer(process_num=n)
-            t0 = time.monotonic()
-            out = tr.train_from_dataset(batches, _model_fn, _slot_loss,
-                                        _optimizer_fn, batch_size=None)
-            dt = time.monotonic() - t0
-            assert out["batches"] == 40
-            return dt
+            def phase():
+                tr = ProcessMultiTrainer(process_num=n)
+                out = tr.train_from_dataset(batches, _model_fn,
+                                            _slot_loss, _optimizer_fn,
+                                            batch_size=None)
+                assert out["batches"] == 40
+            return phase
 
-        t1 = run(1)
-        t2 = run(2)
-        speedup = t1 / t2
-        assert speedup > 1.2, (t1, t2, speedup)
+        one, two = best_of(3, run(1), run(2))
+        speedup = one.best_s / two.best_s
+        assert speedup > 1.2, (one.times, two.times, speedup)
 
 
 def _exit_model_fn():

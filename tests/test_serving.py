@@ -72,6 +72,40 @@ class TestMetrics:
         assert h.percentile(99) == 0.0
         assert h.summary()["count"] == 0
 
+    def test_render_text_prometheus_exposition(self):
+        """Snapshot of the exposition format (ISSUE 7 satellite): a
+        histogram exports as a Prometheus summary — TYPE header,
+        quantile-labeled gauges, and RAW monotone _sum/_count series so
+        rate(..._sum[1m]) / rate(..._count[1m]) works — plus the legacy
+        stat gauges for existing scrapers."""
+        m = ServingMetrics()
+        m.counter("requests_total").inc(7)
+        h = m.histogram("e2e_ms")
+        h.observe(1.5)
+        h.observe(2.25)
+        text = m.render_text()
+        lines = text.splitlines()
+        assert "p1t_serving_requests_total 7" in lines
+        assert "# TYPE p1t_serving_e2e_ms summary" in lines
+        assert 'p1t_serving_e2e_ms{quantile="0.5"} 1.5' in lines
+        assert 'p1t_serving_e2e_ms{quantile="0.95"} 2.25' in lines
+        assert 'p1t_serving_e2e_ms{quantile="0.99"} 2.25' in lines
+        # raw, unrounded totals (repr of the float sum, exact int count)
+        assert "p1t_serving_e2e_ms_sum 3.75" in lines
+        assert "p1t_serving_e2e_ms_count 2" in lines
+        # legacy gauge lines survive for existing scrapers
+        assert any(l.startswith("p1t_serving_e2e_ms_p99 ")
+                   for l in lines)
+        assert any(l.startswith("p1t_serving_e2e_ms_max ")
+                   for l in lines)
+        # the raw sum must not be the 4-digit-rounded summary value
+        h2 = ServingMetrics()
+        hh = h2.histogram("t")
+        for _ in range(3):
+            hh.observe(0.1)  # 0.30000000000000004 raw
+        assert f"p1t_serving_t_sum {repr(0.1 + 0.1 + 0.1)}" \
+            in h2.render_text()
+
 
 class TestBuckets:
     def test_auto_powers_of_two(self):
@@ -243,6 +277,28 @@ class TestAdmissionControl:
         assert rep["deadline_failed"] == 2
         assert rep["accepted"] == 3
         assert rep["completed"] == 1 and rep["unaccounted"] == 0
+
+    def test_result_timeout_typed_on_wedged_batch(self):
+        """ISSUE 7 satellite: a reader blocking on a wedged batch must
+        not wait forever — result(timeout=...) raises the typed
+        DeadlineExceeded. The request itself stays in flight (first-
+        wins), so a later read succeeds and the books still balance."""
+        with flags_guard(serve_chaos_slow_s=1.0):
+            chaos.configure("serve_slow_step@1")
+            srv = Server(_mlp(21), max_batch=1, buckets=(1,),
+                         batch_timeout_ms=0, queue_depth=8).start()
+            x = np.zeros((1, 8), np.float32)
+            fut = srv.submit(x)   # its dispatch stalls 1s
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded, match="still in "
+                               "flight"):
+                fut.result(timeout=0.1)
+            assert time.monotonic() - t0 < 0.9  # didn't ride the stall
+            # the request was NOT cancelled: it completes and accounts
+            assert fut.result(timeout=30).shape == (1, 4)
+            rep = srv.drain()
+        assert rep["accepted"] == 1 and rep["completed"] == 1
+        assert rep["unaccounted"] == 0
 
     def test_submit_validation(self):
         srv = Server(_mlp(8), max_batch=4, buckets=(4,),

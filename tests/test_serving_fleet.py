@@ -1,0 +1,666 @@
+"""Serving fleet (ISSUE 7): supervised replicas, health-gated routing,
+hot-swap, overload degradation.
+
+Fast cases (in-tier) exercise the pure logic — adaptive admission, the
+wire protocol, FleetFuture first-wins, metrics groups/merging, replica
+chaos points, and Supervisor non-trainer adoption with plain-stdlib
+workers (no jax import). The full replica-subprocess matrix (kill
+failover, hot-swap, canary rollback, hang breaker, overload soak) is
+slow-marked — each spawns real ``paddle1_tpu.serving.replica``
+processes (~10s of jax import + warmup apiece) and runs in the CI
+serving-fleet step; ``bench.py --serving-fleet`` is the acceptance
+soak.
+"""
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle1_tpu.core import chaos
+from paddle1_tpu.serving import (AdaptiveAdmission, DeadlineExceeded,
+                                 DeployFailed, FleetFuture, MetricsGroup,
+                                 ReplicaFailed, ServerOverloaded,
+                                 ServingFleet, ServingMetrics,
+                                 merge_snapshots)
+from paddle1_tpu.serving import wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FACTORY = textwrap.dedent('''
+    def make_model(arg):
+        import numpy as np
+        import jax.numpy as jnp
+        if arg == "boom":
+            raise RuntimeError("broken artifact")
+        rng = np.random.default_rng(0)
+        W1 = (rng.standard_normal((8, 16)) * 0.1).astype(np.float32)
+        b1 = np.zeros(16, np.float32)
+        W2 = (rng.standard_normal((16, 4)) * 0.1).astype(np.float32)
+        b2 = np.zeros(4, np.float32)
+        scale = 2.0 if arg == "v2" else 1.0
+
+        def fwd(x):
+            h = jnp.maximum(x @ W1 + b1, 0)
+            return (h @ W2 + b2) * scale
+        return fwd
+''')
+
+
+# -- fast: adaptive admission -------------------------------------------------
+
+class TestAdaptiveAdmission:
+    def test_overload_ramp(self):
+        a = AdaptiveAdmission(100, shed_start=0.5, levels=4, alpha=1.0)
+        a.observe(10)
+        assert a.overload() == 0.0
+        a.observe(50)
+        assert a.overload() == 0.0  # exactly at the start: no shedding
+        a.observe(75)
+        assert abs(a.overload() - 0.5) < 1e-9
+        a.observe(100)
+        assert a.overload() == 1.0
+        a.observe(500)
+        assert a.overload() == 1.0  # clamped
+
+    def test_priority_zero_never_adaptively_shed(self):
+        a = AdaptiveAdmission(10, shed_start=0.5, levels=4, alpha=1.0)
+        a.observe(1000)  # fully overloaded
+        assert not a.should_shed(0, None)
+        assert not a.should_shed(0, 50.0)
+
+    def test_lowest_priority_sheds_first(self):
+        a = AdaptiveAdmission(100, shed_start=0.5, levels=4, alpha=1.0)
+        a.observe(75)  # overload 0.5 -> cutoff score 0.5
+        # p3 (rank 1.0): score >= 0.75 -> shed regardless of deadline
+        assert a.should_shed(3, None)
+        assert a.should_shed(3, 100.0)
+        # p1 (rank 1/3): score 0.25 + 0.25*dl_rank <= 0.5 -> admitted
+        assert not a.should_shed(1, 100.0, 30000.0)
+
+    def test_longest_deadline_breaks_ties(self):
+        a = AdaptiveAdmission(100, shed_start=0.5, levels=4, alpha=1.0)
+        a.observe(80)  # overload 0.6 -> cutoff score 0.4
+        # the marginal class p1 (priority score 0.25): a tight deadline
+        # stays under the cutoff (0.25 + ~0.001 < 0.4), while a long or
+        # absent deadline — the most shed-tolerant work — goes over
+        # (0.25 + 0.25 = 0.5 > 0.4)
+        assert not a.should_shed(1, 100.0, 30000.0)
+        assert a.should_shed(1, None)
+        assert a.should_shed(1, 30000.0, 30000.0)
+
+    def test_ewma_decays_back_to_admitting(self):
+        a = AdaptiveAdmission(10, shed_start=0.5, levels=4, alpha=0.5)
+        a.observe(100)
+        assert a.should_shed(3, None)
+        for _ in range(20):
+            a.observe(0)  # the sweep feeds the EWMA when idle
+        assert a.overload() == 0.0
+        assert not a.should_shed(3, None)
+
+
+# -- fast: wire protocol ------------------------------------------------------
+
+class TestWireProtocol:
+    def test_round_trip_header_and_arrays(self):
+        s1, s2 = socket.socketpair()
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.array([[1, 2]], dtype=np.int64)
+        wire.send_msg(s1, {"kind": "infer", "id": 9,
+                           "deadline_ms": 12.5}, [a, b])
+        h, arrs = wire.recv_msg(s2)
+        assert h["kind"] == "infer" and h["id"] == 9
+        assert h["deadline_ms"] == 12.5 and h["n"] == 2
+        np.testing.assert_array_equal(arrs[0], a)
+        np.testing.assert_array_equal(arrs[1], b)
+        assert arrs[0].dtype == np.float32 and arrs[1].dtype == np.int64
+
+    def test_peer_close_is_connection_error(self):
+        s1, s2 = socket.socketpair()
+        s1.close()
+        with pytest.raises(ConnectionError):
+            wire.recv_msg(s2)
+
+    def test_mid_frame_close_is_connection_error(self):
+        s1, s2 = socket.socketpair()
+        s1.sendall(b"\x40\x00\x00\x00{")  # claims 64 bytes, sends 1
+        s1.close()
+        with pytest.raises(ConnectionError, match="mid-frame"):
+            wire.recv_msg(s2)
+
+    def test_object_arrays_refused(self):
+        # the no-pickle contract: an object array must fail at SEND
+        s1, _ = socket.socketpair()
+        with pytest.raises(Exception):
+            wire.send_msg(s1, {"kind": "infer", "id": 1},
+                          [np.array([object()])])
+
+    def test_idle_hook_can_abort(self):
+        s1, s2 = socket.socketpair()
+        s2.settimeout(0.01)
+
+        class Abort(Exception):
+            pass
+
+        def idle():
+            raise Abort
+
+        with pytest.raises(Abort):
+            wire.recv_msg(s2, idle=idle)
+
+    def test_idle_timeout_preserves_partial_frame(self):
+        # a timeout mid-frame must not desynchronize the stream
+        s1, s2 = socket.socketpair()
+        s2.settimeout(0.02)
+        a = np.ones((2, 2), np.float32)
+        done = threading.Event()
+
+        def slow_send():
+            import io as _io
+            buf = _io.BytesIO()
+            np.lib.format.write_array(buf, a, allow_pickle=False)
+            blob = buf.getvalue()
+            hb = json.dumps({"kind": "result", "id": 1, "n": 1}).encode()
+            import struct as _struct
+            frame = (_struct.pack("<I", len(hb)) + hb
+                     + _struct.pack("<I", len(blob)) + blob)
+            for i in range(0, len(frame), 7):
+                s1.sendall(frame[i:i + 7])
+                time.sleep(0.005)  # forces timeouts between chunks
+            done.set()
+
+        t = threading.Thread(target=slow_send)
+        t.start()
+        h, arrs = wire.recv_msg(s2, idle=lambda: None)
+        t.join()
+        assert h["id"] == 1
+        np.testing.assert_array_equal(arrs[0], a)
+
+
+# -- fast: FleetFuture --------------------------------------------------------
+
+class TestFleetFuture:
+    def test_first_wins_value_then_exception(self):
+        f = FleetFuture()
+        assert f._set_value([np.ones(3)], "v1")
+        assert not f._set_exception(RuntimeError("late"))
+        assert f.version == "v1"
+        np.testing.assert_array_equal(f.result(), np.ones(3))
+
+    def test_first_wins_exception_then_value(self):
+        f = FleetFuture()
+        assert f._set_exception(ReplicaFailed("gone"))
+        assert not f._set_value([np.ones(3)], "v1")
+        with pytest.raises(ReplicaFailed):
+            f.result()
+
+    def test_multi_output_list(self):
+        f = FleetFuture()
+        f._set_value([np.ones(2), np.zeros(2)], "v1")
+        outs = f.result()
+        assert isinstance(outs, list) and len(outs) == 2
+
+    def test_result_timeout_typed(self):
+        f = FleetFuture()
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded, match="still in flight"):
+            f.result(timeout=0.05)
+        assert time.monotonic() - t0 < 5
+        # the request later resolves: first-wins, reader can come back
+        f._set_value([np.ones(1)], "v2")
+        assert f.result().shape == (1,)
+
+
+# -- fast: metrics groups -----------------------------------------------------
+
+class TestMetricsGroups:
+    def test_per_label_isolation_and_aggregate(self):
+        g = MetricsGroup("version")
+        g.child("v1").counter("responses_total").inc(3)
+        g.child("v2").counter("responses_total").inc(4)
+        g.child("v1").histogram("e2e_ms").observe(10.0)
+        g.child("v2").histogram("e2e_ms").observe(50.0)
+        snap = g.snapshot()
+        assert snap["v1"]["counters"]["responses_total"] == 3
+        agg = g.aggregate()
+        assert agg["counters"]["responses_total"] == 7
+        h = agg["histograms"]["e2e_ms"]
+        assert h["count"] == 2 and h["sum"] == 60.0
+        assert h["max"] == 50.0  # conservative: worst child
+
+    def test_group_render_text_labels(self):
+        g = MetricsGroup("replica")
+        g.child(0).counter("responses_total").inc()
+        g.child(1).counter("responses_total").inc(2)
+        text = g.render_text()
+        assert 'p1t_serving_responses_total{replica="0"} 1' in text
+        assert 'p1t_serving_responses_total{replica="1"} 2' in text
+
+    def test_merge_snapshots_cross_process_shape(self):
+        # exactly what fleet_snapshot(include_replicas=True) merges:
+        # plain dicts that rode the wire as JSON
+        m = ServingMetrics()
+        m.counter("requests_total").inc(5)
+        m.histogram("queue_ms").observe(2.0)
+        s1 = json.loads(json.dumps(m.snapshot()))
+        s2 = json.loads(json.dumps(m.snapshot()))
+        agg = merge_snapshots([s1, s2])
+        assert agg["counters"]["requests_total"] == 10
+        assert agg["histograms"]["queue_ms"]["count"] == 2
+
+
+# -- fast: replica chaos points ----------------------------------------------
+
+class TestReplicaChaosPoints:
+    def teardown_method(self):
+        chaos.reset()
+
+    def test_shared_counter_and_qualifier(self):
+        chaos.configure("replica_kill@3:1,replica_slow@2:0")
+        assert chaos.check_replica(0) is None       # req 1
+        assert chaos.check_replica(0) == "replica_slow"   # req 2
+        assert chaos.check_replica(0) is None       # req 3: wrong rank
+        chaos.reset()
+        chaos.configure("replica_kill@3:1")
+        assert chaos.check_replica(1) is None
+        assert chaos.check_replica(1) is None
+        assert chaos.check_replica(1) == "replica_kill"
+
+    def test_kill_beats_hang_beats_slow(self):
+        chaos.configure("replica_kill@1,replica_hang@1,replica_slow@1")
+        assert chaos.check_replica(0) == "replica_kill"
+
+    def test_spec_round_trips_active_spec(self):
+        chaos.configure("replica_hang@4:2")
+        assert chaos.active_spec() == "replica_hang@4:2"
+
+
+# -- fast: Supervisor non-trainer adoption (plain-stdlib workers) -------------
+
+BEATER = textwrap.dedent("""
+    import os, sys, time
+    hb = os.environ["PADDLE_FT_HEARTBEAT_FILE"]
+    if os.environ.get("EXIT_RC"):
+        sys.exit(int(os.environ["EXIT_RC"]))
+    n = int(os.environ.get("BEATS", "3000"))
+    for _ in range(n):
+        os.utime(hb, None)
+        time.sleep(0.02)
+""")
+
+GRANDCHILD_ENV = textwrap.dedent("""
+    import importlib.util, json, os, subprocess, sys, time
+    spec = importlib.util.spec_from_file_location(
+        "health", os.environ["HEALTH_PY"])
+    health = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(health)
+    health.beat()   # adopts + POPS the PADDLE_FT_* env (replica.py
+                    # calls this before anything else for this reason)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import os, json; print(json.dumps(sorted("
+         "k for k in os.environ if k.startswith('PADDLE_FT_'))))"],
+        capture_output=True, text=True)
+    with open(os.environ["RESULT_FILE"], "w") as f:
+        f.write(out.stdout.strip())
+    for _ in range(100):
+        health.beat()
+        time.sleep(0.02)
+""")
+
+
+def _sup(tmp_path, **kw):
+    from paddle1_tpu.distributed.supervisor import Supervisor
+    kw.setdefault("policy", "restart")
+    kw.setdefault("elastic", False)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 3.0)
+    kw.setdefault("hang_timeout", 5.0)
+    kw.setdefault("heartbeat_dir", str(tmp_path / "hb"))
+    return Supervisor(**kw)
+
+
+def _worker(tmp_path, body, name="worker.py"):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+class TestSupervisorAdoption:
+    def test_clean_exit_is_done_not_failure(self, tmp_path):
+        """An essential=False replica exiting 0 (a retire/drain) is
+        role-complete — supervise_once reports nothing."""
+        w = _worker(tmp_path, BEATER)
+        sup = _sup(tmp_path)
+        sup.add_worker(0, [sys.executable, "-u", w],
+                       env=dict(os.environ, BEATS="1"), role="replica")
+        sup.start()
+        t0 = time.monotonic()
+        events = []
+        while time.monotonic() - t0 < 30:
+            events += sup.supervise_once()
+            if sup.worker_done(0):
+                break
+            time.sleep(0.05)
+        assert sup.worker_done(0)
+        assert events == []
+        assert sup.report.failures == []
+
+    def test_restart_then_budget_exhaustion(self, tmp_path):
+        """A crashing replica is relaunched within budget; exhaustion
+        surfaces as a restart_exhausted event ONCE (the corpse must not
+        re-report every sweep)."""
+        w = _worker(tmp_path, BEATER)
+        sup = _sup(tmp_path, max_restarts=1)
+        sup.add_worker(0, [sys.executable, "-u", w],
+                       env=dict(os.environ, EXIT_RC="3"), role="replica")
+        sup.start()
+        actions = []
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            for ev in sup.supervise_once():
+                actions.append(ev.action)
+            if "restart_exhausted" in actions:
+                break
+            time.sleep(0.05)
+        assert actions == ["restarted", "restart_exhausted"]
+        assert sup.incarnation(0) == 1
+        assert sup.restarts_used(0) == 1
+        # abandoned: further sweeps stay quiet
+        for _ in range(5):
+            assert sup.supervise_once() == []
+            time.sleep(0.02)
+
+    def test_per_worker_zero_budget(self, tmp_path):
+        """max_restarts=0 (the deploy-canary setting): first failure is
+        immediately terminal, no relaunch."""
+        w = _worker(tmp_path, BEATER)
+        sup = _sup(tmp_path, max_restarts=5)
+        sup.add_worker(0, [sys.executable, "-u", w],
+                       env=dict(os.environ, EXIT_RC="3"),
+                       role="replica", max_restarts=0)
+        sup.start()
+        t0 = time.monotonic()
+        actions = []
+        while time.monotonic() - t0 < 30 and not actions:
+            actions = [ev.action for ev in sup.supervise_once()]
+            time.sleep(0.05)
+        assert actions == ["restart_exhausted"]
+        assert sup.restarts_used(0) == 0
+
+    def test_retire_exit_never_classified(self, tmp_path):
+        """retire() SIGTERMs and removes the rank — the exit must not
+        appear as a failure (the hot-swap old-replica path)."""
+        w = _worker(tmp_path, BEATER)
+        sup = _sup(tmp_path)
+        sup.add_worker(0, [sys.executable, "-u", w],
+                       env=dict(os.environ), role="replica")
+        sup.start()
+        time.sleep(0.3)
+        sup.retire(0, grace_s=5.0)
+        assert sup.worker_ranks() == []
+        assert sup.supervise_once() == []
+        assert sup.report.failures == []
+
+    def test_heartbeat_env_not_leaked_to_grandchildren(self, tmp_path):
+        """The PR 3 gotcha, replica flavor: the worker adopts the
+        channel (health.beat first), so its grandchildren see NO
+        PADDLE_FT_* vars — a grandchild beating the replica's file
+        would mask a real replica hang."""
+        w = _worker(tmp_path, GRANDCHILD_ENV)
+        result = tmp_path / "grandchild_env.json"
+        health_py = os.path.join(REPO, "paddle1_tpu", "core",
+                                 "health.py")
+        sup = _sup(tmp_path)
+        sup.add_worker(0, [sys.executable, "-u", w],
+                       env=dict(os.environ, HEALTH_PY=health_py,
+                                RESULT_FILE=str(result)),
+                       role="replica")
+        sup.start()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30 and not result.exists():
+            sup.supervise_once()
+            time.sleep(0.05)
+        time.sleep(0.2)
+        assert result.exists(), "worker never wrote the probe result"
+        assert json.loads(result.read_text()) == []
+        sup.retire(0, grace_s=2.0)
+
+    def test_fleet_latches_unhealthy_on_exhaustion(self, tmp_path):
+        """Budget exhaustion marks the FLEET unhealthy (the outer
+        supervisor's signal) — but a probation canary's death does
+        not."""
+        from paddle1_tpu.serving.fleet import _ReplicaClient
+        fleet = ServingFleet("x.py:f", replicas=1,
+                             work_dir=str(tmp_path))
+        standing = _ReplicaClient(fleet, 0, "v1",
+                                  str(tmp_path / "ep0.json"))
+        canary = _ReplicaClient(fleet, 1, "v2",
+                                str(tmp_path / "ep1.json"),
+                                probation=True)
+        assert fleet.healthy
+        fleet._on_replica_exhausted(canary, None)
+        assert fleet.healthy  # deploy failure, not fleet degradation
+        fleet._on_replica_exhausted(standing, None)
+        assert not fleet.healthy
+        assert standing.state == "failed"
+
+
+# -- fast: replica model loading ---------------------------------------------
+
+class TestReplicaModelLoading:
+    def test_file_factory(self, tmp_path):
+        from paddle1_tpu.serving.replica import load_model
+        p = tmp_path / "factory.py"
+        p.write_text(FACTORY)
+        fwd = load_model(f"{p}:make_model", "v1")
+        out = np.asarray(fwd(np.zeros((1, 8), np.float32)))
+        assert out.shape == (1, 4)
+
+    def test_factory_error_propagates(self, tmp_path):
+        from paddle1_tpu.serving.replica import load_model
+        p = tmp_path / "factory.py"
+        p.write_text(FACTORY)
+        with pytest.raises(RuntimeError, match="broken artifact"):
+            load_model(f"{p}:make_model", "boom")
+
+    def test_bad_spec_typed(self):
+        from paddle1_tpu.serving.replica import load_model
+        with pytest.raises(ValueError, match="model spec"):
+            load_model("no-colon-here")
+
+
+# -- slow: the real replica-subprocess matrix ---------------------------------
+
+def _make_fleet(tmp_path, n=2, chaos_spec=None, **kw):
+    factory = tmp_path / "factory.py"
+    factory.write_text(FACTORY)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("buckets", (1, 8))
+    kw.setdefault("batch_timeout_ms", 2)
+    kw.setdefault("input_specs", [((8,), "float32")])
+    kw.setdefault("warmup", True)
+    kw.setdefault("hang_timeout", 30.0)
+    kw.setdefault("poll_s", 0.1)
+    kw.setdefault("version", "v1")
+    kw.setdefault("model_arg", "v1")
+    # small in-flight cap: a request burst must SPREAD across replicas
+    # (with a large cap the first-connected replica can hoover a whole
+    # burst and a rank-qualified chaos point never sees its Nth request)
+    kw.setdefault("inflight_per_replica", 4)
+    env = kw.pop("env", {})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return ServingFleet(f"{factory}:make_model", replicas=n, env=env,
+                        work_dir=str(tmp_path / "fleet"),
+                        chaos_spec=chaos_spec, **kw)
+
+
+def _reference(version="v1"):
+    """The single-process engine answer for the FACTORY model."""
+    rng = np.random.default_rng(0)
+    W1 = (rng.standard_normal((8, 16)) * 0.1).astype(np.float32)
+    b1 = np.zeros(16, np.float32)
+    W2 = (rng.standard_normal((16, 4)) * 0.1).astype(np.float32)
+    b2 = np.zeros(4, np.float32)
+    scale = 2.0 if version == "v2" else 1.0
+
+    def fwd(x):
+        h = np.maximum(x @ W1 + b1, 0)
+        return (h @ W2 + b2) * scale
+    return fwd
+
+
+@pytest.mark.slow
+class TestFleetSubprocessMatrix:
+    def test_kill_failover_every_request_resolves(self, tmp_path):
+        """replica_kill mid-load: in-flight work fails over to the
+        survivor, the Supervisor relaunches the rank, zero
+        client-visible failures, unaccounted == 0."""
+        fleet = _make_fleet(tmp_path, n=2, retry_max=3,
+                            replica_timeout_ms=60000,
+                            chaos_spec="replica_kill@5:1")
+        fleet.start()
+        try:
+            rng = np.random.default_rng(1)
+            xs = [rng.standard_normal((1, 8)).astype(np.float32)
+                  for _ in range(60)]
+            futs = [fleet.submit(x) for x in xs]
+            outs = [f.result(timeout=300) for f in futs]
+            ref = _reference("v1")
+            err = max(float(np.max(np.abs(ref(x) - o)))
+                      for x, o in zip(xs, outs))
+            assert err <= 1e-6, err
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["completed"] == 60
+        assert rep["errors"] == 0
+        assert rep["replica_restarts"] >= 1, rep
+
+    def test_hot_swap_zero_drops_and_version_split(self, tmp_path):
+        """deploy under load: zero dropped requests, responses tagged
+        per version, each matching its own reference at 1e-6, metrics
+        split by version."""
+        fleet = _make_fleet(tmp_path, n=2)
+        fleet.start()
+        stop = threading.Event()
+        got, failures = [], []
+        rng = np.random.default_rng(2)
+        xs = [rng.standard_normal((1, 8)).astype(np.float32)
+              for _ in range(16)]
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                i = (i + 1) % len(xs)
+                try:
+                    f = fleet.submit(xs[i])
+                    got.append((i, f, f.result(timeout=300)))
+                except Exception as e:  # noqa: broad-except — ANY
+                    # failure during the swap fails the zero-drop gate
+                    failures.append(repr(e))
+        t = threading.Thread(target=pump)
+        t.start()
+        try:
+            res = fleet.deploy(fleet.model_spec, "v2", model_arg="v2",
+                               canary=[np.zeros((1, 8), np.float32)])
+        finally:
+            stop.set()
+            t.join(timeout=300)
+        assert res["rolled"] == 2
+        assert not failures, failures[:3]
+        refs = {"v1": _reference("v1"), "v2": _reference("v2")}
+        err = max(float(np.max(np.abs(refs[f.version](xs[i]) - o)))
+                  for i, f, o in got)
+        assert err <= 1e-6, err
+        # tail of the pump ran on v2
+        assert got[-1][1].version == "v2"
+        by_version = fleet.version_metrics.snapshot()
+        assert "v2" in by_version
+        try:
+            assert by_version["v2"]["counters"]["responses_total"] >= 1
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["deploys"] == 1
+
+    def test_failed_canary_rolls_back_still_serving(self, tmp_path):
+        fleet = _make_fleet(tmp_path, n=2)
+        fleet.start()
+        try:
+            with pytest.raises(DeployFailed, match="canary"):
+                fleet.deploy(fleet.model_spec, "v2", model_arg="boom",
+                             ready_timeout_s=60)
+            assert fleet.healthy  # canary death is not fleet sickness
+            x = np.zeros((1, 8), np.float32)
+            f = fleet.submit(x)
+            out = f.result(timeout=120)
+            assert f.version == "v1"
+            assert float(np.max(np.abs(_reference("v1")(x) - out))) \
+                <= 1e-6
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["rollbacks"] == 1
+
+    def test_hang_breaker_failover(self, tmp_path):
+        """replica_hang: the replica stops reading but keeps
+        heartbeating — only the fleet's transport deadline can see it.
+        In-flight work fails over, the rank is force-restarted, every
+        request resolves."""
+        fleet = _make_fleet(tmp_path, n=2, retry_max=3,
+                            replica_timeout_ms=4000,
+                            chaos_spec="replica_hang@4:1")
+        fleet.start()
+        try:
+            rng = np.random.default_rng(3)
+            xs = [rng.standard_normal((1, 8)).astype(np.float32)
+                  for _ in range(40)]
+            futs = [fleet.submit(x) for x in xs]
+            outs = [f.result(timeout=300) for f in futs]
+            assert len(outs) == 40
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["completed"] == 40
+        assert rep["errors"] == 0
+        assert rep["failovers"] >= 1, rep
+
+    def test_overload_sheds_low_priority_typed(self, tmp_path):
+        """Sustained overload (a wedged replica + a flood): adaptive
+        admission sheds low-priority work typed; priority 0 is never
+        adaptively shed; everything admitted resolves and the books
+        balance."""
+        fleet = _make_fleet(tmp_path, n=1, retry_max=3,
+                            replica_timeout_ms=60000,
+                            fleet_queue_depth=64, shed_start=0.5,
+                            chaos_spec="replica_slow@1:0",
+                            env={"JAX_PLATFORMS": "cpu",
+                                 "FLAGS_serve_chaos_slow_s": "2.0"})
+        fleet.start()
+        try:
+            x = np.zeros((1, 8), np.float32)
+            futs, sheds = [], []
+            for i in range(400):
+                prio = i % 4
+                try:
+                    futs.append(fleet.submit(x, priority=prio))
+                except ServerOverloaded as e:
+                    sheds.append((prio, "adaptive" in str(e)))
+            for f in futs:
+                f.result(timeout=300)
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["shed"] == len(sheds)
+        assert rep["shed_adaptive"] >= 1, rep
+        counters = fleet.metrics.snapshot()["counters"]
+        assert "shed_priority_0" not in counters, counters
+        adaptive_prios = {p for p, adaptive in sheds if adaptive}
+        assert adaptive_prios and 0 not in adaptive_prios
