@@ -110,6 +110,21 @@ supervisor-restarted replica replays clean):
 ``replica_slow``     — handle this request only after sleeping
                        ``serve_chaos_slow_s`` (a hiccuping replica —
                        drives the adaptive-admission overload path).
+
+Generative-serving points (checked by :func:`check_gen_step` once per
+continuous-batching decode step; the qualifier is a SLOT id)::
+
+``gen_slot_wedge``   — ``gen_slot_wedge@N[:S]``: on the Nth decode
+                       step, slot S (the lowest active slot when
+                       unqualified) is declared wedged. The engine must
+                       fail ONLY that slot's TokenStream typed, release
+                       the slot, and leave cohabiting sequences
+                       bit-identical to an uncontended run — the
+                       continuous-batching isolation contract.
+``gen_slow_step``    — stall the Nth decode dispatch for
+                       ``serve_chaos_slow_s`` (drives the mid-stream
+                       wall-deadline path). Action belongs to the
+                       engine loop; this stays pure bookkeeping.
 """
 
 from __future__ import annotations
@@ -124,12 +139,13 @@ __all__ = [
     "maybe_poison", "check_checkpoint_write", "check_loader",
     "check_preempt", "check_serve_slow", "check_worker",
     "check_sample", "check_loader_worker_kill", "check_loader_stall",
-    "check_replica",
+    "check_replica", "check_gen_step",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
     "LOADER_WORKER_KILL", "CORRUPT_SAMPLE", "LOADER_STALL",
     "REPLICA_KILL", "REPLICA_HANG", "REPLICA_SLOW",
+    "GEN_SLOT_WEDGE", "GEN_SLOW_STEP",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -146,6 +162,8 @@ LOADER_STALL = "loader_stall"
 REPLICA_KILL = "replica_kill"
 REPLICA_HANG = "replica_hang"
 REPLICA_SLOW = "replica_slow"
+GEN_SLOT_WEDGE = "gen_slot_wedge"
+GEN_SLOW_STEP = "gen_slow_step"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 # loader points share the worker points' ":qualifier" grammar, but the
@@ -153,7 +171,11 @@ _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 _LOADER_POINTS = (LOADER_WORKER_KILL, CORRUPT_SAMPLE, LOADER_STALL)
 # serving-replica points: the qualifier is the REPLICA rank in its fleet
 _REPLICA_POINTS = (REPLICA_KILL, REPLICA_HANG, REPLICA_SLOW)
-_QUALIFIED_POINTS = _WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
+# generative-serving points: the qualifier is a decode SLOT id; both
+# share the per-step counter check_gen_step advances
+_GEN_POINTS = (GEN_SLOT_WEDGE, GEN_SLOW_STEP)
+_QUALIFIED_POINTS = (_WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
+                     + _GEN_POINTS)
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
            PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
@@ -406,6 +428,36 @@ def check_replica(rank: int) -> Optional[str]:
             if (n, None) in armed or (n, rank) in armed:
                 return point
     return None
+
+
+def check_gen_step(active_slots) -> Tuple[Optional[int], bool]:
+    """Generative-serving points, evaluated ONCE per continuous-batching
+    decode step. Both points share one step counter: an entry
+    ``gen_slot_wedge@N:S`` reads "on the Nth decode step, wedge slot S"
+    (without ``:S`` the lowest active slot is wedged);
+    ``gen_slow_step@N`` stalls the Nth dispatch. Returns
+    ``(wedged_slot_or_None, slow)``; the *actions* (failing the slot's
+    stream typed + releasing it / sleeping ``serve_chaos_slow_s``)
+    belong to ``serving.generate`` — this stays pure bookkeeping, like
+    every other point."""
+    if not _armed_worker:
+        return None, False
+    active = sorted(int(s) for s in active_slots)
+    with _lock:
+        n = _counters.get("gen_step", 0) + 1
+        _counters["gen_step"] = n
+        slow = any(n == occ for occ, _ in
+                   _armed_worker.get(GEN_SLOW_STEP, ()))
+        wedged = None
+        for occ, slot in _armed_worker.get(GEN_SLOT_WEDGE, ()):
+            if occ != n:
+                continue
+            if slot is None:
+                wedged = active[0] if active else None
+            elif slot in active:
+                wedged = slot
+            break
+    return wedged, slow
 
 
 def _fire_qualified(point: str, qualifier: int) -> bool:

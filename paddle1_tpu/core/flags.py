@@ -501,6 +501,46 @@ def _define_builtin_flags() -> None:
                 "never adaptively shed; levels-1 = lowest, shed "
                 "first under overload).",
                 validator=lambda v: v >= 2)
+    # Generative serving (consumed by paddle1_tpu.serving.generate —
+    # the KV-cached continuous-batching decode engine; MIGRATING.md
+    # maps the reference FastGeneration/max_dec_len knobs onto these)
+    define_flag("serve_gen_slots", 16,
+                "Decode slots in the GenerationEngine's device-resident "
+                "KV cache — the continuous-batching degree: one jitted "
+                "decode dispatch per token advances up to this many "
+                "sequences, and new requests claim slots as finished "
+                "ones release theirs. The decode executable is "
+                "compiled ONCE for [slots, max_seq]; changing this "
+                "recompiles.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_gen_max_seq", 256,
+                "KV-cache sequence capacity per slot (prompt + "
+                "generated tokens). Sizes the preallocated per-layer "
+                "[slots, max_seq, heads, dim] cache; requests whose "
+                "prompt + token budget exceed it are rejected typed at "
+                "submit.",
+                validator=lambda v: v >= 2)
+    define_flag("serve_gen_prefill_buckets", "",
+                "Comma-separated prompt-length buckets the prefill "
+                "executable compiles (e.g. '16,64,256'); prompts pad "
+                "up to the smallest covering bucket, so prefill "
+                "compiles stay bounded while decode stays ONE "
+                "executable. Empty = powers of two up to "
+                "serve_gen_max_seq.")
+    define_flag("serve_gen_token_budget", 128,
+                "Server-side cap on generated tokens per request: a "
+                "stream still running when it exhausts the budget "
+                "fails mid-stream with typed DeadlineExceeded (the "
+                "client sees a truncation, not silence). Requests may "
+                "ask for fewer via max_new_tokens.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_gen_stream_buffer", 64,
+                "Bounded per-stream token buffer (the async_loss "
+                "in-flight-window idiom as backpressure): a client not "
+                "consuming its TokenStream parks its slot — the slot "
+                "stays claimed but stops decoding — until the buffer "
+                "drains, instead of growing host memory unboundedly.",
+                validator=lambda v: v >= 1)
     define_flag("serve_ready_timeout_s", 120.0,
                 "How long the fleet waits for a (re)spawned replica to "
                 "publish its endpoint and pass the ready handshake "

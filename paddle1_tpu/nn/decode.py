@@ -33,7 +33,63 @@ from ..ops import manip_ops, math_ops
 
 __all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode",
            "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
-           "SampleEmbeddingHelper", "BasicDecoder"]
+           "SampleEmbeddingHelper", "BasicDecoder",
+           "sample_logits_array", "greedy_logits_array"]
+
+
+# -- shared sampling ops (ISSUE 9) ------------------------------------------
+# Pure-jnp so the SAME math runs eagerly (the helpers below) and inside
+# a jitted/vmapped decode step (serving.generate samples per slot with
+# per-slot keys/temperatures WITHOUT leaving the compiled step). The
+# serving parity tests pin eager == jitted at a fixed key schedule.
+
+def greedy_logits_array(logits):
+    """Argmax sampling over the last axis (GreedyEmbeddingHelper's
+    math as a raw-array op)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+
+
+def sample_logits_array(logits, key, temperature=1.0, top_k=0):
+    """Temperature/top-k sampling over the last axis of raw ``logits``.
+
+    ``temperature``/``top_k`` may be python scalars or arrays
+    broadcastable to ``logits.shape[:-1]`` (the serving engine's
+    per-slot form). ``temperature <= 0`` selects greedy argmax for that
+    row — shape-static, so one executable covers mixed greedy/sampled
+    slots. ``top_k > 0`` keeps only values >= the k-th largest (ties
+    included) before the categorical draw. One ``key`` covers the whole
+    batch (per-row keys: vmap this function).
+    """
+    V = logits.shape[-1]
+    # static python scalars take the cheap lowering: the eager helpers
+    # pass plain floats/ints, and a statically-greedy or statically-
+    # unmasked call must not pay the full-vocab sort / extra argmax
+    # (the outputs are bit-identical either way — argmax IS the t<=0
+    # branch of the general form, and top_k<=0 leaves masked==scaled)
+    t_static = isinstance(temperature, (int, float))
+    if t_static and temperature <= 0 and isinstance(top_k, int):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int64)
+    t = jnp.broadcast_to(
+        jnp.asarray(temperature, logits.dtype), logits.shape[:-1])
+    scaled = logits / jnp.maximum(t, 1e-6)[..., None]
+    if isinstance(top_k, int) and top_k <= 0:
+        masked = scaled
+    else:
+        # dynamic per-row k: threshold = k-th largest via an ascending
+        # sort + take_along_axis (lax.top_k needs a static k)
+        tk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32),
+                              logits.shape[:-1])
+        srt = jnp.sort(logits, axis=-1)
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(V - tk, 0, V - 1)[..., None], axis=-1)
+        neg = jnp.finfo(logits.dtype).min
+        masked = jnp.where((tk[..., None] > 0) & (logits < kth), neg,
+                           scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    if t_static:  # statically > 0: the greedy branch is dead
+        return sampled.astype(jnp.int64)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(t <= 0, greedy, sampled).astype(jnp.int64)
 
 
 # -- nested-structure helpers (reference utils.map_structure role) ----------
@@ -375,7 +431,9 @@ class GreedyEmbeddingHelper(DecodeHelper):
                 manip_ops.zeros([B], "bool"))
 
     def sample(self, time, outputs, states):
-        return math_ops.argmax(outputs, axis=-1)
+        # the shared op (same math as the serving decode step's greedy
+        # slots): argmax over the vocab axis
+        return apply("greedy_sample", greedy_logits_array, (outputs,))
 
     def next_inputs(self, time, outputs, states, sample_ids):
         finished = apply("greedy_finished",
@@ -400,9 +458,10 @@ class SampleEmbeddingHelper(GreedyEmbeddingHelper):
         temp = self.softmax_temperature
 
         def f(logits):
-            lg = logits / temp if temp is not None else logits
-            return jax.random.categorical(key, lg, axis=-1).astype(
-                jnp.int64)
+            # the shared op: same draws as the serving decode step's
+            # per-slot sampler at the same key/temperature
+            return sample_logits_array(
+                logits, key, 1.0 if temp is None else temp)
         return apply("sample_categorical", f, (outputs,))
 
 

@@ -52,6 +52,17 @@ def _convert_attention_mask(attn_mask, dtype):
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # Serving decode cache (ISSUE 9): preallocated [slots, max_seq,
+    # heads, dim] K/V written in place at a per-slot cursor ``pos``
+    # ([slots] int32, tokens already written) via dynamic_update_slice.
+    # Unlike ``Cache`` — whose per-step concat grows the K/V shape, so
+    # every decode step is O(written) copy work AND a fresh trace — the
+    # GenCache shapes never change: one compiled decode executable
+    # serves every step of every sequence, and the write is O(new
+    # tokens). Rows at/past a slot's cursor hold stale garbage; the
+    # caller masks them (keys j <= pos+i) and the cursor overwrites
+    # them as it advances.
+    GenCache = collections.namedtuple("GenCache", ["k", "v", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
@@ -85,7 +96,27 @@ class MultiHeadAttention(Layer):
         else:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value))
-        if isinstance(cache, self.Cache):
+        if isinstance(cache, self.GenCache):
+            from ..autograd.engine import apply
+            import jax
+
+            def write(c, n, p):
+                # per-slot in-place write: row s gets its new [L, H, D]
+                # block at cursor p[s]. dynamic_update_slice clamps the
+                # start so an (engine-prevented) overflow can only
+                # corrupt the writing slot's own row, never a neighbor.
+                def one(row, new, pos):
+                    return jax.lax.dynamic_update_slice(
+                        row, new.astype(row.dtype), (pos, 0, 0))
+                return jax.vmap(one)(c, n, p)
+
+            k = apply("gen_cache_write_k", write, (cache.k, k, cache.pos))
+            v = apply("gen_cache_write_v", write, (cache.v, v, cache.pos))
+            new_tokens = query.shape[1]
+            pos = apply("gen_cache_advance",
+                        lambda p: p + np.int32(new_tokens), (cache.pos,))
+            cache = self.GenCache(k, v, pos)
+        elif isinstance(cache, self.Cache):
             k = manip_ops.concat([cache.k, k], axis=1)
             v = manip_ops.concat([cache.v, v], axis=1)
             cache = self.Cache(k, v)
@@ -103,6 +134,17 @@ class MultiHeadAttention(Layer):
         k = mo.zeros([b, 0, self.num_heads, self.head_dim], "float32")
         v = mo.zeros([b, 0, self.num_heads, self.head_dim], "float32")
         return self.Cache(k, v)
+
+    def gen_slot_cache(self, slots, max_seq, dtype="float32"):
+        """Preallocated serving decode cache: ``slots`` independent
+        sequences, each owning one ``[max_seq, heads, dim]`` K/V row
+        written at its own cursor (see :attr:`GenCache`). The arrays
+        never change shape, so the decode step compiles exactly once."""
+        from ..ops import manip_ops as mo
+        shape = [int(slots), int(max_seq), self.num_heads, self.head_dim]
+        return self.GenCache(mo.zeros(shape, dtype),
+                             mo.zeros(shape, dtype),
+                             mo.zeros([int(slots)], "int32"))
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
@@ -188,6 +230,9 @@ class TransformerEncoderLayer(Layer):
     def gen_cache(self, src):
         return self.self_attn.gen_cache(src)
 
+    def gen_slot_cache(self, slots, max_seq, dtype="float32"):
+        return self.self_attn.gen_slot_cache(slots, max_seq, dtype)
+
 
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
@@ -237,6 +282,12 @@ class TransformerEncoder(Layer):
 
     def gen_cache(self, src):
         return [layer.gen_cache(src) for layer in self.layers]
+
+    def gen_slot_cache(self, slots, max_seq, dtype="float32"):
+        """Per-layer preallocated slot caches for the serving decode
+        engine (one :attr:`MultiHeadAttention.GenCache` per block)."""
+        return [layer.gen_slot_cache(slots, max_seq, dtype)
+                for layer in self.layers]
 
     def _forward_pipelined(self, src, src_mask=None):
         """Block stack as an in-graph pipeline over the ``pipeline_axis``

@@ -24,6 +24,15 @@ applied to inference under load:
   rolling model hot-swap with canary rollback (:class:`DeployFailed`),
   and adaptive admission that sheds lowest-priority/longest-deadline
   work first under sustained overload.
+* :class:`~paddle1_tpu.serving.generate.GenerationServer` — generative
+  serving (ISSUE 9): a device-resident ``[slots, max_seq]`` KV-cache
+  decode engine with slot-based continuous batching (one jitted
+  dispatch per token for every active sequence; decode compiled
+  exactly once), prompt-length-bucketed prefill, in-step
+  greedy/temperature/top-k sampling on per-slot RNG keys, and
+  per-token :class:`TokenStream` futures with the Server's
+  admission/deadline/drain contracts extended to token-level
+  accounting.
 
 Quickstart::
 
@@ -44,15 +53,20 @@ Or straight from a deployed artifact::
 from .batcher import Batcher, ServeFuture
 from .engine import InferenceEngine, resolve_buckets
 from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
-                     ServerClosed, ServerOverloaded)
+                     ServerClosed, ServerOverloaded, SlotWedged,
+                     StreamCancelled)
 from .fleet import AdaptiveAdmission, FleetFuture, ServingFleet
-from .metrics import (Counter, Histogram, MetricsGroup, ServingMetrics,
-                      merge_snapshots)
+from .generate import (CausalLM, GenerationEngine, GenerationServer,
+                       TokenStream)
+from .metrics import (Counter, Gauge, Histogram, MetricsGroup,
+                      ServingMetrics, merge_snapshots)
 from .server import Server
 
 __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
-           "ServingMetrics", "Counter", "Histogram", "MetricsGroup",
-           "merge_snapshots", "ServerOverloaded", "DeadlineExceeded",
-           "ServerClosed", "ReplicaFailed", "DeployFailed",
+           "ServingMetrics", "Counter", "Gauge", "Histogram",
+           "MetricsGroup", "merge_snapshots", "ServerOverloaded",
+           "DeadlineExceeded", "ServerClosed", "ReplicaFailed",
+           "DeployFailed", "SlotWedged", "StreamCancelled",
            "ServingFleet", "FleetFuture", "AdaptiveAdmission",
-           "resolve_buckets"]
+           "GenerationEngine", "GenerationServer", "TokenStream",
+           "CausalLM", "resolve_buckets"]

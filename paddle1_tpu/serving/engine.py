@@ -46,23 +46,27 @@ def _dtype_str(dt) -> str:
     return s
 
 
-def resolve_buckets(buckets=None, max_batch: Optional[int] = None
+def resolve_buckets(buckets=None, max_batch: Optional[int] = None,
+                    spec_flag: str = "serve_buckets"
                     ) -> Tuple[int, ...]:
-    """Normalize the bucket list: explicit sequence > ``serve_buckets``
+    """Normalize the bucket list: explicit sequence > the ``spec_flag``
     flag > powers of two up to ``max_batch`` (``serve_max_batch`` flag).
-    Always sorted, deduped, and covering ``max_batch``."""
+    Always sorted, deduped, and covering ``max_batch``. The generation
+    engine reuses the same policy for PROMPT-LENGTH buckets by passing
+    ``spec_flag="serve_gen_prefill_buckets"`` (a different axis, so it
+    must never read the batch-size flag)."""
     explicit_max = max_batch is not None
     if max_batch is None:
         max_batch = int(core_flags.flag("serve_max_batch"))
     if buckets is None:
-        spec = core_flags.flag("serve_buckets")
+        spec = core_flags.flag(spec_flag)
         if spec:
             try:
                 buckets = [int(b) for b in str(spec).split(",") if
                            b.strip()]
             except ValueError:
                 raise InvalidArgumentError(
-                    f"serve_buckets must be comma-separated ints, got "
+                    f"{spec_flag} must be comma-separated ints, got "
                     f"{spec!r}") from None
     if buckets is None:
         buckets, b = [], 1

@@ -9,7 +9,8 @@ from ..core.errors import (ExecutionTimeoutError, PreconditionNotMetError,
                            ResourceExhaustedError, UnavailableError)
 
 __all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed",
-           "ReplicaFailed", "DeployFailed"]
+           "ReplicaFailed", "DeployFailed", "SlotWedged",
+           "StreamCancelled"]
 
 
 class ServerOverloaded(ResourceExhaustedError):
@@ -44,3 +45,20 @@ class DeployFailed(PreconditionNotMetError):
     failure, ready-handshake timeout, or a failed canary inference);
     the deploy was rolled back and the fleet keeps serving the old
     version."""
+
+
+class SlotWedged(UnavailableError):
+    """One decode slot of the generation engine wedged mid-stream (the
+    ``gen_slot_wedge`` chaos point's model of a poisoned request):
+    ONLY that request's TokenStream fails — delivered through the
+    stream, tokens already streamed stay valid — and the slot is
+    released; cohabiting sequences in the continuous batch are
+    untouched."""
+
+
+class StreamCancelled(UnavailableError):
+    """The client cancelled its TokenStream: the slot was released at
+    the next step boundary and no further tokens stream. Reading
+    ``result()`` on a cancelled stream raises this (iteration just
+    stops) — the cancel is client-initiated, so it counts as accounted,
+    not as a server failure."""

@@ -1,0 +1,1092 @@
+"""Generative serving: device-resident KV-cache decode with slot-based
+continuous batching and per-token streaming (ISSUE 9 / ROADMAP item 2).
+
+The eager decode stack (``nn.decode.dynamic_decode``) pays one host
+round trip — and, through the concat-based
+``MultiHeadAttention.Cache``, one growing-shape retrace — per token per
+sequence. This module is the serving analog of PR 1's ``step_many``:
+the whole autoregressive loop stays on device and ONE jitted dispatch
+per token advances every active sequence, however many there are and
+whenever each arrived.
+
+Design (Orca's iteration-level continuous batching + vLLM's
+preallocated KV management, adapted to a bucketed-XLA world where
+shapes must stay static):
+
+* **Prefill/decode split.** Prefill — the whole prompt in one causal
+  pass — is compiled once per *prompt-length bucket*
+  (``serve_gen_prefill_buckets``, resolved through the same
+  ``resolve_buckets`` policy as the batch buckets). Decode is compiled
+  exactly ONCE: its signature is pinned to the fixed
+  ``[slots, max_seq]`` cache, so ragged arrivals, ragged prompt
+  lengths, and any active-slot pattern reuse the same executable (the
+  ``decode_compile_count`` trace counter is the acceptance gate).
+* **Device-resident slot cache.** Per layer, preallocated
+  ``[slots, max_seq, heads, dim]`` K/V arrays
+  (:meth:`~paddle1_tpu.nn.MultiHeadAttention.gen_slot_cache`) written
+  in place at a per-slot cursor via ``dynamic_update_slice`` and
+  DONATED through every dispatch — no per-token cache copy, no
+  per-token reshape, no retrace.
+* **Slot-based continuous batching.** New requests claim free slots in
+  the running decode batch between steps, as finished ones release
+  theirs; a slot's rows are never read by any other slot (per-row
+  writes + per-slot causal masks), so cohabiting sequences are
+  bit-identical to an uncontended run — the isolation contract the
+  ``gen_slot_wedge`` chaos test pins.
+* **Sampling on device.** Greedy/temperature/top-k (the shared
+  ``nn.decode.sample_logits_array`` op) run *inside* the jitted step
+  with per-slot RNG keys (carried as raw key data, split per token),
+  so sampled decode is still one dispatch and a request's draws depend
+  only on (its seed, its token index) — never on its slot or its
+  neighbors.
+* **Per-token streaming.** Each request gets a :class:`TokenStream`
+  (iterator + ``cancel()``); a bounded per-stream buffer is the
+  backpressure (the ``core/async_loss`` bounded-window idiom): a
+  client that stops consuming parks its slot instead of growing host
+  memory. Admission/deadline/shed/drain follow the PR 4 Server
+  contracts, with the accounting extended to tokens:
+  ``tokens_generated == tokens_streamed + tokens_dropped`` and
+  request-level ``unaccounted == 0`` in every drain report.
+
+Quickstart::
+
+    lm = CausalLM(vocab_size=32000, d_model=512, nhead=8,
+                  num_layers=12, max_seq=512)
+    srv = GenerationServer(lm, slots=16, max_seq=512, eos_id=2).start()
+    stream = srv.submit(prompt_ids, max_new_tokens=128, temperature=0.8,
+                        top_k=40, seed=7)
+    for tok in stream:          # per-token, as they decode
+        print(tok)
+    srv.drain()                 # unaccounted == 0, tokens_owed == 0
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import chaos as core_chaos
+from ..core import flags as core_flags
+from ..core import health as core_health
+from ..core.errors import InvalidArgumentError
+from .engine import resolve_buckets
+from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     SlotWedged, StreamCancelled)
+from .metrics import ServingMetrics
+
+__all__ = ["CausalLM", "GenerationEngine", "GenerationServer",
+           "TokenStream"]
+
+
+# ---------------------------------------------------------------------------
+# reference model
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402  (nn loads
+# before serving in the package __init__, and nn never imports serving)
+
+
+class CausalLM(_Layer):
+    """Small decoder-only transformer LM built from the repo's own
+    blocks — the generation engine's reference model (tests/bench serve
+    it; users serve any Layer implementing the same contract:
+    ``gen_slot_cache(slots, max_seq)`` plus
+    ``forward(ids, cache=, positions=, attn_mask=)`` returning
+    ``(logits, new_cache)`` when a cache is passed).
+
+    Supports BOTH cache disciplines: the serving
+    :attr:`~paddle1_tpu.nn.MultiHeadAttention.GenCache` slot path and
+    the eager concat-based ``Cache`` path (``empty_cache``), so the
+    same weights drive the engine and the ``dynamic_decode`` baseline.
+    """
+
+    def __init__(self, vocab_size, d_model=64, nhead=4,
+                 dim_feedforward=128, num_layers=2, max_seq=256):
+        super().__init__()
+        from .. import nn
+        self.vocab_size = int(vocab_size)
+        self.max_seq = int(max_seq)
+        self.embed = nn.Embedding(self.vocab_size, d_model)
+        self.pos_embed = nn.Embedding(self.max_seq, d_model)
+        layer = nn.TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout=0.0)
+        self.encoder = nn.TransformerEncoder(layer, num_layers)
+        self.head = nn.Linear(d_model, self.vocab_size)
+
+    def gen_slot_cache(self, slots, max_seq, dtype="float32"):
+        return self.encoder.gen_slot_cache(slots, max_seq, dtype)
+
+    def empty_cache(self, batch):
+        """Eager incremental-decode cache (the concat-based ``Cache``
+        path ``dynamic_decode`` drives)."""
+        from ..core.tensor import to_tensor
+        return self.encoder.gen_cache(
+            to_tensor(np.zeros((int(batch), 1), np.float32)))
+
+    def forward(self, ids, cache=None, positions=None, attn_mask=None):
+        from ..core.tensor import to_tensor
+        from ..nn import MultiHeadAttention
+        B, L = ids.shape[0], ids.shape[1]
+        off = 0
+        if cache is not None and isinstance(
+                cache[0], MultiHeadAttention.Cache):
+            off = cache[0].k.shape[1]
+        if positions is None:
+            positions = to_tensor(np.broadcast_to(
+                np.arange(off, off + L, dtype=np.int64), (B, L)).copy())
+        x = self.embed(ids) + self.pos_embed(positions)
+        if attn_mask is None and L > 1:
+            # causal over the (cached + new) key length: needed for any
+            # multi-query pass — the no-cache forward AND the eager
+            # concat-cache prefill (single-query decode needs none)
+            j = np.arange(off + L)[None, :]
+            i = np.arange(L)[:, None]
+            attn_mask = to_tensor((j <= off + i)[None, None])
+        out = self.encoder(x, attn_mask, cache)
+        if cache is None:
+            return self.head(out)
+        h, new_caches = out
+        return self.head(h), new_caches
+
+
+# ---------------------------------------------------------------------------
+# token stream
+
+
+class TokenStream:
+    """Per-request streaming handle: iterate tokens as they decode.
+
+    The engine side ``_put``s tokens and ``_finish``es the stream
+    (first-wins, like :class:`~paddle1_tpu.serving.batcher.ServeFuture`);
+    the client iterates (``for tok in stream``), collects
+    (``result()``), or ``cancel()``s. The buffer of *unconsumed* tokens
+    is bounded (``serve_gen_stream_buffer``): when full, the engine
+    parks the slot — decode for this request pauses, the device batch
+    keeps serving everyone else — until the client drains it.
+
+    ``finish_reason``: ``"eos"`` | ``"length"`` (requested
+    ``max_new_tokens`` reached) | ``"deadline"`` | ``"budget"`` (server
+    token budget cut the stream short — typed) | ``"cancelled"`` |
+    ``"error"`` (incl. a drain that ran out of patience — the typed
+    exception says which).
+    """
+
+    def __init__(self, buffer_cap: int):
+        self._cond = threading.Condition()
+        self._cap = int(buffer_cap)
+        self._buf: collections.deque = collections.deque()
+        self._all: List[int] = []
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._cancel_requested = False
+        self.finish_reason: Optional[str] = None
+
+    # -- engine side --------------------------------------------------------
+
+    def _writable(self) -> bool:
+        return len(self._buf) < self._cap
+
+    def _put(self, tok: int) -> bool:
+        with self._cond:
+            if self._done:
+                return False
+            self._buf.append(int(tok))
+            self._all.append(int(tok))
+            self._cond.notify_all()
+        return True
+
+    def _finish(self, reason: str,
+                exc: Optional[BaseException] = None) -> bool:
+        with self._cond:
+            if self._done:
+                return False
+            self._done = True
+            self.finish_reason = reason
+            self._exc = exc
+            self._cond.notify_all()
+        return True
+
+    # -- client side --------------------------------------------------------
+
+    def cancel(self) -> None:
+        """Ask the engine to release this request's slot at the next
+        step boundary; no further tokens stream. Idempotent; a stream
+        that already finished is untouched."""
+        with self._cond:
+            self._cancel_requested = True
+            self._cond.notify_all()
+
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def tokens(self) -> List[int]:
+        """Every token streamed so far (a snapshot copy)."""
+        with self._cond:
+            return list(self._all)
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        with self._cond:
+            while True:
+                if self._buf:
+                    tok = self._buf.popleft()
+                    self._cond.notify_all()  # engine may unpark
+                    return tok
+                if self._done:
+                    # buffered tokens always drain first; a typed
+                    # failure surfaces MID-stream, after everything
+                    # that was generated before it
+                    if self._exc is not None and \
+                            self.finish_reason != "cancelled":
+                        raise self._exc
+                    raise StopIteration
+                self._cond.wait()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream finishes; the full token list.
+        Raises the stream's typed error (incl. :class:`StreamCancelled`
+        after a cancel) — partial tokens stay readable via
+        :attr:`tokens`. This IS a consumer: it drains the bounded
+        buffer while waiting (``_all`` keeps everything), so a parked
+        slot resumes — don't mix it with iteration."""
+        with self._cond:
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
+            while not self._done:
+                if self._buf:
+                    self._buf.clear()  # consumed; engine may unpark
+                    self._cond.notify_all()
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise DeadlineExceeded(
+                        f"TokenStream not finished within {timeout}s — "
+                        "the request is still decoding (reader "
+                        "deadline only; the stream stays accounted)")
+                self._cond.wait(rem)
+            if self._exc is not None:
+                raise self._exc
+            return list(self._all)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
+                 "stream", "deadline", "t_enq", "truncated_by_budget",
+                 "slot", "n_generated", "t_first")
+
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 temperature: float, top_k: int, seed: int,
+                 deadline_s: Optional[float], stream: TokenStream,
+                 truncated_by_budget: bool):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.stream = stream
+        self.t_enq = time.monotonic()
+        self.deadline = (self.t_enq + deadline_s
+                         if deadline_s else None)
+        self.truncated_by_budget = truncated_by_budget
+        self.slot = -1
+        self.n_generated = 0
+        self.t_first = 0.0
+
+
+# ---------------------------------------------------------------------------
+# engine
+
+
+class GenerationEngine:
+    """Device state + compiled executables of the decode loop.
+
+    Owns the per-layer ``[slots, max_seq, heads, dim]`` KV cache and
+    the per-slot cursor/token/RNG/sampling arrays, all donated through
+    every dispatch. :meth:`prefill` runs one prompt (padded to its
+    length bucket) into a slot and samples the first token;
+    :meth:`decode` advances EVERY active slot by one token in one
+    dispatch. Slot scheduling (who is active, stream delivery,
+    deadlines) lives in :class:`GenerationServer` — the engine is
+    purely the device side, so it is reusable under a different front
+    end.
+    """
+
+    def __init__(self, model, slots: Optional[int] = None,
+                 max_seq: Optional[int] = None, prefill_buckets=None,
+                 eos_id: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 cache_dtype: str = "float32"):
+        core_flags.maybe_enable_compilation_cache()
+        import jax
+        self.metrics = metrics
+        self.slots = int(slots if slots is not None
+                         else core_flags.flag("serve_gen_slots"))
+        self.max_seq = int(max_seq if max_seq is not None
+                           else core_flags.flag("serve_gen_max_seq"))
+        if self.slots < 1 or self.max_seq < 2:
+            raise InvalidArgumentError(
+                f"need slots >= 1 and max_seq >= 2, got "
+                f"{self.slots}/{self.max_seq}")
+        self.prefill_buckets = self._resolve_prefill_buckets(
+            prefill_buckets, self.max_seq)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        if not hasattr(model, "gen_slot_cache"):
+            raise InvalidArgumentError(
+                "GenerationEngine needs a model with the generation "
+                "contract: gen_slot_cache(slots, max_seq) and "
+                "forward(ids, cache=, positions=, attn_mask=) -> "
+                f"(logits, new_cache); got {type(model).__name__}")
+        model_cap = getattr(model, "max_seq", None)
+        if model_cap is not None and int(model_cap) < self.max_seq:
+            # positions past the model's embedding table would CLAMP
+            # under jit (jnp.take semantics) and silently degrade every
+            # long sequence — reject the config typed instead
+            raise InvalidArgumentError(
+                f"engine max_seq={self.max_seq} exceeds the model's "
+                f"positional capacity (model.max_seq={int(model_cap)})"
+                " — positions past the table would silently clamp; "
+                "build the model with max_seq >= the engine's")
+        model.eval()
+        self._model = model
+        self._params = model.functional_state()
+        self._lock = threading.Lock()
+        # trace-side-effect counters — the "exactly one decode compile"
+        # acceptance gate reads decode_compile_count
+        self.decode_compile_count = 0
+        self.decode_dispatch_count = 0
+        self.prefill_compile_counts: Dict[int, int] = {}
+        self.prefill_dispatch_counts: Dict[int, int] = {}
+
+        # device state (donated through every dispatch)
+        import jax.numpy as jnp
+        cache = model.gen_slot_cache(self.slots, self.max_seq,
+                                     cache_dtype)
+        self._kv = [(c.k.data, c.v.data) for c in cache]
+        self._lengths = jnp.zeros([self.slots], jnp.int32)
+        self._tokens = jnp.zeros([self.slots], jnp.int32)
+        self._keys = jnp.zeros(
+            [self.slots] + list(jax.random.key_data(
+                jax.random.key(0)).shape), jnp.uint32)
+        self._temps = jnp.zeros([self.slots], jnp.float32)
+        self._topks = jnp.zeros([self.slots], jnp.int32)
+
+        self._decode_jit = jax.jit(self._decode_fn,
+                                   donate_argnums=(1,))
+        self._prefill_jits: Dict[int, object] = {}
+
+    @staticmethod
+    def _resolve_prefill_buckets(buckets, max_seq):
+        # the batch-bucket policy, retargeted at the prompt-length axis
+        # (spec_flag keeps it off the serve_buckets BATCH flag)
+        out = resolve_buckets(buckets, max_seq,
+                              spec_flag="serve_gen_prefill_buckets")
+        if out[-1] > max_seq:
+            raise InvalidArgumentError(
+                f"prefill bucket {out[-1]} exceeds serve_gen_max_seq="
+                f"{max_seq} — a prompt that long could never decode")
+        return out
+
+    # -- traced bodies ------------------------------------------------------
+
+    def _apply_model(self, params, ids, caches, positions, attn_mask):
+        """Run the model functionally on raw arrays (the
+        InferenceEngine idiom: params ride as jit args, dropout off,
+        RNG pinned)."""
+        import jax
+        from ..autograd import engine as autograd_engine
+        from ..core.generator import rng_scope
+        from ..core.tensor import Tensor
+        with autograd_engine.no_grad(), rng_scope(jax.random.key(0)):
+            with self._model.load_functional_state(params):
+                logits, new_caches = self._model(
+                    Tensor(ids, stop_gradient=True),
+                    cache=caches,
+                    positions=Tensor(positions, stop_gradient=True),
+                    attn_mask=Tensor(attn_mask, stop_gradient=True))
+        return logits.data, new_caches
+
+    def _decode_fn(self, params, kv, lengths, tokens, keys, temps,
+                   topks, active):
+        """One token for every slot; compiled exactly once. ``active``
+        gates advancement — inactive slots keep their token/length, so
+        parking a slot (backpressure, free slot) costs nothing and
+        never retraces."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn import MultiHeadAttention
+        from ..nn.decode import sample_logits_array
+        with self._lock:
+            self.decode_compile_count += 1
+        if self.metrics is not None:
+            self.metrics.counter("gen_decode_compiles_total").inc()
+        from ..core.tensor import Tensor
+        S, M = self.slots, self.max_seq
+        pos = jnp.minimum(lengths, M - 1)
+        caches = [MultiHeadAttention.GenCache(
+            Tensor(k, stop_gradient=True),
+            Tensor(v, stop_gradient=True),
+            Tensor(pos, stop_gradient=True)) for k, v in kv]
+        # keys j <= pos are valid: the fed token was just written AT pos
+        mask = (jnp.arange(M)[None, None, None, :]
+                <= pos[:, None, None, None])
+        logits, new_caches = self._apply_model(
+            params, tokens[:, None], caches, pos[:, None], mask)
+        lg = logits[:, -1, :].astype(jnp.float32)
+        kb = jax.random.wrap_key_data(keys)
+        ksamp = jax.vmap(lambda k: jax.random.fold_in(k, 0))(kb)
+        kcarry = jax.vmap(lambda k: jax.random.fold_in(k, 1))(kb)
+        nxt = jax.vmap(sample_logits_array)(lg, ksamp, temps, topks)
+        nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
+        new_lengths = jnp.where(active,
+                                jnp.minimum(lengths + 1, M), lengths)
+        new_keys = jnp.where(active[:, None],
+                             jax.random.key_data(kcarry), keys)
+        new_kv = [(c.k.data, c.v.data) for c in new_caches]
+        return new_kv, new_lengths, nxt, new_keys
+
+    def _prefill_fn_for(self, bucket: int):
+        """Build (once per bucket) the prefill body: the whole padded
+        prompt in one causal pass, K/V written into the slot's cache
+        rows, first token sampled from the last REAL position."""
+        import jax
+
+        def prefill_fn(params, kv, ids, length, slot, key, temp, topk):
+            import jax.numpy as jnp
+            from ..nn import MultiHeadAttention
+            from ..nn.decode import sample_logits_array
+            with self._lock:
+                self.prefill_compile_counts[bucket] = \
+                    self.prefill_compile_counts.get(bucket, 0) + 1
+            if self.metrics is not None:
+                self.metrics.counter("gen_prefill_compiles_total").inc()
+            from ..core.tensor import Tensor
+            L = bucket
+            small = []
+            for k_arr, v_arr in kv:
+                H, D = k_arr.shape[2], k_arr.shape[3]
+                z = jnp.zeros((1, L, H, D), k_arr.dtype)
+                small.append(MultiHeadAttention.GenCache(
+                    Tensor(z, stop_gradient=True),
+                    Tensor(z, stop_gradient=True),
+                    Tensor(jnp.zeros((1,), jnp.int32),
+                           stop_gradient=True)))
+            positions = jnp.arange(L, dtype=jnp.int32)[None]
+            causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+            logits, filled = self._apply_model(
+                params, ids[None], small, positions, causal)
+            new_kv = []
+            for (k_arr, v_arr), c in zip(kv, filled):
+                new_kv.append((
+                    jax.lax.dynamic_update_slice(
+                        k_arr, c.k.data.astype(k_arr.dtype),
+                        (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        v_arr, c.v.data.astype(v_arr.dtype),
+                        (slot, 0, 0, 0))))
+            last = jnp.take(logits[0], length - 1,
+                            axis=0).astype(jnp.float32)
+            kb = jax.random.wrap_key_data(key)
+            first = sample_logits_array(
+                last, jax.random.fold_in(kb, 0), temp, topk)
+            carry = jax.random.key_data(jax.random.fold_in(kb, 1))
+            return new_kv, first.astype(jnp.int32), carry
+        return jax.jit(prefill_fn, donate_argnums=(1,))
+
+    # -- host-side dispatch -------------------------------------------------
+
+    def bucket_for(self, prompt_len: int) -> int:
+        if prompt_len < 1:
+            raise InvalidArgumentError(
+                f"need a prompt of >= 1 token, got {prompt_len}")
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise InvalidArgumentError(
+            f"prompt of {prompt_len} tokens exceeds the largest "
+            f"prefill bucket {self.prefill_buckets[-1]} (buckets "
+            f"{list(self.prefill_buckets)}) — raise "
+            "serve_gen_prefill_buckets/serve_gen_max_seq")
+
+    def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
+                top_k: int, seed: int) -> int:
+        """Run one prompt into ``slot``; returns the first generated
+        token (host int). One dispatch on the bucket executable."""
+        import jax
+        import jax.numpy as jnp
+        P = int(np.shape(prompt)[0])
+        bucket = self.bucket_for(P)
+        if P + 1 > self.max_seq:
+            raise InvalidArgumentError(
+                f"prompt of {P} tokens leaves no room to generate "
+                f"within serve_gen_max_seq={self.max_seq}")
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits.setdefault(
+                bucket, self._prefill_fn_for(bucket))
+        ids = np.zeros([bucket], np.int32)
+        ids[:P] = np.asarray(prompt, np.int32)
+        base = jax.random.key_data(jax.random.fold_in(
+            jax.random.key(seed & 0x7FFFFFFF), 0))
+        with self._lock:
+            self.prefill_dispatch_counts[bucket] = \
+                self.prefill_dispatch_counts.get(bucket, 0) + 1
+        self._kv, first, carry = fn(
+            self._params, self._kv, jnp.asarray(ids),
+            np.int32(P), np.int32(slot), base,
+            np.float32(temperature), np.int32(top_k))
+        first = int(np.asarray(first))
+        # slot bookkeeping (small host-side .at updates, off the jitted
+        # path so they can't force a retrace)
+        self._lengths = self._lengths.at[slot].set(np.int32(P))
+        self._tokens = self._tokens.at[slot].set(np.int32(first))
+        self._keys = self._keys.at[slot].set(carry)
+        self._temps = self._temps.at[slot].set(np.float32(temperature))
+        self._topks = self._topks.at[slot].set(np.int32(top_k))
+        return first
+
+    def decode(self, active_mask: np.ndarray) -> np.ndarray:
+        """One decode step for the whole slot batch; returns the [slots]
+        next-token array (host). Exactly one device dispatch."""
+        import jax.numpy as jnp
+        with self._lock:
+            self.decode_dispatch_count += 1
+        self._kv, self._lengths, self._tokens, self._keys = \
+            self._decode_jit(self._params, self._kv, self._lengths,
+                             self._tokens, self._keys, self._temps,
+                             self._topks,
+                             jnp.asarray(active_mask, bool))
+        return np.asarray(self._tokens)
+
+    def release(self, slot: int) -> None:
+        """Free a slot: reset its cursor so idle writes stay parked at
+        row 0 (the next prefill overwrites everything it will read)."""
+        self._lengths = self._lengths.at[slot].set(np.int32(0))
+
+    def warm_up(self) -> int:
+        """Pre-compile every prefill bucket plus the decode executable
+        (first-token latency stops including XLA compiles). Returns the
+        number of executables compiled. Slot state is reset after."""
+        import jax
+        import jax.numpy as jnp
+        n = 0
+        for b in self.prefill_buckets:
+            self.prefill(0, np.zeros([min(b, self.max_seq - 1)],
+                                     np.int32), 0.0, 0, 0)
+            n += 1
+        self.decode(np.zeros([self.slots], bool))
+        n += 1
+        jax.block_until_ready(self._kv[0][0])
+        self._lengths = jnp.zeros([self.slots], jnp.int32)
+        self._tokens = jnp.zeros([self.slots], jnp.int32)
+        return n
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+class GenerationServer:
+    """Streaming front end over a :class:`GenerationEngine`: admission
+    control, per-request deadlines/token budgets, graceful drain — the
+    PR 4 Server contracts with token-level accounting. One loop thread
+    owns all slot scheduling (iteration-level continuous batching: it
+    admits new prompts into free slots between decode steps)."""
+
+    def __init__(self, model, slots: Optional[int] = None,
+                 max_seq: Optional[int] = None, prefill_buckets=None,
+                 eos_id: Optional[int] = None,
+                 token_budget: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 stream_buffer: Optional[int] = None,
+                 warmup: bool = False,
+                 metrics: Optional[ServingMetrics] = None):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        if isinstance(model, GenerationEngine):
+            if (slots is not None or max_seq is not None
+                    or prefill_buckets is not None):
+                raise InvalidArgumentError(
+                    "slots/max_seq/prefill_buckets cannot be applied "
+                    "to a pre-built GenerationEngine — pass them to "
+                    "GenerationEngine(), or hand the raw model over")
+            self.engine = model
+            self.engine.metrics = self.metrics  # latest-wins rebind
+            if eos_id is not None:
+                self.engine.eos_id = int(eos_id)
+        else:
+            self.engine = GenerationEngine(
+                model, slots=slots, max_seq=max_seq,
+                prefill_buckets=prefill_buckets, eos_id=eos_id,
+                metrics=self.metrics)
+        self.token_budget = int(
+            token_budget if token_budget is not None
+            else core_flags.flag("serve_gen_token_budget"))
+        dl = deadline_ms if deadline_ms is not None \
+            else core_flags.flag("serve_deadline_ms")
+        self.default_deadline_ms = float(dl) if dl else None
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None
+            else core_flags.flag("serve_queue_depth"))
+        self.stream_buffer = int(
+            stream_buffer if stream_buffer is not None
+            else core_flags.flag("serve_gen_stream_buffer"))
+        self._warmup = bool(warmup)
+        self._q: "queue.Queue[_GenRequest]" = queue.Queue(self.queue_depth)
+        self._drain_event = threading.Event()
+        self._accepting = False
+        self._admit_lock = threading.Lock()
+        self._loop: Optional[_GenerationLoop] = None
+        self._seed_counter = [0]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "GenerationServer":
+        if self._loop is not None and self._loop.is_alive():
+            return self
+        self._drain_event.clear()
+        supervised = core_health.supervised()
+        core_health.beat()
+        core_health.add_drain_callback(self._drain_event.set)
+        if core_health.drain_requested():
+            self._drain_event.set()
+        if not supervised and threading.current_thread() is \
+                threading.main_thread():
+            from .server import install_standalone_sigterm_drain
+            install_standalone_sigterm_drain()
+        if self._warmup:
+            n = self.engine.warm_up()
+            self.metrics.counter("warmup_executables_total").inc(n)
+        self._loop = _GenerationLoop(self.engine, self._q,
+                                     self.metrics, self._drain_event)
+        self._loop.start()
+        self._accepting = True
+        return self
+
+    @property
+    def running(self) -> bool:
+        return (self._loop is not None and self._loop.is_alive()
+                and self._accepting)
+
+    def __enter__(self) -> "GenerationServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> TokenStream:
+        """Enqueue one prompt; returns its :class:`TokenStream`.
+        Sheds with :class:`ServerOverloaded` (bounded queue) or raises
+        :class:`ServerClosed` (draining/stopped) synchronously.
+        ``temperature<=0`` is greedy; ``seed`` pins the sampled draws
+        (per-request stream — a request's tokens are identical whether
+        it decodes alone or in a full batch)."""
+        if not self._accepting or self._drain_event.is_set():
+            raise ServerClosed(
+                "generation server is draining/stopped — not admitting")
+        if self._loop is None or not self._loop.is_alive():
+            raise ServerClosed(
+                "generation server not started (or its loop died: "
+                f"{self._loop.fatal!r})" if self._loop is not None
+                else "generation server not started — call start()")
+        prompt = np.asarray(
+            getattr(prompt_ids, "numpy", lambda: prompt_ids)(),
+            ).astype(np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise InvalidArgumentError("submit needs >= 1 prompt token")
+        self.engine.bucket_for(prompt.size)  # typed on oversize NOW
+        room = self.engine.max_seq - int(prompt.size)
+        if room < 1:
+            raise InvalidArgumentError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"generate within max_seq={self.engine.max_seq}")
+        asked = int(max_new_tokens) if max_new_tokens is not None \
+            else self.token_budget
+        if asked < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {asked}")
+        # the server-side budget/capacity cap: a stream cut short by it
+        # fails typed mid-stream (DeadlineExceeded) instead of silently
+        # truncating — the client asked for more than it will get
+        max_new = min(asked, self.token_budget, room)
+        truncated = max_new < asked
+        if seed is None:
+            with self._admit_lock:
+                self._seed_counter[0] += 1
+                seed = self._seed_counter[0]
+        dl = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        stream = TokenStream(self.stream_buffer)
+        req = _GenRequest(prompt.astype(np.int32), max_new,
+                          float(temperature), int(top_k), int(seed),
+                          dl / 1e3 if dl else None, stream, truncated)
+        with self._admit_lock:
+            if not self._accepting or self._drain_event.is_set():
+                raise ServerClosed(
+                    "generation server is draining/stopped — not "
+                    "admitting")
+            self.metrics.counter("requests_total").inc()
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.metrics.counter("shed_total").inc()
+                raise ServerOverloaded(
+                    f"generation queue depth {self.queue_depth} "
+                    "exhausted — request shed (scale out, raise "
+                    "serve_queue_depth, or slow the client)") from None
+        lo = self._loop
+        if self._drain_event.is_set() and lo is not None \
+                and lo.drained.is_set():
+            # lost the admission race against a lockless drain latch
+            # (SIGTERM/health callback): nothing will read the queue —
+            # resolve typed instead of hanging the stream
+            lo._fail_queued(ServerClosed(
+                "generation server drained while the request was "
+                "being admitted"))
+        return stream
+
+    def generate(self, prompt_ids, **kw) -> List[int]:
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(prompt_ids, **kw).result()
+
+    # -- drain --------------------------------------------------------------
+
+    def wait(self, poll_s: float = 0.1,
+             timeout: Optional[float] = None) -> dict:
+        t0 = time.monotonic()
+        while not self._drain_event.is_set():
+            if timeout is not None and time.monotonic() - t0 >= timeout:
+                break
+            core_health.beat()
+            time.sleep(poll_s)
+        return self.drain()
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Graceful shutdown: stop admitting, flush every accepted
+        stream — finish decoding what's owed within ``timeout``, fail
+        the rest typed — and report. ``unaccounted`` (requests) and
+        ``tokens_owed`` are both ≡ 0 by construction; the report proves
+        it."""
+        with self._admit_lock:
+            self._accepting = False
+            self._drain_event.set()
+        drained = True
+        if self._loop is not None:
+            drained = self._loop.drained.wait(timeout)
+            if not drained:
+                self._loop.abort(DeadlineExceeded(
+                    f"generation drain timed out after {timeout}s"))
+                self._loop.drained.wait(max(timeout, 1.0))
+            self._loop.join(timeout=max(timeout, 1.0))
+            self._loop._fail_queued(ServerClosed(
+                "generation server drained while the request was "
+                "being admitted"))
+        core_health.remove_drain_callback(self._drain_event.set)
+        snap = self.metrics.snapshot()
+        c = snap["counters"]
+        report = {
+            "drained": bool(drained),
+            "fatal": (repr(self._loop.fatal) if self._loop is not None
+                      and self._loop.fatal is not None else None),
+            "accepted": (c.get("requests_total", 0)
+                         - c.get("shed_total", 0)),
+            "completed": c.get("streams_completed_total", 0),
+            "deadline_failed": c.get("deadline_expired_total", 0),
+            "cancelled": c.get("streams_cancelled_total", 0),
+            "errors": c.get("errors_total", 0),
+            "shed": c.get("shed_total", 0),
+            "tokens_generated": c.get("tokens_generated_total", 0),
+            "tokens_streamed": c.get("tokens_streamed_total", 0),
+            "tokens_dropped": c.get("tokens_dropped_total", 0),
+            "decode_compiles": self.engine.decode_compile_count,
+            "decode_dispatches": self.engine.decode_dispatch_count,
+            "prefill_compile_counts": dict(
+                self.engine.prefill_compile_counts),
+        }
+        report["unaccounted"] = (
+            report["accepted"] - report["completed"]
+            - report["deadline_failed"] - report["cancelled"]
+            - report["errors"])
+        report["tokens_owed"] = (
+            report["tokens_generated"] - report["tokens_streamed"]
+            - report["tokens_dropped"])
+        return report
+
+    stop = drain
+
+
+class _GenerationLoop(threading.Thread):
+    """The scheduler thread: admits prompts into free slots, runs one
+    decode dispatch per iteration for every active slot, delivers
+    tokens, enforces deadlines/budgets, and answers chaos."""
+
+    _POLL_S = 0.02
+
+    def __init__(self, engine: GenerationEngine,
+                 q: "queue.Queue", metrics: ServingMetrics,
+                 drain_event: threading.Event):
+        super().__init__(name="p1t-generation-loop", daemon=True)
+        self.engine = engine
+        self.q = q
+        self.metrics = metrics
+        self.drain = drain_event
+        self.drained = threading.Event()
+        self.fatal: Optional[BaseException] = None
+        self._abort_exc: Optional[BaseException] = None
+        self._by_slot: Dict[int, _GenRequest] = {}
+        self._free: List[int] = list(range(engine.slots))
+
+    def abort(self, exc: BaseException) -> None:
+        """A drain that ran out of patience: fail everything still in
+        flight typed at the next loop boundary."""
+        self._abort_exc = exc
+
+    # -- resolution helpers (single-threaded: only this thread calls) -------
+
+    def _deliver(self, req: _GenRequest, tok: int) -> None:
+        m = self.metrics
+        m.counter("tokens_generated_total").inc()
+        if req.stream._put(tok):
+            m.counter("tokens_streamed_total").inc()
+        else:
+            m.counter("tokens_dropped_total").inc()
+        req.n_generated += 1
+
+    def _finish(self, req: _GenRequest, reason: str,
+                exc: Optional[BaseException] = None) -> None:
+        if req.stream._finish(reason, exc):
+            m = self.metrics
+            if reason in ("eos", "length"):
+                m.counter("streams_completed_total").inc()
+                m.record_response()
+            elif reason == "cancelled":
+                m.counter("streams_cancelled_total").inc()
+            elif reason in ("deadline", "budget"):
+                m.counter("deadline_expired_total").inc()
+            else:
+                m.counter("errors_total").inc()
+            if req.n_generated and req.t_first:
+                dt = max(time.monotonic() - req.t_first, 1e-9)
+                m.histogram("tokens_per_s").observe(
+                    req.n_generated / dt)
+        if req.slot >= 0:
+            self.engine.release(req.slot)
+            import bisect
+            bisect.insort(self._free, req.slot)
+            del self._by_slot[req.slot]
+            req.slot = -1
+
+    def _fail_queued(self, exc: BaseException) -> None:
+        while True:
+            try:
+                req = self.q.get_nowait()
+            except queue.Empty:
+                return
+            if req.stream._finish("error", exc):
+                self.metrics.counter("errors_total").inc()
+
+    def _fail_inflight(self, exc: BaseException, reason="error") -> None:
+        for slot in list(self._by_slot):
+            self._finish(self._by_slot[slot], reason, exc)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _admit(self) -> None:
+        """Claim free slots for queued prompts (iteration-level
+        scheduling: runs between decode steps, so a late request joins
+        the RUNNING batch). A drain keeps admitting — queued requests
+        were accepted and are owed an answer — while `submit` has
+        already stopped new arrivals."""
+        while self._free:
+            try:
+                req = self.q.get_nowait()
+            except queue.Empty:
+                return
+            now = time.monotonic()
+            if req.stream._cancel_requested:
+                self._finish(req, "cancelled", StreamCancelled(
+                    "cancelled before decoding started"))
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline", DeadlineExceeded(
+                    f"request expired after "
+                    f"{(now - req.t_enq) * 1e3:.1f}ms in queue — "
+                    "never prefetched into a slot"))
+                continue
+            # lowest free slot first: deterministic assignment (chaos
+            # specs name slots; staggered-parity runs reproduce)
+            slot = self._free.pop(0)
+            req.slot = slot
+            self._by_slot[slot] = req
+            try:
+                t0 = time.monotonic()
+                first = self.engine.prefill(
+                    slot, req.prompt, req.temperature, req.top_k,
+                    req.seed)
+                self.metrics.histogram("prefill_ms").observe(
+                    (time.monotonic() - t0) * 1e3)
+                self.metrics.histogram("queue_ms").observe(
+                    (t0 - req.t_enq) * 1e3)
+            except Exception as e:
+                self._finish(req, "error", e)
+                continue
+            req.t_first = time.monotonic()
+            self._deliver(req, first)
+            self._maybe_complete(req, first)
+
+    def _maybe_complete(self, req: _GenRequest, tok: int) -> None:
+        eos = self.engine.eos_id
+        if eos is not None and tok == eos:
+            self._finish(req, "eos")
+        elif req.n_generated >= req.max_new:
+            if req.truncated_by_budget:
+                self._finish(req, "budget", DeadlineExceeded(
+                    f"token budget exhausted after {req.n_generated} "
+                    "tokens (server cap serve_gen_token_budget/"
+                    "max_seq room below the requested "
+                    "max_new_tokens) — stream truncated"))
+            else:
+                self._finish(req, "length")
+
+    def _sweep(self) -> None:
+        """Client cancels + wall deadlines, checked at step boundaries
+        so a mid-stream failure is typed and immediate."""
+        now = time.monotonic()
+        for slot in list(self._by_slot):
+            req = self._by_slot[slot]
+            if req.stream._cancel_requested:
+                self._finish(req, "cancelled", StreamCancelled(
+                    f"cancelled after {req.n_generated} tokens"))
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline", DeadlineExceeded(
+                    f"wall deadline exceeded mid-stream after "
+                    f"{req.n_generated} tokens"))
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self) -> None:
+        m = self.metrics
+        slots = self.engine.slots
+        try:
+            while True:
+                core_health.beat()
+                if self._abort_exc is not None:
+                    self._fail_inflight(self._abort_exc)
+                    self._fail_queued(self._abort_exc)
+                    break
+                self._sweep()
+                self._admit()
+                if not self._by_slot:
+                    m.gauge("slot_occupancy").set(0.0)
+                    if self.drain.is_set() and self.q.empty():
+                        break
+                    time.sleep(self._POLL_S)
+                    continue
+                wedged, slow = core_chaos.check_gen_step(
+                    list(self._by_slot))
+                if slow:
+                    time.sleep(float(
+                        core_flags.flag("serve_chaos_slow_s")))
+                if wedged is not None and wedged in self._by_slot:
+                    req = self._by_slot[wedged]
+                    self._finish(req, "error", SlotWedged(
+                        f"decode slot {wedged} wedged after "
+                        f"{req.n_generated} tokens (chaos "
+                        "gen_slot_wedge) — stream failed, slot "
+                        "released, cohabitants unaffected"))
+                if not self._by_slot:
+                    continue
+                active = np.zeros([slots], bool)
+                for slot, req in self._by_slot.items():
+                    active[slot] = req.stream._writable()
+                m.gauge("slot_occupancy").set(
+                    len(self._by_slot) / slots)
+                if not active.any():
+                    time.sleep(self._POLL_S)  # every stream is parked
+                    continue
+                t0 = time.monotonic()
+                toks = self.engine.decode(active)
+                m.histogram("decode_step_ms").observe(
+                    (time.monotonic() - t0) * 1e3)
+                for slot in list(self._by_slot):
+                    if not active[slot]:
+                        continue
+                    req = self._by_slot[slot]
+                    self._deliver(req, int(toks[slot]))
+                    self._maybe_complete(req, int(toks[slot]))
+        except BaseException as e:  # noqa: broad-except — the loop
+            # thread must record ANY death and resolve every stream
+            # typed rather than leave clients blocked mid-iteration
+            self.fatal = e
+            err = RuntimeError(f"generation loop died: {e!r}")
+            self._fail_inflight(err)
+            self._fail_queued(err)
+            self.drain.set()
+            try:
+                core_health.report_unhealthy(
+                    f"generation loop died: {e!r}")
+            except Exception:  # noqa: broad-except — best-effort
+                # marker; the fatal must not be masked by an
+                # unwritable health dir
+                pass
+            if not isinstance(e, Exception):
+                raise
+        finally:
+            self.drained.set()
+            # close the admission race for good: a submit whose put
+            # landed after this loop's final empty-queue check is
+            # either swept HERE (put before the sweep) or sees
+            # drained already set on its own post-put check (put
+            # after the sweep — drained.set() above happened-before
+            # it) and sweeps itself. Normal drains flushed the queue
+            # already, so this is a no-op for them.
+            self._fail_queued(ServerClosed(
+                "generation server drained while the request was "
+                "being admitted"))
+
+
+# kept for parity tests/bench: eagerly decode ONE sequence with the
+# concat-Cache path but the ENGINE's key schedule, so sampled outputs
+# are comparable token-for-token with the jitted slot decode
+def eager_generate(model, prompt_ids, max_new_tokens, eos_id=None,
+                   temperature=0.0, top_k=0, seed=0):
+    """Reference eager decode (one sequence, incremental concat cache):
+    prefill the prompt, then sample a token per step with the same
+    per-request key schedule the engine uses. Returns the token list."""
+    import jax
+    from ..core.tensor import to_tensor
+    from ..nn.decode import sample_logits_array
+    prompt = np.asarray(prompt_ids, np.int64).reshape(1, -1)
+    cache = model.empty_cache(1)
+    logits, cache = model(to_tensor(prompt), cache=cache)
+    key = jax.random.fold_in(
+        jax.random.key(int(seed) & 0x7FFFFFFF), 0)
+    out: List[int] = []
+    last = np.asarray(logits.numpy())[0, -1].astype(np.float32)
+    for _ in range(int(max_new_tokens)):
+        tok = int(np.asarray(sample_logits_array(
+            last, jax.random.fold_in(key, 0),
+            np.float32(temperature), np.int32(top_k))))
+        key = jax.random.fold_in(key, 1)
+        out.append(tok)
+        if eos_id is not None and tok == eos_id:
+            break
+        if len(out) >= int(max_new_tokens):
+            break
+        ids = np.asarray([[tok]], np.int64)
+        logits, cache = model(to_tensor(ids), cache=cache)
+        last = np.asarray(logits.numpy())[0, -1].astype(np.float32)
+    return out

@@ -31,8 +31,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["Counter", "Histogram", "ServingMetrics", "MetricsGroup",
-           "merge_snapshots"]
+__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics",
+           "MetricsGroup", "merge_snapshots"]
 
 # reservoir size per histogram: large enough for a stable p99 (the
 # quantile of the last ~4k observations), small enough to sort per
@@ -58,6 +58,25 @@ class Counter:
 
     @property
     def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    """Last-written value (slot occupancy, queue depth...) — unlike a
+    Counter it moves both ways; ``set`` is a plain float store (atomic
+    under the GIL, no lock on the per-step hot path)."""
+
+    __slots__ = ("name", "_v")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> float:
         return self._v
 
 
@@ -125,6 +144,7 @@ class ServingMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._resp_times: collections.deque = collections.deque(
             maxlen=_QPS_WINDOW)
@@ -138,6 +158,13 @@ class ServingMetrics:
             with self._lock:
                 c = self._counters.setdefault(name, Counter(name))
         return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
 
     def histogram(self, name: str) -> Histogram:
         h = self._histograms.get(name)
@@ -172,11 +199,13 @@ class ServingMetrics:
         """The whole registry as one JSON-able dict."""
         with self._lock:
             counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()}
             hists = list(self._histograms.values())
         return {
             "qps": round(self.qps(), 2),
             "uptime_s": round(time.monotonic() - self._started, 3),
             "counters": counters,
+            "gauges": gauges,
             "histograms": {h.name: h.summary() for h in hists},
         }
 
@@ -209,11 +238,16 @@ class ServingMetrics:
 
         with self._lock:
             counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()}
             hists = list(self._histograms.values())
         lines = [line("p1t_serving_qps", round(self.qps(), 2)),
                  line("p1t_serving_uptime_seconds",
                       round(time.monotonic() - self._started, 3))]
         for name, v in sorted(counters.items()):
+            lines.append(line(f"p1t_serving_{name}", v))
+        for name, v in sorted(gauges.items()):
+            if type_headers:
+                lines.append(f"# TYPE p1t_serving_{name} gauge")
             lines.append(line(f"p1t_serving_{name}", v))
         for h in sorted(hists, key=lambda h: h.name):
             base = f"p1t_serving_{h.name}"
@@ -283,6 +317,7 @@ def merge_snapshots(snaps: Iterable[Dict[str, object]]
     the conservative bound is the useful one (documented on the line a
     dashboard reads: an aggregate p99 here is "no child was worse")."""
     counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
     hists: Dict[str, Dict[str, float]] = {}
     qps = 0.0
     uptime = 0.0
@@ -291,6 +326,10 @@ def merge_snapshots(snaps: Iterable[Dict[str, object]]
         uptime = max(uptime, float(s.get("uptime_s", 0.0) or 0.0))
         for k, v in (s.get("counters") or {}).items():
             counters[k] = counters.get(k, 0) + v
+        for k, v in (s.get("gauges") or {}).items():
+            # gauges are instantaneous levels, not totals: like the
+            # quantiles, the aggregate takes the WORST (highest) child
+            gauges[k] = max(gauges.get(k, 0.0), float(v))
         for name, h in (s.get("histograms") or {}).items():
             m = hists.setdefault(name, {
                 "count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
@@ -304,4 +343,5 @@ def merge_snapshots(snaps: Iterable[Dict[str, object]]
                      else 0.0)
         m["sum"] = round(m["sum"], 4)
     return {"qps": round(qps, 2), "uptime_s": uptime,
-            "counters": counters, "histograms": hists}
+            "counters": counters, "gauges": gauges,
+            "histograms": hists}

@@ -35,7 +35,31 @@ from .engine import InferenceEngine
 from .errors import ServerClosed, ServerOverloaded
 from .metrics import ServingMetrics
 
-__all__ = ["Server"]
+__all__ = ["Server", "install_standalone_sigterm_drain"]
+
+
+def install_standalone_sigterm_drain() -> None:
+    """For an UNSUPERVISED serving worker on the main thread: make
+    SIGTERM mean "drain", not "die with the queue full", by chaining a
+    ``core.health.request_drain()`` in front of whatever handler the
+    script installed. Idempotent per process — a restart-after-drain
+    loop must not wrap our own handler in a fresh closure each cycle
+    (an N-deep chain re-running request_drain N times per SIGTERM).
+    Shared by :class:`Server` and the generation server."""
+    import signal
+    prev = signal.getsignal(signal.SIGTERM)
+    if getattr(prev, "_p1_serving_drain", False):
+        return
+
+    def _on_sigterm(signum, frame, _prev=prev):
+        core_health.request_drain()  # fans out to subscribers
+        if callable(_prev):
+            _prev(signum, frame)
+    _on_sigterm._p1_serving_drain = True
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (OSError, ValueError):  # pragma: no cover
+        pass  # exotic host; drain() still works programmatically
 
 
 class Server:
@@ -139,24 +163,7 @@ class Server:
             # standalone worker (no Supervisor → health installed no
             # handler): SIGTERM must still mean "drain", not "die with
             # the queue full". Chain whatever the script installed.
-            import signal
-            prev = signal.getsignal(signal.SIGTERM)
-            if not getattr(prev, "_p1_serving_drain", False):
-                # install once per process: a restart-after-drain loop
-                # must not wrap our own handler in a fresh closure each
-                # cycle (an N-deep chain re-running request_drain N
-                # times per SIGTERM)
-
-                def _on_sigterm(signum, frame, _prev=prev):
-                    core_health.request_drain()  # fans out to subscribers
-                    if callable(_prev):
-                        _prev(signum, frame)
-                _on_sigterm._p1_serving_drain = True
-                try:
-                    signal.signal(signal.SIGTERM, _on_sigterm)
-                except (OSError, ValueError):  # pragma: no cover
-                    pass  # exotic host; drain() still works
-                    # programmatically
+            install_standalone_sigterm_drain()
         if self._warmup:
             n = self.engine.warm_up()
             self.metrics.counter("warmup_buckets_total").inc(n)

@@ -291,6 +291,81 @@ class TestBeamSearchDecode:
                     assert ln[b, k] >= 1
 
 
+class TestDecodeAccumulationLinear:
+    """ISSUE 9 satellite: dynamic_decode's output accumulation must be
+    O(steps) — per-step outputs buffered in a host list, ONE stack at
+    finalize — never re-concatenated per step (O(steps²) copy work and
+    a growing-shape retrace per step). Pinned by an op-count regression
+    plus a bit-parity check against the per-step-concat formulation."""
+
+    def _run(self, T, fx=None):
+        fx = fx or _Seq2SeqFixture(seed=12)
+        gt = np.random.default_rng(7).standard_normal(
+            (B, T, EMB)).astype(np.float32)
+        helper = nn.TrainingHelper(to_tensor(gt),
+                                   np.full(B, T, np.int64))
+        dec = nn.BasicDecoder(fx.cell, helper, output_fn=fx.output_fn)
+        return nn.dynamic_decode(dec, inits=to_tensor(fx.h0))
+
+    def test_stack_once_and_no_per_step_concat(self, monkeypatch):
+        from paddle1_tpu.ops import manip_ops
+        counts = {"stack": 0, "concat": 0}
+        real_stack, real_concat = manip_ops.stack, manip_ops.concat
+
+        def stack(x, axis=0, name=None):
+            counts["stack"] += 1
+            return real_stack(x, axis=axis)
+
+        def concat(x, axis=0, name=None):
+            counts["concat"] += 1
+            return real_concat(x, axis=axis)
+        import paddle1_tpu.nn.decode as D
+        monkeypatch.setattr(D.manip_ops, "stack", stack)
+        monkeypatch.setattr(D.manip_ops, "concat", concat)
+        per_T = {}
+        for T in (4, 8):
+            counts["stack"] = counts["concat"] = 0
+            self._run(T)
+            per_T[T] = dict(counts)
+        # one stack per OUTPUT LEAF (cell_outputs + sample_ids), no
+        # driver-side concats — and neither grows with the step count
+        assert per_T[4]["stack"] == per_T[8]["stack"] == 2
+        assert per_T[4]["concat"] == per_T[8]["concat"] == 0
+
+    def test_parity_with_per_step_concat_accumulation(self, monkeypatch):
+        """The finalize-time single stack must be BIT-identical to the
+        O(steps²) formulation it replaces (re-concatenating the
+        accumulator every step)."""
+        fx = _Seq2SeqFixture(seed=12)
+        outs, _ = self._run(6, fx)
+        ref = _np(outs.cell_outputs)
+
+        from paddle1_tpu.ops import manip_ops
+        real_stack = manip_ops.stack
+
+        def stack_via_per_step_concat(x, axis=0, name=None):
+            from paddle1_tpu.ops.manip_ops import concat, unsqueeze
+            acc = unsqueeze(x[0], axis)
+            for t in x[1:]:  # the quadratic re-concat, on purpose
+                acc = concat([acc, unsqueeze(t, axis)], axis=axis)
+            return acc
+        import paddle1_tpu.nn.decode as D
+        monkeypatch.setattr(D.manip_ops, "stack",
+                            stack_via_per_step_concat)
+        outs2, _ = self._run(6, fx)
+        monkeypatch.setattr(D.manip_ops, "stack", real_stack)
+        np.testing.assert_array_equal(ref, _np(outs2.cell_outputs))
+
+    def test_repeat_runs_bit_identical(self):
+        fx = _Seq2SeqFixture(seed=12)
+        a, _ = self._run(5, fx)
+        b, _ = self._run(5, fx)
+        np.testing.assert_array_equal(_np(a.cell_outputs),
+                                      _np(b.cell_outputs))
+        np.testing.assert_array_equal(_np(a.sample_ids),
+                                      _np(b.sample_ids))
+
+
 class TestFluidSpellings:
     def test_names_resolve(self):
         import paddle1_tpu.fluid.layers as L

@@ -1,0 +1,485 @@
+"""Generative serving (ISSUE 9): device-resident KV-cache decode with
+slot-based continuous batching and per-token streaming.
+
+Fast cases ride tier-1 around ONE module-scoped model+engine (the XLA
+compiles are paid once); the continuous-batching soak matrix and the
+staggered-load drain soak are slow-marked (CI's generate lane and
+``bench.py --generate`` run them)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core import chaos, health
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.core.flags import flags_guard
+from paddle1_tpu.serving import (CausalLM, DeadlineExceeded,
+                                 GenerationEngine, GenerationServer,
+                                 ServerClosed, ServerOverloaded,
+                                 SlotWedged, StreamCancelled)
+from paddle1_tpu.serving.generate import eager_generate
+
+VOCAB, MAX_SEQ, SLOTS = 32, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    health.reset()
+    chaos.reset()
+    yield
+    health.reset()
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    paddle.seed(7)
+    return CausalLM(vocab_size=VOCAB, d_model=16, nhead=2,
+                    dim_feedforward=32, num_layers=2, max_seq=MAX_SEQ)
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    # one engine for the whole module: its jit caches make every test
+    # after the first nearly free, and the decode-compile-count gate
+    # gets to assert "still exactly one" ACROSS the whole module
+    return GenerationEngine(lm, slots=SLOTS, max_seq=MAX_SEQ,
+                            prefill_buckets=(4, 8))
+
+
+def _serve(engine, **kw):
+    kw.setdefault("token_budget", 10)
+    return GenerationServer(engine, **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# slot cache (tentpole unit)
+
+
+class TestSlotCache:
+    def test_static_cache_matches_concat_cache(self, lm):
+        """The masked [slots, max_seq] write path must compute the same
+        attention as the growing concat Cache for the same tokens."""
+        from paddle1_tpu.core.tensor import to_tensor
+        ids = np.array([[3, 9, 1, 4]], np.int64)
+        # concat path: prefill then 2 incremental steps
+        cache = lm.empty_cache(1)
+        lg_a, cache = lm(to_tensor(ids[:, :2]), cache=cache)
+        steps_a = [np.asarray(lg_a.numpy())[0, -1]]
+        for t in (2, 3):
+            lg_a, cache = lm(to_tensor(ids[:, t:t + 1]), cache=cache)
+            steps_a.append(np.asarray(lg_a.numpy())[0, -1])
+        # slot path: same tokens through a GenCache at slot 0
+        import jax.numpy as jnp
+        from paddle1_tpu.nn import MultiHeadAttention
+        slot_cache = lm.gen_slot_cache(1, 8)
+        pos = to_tensor(np.zeros([1], np.int32))
+        caches = [MultiHeadAttention.GenCache(c.k, c.v, pos)
+                  for c in slot_cache]
+        mask = to_tensor(
+            (np.arange(8)[None, None, None, :]
+             <= np.arange(2)[None, None, :, None]).copy())
+        positions = to_tensor(np.arange(2, dtype=np.int64)[None])
+        lg_b, caches = lm(to_tensor(ids[:, :2]), cache=caches,
+                          positions=positions, attn_mask=mask)
+        steps_b = [np.asarray(lg_b.numpy())[0, -1]]
+        for t in (2, 3):
+            mask = to_tensor(
+                (np.arange(8)[None, None, None, :] <= t).copy()
+                .reshape(1, 1, 1, 8))
+            positions = to_tensor(np.array([[t]], np.int64))
+            lg_b, caches = lm(to_tensor(ids[:, t:t + 1]), cache=caches,
+                              positions=positions, attn_mask=mask)
+            steps_b.append(np.asarray(lg_b.numpy())[0, -1])
+        for a, b in zip(steps_a, steps_b):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    def test_gen_cache_write_advances_cursor_in_place_shape(self):
+        import paddle1_tpu.nn as nn
+        mha = nn.MultiHeadAttention(8, 2)
+        c = mha.gen_slot_cache(3, 6)
+        assert list(c.k.shape) == [3, 6, 2, 4]
+        from paddle1_tpu.core.tensor import to_tensor
+        x = to_tensor(np.random.default_rng(0).standard_normal(
+            (3, 1, 8)).astype(np.float32))
+        mask = to_tensor(np.ones((3, 1, 1, 6), bool))
+        _, c2 = mha(x, x, x, attn_mask=mask, cache=c)
+        assert list(c2.k.shape) == [3, 6, 2, 4]  # shape NEVER grows
+        np.testing.assert_array_equal(np.asarray(c2.pos.numpy()),
+                                      [1, 1, 1])
+
+
+# ---------------------------------------------------------------------------
+# engine: parity + the one-compile contract
+
+
+class TestGenerationEngine:
+    def test_greedy_parity_with_eager_decode(self, lm, engine):
+        srv = _serve(engine)
+        prompt = [1, 5, 3]
+        got = srv.submit(prompt, max_new_tokens=8).result(timeout=120)
+        srv.drain()
+        assert got == eager_generate(lm, prompt, 8)
+
+    def test_sampled_parity_with_eager_decode(self, lm, engine):
+        """The per-request key schedule (fold 0 = draw, fold 1 = carry,
+        chained from fold_in(key(seed), 0)) is shared by the jitted
+        slot decode and the eager reference — same seed, same tokens,
+        bit-exact."""
+        srv = _serve(engine)
+        kw = dict(max_new_tokens=8, temperature=0.8, top_k=6, seed=77)
+        got = srv.submit([1, 5, 3], **kw).result(timeout=120)
+        srv.drain()
+        assert got == eager_generate(lm, [1, 5, 3], 8, temperature=0.8,
+                                     top_k=6, seed=77)
+
+    def test_one_decode_compile_across_ragged_lengths(self, lm, engine):
+        srv = _serve(engine)
+        outs = [srv.submit(p, max_new_tokens=4).result(timeout=120)
+                for p in ([2], [1, 2, 3], [4, 4, 4, 4, 4, 4, 7])]
+        rep = srv.drain()
+        assert all(len(o) == 4 for o in outs)
+        # ragged prompt lengths hit different PREFILL buckets but the
+        # decode executable — pinned to [slots, max_seq] — is ONE
+        assert engine.decode_compile_count == 1
+        assert set(engine.prefill_compile_counts) <= {4, 8}
+        assert all(v == 1 for v in engine.prefill_compile_counts.values())
+        assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+
+    def test_eos_finishes_stream(self, lm):
+        # an eos_id that the greedy argmax actually emits: probe one
+        # eager decode and use its 3rd token as the "eos"
+        probe = eager_generate(lm, [1, 5, 3], 6)
+        eng = GenerationEngine(lm, slots=2, max_seq=MAX_SEQ,
+                               prefill_buckets=(4,), eos_id=probe[2])
+        srv = _serve(eng)
+        st = srv.submit([1, 5, 3], max_new_tokens=10)
+        got = st.result(timeout=120)
+        srv.drain()
+        assert st.finish_reason == "eos"
+        assert got == probe[:3]
+
+    def test_alone_vs_batched_bit_identical(self, lm, engine):
+        """A request's tokens must not depend on who shares the batch
+        (greedy AND seeded sampling) — the slot-isolation contract."""
+        prompts = [[1, 5, 3], [2, 2], [7, 1, 4, 9, 6]]
+        kw = [dict(max_new_tokens=6),
+              dict(max_new_tokens=6, temperature=0.9, top_k=5, seed=11),
+              dict(max_new_tokens=6, temperature=0.7, seed=12)]
+        srv = _serve(engine)
+        batched = [srv.submit(p, **k).result(timeout=120)
+                   for p in prompts for k in [kw[prompts.index(p)]]]
+        srv.drain()
+        alone = []
+        for p, k in zip(prompts, kw):
+            srv = _serve(engine)
+            alone.append(srv.submit(p, **k).result(timeout=120))
+            srv.drain()
+        assert batched == alone
+
+    def test_needs_generation_contract(self):
+        m = paddle.nn.Linear(4, 4)
+        with pytest.raises(InvalidArgumentError, match="gen_slot_cache"):
+            GenerationEngine(m, slots=2, max_seq=8)
+
+    def test_model_positional_capacity_validated(self, lm):
+        # an engine max_seq past the model's embedding table would
+        # CLAMP positions under jit (silent degradation) — typed now
+        with pytest.raises(InvalidArgumentError,
+                           match="positional capacity"):
+            GenerationEngine(lm, slots=2, max_seq=MAX_SEQ * 4)
+
+    def test_prompt_too_long_typed(self, engine):
+        srv = _serve(engine)
+        with pytest.raises(InvalidArgumentError, match="bucket"):
+            srv.submit(list(range(MAX_SEQ + 4)))
+        with pytest.raises(InvalidArgumentError, match="room"):
+            srv.submit(list(range(MAX_SEQ)))
+        with pytest.raises(InvalidArgumentError):
+            srv.submit([])
+        rep = srv.drain()
+        assert rep["accepted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# streaming front end
+
+
+class TestGenerationServer:
+    def test_tokens_stream_incrementally(self, lm, engine):
+        srv = _serve(engine)
+        st = srv.submit([1, 2], max_new_tokens=6)
+        seen = list(st)  # iterator consumes per token
+        srv.drain()
+        assert len(seen) == 6 and st.finish_reason == "length"
+        assert st.tokens == seen
+
+    def test_budget_truncation_typed_midstream(self, lm, engine):
+        srv = _serve(engine, token_budget=3)
+        st = srv.submit([1, 2], max_new_tokens=50)
+        with pytest.raises(DeadlineExceeded, match="budget"):
+            st.result(timeout=120)
+        assert st.finish_reason == "budget"
+        assert len(st.tokens) == 3  # everything generated still arrived
+        rep = srv.drain()
+        assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+
+    def test_cancel_releases_slot(self, lm, engine):
+        srv = _serve(engine, token_budget=200)
+        st = srv.submit([1, 2], max_new_tokens=200)
+        while len(st.tokens) < 2:
+            time.sleep(0.005)
+        st.cancel()
+        with pytest.raises(StreamCancelled):
+            st.result(timeout=60)
+        # iteration just stops (no raise) after a cancel
+        assert isinstance(list(st), list)
+        # the slot is free again: another request completes
+        out = srv.submit([3], max_new_tokens=3).result(timeout=120)
+        rep = srv.drain()
+        assert len(out) == 3
+        assert rep["cancelled"] == 1 and rep["unaccounted"] == 0
+
+    def test_overload_sheds_typed(self, lm, engine):
+        srv = _serve(engine, queue_depth=2, token_budget=3)
+        shed = 0
+        for _ in range(SLOTS + 8):
+            try:
+                srv.submit([1], max_new_tokens=3)
+            except ServerOverloaded:
+                shed += 1
+        rep = srv.drain(timeout=120)
+        assert shed > 0 and rep["shed"] == shed
+        assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+
+    def test_submit_after_drain_typed(self, lm, engine):
+        srv = _serve(engine)
+        srv.drain()
+        with pytest.raises(ServerClosed):
+            srv.submit([1])
+
+    def test_backpressure_parks_slot_without_changing_tokens(
+            self, lm, engine):
+        srv = _serve(engine, stream_buffer=2, token_budget=12)
+        st = srv.submit([1, 2], max_new_tokens=12)
+        # don't consume: the slot parks at the buffer bound
+        deadline = time.monotonic() + 30
+        while not st.done() and len(st.tokens) < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.1)  # give the loop time to (wrongly) overrun
+        assert len(st.tokens) <= 3  # parked: bound + at most one step
+        got = st.result(timeout=120)  # result() consumes → unparks
+        rep = srv.drain()
+        assert len(got) == 12 and rep["tokens_owed"] == 0
+        # parity: parking must not change WHAT is generated
+        srv = _serve(engine, stream_buffer=64, token_budget=12)
+        ref = srv.submit([1, 2], max_new_tokens=12).result(timeout=120)
+        srv.drain()
+        assert got == ref
+
+    def test_wall_deadline_midstream_via_slow_step_chaos(
+            self, lm, engine):
+        chaos.configure("gen_slow_step@2")
+        with flags_guard(serve_chaos_slow_s=0.4):
+            srv = _serve(engine, token_budget=100)
+            st = srv.submit([1, 2], max_new_tokens=100, deadline_ms=150)
+            with pytest.raises(DeadlineExceeded, match="mid-stream"):
+                st.result(timeout=120)
+            rep = srv.drain()
+        assert st.finish_reason == "deadline"
+        assert rep["deadline_failed"] == 1 and rep["unaccounted"] == 0
+
+    def test_drain_under_load_token_accounting(self, lm, engine):
+        srv = _serve(engine, queue_depth=64, token_budget=4)
+        streams = [srv.submit([1 + i % 5], max_new_tokens=4)
+                   for i in range(10)]
+        rep = srv.drain(timeout=120)
+        assert all(s.done() for s in streams)
+        assert rep["accepted"] == 10
+        assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+        assert rep["tokens_generated"] == rep["tokens_streamed"]
+
+    def test_metrics_surface(self, lm, engine):
+        srv = _serve(engine)
+        srv.submit([1, 2], max_new_tokens=4).result(timeout=120)
+        snap = srv.metrics.snapshot()
+        srv.drain()
+        assert snap["counters"]["tokens_generated_total"] >= 4
+        assert "tokens_per_s" in srv.metrics.snapshot()["histograms"]
+        assert "slot_occupancy" in snap["gauges"]
+        text = srv.metrics.render_text()
+        assert "p1t_serving_tokens_generated_total" in text
+        assert "# TYPE p1t_serving_slot_occupancy gauge" in text
+
+
+# ---------------------------------------------------------------------------
+# chaos: slot isolation
+
+
+class TestSlotWedgeChaos:
+    def test_wedge_fails_only_that_stream_and_releases_slot(
+            self, lm, engine):
+        srv = _serve(engine, token_budget=12)
+        ref = srv.submit([1, 5, 3], max_new_tokens=12).result(timeout=120)
+        srv.drain()
+        chaos.configure("gen_slot_wedge@4:1")
+        srv = _serve(engine, token_budget=12)
+        a = srv.submit([1, 5, 3], max_new_tokens=12)  # slot 0
+        b = srv.submit([2, 2], max_new_tokens=12)     # slot 1: wedged
+        got_a = a.result(timeout=120)
+        with pytest.raises(SlotWedged):
+            b.result(timeout=120)
+        assert 0 < len(b.tokens) < 12  # typed MID-stream, tokens kept
+        # the wedged slot is released: a follow-up request completes
+        c = srv.submit([4, 4], max_new_tokens=3).result(timeout=120)
+        rep = srv.drain()
+        # cohabitant is BIT-identical to the uncontended run (pad-leak
+        # analog: the wedge never touches a neighbor's cache rows)
+        assert got_a == ref
+        assert len(c) == 3
+        assert rep["errors"] == 1 and rep["unaccounted"] == 0
+        assert rep["tokens_owed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sampling parity (satellite): eager vs inside a jitted scan
+
+
+class TestSamplingParity:
+    def test_helpers_identical_eager_vs_jitted_scan(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle1_tpu.nn.decode import sample_logits_array
+        rng = np.random.default_rng(3)
+        seq = jnp.asarray(rng.standard_normal((6, 16)).astype(np.float32))
+        base = jax.random.key(42)
+        for temp, top_k in ((0.0, 0), (0.8, 0), (0.7, 4), (1.3, 1)):
+            eager = [np.asarray(sample_logits_array(
+                seq[t], jax.random.fold_in(base, t), temp, top_k))
+                for t in range(6)]
+
+            @jax.jit
+            def scan_run(seq):
+                def body(t, lg):
+                    return t + 1, sample_logits_array(
+                        lg, jax.random.fold_in(base, t), temp, top_k)
+                _, toks = jax.lax.scan(body, 0, seq)
+                return toks
+            np.testing.assert_array_equal(np.asarray(scan_run(seq)),
+                                          np.stack(eager))
+
+    def test_per_slot_keys_split_independent(self):
+        """vmapped per-slot sampling must equal each slot sampled alone
+        with its own key — the per-slot RNG split the engine relies on
+        (the easy thing to get wrong)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle1_tpu.nn.decode import sample_logits_array
+        rng = np.random.default_rng(5)
+        lg = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32))
+        keys = jax.vmap(jax.random.key)(jnp.arange(100, 104))
+        temps = jnp.asarray([0.0, 0.9, 0.7, 1.2], jnp.float32)
+        topks = jnp.asarray([0, 3, 0, 5], jnp.int32)
+        batched = np.asarray(jax.jit(jax.vmap(sample_logits_array))(
+            lg, keys, temps, topks))
+        alone = [np.asarray(sample_logits_array(
+            lg[i], jax.random.key(100 + i),
+            float(temps[i]), int(topks[i]))) for i in range(4)]
+        np.testing.assert_array_equal(batched, np.asarray(alone))
+
+    def test_top_k_masks_to_top_candidates(self):
+        import jax
+        from paddle1_tpu.nn.decode import sample_logits_array
+        lg = np.zeros((256, 8), np.float32)
+        lg[:, 2], lg[:, 5] = 5.0, 4.0  # top-2 candidates
+        toks = np.asarray(sample_logits_array(
+            lg, jax.random.key(0), 1.0, 2))
+        assert set(toks.tolist()) <= {2, 5}
+        assert len(set(toks.tolist())) == 2  # temperature still samples
+
+    def test_sample_helper_rewired_through_shared_op(self):
+        # SampleEmbeddingHelper must keep its exact draw schedule after
+        # the rewire: same seed → same ids as raw categorical
+        import jax
+        from paddle1_tpu.core.tensor import to_tensor
+        from paddle1_tpu.nn.decode import SampleEmbeddingHelper
+        h = SampleEmbeddingHelper(lambda x: x, np.zeros(3, np.int64), 1,
+                                  softmax_temperature=0.7, seed=9)
+        lg = np.random.default_rng(0).standard_normal(
+            (3, 16)).astype(np.float32)
+        got = np.asarray(h.sample(2, to_tensor(lg), None).numpy())
+        ref = np.asarray(jax.random.categorical(
+            jax.random.key(9 + 2), lg / 0.7, axis=-1))
+        np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# slow soak matrix (CI's generate lane; bench.py --generate is the gate)
+
+
+@pytest.mark.slow
+class TestContinuousBatchingSoak:
+    def test_staggered_arrivals_bit_identical_and_one_compile(self, lm):
+        paddle.seed(7)
+        eng = GenerationEngine(lm, slots=4, max_seq=MAX_SEQ,
+                               prefill_buckets=(4, 8))
+        prompts = [[1, 5, 3], [2, 2], [7, 1, 4, 9, 6], [3], [6, 6],
+                   [9, 9, 9, 9, 9, 9, 9], [2, 4], [1]]
+        kws = [dict(max_new_tokens=8) if i % 2 else
+               dict(max_new_tokens=8, temperature=0.9, top_k=6,
+                    seed=50 + i) for i in range(len(prompts))]
+
+        def run(stagger):
+            srv = _serve(eng, queue_depth=64, token_budget=8)
+            streams = []
+            for i, (p, k) in enumerate(zip(prompts, kws)):
+                streams.append(srv.submit(p, **k))
+                if stagger and i % 3 == 2:
+                    # let the running batch advance before more join
+                    while len(streams[0].tokens) < min(2 + i, 8):
+                        time.sleep(0.002)
+            outs = [s.result(timeout=120) for s in streams]
+            rep = srv.drain(timeout=120)
+            return outs, rep
+
+        burst, rep1 = run(stagger=False)
+        staggered, rep2 = run(stagger=True)
+        assert staggered == burst
+        assert eng.decode_compile_count == 1
+        for rep in (rep1, rep2):
+            assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+
+    def test_slot_reuse_waves_with_ragged_lengths(self, lm):
+        paddle.seed(7)
+        eng = GenerationEngine(lm, slots=2, max_seq=MAX_SEQ,
+                               prefill_buckets=(4, 8))
+        srv = _serve(eng, queue_depth=64, token_budget=6)
+        rng = np.random.default_rng(0)
+        streams = []
+        for i in range(12):  # 6 waves over 2 slots
+            n = int(rng.integers(1, 7))
+            streams.append(srv.submit(
+                rng.integers(0, VOCAB, size=n).tolist(),
+                max_new_tokens=int(rng.integers(2, 7))))
+        outs = [s.result(timeout=120) for s in streams]
+        rep = srv.drain(timeout=120)
+        assert all(len(o) >= 2 for o in outs)
+        assert eng.decode_compile_count == 1
+        assert rep["unaccounted"] == 0 and rep["tokens_owed"] == 0
+        # every request alone reproduces its batched tokens exactly
+        for i in (0, 5, 11):
+            srv = _serve(eng, token_budget=6)
+            rng2 = np.random.default_rng(0)
+            reqs = []
+            for j in range(12):
+                n = int(rng2.integers(1, 7))
+                reqs.append((rng2.integers(0, VOCAB, size=n).tolist(),
+                             int(rng2.integers(2, 7))))
+            p, m = reqs[i]
+            assert srv.submit(p, max_new_tokens=m).result(
+                timeout=120) == outs[i]
+            srv.drain()

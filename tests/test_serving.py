@@ -106,6 +106,44 @@ class TestMetrics:
         assert f"p1t_serving_t_sum {repr(0.1 + 0.1 + 0.1)}" \
             in h2.render_text()
 
+    def test_generation_metrics_exposition(self):
+        """ISSUE 9 satellite, extending the PR 7 format snapshot: the
+        generation counters/gauge/histogram export — a gauge gets a
+        ``# TYPE ... gauge`` header and a plain sample line, the
+        per-request tokens_per_s rides the summary format, and
+        tokens_generated_total is an ordinary counter line."""
+        m = ServingMetrics()
+        m.counter("tokens_generated_total").inc(37)
+        m.gauge("slot_occupancy").set(0.75)
+        m.histogram("tokens_per_s").observe(120.0)
+        m.histogram("tokens_per_s").observe(80.0)
+        lines = m.render_text().splitlines()
+        assert "p1t_serving_tokens_generated_total 37" in lines
+        assert "# TYPE p1t_serving_slot_occupancy gauge" in lines
+        assert "p1t_serving_slot_occupancy 0.75" in lines
+        assert "# TYPE p1t_serving_tokens_per_s summary" in lines
+        assert "p1t_serving_tokens_per_s_count 2" in lines
+        assert "p1t_serving_tokens_per_s_sum 200.0" in lines
+        # snapshot carries the gauge; labeled multi-child pages drop
+        # the TYPE header but keep the labeled sample (PR 7 rule)
+        assert m.snapshot()["gauges"]["slot_occupancy"] == 0.75
+        labeled = m.render_text(label=("version", "v2"),
+                                type_headers=False)
+        assert 'p1t_serving_slot_occupancy{version="v2"} 0.75' \
+            in labeled.splitlines()
+        assert "# TYPE p1t_serving_slot_occupancy gauge" not in labeled
+
+    def test_gauges_merge_worst_child(self):
+        from paddle1_tpu.serving import merge_snapshots
+        a, b = ServingMetrics(), ServingMetrics()
+        a.gauge("slot_occupancy").set(0.25)
+        b.gauge("slot_occupancy").set(0.9)
+        a.counter("tokens_generated_total").inc(10)
+        b.counter("tokens_generated_total").inc(5)
+        agg = merge_snapshots([a.snapshot(), b.snapshot()])
+        assert agg["gauges"]["slot_occupancy"] == 0.9
+        assert agg["counters"]["tokens_generated_total"] == 15
+
 
 class TestBuckets:
     def test_auto_powers_of_two(self):
