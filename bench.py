@@ -1190,6 +1190,197 @@ def bench_generate(on_tpu, steps_override=None):
             f"drain): {json.dumps(detail)}")
 
 
+def bench_obs(on_tpu, steps_override=None):
+    """``--obs``: observability acceptance gate (ISSUE 10), two parts.
+
+    **Overhead** — the same tiny-MLP training loop (per-step readback:
+    the worst case for instrumentation, every phase histogram AND the
+    readback timer fire each step) is timed with observability fully
+    off and with metrics+tracing fully on, interleaved best-of-3
+    (bench_utils noise policy). Gates: enabled overhead < 5% of step
+    time, and disabled cost ≈ 0 proven STRUCTURALLY — a disabled run
+    touches neither the process registry nor the trace sink (zero
+    metric families, zero span files), so the only possible residue is
+    the flag checks themselves.
+
+    **Cross-process trace** — a 2-replica ServingFleet soak with a
+    ``replica_hang`` chaos point and a tight transport deadline: the
+    wedged request fails over, and the merged chrome-trace export must
+    show ONE request's spans across >= 3 processes (client/router in
+    the fleet process, the wedged replica, the failover replica)
+    linked by trace_id, with client -> router -> replica -> batcher
+    span names and flow events. ``vs_baseline`` is 1.0 iff every gate
+    holds; the metric is the enabled-overhead fraction."""
+    import os
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import jax
+    import paddle1_tpu as paddle
+    from bench_utils import best_of
+    from paddle1_tpu import obs
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.core import flags as core_flags
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import ParallelEngine, build_mesh
+    from paddle1_tpu.obs import trace as obs_trace
+    from paddle1_tpu.serving import ServingFleet
+
+    steps = steps_override or (100 if on_tpu else 60)
+    rng = np.random.default_rng(0)
+    # a few-ms step (batch 256 MLP on CPU): small enough to iterate,
+    # big enough that the gate measures instrumentation against a
+    # realistic denominator — real training steps are ms-scale and up,
+    # and the per-step obs cost is a fixed ~tens of us
+    batches = [{"x": rng.standard_normal((256, 256)).astype(np.float32),
+                "y": rng.standard_normal((256, 64)).astype(np.float32)}
+               for _ in range(8)]
+
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(256, 512), paddle.nn.ReLU(),
+        paddle.nn.Linear(512, 64))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    loss_fn = lambda m, b: \
+        ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+    mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+    engine = ParallelEngine(model, opt, loss_fn, mesh=mesh)
+    for _ in range(5):  # compile + settle outside every timed round
+        float(engine.step(batches[0]))
+
+    def run_steps():
+        for i in range(steps):
+            # per-step readback: the instrumentation worst case (each
+            # step pays shard+dispatch histograms AND the readback
+            # timer when enabled)
+            float(engine.step(batches[i % len(batches)]))
+
+    tmp = tempfile.mkdtemp(prefix="p1t_obsbench_")
+    train_trace = os.path.join(tmp, "train_trace")
+    try:
+        # structural disabled-cost proof BEFORE anything ever enables
+        # obs in this process (a fresh registry must stay untouched)
+        obs.reset_process_registry()
+        run_steps()
+        disabled_clean = obs.process_registry().empty() and \
+            not os.path.isdir(train_trace)
+
+        def disabled_phase():
+            run_steps()
+
+        def enabled_phase():
+            with core_flags.flags_guard(obs_metrics=True,
+                                        obs_trace_dir=train_trace):
+                run_steps()
+
+        # best-of-5: the true overhead is ~tens of us/step (~1-2%) but
+        # this shared box schedules ~10ms stalls into 200ms phases —
+        # min-of-5 interleaved keeps the gate's noise floor well under
+        # the 5% line (bench_utils noise policy)
+        dis_bo, en_bo = best_of(5, disabled_phase, enabled_phase)
+        overhead = en_bo.best_s / dis_bo.best_s - 1.0
+
+        snap = obs.process_registry().snapshot()
+        hists = snap["histograms"]
+        metrics_ok = all(
+            hists.get(h, {}).get("count", 0) >= steps
+            for h in ("train_shard_seconds", "train_dispatch_seconds",
+                      "train_readback_seconds"))
+        train_span_names = {s["name"]
+                           for s in obs_trace.read_spans(train_trace)}
+        train_trace_ok = {"train/step", "train/shard",
+                          "train/dispatch"} <= train_span_names
+
+        # live telemetry endpoint smoke: the enabled run's families
+        # must be scrapeable, and /healthz must answer
+        tele = obs.TelemetryServer(port=0).start()
+        page = urllib.request.urlopen(
+            tele.url + "/metrics", timeout=10).read().decode()
+        hz = json.loads(urllib.request.urlopen(
+            tele.url + "/healthz", timeout=10).read())
+        tele.stop()
+        endpoint_ok = ("# TYPE p1t_train_dispatch_seconds summary"
+                       in page and hz.get("ok") is True)
+
+        # -- part B: one request's spans across >= 3 processes ----------
+        fleet_trace = os.path.join(tmp, "fleet_trace")
+        factory = os.path.join(tmp, "factory.py")
+        with open(factory, "w") as f:
+            f.write(_FLEET_FACTORY)
+        chaos.reset()
+        # replicas inherit the sink via env; this process via set_flags
+        os.environ["FLAGS_obs_trace_dir"] = fleet_trace
+        core_flags.set_flags({"obs_trace_dir": fleet_trace})
+        try:
+            fleet = ServingFleet(
+                f"{factory}:make_model", replicas=2, version="v1",
+                model_arg="v1", max_batch=8, buckets=(1, 8),
+                batch_timeout_ms=2, input_specs=[((32,), "float32")],
+                warmup=True, retry_max=2, replica_timeout_ms=2000,
+                hang_timeout=30.0, poll_s=0.1, inflight_per_replica=2,
+                chaos_spec="replica_hang@1:0",
+                env={"JAX_PLATFORMS": "cpu"},
+                work_dir=os.path.join(tmp, "fleet"))
+            fleet.start()
+            futs = [fleet.submit(
+                rng.standard_normal((1, 32)).astype(np.float32))
+                for _ in range(8)]
+            for fut in futs:
+                fut.result(timeout=120)
+            freport = fleet.drain()
+        finally:
+            core_flags.set_flags({"obs_trace_dir": ""})
+            os.environ.pop("FLAGS_obs_trace_dir", None)
+
+        pids_by_trace = {}
+        for s in obs_trace.read_spans(fleet_trace):
+            if s.get("trace"):
+                pids_by_trace.setdefault(s["trace"], set()).add(s["pid"])
+        best_tid, best_pids = max(pids_by_trace.items(),
+                                  key=lambda kv: len(kv[1]),
+                                  default=(None, set()))
+        merged = os.path.join(tmp, "fleet_request_trace.json")
+        # the export's parent-aware filter also pulls in spans that
+        # flow-link INTO the trace (a micro-batch dispatch span lists
+        # every co-batched request as a parent)
+        stats = obs_trace.export_chrome_trace(fleet_trace, merged,
+                                              trace_id=best_tid)
+        names = set(stats["names"])
+        fleet_ok = (len(best_pids) >= 3 and stats["flows"] >= 3
+                    and freport["unaccounted"] == 0
+                    and {"client/submit", "fleet/dispatch",
+                         "replica/recv", "replica/serve",
+                         "serve/batch_dispatch"} <= names)
+
+        ok = (disabled_clean and overhead < 0.05 and metrics_ok
+              and train_trace_ok and endpoint_ok and fleet_ok)
+        detail = {"steps": steps,
+                  "disabled_s": round(dis_bo.best_s, 4),
+                  "enabled_s": round(en_bo.best_s, 4),
+                  "overhead_frac": round(overhead, 4),
+                  "disabled_clean": disabled_clean,
+                  "metrics_ok": metrics_ok,
+                  "train_trace_ok": train_trace_ok,
+                  "endpoint_ok": endpoint_ok,
+                  "fleet_trace_pids": len(best_pids),
+                  "fleet_flows": stats["flows"],
+                  "fleet_span_names": sorted(names),
+                  "fleet_unaccounted": freport["unaccounted"],
+                  "chrome_trace": merged}
+        _emit("obs_overhead_frac", max(overhead, 0.0), "fraction",
+              1.0 if ok else 0.0, detail)
+        if not ok:
+            raise AssertionError(
+                "obs gate failed (need disabled-cost ~0, enabled "
+                "overhead < 5%, scrapeable endpoint, and one request "
+                f"traced across >= 3 processes): {json.dumps(detail)}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+
 _FLEET_FACTORY = '''
 """bench --serving-fleet replica model: a deterministic MLP whose
 weights are a pure function of the seed, so every replica process —
@@ -1449,6 +1640,16 @@ def main():
                          "arrival bit-parity, exactly one decode "
                          "compile, and token-level unaccounted==0 on "
                          "a drain under load; vs_baseline = speedup/5")
+    ap.add_argument("--obs", action="store_true",
+                    help="observability gate: instrumented training "
+                         "loop overhead (metrics+tracing enabled < 5% "
+                         "of step time, disabled ~0 proven "
+                         "structurally), a scrapeable /metrics + "
+                         "/healthz endpoint, and a fleet soak whose "
+                         "merged chrome trace shows one request's "
+                         "spans across >= 3 processes (client/router, "
+                         "wedged replica, failover replica) linked by "
+                         "trace_id with flow events")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection soak: run the ResilientTrainer "
                          "through a poisoned batch, a failed checkpoint "
@@ -1486,6 +1687,8 @@ def main():
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.generate:
         bench_generate(on_tpu, steps_override=args.steps)
+    elif args.obs:
+        bench_obs(on_tpu, steps_override=args.steps)
     elif args.chaos:
         bench_chaos_soak(on_tpu, steps_override=args.steps)
     elif args.loader_chaos:

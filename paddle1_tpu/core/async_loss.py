@@ -21,15 +21,27 @@ increments it exactly once per handle (the fetched value is cached).
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
+import time
+from typing import Any, Callable, Optional
 
 import numpy as np
 
 __all__ = ["LossFuture", "StepFuture", "readback_count",
-           "reset_readback_count"]
+           "reset_readback_count", "set_readback_observer"]
 
 _lock = threading.Lock()
 _readbacks = 0
+# optional duration hook (seconds per materialization): obs wires the
+# train_readback_seconds histogram through it when obs_metrics is on —
+# None (the default) keeps the fetch path free of even a perf_counter
+_observer: Optional[Callable[[float], None]] = None
+
+
+def set_readback_observer(fn: Optional[Callable[[float], None]]) -> None:
+    """Install (or clear, with None) a callable receiving each
+    materialization's duration in seconds."""
+    global _observer
+    _observer = fn
 
 
 def readback_count() -> int:
@@ -93,8 +105,12 @@ class LossFuture:
 
     def numpy(self) -> np.ndarray:
         if self._result is None:
+            obs = _observer
+            t0 = time.perf_counter() if obs is not None else 0.0
             self._result = np.asarray(self._arr)
             _count_readback()
+            if obs is not None:
+                obs(time.perf_counter() - t0)
         return self._result
 
     def item(self) -> float:
@@ -206,8 +222,12 @@ class StepFuture(LossFuture):
 
     def _fetch(self) -> np.ndarray:
         if self._raw is None:
+            obs = _observer
+            t0 = time.perf_counter() if obs is not None else 0.0
             self._raw = np.asarray(self._arr)
             _count_readback()
+            if obs is not None:
+                obs(time.perf_counter() - t0)
         return self._raw
 
     def numpy(self) -> np.ndarray:

@@ -547,6 +547,43 @@ def _define_builtin_flags() -> None:
                 "(covers import + per-bucket XLA warmup) before "
                 "treating the launch — or a deploy canary — as failed.",
                 validator=lambda v: v > 0)
+    # Observability (consumed by paddle1_tpu.obs — the unified metrics
+    # registry, cross-process tracing and live telemetry of ISSUE 10;
+    # MIGRATING.md maps the reference paddle.profiler / tools/timeline
+    # knobs onto these)
+    define_flag("obs_metrics", False,
+                "Per-step training instrumentation into the process "
+                "MetricsRegistry (engine phase histograms: data wait, "
+                "shard, dispatch, readback; samples/s and "
+                "steps-per-readback gauges). Off by default so the "
+                "disabled hot-path cost is ~0 (the bench.py --obs "
+                "gate); rare lifecycle counters (checkpoints, "
+                "restarts, quarantines) record regardless.")
+    define_flag("obs_port", 0,
+                "Serve GET /metrics (Prometheus text exposition of the "
+                "process registry) and /healthz from a stdlib-HTTP "
+                "daemon thread on this port. 0 disables (default), -1 "
+                "binds an ephemeral port. ServingFleet.start_telemetry "
+                "and Supervisor.start_telemetry additionally aggregate "
+                "child pages via merge_snapshots.",
+                validator=lambda v: v >= -1)
+    define_flag("obs_trace_dir", "",
+                "Cross-process trace sink: every process appends "
+                "completed spans (trace_id/span_id/parent, epoch-us "
+                "timestamps) to spans-<pid>.jsonl under this "
+                "directory; obs.trace.export_chrome_trace merges them "
+                "into one chrome://tracing view with flow arrows "
+                "(request: client -> fleet router -> replica -> "
+                "batcher -> dispatch; training: per-step phase "
+                "breakdown). Propagated to Supervisor workers and "
+                "fleet replicas via FLAGS_obs_trace_dir env. Empty "
+                "disables.")
+    define_flag("obs_events_file", "",
+                "Structured JSONL lifecycle journal (restart, resize, "
+                "deploy, shed, quarantine, checkpoint commit): one "
+                "JSON object per line, shared append-safely by every "
+                "process of a job (propagated to workers via env). "
+                "Empty disables.")
     # IO formats
     define_flag("io_load_pickle", False,
                 "Allow fluid.io load_* to read LEGACY pickle payloads. "

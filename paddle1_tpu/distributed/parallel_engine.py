@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
@@ -43,7 +44,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import flags as core_flags
+from ..core import async_loss
 from ..core.async_loss import LossFuture, StepFuture
+from ..obs import trace as obs_trace
 from ..core.generator import next_key, rng_scope
 from ..core.tensor import Tensor
 from ..autograd import engine as autograd_engine
@@ -53,6 +56,67 @@ from .sharding_specs import (data_partition_spec, param_partition_specs,
 from .topology import build_mesh
 
 __all__ = ["ParallelEngine", "make_train_step"]
+
+
+def _obs_step_registry():
+    """The process registry iff per-step instrumentation is on
+    (obs_metrics flag) — one flag read on the hot path, None otherwise
+    (the bench --obs disabled-cost contract)."""
+    from ..obs import registry as obs_registry
+    return obs_registry.step_registry()
+
+
+# process-level throughput state behind the train_samples_per_s /
+# train_steps_per_readback gauges: one gauge family per process, so the
+# state is process-global too — two engines in one process (train +
+# eval, GAN pairs) contribute to ONE aggregate instead of clobbering
+# each other with per-engine numbers against a process-wide readback
+# counter
+_obs_thru = {"rb_base": None, "last_t": None, "rate": None}
+
+
+def _obs_note_steps(m, k: int, rows: int, t_now: float) -> None:
+    """Feed the throughput gauges after an instrumented dispatch:
+    samples/s as an EWMA over wall time between dispatches, and
+    steps-per-readback (how well the lazy-loss window amortizes the
+    host round trip — the step_many story in one number)."""
+    st = _obs_thru
+    if st["rb_base"] is None:
+        st["rb_base"] = async_loss.readback_count()
+    c = m.counter("train_steps_total")
+    c.inc(k)
+    last, st["last_t"] = st["last_t"], t_now
+    if last is not None and t_now > last:
+        inst = (k * rows) / (t_now - last)
+        st["rate"] = inst if st["rate"] is None else \
+            0.8 * st["rate"] + 0.2 * inst
+        m.gauge("train_samples_per_s").set(st["rate"])
+    rb = async_loss.readback_count() - st["rb_base"]
+    total = c.value
+    m.gauge("train_steps_per_readback").set(
+        total / rb if rb > 0 else float(total))
+
+
+_readback_obs_installed = False
+
+
+def _ensure_readback_observer():
+    """Route LossFuture materialization durations into the process
+    registry's train_readback_seconds histogram (idempotent; installed
+    the first time an instrumented step runs, so uninstrumented
+    processes never pay the per-fetch perf_counter)."""
+    global _readback_obs_installed
+    if _readback_obs_installed:
+        return
+    _readback_obs_installed = True
+    from ..obs import registry as obs_registry
+
+    def observe(dt: float) -> None:
+        if obs_registry.metrics_on():
+            obs_registry.process_registry().histogram(
+                "train_readback_seconds").observe(dt)
+
+    async_loss.set_readback_observer(observe)
 
 
 def _as_arrays(batch):
@@ -553,14 +617,44 @@ class ParallelEngine:
             self._inflight.popleft().block()
         return fut
 
+    # -- per-step observability (obs_metrics flag; ISSUE 10) ---------------
+
+    @staticmethod
+    def _obs_rows(batch, grad_accum: int) -> int:
+        """Leading-dim sample count of one (sharded) batch — the
+        samples/s numerator. Under grad_accum the leading dim is the
+        accumulation axis and the per-micro-batch dim sits behind it."""
+        leaves = jax.tree_util.tree_leaves(batch)
+        if not leaves or np.ndim(leaves[0]) == 0:
+            return 1
+        shape = np.shape(leaves[0])
+        if grad_accum > 1 and len(shape) > 1:
+            return int(shape[0]) * int(shape[1])
+        return int(shape[0])
+
     def step(self, batch, lr: Optional[float] = None) -> LossFuture:
+        m = _obs_step_registry()
+        if m is not None:
+            _ensure_readback_observer()
+        t0 = time.perf_counter() if m is not None else 0.0
         lr_val = jnp.asarray(lr if lr is not None else
                              self.optimizer.get_lr(), jnp.float32)
-        batch = self.shard_batch(batch)
-        self._guard_retrace("step", batch)
-        self.dispatch_count += 1
-        loss, self.params, self.opt_state = self._jit(
-            self.params, self.opt_state, batch, next_key(), lr_val)
+        with obs_trace.span("train/step", cat="Engine"):
+            with obs_trace.span("train/shard", cat="Engine"):
+                batch = self.shard_batch(batch)
+            t1 = time.perf_counter() if m is not None else 0.0
+            self._guard_retrace("step", batch)
+            self.dispatch_count += 1
+            with obs_trace.span("train/dispatch", cat="Engine"):
+                loss, self.params, self.opt_state = self._jit(
+                    self.params, self.opt_state, batch, next_key(),
+                    lr_val)
+        if m is not None:
+            t2 = time.perf_counter()
+            m.histogram("train_shard_seconds").observe(t1 - t0)
+            m.histogram("train_dispatch_seconds").observe(t2 - t1)
+            _obs_note_steps(m, 1,
+                            self._obs_rows(batch, self.grad_accum), t2)
         sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(sched, "step"):
             sched.step()
@@ -609,21 +703,37 @@ class ParallelEngine:
             raise InvalidArgumentError("step_many needs >= 1 batch")
         if k == 1:
             return self.step(batches[0], lr)
-        sharded = [self.shard_batch(b) for b in batches]
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                         *sharded)
-        self._guard_retrace(f"step_many[k={k}]", sharded[0])
-        sched = getattr(self.optimizer, "_learning_rate", None)
-        lrs = []
-        for _ in range(k):
-            lrs.append(lr if lr is not None else self.optimizer.get_lr())
-            if hasattr(sched, "step"):
-                sched.step()
-        lrs = jnp.asarray(lrs, jnp.float32)
-        keys = jnp.stack([next_key() for _ in range(k)])
-        self.dispatch_count += 1
-        losses, self.params, self.opt_state = self._jit_many(k)(
-            self.params, self.opt_state, stacked, keys, lrs)
+        m = _obs_step_registry()
+        if m is not None:
+            _ensure_readback_observer()
+        t0 = time.perf_counter() if m is not None else 0.0
+        with obs_trace.span("train/step_many", cat="Engine",
+                            args={"k": k}):
+            with obs_trace.span("train/shard", cat="Engine"):
+                sharded = [self.shard_batch(b) for b in batches]
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *sharded)
+            t1 = time.perf_counter() if m is not None else 0.0
+            self._guard_retrace(f"step_many[k={k}]", sharded[0])
+            sched = getattr(self.optimizer, "_learning_rate", None)
+            lrs = []
+            for _ in range(k):
+                lrs.append(lr if lr is not None
+                           else self.optimizer.get_lr())
+                if hasattr(sched, "step"):
+                    sched.step()
+            lrs = jnp.asarray(lrs, jnp.float32)
+            keys = jnp.stack([next_key() for _ in range(k)])
+            self.dispatch_count += 1
+            with obs_trace.span("train/dispatch", cat="Engine"):
+                losses, self.params, self.opt_state = self._jit_many(k)(
+                    self.params, self.opt_state, stacked, keys, lrs)
+        if m is not None:
+            t2 = time.perf_counter()
+            m.histogram("train_shard_seconds").observe(t1 - t0)
+            m.histogram("train_dispatch_seconds").observe(t2 - t1)
+            _obs_note_steps(
+                m, k, self._obs_rows(sharded[0], self.grad_accum), t2)
         # check_finite: the scan body already emits packed [loss,
         # notfinite] pairs, so `losses` is [k, 2] and the per-step flags
         # ride the same single readback
@@ -641,6 +751,8 @@ class ParallelEngine:
         k = self.train_steps_per_sync
         it = iter(batches)
         while True:
+            m = _obs_step_registry()
+            t0 = time.perf_counter() if m is not None else 0.0
             if hasattr(it, "peek_many"):
                 try:
                     chunk = it.peek_many(k)
@@ -653,6 +765,11 @@ class ParallelEngine:
                         chunk.append(next(it))
                     except StopIteration:
                         break
+            if m is not None:
+                # host data wait: time the step loop spent blocked on
+                # the input pipeline before it could even dispatch
+                m.histogram("train_data_wait_seconds").observe(
+                    time.perf_counter() - t0)
             if not chunk:
                 return
             if len(chunk) == k and k > 1:

@@ -54,6 +54,8 @@ from ..core import flags as core_flags
 from ..core import health
 from ..core.errors import InvalidArgumentError
 from ..core.generator import get_rng_state, set_rng_state
+from ..obs import events as obs_events
+from ..obs import registry as obs_registry
 from .checkpoint import CheckpointCorruptError, CheckpointManager
 
 __all__ = ["ResilientTrainer", "ResilienceReport", "BadStepError"]
@@ -265,6 +267,7 @@ class ResilientTrainer:
         the previous checkpoint window)."""
         self.engine.drain()
         health.beat()  # a long drain must not read as a hang
+        t0 = time.perf_counter()
         try:
             self._retrying(
                 lambda: self.manager.save(step, self._state(),
@@ -272,12 +275,22 @@ class ResilientTrainer:
                 what=f"checkpoint save (step {step})")
         except Exception as e:
             self.report.checkpoint_write_failures += 1
+            obs_registry.process_registry().counter(
+                "ft_checkpoint_write_failures_total").inc()
+            obs_events.emit("checkpoint_abandoned", step=int(step),
+                            error=repr(e))
             warnings.warn(
                 f"checkpoint at step {step} abandoned after "
                 f"{self.max_retries} retries ({e}); continuing — the "
                 f"restore window stays at step {self.manager.latest_step()}")
             return False
+        dt = time.perf_counter() - t0
         self.report.checkpoints_written += 1
+        m = obs_registry.process_registry()
+        m.counter("ft_checkpoints_total").inc()
+        m.histogram("ft_checkpoint_save_seconds").observe(dt)
+        obs_events.emit("checkpoint_commit", step=int(step),
+                        seconds=round(dt, 4))
         self._last_saved = int(step)
         return True
 
@@ -285,6 +298,7 @@ class ResilientTrainer:
         """Roll engine + RNG + LR schedule + host recovery state back to
         the newest checkpoint that verifies (falling back past corrupt
         ones). Returns the restored global step."""
+        t0 = time.perf_counter()
         try:
             restored, ckpt_step = self.manager.restore(self._state())
         except FileNotFoundError as e:
@@ -332,6 +346,11 @@ class ResilientTrainer:
         # iterator right after a restore)
         self._restored_loader_state = meta.get("loader")
         self.report.restores += 1
+        m = obs_registry.process_registry()
+        m.counter("ft_restores_total").inc()
+        m.histogram("ft_checkpoint_restore_seconds").observe(
+            time.perf_counter() - t0)
+        obs_events.emit("restore", step=int(meta.get("step", ckpt_step)))
         return int(meta.get("step", ckpt_step))
 
     # -- retry wrapper ---------------------------------------------------
@@ -550,6 +569,8 @@ class ResilientTrainer:
                     self.report.divergence_trips += 1
                 if bad or diverged:
                     self.report.bad_steps += 1
+                    obs_registry.process_registry().counter(
+                        "ft_bad_steps_total").inc()
                     if self.scaler is not None:
                         self.scaler.record_step(found_inf=True)
                     step, it = self._handle_bad_step(
@@ -573,6 +594,11 @@ class ResilientTrainer:
                     self.save(step)
             except chaos.SimulatedPreemption as e:
                 self.report.preemptions += 1
+                obs_registry.process_registry().counter(
+                    "ft_preemptions_total").inc()
+                obs_events.emit("preemption", step=int(step),
+                                graceful=bool(getattr(e, "graceful",
+                                                      False)))
                 if getattr(e, "graceful", False):
                     # an advance NOTICE (SIGTERM grace window): the
                     # current params are known-good — checkpoint them

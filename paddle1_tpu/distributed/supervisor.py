@@ -361,6 +361,7 @@ class Supervisor:
         # runs concurrently with its sweep thread (supervise_once) —
         # run()'s single-threaded trainer loop never contends on it
         self._table_lock = threading.Lock()
+        self._telemetry = None
         self.report = SupervisorReport(policy=self.policy)
 
     # -- registration -----------------------------------------------------
@@ -382,6 +383,71 @@ class Supervisor:
                                           role, essential,
                                           max_restarts=max_restarts)
         return rank
+
+    # -- telemetry (ISSUE 10) ----------------------------------------------
+
+    def start_telemetry(self, port: Optional[int] = None):
+        """Serve this pod's ``/metrics`` + ``/healthz``: the
+        supervisor's own process registry (restart/resize/failure
+        counters) followed by the merged snapshot of every worker's
+        registry — workers publish their snapshots to per-rank files in
+        the heartbeat dir (the env :meth:`_obs_worker_env` stamps), and
+        the page folds them via :func:`~paddle1_tpu.obs.merge_snapshots`
+        labeled ``scope="workers"``. ``port`` None reads the
+        ``obs_port`` flag (0 keeps it off); 0 binds ephemeral. Returns
+        the :class:`~paddle1_tpu.obs.TelemetryServer` (or None)."""
+        if self._telemetry is not None:
+            return self._telemetry
+        from ..obs.http import TelemetryServer, resolve_port_flag
+        port = resolve_port_flag(port)
+        if port is None:
+            return None
+        self._telemetry = TelemetryServer(
+            port=port, providers=[self._worker_metrics_page],
+            healthz=self._healthz).start()
+        return self._telemetry
+
+    def stop_telemetry(self) -> None:
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
+
+    def _worker_snapshots(self) -> Dict[int, dict]:
+        import json as _json
+        out: Dict[int, dict] = {}
+        with self._table_lock:
+            ranks = list(self._workers)
+        for rank in ranks:
+            path = os.path.join(self._heartbeat_dir(),
+                                f"metrics.{rank}.json")
+            try:
+                with open(path) as f:
+                    out[rank] = _json.load(f)
+            except (OSError, ValueError):
+                continue  # not published yet / torn mid-replace (the
+                # writer's atomic rename makes this a startup race only)
+        return out
+
+    def _worker_metrics_page(self) -> str:
+        from ..obs.registry import merge_snapshots, render_snapshot_text
+        snaps = self._worker_snapshots()
+        if not snaps:
+            return ""
+        return render_snapshot_text(merge_snapshots(snaps.values()),
+                                    namespace="p1t",
+                                    label=("scope", "workers"))
+
+    def _healthz(self) -> dict:
+        with self._table_lock:
+            workers = {
+                w.rank: ("done" if w.done else
+                         "running" if w.proc is not None
+                         and w.proc.poll() is None else "down")
+                for w in self._workers.values()}
+        return {"ok": all(v != "down" for v in workers.values()),
+                "policy": self.policy, "workers": workers,
+                "restarts": dict(self.report.restarts),
+                "resizes": len(self.report.resizes)}
 
     def attach(self, rank: int, proc, role: str = "trainer",
                essential: bool = False) -> int:
@@ -429,6 +495,7 @@ class Supervisor:
         env[HEARTBEAT_ENV] = w.hb_file
         env[STACKDUMP_ENV] = w.dump_path
         env[INCARNATION_ENV] = str(w.incarnation)
+        self._obs_worker_env(w, env)
         stdout = None
         if w.log_path:
             if w.log_fh is not None:  # previous incarnation's handle
@@ -450,6 +517,31 @@ class Supervisor:
         w.proc = subprocess.Popen(
             w.cmd, env=env, stdout=stdout,
             stderr=subprocess.STDOUT if stdout else None)
+
+    def _obs_worker_env(self, w: _Worker, env: Dict[str, str]) -> None:
+        """Stamp observability plumbing into one worker's env (ISSUE
+        10): the trace sink + events journal flags (so `set_flags` in
+        the supervisor process reaches children that only inherit
+        env), the job's trace context (worker spans join the
+        supervisor's trace), and a per-rank snapshot file the worker's
+        process registry publishes to — what :meth:`start_telemetry`
+        aggregates. Explicit worker env always wins."""
+        from ..obs import registry as obs_registry
+        from ..obs import trace as obs_trace
+        for flag_name in ("obs_trace_dir", "obs_events_file"):
+            v = core_flags.flag(flag_name)
+            key = "FLAGS_" + flag_name
+            if v and key not in env:
+                env[key] = str(v)
+        if core_flags.flag("obs_metrics"):
+            env.setdefault("FLAGS_obs_metrics", "1")
+            env.setdefault(
+                obs_registry.SNAPSHOT_ENV,
+                os.path.join(self._heartbeat_dir(),
+                             f"metrics.{w.rank}.json"))
+        entry = obs_trace.env_entry()
+        if entry is not None and entry[0] not in env:
+            env[entry[0]] = entry[1]
 
     def start(self) -> "Supervisor":
         """Spawn every registered (not yet running) respawnable worker."""
@@ -742,6 +834,13 @@ class Supervisor:
         w.incarnation += 1
         self.report.restarts[w.rank] = used + 1
         self._spawn(w)
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        obs_registry.process_registry().counter(
+            "ft_worker_restarts_total").inc()
+        obs_events.emit("worker_restart", rank=w.rank, role=w.role,
+                        incarnation=w.incarnation,
+                        restarts_used=used + 1)
         print(f"supervisor: rank {w.rank} relaunched "
               f"(restart {used + 1}/{budget}, "
               f"incarnation {w.incarnation})", file=sys.stderr)
@@ -786,6 +885,8 @@ class Supervisor:
         so resilient loops checkpoint and exit), wait out the grace
         window, then terminate stragglers."""
         self.report.drained = True
+        from ..obs import events as obs_events
+        obs_events.emit("drain", workers=len(self._workers))
         self._graceful_stop(list(self._workers.values()),
                             self.grace_s if grace_s is None else grace_s,
                             kill_stragglers=False)
@@ -830,6 +931,15 @@ class Supervisor:
         """Bookkeeping common to policy handling and resize routing:
         counters, stack dump for hangs, marker consumption."""
         self.report.failures.append(f)
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        m = obs_registry.process_registry()
+        m.counter("ft_worker_failures_total").inc()
+        if f.kind == HANG:
+            m.counter("ft_worker_hangs_total").inc()
+        obs_events.emit("worker_failure", rank=w.rank, role=w.role,
+                        kind=f.kind, reason=f.reason,
+                        exit_code=f.exit_code)
         if f.kind == HANG:
             self.report.hangs_detected += 1
             f.stack_dump = self._collect_stack_dump(w)
@@ -942,6 +1052,11 @@ class Supervisor:
         # 4. relaunch with the new world coordinates
         self.report.resizes.append({"from": old_world, "to": new_world,
                                     "reason": reason})
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        obs_registry.process_registry().counter("ft_resizes_total").inc()
+        obs_events.emit("resize", world_from=old_world,
+                        world_to=new_world, reason=reason)
         self.report.world_size = new_world
         self.world_size = new_world
         for w in targets:
@@ -1076,4 +1191,5 @@ class Supervisor:
             self._terminate_all()
             raise
         finally:
+            self.stop_telemetry()
             self._close_logs()

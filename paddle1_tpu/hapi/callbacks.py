@@ -14,7 +14,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "CallbackList"]
+           "LRScheduler", "MetricsCallback", "CallbackList"]
 
 
 class Callback:
@@ -188,6 +188,90 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print(f"Early stopping: no improvement in "
                           f"{self.monitor} for {self.patience} evals")
+
+
+class MetricsCallback(Callback):
+    """Publish ``Model.fit``/``evaluate`` progress into the unified
+    observability registry (ISSUE 10 satellite), so a hapi run is
+    scrapeable from ``/metrics`` exactly like an engine run:
+
+    * ``hapi_steps_total`` / ``hapi_epochs_total`` counters,
+    * ``hapi_loss`` gauge (latest train loss) and per-eval-metric
+      ``hapi_eval_<name>`` gauges,
+    * ``hapi_step_seconds`` histogram and ``hapi_samples_per_s`` gauge
+      (throughput from ``batch_size`` × step rate).
+
+    ``log_freq`` bounds the cost: reading a lazy loss materializes it
+    (one device→host readback), so the loss gauge updates every
+    ``log_freq``-th step — counters and timing are readback-free and
+    update every step. Adding the callback is the opt-in; it reports
+    into :func:`paddle1_tpu.obs.process_registry` (or a registry you
+    pass)."""
+
+    def __init__(self, batch_size: int = 1, log_freq: int = 10,
+                 registry=None):
+        super().__init__()
+        self.batch_size = int(batch_size)
+        self.log_freq = max(int(log_freq), 1)
+        self._registry = registry
+        self._last_t = None
+
+    @property
+    def registry(self):
+        if self._registry is None:
+            from ..obs import process_registry
+            self._registry = process_registry()
+        return self._registry
+
+    @staticmethod
+    def _scalar(v) -> Optional[float]:
+        try:
+            return float(np.ravel(np.asarray(v))[0])
+        except (TypeError, ValueError):
+            return None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.registry.gauge("hapi_epoch").set(epoch)
+        self._last_t = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        m = self.registry
+        m.counter("hapi_steps_total").inc()
+        now = time.perf_counter()
+        if self._last_t is not None:
+            dt = now - self._last_t
+            m.histogram("hapi_step_seconds").observe(dt)
+            if dt > 0:
+                m.gauge("hapi_samples_per_s").set(self.batch_size / dt)
+        self._last_t = now
+        if step % self.log_freq == 0:
+            losses = (logs or {}).get("loss")
+            if losses is not None:
+                vals = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
+                v = self._scalar(vals[0])  # materializes a lazy loss
+                if v is not None:
+                    m.gauge("hapi_loss").set(v)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.registry.counter("hapi_epochs_total").inc()
+
+    def on_eval_end(self, logs=None):
+        m = self.registry
+        for k, v in (logs or {}).items():
+            v = self._scalar(v)
+            if v is not None:
+                m.gauge(f"hapi_eval_{_metric_slug(k)}").set(v)
+
+
+def _metric_slug(name: str) -> str:
+    """Metric-name-safe slug of a user metric key (the lint contract:
+    snake_case, nothing the exposition format chokes on)."""
+    out = []
+    for ch in str(name).lower():
+        out.append(ch if ch.isalnum() else "_")
+    slug = "".join(out).strip("_") or "metric"
+    return slug if slug[0].isalpha() else "m_" + slug
 
 
 class LRScheduler(Callback):

@@ -122,10 +122,17 @@ class BadSampleLog:
                quarantine_file: str = "") -> None:
         if not skipped:
             return
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        m = obs_registry.process_registry()
+        m.counter("loader_bad_samples_total").inc(len(skipped))
         with self._lock:
             self.count += len(skipped)
             if policy != "quarantine":
                 return
+            m.counter("loader_quarantined_total").inc(len(skipped))
+            obs_events.emit("quarantine", count=len(skipped),
+                            indices=[r.get("index") for r in skipped])
             self.records.extend(skipped)
             if not quarantine_file:
                 return

@@ -306,6 +306,11 @@ class _SingleProcessIter:
                 waited += _SWEEP_SLICE_S
                 if waited >= timeout:
                     self._loader.stall_events += 1
+                    from ..obs import events as obs_events
+                    from ..obs import registry as obs_registry
+                    obs_registry.process_registry().counter(
+                        "loader_stalls_total").inc()
+                    obs_events.emit("loader_stall", waited=round(waited, 2))
                     alive = self._thread.is_alive()
                     err = DataLoaderStalled(
                         f"no batch in {waited:.1f}s "
@@ -786,6 +791,11 @@ class _MultiProcessIter:
         """Watchdog trip: dump liveness + pending map, then restart the
         worker owing the next batch (budget permitting) or fail typed."""
         self._loader.stall_events += 1
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        obs_registry.process_registry().counter(
+            "loader_stalls_total").inc()
+        obs_events.emit("loader_stall", waited=round(waited, 2))
         dump = self._liveness_dump()
         w = self._recv_seq % self._nw
         warnings.warn(
@@ -871,6 +881,12 @@ class _MultiProcessIter:
             for s in redo:
                 self._task_qs[w].put((s, self._pending[s]))
             self._loader.worker_restart_count += 1
+            from ..obs import events as obs_events
+            from ..obs import registry as obs_registry
+            obs_registry.process_registry().counter(
+                "loader_worker_restarts_total").inc()
+            obs_events.emit("loader_worker_restart", worker=int(w),
+                            reason=reason, exitcode=exitcode)
             warnings.warn(
                 f"DataLoader worker {w} {reason} (exitcode {exitcode}); "
                 f"re-spawned (restart {self._restarts[w]}/"

@@ -1,0 +1,49 @@
+"""Process-wide observability: one pane of glass (ISSUE 10).
+
+Three layers, each opt-in and independently cheap:
+
+* **Metrics** — :mod:`obs.registry` promotes the serving runtime's
+  Counter/Gauge/Histogram into the single process-wide
+  :class:`MetricsRegistry`. Serving keeps its per-Server registries
+  (re-exported from ``serving/metrics.py``, zero API break); training
+  (:class:`~paddle1_tpu.distributed.ParallelEngine` step phases,
+  :class:`~paddle1_tpu.distributed.ResilientTrainer` checkpoints,
+  loader resilience, :class:`~paddle1_tpu.hapi.callbacks.
+  MetricsCallback`) reports into :func:`process_registry`. Per-step
+  phase timing is gated by the ``obs_metrics`` flag so the disabled
+  cost is ≈ 0 (the ``bench.py --obs`` gate); rare lifecycle counters
+  (checkpoints, restarts, quarantines) are always on.
+* **Tracing** — :mod:`obs.trace` extends profiler spans with
+  trace_id/span_id context that crosses process boundaries: over the
+  serving wire protocol's frame header, and into Supervisor worker env
+  via ``PADDLE_OBS_TRACE_CTX``. With ``obs_trace_dir`` set, every
+  process appends completed spans to ``spans-<pid>.jsonl`` there and
+  :func:`~paddle1_tpu.obs.trace.export_chrome_trace` merges them into
+  ONE chrome://tracing view with flow arrows — a request flowing
+  client → fleet router → replica → batcher → dispatch, or a training
+  step's host-side phase breakdown.
+* **Live telemetry** — :mod:`obs.http` serves ``/metrics`` (Prometheus
+  text exposition) and ``/healthz`` from a stdlib daemon thread (flag
+  ``obs_port``); ``ServingFleet.start_telemetry`` and
+  ``Supervisor.start_telemetry`` aggregate child pages via
+  :func:`merge_snapshots`. :mod:`obs.events` is the structured JSONL
+  lifecycle journal (restart, resize, deploy, shed, quarantine,
+  checkpoint commit) behind ``obs_events_file``.
+"""
+
+from __future__ import annotations
+
+from . import events, trace
+from .http import TelemetryServer, start_telemetry_from_flags
+from .registry import (Counter, Gauge, Histogram, MetricsGroup,
+                       MetricsRegistry, ServingMetrics, merge_snapshots,
+                       metrics_on, process_registry, render_snapshot_text,
+                       reset_process_registry, step_registry)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "ServingMetrics",
+    "MetricsGroup", "merge_snapshots", "render_snapshot_text",
+    "process_registry", "reset_process_registry", "metrics_on",
+    "step_registry", "TelemetryServer", "start_telemetry_from_flags",
+    "trace", "events",
+]

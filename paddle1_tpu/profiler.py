@@ -13,7 +13,16 @@ Two layers, mirroring the reference's host+device design:
 
 Chrome-trace export: host spans serialize to the chrome://tracing JSON
 format directly (the reference needed tools/timeline.py:115 to convert its
-proto; we emit the final format)."""
+proto; we emit the final format).
+
+Cross-process identity (ISSUE 10): when the ``obs_trace_dir`` flag is
+set, every completed span is ALSO appended to the process's
+``spans-<pid>.jsonl`` sink with trace_id/span_id/parent context from
+:mod:`paddle1_tpu.obs.trace` — spans record in that mode even while the
+aggregation tables are off, so a serving replica can trace requests
+without paying for the profiler's event list. The per-process JSONL
+files merge into one cross-process chrome trace via
+``obs.trace.export_chrome_trace``."""
 
 from __future__ import annotations
 
@@ -24,6 +33,8 @@ import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+from .obs import trace as obs_trace
 
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
            "reset_profiler", "export_chrome_tracing"]
@@ -41,35 +52,72 @@ def _now_us() -> float:
 
 class RecordEvent:
     """Named host span (reference platform/profiler.h:127 RecordEvent).
-    Usable as context manager or begin()/end() pair."""
+    Usable as context manager or begin()/end() pair. ``args`` ride the
+    span into the chrome-trace export and the cross-process sink (e.g.
+    the decode engine tags slot occupancy)."""
 
-    def __init__(self, name: str, event_type: str = "Operator"):
+    def __init__(self, name: str, event_type: str = "Operator",
+                 args: Optional[dict] = None):
         self.name = str(name) if name is not None else "<unnamed>"
         self.event_type = event_type
+        self.args = args
         self._begin = None
+        self._wall = None
+        self._span_id = None
+        self._trace = None
 
     def begin(self):
-        if not _enabled:
+        tracing = obs_trace.sink_active()
+        if not _enabled and not tracing:
             return self
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
+        if tracing:
+            # capture identity at begin: parent = innermost open span on
+            # this thread, else the ambient context (wire/env-seeded)
+            parent = stack[-1] if stack else None
+            if parent is not None and parent._span_id is not None:
+                self._trace = (parent._trace[0], parent._span_id) \
+                    if parent._trace else None
+            else:
+                self._trace = obs_trace.current()
+            self._span_id = obs_trace.new_span_id()
+            self._wall = time.time()
         self._begin = _now_us()
         stack.append(self)
         return self
 
     def end(self):
-        if not _enabled or self._begin is None:
+        if self._begin is None:
             return
+        # Stack maintenance happens UNCONDITIONALLY: stop_profiler
+        # flipping _enabled mid-span used to early-return here and
+        # leave the span on _tls.stack forever, mis-nesting every
+        # later span on the thread (ISSUE 10 satellite).
         stack = getattr(_tls, "stack", [])
         if stack and stack[-1] is self:
             stack.pop()
-        ev = {"name": self.name, "type": self.event_type,
-              "ts": self._begin, "dur": _now_us() - self._begin,
-              "tid": threading.get_ident(),
-              "depth": len(stack)}
-        with _lock:
-            _events.append(ev)
+        elif self in stack:  # mis-paired end() calls: drop it anyway
+            stack.remove(self)
+        dur_us = _now_us() - self._begin
+        if _enabled:
+            ev = {"name": self.name, "type": self.event_type,
+                  "ts": self._begin, "dur": dur_us,
+                  "tid": threading.get_ident(),
+                  "depth": len(stack)}
+            if self.args:
+                ev["args"] = dict(self.args)
+            with _lock:
+                _events.append(ev)
+        if self._span_id is not None:
+            obs_trace.record_span(
+                self.name, dur_us / 1e6, ctx=self._trace,
+                span_id=self._span_id, cat=self.event_type,
+                args=self.args,
+                end_time=(self._wall + dur_us / 1e6
+                          if self._wall is not None else None))
+            self._span_id = self._trace = self._wall = None
         self._begin = None
 
     def __enter__(self):
@@ -143,7 +191,8 @@ def export_chrome_tracing(path: str, events: Optional[List[dict]] = None):
     trace = {"traceEvents": [
         {"name": ev["name"], "cat": ev["type"], "ph": "X",
          "ts": ev["ts"], "dur": ev["dur"], "pid": os.getpid(),
-         "tid": ev["tid"]}
+         "tid": ev["tid"],
+         **({"args": ev["args"]} if ev.get("args") else {})}
         for ev in events]}
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:

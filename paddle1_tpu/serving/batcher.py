@@ -163,7 +163,8 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "sig", "future", "t_enq", "deadline")
+    __slots__ = ("arrays", "rows", "sig", "future", "t_enq", "deadline",
+                 "trace")
 
     def __init__(self, arrays: Sequence[np.ndarray], sig: tuple,
                  deadline_s: Optional[float]):
@@ -175,6 +176,10 @@ class _Request:
         self.t_enq = time.monotonic()
         self.deadline = (self.t_enq + deadline_s
                          if deadline_s is not None else None)
+        # (trace_id, span_id) stamped by Server.submit when tracing is
+        # on — the batcher's dispatch span lists it as a flow parent so
+        # a cross-process chrome trace shows request -> micro-batch
+        self.trace = None
 
 
 class Batcher(threading.Thread):
@@ -338,6 +343,20 @@ class Batcher(threading.Thread):
             outs = self.engine.dispatch_padded(padded, bucket)
             t2 = time.monotonic()
             m.histogram("dispatch_ms").observe((t2 - t1) * 1e3)
+            from ..obs import trace as obs_trace
+            if obs_trace.sink_active():
+                # one dispatch span per micro-batch, flow-linked to
+                # every co-batched request's span (client -> ... ->
+                # batcher -> dispatch in the merged chrome trace)
+                parents = [r.trace[1] for r in live
+                           if r.trace is not None]
+                tid = next((r.trace[0] for r in live
+                            if r.trace is not None), None)
+                ctx = (tid, None) if tid else None
+                obs_trace.record_span(
+                    "serve/batch_dispatch", t2 - t0, ctx=ctx,
+                    parents=parents, cat="Serving",
+                    args={"rows": rows, "bucket": bucket})
             m.histogram("batch_occupancy").observe(rows / bucket)
             m.counter("batches_total").inc()
             m.counter("batches_full_total" if rows >= self.max_batch

@@ -87,6 +87,8 @@ from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from ..obs import events as obs_events
+from ..obs import trace as obs_trace
 from . import wire
 from .batcher import ServeFuture
 from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
@@ -204,7 +206,7 @@ class AdaptiveAdmission:
 
 class _FleetRequest:
     __slots__ = ("id", "arrays", "priority", "deadline", "deadline_ms",
-                 "future", "t_enq", "retries", "pinned")
+                 "future", "t_enq", "retries", "pinned", "trace")
 
     def __init__(self, rid: int, arrays: List[np.ndarray],
                  priority: int, deadline_s: Optional[float],
@@ -223,6 +225,10 @@ class _FleetRequest:
         # without the candidate ever answering. Pinned requests fail
         # typed instead of failing over (still fully accounted).
         self.pinned = pinned
+        # (trace_id, client_span_id) when tracing is on: the identity
+        # that rides the wire header so the replica's spans join this
+        # request's flow (ISSUE 10)
+        self.trace = None
 
 
 # replica client states
@@ -392,11 +398,21 @@ class _ReplicaClient:
                 return
         with self.lock:
             self.inflight[req.id] = (req, now)
+        header = {"kind": "infer", "id": req.id,
+                  "deadline_ms": remaining_ms}
+        if req.trace is not None:
+            # the router's dispatch span: child of the client submit,
+            # parent of the replica's spans (its id rides the wire) —
+            # a failover re-dispatch records a SECOND one, so the
+            # merged trace shows the request visiting both replicas
+            sid = obs_trace.record_span(
+                "fleet/dispatch", 0.0, ctx=req.trace, cat="Serving",
+                args={"id": req.id, "replica": self.rank,
+                      "attempt": req.retries})
+            header["trace"] = obs_trace.wire_header((req.trace[0], sid))
         try:
             with self.send_lock:
-                wire.send_msg(conn, {"kind": "infer", "id": req.id,
-                                     "deadline_ms": remaining_ms},
-                              req.arrays)
+                wire.send_msg(conn, header, req.arrays)
         except (OSError, ConnectionError):
             self._on_transport_loss("send failed")
 
@@ -621,6 +637,11 @@ class ServingFleet:
         self._drained = False
         self._deploy_lock = threading.Lock()
         self._sweeper: Optional[threading.Thread] = None
+        self._telemetry = None
+        # shed journal rate limit: sheds are per-REQUEST (not a rare
+        # lifecycle moment) — at most one aggregated event per second
+        self._shed_pending = 0
+        self._shed_last_emit = 0.0
         self.deploys = 0
         self.rollbacks = 0
 
@@ -741,6 +762,7 @@ class ServingFleet:
         dl = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
         scale = self.replica_timeout_s * 1e3
+        shed_exc = shed_overload = None
         with self._queue_cond:
             if not self._accepting:
                 raise ServerClosed(
@@ -760,18 +782,47 @@ class ServingFleet:
                 self.metrics.counter("shed_total").inc()
                 self.metrics.counter("shed_adaptive_total").inc()
                 self.metrics.counter(
-                    f"shed_priority_{int(priority)}").inc()
-                raise ServerOverloaded(
+                    f"shed_priority_{int(priority)}_total").inc()
+                shed_overload = self.admission.overload()
+                self._shed_pending += 1
+                # journal write + raise happen OUTSIDE the admission
+                # lock: an overload storm is exactly when disk latency
+                # must not serialize every submit
+                shed_exc = ServerOverloaded(
                     f"adaptive admission shed priority-{priority} "
-                    f"request at overload "
-                    f"{self.admission.overload():.2f} — admitted "
-                    "traffic keeps its p99 while capacity recovers")
-            self._rid += 1
-            req = _FleetRequest(self._rid, arrays, int(priority),
-                                dl / 1e3 if dl else None, dl)
-            self._live[req.id] = req
-            self._queue.append(req)
-            self._queue_cond.notify()
+                    f"request at overload {shed_overload:.2f} — "
+                    "admitted traffic keeps its p99 while capacity "
+                    "recovers")
+            else:
+                self._rid += 1
+                req = _FleetRequest(self._rid, arrays, int(priority),
+                                    dl / 1e3 if dl else None, dl)
+                if obs_trace.sink_active():
+                    # each request is its own trace; only the (cheap)
+                    # id mint happens under the lock
+                    req.trace = (obs_trace.new_trace_id(),
+                                 obs_trace.new_span_id())
+                self._live[req.id] = req
+                self._queue.append(req)
+                self._queue_cond.notify()
+        if shed_exc is not None:
+            # aggregated, >= 1s apart: a storm shedding thousands/s
+            # must not pay a journal write+flush per request
+            now = time.monotonic()
+            if now - self._shed_last_emit >= 1.0:
+                self._shed_last_emit = now
+                count, self._shed_pending = self._shed_pending, 0
+                obs_events.emit("shed", count=count,
+                                last_priority=int(priority),
+                                overload=round(shed_overload, 3))
+            raise shed_exc
+        if req.trace is not None:
+            # the trace's root span, recorded outside the lock (file
+            # order is irrelevant — the exporter links by id)
+            obs_trace.record_span(
+                "client/submit", 0.0, ctx=(req.trace[0], None),
+                span_id=req.trace[1], cat="Serving",
+                args={"id": req.id, "priority": int(priority)})
         return req.future
 
     def infer(self, *inputs, deadline_ms: Optional[float] = None,
@@ -812,6 +863,12 @@ class ServingFleet:
             self._unlive(req)
             now = time.monotonic()
             e2e = (now - req.t_enq) * 1e3
+            if req.trace is not None:
+                obs_trace.record_span(
+                    "fleet/e2e", e2e / 1e3, ctx=req.trace,
+                    cat="Serving",
+                    args={"id": req.id, "version": version,
+                          "replica": client.rank})
             self.metrics.counter("responses_total").inc()
             self.metrics.histogram("e2e_ms").observe(e2e)
             self.metrics.record_response()
@@ -1079,6 +1136,53 @@ class ServingFleet:
             snap["replica_aggregate"] = merge_snapshots(reps.values())
         return snap
 
+    # -- telemetry (ISSUE 10) ----------------------------------------------
+
+    def start_telemetry(self, port: Optional[int] = None,
+                        scrape_replicas: bool = True):
+        """Serve the fleet's ``/metrics`` + ``/healthz``: the fleet
+        registry (typed page), the per-version and per-replica
+        MetricsGroup pages (labeled, untyped), and — with
+        ``scrape_replicas`` — the live replica Servers scraped over the
+        wire and folded via :func:`~paddle1_tpu.obs.merge_snapshots`
+        into one ``scope="replica_aggregate"`` section. ``port`` None
+        reads the ``obs_port`` flag (0 keeps it off); 0 binds
+        ephemeral. Stopped by :meth:`drain`."""
+        if self._telemetry is not None:
+            return self._telemetry
+        from ..obs.http import TelemetryServer, resolve_port_flag
+        port = resolve_port_flag(port)
+        if port is None:
+            return None
+        from .metrics import render_snapshot_text
+
+        def replica_page() -> str:
+            if not scrape_replicas:
+                return ""
+            snap = self.fleet_snapshot(include_replicas=True)
+            agg = snap.get("replica_aggregate") or {}
+            if not agg.get("counters") and not agg.get("histograms"):
+                return ""
+            return render_snapshot_text(
+                agg, namespace="p1t_serving",
+                label=("scope", "replica_aggregate"))
+
+        def healthz() -> dict:
+            with self._lock:
+                states = {r: c.state for r, c in self._clients.items()}
+            return {"ok": self.healthy and not self._drained,
+                    "version": self.version, "replicas": states,
+                    "deploys": self.deploys,
+                    "rollbacks": self.rollbacks}
+
+        self._telemetry = TelemetryServer(
+            port=port, registry=self.metrics,
+            providers=[self.version_metrics.render_text,
+                       self.replica_metrics.render_text,
+                       replica_page],
+            healthz=healthz).start()
+        return self._telemetry
+
     # -- hot swap ----------------------------------------------------------
 
     def deploy(self, model: str, version: str, model_arg: str = "",
@@ -1117,6 +1221,8 @@ class ServingFleet:
             except DeployFailed:
                 self.rollbacks += 1
                 self.metrics.counter("rollbacks_total").inc()
+                obs_events.emit("deploy_rollback", version=str(version),
+                                promoted=len(swapped))
                 if swapped:
                     # late-roll failure: put the old version back on
                     # the already-swapped slots (same machinery, old
@@ -1140,6 +1246,8 @@ class ServingFleet:
             self.version = str(version)
             self.deploys += 1
             self.metrics.counter("deploys_total").inc()
+            obs_events.emit("deploy", version=str(version),
+                            replicas=list(swapped))
             return {"version": version, "replicas": swapped,
                     "rolled": len(swapped)}
 
@@ -1256,6 +1364,9 @@ class ServingFleet:
             for rank in list(self._clients):
                 self._sup.retire(rank, grace_s=10.0)
         self._drained = True
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
         snap = self.metrics.snapshot()
         c = snap["counters"]
         report = {

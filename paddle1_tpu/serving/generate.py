@@ -1019,8 +1019,17 @@ class _GenerationLoop(threading.Thread):
                     continue
                 t0 = time.monotonic()
                 toks = self.engine.decode(active)
-                m.histogram("decode_step_ms").observe(
-                    (time.monotonic() - t0) * 1e3)
+                dt = time.monotonic() - t0
+                m.histogram("decode_step_ms").observe(dt * 1e3)
+                from ..obs import trace as obs_trace
+                if obs_trace.sink_active():
+                    # decode spans tag slot occupancy: the trace view
+                    # shows continuous batching fill alongside timing
+                    obs_trace.record_span(
+                        "gen/decode_step", dt, cat="Serving",
+                        args={"slots_active": int(active.sum()),
+                              "occupancy": round(
+                                  len(self._by_slot) / slots, 4)})
                 for slot in list(self._by_slot):
                     if not active[slot]:
                         continue

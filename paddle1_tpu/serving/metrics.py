@@ -1,347 +1,24 @@
-"""Serving metrics: counters + latency histograms with a plain-text dump.
+"""Serving metrics — re-export shim over the unified registry.
 
-The observability half of the serving runtime (ISSUE 4): every number a
-load balancer / autoscaler / on-call needs to reason about a serving
-worker — QPS, queue/pad/dispatch/readback latency quantiles, batch
-occupancy, shed and deadline counts, per-bucket compile counts — lives
-in one :class:`ServingMetrics` registry. ``snapshot()`` returns it as a
-plain dict (JSON-able; the test/bench surface), ``render_text()`` emits
-a Prometheus-style exposition for scraping.
+The Counter/Gauge/Histogram/registry implementation that started life
+here (ISSUE 4, grown through ISSUES 7/8) was promoted to
+:mod:`paddle1_tpu.obs.registry` as the process-wide metrics layer
+(ISSUE 10): one implementation, every subsystem. This module keeps the
+serving-facing surface byte-compatible — :class:`ServingMetrics` is the
+same class (namespace ``p1t_serving``, so every existing scrape page,
+snapshot key and drain report is unchanged), :class:`MetricsGroup` and
+:func:`merge_snapshots` are the same objects.
 
-Deliberately dependency-free and cheap: counters are a locked int,
-histograms keep exact count/sum plus a bounded reservoir of recent
-observations for quantiles (serving latency distributions are what the
-last few thousand requests say, not what the process saw at boot). A
-registry is instantiated per :class:`~paddle1_tpu.serving.Server`, so
-two servers in one process (A/B models) never mix their numbers.
-
-The fleet layer (ISSUE 7) adds two multi-registry shapes on top:
-:class:`MetricsGroup` keys child registries by a label (per model
-version, per replica) so a rolling deploy's two versions never mix
-their latencies, and :func:`merge_snapshots` folds many snapshots —
-including ones shipped over the wire from replica subprocesses — into
-one fleet-wide aggregate (counters/count/sum add exactly; quantiles
-take the worst child, the conservative merge for an SLO read).
+New code should import from ``paddle1_tpu.obs`` directly.
 """
 
 from __future__ import annotations
 
-import collections
-import threading
-import time
-from typing import Dict, Iterable, List, Optional, Tuple
+from ..obs.registry import (_QPS_WINDOW, _RESERVOIR, Counter, Gauge,
+                            Histogram, MetricsGroup, MetricsRegistry,
+                            ServingMetrics, merge_snapshots,
+                            render_snapshot_text)
 
 __all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics",
-           "MetricsGroup", "merge_snapshots"]
-
-# reservoir size per histogram: large enough for a stable p99 (the
-# quantile of the last ~4k observations), small enough to sort per
-# snapshot without showing up in a profile
-_RESERVOIR = 4096
-# QPS window: rate over the last N responses' timestamps
-_QPS_WINDOW = 512
-
-
-class Counter:
-    """Monotone counter (requests, sheds, compiles...)."""
-
-    __slots__ = ("name", "_v", "_lock")
-
-    def __init__(self, name: str):
-        self.name = name
-        self._v = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._v += n
-
-    @property
-    def value(self) -> int:
-        return self._v
-
-
-class Gauge:
-    """Last-written value (slot occupancy, queue depth...) — unlike a
-    Counter it moves both ways; ``set`` is a plain float store (atomic
-    under the GIL, no lock on the per-step hot path)."""
-
-    __slots__ = ("name", "_v")
-
-    def __init__(self, name: str):
-        self.name = name
-        self._v = 0.0
-
-    def set(self, v: float) -> None:
-        self._v = float(v)
-
-    @property
-    def value(self) -> float:
-        return self._v
-
-
-class Histogram:
-    """Latency/occupancy histogram: exact count+sum, reservoir quantiles."""
-
-    __slots__ = ("name", "_lock", "count", "sum", "max", "_recent")
-
-    def __init__(self, name: str):
-        self.name = name
-        self._lock = threading.Lock()
-        self.count = 0
-        self.sum = 0.0
-        self.max = 0.0
-        self._recent: collections.deque = collections.deque(
-            maxlen=_RESERVOIR)
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        with self._lock:
-            self.count += 1
-            self.sum += v
-            if v > self.max:
-                self.max = v
-            self._recent.append(v)
-
-    def percentile(self, p: float) -> float:
-        """Quantile over the reservoir (nearest-rank); 0.0 when empty."""
-        with self._lock:
-            data = sorted(self._recent)
-        if not data:
-            return 0.0
-        idx = min(len(data) - 1, max(0, int(round(
-            (p / 100.0) * (len(data) - 1)))))
-        return data[idx]
-
-    def totals(self) -> Tuple[int, float]:
-        """Raw (count, sum) — unrounded, for the Prometheus ``_sum`` /
-        ``_count`` series a ``rate()`` is computed from (the rounded
-        ``summary()`` values drift a rate by up to 5e-5 per scrape)."""
-        with self._lock:
-            return self.count, self.sum
-
-    def summary(self) -> Dict[str, float]:
-        with self._lock:
-            data = sorted(self._recent)
-            count, total, mx = self.count, self.sum, self.max
-        def q(p):
-            if not data:
-                return 0.0
-            return data[min(len(data) - 1,
-                            max(0, int(round((p / 100.0)
-                                             * (len(data) - 1)))))]
-        return {"count": count, "sum": round(total, 4),
-                "mean": round(total / count, 4) if count else 0.0,
-                "p50": round(q(50), 4), "p95": round(q(95), 4),
-                "p99": round(q(99), 4), "max": round(mx, 4)}
-
-
-class ServingMetrics:
-    """The per-server registry. Counters and histograms are created on
-    first touch, so instrumentation points never need registration
-    boilerplate and ``snapshot()`` only reports what actually fired."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
-        self._resp_times: collections.deque = collections.deque(
-            maxlen=_QPS_WINDOW)
-        self._started = time.monotonic()
-
-    # -- instrumentation surface -------------------------------------------
-
-    def counter(self, name: str) -> Counter:
-        c = self._counters.get(name)
-        if c is None:
-            with self._lock:
-                c = self._counters.setdefault(name, Counter(name))
-        return c
-
-    def gauge(self, name: str) -> Gauge:
-        g = self._gauges.get(name)
-        if g is None:
-            with self._lock:
-                g = self._gauges.setdefault(name, Gauge(name))
-        return g
-
-    def histogram(self, name: str) -> Histogram:
-        h = self._histograms.get(name)
-        if h is None:
-            with self._lock:
-                h = self._histograms.setdefault(name, Histogram(name))
-        return h
-
-    def record_response(self, n: int = 1) -> None:
-        """Feed the QPS window (called once per completed request)."""
-        now = time.monotonic()
-        with self._lock:
-            for _ in range(n):
-                self._resp_times.append(now)
-
-    def qps(self) -> float:
-        """Responses/second over the recent-response window."""
-        with self._lock:
-            if len(self._resp_times) < 2:
-                return 0.0
-            span = self._resp_times[-1] - self._resp_times[0]
-            n = len(self._resp_times) - 1
-        if span <= 0:
-            # burst faster than the clock tick: rate over process life
-            span = max(time.monotonic() - self._started, 1e-6)
-            n += 1
-        return n / span
-
-    # -- export surface -----------------------------------------------------
-
-    def snapshot(self) -> Dict[str, object]:
-        """The whole registry as one JSON-able dict."""
-        with self._lock:
-            counters = {n: c.value for n, c in self._counters.items()}
-            gauges = {n: g.value for n, g in self._gauges.items()}
-            hists = list(self._histograms.values())
-        return {
-            "qps": round(self.qps(), 2),
-            "uptime_s": round(time.monotonic() - self._started, 3),
-            "counters": counters,
-            "gauges": gauges,
-            "histograms": {h.name: h.summary() for h in hists},
-        }
-
-    def render_text(self, label: Optional[Tuple[str, str]] = None,
-                    type_headers: bool = True) -> str:
-        """Prometheus-style plain-text exposition (one scrape page).
-
-        Histograms are emitted as Prometheus *summaries*: a ``# TYPE``
-        header, quantile-labeled gauges, and RAW (unrounded) monotone
-        ``_sum``/``_count`` series — the pair ``rate()`` needs, so
-        ``rate(..._sum[1m]) / rate(..._count[1m])`` yields a true
-        rolling mean (the rounded summary values would drift it).
-        The legacy ``_mean``/``_max``/``_p50``/``_p95``/``_p99`` gauge
-        lines are kept for existing scrapers. ``label`` tags every
-        sample with one extra ``key="value"`` pair — the
-        :class:`MetricsGroup` per-version/per-replica pages, which pass
-        ``type_headers=False``: the text format allows one TYPE line
-        per metric family per page, so a multi-child page emits the
-        labeled samples untyped rather than a duplicate header per
-        child (untyped samples parse fine; duplicate TYPE lines do
-        not)."""
-        def line(name, value, *pairs):
-            pairs = [p for p in pairs if p is not None]
-            if label is not None:
-                pairs.append(label)
-            if pairs:
-                lab = ",".join(f'{k}="{v}"' for k, v in pairs)
-                return f"{name}{{{lab}}} {value}"
-            return f"{name} {value}"
-
-        with self._lock:
-            counters = {n: c.value for n, c in self._counters.items()}
-            gauges = {n: g.value for n, g in self._gauges.items()}
-            hists = list(self._histograms.values())
-        lines = [line("p1t_serving_qps", round(self.qps(), 2)),
-                 line("p1t_serving_uptime_seconds",
-                      round(time.monotonic() - self._started, 3))]
-        for name, v in sorted(counters.items()):
-            lines.append(line(f"p1t_serving_{name}", v))
-        for name, v in sorted(gauges.items()):
-            if type_headers:
-                lines.append(f"# TYPE p1t_serving_{name} gauge")
-            lines.append(line(f"p1t_serving_{name}", v))
-        for h in sorted(hists, key=lambda h: h.name):
-            base = f"p1t_serving_{h.name}"
-            s = h.summary()
-            count, total = h.totals()
-            if type_headers:
-                lines.append(f"# TYPE {base} summary")
-            for q, stat in (("0.5", "p50"), ("0.95", "p95"),
-                            ("0.99", "p99")):
-                lines.append(line(base, s[stat], ("quantile", q)))
-            lines.append(line(base + "_sum", repr(float(total))))
-            lines.append(line(base + "_count", count))
-            for stat in ("mean", "p50", "p95", "p99", "max"):
-                lines.append(line(f"{base}_{stat}", s[stat]))
-        return "\n".join(lines) + "\n"
-
-
-class MetricsGroup:
-    """A labeled family of :class:`ServingMetrics` registries — the
-    fleet's per-model-version and per-replica split (a rolling deploy
-    serves two versions at once; mixing their latency histograms would
-    hide a regression in the new one behind the old one's volume).
-    Children are created on first touch, like the registry's own
-    counters; :meth:`aggregate` folds them into one fleet-wide view."""
-
-    def __init__(self, label_key: str):
-        self.label_key = label_key
-        self._lock = threading.Lock()
-        self._children: Dict[str, ServingMetrics] = {}
-
-    def child(self, label) -> ServingMetrics:
-        label = str(label)
-        m = self._children.get(label)
-        if m is None:
-            with self._lock:
-                m = self._children.setdefault(label, ServingMetrics())
-        return m
-
-    def labels(self) -> List[str]:
-        with self._lock:
-            return sorted(self._children)
-
-    def snapshot(self) -> Dict[str, Dict[str, object]]:
-        with self._lock:
-            kids = dict(self._children)
-        return {label: m.snapshot() for label, m in sorted(kids.items())}
-
-    def aggregate(self) -> Dict[str, object]:
-        return merge_snapshots(self.snapshot().values())
-
-    def render_text(self) -> str:
-        with self._lock:
-            kids = dict(self._children)
-        return "".join(
-            m.render_text(label=(self.label_key, label),
-                          type_headers=False)
-            for label, m in sorted(kids.items()))
-
-
-def merge_snapshots(snaps: Iterable[Dict[str, object]]
-                    ) -> Dict[str, object]:
-    """Fold many ``ServingMetrics.snapshot()`` dicts into one aggregate
-    (across a MetricsGroup's children, or across replica subprocesses'
-    wire-shipped snapshots). Counters, histogram counts and sums add
-    exactly; quantiles/max take the WORST child — reservoir quantiles
-    cannot be merged without the raw observations, and for an SLO read
-    the conservative bound is the useful one (documented on the line a
-    dashboard reads: an aggregate p99 here is "no child was worse")."""
-    counters: Dict[str, int] = {}
-    gauges: Dict[str, float] = {}
-    hists: Dict[str, Dict[str, float]] = {}
-    qps = 0.0
-    uptime = 0.0
-    for s in snaps:
-        qps += float(s.get("qps", 0.0) or 0.0)
-        uptime = max(uptime, float(s.get("uptime_s", 0.0) or 0.0))
-        for k, v in (s.get("counters") or {}).items():
-            counters[k] = counters.get(k, 0) + v
-        for k, v in (s.get("gauges") or {}).items():
-            # gauges are instantaneous levels, not totals: like the
-            # quantiles, the aggregate takes the WORST (highest) child
-            gauges[k] = max(gauges.get(k, 0.0), float(v))
-        for name, h in (s.get("histograms") or {}).items():
-            m = hists.setdefault(name, {
-                "count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
-                "p95": 0.0, "p99": 0.0, "max": 0.0})
-            m["count"] += h["count"]
-            m["sum"] += h["sum"]
-            for q in ("p50", "p95", "p99", "max"):
-                m[q] = max(m[q], h[q])
-    for m in hists.values():
-        m["mean"] = (round(m["sum"] / m["count"], 4) if m["count"]
-                     else 0.0)
-        m["sum"] = round(m["sum"], 4)
-    return {"qps": round(qps, 2), "uptime_s": uptime,
-            "counters": counters, "gauges": gauges,
-            "histograms": hists}
+           "MetricsRegistry", "MetricsGroup", "merge_snapshots",
+           "render_snapshot_text"]

@@ -94,12 +94,13 @@ class _DrainRequested(Exception):
 
 def _resolver_loop(q: "queue.Queue", version: str) -> None:
     from . import wire
+    from ..obs import trace as obs_trace
     while True:
         item = q.get()
         try:
             if item is None:
                 return
-            rid, fut, conn, send_lock = item
+            rid, fut, conn, send_lock, tr = item
             try:
                 outs = fut.result()
             except Exception as e:  # noqa: broad-except — every owed
@@ -111,6 +112,16 @@ def _resolver_loop(q: "queue.Queue", version: str) -> None:
             else:
                 arrays = outs if isinstance(outs, list) else [outs]
                 header = {"kind": "result", "id": rid, "version": version}
+            if tr is not None:
+                # the request's span in THIS process: opened at submit
+                # (another thread), closed here at resolution — parented
+                # to the fleet's dispatch span from the wire header
+                ctx, sid, t0 = tr
+                obs_trace.record_span("replica/serve", time.time() - t0,
+                                      ctx=ctx, span_id=sid,
+                                      cat="Serving",
+                                      args={"id": rid,
+                                            "version": version})
             try:
                 with send_lock:
                     wire.send_msg(conn, header, arrays)
@@ -151,6 +162,19 @@ def _serve_conn(conn: socket.socket, srv, args, resolver_q,
                     "version": args.version,
                     "snapshot": srv.metrics.snapshot()})
         elif kind == "infer":
+            from ..obs import trace as obs_trace
+            tr = None
+            wire_ctx = obs_trace.adopt_header(header.get("trace"))
+            if wire_ctx is not None and obs_trace.sink_active():
+                # receipt marker FIRST — flushed before the chaos check
+                # below can kill/wedge this process, so a request that
+                # dies here is still visible in the merged trace (the
+                # failover's "it reached replica N" evidence)
+                obs_trace.instant("replica/recv", ctx=wire_ctx,
+                                  cat="Serving",
+                                  args={"id": header.get("id"),
+                                        "rank": args.rank})
+                tr = (wire_ctx, obs_trace.new_span_id(), time.time())
             if core_chaos.enabled():
                 point = core_chaos.check_replica(args.rank)
                 if point == core_chaos.REPLICA_KILL:
@@ -167,8 +191,17 @@ def _serve_conn(conn: socket.socket, srv, args, resolver_q,
                     time.sleep(float(
                         core_flags.flag("serve_chaos_slow_s")))
             try:
-                fut = srv.submit(*arrays,
-                                 deadline_ms=header.get("deadline_ms"))
+                if tr is not None:
+                    # submit under the request's context so the Server
+                    # stamps it onto the batcher request (the dispatch
+                    # span flow-links back to replica/serve)
+                    with obs_trace.context(tr[0][0], tr[1]):
+                        fut = srv.submit(
+                            *arrays,
+                            deadline_ms=header.get("deadline_ms"))
+                else:
+                    fut = srv.submit(
+                        *arrays, deadline_ms=header.get("deadline_ms"))
             except Exception as e:  # noqa: broad-except — admission
                 # errors (shed/closed/invalid) go back typed so the
                 # fleet can retry elsewhere or surface them
@@ -178,7 +211,7 @@ def _serve_conn(conn: socket.socket, srv, args, resolver_q,
                         "version": args.version,
                         "etype": type(e).__name__, "msg": str(e)})
                 continue
-            resolver_q.put((header.get("id"), fut, conn, send_lock))
+            resolver_q.put((header.get("id"), fut, conn, send_lock, tr))
 
 
 def main(argv=None) -> int:

@@ -229,6 +229,12 @@ class Server:
             else self.default_deadline_ms
         req = _Request(arrays, self.engine._inner_sig(arrays),
                        dl / 1e3 if dl else None)
+        from ..obs import trace as obs_trace
+        if obs_trace.sink_active():
+            # the submitter's context (a replica adopts the wire frame's
+            # context around this call) rides the request into the
+            # batcher's dispatch span
+            req.trace = obs_trace.current()
         # counted BEFORE the enqueue: were it counted after, the batcher
         # could complete the request before it registered as accepted
         # and a concurrent snapshot would read unaccounted < 0. Sheds

@@ -11,7 +11,10 @@ on BOTH ends — the same no-executable-payloads rule ``fluid.io`` adopted
 for checkpoints (PR 4): a serving fleet is long-lived infrastructure and
 its IPC plane must not be a pickle deserializer, even on loopback. The
 JSON header carries everything else (request id, kind, version tag,
-deadline, error type/message).
+deadline, error type/message — and, when tracing is on, the request's
+``trace`` context ``{"t": trace_id, "s": parent_span_id}`` from
+:mod:`paddle1_tpu.obs.trace`, which is how one chrome trace follows a
+request across the fleet/replica process boundary).
 
 Reads are restartable across socket timeouts: :func:`recv_msg` keeps
 its partial buffer while the caller's ``idle`` hook runs (the replica

@@ -1,0 +1,541 @@
+"""ISSUE 10: unified metrics registry, cross-process tracing, live
+telemetry endpoint, lifecycle journal — and the exposition-format
+conformance lock (one # TYPE per family, _total counters, raw
+_sum/_count) plus the profiler span-stack-leak regression."""
+
+import json
+import os
+import re
+import socket
+import tempfile
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle1_tpu import obs, profiler
+from paddle1_tpu.core import flags as core_flags
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.obs import events as obs_events
+from paddle1_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.reset_process_registry()
+    yield
+    obs.reset_process_registry()
+
+
+class TestUnifiedRegistry:
+    def test_namespace_rendering(self):
+        m = obs.MetricsRegistry(namespace="p1t")
+        m.counter("train_steps_total").inc(3)
+        text = m.render_text()
+        assert "# TYPE p1t_train_steps_total counter" in text.splitlines()
+        assert "p1t_train_steps_total 3" in text.splitlines()
+
+    def test_serving_shim_unchanged(self):
+        # zero API break: serving imports resolve to the same objects,
+        # default namespace still p1t_serving
+        from paddle1_tpu.serving.metrics import (MetricsRegistry,
+                                                 ServingMetrics)
+        assert ServingMetrics is MetricsRegistry
+        m = ServingMetrics()
+        m.counter("requests_total").inc()
+        assert "p1t_serving_requests_total 1" in m.render_text()
+
+    def test_kind_conflict_guard(self):
+        m = obs.MetricsRegistry()
+        m.counter("x_total")
+        with pytest.raises(InvalidArgumentError):
+            m.gauge("x_total")
+        with pytest.raises(InvalidArgumentError):
+            m.histogram("x_total")
+
+    def test_process_registry_singleton_and_reset(self):
+        a = obs.process_registry()
+        assert obs.process_registry() is a
+        a.counter("x_total").inc()
+        b = obs.reset_process_registry()
+        assert obs.process_registry() is b
+        assert b.empty()
+
+    def test_step_registry_flag_gate(self):
+        assert obs.step_registry() is None
+        with core_flags.flags_guard(obs_metrics=True):
+            assert obs.step_registry() is obs.process_registry()
+
+    def test_snapshot_file_roundtrip(self, tmp_path):
+        m = obs.process_registry()
+        m.counter("x_total").inc(7)
+        path = str(tmp_path / "snap.json")
+        from paddle1_tpu.obs.registry import write_snapshot_file
+        write_snapshot_file(path)
+        snap = json.load(open(path))
+        assert snap["counters"]["x_total"] == 7
+
+
+# -- exposition conformance (ISSUE 10 satellite) ---------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'            # family/sample name
+    r'(\{[a-zA-Z0-9_]+="[^"]*"'               # optional label set
+    r'(,[a-zA-Z0-9_]+="[^"]*")*\})?'
+    r' (-?[0-9.e+-]+|NaN)$')                  # value
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|summary|histogram|untyped)$")
+
+
+def parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns (types, samples)
+    and asserts structural validity — every line is a TYPE header, a
+    comment, or a well-formed sample; one TYPE per family per page."""
+    types = {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed TYPE line: {line!r}"
+            fam = m.group(1)
+            assert fam not in types, f"duplicate # TYPE for {fam}"
+            types[fam] = m.group(2)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        samples.append((m.group(1), line))
+    # conformance rules the PR 7/8 fixes locked in:
+    for fam, kind in types.items():
+        if kind == "counter":
+            assert fam.endswith("_total"), \
+                f"counter family {fam} must end _total"
+        if kind == "summary":
+            names = {n for n, _ in samples}
+            assert f"{fam}_sum" in names and f"{fam}_count" in names, \
+                f"summary {fam} missing raw _sum/_count"
+    return types, samples
+
+
+class TestExpositionConformance:
+    def _populated(self, m):
+        m.counter("requests_total").inc(7)
+        m.gauge("slot_occupancy").set(0.75)
+        h = m.histogram("e2e_ms")
+        for _ in range(3):
+            h.observe(0.1)
+        return m
+
+    def test_serving_page(self):
+        m = self._populated(obs.MetricsRegistry())
+        types, samples = parse_exposition(m.render_text())
+        assert types["p1t_serving_requests_total"] == "counter"
+        assert types["p1t_serving_slot_occupancy"] == "gauge"
+        assert types["p1t_serving_e2e_ms"] == "summary"
+        # RAW unrounded _sum (repr of the float accumulation, not the
+        # 4-digit-rounded summary value)
+        line = next(l for n, l in samples
+                    if n == "p1t_serving_e2e_ms_sum")
+        assert line.split()[-1] == repr(0.1 + 0.1 + 0.1)
+
+    def test_process_page(self):
+        m = self._populated(obs.process_registry())
+        m.histogram("train_dispatch_seconds").observe(0.001)
+        types, _ = parse_exposition(m.render_text())
+        assert types["p1t_train_dispatch_seconds"] == "summary"
+
+    def test_group_page_untyped_labeled(self):
+        g = obs.MetricsGroup("version")
+        self._populated(g.child("v1"))
+        self._populated(g.child("v2"))
+        text = g.render_text()
+        types, samples = parse_exposition(text)
+        assert not types  # labeled multi-child pages drop TYPE headers
+        assert any('version="v2"' in l for _, l in samples)
+
+    def test_merged_snapshot_page(self):
+        a = self._populated(obs.MetricsRegistry())
+        b = self._populated(obs.MetricsRegistry())
+        merged = obs.merge_snapshots([a.snapshot(), b.snapshot()])
+        assert merged["counters"]["requests_total"] == 14
+        text = obs.render_snapshot_text(merged, namespace="p1t_serving",
+                                        label=("scope", "agg"))
+        types, samples = parse_exposition(text)
+        assert not types
+        line = next(l for n, l in samples
+                    if n == "p1t_serving_requests_total")
+        assert 'scope="agg"' in line and line.endswith(" 14")
+
+    def test_composite_fleet_style_page(self):
+        # a typed page followed by labeled group pages — the fleet's
+        # /metrics composition — must still parse with unique TYPEs
+        m = self._populated(obs.MetricsRegistry())
+        g = obs.MetricsGroup("replica")
+        self._populated(g.child(0))
+        parse_exposition(m.render_text() + g.render_text())
+
+
+class TestTrace:
+    def test_span_nesting_and_export(self, tmp_path):
+        d = str(tmp_path / "tr")
+        with core_flags.flags_guard(obs_trace_dir=d):
+            with obs_trace.span("outer", args={"k": 1}):
+                with obs_trace.span("inner"):
+                    pass
+                ctx = obs_trace.current()
+                # a cross-thread child (the replica resolver pattern):
+                # this is the hop that earns a flow arrow
+                t = threading.Thread(
+                    target=lambda: obs_trace.record_span(
+                        "other_thread", 0.001, ctx=ctx))
+                t.start()
+                t.join()
+            obs_trace.instant("mark")
+        recs = obs_trace.read_spans(d)
+        by = {r["name"]: r for r in recs}
+        assert by["inner"]["parent"] == by["outer"]["span"]
+        assert by["outer"]["args"] == {"k": 1}
+        assert by["inner"]["trace"] == by["outer"]["trace"]
+        out = str(tmp_path / "chrome.json")
+        stats = obs_trace.export_chrome_trace(d, out)
+        # same-thread nesting renders as stacked slices (no arrow);
+        # the cross-thread hop is exactly one flow
+        assert stats["flows"] == 1
+        trace = json.load(open(out))
+        phases = {e["ph"] for e in trace["traceEvents"]}
+        assert {"X", "s", "f"} <= phases
+
+    def test_instant_flushed_immediately(self, tmp_path):
+        # instants survive a SIGKILL a microsecond later: the record
+        # must be on disk BEFORE any explicit flush
+        d = str(tmp_path / "tr")
+        with core_flags.flags_guard(obs_trace_dir=d):
+            obs_trace.instant("recv", ctx=("t" * 16, "s" * 16))
+            fn = os.path.join(d, f"spans-{os.getpid()}.jsonl")
+            raw = open(fn).read()
+        assert '"recv"' in raw
+
+    def test_wire_header_roundtrip(self):
+        ctx = (obs_trace.new_trace_id(), obs_trace.new_span_id())
+        h = obs_trace.wire_header(ctx)
+        assert obs_trace.adopt_header(h) == ctx
+        assert obs_trace.adopt_header({"t": 'bad"id', "s": "x"}) is None
+        assert obs_trace.adopt_header("nope") is None
+        assert obs_trace.adopt_header({}) is None
+
+    def test_env_ctx_parsing(self, monkeypatch):
+        monkeypatch.setenv(obs_trace.TRACE_CTX_ENV, "abc123:def456")
+        assert obs_trace._env_ctx() == ("abc123", "def456")
+        monkeypatch.setenv(obs_trace.TRACE_CTX_ENV, "garbage")
+        assert obs_trace._env_ctx() is None
+
+    def test_context_manager_sets_current(self):
+        with obs_trace.context("t1", "s1"):
+            assert obs_trace.current() == ("t1", "s1")
+
+    def test_disabled_is_noop(self, tmp_path):
+        assert not obs_trace.sink_active()
+        with obs_trace.span("x"):
+            pass
+        obs_trace.instant("y")
+        # nothing written anywhere, and span() returned the shared
+        # null object (the hot-path zero-cost contract)
+        assert obs_trace.span("z") is obs_trace.span("w")
+
+
+class TestProfilerSpanLeak:
+    def test_stop_mid_span_does_not_leak_stack(self):
+        # the satellite regression: stop_profiler flipping _enabled
+        # mid-span used to make end() early-return with the span still
+        # on _tls.stack, mis-nesting every later span on the thread
+        profiler.start_profiler()
+        ev = profiler.RecordEvent("outer").begin()
+        profiler.stop_profiler()
+        ev.end()
+        assert not getattr(profiler._tls, "stack", [])
+        # and a following profiled span records at depth 0
+        profiler.start_profiler()
+        with profiler.RecordEvent("next"):
+            pass
+        profiler._enabled = False
+        with profiler._lock:
+            evs = [e for e in profiler._events if e["name"] == "next"]
+        profiler.stop_profiler()
+        assert evs and evs[0]["depth"] == 0
+
+    def test_record_event_writes_trace_sink_without_profiler(
+            self, tmp_path):
+        profiler.reset_profiler()  # drop the previous test's events
+        d = str(tmp_path / "tr")
+        with core_flags.flags_guard(obs_trace_dir=d):
+            with profiler.RecordEvent("serving_op", args={"rows": 4}):
+                pass
+        recs = obs_trace.read_spans(d)
+        assert recs and recs[0]["name"] == "serving_op"
+        assert recs[0]["args"] == {"rows": 4}
+        # profiler tables stayed off: nothing aggregated
+        assert profiler.stop_profiler() == []
+
+
+class TestEvents:
+    def test_emit_and_read(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with core_flags.flags_guard(obs_events_file=path):
+            obs_events.emit("checkpoint_commit", step=7, seconds=0.5)
+            obs_events.emit("worker_restart", rank=2)
+        recs = obs_events.read_events(path)
+        assert [r["event"] for r in recs] == ["checkpoint_commit",
+                                             "worker_restart"]
+        assert recs[0]["step"] == 7 and recs[0]["pid"] == os.getpid()
+
+    def test_disabled_noop(self, tmp_path):
+        assert core_flags.flag("obs_events_file") == ""
+        obs_events.emit("x")  # must not raise, must not create files
+
+    def test_unserializable_fields_degrade(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with core_flags.flags_guard(obs_events_file=path):
+            obs_events.emit("weird", obj=object())
+        recs = obs_events.read_events(path)
+        assert recs and recs[0]["event"] == "weird"
+
+
+class TestTelemetryEndpoint:
+    def _get(self, url):
+        return urllib.request.urlopen(url, timeout=10)
+
+    def test_metrics_and_healthz(self):
+        m = obs.process_registry()
+        m.counter("train_steps_total").inc(5)
+        srv = obs.TelemetryServer(port=0).start()
+        try:
+            page = self._get(srv.url + "/metrics").read().decode()
+            types, _ = parse_exposition(page)
+            assert types["p1t_train_steps_total"] == "counter"
+            hz = json.loads(self._get(srv.url + "/healthz").read())
+            assert hz["ok"] is True and hz["pid"] == os.getpid()
+            with pytest.raises(urllib.error.HTTPError):
+                self._get(srv.url + "/nope")
+        finally:
+            srv.stop()
+
+    def test_provider_error_never_kills_page(self):
+        def boom():
+            raise RuntimeError("broken provider")
+        srv = obs.TelemetryServer(port=0, registry=False,
+                                  providers=[boom, lambda: "ok 1\n"])
+        srv.start()
+        try:
+            page = self._get(srv.url + "/metrics").read().decode()
+            assert "# provider error" in page and "ok 1" in page
+        finally:
+            srv.stop()
+
+    def test_flag_disabled(self):
+        assert obs.start_telemetry_from_flags() is None
+
+
+class TestEngineInstrumentation:
+    def _engine(self):
+        import jax
+        import paddle1_tpu as paddle
+        from paddle1_tpu.core.tensor import Tensor
+        from paddle1_tpu.distributed import ParallelEngine, build_mesh
+        paddle.seed(0)
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        loss_fn = lambda m, b: \
+            ((m(Tensor(b["x"])) - Tensor(b["y"])) ** 2).mean()
+        mesh = build_mesh(dp=1, devices=jax.devices()[:1])
+        return ParallelEngine(model, opt, loss_fn, mesh=mesh)
+
+    def test_step_phases_and_gauges(self):
+        eng = self._engine()
+        rng = np.random.default_rng(0)
+        b = {"x": rng.standard_normal((4, 8)).astype(np.float32),
+             "y": rng.standard_normal((4, 8)).astype(np.float32)}
+        float(eng.step(b))  # disabled: registry stays untouched
+        assert obs.process_registry().empty()
+        with core_flags.flags_guard(obs_metrics=True):
+            for _ in range(3):
+                float(eng.step(b))
+            list(eng.step_stream([b] * 2))
+            eng.drain()
+        snap = obs.process_registry().snapshot()
+        h = snap["histograms"]
+        assert h["train_shard_seconds"]["count"] >= 5
+        assert h["train_dispatch_seconds"]["count"] >= 5
+        assert h["train_readback_seconds"]["count"] >= 3
+        assert h["train_data_wait_seconds"]["count"] >= 1
+        assert snap["counters"]["train_steps_total"] >= 5
+        assert snap["gauges"]["train_samples_per_s"] > 0
+        assert snap["gauges"]["train_steps_per_readback"] > 0
+
+    def test_step_trace_spans(self, tmp_path):
+        eng = self._engine()
+        rng = np.random.default_rng(0)
+        b = {"x": rng.standard_normal((4, 8)).astype(np.float32),
+             "y": rng.standard_normal((4, 8)).astype(np.float32)}
+        d = str(tmp_path / "tr")
+        with core_flags.flags_guard(obs_trace_dir=d):
+            float(eng.step(b))
+        names = {r["name"] for r in obs_trace.read_spans(d)}
+        assert {"train/step", "train/shard", "train/dispatch"} <= names
+
+
+class TestMetricsCallback:
+    def test_publishes_into_registry(self):
+        from paddle1_tpu.hapi.callbacks import MetricsCallback
+        cb = MetricsCallback(batch_size=32, log_freq=2)
+        cb.on_epoch_begin(0)
+        for step in range(4):
+            cb.on_train_batch_end(step, {"loss": [0.5 - 0.1 * step]})
+        cb.on_epoch_end(0)
+        cb.on_eval_end({"loss": [0.25], "acc@Top-1": 0.9})
+        m = obs.process_registry()
+        snap = m.snapshot()
+        assert snap["counters"]["hapi_steps_total"] == 4
+        assert snap["counters"]["hapi_epochs_total"] == 1
+        assert snap["histograms"]["hapi_step_seconds"]["count"] == 4
+        # log_freq=2: steps 0 and 2 updated the loss gauge (readback
+        # bounded); last write was step 2's 0.3
+        assert abs(snap["gauges"]["hapi_loss"] - 0.3) < 1e-6
+        assert snap["gauges"]["hapi_samples_per_s"] > 0
+        assert abs(snap["gauges"]["hapi_eval_acc_top_1"] - 0.9) < 1e-9
+        # the slugged eval gauge passes the lint's naming contract
+        assert re.match(r"^[a-z][a-z0-9_]*$", "hapi_eval_acc_top_1")
+
+
+class TestSupervisorObsPlumbing:
+    def test_worker_env_stamping(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        from paddle1_tpu.obs.registry import SNAPSHOT_ENV
+        sup = Supervisor(policy="fail_fast",
+                         heartbeat_dir=str(tmp_path / "hb"),
+                         world_size=1)
+        sup.add_worker(0, ["true"])
+        w = sup._workers[0]
+        d = str(tmp_path / "tr")
+        ev_file = str(tmp_path / "events.jsonl")
+        with core_flags.flags_guard(obs_trace_dir=d,
+                                    obs_events_file=ev_file,
+                                    obs_metrics=True):
+            env = {}
+            sup._obs_worker_env(w, env)
+        assert env["FLAGS_obs_trace_dir"] == d
+        assert env["FLAGS_obs_events_file"] == ev_file
+        assert env["FLAGS_obs_metrics"] == "1"
+        assert env[SNAPSHOT_ENV].endswith("metrics.0.json")
+        tid, sid = env[obs_trace.TRACE_CTX_ENV].split(":")
+        assert obs_trace._ID_RE.match(tid) and obs_trace._ID_RE.match(sid)
+        # disabled: nothing stamped
+        env = {}
+        sup._obs_worker_env(w, env)
+        assert not env
+
+    def test_worker_snapshot_aggregation_page(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="fail_fast",
+                         heartbeat_dir=str(tmp_path / "hb"),
+                         world_size=1)
+        sup.add_worker(0, ["true"])
+        sup.add_worker(1, ["true"])
+        os.makedirs(sup._heartbeat_dir(), exist_ok=True)
+        for rank in (0, 1):
+            reg = obs.MetricsRegistry(namespace="p1t")
+            reg.counter("train_steps_total").inc(10 + rank)
+            from paddle1_tpu.obs.registry import write_snapshot_file
+            write_snapshot_file(os.path.join(
+                sup._heartbeat_dir(), f"metrics.{rank}.json"), reg)
+        page = sup._worker_metrics_page()
+        types, samples = parse_exposition(page)
+        line = next(l for n, l in samples
+                    if n == "p1t_train_steps_total")
+        assert 'scope="workers"' in line and line.endswith(" 21")
+
+    def test_supervisor_telemetry_endpoint(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="fail_fast",
+                         heartbeat_dir=str(tmp_path / "hb"),
+                         world_size=1)
+        sup.add_worker(0, ["true"])
+        srv = sup.start_telemetry(port=0)
+        try:
+            hz = json.loads(urllib.request.urlopen(
+                srv.url + "/healthz", timeout=10).read())
+            assert hz["policy"] == "fail_fast"
+            assert hz["workers"] == {0: "down"} or \
+                hz["workers"] == {"0": "down"}
+        finally:
+            sup.stop_telemetry()
+
+
+class TestWireTracePropagation:
+    def test_trace_header_rides_frames(self):
+        from paddle1_tpu.serving import wire
+        a, b = socket.socketpair()
+        try:
+            ctx = (obs_trace.new_trace_id(), obs_trace.new_span_id())
+            hdr = {"kind": "infer", "id": 7,
+                   "trace": obs_trace.wire_header(ctx)}
+            wire.send_msg(a, hdr, [np.ones((2, 3), np.float32)])
+            got, arrays = wire.recv_msg(b)
+            assert obs_trace.adopt_header(got["trace"]) == ctx
+            assert arrays[0].shape == (2, 3)
+        finally:
+            a.close()
+            b.close()
+
+    def test_server_stamps_request_trace(self, tmp_path):
+        # a replica submits under the wire context; the batcher request
+        # must carry it so the dispatch span can flow-link back
+        from paddle1_tpu.serving.batcher import _Request
+        d = str(tmp_path / "tr")
+        with core_flags.flags_guard(obs_trace_dir=d):
+            with obs_trace.context("t" * 16, "s" * 16):
+                # the Server.submit stamping path, isolated
+                req = _Request([np.ones((1, 4), np.float32)],
+                               ("sig",), None)
+                req.trace = obs_trace.current()
+        assert req.trace == ("t" * 16, "s" * 16)
+
+
+class TestMetricNameLint:
+    def test_repo_is_clean(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_metric_names",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "tools", "check_metric_names.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main() == 0
+
+    def test_rules_catch_violations(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_metric_names",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "tools", "check_metric_names.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "m.counter('requests')\n"          # counter without _total
+            "m.histogram('latency')\n"         # histogram without unit
+            "m.gauge('CamelCase')\n"           # not snake_case
+            "m.gauge('dual_ms')\n"
+            "m.histogram('dual_ms')\n")        # kind conflict
+        problems = mod.check([str(bad)])
+        text = "\n".join(problems)
+        assert "'requests' must end in '_total'" in text
+        assert "needs a unit suffix" in text
+        assert "not snake_case" in text
+        assert "multiple kinds" in text

@@ -661,6 +661,9 @@ class TestFleetSubprocessMatrix:
         assert rep["shed"] == len(sheds)
         assert rep["shed_adaptive"] >= 1, rep
         counters = fleet.metrics.snapshot()["counters"]
+        # per-priority shed counters grew the _total suffix (ISSUE 10
+        # metric-name lint); priority 0 must never be adaptively shed
+        assert "shed_priority_0_total" not in counters, counters
         assert "shed_priority_0" not in counters, counters
         adaptive_prios = {p for p, adaptive in sheds if adaptive}
         assert adaptive_prios and 0 not in adaptive_prios
