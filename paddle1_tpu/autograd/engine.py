@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import flags
-from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from ..core.errors import (InvalidArgumentError, PreconditionNotMetError,
+                           ResourceExhaustedError)
 from ..core.tensor import Tensor
 
 __all__ = ["apply", "apply_custom_vjp", "run_backward", "grad", "no_grad",
@@ -84,17 +85,45 @@ def _is_float(x) -> bool:
         jnp.issubdtype(jnp.result_type(x), jnp.complexfloating)
 
 
+# live-GradNode census behind the eager_max_tape_len safety valve: an
+# eager loop that records ops forever without ever running backward
+# (the leak shape the flag exists for) fails loudly instead of growing
+# host memory until the OOM killer picks a victim. A deque of tokens,
+# not an int: append()/pop() are single C calls — atomic under the GIL
+# from any thread AND from a GC-triggered __del__ interleaving with an
+# in-progress update, where an `n += 1` read-modify-write would lose
+# counts (and a lock could self-deadlock when __del__ fires inside the
+# locked region of the same thread)
+_live_nodes: deque = deque()
+
+
+def _live_node_count() -> int:
+    return len(_live_nodes)
+
+
 class GradNode:
     """One reverse-graph node: the vjp closure of one executed op plus edges
     to producer nodes / leaf tensors."""
 
     __slots__ = ("name", "vjp_fn", "in_edges", "out_tensors", "n_outputs",
-                 "out_float", "out_shapes")
+                 "out_float", "out_shapes", "_counted")
 
     def __init__(self, name: str, vjp_fn: Callable,
                  in_edges: List[Tuple[Optional["GradNode"], int,
                                       Optional[Tensor]]],
                  out_tensors: List[Tensor]):
+        _live_nodes.append(None)
+        self._counted = True
+        if len(_live_nodes) > flags.flag("eager_max_tape_len"):
+            _live_nodes.pop()
+            self._counted = False
+            raise ResourceExhaustedError(
+                f"autograd graph exceeds eager_max_tape_len="
+                f"{flags.flag('eager_max_tape_len')} live grad nodes — "
+                "an eager loop recording ops without ever calling "
+                ".backward() (or running under no_grad()) leaks the "
+                "whole graph; wrap inference in no_grad(), call "
+                "backward, or raise the flag")
         self.name = name
         self.vjp_fn = vjp_fn
         # Per differentiable input: (producer_node, producer_out_index,
@@ -106,9 +135,25 @@ class GradNode:
         self.out_float = [_is_float(t.data) for t in out_tensors]
         self.out_shapes = [(t.data.shape, t.data.dtype) for t in out_tensors]
 
+    def _uncount(self):
+        if self._counted:
+            self._counted = False
+            try:
+                _live_nodes.pop()
+            except IndexError:  # pragma: no cover - cannot underflow
+                pass            # unless census resets race teardown
+
     def release(self):
         self.vjp_fn = None
         self.in_edges = []
+        self._uncount()
+
+    def __del__(self):
+        # a node GC'd without release() (its tensors simply died) must
+        # leave the census too, or the valve trips on long well-behaved
+        # eager runs
+        if getattr(self, "_counted", False):
+            self._uncount()
 
 
 def apply(name: str, pure_fn: Callable, tensor_inputs: Sequence[Tensor],

@@ -183,32 +183,58 @@ class flags_guard:
 
 
 def _define_builtin_flags() -> None:
-    # Numerics / debugging (reference: platform/flags.cc check_nan_inf,
-    # cudnn_deterministic).
+    # Numerics / debugging (reference: platform/flags.cc check_nan_inf).
+    # NOTE (ISSUE 11 dead-flag audit): the reference-compat no-ops
+    # `deterministic`, `allocator_strategy` and
+    # `fraction_of_gpu_memory_to_use` were DELETED — they validated and
+    # did nothing (the VERDICT dead-flag class); XLA:TPU lowering is
+    # deterministic by construction and memory is XLA/PJRT-managed
+    # (XLA_PYTHON_CLIENT_MEM_FRACTION). See MIGRATING.md.
     define_flag("check_nan_inf", False,
                 "Sweep op outputs for NaN/Inf after every eager op.")
-    define_flag("deterministic", False,
-                "Prefer deterministic XLA lowerings where available.")
+    define_flag("debug_lock_sanitizer", False,
+                "Runtime lock-order sanitizer (core/locks.py): hot-"
+                "class locks built through core.locks.make_lock become "
+                "order-recording wrappers — acquiring two locks in "
+                "opposite orders anywhere in the process raises typed "
+                "LockOrderError at the second site, and a marked "
+                "blocking call (wire recv, future wait) while holding "
+                "one raises BlockingUnderLockError. Off (the default) "
+                "is structurally free: make_lock returns a plain "
+                "threading.Lock. Enabled for the CI concurrency "
+                "lanes.")
     # Eager engine
     define_flag("eager_max_tape_len", 1_000_000,
-                "Safety valve on autograd tape length.")
+                "Safety valve on the autograd graph: an eager "
+                "process holding more than this many LIVE grad nodes "
+                "(ops recorded, backward never run) fails loudly in "
+                "autograd.engine instead of growing host memory "
+                "unboundedly.",
+                validator=lambda v: v >= 1)
     define_flag("retain_grad_for_all", False,
                 "Retain .grad for non-leaf tensors (debugging).")
-    # Memory (analog of allocator strategy / gpu mem fraction flags)
-    define_flag("allocator_strategy", "xla_default",
-                "Informational: TPU memory is managed by XLA/PJRT.",
-                validator=lambda v: v in ("xla_default",))
-    define_flag("fraction_of_gpu_memory_to_use", 1.0,
-                "Compat no-op: XLA preallocation is controlled by "
-                "XLA_PYTHON_CLIENT_MEM_FRACTION.")
     # Collectives
     define_flag("collective_timeout_s", 1800.0,
-                "Informational timeout for distributed rendezvous.")
+                "Distributed rendezvous bound: passed to "
+                "jax.distributed.initialize as initialization_timeout "
+                "by init_parallel_env (a worker that cannot reach the "
+                "coordinator fails after this many seconds instead of "
+                "blocking the pod forever).",
+                validator=lambda v: v > 0)
     define_flag("hierarchical_allreduce", False,
-                "Prefer ICI-then-DCN hierarchical collectives on multislice.")
+                "Default for DistributedStrategy."
+                "use_hierarchical_allreduce: prefer ICI-then-DCN "
+                "hierarchical collectives (collective."
+                "hierarchical_all_reduce) on multislice topologies.")
     # Profiler
-    define_flag("profiler_trace_dir", "/tmp/ptpu_trace",
-                "Directory for jax.profiler traces.")
+    define_flag("profiler_trace_dir", "",
+                "Default log_dir for profiler.start_profiler: when set "
+                "and start_profiler is called without an explicit "
+                "log_dir, the device (XLA) trace is written here. "
+                "Empty (the default) keeps start_profiler host-only "
+                "unless a log_dir is passed. The cross-process span "
+                "sink is obs_trace_dir; this flag only routes the "
+                "jax.profiler device trace.")
     # JIT
     define_flag("jit_donate_params", True,
                 "Donate parameter buffers in compiled training steps.")

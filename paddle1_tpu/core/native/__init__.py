@@ -44,7 +44,8 @@ def build_capi():
                _CAPI_SRC, "-o", _CAPI_LIB, f"-I{inc}", f"-L{libdir}",
                f"-l{pyver}", "-ldl", "-lm"]
         try:
-            subprocess.run(cmd, check=True, capture_output=True,
+            subprocess.run(cmd, check=True,  # noqa: lock-blocking — serializes the one-shot build
+                           capture_output=True,
                            timeout=180)
             return _CAPI_LIB
         except Exception:

@@ -106,6 +106,11 @@ class DistributedStrategy:
 
     def __init__(self):
         self.__dict__["_conf"] = copy.deepcopy(_DEFAULTS)
+        # flag-defaulted fields, resolved at construction (not import)
+        # so set_flags before building a strategy takes effect
+        from ...core import flags as core_flags
+        self.__dict__["_conf"]["use_hierarchical_allreduce"] = bool(
+            core_flags.flag("hierarchical_allreduce"))
 
     def __getattr__(self, name):
         conf = self.__dict__.get("_conf", {})

@@ -49,11 +49,20 @@ def init_parallel_env(strategy=None):
     # instantiating the backend first makes initialize() unusable.
     if nranks > 1 and endpoints:
         coordinator = endpoints.split(",")[0]
+        from ..core import flags as core_flags
         try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=nranks,
-                process_id=env.get_rank())
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=nranks,
+                    process_id=env.get_rank(),
+                    initialization_timeout=int(
+                        core_flags.flag("collective_timeout_s")))
+            except TypeError:  # older jax: no timeout parameter
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=nranks,
+                    process_id=env.get_rank())
         except RuntimeError as e:
             # "already initialized" is fine (launcher or user did it);
             # anything else means the multi-host bootstrap FAILED and

@@ -340,7 +340,7 @@ class ParallelEngine:
                  zero_stage: int = 0, grad_accum: int = 1,
                  clip_global_norm: Optional[float] = None,
                  batch_spec: Optional[Any] = None,
-                 donate: bool = True,
+                 donate: Optional[bool] = None,
                  amp_dtype: Optional[str] = None,
                  recompute: bool = False,
                  pp_microbatches: Optional[int] = None,
@@ -348,6 +348,10 @@ class ParallelEngine:
                  inflight_window: int = 2,
                  check_finite: bool = False):
         core_flags.maybe_enable_compilation_cache()
+        # donate=None resolves from the jit_donate_params flag (the
+        # reference's buffer-donation toggle) — an explicit arg wins
+        donate = (bool(core_flags.flag("jit_donate_params"))
+                  if donate is None else bool(donate))
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else build_mesh(

@@ -101,6 +101,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core import flags as core_flags
+from ..core import locks as core_locks
 from ..core.errors import InvalidArgumentError
 from ..core.health import (HEARTBEAT_ENV, INCARNATION_ENV, STACKDUMP_ENV,
                            UNHEALTHY_SUFFIX)
@@ -355,12 +356,12 @@ class Supervisor:
         self._resize_request: Optional[Tuple[int, str]] = None
         self._elastic_override = elastic
         self._procs_track_world = True
-        self._workers: Dict[int, _Worker] = {}
         # serializes worker-table mutation against the embedding
         # surface: a fleet's deploy thread (add_worker/retire/spawn)
         # runs concurrently with its sweep thread (supervise_once) —
         # run()'s single-threaded trainer loop never contends on it
-        self._table_lock = threading.Lock()
+        self._table_lock = core_locks.make_lock("Supervisor._table_lock")
+        self._workers: Dict[int, _Worker] = {}  # guarded-by: self._table_lock
         self._telemetry = None
         self.report = SupervisorReport(policy=self.policy)
 
@@ -455,10 +456,17 @@ class Supervisor:
         fleet mp workers via :class:`MpProcessHandle`). No respawn spec,
         no heartbeat: exit-only watching; ``restart`` falls back to
         ``fail_fast`` for these."""
-        if rank in self._workers:
-            raise InvalidArgumentError(f"rank {rank} already registered")
-        self._workers[rank] = _Worker(rank, role=role, essential=essential,
-                                      proc=proc)
+        with self._table_lock:
+            # under the lock like add_worker: the legacy watch surfaces
+            # adopt from the training thread while an embedding owner's
+            # sweep may already be iterating the table (the unlocked
+            # check-then-insert here was the one _workers mutation the
+            # guarded-by pass caught outside the lock)
+            if rank in self._workers:
+                raise InvalidArgumentError(
+                    f"rank {rank} already registered")
+            self._workers[rank] = _Worker(rank, role=role,
+                                          essential=essential, proc=proc)
         return rank
 
     # -- spawning ---------------------------------------------------------
@@ -1033,20 +1041,21 @@ class Supervisor:
         elastic = sorted(self._elastic_workers(), key=lambda w: w.rank)
         if self._procs_track_world:
             template = elastic[0]
-            for w in elastic:
-                if w.rank >= new_world:
-                    if w.log_fh is not None:
-                        try:
-                            w.log_fh.close()
-                        except OSError:  # pragma: no cover
-                            pass
-                        w.log_fh = None
-                    del self._workers[w.rank]
-            for rank in range(new_world):
-                if rank not in self._workers:
-                    self._workers[rank] = self._clone_worker(template,
-                                                             rank)
-            targets = [self._workers[r] for r in range(new_world)]
+            with self._table_lock:
+                for w in elastic:
+                    if w.rank >= new_world:
+                        if w.log_fh is not None:
+                            try:
+                                w.log_fh.close()
+                            except OSError:  # pragma: no cover
+                                pass
+                            w.log_fh = None
+                        del self._workers[w.rank]
+                for rank in range(new_world):
+                    if rank not in self._workers:
+                        self._workers[rank] = self._clone_worker(
+                            template, rank)
+                targets = [self._workers[r] for r in range(new_world)]
         else:
             targets = elastic  # single-controller: env-only resize
         # 4. relaunch with the new world coordinates

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..core import locks
 from ..core.errors import InvalidArgumentError
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -182,12 +183,13 @@ class MetricsRegistry:
 
     def __init__(self, namespace: str = "p1t_serving"):
         self.namespace = str(namespace)
-        self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
-        self._resp_times: collections.deque = collections.deque(
-            maxlen=_QPS_WINDOW)
+        self._lock = locks.make_lock("MetricsRegistry._lock")
+        self._counters: Dict[str, Counter] = {}      # guarded-by: self._lock
+        self._gauges: Dict[str, Gauge] = {}          # guarded-by: self._lock
+        self._histograms: Dict[str, Histogram] = {}  # guarded-by: self._lock
+        # (family dicts are lock-free on the READ fast path by design —
+        # `get` then locked setdefault — so only mutation is guarded)
+        self._resp_times = collections.deque(maxlen=_QPS_WINDOW)  # guarded-by: self._lock
         self._started = time.monotonic()
 
     # -- instrumentation surface -------------------------------------------
@@ -340,8 +342,8 @@ class MetricsGroup:
     def __init__(self, label_key: str, namespace: str = "p1t_serving"):
         self.label_key = label_key
         self.namespace = namespace
-        self._lock = threading.Lock()
-        self._children: Dict[str, MetricsRegistry] = {}
+        self._lock = locks.make_lock("MetricsGroup._lock")
+        self._children: Dict[str, MetricsRegistry] = {}  # guarded-by: self._lock
 
     def child(self, label) -> MetricsRegistry:
         label = str(label)

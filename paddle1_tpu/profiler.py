@@ -137,10 +137,15 @@ def reset_profiler():
 def start_profiler(state: str = "All", tracer_option: str = "Default",
                    log_dir: Optional[str] = None):
     """Enable host-span recording; with a log_dir also start the device
-    (XLA) trace (reference profiler.py:190 start_profiler)."""
+    (XLA) trace (reference profiler.py:190 start_profiler). ``log_dir``
+    None falls back to the ``profiler_trace_dir`` flag (empty keeps the
+    device trace off)."""
     global _enabled
     reset_profiler()
     _enabled = True
+    if log_dir is None:
+        from .core import flags as core_flags
+        log_dir = core_flags.flag("profiler_trace_dir") or None
     if log_dir:
         import jax
         jax.profiler.start_trace(log_dir)

@@ -38,6 +38,7 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core.locks import note_blocking
 from .errors import DeadlineExceeded
 
 __all__ = ["Batcher", "ServeFuture"]
@@ -86,13 +87,16 @@ class ServeFuture:
                  "_lo", "_hi")
 
     def __init__(self):
+        # plain Lock by design: one is built per REQUEST on the submit
+        # hot path (a sanitized wrapper would tax every request to
+        # watch a leaf lock that guards only this future's own fields)
         self._lock = threading.Lock()
-        self._event: Optional[threading.Event] = None
-        self._done = False
-        self._exc: Optional[BaseException] = None
-        self._batch: Optional[_BatchResult] = None
-        self._lo = 0
-        self._hi = 0
+        self._event: Optional[threading.Event] = None  # guarded-by: self._lock
+        self._done = False                   # guarded-by: self._lock
+        self._exc: Optional[BaseException] = None      # guarded-by: self._lock
+        self._batch: Optional[_BatchResult] = None     # guarded-by: self._lock
+        self._lo = 0                         # guarded-by: self._lock
+        self._hi = 0                         # guarded-by: self._lock
 
     # -- batcher side -------------------------------------------------------
     # Resolution is FIRST-WINS: a drain timeout may fail a future whose
@@ -136,6 +140,11 @@ class ServeFuture:
             if self._event is None:
                 self._event = threading.Event()
             ev = self._event
+        # sanitizer hook: blocking on a future's resolution while
+        # holding any sanitized lock is a deadlock shape (the resolver
+        # may need that very lock) — free no-op when the sanitizer is
+        # off, typed BlockingUnderLockError in the CI concurrency lanes
+        note_blocking("ServeFuture.result/exception wait")
         return ev.wait(timeout)
 
     def exception(self, timeout: Optional[float] = None
@@ -211,8 +220,13 @@ class Batcher(threading.Thread):
         # requests popped off the queue but not yet resolved — exposed
         # so a drain() that times out on a WEDGED dispatch can fail the
         # in-flight futures too (the no-silent-drop contract), not just
-        # the still-queued ones
-        self._pending: List[_Request] = []
+        # the still-queued ones. The lock closes the (previously
+        # GIL-benign) race between this thread's append/clear and a
+        # drain thread's fail_inflight snapshot; it is uncontended on
+        # the hot path (~100ns) and touched once per request.
+        from ..core import locks as core_locks
+        self._pending_lock = core_locks.make_lock("Batcher._pending_lock")
+        self._pending: List[_Request] = []  # guarded-by: self._pending_lock
 
     # -- loop ---------------------------------------------------------------
 
@@ -222,7 +236,6 @@ class Batcher(threading.Thread):
         # its future is resolved — the death handler below must be able
         # to fail IN-FLIGHT requests (mid-assembly, mid-dispatch, the
         # carried incompatible request), not just the ones still queued
-        pending = self._pending
         try:
             while True:
                 core_health.beat()
@@ -235,12 +248,14 @@ class Batcher(threading.Thread):
                         if self.drain.is_set():
                             break
                         continue
-                pending.append(req)
-                batch, carry = self._assemble(req, pending)
+                with self._pending_lock:
+                    self._pending.append(req)
+                batch, carry = self._assemble(req)
                 self._dispatch(batch)
-                pending.clear()
-                if carry is not None:
-                    pending.append(carry)
+                with self._pending_lock:
+                    self._pending.clear()
+                    if carry is not None:
+                        self._pending.append(carry)
         except BaseException as e:  # noqa: broad-except — the batcher
             # thread must record ANY death (incl. interrupts) and fail
             # queued AND in-flight futures loudly rather than leave
@@ -271,17 +286,19 @@ class Batcher(threading.Thread):
         per future that a racing dispatch already resolved). Called by
         the death handler above and by ``Server.drain`` when the flush
         times out on a wedged executable."""
-        for r in list(self._pending):
+        with self._pending_lock:
+            snapshot = list(self._pending)
+        for r in snapshot:
             if r.future._set_exception(exc):
                 self.metrics.counter("errors_total").inc()
 
-    def _assemble(self, first: _Request, pending: List[_Request]
+    def _assemble(self, first: _Request
                   ) -> Tuple[List[_Request], Optional[_Request]]:
         """Grow a micro-batch from the queue: same inner signature, up
         to ``max_batch`` rows, within ``batch_timeout_ms`` of the first
         request's ENQUEUE (a request that already aged past the timeout
         in the queue flushes immediately; draining flushes immediately
-        too). Every request popped is appended to ``pending`` at once,
+        too). Every request popped is appended to ``_pending`` at once,
         so the death handler can resolve it. Returns (batch, carried
         incompatible request)."""
         batch, rows = [first], first.rows
@@ -299,7 +316,8 @@ class Batcher(threading.Thread):
                 # against submitters)
                 time.sleep(min(rem, self._GATHER_SLICE_S))
                 continue
-            pending.append(nxt)
+            with self._pending_lock:
+                self._pending.append(nxt)
             if nxt.sig != first.sig or rows + nxt.rows > self.max_batch:
                 return batch, nxt  # flush now; nxt seeds the next batch
             batch.append(nxt)

@@ -86,6 +86,7 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core import locks
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from ..obs import events as obs_events
 from ..obs import trace as obs_trace
@@ -256,15 +257,18 @@ class _ReplicaClient:
         self.expected_incarnation = 0
         self.probation = probation
         self.state = _STARTING
-        self.conn: Optional[socket.socket] = None
+        # send_lock is a DELIBERATE hold-across-sendall: its whole job
+        # is serializing frames onto this one socket, so it stays a
+        # plain Lock (outside the sanitizer's hold-while-blocking net)
         self.send_lock = threading.Lock()
-        self.lock = threading.Lock()
+        self.lock = locks.make_lock(f"ReplicaClient[{rank}].lock")
         self.cond = threading.Condition(self.lock)
+        self.conn: Optional[socket.socket] = None   # guarded-by: self.lock
         # id -> (request, t_sent): what this replica owes us
-        self.inflight: Dict[int, Tuple[_FleetRequest, float]] = {}
-        self.consecutive_failures = 0
-        self.needs_restart = False
-        self._recv_gen = 0  # invalidates a stale receiver thread
+        self.inflight: Dict[int, Tuple[_FleetRequest, float]] = {}  # guarded-by: self.lock
+        self.consecutive_failures = 0               # guarded-by: self.lock
+        self.needs_restart = False                  # guarded-by: self.lock
+        self._recv_gen = 0   # guarded-by: self.lock — invalidates a stale receiver
         self.puller = threading.Thread(
             target=self._puller_loop, daemon=True,
             name=f"p1t-fleet-pull-{rank}")
@@ -412,7 +416,7 @@ class _ReplicaClient:
             header["trace"] = obs_trace.wire_header((req.trace[0], sid))
         try:
             with self.send_lock:
-                wire.send_msg(conn, header, req.arrays)
+                wire.send_msg(conn, header, req.arrays)  # noqa: lock-blocking — lock is FOR sendall
         except (OSError, ConnectionError):
             self._on_transport_loss("send failed")
 
@@ -470,10 +474,9 @@ class _ReplicaClient:
         if etype not in _CLIENT_ETYPES:
             with self.lock:
                 self.consecutive_failures += 1
-                tripped = (self.consecutive_failures
-                           >= self.fleet.breaker_failures)
-            if tripped:
-                self.needs_restart = True
+                if (self.consecutive_failures
+                        >= self.fleet.breaker_failures):
+                    self.needs_restart = True
         self.fleet._resolve_error(req, etype, msg, self)
 
     # -- failure handling --------------------------------------------------
@@ -515,16 +518,18 @@ class _ReplicaClient:
         with self.lock:
             aged = any(now - t0 > timeout_s
                        for _, t0 in self.inflight.values())
+            if aged:
+                self.needs_restart = True
         if not aged:
             return False
-        self.needs_restart = True
         self._on_transport_loss(
             f"wedged: request in flight > {timeout_s:.1f}s")
         return True
 
     def on_process_restart(self, new_incarnation: int) -> None:
-        self.expected_incarnation = int(new_incarnation)
-        self.needs_restart = False
+        with self.lock:
+            self.expected_incarnation = int(new_incarnation)
+            self.needs_restart = False
         self._on_transport_loss("restarted by supervisor")
         if self.state not in (_FAILED, _RETIRED):
             self.set_state(_STARTING)
@@ -620,30 +625,35 @@ class ServingFleet:
         self.version_metrics = MetricsGroup("version")
         self.replica_metrics = MetricsGroup("replica")
 
-        self.healthy = True
-        self._sup = None
-        self._clients: Dict[int, _ReplicaClient] = {}
-        self._next_rank = 0
-        self._rid = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ServingFleet._lock")
         self._queue_cond = threading.Condition(self._lock)
-        self._queue: "collections.deque[_FleetRequest]" = \
-            collections.deque()
-        self._live: Dict[int, _FleetRequest] = {}
-        self._rpc_waiters: Dict[int, dict] = {}
-        self._accepting = False
-        self._stop = False
+        # deploy is an administrative roll that BLOCKS by design while
+        # holding its mutex (spawn, warmup, canary result) — order is
+        # still sanitized, hold-while-blocking deliberately exempt
+        self._deploy_lock = locks.make_lock("ServingFleet._deploy_lock",
+                                            allow_blocking=True)
+        self.healthy = True                  # guarded-by: self._lock
+        self._sup = None
+        self._clients: Dict[int, _ReplicaClient] = {}  # guarded-by: self._lock
+        self._next_rank = 0                  # guarded-by: self._lock
+        self._rid = 0                        # guarded-by: self._lock
+        self._queue = collections.deque()    # guarded-by: self._lock
+        # (holds _FleetRequest; rebindable — the sweep filters expired
+        # entries by swapping in a fresh deque under the lock)
+        self._live: Dict[int, _FleetRequest] = {}      # guarded-by: self._lock
+        self._rpc_waiters: Dict[int, dict] = {}        # guarded-by: self._lock
+        self._accepting = False              # guarded-by: self._lock
+        self._stop = False                   # guarded-by: self._lock
         self._started = False
         self._drained = False
-        self._deploy_lock = threading.Lock()
         self._sweeper: Optional[threading.Thread] = None
         self._telemetry = None
         # shed journal rate limit: sheds are per-REQUEST (not a rare
         # lifecycle moment) — at most one aggregated event per second
-        self._shed_pending = 0
-        self._shed_last_emit = 0.0
-        self.deploys = 0
-        self.rollbacks = 0
+        self._shed_pending = 0               # guarded-by: self._lock
+        self._shed_last_emit = 0.0           # guarded-by: self._lock
+        self.deploys = 0                     # guarded-by: self._deploy_lock
+        self.rollbacks = 0                   # guarded-by: self._deploy_lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -671,7 +681,8 @@ class ServingFleet:
         self._sup.start()
         for c in self._clients.values():
             c.start()
-        self._accepting = True
+        with self._lock:
+            self._accepting = True
         self._started = True
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True,
@@ -716,8 +727,9 @@ class ServingFleet:
                      probation: bool = False,
                      max_restarts: Optional[int] = None
                      ) -> _ReplicaClient:
-        rank = self._next_rank
-        self._next_rank += 1
+        with self._lock:
+            rank = self._next_rank
+            self._next_rank += 1
         ep = os.path.join(self._work_dir, f"replica.{rank}.json")
         try:  # a stale endpoint from a previous rank must never match
             os.unlink(ep)
@@ -807,11 +819,17 @@ class ServingFleet:
                 self._queue_cond.notify()
         if shed_exc is not None:
             # aggregated, >= 1s apart: a storm shedding thousands/s
-            # must not pay a journal write+flush per request
+            # must not pay a journal write+flush per request. Only the
+            # counter swap re-enters the lock (two shedding threads
+            # racing the unlocked swap could both zero _shed_pending
+            # and drop counts); the journal WRITE stays outside it.
             now = time.monotonic()
-            if now - self._shed_last_emit >= 1.0:
-                self._shed_last_emit = now
-                count, self._shed_pending = self._shed_pending, 0
+            count = 0
+            with self._queue_cond:
+                if now - self._shed_last_emit >= 1.0:
+                    self._shed_last_emit = now
+                    count, self._shed_pending = self._shed_pending, 0
+            if count:
                 obs_events.emit("shed", count=count,
                                 last_priority=int(priority),
                                 overload=round(shed_overload, 3))
@@ -985,8 +1003,11 @@ class ServingFleet:
                 continue
             if client.sweep_timeouts(now, self.replica_timeout_s):
                 self.metrics.counter("replica_wedged_total").inc()
-            if client.needs_restart:
+            with client.lock:  # atomic test-and-clear: a breaker trip
+                # racing this sweep must be consumed exactly once
+                needs_restart = client.needs_restart
                 client.needs_restart = False
+            if needs_restart:
                 if client.state not in (_FAILED, _RETIRED, _DRAINING):
                     try:
                         restarted = self._sup.restart_rank(client.rank)
@@ -1045,7 +1066,8 @@ class ServingFleet:
             # unblocks its wait_connected immediately); the standing
             # fleet is intact and stays healthy
             return
-        self.healthy = False
+        with self._lock:
+            self.healthy = False
         self.metrics.counter("replica_exhausted_total").inc()
         reason = (f"serving fleet: replica {client.rank} out of "
                   f"restart budget"
@@ -1086,7 +1108,7 @@ class ServingFleet:
             self._rpc_waiters[rid] = waiter
         try:
             with client.send_lock:
-                wire.send_msg(conn, {"kind": kind, "id": rid})
+                wire.send_msg(conn, {"kind": kind, "id": rid})  # noqa: lock-blocking — send lock
         except (OSError, ConnectionError):
             with self._lock:
                 self._rpc_waiters.pop(rid, None)
@@ -1358,8 +1380,9 @@ class ServingFleet:
             # anything still unresolved fails typed, never silently
             self._fail_all_pending(PreconditionNotMetError(
                 f"fleet drain timed out after {timeout}s"))
-        self._stop = True
-        self._notify_queue()
+        with self._queue_cond:
+            self._stop = True
+            self._queue_cond.notify_all()
         if self._sup is not None and not already:
             for rank in list(self._clients):
                 self._sup.retire(rank, grace_s=10.0)

@@ -73,6 +73,7 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core import locks
 from ..core.errors import InvalidArgumentError
 from .engine import resolve_buckets
 from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
@@ -356,7 +357,7 @@ class GenerationEngine:
         model.eval()
         self._model = model
         self._params = model.functional_state()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("GenerationEngine._lock")
         # trace-side-effect counters — the "exactly one decode compile"
         # acceptance gate reads decode_compile_count
         self.decode_compile_count = 0
@@ -640,10 +641,10 @@ class GenerationServer:
         self._warmup = bool(warmup)
         self._q: "queue.Queue[_GenRequest]" = queue.Queue(self.queue_depth)
         self._drain_event = threading.Event()
-        self._accepting = False
-        self._admit_lock = threading.Lock()
+        self._admit_lock = locks.make_lock("GenerationServer._admit_lock")
+        self._accepting = False          # guarded-by: self._admit_lock
         self._loop: Optional[_GenerationLoop] = None
-        self._seed_counter = [0]
+        self._seed_counter = [0]         # guarded-by: self._admit_lock
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -666,7 +667,8 @@ class GenerationServer:
         self._loop = _GenerationLoop(self.engine, self._q,
                                      self.metrics, self._drain_event)
         self._loop.start()
-        self._accepting = True
+        with self._admit_lock:
+            self._accepting = True
         return self
 
     @property

@@ -124,7 +124,7 @@ def _resolver_loop(q: "queue.Queue", version: str) -> None:
                                             "version": version})
             try:
                 with send_lock:
-                    wire.send_msg(conn, header, arrays)
+                    wire.send_msg(conn, header, arrays)  # noqa: lock-blocking — lock is FOR sendall
             except (OSError, ConnectionError):
                 pass  # fleet connection died; its failover retries this
         finally:
@@ -151,13 +151,13 @@ def _serve_conn(conn: socket.socket, srv, args, resolver_q,
         kind = header.get("kind")
         if kind == "ping":
             with send_lock:
-                wire.send_msg(conn, {
+                wire.send_msg(conn, {  # noqa: lock-blocking — frame lock IS for sendall
                     "kind": "pong", "id": header.get("id"),
                     "version": args.version,
                     "warm_buckets": sorted(srv.engine.compile_counts)})
         elif kind == "metrics":
             with send_lock:
-                wire.send_msg(conn, {
+                wire.send_msg(conn, {  # noqa: lock-blocking — frame lock IS for sendall
                     "kind": "metrics_result", "id": header.get("id"),
                     "version": args.version,
                     "snapshot": srv.metrics.snapshot()})
@@ -206,7 +206,7 @@ def _serve_conn(conn: socket.socket, srv, args, resolver_q,
                 # errors (shed/closed/invalid) go back typed so the
                 # fleet can retry elsewhere or surface them
                 with send_lock:
-                    wire.send_msg(conn, {
+                    wire.send_msg(conn, {  # noqa: lock-blocking — frame lock IS for sendall
                         "kind": "error", "id": header.get("id"),
                         "version": args.version,
                         "etype": type(e).__name__, "msg": str(e)})

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core import locks
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from .batcher import Batcher, ServeFuture, _Request
 from .engine import InferenceEngine
@@ -127,13 +128,13 @@ class Server:
         self._warmup = bool(warmup)
         self._q: "queue.Queue[_Request]" = queue.Queue(self.queue_depth)
         self._drain_event = threading.Event()
-        self._accepting = False
         # makes {accepting-check → requests_total → enqueue} atomic
         # against drain()'s accepting-flip: without it a drain landing
         # between the count and the put snapshots accepted=completed+1
         # and reports unaccounted=1 for a request that resolves typed a
         # beat later (uncontended acquire is ~100ns — no convoy)
-        self._admit_lock = threading.Lock()
+        self._admit_lock = locks.make_lock("Server._admit_lock")
+        self._accepting = False          # guarded-by: self._admit_lock
         self._batcher: Optional[Batcher] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -171,7 +172,8 @@ class Server:
                                 self.batch_timeout_ms, self.metrics,
                                 self._drain_event)
         self._batcher.start()
-        self._accepting = True
+        with self._admit_lock:
+            self._accepting = True
         return self
 
     @property

@@ -37,6 +37,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.locks import note_blocking
+
 __all__ = ["send_msg", "recv_msg"]
 
 _U32 = struct.Struct("<I")
@@ -98,6 +100,10 @@ def recv_msg(sock: socket.socket, idle=None
     ``ConnectionError`` when the peer closed (mid-frame or between
     frames); ``idle()`` runs on every socket timeout and may raise to
     abort the read."""
+    # sanitizer hook: a frame read can block for the peer's whole
+    # compute; doing that while holding a sanitized lock stalls every
+    # thread needing it (free no-op unless debug_lock_sanitizer armed)
+    note_blocking("wire.recv_msg")
     (hlen,) = _U32.unpack(_recv_exact(sock, 4, idle))
     if hlen > _MAX_HEADER:
         raise ConnectionError(

@@ -1,0 +1,518 @@
+"""Concurrency lint suite + runtime lock-order sanitizer (ISSUE 11).
+
+Violation matrix per pass (seeded bad files assert exact rule/line
+findings), clean-repo asserts through the UNIFIED entry, the noqa
+framework contract, the sanitizer's inversion/blocking detection with
+structural-zero-cost-off proof, and a regression for the genuine race
+the guarded-mutation pass surfaced (the fleet's shed-journal counter
+swap outside the admission lock)."""
+
+import os
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import lint as tl  # noqa: E402 — path bootstrap first
+from paddle1_tpu.core import flags as core_flags  # noqa: E402
+from paddle1_tpu.core import locks  # noqa: E402
+from paddle1_tpu.core.locks import (BlockingUnderLockError,  # noqa: E402
+                                    LockOrderError)
+
+
+def _run(tmp_path, src, select, name="seed.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return tl.run(paths=[str(p)], select=select).findings
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- framework: noqa infra ---------------------------------------------------
+
+class TestNoqaFramework:
+    BAD = ("import time\n"
+           "class C:\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(1)\n")
+
+    def test_finding_without_marker(self, tmp_path):
+        fs = _run(tmp_path, self.BAD, ["lock-discipline"])
+        assert [(f.rule, f.line) for f in fs] == [("lock-blocking", 5)]
+
+    def test_marker_with_reason_suppresses(self, tmp_path):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # noqa: lock-blocking — test pacing only")
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_marker_without_reason_is_its_own_finding(self, tmp_path):
+        src = self.BAD.replace(
+            "time.sleep(1)", "time.sleep(1)  # noqa: lock-blocking")
+        fs = _run(tmp_path, src, ["lock-discipline"])
+        rules = sorted(f.rule for f in fs)
+        assert rules == ["lock-blocking", "noqa-reason"]
+
+    def test_marker_for_other_rule_does_not_suppress(self, tmp_path):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # noqa: guarded-mutation — wrong rule")
+        assert _by_rule(_run(tmp_path, src, ["lock-discipline"]),
+                        "lock-blocking")
+
+    def test_multi_rule_marker(self, tmp_path):
+        src = self.BAD.replace(
+            "time.sleep(1)",
+            "time.sleep(1)  # noqa: guarded-mutation,lock-blocking — x")
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+
+# -- lock-discipline: violation matrix ---------------------------------------
+
+class TestLockDisciplineMatrix:
+    def test_blocking_calls_under_lock(self, tmp_path):
+        src = (
+            "import time, subprocess\n"              # 1
+            "class C:\n"                             # 2
+            "    def f(self):\n"                     # 3
+            "        with self._lock:\n"             # 4
+            "            time.sleep(0.1)\n"          # 5
+            "            self.task_q.get(timeout=1)\n"   # 6
+            "            self.q.put(1)\n"            # 7
+            "            self.sock.sendall(b'x')\n"  # 8
+            "            fut.result()\n"             # 9
+            "            t.join()\n"                 # 10
+            "            subprocess.run(['ls'])\n"   # 11
+            "            wire.send_msg(conn, {})\n"  # 12
+        )
+        fs = _by_rule(_run(tmp_path, src, ["lock-discipline"]),
+                      "lock-blocking")
+        assert sorted(f.line for f in fs) == [5, 6, 7, 8, 9, 10, 11, 12]
+
+    def test_non_blocking_shapes_are_clean(self, tmp_path):
+        src = (
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.q.get_nowait()\n"       # nowait variants
+            "            self.q.put_nowait(1)\n"
+            "            d = self.headers.get('k')\n"  # dict.get
+            "            s = ', '.join(['a'])\n"       # str.join has args
+            "        self.q.get(timeout=1)\n"          # outside the lock
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_closure_under_lock_not_flagged(self, tmp_path):
+        src = (
+            "import time\n"
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            def later():\n"
+            "                time.sleep(1)\n"  # runs after release
+            "            self.cb = later\n"
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_guarded_mutation_outside_lock(self, tmp_path):
+        src = (
+            "import threading\n"                              # 1
+            "class C:\n"                                      # 2
+            "    def __init__(self):\n"                       # 3
+            "        self._lock = threading.Lock()\n"         # 4
+            "        self.state = {}   # guarded-by: self._lock\n"  # 5
+            "        self.n = 0        # guarded-by: self._lock\n"  # 6
+            "    def good(self):\n"                           # 7
+            "        with self._lock:\n"                      # 8
+            "            self.state['k'] = 1\n"               # 9
+            "            self.n += 1\n"                       # 10
+            "    def bad(self):\n"                            # 11
+            "        self.state['k'] = 2\n"                   # 12
+            "        self.n = 5\n"                            # 13
+            "        self.state.clear()\n"                    # 14
+        )
+        fs = _by_rule(_run(tmp_path, src, ["lock-discipline"]),
+                      "guarded-mutation")
+        assert sorted(f.line for f in fs) == [12, 13, 14]
+
+    def test_condition_alias_counts_as_lock(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cond = threading.Condition(self._lock)\n"
+            "        self.items = []  # guarded-by: self._lock\n"
+            "    def ok(self):\n"
+            "        with self._cond:\n"       # Condition(self._lock)
+            "            self.items.append(1)\n"
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_wrong_lock_is_flagged(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._other_lock = threading.Lock()\n"
+            "        self.n = 0  # guarded-by: self._lock\n"
+            "    def bad(self):\n"
+            "        with self._other_lock:\n"
+            "            self.n = 1\n"                        # 9
+        )
+        fs = _by_rule(_run(tmp_path, src, ["lock-discipline"]),
+                      "guarded-mutation")
+        assert [f.line for f in fs] == [9]
+
+    def test_init_is_exempt(self, tmp_path):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0  # guarded-by: self._lock\n"
+            "        self.n = 1\n"  # still __init__: fine
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_lock_order_cycle(self, tmp_path):
+        src = (
+            "class C:\n"                       # 1
+            "    def ab(self):\n"              # 2
+            "        with self._a_lock:\n"     # 3
+            "            with self._b_lock:\n"  # 4
+            "                pass\n"           # 5
+            "    def ba(self):\n"              # 6
+            "        with self._b_lock:\n"     # 7
+            "            with self._a_lock:\n"  # 8
+            "                pass\n"           # 9
+        )
+        fs = _by_rule(_run(tmp_path, src, ["lock-discipline"]),
+                      "lock-order")
+        assert len(fs) == 1 and "inversion" in fs[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = (
+            "class C:\n"
+            "    def ab(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+            "    def ab2(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+    def test_same_attr_other_class_no_false_cycle(self, tmp_path):
+        # _lock in TWO classes is two locks: A nests x->y, B nests
+        # y->x — per-class graphs must NOT merge into a false cycle
+        src = (
+            "class A:\n"
+            "    def f(self):\n"
+            "        with self._x_lock:\n"
+            "            with self._y_lock:\n"
+            "                pass\n"
+            "class B:\n"
+            "    def f(self):\n"
+            "        with self._y_lock:\n"
+            "            with self._x_lock:\n"
+            "                pass\n"
+        )
+        assert not _run(tmp_path, src, ["lock-discipline"])
+
+
+# -- flag-liveness: violation matrix -----------------------------------------
+
+class TestFlagLivenessMatrix:
+    def test_dead_flag_found_at_define_site(self, tmp_path):
+        src = ("def define_flag(n, d, h=''):\n"
+               "    pass\n"
+               "define_flag('zombie_flag', 1, 'nobody reads me')\n")
+        fs = _by_rule(_run(tmp_path, src, ["flag-liveness"]),
+                      "dead-flag")
+        assert len(fs) == 1 and fs[0].line == 3 \
+            and "zombie_flag" in fs[0].message
+
+    def test_direct_read_is_live(self, tmp_path):
+        src = ("define_flag('live_flag', 1)\n"
+               "v = flag('live_flag')\n")
+        assert not _run(tmp_path, src, ["flag-liveness"])
+
+    def test_indirect_reads_are_live(self, tmp_path):
+        # the repo's real shapes: helper-call literal, kwarg default,
+        # set_flags dict key, FLAGS_ env propagation
+        src = ("define_flag('a_flag', 1)\n"
+               "define_flag('b_flag', 1)\n"
+               "define_flag('c_flag', 1)\n"
+               "define_flag('d_flag', 1)\n"
+               "x = _flag_default(None, 'a_flag')\n"
+               "def f(spec_flag='b_flag'):\n"
+               "    pass\n"
+               "set_flags({'c_flag': 2})\n"
+               "env['FLAGS_d_flag'] = '1'\n")
+        assert not _run(tmp_path, src, ["flag-liveness"])
+
+    def test_help_text_mention_is_not_a_read(self, tmp_path):
+        src = ("define_flag('one_flag', 1)\n"
+               "define_flag('other_flag', 1, 'raise one_flag instead')\n"
+               "v = flag('other_flag')\n")
+        fs = _by_rule(_run(tmp_path, src, ["flag-liveness"]),
+                      "dead-flag")
+        assert len(fs) == 1 and "one_flag" in fs[0].message
+
+    def test_forward_compat_allowlist(self, tmp_path, monkeypatch):
+        from tools.lint import flag_liveness as fl
+        monkeypatch.setattr(fl, "FORWARD_COMPAT",
+                            {"zombie_flag": "ROADMAP #2 reads it"})
+        src = "define_flag('zombie_flag', 1)\n"
+        assert not _run(tmp_path, src, ["flag-liveness"])
+
+    def test_stale_allowlist_entry_is_flagged(self, tmp_path,
+                                              monkeypatch):
+        from tools.lint import flag_liveness as fl
+        monkeypatch.setattr(fl, "FORWARD_COMPAT",
+                            {"live_flag": "ROADMAP #2"})
+        src = ("define_flag('live_flag', 1)\n"
+               "v = flag('live_flag')\n")
+        fs = _by_rule(_run(tmp_path, src, ["flag-liveness"]),
+                      "dead-flag")
+        assert len(fs) == 1 and "stale" in fs[0].message
+
+
+# -- migrated passes still catch their classes through the framework ---------
+
+class TestMigratedPasses:
+    def test_bare_except_via_framework(self, tmp_path):
+        src = "try:\n    x()\nexcept:\n    pass\n"
+        fs = _by_rule(_run(tmp_path, src, ["bare-except"]),
+                      "broad-except")
+        assert len(fs) == 1 and fs[0].line == 3
+
+    def test_metric_names_via_framework(self, tmp_path):
+        src = ("m.counter('requests')\n"
+               "m.histogram('latency')\n"
+               "m.gauge('dual')\nm.histogram('dual')\n")
+        fs = _by_rule(_run(tmp_path, src, ["metric-names"]),
+                      "metric-name")
+        text = " | ".join(f.message for f in fs)
+        assert "'requests' must end in '_total'" in text
+        assert "needs a unit suffix" in text
+        assert "multiple kinds" in text
+
+
+# -- the unified clean-repo gate ---------------------------------------------
+
+class TestCleanRepo:
+    def test_all_passes_clean_on_repo(self):
+        result = tl.run()
+        msgs = [f.format(REPO) for f in result.findings]
+        assert not msgs, "\n".join(msgs)
+        # the walk actually covered the runtime packages
+        assert result.files_checked > 100
+
+
+# -- runtime sanitizer --------------------------------------------------------
+
+class TestLockSanitizer:
+    def setup_method(self):
+        locks.reset_order_graph()
+
+    def test_structurally_free_when_off(self):
+        # force OFF explicitly: this test must also hold inside the CI
+        # sanitizer lane, where FLAGS_debug_lock_sanitizer=1 is exported
+        with core_flags.flags_guard(debug_lock_sanitizer=False):
+            lk = locks.make_lock("x")
+            rlk = locks.make_rlock("y")
+            # PLAIN stdlib primitives — not a wrapper with a flag branch
+            assert type(lk) is type(threading.Lock())
+            assert type(rlk) is type(threading.RLock())
+
+    def test_detects_seeded_inversion(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("A")
+            b = locks.make_lock("B")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(LockOrderError, match="inversion"):
+                with b:
+                    with a:
+                        pass
+
+    def test_detects_transitive_cycle(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("A")
+            b = locks.make_lock("B")
+            c = locks.make_lock("C")
+            with a, b:
+                pass
+            with b, c:
+                pass
+            with pytest.raises(LockOrderError):
+                with c, a:
+                    pass
+
+    def test_cross_thread_inversion(self):
+        """The point of the graph being process-wide: thread 1 records
+        A->B, thread 2's B->A raises — no interleaving luck needed."""
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("A")
+            b = locks.make_lock("B")
+
+            def t1():
+                with a:
+                    with b:
+                        pass
+            th = threading.Thread(target=t1)
+            th.start()
+            th.join()
+            with pytest.raises(LockOrderError):
+                with b:
+                    with a:
+                        pass
+
+    def test_consistent_order_never_raises(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("A")
+            b = locks.make_lock("B")
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+
+    def test_same_name_distinct_instances_nested_is_typed(self):
+        """Name-keyed ordering cannot verify two instances sharing a
+        name nested — typed error telling you to name them apart (NOT
+        an IndexError out of the path printer)."""
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("Twin._lock")
+            b = locks.make_lock("Twin._lock")
+            with pytest.raises(LockOrderError, match="distinct names"):
+                with a:
+                    with b:
+                        pass
+
+    def test_rlock_reentry_records_no_edge(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            r = locks.make_rlock("R")
+            with r:
+                with r:  # reentrant: must not self-edge or deadlock
+                    pass
+            assert locks.held_locks() == []
+
+    def test_blocking_under_lock_raises_typed(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            a = locks.make_lock("A")
+            with pytest.raises(BlockingUnderLockError, match="convoy"):
+                with a:
+                    locks.note_blocking("test wait")
+            locks.note_blocking("no lock held")  # clean
+
+    def test_allow_blocking_administrative_mutex(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            adm = locks.make_lock("Deploy", allow_blocking=True)
+            with adm:
+                locks.note_blocking("canary result")  # declared OK
+            # ... but order is still tracked for it
+            b = locks.make_lock("B2")
+            with adm:
+                with b:
+                    pass
+            with pytest.raises(LockOrderError):
+                with b:
+                    with adm:
+                        pass
+
+    def test_condition_over_sanitized_lock(self):
+        with core_flags.flags_guard(debug_lock_sanitizer=True):
+            lk = locks.make_lock("CondBase")
+            cond = threading.Condition(lk)
+            with cond:
+                cond.wait(timeout=0.01)  # release/reacquire round-trip
+                cond.notify_all()
+            assert locks.held_locks() == []
+
+    def test_note_blocking_free_when_never_armed(self):
+        # no sanitized lock was ever constructed in an off process —
+        # the hook is one module-bool test (hot-path contract); here we
+        # just pin the off-behavior: never raises whatever is held
+        plain = threading.Lock()
+        with plain:
+            locks.note_blocking("off")
+
+
+# -- regression: the shed-journal counter swap (guarded-mutation find) -------
+
+class TestFleetShedAccountingRace:
+    @staticmethod
+    def _quiet_fleet():
+        """A fleet object with admission state but no processes: the
+        submit path up to the shed raise is exercisable without
+        replicas (nothing ever pulls the queue)."""
+        from paddle1_tpu.serving.fleet import ServingFleet
+        fleet = ServingFleet("unused:factory", replicas=1,
+                             fleet_queue_depth=64, shed_start=0.5,
+                             priority_levels=4)
+        with fleet._lock:
+            fleet._accepting = True
+        # saturate the admission EWMA so every low-priority submit
+        # sheds adaptively
+        for _ in range(64):
+            fleet.admission.observe(64)
+        return fleet
+
+    def test_concurrent_sheds_never_lose_counts(self):
+        from paddle1_tpu.serving.errors import ServerOverloaded
+        fleet = self._quiet_fleet()
+        import numpy as np
+        x = np.zeros((1, 4), np.float32)
+        shed = [0] * 8
+        emitted = []
+
+        # capture the aggregated journal counts without a real file
+        from paddle1_tpu.obs import events as obs_events
+        orig_emit = obs_events.emit
+
+        def fake_emit(kind, **fields):
+            if kind == "shed":
+                emitted.append(fields["count"])
+        obs_events.emit = fake_emit
+        try:
+            def pump(i):
+                for _ in range(200):
+                    try:
+                        fleet.submit(x, priority=3)
+                    except ServerOverloaded:
+                        shed[i] += 1
+            ts = [threading.Thread(target=pump, args=(i,))
+                  for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            obs_events.emit = orig_emit
+        snap = fleet.metrics.snapshot()["counters"]
+        adaptive = snap["shed_adaptive_total"]
+        # plenty of contention actually happened (some submits are
+        # legitimately admitted as the EWMA decays — hard-full sheds
+        # land in shed_total but not the adaptive journal)
+        assert adaptive > 500
+        assert snap["shed_total"] == sum(shed)
+        # the race this regression pins: every ADAPTIVE shed lands in
+        # exactly one journal aggregate or in the still-pending
+        # counter — the pre-fix unlocked swap could double-zero
+        # _shed_pending and lose (or double-emit) counts here
+        with fleet._lock:
+            pending = fleet._shed_pending
+        assert sum(emitted) + pending == adaptive

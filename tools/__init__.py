@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.lint` works from the repo
+# root (the unified static-analysis entry — see tools/lint/).

@@ -1,129 +1,30 @@
 #!/usr/bin/env python
-"""Lint the metric-name contract (ISSUE 10 satellite).
+"""Shim: the metric-name lint moved into the unified suite (ISSUE 11).
 
-Walks every ``.py`` under ``paddle1_tpu/`` (plus ``bench.py`` /
-``bench_utils.py``) and AST-collects string-literal metric names at
-``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` call
-sites, then enforces the rules the Prometheus exposition (and the
-conformance test) depend on:
-
-* **snake_case** — ``[a-z][a-z0-9_]*``: anything else breaks the
-  sample-line grammar or the family prefix join;
-* **counters end ``_total``** — the Prometheus counter convention
-  ``rate()`` recipes assume;
-* **histograms carry a unit suffix** — ``_seconds``/``_ms``/``_us``/
-  ``_s``/``_per_s`` (or a known unitless family like ``_occupancy``):
-  an unsuffixed latency family is a dashboard ambiguity forever;
-* **no duplicate family registration across kinds** — one name must be
-  exactly one of counter/gauge/histogram everywhere it appears (the
-  registry also enforces this per-instance at runtime; the lint
-  catches cross-module collisions before they meet in one registry).
-
-Dynamic names (f-strings) are invisible to the lint — keep them on the
-same conventions by hand (the registry's kind guard still covers them
-at runtime). Exit code 0 clean, 1 with findings; wired into CI next to
-check_no_bare_except.
+The implementation (rules unchanged) lives in
+``tools/lint/metric_names.py`` and runs as the ``metric-names`` pass of
+``python -m tools.lint --all``. This file keeps the historical
+standalone surface — ``collect``, ``check``, ``main``, the rule
+constants — for existing callers and tests, and still works as a
+script: ``python tools/check_metric_names.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-METHODS = ("counter", "gauge", "histogram")
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-HIST_UNIT_SUFFIXES = ("_seconds", "_ms", "_us", "_s", "_per_s")
-# unitless histogram families that are ratios/fractions by nature
-HIST_UNITLESS_OK = {"batch_occupancy"}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
+from tools.lint.metric_names import (  # noqa: E402 — path bootstrap first
+    HIST_UNIT_SUFFIXES, HIST_UNITLESS_OK, METHODS, NAME_RE, check,
+    collect, main, repo_root, target_files)
 
-def repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def target_files(root: str):
-    pkg = os.path.join(root, "paddle1_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-    for fn in ("bench.py", "bench_utils.py"):
-        p = os.path.join(root, fn)
-        if os.path.exists(p):
-            yield p
-
-
-def collect(path: str):
-    """Yield (kind, name, lineno) for every literal metric touch."""
-    with open(path, "r") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError:
-        return
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and fn.attr in METHODS):
-            continue
-        if not node.args:
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            yield fn.attr, arg.value, node.lineno
-
-
-def check(files) -> list:
-    problems = []
-    kinds_by_name: dict = {}
-    for path in files:
-        rel = os.path.relpath(path, repo_root())
-        for kind, name, lineno in collect(path):
-            where = f"{rel}:{lineno}"
-            if not NAME_RE.match(name):
-                problems.append(
-                    f"{where}: {kind} name {name!r} is not snake_case")
-            if kind == "counter" and not name.endswith("_total"):
-                problems.append(
-                    f"{where}: counter {name!r} must end in '_total'")
-            if kind in ("gauge", "histogram") and name.endswith("_total"):
-                problems.append(
-                    f"{where}: {kind} {name!r} must NOT end in "
-                    "'_total' (that suffix promises a counter)")
-            if kind == "histogram" \
-                    and not name.endswith(HIST_UNIT_SUFFIXES) \
-                    and name not in HIST_UNITLESS_OK:
-                problems.append(
-                    f"{where}: histogram {name!r} needs a unit suffix "
-                    f"{HIST_UNIT_SUFFIXES} (or add it to the unitless "
-                    "allowlist if it is a ratio)")
-            kinds_by_name.setdefault(name, {})[kind] = where
-    for name, kinds in sorted(kinds_by_name.items()):
-        if len(kinds) > 1:
-            sites = ", ".join(f"{k} at {w}" for k, w in sorted(
-                kinds.items()))
-            problems.append(
-                f"metric family {name!r} registered as multiple kinds: "
-                f"{sites} — one family, one kind")
-    return problems
-
-
-def main(argv=None) -> int:
-    root = repo_root()
-    problems = check(sorted(target_files(root)))
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"\n{len(problems)} metric-name problem(s) "
-              "(see tools/check_metric_names.py header for the rules)")
-        return 1
-    print("metric names OK")
-    return 0
-
+__all__ = ["HIST_UNIT_SUFFIXES", "HIST_UNITLESS_OK", "METHODS",
+           "NAME_RE", "check", "collect", "main", "repo_root",
+           "target_files"]
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,219 +1,31 @@
 #!/usr/bin/env python
-"""Lint: no exception handler may swallow interrupts.
+"""Shim: the bare-except lint moved into the unified suite (ISSUE 11).
 
-The fault-tolerance stack is built on retry wrappers and
-surface-worker-errors-later queues — exactly the code shapes that, when
-written as ``except:`` or a swallowed ``except BaseException``, eat
-``KeyboardInterrupt``/``SystemExit``/``SimulatedPreemption`` and turn
-"ctrl-C the run" or "preempt the worker" into a silent hang. This
-checker enforces, over the runtime packages:
-
-* **bare ``except:``** — always an error (it is ``except BaseException``
-  in disguise);
-* **``except BaseException`` / ``except KeyboardInterrupt`` /
-  ``except SystemExit``** — an error unless the handler body contains a
-  ``raise``, or the ``except`` line carries an explicit
-  ``# noqa: broad-except`` marker documenting why the catch is sound
-  (e.g. a producer thread forwarding the error object to its consumer,
-  where it IS re-raised);
-* the marker itself must carry a **reason** (``# noqa: broad-except —
-  why``) — a bare marker is an error: the allowlist is documentation,
-  not an escape hatch;
-* **``except SimulatedPreemption``** without re-raise — an error except
-  in the designated preemption-handler files
-  (``PREEMPTION_HANDLER_FILES``): a preemption notice must unwind to
-  the resilient loop's handler (which checkpoints), and the supervisor
-  stack must never absorb one in a generic retry/cleanup wrapper.
-* **error-forwarding allowlist** (``ERROR_FORWARDING_FILES``): in the
-  producer/worker loops of the input pipeline, ``except BaseException
-  as e`` is sound *without* a marker when the handler demonstrably
-  FORWARDS the caught object to its consumer — assigns it (``self._err
-  = e``) or ships it through a queue ``put``/``put_nowait`` — where it
-  is re-raised on the consumer's next ``next()``/``read()``. This is
-  checked structurally (the bound name must appear as an assignment
-  value or a ``put`` argument), so the allowlist cannot silently decay
-  into a blanket exemption; a broad catch in those files that does
-  *not* forward is still an error.
-
-Retry wrappers must catch ``Exception``, never broader.
-
-Usage: ``python tools/check_no_bare_except.py [paths...]`` — default
-paths are the runtime packages. Exit 0 clean, 1 with findings (one
-``path:line: message`` per finding).
+The implementation (rules unchanged) lives in
+``tools/lint/bare_except.py`` and runs as the ``bare-except`` pass of
+``python -m tools.lint --all``. This file keeps the historical
+standalone surface — ``check_source``, ``main``, the module constants —
+for existing callers and tests, and still works as a script:
+``python tools/check_no_bare_except.py [paths...]``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Iterator, List, Tuple
 
-MARKER = "noqa: broad-except"
-DEFAULT_PATHS = ("paddle1_tpu", "tools", "bench.py", "benches.py")
-BROAD_NAMES = {"BaseException", "KeyboardInterrupt", "SystemExit",
-               "GeneratorExit"}
-# catching the preemption notice without re-raising is only sound in
-# the loop that OWNS preemption handling (checkpoint + resume); any
-# other absorption — a supervisor retry wrapper, a cleanup path — turns
-# "preempt the worker" into a silent hang or lost progress
-PREEMPTION_NAMES = {"SimulatedPreemption"}
-PREEMPTION_HANDLER_FILES = ("distributed/resilience.py",)
-# files whose producer/worker loops may catch BaseException WITHOUT a
-# marker IF the handler structurally forwards the exception object to
-# its consumer (assignment or queue put — see module docstring); the
-# consumer re-raises it, so the interrupt is delayed one queue hop, not
-# swallowed
-ERROR_FORWARDING_FILES = ("io/dataloader.py", "fluid/reader.py")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
+from tools.lint.bare_except import (  # noqa: E402 — path bootstrap first
+    BROAD_NAMES, DEFAULT_PATHS, ERROR_FORWARDING_FILES, MARKER,
+    PREEMPTION_HANDLER_FILES, PREEMPTION_NAMES, check_source,
+    iter_py_files, main)
 
-def _forwards_exception(handler: ast.ExceptHandler) -> bool:
-    """True iff the handler's body forwards the caught exception object
-    to a CONSUMER-VISIBLE sink: the bound name (``except ... as e``) is
-    assigned to an *attribute* (``self._err = e`` — re-raised on the
-    consumer's next ``next()``) or appears in the arguments of a
-    ``put``/``put_nowait`` call (shipped through a queue). A plain
-    local binding (``msg = f"ignoring {e}"``) does NOT count — that is
-    the decay-into-swallowing shape this check exists to reject; a
-    handler that re-binds ``e`` to a wrapper and then sinks the new
-    object still passes via the same two sinks."""
-    name = handler.name
-    if not name:
-        return False
-
-    def mentions(node: ast.AST) -> bool:
-        return any(isinstance(sub, ast.Name) and sub.id == name
-                   for sub in ast.walk(node))
-
-    for sub in ast.walk(handler):
-        if isinstance(sub, ast.Assign) and mentions(sub.value) and \
-                any(isinstance(t, ast.Attribute) for t in sub.targets):
-            return True
-        if isinstance(sub, ast.Call):
-            fn = sub.func
-            if isinstance(fn, ast.Attribute) and \
-                    fn.attr in ("put", "put_nowait") and \
-                    any(mentions(a) for a in sub.args):
-                return True
-    return False
-
-
-def _exception_names(node: ast.expr) -> Iterator[str]:
-    """Names caught by an except clause's type expression."""
-    if isinstance(node, ast.Tuple):
-        for elt in node.elts:
-            yield from _exception_names(elt)
-    elif isinstance(node, ast.Name):
-        yield node.id
-    elif isinstance(node, ast.Attribute):
-        yield node.attr
-
-
-def _contains_raise(handler: ast.ExceptHandler) -> bool:
-    for sub in ast.walk(handler):
-        if isinstance(sub, ast.Raise):
-            return True
-    return False
-
-
-def check_source(src: str, path: str = "<string>") -> List[Tuple[int, str]]:
-    """(line, message) findings for one file's source text."""
-    findings: List[Tuple[int, str]] = []
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-
-    def marked(lineno: int) -> bool:
-        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-        return MARKER in line
-
-    def marker_reason(lineno: int) -> str:
-        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-        _, _, tail = line.partition(MARKER)
-        return tail.strip()
-
-    norm_path = path.replace(os.sep, "/")
-    preemption_handler = any(norm_path.endswith(suffix)
-                             for suffix in PREEMPTION_HANDLER_FILES)
-    error_forwarder = any(norm_path.endswith(suffix)
-                          for suffix in ERROR_FORWARDING_FILES)
-
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        has_marker = marked(node.lineno)
-        if has_marker and not marker_reason(node.lineno):
-            findings.append((
-                node.lineno,
-                f"'# {MARKER}' without a reason — the marker documents "
-                f"WHY the broad catch is sound ('# {MARKER} — <reason>')"))
-        if node.type is None:
-            if not has_marker:
-                findings.append((
-                    node.lineno,
-                    "bare 'except:' swallows KeyboardInterrupt/"
-                    "SystemExit — catch Exception (or narrower)"))
-            continue
-        broad = [n for n in _exception_names(node.type)
-                 if n in BROAD_NAMES]
-        if broad and error_forwarder and _forwards_exception(node):
-            broad = []  # forwarded to the consumer, re-raised there
-        if broad and not _contains_raise(node) and not has_marker:
-            findings.append((
-                node.lineno,
-                f"'except {'/'.join(broad)}' without re-raise — a retry/"
-                "cleanup wrapper here can swallow interrupts; catch "
-                "Exception, re-raise, or justify with "
-                f"'# {MARKER} — <reason>'"))
-        preempt = [n for n in _exception_names(node.type)
-                   if n in PREEMPTION_NAMES]
-        if preempt and not _contains_raise(node) and not has_marker \
-                and not preemption_handler:
-            findings.append((
-                node.lineno,
-                f"'except {'/'.join(preempt)}' without re-raise outside "
-                "the designated preemption handler "
-                f"({', '.join(PREEMPTION_HANDLER_FILES)}) — a preemption "
-                "notice must unwind to the resilient loop (which "
-                "checkpoints), not die in a retry/cleanup wrapper"))
-    return findings
-
-
-def iter_py_files(paths) -> Iterator[str]:
-    for p in paths:
-        if os.path.isfile(p):
-            yield p
-        else:
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs if d != "__pycache__"]
-                for f in sorted(files):
-                    if f.endswith(".py"):
-                        yield os.path.join(root, f)
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = argv or [os.path.join(repo_root, p) for p in DEFAULT_PATHS]
-    total = 0
-    for path in iter_py_files(paths):
-        try:
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-        except OSError as e:
-            print(f"{path}:0: unreadable ({e})")
-            total += 1
-            continue
-        for lineno, msg in check_source(src, path):
-            print(f"{path}:{lineno}: {msg}")
-            total += 1
-    if total:
-        print(f"check_no_bare_except: {total} finding(s)", file=sys.stderr)
-        return 1
-    return 0
-
+__all__ = ["BROAD_NAMES", "DEFAULT_PATHS", "ERROR_FORWARDING_FILES",
+           "MARKER", "PREEMPTION_HANDLER_FILES", "PREEMPTION_NAMES",
+           "check_source", "iter_py_files", "main"]
 
 if __name__ == "__main__":
     sys.exit(main())

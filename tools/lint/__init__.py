@@ -1,0 +1,53 @@
+"""Unified static-analysis suite — ``python -m tools.lint`` (ISSUE 11).
+
+One framework (:mod:`tools.lint.framework`), four passes:
+
+* ``bare-except`` — no handler may swallow interrupts (PR 2, migrated);
+* ``metric-names`` — the Prometheus naming contract (PR 9, migrated);
+* ``lock-discipline`` — blocking calls under locks, ``# guarded-by:``
+  mutation discipline, nested-``with`` lock-order cycles (new);
+* ``flag-liveness`` — every ``define_flag`` needs a reader (new).
+
+See README "Static analysis" for the conventions
+(``# noqa: <rule> — reason``, ``# guarded-by: <lock>``) and
+``core/locks.py`` for the runtime lock-order sanitizer that covers what
+a lexical pass cannot.
+"""
+
+from __future__ import annotations
+
+from .bare_except import BareExceptPass
+from .flag_liveness import FlagLivenessPass
+from .framework import (DEFAULT_PATHS, Finding, LintPass, RunResult,
+                        iter_py_files, parse_noqa, repo_root, report,
+                        run_passes)
+from .lock_discipline import LockDisciplinePass
+from .metric_names import MetricNamesPass
+
+ALL_PASSES = (BareExceptPass, MetricNamesPass, LockDisciplinePass,
+              FlagLivenessPass)
+
+__all__ = ["ALL_PASSES", "BareExceptPass", "MetricNamesPass",
+           "LockDisciplinePass", "FlagLivenessPass", "Finding",
+           "LintPass", "RunResult", "run_passes", "report",
+           "repo_root", "iter_py_files", "parse_noqa", "DEFAULT_PATHS",
+           "make_passes", "run"]
+
+
+def make_passes(select=None):
+    """Instantiate the registered passes (all, or by ``name``)."""
+    classes = ALL_PASSES
+    if select:
+        wanted = {s.strip() for s in select if s and s.strip()}
+        classes = [c for c in ALL_PASSES if c.name in wanted]
+        unknown = wanted - {c.name for c in classes}
+        if unknown:
+            raise SystemExit(
+                f"unknown pass(es) {sorted(unknown)} — known: "
+                f"{[c.name for c in ALL_PASSES]}")
+    return [c() for c in classes]
+
+
+def run(paths=None, select=None, root=None) -> RunResult:
+    """Programmatic entry: run the (selected) passes, return findings."""
+    return run_passes(make_passes(select), paths=paths, root=root)

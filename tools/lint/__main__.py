@@ -1,0 +1,45 @@
+"""CLI for the unified lint suite: ``python -m tools.lint [--all]``.
+
+Exit 0 clean, 1 with findings (one ``path:line: [rule] message`` per
+finding). ``--all`` (also the default with no arguments) runs every
+registered pass over the runtime packages; ``--select`` picks passes;
+positional paths narrow the walk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import ALL_PASSES, make_passes, report, run_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="unified static-analysis suite (see tools/lint/)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every pass (the default when no --select "
+                         "is given)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated pass names, e.g. "
+                         "--select lock-discipline,flag-liveness")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to walk (default: the runtime "
+                         "packages)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for c in ALL_PASSES:
+            print(f"{c.name}: rules {', '.join(c.rules)}")
+        return 0
+    select = ([s for s in args.select.split(",") if s]
+              if args.select and not args.all else None)
+    passes = make_passes(select)
+    result = run_passes(passes, paths=args.paths or None)
+    return report(result)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

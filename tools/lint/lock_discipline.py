@@ -1,0 +1,366 @@
+"""Lint pass: lock discipline for the threaded runtime (ISSUE 11).
+
+Three rules over every ``with <lock>:`` region (a context expression
+whose final name component is ``lock``/``rlock``/``mutex``/``cond`` —
+``self._lock``, ``client.cond``, ``_table_lock``, ``self._queue_cond``
+all match):
+
+* **lock-blocking** — a blocking call lexically inside a lock region:
+  ``time.sleep``, a queue's ``get``/``put`` (receiver named like a
+  queue: ``q``/``_q``/``*_q``/``*queue*``), socket ``sendall``/
+  ``recv``/``accept``/``connect``, the serving wire helpers
+  ``send_msg``/``recv_msg``, zero-positional-arg ``.join()`` (thread
+  join; ``", ".join(xs)`` has an argument and is exempt), future
+  ``.result()``, and ``subprocess.run``/``check_call``/
+  ``check_output``/``communicate``. Holding a lock across any of these
+  convoys every other thread that needs it against a sleep, a kernel
+  buffer, or a wedged executable — the ``_on_transport_loss``
+  sendall-under-lock class PR 7's review rounds hand-found.
+  Intentional sites (e.g. a per-connection send lock whose entire job
+  is serializing ``sendall``) carry ``# noqa: lock-blocking — reason``.
+  ``cond.wait()`` is deliberately NOT in the list: a Condition wait
+  releases its lock.
+
+* **guarded-mutation** — the ``# guarded-by:`` convention. Declaring an
+  attribute in ``__init__`` with a trailing comment::
+
+      self._clients = {}   # guarded-by: self._lock
+
+  makes every later mutation of ``self._clients`` (assignment,
+  augmented assignment, subscript store/delete, or a mutator method
+  call — ``append``/``pop``/``clear``/``update``/...) outside a ``with
+  self._lock:`` region an error, in every method of that class
+  (``__init__`` itself is exempt: construction happens-before
+  publication). A ``threading.Condition(self._lock)`` attribute is
+  recognized as an alias — holding ``self._queue_cond`` IS holding
+  ``self._lock``. Several guards may be listed comma-separated; any
+  one of them satisfies the check.
+
+* **lock-order** — the per-class nested-``with`` acquisition graph:
+  ``with a:`` containing ``with b:`` records the edge a→b, across all
+  methods of the class (module-level regions graph per module). A
+  cycle is a lock-order inversion — the deadlock the runtime sanitizer
+  (``core/locks.py``) catches dynamically, reported here before the
+  code ever runs.
+
+The pass is lexical (no interprocedural analysis): a blocking call
+hidden behind a helper function is the runtime sanitizer's job; this
+pass keeps the obvious shapes out of review. Nested ``def``/``lambda``
+bodies drop the held-lock stack — a closure defined under a lock does
+not *execute* under it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, LintPass
+
+# final identifier component that makes a `with` expression a lock
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|rlock|mutex|cond)$")
+# receiver identifier segments that make .get/.put a QUEUE operation
+_QUEUE_SEGMENTS = {"q", "queue", "queues"}
+
+_BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "accept", "connect",
+                   "result", "communicate", "send_msg", "recv_msg"}
+_SUBPROCESS_FNS = {"run", "check_call", "check_output", "call"}
+_MUTATOR_METHODS = {"append", "appendleft", "extend", "insert", "pop",
+                    "popleft", "popitem", "remove", "discard", "clear",
+                    "update", "setdefault", "add"}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([^#]+?)\s*$")
+
+
+def _name_tail(node: ast.expr) -> Optional[str]:
+    """Final identifier component of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _expr_text(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all exprs
+        return "<?>"
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    tail = _name_tail(node)
+    return bool(tail and _LOCK_NAME_RE.search(tail))
+
+
+def _is_queue_name(node: ast.expr) -> bool:
+    tail = _name_tail(node)
+    if not tail:
+        return False
+    return any(seg in _QUEUE_SEGMENTS
+               for seg in tail.lower().split("_") if seg)
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    """Why this call is blocking, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "sleep":
+            return "sleep()"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    attr = fn.attr
+    if attr == "sleep":
+        return f"{_expr_text(fn)}()"
+    if attr == "join" and not node.args:
+        return ".join() (thread/process join)"
+    if attr in ("get", "put") and _is_queue_name(fn.value):
+        return (f"queue .{attr}() (use the _nowait variant or move it "
+                "outside the lock)")
+    if attr in _BLOCKING_ATTRS:
+        return f".{attr}()"
+    if attr in _SUBPROCESS_FNS and _name_tail(fn.value) == "subprocess":
+        return f"subprocess.{attr}()"
+    return None
+
+
+class _ClassInfo:
+    """Per-class lock state: guard declarations, Condition aliases, and
+    the acquisition-order graph."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        # attr -> (guard lock texts, declaration line)
+        self.guards: Dict[str, Tuple[Tuple[str, ...], int]] = {}
+        # "self._queue_cond" -> "self._lock" (Condition wraps it)
+        self.aliases: Dict[str, str] = {}
+        # lock text -> {inner lock text -> first edge line}
+        self.order: Dict[str, Dict[str, int]] = {}
+
+    def canon(self, lock_text: str) -> str:
+        seen: Set[str] = set()
+        while lock_text in self.aliases and lock_text not in seen:
+            seen.add(lock_text)
+            lock_text = self.aliases[lock_text]
+        return lock_text
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    rules = ("lock-blocking", "guarded-mutation", "lock-order")
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        lines = src.splitlines()
+        findings: List[Finding] = []
+        module_info = _ClassInfo("<module>", path)
+        infos = [module_info]
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(node.name, path)
+                infos.append(info)
+                self._collect_guards(node, lines, info)
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._walk(meth, [], info, findings,
+                                   in_init=(meth.name == "__init__"))
+            else:
+                self._walk(node, [], module_info, findings,
+                           in_init=False)
+        for info in infos:
+            findings.extend(self._order_findings(info))
+        return findings
+
+    # -- guard declarations --------------------------------------------------
+
+    def _collect_guards(self, cls: ast.ClassDef, lines: List[str],
+                        info: _ClassInfo) -> None:
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            attr_targets = [
+                t for t in targets
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"]
+            if not attr_targets:
+                continue
+            value = node.value
+            # alias: self.X = threading.Condition(self.Y)
+            if value is not None and isinstance(value, ast.Call) \
+                    and _name_tail(value.func) == "Condition" \
+                    and value.args:
+                inner = _expr_text(value.args[0])
+                for t in attr_targets:
+                    info.aliases[f"self.{t.attr}"] = inner
+            line = (lines[node.lineno - 1]
+                    if 0 < node.lineno <= len(lines) else "")
+            m = _GUARDED_BY_RE.search(line)
+            if m:
+                # anything after an em/en dash is prose, not a guard
+                spec = re.split(r"\s*[—–]", m.group(1), maxsplit=1)[0]
+                guards = tuple(g.strip() for g in
+                               spec.split(",") if g.strip())
+                for t in attr_targets:
+                    info.guards[t.attr] = (guards, node.lineno)
+
+    # -- the walk ------------------------------------------------------------
+
+    def _walk(self, node: ast.AST, held: List[Tuple[str, int]],
+              info: _ClassInfo, findings: List[Finding],
+              in_init: bool) -> None:
+        """Recursive descent carrying the lexically-held lock stack.
+        ``held`` entries are (canonical lock text, with-line)."""
+        if isinstance(node, ast.With):
+            for item in node.items:
+                # the context expressions evaluate BEFORE acquisition
+                self._walk(item.context_expr, held, info, findings,
+                           in_init)
+            pushed = 0
+            for item in node.items:
+                ctx = item.context_expr
+                if _is_lock_expr(ctx):
+                    lock = info.canon(_expr_text(ctx))
+                    if held and held[-1][0] != lock:
+                        info.order.setdefault(held[-1][0], {}) \
+                            .setdefault(lock, node.lineno)
+                    held.append((lock, node.lineno))
+                    pushed += 1
+            for child in node.body:
+                self._walk(child, held, info, findings, in_init)
+            for _ in range(pushed):
+                held.pop()
+            return
+
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def does not EXECUTE under the enclosing with
+            for child in node.body:
+                self._walk(child, [], info, findings, in_init)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, [], info, findings, in_init)
+            return
+
+        if isinstance(node, ast.Call) and held:
+            why = _blocking_call(node)
+            if why is not None:
+                lock = held[-1][0]
+                findings.append(Finding(
+                    info.path, node.lineno, "lock-blocking",
+                    f"blocking call {why} while holding {lock} "
+                    f"(class {info.name}) — every thread needing the "
+                    "lock convoys behind it; move the call outside "
+                    "the region or justify with '# noqa: "
+                    "lock-blocking — reason'"))
+
+        if not in_init:
+            self._check_mutation(node, held, info, findings)
+
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, info, findings, in_init)
+
+    def _check_mutation(self, node: ast.AST,
+                        held: List[Tuple[str, int]], info: _ClassInfo,
+                        findings: List[Finding]) -> None:
+        """guarded-mutation: writes to declared attrs outside their
+        lock."""
+        if not info.guards:
+            return
+        mutated: List[Tuple[str, int]] = []
+
+        def self_attr(expr: ast.expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                return expr.attr
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    a = self_attr(e)
+                    if a is not None:
+                        mutated.append((a, e.lineno))
+                    elif isinstance(e, ast.Subscript):
+                        a = self_attr(e.value)
+                        if a is not None:
+                            mutated.append((a, e.lineno))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    a = self_attr(t.value)
+                    if a is not None:
+                        mutated.append((a, t.lineno))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in _MUTATOR_METHODS:
+                a = self_attr(fn.value)
+                if a is not None:
+                    mutated.append((a, node.lineno))
+
+        held_locks = {lock for lock, _ in held}
+        for attr, lineno in mutated:
+            decl = info.guards.get(attr)
+            if decl is None:
+                continue
+            guards, decl_line = decl
+            canon_guards = {info.canon(g) for g in guards}
+            if held_locks & canon_guards:
+                continue
+            findings.append(Finding(
+                info.path, lineno, "guarded-mutation",
+                f"self.{attr} is declared '# guarded-by: "
+                f"{', '.join(guards)}' (line {decl_line}) but is "
+                "mutated here "
+                + (f"under {sorted(held_locks)} "
+                   if held_locks else "with no lock held ")
+                + f"(class {info.name}) — wrap the mutation in the "
+                  "declared lock or justify with '# noqa: "
+                  "guarded-mutation — reason'"))
+
+    # -- lock-order ----------------------------------------------------------
+
+    def _order_findings(self, info: _ClassInfo) -> List[Finding]:
+        """DFS the acquisition graph for cycles."""
+        out: List[Finding] = []
+        graph = info.order
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        reported: Set[Tuple[str, str]] = set()
+
+        def dfs(n: str, stack: List[str]) -> None:
+            color[n] = GRAY
+            stack.append(n)
+            for m, line in sorted(graph.get(n, {}).items()):
+                if color.get(m, WHITE) == GRAY:
+                    cyc = stack[stack.index(m):] + [m]
+                    key = (min(cyc), max(cyc))
+                    if key not in reported:
+                        reported.add(key)
+                        out.append(Finding(
+                            info.path, line, "lock-order",
+                            "lock-order inversion in "
+                            f"{info.name}: acquisition cycle "
+                            + " -> ".join(cyc)
+                            + " — two threads taking these locks in "
+                              "opposite orders deadlock; pick one "
+                              "global order"))
+                elif color.get(m, WHITE) == WHITE:
+                    dfs(m, stack)
+            stack.pop()
+            color[n] = BLACK
+
+        for n in sorted(graph):
+            if color.get(n, WHITE) == WHITE:
+                dfs(n, [])
+        return out

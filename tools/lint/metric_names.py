@@ -1,0 +1,165 @@
+"""Lint pass: the metric-name contract (ISSUE 10 satellite).
+
+Migrated from ``tools/check_metric_names.py`` into the unified
+framework — the standalone script is now a thin shim over this module.
+
+AST-collects string-literal metric names at ``.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` call sites across
+``paddle1_tpu/`` (plus ``bench.py``/``bench_utils.py``) and enforces
+what the Prometheus exposition (and the conformance test) depend on:
+
+* **snake_case** — ``[a-z][a-z0-9_]*``;
+* **counters end ``_total``** (the ``rate()`` convention), gauges and
+  histograms must NOT;
+* **histograms carry a unit suffix** — ``_seconds``/``_ms``/``_us``/
+  ``_s``/``_per_s`` (or a known unitless family);
+* **one family, one kind** across every module (the registry enforces
+  it per instance at runtime; the lint catches cross-module collisions
+  before they meet in one registry).
+
+Dynamic names (f-strings) are invisible to the lint — keep them on the
+same conventions by hand (the registry's kind guard still covers them
+at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from .framework import Finding, LintPass
+
+METHODS = ("counter", "gauge", "histogram")
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+HIST_UNIT_SUFFIXES = ("_seconds", "_ms", "_us", "_s", "_per_s")
+# unitless histogram families that are ratios/fractions by nature
+HIST_UNITLESS_OK = {"batch_occupancy"}
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def target_files(root: str) -> Iterable[str]:
+    pkg = os.path.join(root, "paddle1_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+    for fn in ("bench.py", "bench_utils.py"):
+        p = os.path.join(root, fn)
+        if os.path.exists(p):
+            yield p
+
+
+def collect(path: str):
+    """Yield (kind, name, lineno) for every literal metric touch."""
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        return
+    yield from collect_tree(tree)
+
+
+def collect_tree(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in METHODS):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield fn.attr, arg.value, node.lineno
+
+
+def _site_problems(kind: str, name: str) -> List[str]:
+    """Rule messages for one (kind, name) touch — shared by the legacy
+    string surface and the framework pass, so wording never drifts."""
+    out = []
+    if not NAME_RE.match(name):
+        out.append(f"{kind} name {name!r} is not snake_case")
+    if kind == "counter" and not name.endswith("_total"):
+        out.append(f"counter {name!r} must end in '_total'")
+    if kind in ("gauge", "histogram") and name.endswith("_total"):
+        out.append(f"{kind} {name!r} must NOT end in "
+                   "'_total' (that suffix promises a counter)")
+    if kind == "histogram" \
+            and not name.endswith(HIST_UNIT_SUFFIXES) \
+            and name not in HIST_UNITLESS_OK:
+        out.append(f"histogram {name!r} needs a unit suffix "
+                   f"{HIST_UNIT_SUFFIXES} (or add it to the unitless "
+                   "allowlist if it is a ratio)")
+    return out
+
+
+def check(files) -> list:
+    """Legacy string-report surface (kept for the shim + tests)."""
+    problems = []
+    kinds_by_name: Dict[str, Dict[str, str]] = {}
+    root = repo_root()
+    for path in files:
+        rel = os.path.relpath(path, root)
+        for kind, name, lineno in collect(path):
+            where = f"{rel}:{lineno}"
+            for msg in _site_problems(kind, name):
+                problems.append(f"{where}: {msg}")
+            kinds_by_name.setdefault(name, {})[kind] = where
+    for name, kinds in sorted(kinds_by_name.items()):
+        if len(kinds) > 1:
+            sites = ", ".join(f"{k} at {w}" for k, w in sorted(
+                kinds.items()))
+            problems.append(
+                f"metric family {name!r} registered as multiple kinds: "
+                f"{sites} — one family, one kind")
+    return problems
+
+
+class MetricNamesPass(LintPass):
+    name = "metric-names"
+    rules = ("metric-name",)
+    roots = ("paddle1_tpu", "bench.py", "bench_utils.py")
+
+    def begin(self) -> None:
+        # name -> kind -> (path, line): cross-file kind-conflict state
+        self._kinds: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    def check_file(self, path, rel, src, tree):
+        for kind, name, lineno in collect_tree(tree):
+            for msg in _site_problems(kind, name):
+                yield Finding(path, lineno, "metric-name", msg)
+            self._kinds.setdefault(name, {})[kind] = (path, lineno)
+
+    def finish(self):
+        for name, kinds in sorted(self._kinds.items()):
+            if len(kinds) > 1:
+                sites = ", ".join(
+                    f"{k} at {os.path.basename(p)}:{ln}"
+                    for k, (p, ln) in sorted(kinds.items()))
+                first = sorted(kinds.values())[0]
+                yield Finding(
+                    first[0], first[1], "metric-name",
+                    f"metric family {name!r} registered as multiple "
+                    f"kinds: {sites} — one family, one kind")
+
+
+def main(argv=None) -> int:
+    """Standalone entry (kept for the shim + existing tests)."""
+    root = repo_root()
+    problems = check(sorted(target_files(root)))
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} metric-name problem(s) "
+              "(see tools/lint/metric_names.py header for the rules)")
+        return 1
+    print("metric names OK")
+    return 0
