@@ -59,6 +59,12 @@ def _count_readback() -> None:
     global _readbacks
     with _lock:
         _readbacks += 1
+    # the jit sanitizer's host-sync accounting (ISSUE 12): one module
+    # bool test when the sanitizer never armed — attribution to the
+    # engine step loop (or whatever hot_section the thread is in)
+    # makes "this loop pays one readback per chunk" assertable
+    from . import jit_sanitizer
+    jit_sanitizer.note_host_sync("loss_readback")
 
 
 class LossFuture:

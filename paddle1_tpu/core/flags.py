@@ -203,6 +203,22 @@ def _define_builtin_flags() -> None:
                 "is structurally free: make_lock returns a plain "
                 "threading.Lock. Enabled for the CI concurrency "
                 "lanes.")
+    define_flag("debug_jit_sanitizer", False,
+                "Runtime JIT-discipline sanitizer (core/jit_sanitizer"
+                ".py): engine/serving/generate jit entry points raise "
+                "typed RetraceStormError when one site compiles more "
+                "distinct signatures than its limit (the "
+                "jit_retrace_warn warn-once upgraded to enforceable), "
+                "donated buffers are poisoned (deleted) after every "
+                "donating dispatch so use-after-donate fails "
+                "deterministically with typed UseAfterDonateError "
+                "naming the donation site — on CPU donation silently "
+                "no-ops, which is how the PR 1 aliasing bug passed "
+                "tests — and host-sync events (loss readbacks, decode "
+                "token fetches) are counted per hot section. Off (the "
+                "default) is structurally free: site() returns None "
+                "and wrap_donating() returns the function unchanged. "
+                "Enabled for the CI debug-sanitizers lane.")
     # Eager engine
     define_flag("eager_max_tape_len", 1_000_000,
                 "Safety valve on the autograd graph: an eager "

@@ -1,0 +1,314 @@
+"""Runtime JIT-discipline sanitizer (ISSUE 12).
+
+The static passes (``tools/lint/donation_safety.py``,
+``retrace_hazard.py``, ``host_sync.py``) see lexical shapes; they
+cannot see a donated buffer smuggled through a helper return, a
+retrace storm driven by runtime shapes, or a readback three frames
+down a hot loop. This module covers those dynamically, the
+``core/locks.py`` way: one flag (``debug_jit_sanitizer``), structurally
+zero cost off, typed errors on.
+
+* **Retrace-storm enforcement** — the engines count distinct dispatch
+  signatures (the ``jit_retrace_warn`` warn-once guard). Under the
+  sanitizer, a site whose signature count exceeds its limit raises the
+  typed :class:`RetraceStormError` instead of warning once and letting
+  the host loop serialize behind the compiler — the warn upgraded to
+  an enforceable invariant for the CI sanitizer lane.
+
+* **Donated-buffer poisoning** — after a donating dispatch,
+  :meth:`JitSite.poison_donated` records each donated ``jax.Array``
+  and ``.delete()``-s it. On CPU (the test backend) donation silently
+  no-ops — input and output are separate buffers — which is exactly
+  why the PR 1 donation-aliasing bug passed every test: the poisoned
+  delete makes ANY later use fail deterministically on every backend.
+  A use reaching a guarded entry point (:meth:`JitSite.guard_args`)
+  raises the typed :class:`UseAfterDonateError` *naming the donation
+  site*; a use anywhere else fails with jax's own deleted-buffer
+  error — loud either way, never silent corruption.
+
+* **Host-sync counting** — :func:`note_host_sync` marks a real
+  device→host readback (the ``async_loss`` materialization, the decode
+  loop's token fetch — the ``note_blocking`` pattern retargeted).
+  Under the sanitizer each event is counted, attributed to the
+  innermost :func:`hot_section` the thread is in (the engine step
+  loop, the batcher dispatch, the decode loop mark themselves). Tests
+  assert sync *budgets* — "this loop pays exactly one readback per
+  chunk" — instead of eyeballing profiles. Free when never armed: one
+  module bool test.
+
+Off (the default) is structurally free: :func:`site` returns ``None``
+(engines hold a ``None`` attribute and skip one ``is not None`` test
+per dispatch), :func:`wrap_donating` returns the function object
+unchanged, and :func:`hot_section` hands back a shared no-op context
+manager.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .errors import EnforceNotMet
+
+__all__ = ["RetraceStormError", "UseAfterDonateError", "RETRACE_LIMIT",
+           "sanitizing", "site", "JitSite", "wrap_donating",
+           "hot_section", "note_host_sync", "host_sync_events",
+           "host_sync_count", "reset"]
+
+
+class RetraceStormError(EnforceNotMet):
+    """One jit entry point compiled more distinct signatures than its
+    limit — the silent host-loop serializer, made loud."""
+
+
+class UseAfterDonateError(EnforceNotMet):
+    """A buffer whose storage was donated to XLA re-entered a guarded
+    dispatch — the PR 1 embedding-deletion shape, caught typed."""
+
+
+# distinct signatures one site may compile before the storm is an
+# error (generous: shape buckets are bounded by design — a site
+# legitimately needing more passes an explicit limit to site())
+RETRACE_LIMIT = 8
+
+# flipped True the first time a site/hot_section arms — the only cost
+# note_host_sync() pays in a process that never enabled the flag
+_armed = False
+
+_lock = threading.Lock()
+# id(donated jax.Array) -> site name; consulted ONLY for arrays whose
+# .is_deleted() is True (ids recycle after GC — deletion is the
+# poison, the registry merely names the donation site in the error)
+_donated: Dict[int, str] = {}
+# (section, what) -> count of host-sync events
+_sync_events: Dict[Tuple[str, str], int] = {}
+
+_tls = threading.local()
+
+
+def sanitizing() -> bool:
+    """Whether the ``debug_jit_sanitizer`` flag is on (read per
+    construction — hot paths hold the site object, not the flag)."""
+    from . import flags as core_flags
+    return bool(core_flags.flag("debug_jit_sanitizer"))
+
+
+def reset() -> None:
+    """Drop donated-buffer records and sync counters, and re-derive the
+    armed latch from the CURRENT flag (test isolation: an armed test
+    must not leave flag-off code counting — or paying the counter lock
+    — for the rest of the process)."""
+    global _armed
+    with _lock:
+        _donated.clear()
+        _sync_events.clear()
+    _armed = sanitizing()
+
+
+class JitSite:
+    """Per-entry-point sanitizer handle (engine step, decode, prefill).
+    Constructed only when the flag is on — see :func:`site`."""
+
+    __slots__ = ("name", "retrace_limit")
+
+    def __init__(self, name: str, retrace_limit: int = RETRACE_LIMIT):
+        self.name = name
+        self.retrace_limit = int(retrace_limit)
+
+    # -- retrace storms -----------------------------------------------------
+
+    def note_signatures(self, n: int, kind: str = "",
+                        limit: Optional[int] = None) -> None:
+        """Record that this site has now seen ``n`` distinct dispatch
+        signatures; raises typed when past the limit."""
+        lim = self.retrace_limit if limit is None else int(limit)
+        if n > lim:
+            raise RetraceStormError(
+                f"retrace storm at {self.name}"
+                + (f" ({kind})" if kind else "")
+                + f": {n} distinct jit signatures compiled (limit "
+                f"{lim}) — every one is a full XLA compile silently "
+                "re-serializing the host loop. Pad or bucket the "
+                "varying dimension to a fixed set of shapes "
+                "(serve_buckets / serve_gen_prefill_buckets are the "
+                "serving knobs; pad batches for training). "
+                "debug_jit_sanitizer upgraded the jit_retrace_warn "
+                "warn-once to this error.")
+
+    # -- donation poisoning -------------------------------------------------
+
+    def guard_args(self, leaves: Iterable[Any],
+                   what: str = "") -> None:
+        """Raise typed if any argument leaf was poisoned by an earlier
+        donating dispatch (the use-after-donate entry check)."""
+        for leaf in leaves:
+            is_deleted = getattr(leaf, "is_deleted", None)
+            if is_deleted is None:
+                continue
+            try:
+                dead = bool(is_deleted())
+            except TypeError:  # pragma: no cover - exotic array type
+                continue
+            if dead:
+                origin = _donated.get(id(leaf))
+                raise UseAfterDonateError(
+                    f"use-after-donate entering {self.name}"
+                    + (f" ({what})" if what else "") + ": an argument "
+                    "buffer was donated "
+                    + (f"by {origin} " if origin else "")
+                    + "in an earlier dispatch — its storage belongs "
+                    "to XLA now (on CPU the donation silently no-ops, "
+                    "which is how the PR 1 aliasing bug passed every "
+                    "test). Rebind the variable from the dispatch "
+                    "result, or copy before donating "
+                    "(jnp.array(v, copy=True)).")
+
+    def poison_donated(self, leaves: Iterable[Any]) -> None:
+        """After a donating dispatch: delete each donated array so any
+        later use fails deterministically (on TPU jax already deleted
+        them — the delete is idempotent; on CPU, where donation
+        no-ops, this closes the silent-corruption window)."""
+        dead: List[Any] = []
+        for leaf in leaves:
+            if hasattr(leaf, "is_deleted") and hasattr(leaf, "delete"):
+                dead.append(leaf)
+        with _lock:
+            for leaf in dead:
+                _donated[id(leaf)] = self.name
+                # keep the registry bounded: ids recycle anyway, the
+                # names are best-effort forensics
+                if len(_donated) > 4096:
+                    _donated.clear()
+                    _donated[id(leaf)] = self.name
+        for leaf in dead:
+            try:
+                leaf.delete()
+            except Exception:  # pragma: no cover - never break dispatch
+                pass
+
+
+def site(name: str,
+         retrace_limit: int = RETRACE_LIMIT) -> Optional[JitSite]:
+    """A :class:`JitSite` when ``debug_jit_sanitizer`` is on, else
+    ``None`` — callers keep the result and gate on ``is not None``
+    (one pointer test per dispatch; nothing off the flag path)."""
+    global _armed
+    if not sanitizing():
+        return None
+    _armed = True
+    return JitSite(name, retrace_limit)
+
+
+def wrap_donating(fn, donate_argnums: Tuple[int, ...], name: str,
+                  retrace_limit: int = RETRACE_LIMIT):
+    """Wrap a donating jit callable with the guard/poison pair. OFF:
+    returns ``fn`` itself (the pass-through the zero-cost test pins).
+    ON: every call checks all argument leaves for poisoned buffers,
+    dispatches, then poisons the donated ones."""
+    s = site(name, retrace_limit)
+    if s is None:
+        return fn
+
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        import jax
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        s.guard_args(leaves, "wrapped call")
+        donated = [leaf for i in donate_argnums if i < len(args)
+                   for leaf in jax.tree_util.tree_leaves(args[i])]
+        out = fn(*args, **kwargs)
+        s.poison_donated(donated)
+        return out
+
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
+# -- hot sections + host-sync counting ---------------------------------------
+
+
+class _NullSection:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSection()
+
+
+def _sections() -> List[str]:
+    s = getattr(_tls, "sections", None)
+    if s is None:
+        s = _tls.sections = []
+    return s
+
+
+class _HotSection:
+    __slots__ = ("name", "_owner")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._owner: Optional[List[str]] = None
+
+    def __enter__(self):
+        # remember the OWNING thread's list: a generator-held section
+        # (step_stream) can be finalized by another thread (GC), and
+        # the marker must come off the list it went onto — not the
+        # finalizer's, and never leak on the owner's
+        self._owner = _sections()
+        self._owner.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        s = self._owner if self._owner is not None else _sections()
+        self._owner = None
+        for i in range(len(s) - 1, -1, -1):
+            if s[i] == self.name:
+                del s[i]
+                break
+        return False
+
+
+def hot_section(name: str):
+    """Mark a latency-budgeted region (the runtime half of the lint
+    pass's ``# hot-path`` marker). Shared no-op when the flag is off;
+    on, host-sync events on this thread attribute to the innermost
+    section."""
+    global _armed
+    if not sanitizing():
+        return _NULL
+    _armed = True
+    return _HotSection(name)
+
+
+def note_host_sync(what: str) -> None:
+    """Mark one real device→host readback (async_loss materialization,
+    decode token fetch). Counted under the sanitizer, attributed to the
+    innermost hot section ('' outside one). Free when never armed: one
+    module bool test."""
+    if not _armed:
+        return
+    s = _sections()
+    section = s[-1] if s else ""
+    with _lock:
+        key = (section, what)
+        _sync_events[key] = _sync_events.get(key, 0) + 1
+
+
+def host_sync_events() -> Dict[Tuple[str, str], int]:
+    """Copy of the (section, what) -> count map (test hook)."""
+    with _lock:
+        return dict(_sync_events)
+
+
+def host_sync_count(section: Optional[str] = None) -> int:
+    """Total counted sync events, optionally for one section."""
+    with _lock:
+        return sum(n for (sec, _), n in _sync_events.items()
+                   if section is None or sec == section)

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import flags as core_flags
 from ..core import async_loss
+from ..core import jit_sanitizer
 from ..core.async_loss import LossFuture, StepFuture
 from ..obs import trace as obs_trace
 from ..core.generator import next_key, rng_scope
@@ -464,6 +465,9 @@ class ParallelEngine:
         self.trace_count = 0
         self._seen_sigs: Dict[str, set] = {}
         self._retrace_warned = False
+        # None when debug_jit_sanitizer is off: the hot path pays one
+        # pointer test per dispatch, nothing else (core/locks.py idiom)
+        self._jsan = jit_sanitizer.site("ParallelEngine")
 
         def counted_step(params, opt_state, batch, key, lr):
             self.trace_count += 1
@@ -500,13 +504,18 @@ class ParallelEngine:
                     pass  # exotic leaf: plain placement (donation of an
                     # alias is then possible — but nothing reached this
                     # in practice; numeric params always copy above)
-            return jax.device_put(v, sh)
+            # exotic-leaf fallback; numeric params always copy above
+            return jax.device_put(v, sh)  # noqa: donated-alias — see above
 
         self.params = {k: _owned(v, param_sh[k])
                        for k, v in self.params.items()}
-        slots = {k: {n: jax.device_put(a, slot_sh[0][k][n])
+        # slots/step0 come straight out of functional_init: freshly
+        # allocated, nothing else holds them — aliasing is impossible
+        slots = {k: {n: jax.device_put(  # noqa: donated-alias — fresh from functional_init
+            a, slot_sh[0][k][n])
                      for n, a in d.items()} for k, d in slots.items()}
-        self.opt_state = (slots, jax.device_put(step0, slot_sh[1]))
+        self.opt_state = (slots, jax.device_put(  # noqa: donated-alias — fresh from functional_init
+            step0, slot_sh[1]))
 
     # -- data placement -----------------------------------------------------
 
@@ -582,7 +591,7 @@ class ParallelEngine:
                 return jax.make_array_from_process_local_data(sh, a)
             # numpy single-host, or a jax.Array from a DIFFERENT mesh
             # (device_put reshards global arrays on either topology)
-            return jax.device_put(a, sh)
+            return jax.device_put(a, sh)  # noqa: donated-alias — batch leaves are never donated
         return jax.tree_util.tree_map(place, arrs)
 
     # -- training -----------------------------------------------------------
@@ -601,6 +610,10 @@ class ParallelEngine:
         sig = self._shape_sig(batch)
         if sig in seen:
             return
+        if self._jsan is not None:
+            # sanitizer lane: the warn-once below becomes enforceable —
+            # a site compiling past its signature limit raises typed
+            self._jsan.note_signatures(len(seen) + 1, kind=kind)
         if seen and not self._retrace_warned \
                 and core_flags.flag("jit_retrace_warn"):
             self._retrace_warned = True
@@ -636,7 +649,8 @@ class ParallelEngine:
             return int(shape[0]) * int(shape[1])
         return int(shape[0])
 
-    def step(self, batch, lr: Optional[float] = None) -> LossFuture:
+    def step(self, batch,  # hot-path: one dispatch per call
+             lr: Optional[float] = None) -> LossFuture:
         m = _obs_step_registry()
         if m is not None:
             _ensure_readback_observer()
@@ -649,10 +663,21 @@ class ParallelEngine:
             t1 = time.perf_counter() if m is not None else 0.0
             self._guard_retrace("step", batch)
             self.dispatch_count += 1
+            donated = None
+            if self._jsan is not None and self._donate:
+                donated = jax.tree_util.tree_leaves(
+                    (self.params, self.opt_state))
+                self._jsan.guard_args(donated, "step")
             with obs_trace.span("train/dispatch", cat="Engine"):
                 loss, self.params, self.opt_state = self._jit(
                     self.params, self.opt_state, batch, next_key(),
                     lr_val)
+            if donated is not None:
+                # the old params/opt_state buffers were donated: poison
+                # them so a use-after-donate (a stale alias anywhere)
+                # fails deterministically instead of silently reading
+                # XLA-owned storage on TPU while passing on CPU
+                self._jsan.poison_donated(donated)
         if m is not None:
             t2 = time.perf_counter()
             m.histogram("train_shard_seconds").observe(t1 - t0)
@@ -692,7 +717,7 @@ class ParallelEngine:
         self._jit_many_cache[k] = fn
         return fn
 
-    def step_many(self, batches: Sequence[Any],
+    def step_many(self, batches: Sequence[Any],  # hot-path: k steps, one dispatch
                   lr: Optional[float] = None) -> LossFuture:
         """Run ``len(batches)`` optimizer steps inside ONE jitted
         executable (``lax.scan`` over steps, composing with the
@@ -729,9 +754,16 @@ class ParallelEngine:
             lrs = jnp.asarray(lrs, jnp.float32)
             keys = jnp.stack([next_key() for _ in range(k)])
             self.dispatch_count += 1
+            donated = None
+            if self._jsan is not None and self._donate:
+                donated = jax.tree_util.tree_leaves(
+                    (self.params, self.opt_state))
+                self._jsan.guard_args(donated, "step_many")
             with obs_trace.span("train/dispatch", cat="Engine"):
                 losses, self.params, self.opt_state = self._jit_many(k)(
                     self.params, self.opt_state, stacked, keys, lrs)
+            if donated is not None:
+                self._jsan.poison_donated(donated)
         if m is not None:
             t2 = time.perf_counter()
             m.histogram("train_shard_seconds").observe(t1 - t0)
@@ -754,6 +786,11 @@ class ParallelEngine:
         scan. Yields one LossFuture per dispatch."""
         k = self.train_steps_per_sync
         it = iter(batches)
+        # hot-path: the engine step loop (syncs here stall dispatch)
+        with jit_sanitizer.hot_section("engine_step_loop"):
+            yield from self._step_stream(it, k, lr)
+
+    def _step_stream(self, it, k: int, lr: Optional[float]):  # hot-path
         while True:
             m = _obs_step_registry()
             t0 = time.perf_counter() if m is not None else 0.0

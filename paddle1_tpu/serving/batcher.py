@@ -38,6 +38,7 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core import jit_sanitizer
 from ..core.locks import note_blocking
 from .errors import DeadlineExceeded
 
@@ -62,6 +63,7 @@ class _BatchResult:
         with self._lock:
             if self._host is None:
                 t0 = time.monotonic()
+                jit_sanitizer.note_host_sync("batch_readback")
                 self._host = [np.asarray(o) for o in self._device]
                 if self._metrics is not None:
                     self._metrics.histogram("readback_ms").observe(
@@ -230,32 +232,16 @@ class Batcher(threading.Thread):
 
     # -- loop ---------------------------------------------------------------
 
-    def run(self) -> None:
-        carry: Optional[_Request] = None
+    def run(self) -> None:  # hot-path: the batcher dispatch loop
         # every request popped off the queue lives in ``_pending`` until
         # its future is resolved — the death handler below must be able
         # to fail IN-FLIGHT requests (mid-assembly, mid-dispatch, the
         # carried incompatible request), not just the ones still queued
         try:
-            while True:
-                core_health.beat()
-                req = carry
-                carry = None
-                if req is None:
-                    try:
-                        req = self.q.get(timeout=self._POLL_S)
-                    except queue.Empty:
-                        if self.drain.is_set():
-                            break
-                        continue
-                with self._pending_lock:
-                    self._pending.append(req)
-                batch, carry = self._assemble(req)
-                self._dispatch(batch)
-                with self._pending_lock:
-                    self._pending.clear()
-                    if carry is not None:
-                        self._pending.append(carry)
+            # hot section for the sanitizer's sync accounting: a
+            # readback on THIS thread would stall every queued request
+            with jit_sanitizer.hot_section("batcher_dispatch"):
+                self._run_loop()
         except BaseException as e:  # noqa: broad-except — the batcher
             # thread must record ANY death (incl. interrupts) and fail
             # queued AND in-flight futures loudly rather than leave
@@ -280,6 +266,28 @@ class Batcher(threading.Thread):
                 raise
         finally:
             self.drained.set()
+
+    def _run_loop(self) -> None:  # hot-path: the batcher dispatch loop
+        carry: Optional[_Request] = None
+        while True:
+            core_health.beat()
+            req = carry
+            carry = None
+            if req is None:
+                try:
+                    req = self.q.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    if self.drain.is_set():
+                        break
+                    continue
+            with self._pending_lock:
+                self._pending.append(req)
+            batch, carry = self._assemble(req)
+            self._dispatch(batch)
+            with self._pending_lock:
+                self._pending.clear()
+                if carry is not None:
+                    self._pending.append(carry)
 
     def fail_inflight(self, exc: BaseException) -> None:
         """Fail every popped-but-unresolved request (first-wins: no-op
@@ -324,7 +332,7 @@ class Batcher(threading.Thread):
             rows += nxt.rows
         return batch, None
 
-    def _dispatch(self, batch: List[_Request]) -> None:
+    def _dispatch(self, batch: List[_Request]) -> None:  # hot-path: pad + dispatch, NO readback
         m = self.metrics
         now = time.monotonic()
         live: List[_Request] = []

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core import flags as core_flags
+from ..core import jit_sanitizer
 from ..core.errors import InvalidArgumentError, UnimplementedError
 
 __all__ = ["InferenceEngine", "resolve_buckets"]
@@ -110,6 +111,13 @@ class InferenceEngine:
         self.dispatch_counts: Dict[int, int] = {}
         self._seen_inner_sigs: set = set()
         self._retrace_warned = False
+        # None when debug_jit_sanitizer is off (one pointer test per
+        # admission). The sanitizer bounds the INNER signature count
+        # only: batch-size variation is bucketed by design and all
+        # buckets SHARE one inner signature, so the bucket count never
+        # approaches the limit — what does is unpadded variable inner
+        # shapes, exactly the unbounded hazard buckets can't absorb
+        self._jsan = jit_sanitizer.site("InferenceEngine")
         self._lock = threading.Lock()
         self._pure, self._params, specs, fixed_batch = \
             self._build_pure(model)
@@ -256,6 +264,9 @@ class InferenceEngine:
     def _guard_retrace(self, sig) -> None:
         if sig in self._seen_inner_sigs:
             return
+        if self._jsan is not None:
+            self._jsan.note_signatures(len(self._seen_inner_sigs) + 1,
+                                       kind="inner signature")
         if self._seen_inner_sigs and not self._retrace_warned \
                 and core_flags.flag("jit_retrace_warn"):
             self._retrace_warned = True

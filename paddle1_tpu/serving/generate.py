@@ -73,6 +73,7 @@ import numpy as np
 from ..core import chaos as core_chaos
 from ..core import flags as core_flags
 from ..core import health as core_health
+from ..core import jit_sanitizer
 from ..core import locks
 from ..core.errors import InvalidArgumentError
 from .engine import resolve_buckets
@@ -381,6 +382,10 @@ class GenerationEngine:
         self._decode_jit = jax.jit(self._decode_fn,
                                    donate_argnums=(1,))
         self._prefill_jits: Dict[int, object] = {}
+        # None when debug_jit_sanitizer is off: decode's compile-once
+        # contract becomes enforceable (limit=1) and the donated KV
+        # cache is poisoned after every dispatch
+        self._jsan = jit_sanitizer.site("GenerationEngine")
 
     @staticmethod
     def _resolve_prefill_buckets(buckets, max_seq):
@@ -538,10 +543,16 @@ class GenerationEngine:
         with self._lock:
             self.prefill_dispatch_counts[bucket] = \
                 self.prefill_dispatch_counts.get(bucket, 0) + 1
+        donated = None
+        if self._jsan is not None:
+            donated = [a for pair in self._kv for a in pair]
+            self._jsan.guard_args(donated, "prefill")
         self._kv, first, carry = fn(
             self._params, self._kv, jnp.asarray(ids),
             np.int32(P), np.int32(slot), base,
             np.float32(temperature), np.int32(top_k))
+        if donated is not None:
+            self._jsan.poison_donated(donated)
         first = int(np.asarray(first))
         # slot bookkeeping (small host-side .at updates, off the jitted
         # path so they can't force a retrace)
@@ -552,18 +563,29 @@ class GenerationEngine:
         self._topks = self._topks.at[slot].set(np.int32(top_k))
         return first
 
-    def decode(self, active_mask: np.ndarray) -> np.ndarray:
+    def decode(self, active_mask: np.ndarray) -> np.ndarray:  # hot-path: one dispatch per token
         """One decode step for the whole slot batch; returns the [slots]
         next-token array (host). Exactly one device dispatch."""
         import jax.numpy as jnp
         with self._lock:
             self.decode_dispatch_count += 1
+        donated = None
+        if self._jsan is not None:
+            donated = [a for pair in self._kv for a in pair]
+            self._jsan.guard_args(donated, "decode")
         self._kv, self._lengths, self._tokens, self._keys = \
             self._decode_jit(self._params, self._kv, self._lengths,
                              self._tokens, self._keys, self._temps,
                              self._topks,
                              jnp.asarray(active_mask, bool))
-        return np.asarray(self._tokens)
+        if donated is not None:
+            self._jsan.poison_donated(donated)
+            # the compile-once contract, enforceable: a second decode
+            # compile means a signature leaked into the pinned shape
+            self._jsan.note_signatures(self.decode_compile_count,
+                                       kind="decode recompile", limit=1)
+        jit_sanitizer.note_host_sync("gen_token_readback")
+        return np.asarray(self._tokens)  # noqa: hidden-host-sync — the ONE intended readback
 
     def release(self, slot: int) -> None:
         """Free a slot: reset its cursor so idle writes stay parked at
@@ -979,65 +1001,14 @@ class _GenerationLoop(threading.Thread):
 
     # -- main loop ----------------------------------------------------------
 
-    def run(self) -> None:
+    def run(self) -> None:  # hot-path: the decode loop
         m = self.metrics
         slots = self.engine.slots
         try:
-            while True:
-                core_health.beat()
-                if self._abort_exc is not None:
-                    self._fail_inflight(self._abort_exc)
-                    self._fail_queued(self._abort_exc)
-                    break
-                self._sweep()
-                self._admit()
-                if not self._by_slot:
-                    m.gauge("slot_occupancy").set(0.0)
-                    if self.drain.is_set() and self.q.empty():
-                        break
-                    time.sleep(self._POLL_S)
-                    continue
-                wedged, slow = core_chaos.check_gen_step(
-                    list(self._by_slot))
-                if slow:
-                    time.sleep(float(
-                        core_flags.flag("serve_chaos_slow_s")))
-                if wedged is not None and wedged in self._by_slot:
-                    req = self._by_slot[wedged]
-                    self._finish(req, "error", SlotWedged(
-                        f"decode slot {wedged} wedged after "
-                        f"{req.n_generated} tokens (chaos "
-                        "gen_slot_wedge) — stream failed, slot "
-                        "released, cohabitants unaffected"))
-                if not self._by_slot:
-                    continue
-                active = np.zeros([slots], bool)
-                for slot, req in self._by_slot.items():
-                    active[slot] = req.stream._writable()
-                m.gauge("slot_occupancy").set(
-                    len(self._by_slot) / slots)
-                if not active.any():
-                    time.sleep(self._POLL_S)  # every stream is parked
-                    continue
-                t0 = time.monotonic()
-                toks = self.engine.decode(active)
-                dt = time.monotonic() - t0
-                m.histogram("decode_step_ms").observe(dt * 1e3)
-                from ..obs import trace as obs_trace
-                if obs_trace.sink_active():
-                    # decode spans tag slot occupancy: the trace view
-                    # shows continuous batching fill alongside timing
-                    obs_trace.record_span(
-                        "gen/decode_step", dt, cat="Serving",
-                        args={"slots_active": int(active.sum()),
-                              "occupancy": round(
-                                  len(self._by_slot) / slots, 4)})
-                for slot in list(self._by_slot):
-                    if not active[slot]:
-                        continue
-                    req = self._by_slot[slot]
-                    self._deliver(req, int(toks[slot]))
-                    self._maybe_complete(req, int(toks[slot]))
+            # hot section for the sanitizer's sync accounting: every
+            # readback on this thread attributes to the decode loop
+            with jit_sanitizer.hot_section("gen_decode_loop"):
+                self._run_loop(m, slots)
         except BaseException as e:  # noqa: broad-except — the loop
             # thread must record ANY death and resolve every stream
             # typed rather than leave clients blocked mid-iteration
@@ -1067,6 +1038,63 @@ class _GenerationLoop(threading.Thread):
             self._fail_queued(ServerClosed(
                 "generation server drained while the request was "
                 "being admitted"))
+
+    def _run_loop(self, m, slots: int) -> None:  # hot-path: decode loop
+        while True:
+            core_health.beat()
+            if self._abort_exc is not None:
+                self._fail_inflight(self._abort_exc)
+                self._fail_queued(self._abort_exc)
+                break
+            self._sweep()
+            self._admit()
+            if not self._by_slot:
+                m.gauge("slot_occupancy").set(0.0)
+                if self.drain.is_set() and self.q.empty():
+                    break
+                time.sleep(self._POLL_S)
+                continue
+            wedged, slow = core_chaos.check_gen_step(
+                list(self._by_slot))
+            if slow:
+                time.sleep(float(
+                    core_flags.flag("serve_chaos_slow_s")))
+            if wedged is not None and wedged in self._by_slot:
+                req = self._by_slot[wedged]
+                self._finish(req, "error", SlotWedged(
+                    f"decode slot {wedged} wedged after "
+                    f"{req.n_generated} tokens (chaos "
+                    "gen_slot_wedge) — stream failed, slot "
+                    "released, cohabitants unaffected"))
+            if not self._by_slot:
+                continue
+            active = np.zeros([slots], bool)
+            for slot, req in self._by_slot.items():
+                active[slot] = req.stream._writable()
+            m.gauge("slot_occupancy").set(
+                len(self._by_slot) / slots)
+            if not active.any():
+                time.sleep(self._POLL_S)  # every stream is parked
+                continue
+            t0 = time.monotonic()
+            toks = self.engine.decode(active)
+            dt = time.monotonic() - t0
+            m.histogram("decode_step_ms").observe(dt * 1e3)
+            from ..obs import trace as obs_trace
+            if obs_trace.sink_active():
+                # decode spans tag slot occupancy: the trace view
+                # shows continuous batching fill alongside timing
+                obs_trace.record_span(
+                    "gen/decode_step", dt, cat="Serving",
+                    args={"slots_active": int(active.sum()),
+                          "occupancy": round(
+                              len(self._by_slot) / slots, 4)})
+            for slot in list(self._by_slot):
+                if not active[slot]:
+                    continue
+                req = self._by_slot[slot]
+                self._deliver(req, int(toks[slot]))
+                self._maybe_complete(req, int(toks[slot]))
 
 
 # kept for parity tests/bench: eagerly decode ONE sequence with the

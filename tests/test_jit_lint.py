@@ -1,0 +1,592 @@
+"""JIT-discipline suite (ISSUE 12): the three static passes
+(donation-safety, retrace-hazard, host-sync) with seeded violation
+matrices hitting exact lines per rule, the runtime jit sanitizer
+(structural zero cost off; typed use-after-donate, retrace-storm and
+host-sync accounting on), the PR 1 donation-aliasing regression made
+deterministic, and the CLI satellites (--select teaching error,
+--budget-s timing gate, same-PR flag liveness)."""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import lint as tl  # noqa: E402 — path bootstrap first
+from tools.lint import UnknownPassError  # noqa: E402
+from paddle1_tpu.core import flags as core_flags  # noqa: E402
+from paddle1_tpu.core import jit_sanitizer as js  # noqa: E402
+from paddle1_tpu.core.jit_sanitizer import (  # noqa: E402
+    RetraceStormError, UseAfterDonateError)
+
+
+def _run(tmp_path, src, select, name="seed.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return tl.run(paths=[str(p)], select=select).findings
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- donation-safety: violation matrix ---------------------------------------
+
+class TestDonationSafetyMatrix:
+    def test_use_after_donate_exact_line(self, tmp_path):
+        src = (
+            "import jax\n"                                    # 1
+            "def step(p, b):\n"                               # 2
+            "    return p\n"                                  # 3
+            "fn = jax.jit(step, donate_argnums=(0,))\n"       # 4
+            "def train(params, batch):\n"                     # 5
+            "    out = fn(params, batch)\n"                   # 6: donated
+            "    print(params)\n"                             # 7: USE
+            "    return out\n"                                # 8
+        )
+        fs = _by_rule(_run(tmp_path, src, ["donation-safety"]),
+                      "use-after-donate")
+        assert [(f.line) for f in fs] == [7]
+        assert "donated position" in fs[0].message
+
+    def test_reassign_from_result_is_clean(self, tmp_path):
+        # the engine idiom: the donated name is rebound by the same
+        # statement that dispatches
+        src = (
+            "import jax\n"
+            "def step(p, s, b):\n"
+            "    return 0.0, p, s\n"
+            "fn = jax.jit(step, donate_argnums=(0, 1))\n"
+            "def train(self, batch):\n"
+            "    loss, self.params, self.opt = fn(\n"
+            "        self.params, self.opt, batch)\n"
+            "    return loss, self.params\n"  # rebound: fine
+        )
+        assert not _run(tmp_path, src, ["donation-safety"])
+
+    def test_conditional_donate_argnums_counts(self, tmp_path):
+        # the engine's `(0, 1) if donate else ()` shape: the donating
+        # configuration is what gets checked
+        src = (
+            "import jax\n"                                     # 1
+            "donate = True\n"                                  # 2
+            "def step(p, b):\n"                                # 3
+            "    return p\n"                                   # 4
+            "fn = jax.jit(step,\n"                             # 5
+            "             donate_argnums=(0,) if donate else ())\n"
+            "def train(params, batch):\n"                      # 7
+            "    out = fn(params, batch)\n"                    # 8
+            "    params.keys()\n"                              # 9: USE
+        )
+        fs = _by_rule(_run(tmp_path, src, ["donation-safety"]),
+                      "use-after-donate")
+        assert [f.line for f in fs] == [9]
+
+    def test_donated_alias_device_put(self, tmp_path):
+        src = (
+            "import jax\n"                                     # 1
+            "import jax.numpy as jnp\n"                        # 2
+            "fn = jax.jit(lambda p: p, donate_argnums=(0,))\n"  # 3
+            "def place(v, sh):\n"                              # 4
+            "    a = jax.device_put(v, sh)\n"                  # 5: alias
+            "    b = jax.device_put(jnp.array(v, copy=True), sh)\n"
+            "    return a, b\n"                                # 7
+        )
+        fs = _by_rule(_run(tmp_path, src, ["donation-safety"]),
+                      "donated-alias")
+        assert [f.line for f in fs] == [5]
+        assert "ALIAS" in fs[0].message
+
+    def test_loop_target_rebind_is_not_a_read(self, tmp_path):
+        # `for x in items:` REBINDS x (Store ctx) — disposing of the
+        # donated name, not reading it; later reads of the loop var
+        # are reads of the fresh binding
+        src = (
+            "import jax\n"
+            "f = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+            "def h(x, items):\n"
+            "    f(x)\n"
+            "    for x in items:\n"
+            "        print(x)\n"
+        )
+        assert not _run(tmp_path, src, ["donation-safety"])
+
+    def test_non_donating_file_device_put_is_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def place(v, sh):\n"
+            "    return jax.device_put(v, sh)\n"  # nothing donates here
+        )
+        assert not _run(tmp_path, src, ["donation-safety"])
+
+    def test_noqa_with_reason_suppresses(self, tmp_path):
+        src = (
+            "import jax\n"
+            "fn = jax.jit(lambda p: p, donate_argnums=(0,))\n"
+            "def place(v, sh):\n"
+            "    return jax.device_put(v, sh)"
+            "  # noqa: donated-alias — v is freshly built here\n"
+        )
+        assert not _run(tmp_path, src, ["donation-safety"])
+
+
+# -- retrace-hazard: violation matrix ----------------------------------------
+
+class TestRetraceHazardMatrix:
+    def test_module_level_array_capture(self, tmp_path):
+        src = (
+            "import jax\n"                                     # 1
+            "import numpy as np\n"                             # 2
+            "TABLE = np.arange(1000)\n"                        # 3
+            "@jax.jit\n"                                       # 4
+            "def embed(ids):\n"                                # 5
+            "    return TABLE[ids]\n"                          # 6: capture
+        )
+        fs = _by_rule(_run(tmp_path, src, ["retrace-hazard"]),
+                      "retrace-closure")
+        assert [f.line for f in fs] == [6]
+        assert "TABLE" in fs[0].message
+
+    def test_threaded_array_is_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "TABLE = np.arange(1000)\n"
+            "@jax.jit\n"
+            "def embed(table, ids):\n"
+            "    return table[ids]\n"      # through the signature: fine
+            "out = embed(TABLE, 3)\n"      # call-site use is host-side
+        )
+        assert not _run(tmp_path, src, ["retrace-hazard"])
+
+    def test_nonhashable_static_args(self, tmp_path):
+        src = (
+            "import jax\n"                                     # 1
+            "import numpy as np\n"                             # 2
+            "def f(x, cfg):\n"                                 # 3
+            "    return x\n"                                   # 4
+            "g = jax.jit(f, static_argnums=(1,))\n"            # 5
+            "g(1, [2, 3])\n"                                   # 6: list
+            "g(1, {'a': 1})\n"                                 # 7: dict
+            "g(1, np.array([1]))\n"                            # 8: array
+            "g(1, (2, 3))\n"                                   # tuple: ok
+            "h = jax.jit(f, static_argnames=('cfg',))\n"       # 10
+            "h(1, cfg={'a'})\n"                                # 11: set
+        )
+        fs = _by_rule(_run(tmp_path, src, ["retrace-hazard"]),
+                      "retrace-static-arg")
+        assert sorted(f.line for f in fs) == [6, 7, 8, 11]
+
+    def test_scalar_feedback_loop(self, tmp_path):
+        src = (
+            "import jax\n"                                     # 1
+            "def f(x):\n"                                      # 2
+            "    return x * 2\n"                               # 3
+            "step = jax.jit(f)\n"                              # 4
+            "x = 1.0\n"                                        # 5
+            "for _ in range(10):\n"                            # 6
+            "    out = step(x)\n"                              # 7
+            "    x = float(out)\n"                             # 8
+            "    y = step(x)\n"                                # 9: feedback
+        )
+        fs = _by_rule(_run(tmp_path, src, ["retrace-hazard"]),
+                      "retrace-scalar-feedback")
+        # BOTH calls feed the scalar on the next iteration: line 7
+        # consumes the float assigned at 8 when the loop comes around
+        assert [f.line for f in fs] == [7, 9]
+
+    def test_device_carry_is_clean(self, tmp_path):
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "step = jax.jit(f)\n"
+            "x = 1.0\n"
+            "for _ in range(10):\n"
+            "    x = step(x)\n"       # stays on device: fine
+            "print(float(x))\n"       # one readback after the loop
+        )
+        assert not _run(tmp_path, src, ["retrace-hazard"])
+
+
+# -- host-sync: violation matrix ---------------------------------------------
+
+class TestHostSyncMatrix:
+    def test_traced_body_syncs(self, tmp_path):
+        src = (
+            "import jax\n"                                     # 1
+            "import numpy as np\n"                             # 2
+            "@jax.jit\n"                                       # 3
+            "def f(x):\n"                                      # 4
+            "    a = float(x)\n"                               # 5
+            "    b = x.item()\n"                               # 6
+            "    c = np.asarray(x)\n"                          # 7
+            "    d = int(np.shape(x)[0])\n"                    # 8: static
+            "    return a + b + d\n"                           # 9
+        )
+        fs = _by_rule(_run(tmp_path, src, ["host-sync"]),
+                      "hidden-host-sync")
+        assert sorted(f.line for f in fs) == [5, 6, 7]
+
+    def test_hot_path_marker_on_def_line(self, tmp_path):
+        src = (
+            "import numpy as np\n"                             # 1
+            "class Loop:\n"                                    # 2
+            "    def run(self):  # hot-path: decode\n"         # 3
+            "        t = self.buf.item()\n"                    # 4
+            "        a = np.asarray(self._tokens)\n"           # 5
+            "        f = float(t)\n"                           # 6
+            "        n = int(t)\n"                      # int ok on host
+        )
+        fs = _by_rule(_run(tmp_path, src, ["host-sync"]),
+                      "hidden-host-sync")
+        assert sorted(f.line for f in fs) == [4, 5, 6]
+
+    def test_hot_path_marker_above_loop(self, tmp_path):
+        src = (
+            "import numpy as np\n"                             # 1
+            "def run(q):\n"                                    # 2
+            "    # hot-path\n"                                 # 3
+            "    while True:\n"                                # 4
+            "        v = q.result.numpy()\n"                   # 5
+        )
+        fs = _by_rule(_run(tmp_path, src, ["host-sync"]),
+                      "hidden-host-sync")
+        assert [f.line for f in fs] == [5]
+
+    def test_unmarked_code_is_clean(self, tmp_path):
+        src = (
+            "import numpy as np\n"
+            "def report(loss):\n"
+            "    return float(loss), np.asarray(loss)\n"  # cold path
+        )
+        assert not _run(tmp_path, src, ["host-sync"])
+
+    def test_jnp_asarray_not_flagged(self, tmp_path):
+        # host→device transfer, not a readback
+        src = (
+            "import jax.numpy as jnp\n"
+            "def run(self):  # hot-path\n"
+            "    return jnp.asarray(self.mask, bool)\n"
+        )
+        assert not _run(tmp_path, src, ["host-sync"])
+
+    def test_noqa_documents_intended_sync(self, tmp_path):
+        src = (
+            "import numpy as np\n"
+            "def decode(self):  # hot-path\n"
+            "    return np.asarray(self._tokens)"
+            "  # noqa: hidden-host-sync — the one intended readback\n"
+        )
+        assert not _run(tmp_path, src, ["host-sync"])
+
+
+# -- satellite: --select teaching error --------------------------------------
+
+class TestSelectTeachingError:
+    def test_unknown_pass_is_typed_and_lists_registry(self):
+        with pytest.raises(UnknownPassError) as ei:
+            tl.make_passes(["no-such-pass"])
+        e = ei.value
+        assert e.unknown == ["no-such-pass"]
+        teach = e.teach()
+        for c in tl.ALL_PASSES:
+            assert c.name in teach
+        assert "donation-safety" in teach and "--select" in teach
+
+    def test_cli_exit_2_with_teaching_message(self, capsys):
+        from tools.lint.__main__ import main
+        rc = main(["--select", "no-such-pass"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "unknown pass" in err and "host-sync" in err
+        assert "Traceback" not in err
+
+    def test_cli_valid_select_still_runs(self, tmp_path, capsys):
+        p = tmp_path / "ok.py"
+        p.write_text("x = 1\n")
+        from tools.lint.__main__ import main
+        assert main(["--select", "donation-safety", str(p)]) == 0
+
+    def test_cli_budget_exceeded_fails(self, tmp_path, capsys):
+        p = tmp_path / "ok.py"
+        p.write_text("x = 1\n")
+        from tools.lint.__main__ import main
+        rc = main(["--select", "bare-except", "--budget-s", "1e-9",
+                   str(p)])
+        assert rc == 1
+        assert "budget" in capsys.readouterr().err
+
+    def test_cli_budget_generous_passes(self, tmp_path):
+        p = tmp_path / "ok.py"
+        p.write_text("x = 1\n")
+        from tools.lint.__main__ import main
+        assert main(["--select", "bare-except", "--budget-s", "600",
+                     str(p)]) == 0
+
+
+# -- satellite: flag-liveness same-PR hygiene --------------------------------
+
+class TestFlagLivenessSamePR:
+    def test_same_pr_define_and_read_needs_no_allowlist(self, tmp_path):
+        """A flag defined in one file and read in another of the same
+        walk passes with an EMPTY allowlist — wiring a flag in the PR
+        that defines it must never require FORWARD_COMPAT."""
+        (tmp_path / "flags.py").write_text(
+            "def define_flag(n, d, h=''):\n    pass\n"
+            "define_flag('debug_seeded_sanitizer', False, 'help')\n")
+        (tmp_path / "sanitizer.py").write_text(
+            "def sanitizing():\n"
+            "    return bool(flag('debug_seeded_sanitizer'))\n")
+        fs = tl.run(paths=[str(tmp_path)],
+                    select=["flag-liveness"]).findings
+        assert not [f for f in fs if f.rule == "dead-flag"]
+
+    def test_debug_jit_sanitizer_not_allowlisted(self):
+        from tools.lint import flag_liveness as fl
+        assert "debug_jit_sanitizer" not in fl.FORWARD_COMPAT
+        # and the repo-wide pass holds it live (core/jit_sanitizer.py
+        # reads it) — covered by TestCleanRepo, pinned here explicitly
+        res = tl.run(select=["flag-liveness"])
+        assert not [f for f in res.findings
+                    if "debug_jit_sanitizer" in f.message]
+
+
+# -- runtime sanitizer --------------------------------------------------------
+
+class TestJitSanitizer:
+    def setup_method(self):
+        js.reset()
+
+    def test_structurally_free_when_off(self):
+        # force OFF explicitly: must also hold inside the CI
+        # debug-sanitizers lane where the env flag is exported
+        with core_flags.flags_guard(debug_jit_sanitizer=False):
+            fn = lambda x: x
+            assert js.wrap_donating(fn, (0,), "t") is fn  # PASS-THROUGH
+            assert js.site("t") is None
+            # shared no-op section object, no allocation per entry
+            assert js.hot_section("a") is js.hot_section("b")
+
+    def test_seeded_use_after_donate_typed(self):
+        import jax
+        import jax.numpy as jnp
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            g = jax.jit(lambda x: x * 2, donate_argnums=(0,))
+            w = js.wrap_donating(g, (0,), "seed.step")
+            a = jnp.arange(4.0)
+            out = w(a)
+            assert float(np.asarray(out)[1]) == 2.0
+            with pytest.raises(UseAfterDonateError,
+                               match="seed.step"):
+                w(a)
+
+    def test_poison_makes_any_use_fail(self):
+        """Even a use NOT reaching a guarded entry fails
+        deterministically (jax's deleted-buffer error) instead of
+        silently reading XLA-owned storage."""
+        import jax
+        import jax.numpy as jnp
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            w = js.wrap_donating(
+                jax.jit(lambda x: x + 1, donate_argnums=(0,)),
+                (0,), "seed.step")
+            a = jnp.arange(4.0)
+            w(a)
+            with pytest.raises(RuntimeError, match="deleted"):
+                np.asarray(a)
+
+    def test_seeded_three_retrace_storm_typed(self):
+        import jax
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            s = js.site("seed.engine", retrace_limit=3)
+            fn = jax.jit(lambda x: x.sum())
+            seen = set()
+            with pytest.raises(RetraceStormError, match="retrace storm"):
+                for n in range(1, 8):  # 3 retraces past the first is
+                    x = np.zeros([n], np.float32)  # the storm
+                    seen.add(x.shape)
+                    s.note_signatures(len(seen))
+                    fn(x)
+            assert len(seen) == 4  # raised at the 4th distinct sig
+
+    def test_engine_retrace_storm_enforced(self):
+        """ParallelEngine._guard_retrace upgraded: distinct batch
+        shapes past the limit raise typed instead of warning once."""
+        import paddle1_tpu as paddle
+        from paddle1_tpu import nn, optimizer
+        from paddle1_tpu.distributed.parallel_engine import ParallelEngine
+        with core_flags.flags_guard(debug_jit_sanitizer=True,
+                                    jit_retrace_warn=False):
+            m = nn.Linear(4, 2)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=m.parameters())
+            eng = ParallelEngine(m, opt,
+                                 lambda mod, b: mod(b[0]).mean(),
+                                 donate=False)
+            assert eng._jsan is not None
+            with pytest.raises(RetraceStormError):
+                for i in range(js.RETRACE_LIMIT + 2):
+                    # distinct batch shape per step (multiples of the
+                    # 8-way dp mesh): every one is a fresh signature
+                    x = np.random.rand(8 * (i + 1),
+                                       4).astype(np.float32)
+                    eng.step((paddle.to_tensor(x),))
+
+    def test_engine_use_after_donate_typed(self):
+        """Stale donated params fed back into the engine raise typed,
+        naming the donation site."""
+        import paddle1_tpu as paddle
+        from paddle1_tpu import nn, optimizer
+        from paddle1_tpu.distributed.parallel_engine import ParallelEngine
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            m = nn.Linear(4, 2)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=m.parameters())
+            eng = ParallelEngine(m, opt,
+                                 lambda mod, b: mod(b[0]).mean(),
+                                 donate=True)
+            x = paddle.to_tensor(
+                np.random.rand(8, 4).astype(np.float32))
+            eng.step((x,))
+            stale = eng.params          # about to be donated
+            eng.step((x,))              # stale poisoned here
+            eng.params = stale
+            with pytest.raises(UseAfterDonateError,
+                               match="ParallelEngine"):
+                eng.step((x,))
+
+    def test_host_sync_counting_in_hot_section(self):
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            with js.hot_section("seed_loop"):
+                js.note_host_sync("loss_readback")
+                js.note_host_sync("loss_readback")
+            js.note_host_sync("loss_readback")  # outside: section ''
+            ev = js.host_sync_events()
+            assert ev[("seed_loop", "loss_readback")] == 2
+            assert ev[("", "loss_readback")] == 1
+            assert js.host_sync_count("seed_loop") == 2
+            assert js.host_sync_count() == 3
+
+    def test_loss_readback_attributed_to_step_loop(self):
+        """async_loss materialization events attribute to the
+        engine_step_loop section held by step_stream's consumer."""
+        import paddle1_tpu as paddle
+        from paddle1_tpu import nn, optimizer
+        from paddle1_tpu.distributed.parallel_engine import ParallelEngine
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            m = nn.Linear(4, 2)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=m.parameters())
+            eng = ParallelEngine(m, opt,
+                                 lambda mod, b: mod(b[0]).mean(),
+                                 donate=False)
+            x = paddle.to_tensor(
+                np.random.rand(8, 4).astype(np.float32))
+            for fut in eng.step_stream([(x,)] * 3):
+                float(fut)  # the per-step readback the loop pays
+            assert js.host_sync_count("engine_step_loop") >= 3
+
+    def test_reset_disarms_when_flag_off(self):
+        """An armed test must not leave flag-off code counting (or
+        paying the counter lock) for the rest of the process: reset()
+        re-derives the armed latch from the current flag. The off half
+        forces the flag explicitly so this also holds inside the CI
+        debug-sanitizers lane (FLAGS_debug_jit_sanitizer=1 in env)."""
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            js.hot_section("arming")  # arms the module
+            js.note_host_sync("x")
+            assert js.host_sync_count() == 1
+        with core_flags.flags_guard(debug_jit_sanitizer=False):
+            js.reset()  # flag is off HERE: must disarm
+            js.note_host_sync("x")  # must NOT count
+            assert js.host_sync_count() == 0
+
+    def test_hot_section_exit_is_name_keyed(self):
+        """A generator-held section finalized out of order must not pop
+        another section's marker."""
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            outer = js.hot_section("outer")
+            inner = js.hot_section("inner")
+            outer.__enter__()
+            inner.__enter__()
+            outer.__exit__(None, None, None)  # out of order
+            js.note_host_sync("x")
+            assert js.host_sync_count("inner") == 1
+            inner.__exit__(None, None, None)
+
+
+# -- the PR 1 donation-aliasing regression, deterministic --------------------
+
+class TestDonationAliasingRegression:
+    def setup_method(self):
+        js.reset()
+
+    def test_pr1_shape_fails_deterministically(self):
+        """The exact PR 1 bug shape: device_put on the same device
+        ELIDES the copy — the placed array IS the layer's buffer — and
+        the first donating dispatch hands the layer's storage to XLA.
+        On CPU donation no-ops, so pre-sanitizer this read back the
+        stale value silently (the corruption that deleted a live
+        BertModel embedding on TPU). Under the sanitizer the layer
+        read fails deterministically on every backend."""
+        import jax
+        import jax.numpy as jnp
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            layer_buf = jnp.arange(8.0)          # the live layer buffer
+            placed = jax.device_put(layer_buf)    # elided copy: ALIAS
+            assert placed is layer_buf            # the PR 1 trap itself
+            step = js.wrap_donating(
+                jax.jit(lambda p: p * 2, donate_argnums=(0,)),
+                (0,), "regress.engine")
+            step(placed)                          # donates the alias
+            with pytest.raises(RuntimeError, match="deleted"):
+                np.asarray(layer_buf)             # the layer read: LOUD
+            # re-entering a guarded dispatch names the donation site
+            with pytest.raises(UseAfterDonateError,
+                               match="regress.engine"):
+                step(layer_buf)
+
+    def test_copy_first_fix_is_immune(self):
+        """The PR 1 fix (copy before placement) under the same drive:
+        the layer buffer survives the donating dispatch."""
+        import jax
+        import jax.numpy as jnp
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            layer_buf = jnp.arange(8.0)
+            placed = jax.device_put(jnp.array(layer_buf, copy=True))
+            assert placed is not layer_buf
+            step = js.wrap_donating(
+                jax.jit(lambda p: p * 2, donate_argnums=(0,)),
+                (0,), "regress.engine")
+            step(placed)
+            np.testing.assert_allclose(np.asarray(layer_buf),
+                                       np.arange(8.0))
+
+
+# -- generation engine under the sanitizer ------------------------------------
+
+class TestGenerationUnderSanitizer:
+    def setup_method(self):
+        js.reset()
+
+    @pytest.mark.slow
+    def test_decode_compile_once_and_kv_poisoning(self):
+        from paddle1_tpu.serving import CausalLM
+        from paddle1_tpu.serving.generate import GenerationEngine
+        with core_flags.flags_guard(debug_jit_sanitizer=True):
+            lm = CausalLM(vocab_size=64, d_model=32, nhead=2,
+                          num_layers=1, max_seq=32)
+            eng = GenerationEngine(lm, slots=2, max_seq=32,
+                                   prefill_buckets=[8])
+            eng.prefill(0, np.arange(4, dtype=np.int32), 0.0, 0, 1)
+            for _ in range(3):
+                eng.decode(np.array([True, False]))
+            assert eng.decode_compile_count == 1
+            # per-token readbacks counted
+            assert js.host_sync_count() >= 3

@@ -247,6 +247,74 @@ class TestTrace:
         assert obs_trace.span("z") is obs_trace.span("w")
 
 
+class TestExportEdgeCases:
+    """export_chrome_trace must survive every shape a crashed or idle
+    fleet leaves behind (ISSUE 12 satellite): an empty/missing trace
+    dir, a pid that opened its sink but completed zero spans, and a
+    torn final JSONL line from a SIGKILLed process."""
+
+    def test_empty_trace_dir(self, tmp_path):
+        d = tmp_path / "tr"
+        d.mkdir()
+        out = str(tmp_path / "chrome.json")
+        stats = obs_trace.export_chrome_trace(str(d), out)
+        assert stats["events"] == 0 and stats["flows"] == 0
+        assert json.load(open(out)) == {"traceEvents": []}
+
+    def test_missing_trace_dir(self, tmp_path):
+        # never created (tracing was configured but nothing recorded)
+        out = str(tmp_path / "chrome.json")
+        stats = obs_trace.export_chrome_trace(
+            str(tmp_path / "never_made"), out)
+        assert stats["events"] == 0
+        assert json.load(open(out)) == {"traceEvents": []}
+
+    def test_zero_span_pid_file(self, tmp_path):
+        # a process that armed its sink and died before any span
+        # completed leaves an empty spans-<pid>.jsonl
+        d = tmp_path / "tr"
+        d.mkdir()
+        (d / "spans-12345.jsonl").write_text("")
+        (d / "spans-12346.jsonl").write_text("\n\n")  # blank lines only
+        out = str(tmp_path / "chrome.json")
+        stats = obs_trace.export_chrome_trace(str(d), out)
+        assert stats["events"] == 0 and stats["pids"] == []
+
+    def test_truncated_last_line_is_skipped(self, tmp_path):
+        # a SIGKILL mid-write tears the final line; every complete
+        # record before it must still export
+        d = tmp_path / "tr"
+        d.mkdir()
+        good = json.dumps({"ph": "X", "name": "step", "cat": "Engine",
+                           "ts": 1.0, "dur": 2.0, "pid": 7, "tid": 1,
+                           "trace": "t1", "span": "s1",
+                           "parent": None})
+        (d / "spans-7.jsonl").write_text(
+            good + "\n" + '{"ph": "X", "name": "torn", "ts": 3')
+        out = str(tmp_path / "chrome.json")
+        stats = obs_trace.export_chrome_trace(str(d), out)
+        assert stats["events"] == 1
+        assert stats["names"] == ["step"]
+        assert stats["pids"] == [7]
+        ev = json.load(open(out))["traceEvents"]
+        assert [e["name"] for e in ev] == ["step"]
+
+    def test_mixed_torn_and_foreign_files(self, tmp_path):
+        # non-span files in the dir are ignored; torn lines in one pid
+        # file don't poison another pid's records
+        d = tmp_path / "tr"
+        d.mkdir()
+        (d / "notes.txt").write_text("not a span file")
+        (d / "spans-1.jsonl").write_text('{"broken...')
+        rec = json.dumps({"ph": "i", "name": "mark", "ts": 5.0,
+                          "pid": 2, "tid": 9, "s": "p",
+                          "trace": "t2", "span": "s2"})
+        (d / "spans-2.jsonl").write_text(rec + "\n")
+        stats = obs_trace.export_chrome_trace(
+            str(d), str(tmp_path / "chrome.json"))
+        assert stats["events"] == 1 and stats["pids"] == [2]
+
+
 class TestProfilerSpanLeak:
     def test_stop_mid_span_does_not_leak_stack(self):
         # the satellite regression: stop_profiler flipping _enabled

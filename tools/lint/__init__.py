@@ -1,50 +1,65 @@
-"""Unified static-analysis suite — ``python -m tools.lint`` (ISSUE 11).
+"""Unified static-analysis suite — ``python -m tools.lint`` (ISSUE 11,
+extended with the JIT-discipline passes in ISSUE 12).
 
-One framework (:mod:`tools.lint.framework`), four passes:
+One framework (:mod:`tools.lint.framework`), seven passes:
 
 * ``bare-except`` — no handler may swallow interrupts (PR 2, migrated);
 * ``metric-names`` — the Prometheus naming contract (PR 9, migrated);
 * ``lock-discipline`` — blocking calls under locks, ``# guarded-by:``
-  mutation discipline, nested-``with`` lock-order cycles (new);
-* ``flag-liveness`` — every ``define_flag`` needs a reader (new).
+  mutation discipline, nested-``with`` lock-order cycles (ISSUE 11);
+* ``flag-liveness`` — every ``define_flag`` needs a reader (ISSUE 11);
+* ``donation-safety`` — use-after-donate and ``device_put`` aliasing
+  at donating jit boundaries (ISSUE 12);
+* ``retrace-hazard`` — constant-folded closures, non-hashable static
+  args, host-scalar feedback loops (ISSUE 12);
+* ``host-sync`` — hidden device→host readbacks in traced bodies and
+  ``# hot-path`` regions (ISSUE 12).
 
 See README "Static analysis" for the conventions
-(``# noqa: <rule> — reason``, ``# guarded-by: <lock>``) and
-``core/locks.py`` for the runtime lock-order sanitizer that covers what
-a lexical pass cannot.
+(``# noqa: <rule> — reason``, ``# guarded-by: <lock>``,
+``# hot-path``), ``core/locks.py`` for the runtime lock-order
+sanitizer, and ``core/jit_sanitizer.py`` for the runtime half of the
+JIT-discipline suite (retrace-storm enforcement, donated-buffer
+poisoning, host-sync counting) — each covers what a lexical pass
+cannot.
 """
 
 from __future__ import annotations
 
 from .bare_except import BareExceptPass
+from .donation_safety import DonationSafetyPass
 from .flag_liveness import FlagLivenessPass
 from .framework import (DEFAULT_PATHS, Finding, LintPass, RunResult,
-                        iter_py_files, parse_noqa, repo_root, report,
-                        run_passes)
+                        UnknownPassError, iter_py_files, parse_noqa,
+                        repo_root, report, run_passes)
+from .host_sync import HostSyncPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
+from .retrace_hazard import RetraceHazardPass
 
 ALL_PASSES = (BareExceptPass, MetricNamesPass, LockDisciplinePass,
-              FlagLivenessPass)
+              FlagLivenessPass, DonationSafetyPass, RetraceHazardPass,
+              HostSyncPass)
 
 __all__ = ["ALL_PASSES", "BareExceptPass", "MetricNamesPass",
-           "LockDisciplinePass", "FlagLivenessPass", "Finding",
-           "LintPass", "RunResult", "run_passes", "report",
-           "repo_root", "iter_py_files", "parse_noqa", "DEFAULT_PATHS",
-           "make_passes", "run"]
+           "LockDisciplinePass", "FlagLivenessPass",
+           "DonationSafetyPass", "RetraceHazardPass", "HostSyncPass",
+           "Finding", "LintPass", "RunResult", "UnknownPassError",
+           "run_passes", "report", "repo_root", "iter_py_files",
+           "parse_noqa", "DEFAULT_PATHS", "make_passes", "run"]
 
 
 def make_passes(select=None):
-    """Instantiate the registered passes (all, or by ``name``)."""
+    """Instantiate the registered passes (all, or by ``name``).
+    Raises :class:`UnknownPassError` (typed, carrying the registry)
+    when a selected name is not registered."""
     classes = ALL_PASSES
     if select:
         wanted = {s.strip() for s in select if s and s.strip()}
         classes = [c for c in ALL_PASSES if c.name in wanted]
         unknown = wanted - {c.name for c in classes}
         if unknown:
-            raise SystemExit(
-                f"unknown pass(es) {sorted(unknown)} — known: "
-                f"{[c.name for c in ALL_PASSES]}")
+            raise UnknownPassError(unknown, ALL_PASSES)
     return [c() for c in classes]
 
 

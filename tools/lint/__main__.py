@@ -1,17 +1,21 @@
 """CLI for the unified lint suite: ``python -m tools.lint [--all]``.
 
 Exit 0 clean, 1 with findings (one ``path:line: [rule] message`` per
-finding). ``--all`` (also the default with no arguments) runs every
-registered pass over the runtime packages; ``--select`` picks passes;
-positional paths narrow the walk.
+finding), 2 on usage errors (an unknown ``--select`` name prints the
+pass registry instead of a stack trace). ``--all`` (also the default
+with no arguments) runs every registered pass over the runtime
+packages; ``--select`` picks passes; positional paths narrow the walk;
+``--budget-s`` fails the run when the wall time exceeds the budget
+(the CI guard keeping lint growth out of the tier-1 cap).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 
-from . import ALL_PASSES, make_passes, report, run_passes
+from . import ALL_PASSES, UnknownPassError, make_passes, report, run_passes
 
 
 def main(argv=None) -> int:
@@ -23,9 +27,13 @@ def main(argv=None) -> int:
                          "is given)")
     ap.add_argument("--select", default="",
                     help="comma-separated pass names, e.g. "
-                         "--select lock-discipline,flag-liveness")
+                         "--select lock-discipline,donation-safety")
     ap.add_argument("--list", action="store_true",
                     help="list registered passes and exit")
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="fail (exit 1) when the run takes longer than "
+                         "this many seconds, findings or not — the CI "
+                         "timing gate (0 disables)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to walk (default: the runtime "
                          "packages)")
@@ -36,9 +44,23 @@ def main(argv=None) -> int:
         return 0
     select = ([s for s in args.select.split(",") if s]
               if args.select and not args.all else None)
-    passes = make_passes(select)
+    try:
+        passes = make_passes(select)
+    except UnknownPassError as e:
+        print(e.teach(), file=sys.stderr)
+        return 2
+    t0 = time.monotonic()
     result = run_passes(passes, paths=args.paths or None)
-    return report(result)
+    dt = time.monotonic() - t0
+    rc = report(result)
+    if args.budget_s and dt > args.budget_s:
+        print(f"tools.lint: run took {dt:.1f}s, over the "
+              f"--budget-s {args.budget_s:g}s budget — a pass grew "
+              "superlinear (or the walk picked up a new tree); "
+              "profile it before it eats the tier-1 wall-time cap",
+              file=sys.stderr)
+        return 1
+    return rc
 
 
 if __name__ == "__main__":

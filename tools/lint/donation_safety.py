@@ -1,0 +1,167 @@
+"""Lint pass: buffer-donation discipline at the jit boundary (ISSUE 12).
+
+Donation (``donate_argnums``) hands a buffer's storage to XLA: after
+the dispatch, the Python object still exists but its device memory may
+already hold the *output* — or be freed. On CPU (the tier-1 test
+backend) donation silently degrades to a copy, so a use-after-donate
+bug passes every test and corrupts training only on the TPU. That is
+exactly how the PR 1 donation-aliasing bug deleted a live BertModel
+embedding. Two rules make the shape a lint error:
+
+* **use-after-donate** — inside one function, a variable passed at a
+  donated position of a known donating jit callable (``self._jit =
+  jax.jit(step, donate_argnums=(0, 1))`` … ``self._jit(self.params,
+  …)``) is *read again* before being reassigned. The safe engine idiom
+  — ``loss, self.params, … = self._jit(self.params, …)`` — reassigns
+  the donated name in the same statement and is clean. The analysis is
+  lexical and per-function: a donated buffer smuggled through a helper
+  return is the runtime sanitizer's catch
+  (``core.jit_sanitizer`` poisons donated buffers so *any* later use
+  fails typed).
+
+* **donated-alias** — in a file that builds a donating jit, a
+  ``device_put`` whose source is a bare name/attribute (no intervening
+  copy). ``device_put`` elides same-device copies per shard, so the
+  result can alias the source buffer — donate the result and the
+  source's storage is deleted out from under whoever still holds it
+  (the PR 1 bug shape: single-device → replicated-on-mesh aliased the
+  Layer's own array). The fix is ``device_put(jnp.array(v, copy=True),
+  sharding)``; genuinely fresh sources (a buffer nothing else holds)
+  carry ``# noqa: donated-alias — reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .framework import Finding, LintPass
+from .jitlib import JitInfo, collect_jit_info, expr_text
+
+
+def _is_device_put(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "device_put"
+    if isinstance(fn, ast.Name):
+        return fn.id == "device_put"
+    return False
+
+
+def _is_bare_source(node: ast.expr) -> bool:
+    """A device_put source that may alias live storage: a plain name or
+    attribute chain (``v``, ``t.data``, ``self._buf``). A call
+    (``jnp.array(v, copy=True)``, ``np.asarray(x)``) materializes a
+    fresh buffer and is clean."""
+    return isinstance(node, (ast.Name, ast.Attribute))
+
+
+class DonationSafetyPass(LintPass):
+    name = "donation-safety"
+    rules = ("use-after-donate", "donated-alias")
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        info = collect_jit_info(tree)
+        findings: List[Finding] = []
+        if not info.any_donating:
+            return findings
+        # rule 2: aliasing device_put anywhere in a donating file
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_device_put(node) \
+                    and node.args and _is_bare_source(node.args[0]):
+                findings.append(Finding(
+                    path, node.lineno, "donated-alias",
+                    f"device_put({expr_text(node.args[0])}, ...) in a "
+                    "file that donates buffers: device_put elides "
+                    "same-device copies, so the result can ALIAS the "
+                    "source — a later donating dispatch then deletes "
+                    "the source's storage out from under its other "
+                    "holders (the PR 1 embedding-deletion shape). Copy "
+                    "first (device_put(jnp.array(v, copy=True), sh)) "
+                    "or justify with '# noqa: donated-alias — reason'"))
+        # rule 1: per-function use-after-donate
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(node, info, path, findings)
+        return findings
+
+    # -- use-after-donate ---------------------------------------------------
+
+    def _check_function(self, fn: ast.AST, info: JitInfo, path: str,
+                        findings: List[Finding]) -> None:
+        # var text -> (donation line, callable text)
+        donated: Dict[str, Tuple[int, str]] = {}
+
+        def forget(target: ast.expr) -> None:
+            elts = (target.elts if isinstance(target, ast.Tuple)
+                    else [target])
+            for e in elts:
+                if isinstance(e, (ast.Name, ast.Attribute)):
+                    donated.pop(expr_text(e), None)
+                elif isinstance(e, ast.Starred):
+                    forget(e.value)
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested scope: fresh analysis via the outer walk
+            if isinstance(node, ast.Assign):
+                visit(node.value)
+                for t in node.targets:
+                    forget(t)
+                return
+            if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if node.value is not None:
+                    visit(node.value)
+                if isinstance(node, ast.AugAssign):
+                    # `x += ...` reads x first — flagged by the Load
+                    # check below if donated, then counts as reassigned
+                    check_load(node.target)
+                forget(node.target)
+                return
+            if isinstance(node, ast.Call):
+                for sub in list(node.args) + [k.value for k
+                                              in node.keywords]:
+                    visit(sub)
+                visit(node.func)
+                wrap = info.by_name.get(expr_text(node.func))
+                if wrap is not None and wrap.donating:
+                    for i in wrap.donate_argnums:
+                        if i < len(node.args) and isinstance(
+                                node.args[i], (ast.Name, ast.Attribute)):
+                            donated[expr_text(node.args[i])] = (
+                                node.lineno, expr_text(node.func))
+                return
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    # a rebind (for-loop target, with-as, comprehension
+                    # target) or del DISPOSES of the donated name — it
+                    # is not a read of the donated storage
+                    donated.pop(expr_text(node), None)
+                else:
+                    check_load(node)
+                # fall through: an Attribute's .value may itself be a
+                # donated name (self.params[...] reads self.params)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        def check_load(node: ast.expr) -> None:
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                return
+            hit = donated.get(expr_text(node))
+            if hit is not None:
+                line, callee = hit
+                findings.append(Finding(
+                    path, node.lineno, "use-after-donate",
+                    f"'{expr_text(node)}' was passed at a donated "
+                    f"position of {callee} on line {line} — its device "
+                    "storage now belongs to XLA (freed or holding the "
+                    "output; on CPU the donation silently no-ops, so "
+                    "tests won't catch it). Reassign it from the "
+                    "dispatch result before reading, or justify with "
+                    "'# noqa: use-after-donate — reason'"))
+
+        body = getattr(fn, "body", [])
+        for stmt in body:
+            visit(stmt)

@@ -39,7 +39,11 @@ from .framework import Finding, LintPass
 # flag name -> reason naming the ROADMAP item that will read it.
 # (Empty today: the ISSUE 11 audit wired or deleted every dead flag —
 # see MIGRATING.md "Flag registry discipline". Add entries here ONLY
-# with a concrete ROADMAP pointer.)
+# with a concrete ROADMAP pointer. A flag WIRED IN THE SAME PR that
+# defines it must never need an entry: the pass cross-references reads
+# across the whole walk, so define-in-flags.py + read-anywhere passes
+# on its own — debug_jit_sanitizer (ISSUE 12) is the worked example,
+# and tests/test_jit_lint.py pins the regression.)
 FORWARD_COMPAT: Dict[str, str] = {}
 
 _ENV_RE = re.compile(r"FLAGS_([A-Za-z_][A-Za-z0-9_]*)")

@@ -43,6 +43,30 @@ _NOQA_RE = re.compile(r"#\s*noqa:\s*(.*)$")
 _REASON_SPLIT_RE = re.compile(r"\s+[—–]\s*|\s+-\s+|\s*[—–]\s*")
 
 
+class UnknownPassError(ValueError):
+    """``--select`` named a pass that is not registered. Typed so
+    programmatic callers can catch it; carries the registry so the CLI
+    can teach instead of stack-trace."""
+
+    def __init__(self, unknown, known_passes):
+        self.unknown = sorted(unknown)
+        self.known = list(known_passes)  # pass classes (name + rules)
+        names = ", ".join(c.name for c in self.known)
+        super().__init__(
+            f"unknown pass(es) {self.unknown} — registered passes: "
+            f"{names}")
+
+    def teach(self) -> str:
+        lines = [f"tools.lint: unknown pass(es) "
+                 f"{', '.join(repr(u) for u in self.unknown)}",
+                 "registered passes (use with --select):"]
+        for c in self.known:
+            lines.append(f"  {c.name:<18} rules: {', '.join(c.rules)}")
+        lines.append("('python -m tools.lint --list' prints the same "
+                     "registry)")
+        return "\n".join(lines)
+
+
 @dataclass
 class Finding:
     """One lint hit: ``path:line: [rule] message``."""

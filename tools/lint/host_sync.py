@@ -1,0 +1,153 @@
+"""Lint pass: hidden host synchronization (ISSUE 12).
+
+A device→host readback (``.item()``, ``float()``, ``np.asarray`` on a
+device array) blocks the dispatching thread until the device drains —
+the ~70 ms round trip the whole ``core/async_loss`` machinery exists
+to keep off the step loop. Inside a *traced* body the same shapes are
+worse: they either raise a ConcretizationTypeError or silently bake a
+traced value into the executable. This pass flags both, in the two
+region kinds where a sync is a defect rather than a choice:
+
+* **traced bodies** — functions the file jits (see
+  ``tools/lint/jitlib``): any ``float()``/``int()``/``bool()`` whose
+  argument is not a pure shape expression (``int(np.shape(x)[0])`` is
+  static under trace and fine), any ``.item()``/``.tolist()``/
+  ``.numpy()``, and any ``np.asarray``/``np.array``.
+
+* **``# hot-path`` regions** — a ``# hot-path[: name]`` comment on (or
+  directly above) a ``def``/``for``/``while``/``with`` line marks that
+  node's body as a latency-budgeted region (the engine step loop, the
+  batcher dispatch, the decode loop). Inside one, ``.item()``/
+  ``.tolist()``/``.numpy()`` on anything, ``float()`` of a bare
+  name/attribute, and ``np.asarray``/``np.array`` of an *attribute*
+  (device state lives on ``self``) are flagged. Intended syncs — the
+  decode loop's one per-token readback — carry
+  ``# noqa: hidden-host-sync — reason``, which is the point: the sync
+  budget of a hot region becomes greppable documentation.
+
+``jnp.asarray`` is deliberately NOT flagged: it is a host→device
+transfer (or a no-op on device values), not a readback. The runtime
+side of this pass is ``core.jit_sanitizer.note_host_sync``, which
+counts real sync events inside ``hot_section`` regions when
+``debug_jit_sanitizer`` is on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from .framework import Finding, LintPass
+from .jitlib import collect_jit_info
+
+_HOT_RE = re.compile(r"#\s*hot-path\b")
+
+_READBACK_METHODS = {"item", "tolist", "numpy"}
+_SCALARIZERS = {"float", "int", "bool"}
+_NP_MODULES = {"np", "numpy"}
+_NP_SYNC_FNS = {"asarray", "array", "ascontiguousarray"}
+
+
+def _np_call(node: ast.Call) -> bool:
+    fn = node.func
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr in _NP_SYNC_FNS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _NP_MODULES)
+
+
+def _shape_like(node: ast.expr) -> bool:
+    """Static-under-trace expressions: shapes, dims, lens, constants."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _shape_like(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size")
+    if isinstance(node, ast.BinOp):
+        return _shape_like(node.left) and _shape_like(node.right)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            return True
+        if isinstance(fn, ast.Attribute) and fn.attr in ("shape",
+                                                         "ndim"):
+            return True
+    return False
+
+
+class HostSyncPass(LintPass):
+    name = "host-sync"
+    rules = ("hidden-host-sync",)
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        info = collect_jit_info(tree)
+        hot_lines = {i for i, text in enumerate(src.splitlines(),
+                                                start=1)
+                     if _HOT_RE.search(text)}
+        hot_nodes = []
+        if hot_lines:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.For,
+                                     ast.While, ast.With)):
+                    if node.lineno in hot_lines \
+                            or node.lineno - 1 in hot_lines:
+                        hot_nodes.append(node)
+        for fdef in info.traced_defs:
+            self._scan(fdef, path, findings, traced=True,
+                       region=fdef.name)
+        for node in hot_nodes:
+            # a hot region nested in a traced body was already scanned
+            # with the stricter rules
+            if node not in info.traced_defs:
+                self._scan(node, path, findings, traced=False,
+                           region=getattr(node, "name", "hot region"))
+        return findings
+
+    def _scan(self, root: ast.AST, path: str,
+              findings: List[Finding], traced: bool,
+              region: str) -> None:
+        where = (f"inside jit-traced '{region}'" if traced
+                 else f"on the hot path ('{region}')")
+        tail = (" — under trace this concretizes (error) or bakes a "
+                "constant; move it outside the jitted body"
+                if traced else
+                " — a device→host readback stalls the dispatch "
+                "pipeline here; move it off the hot path, batch it, "
+                "or document the intended sync") \
+            + " ('# noqa: hidden-host-sync — reason')"
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in _READBACK_METHODS and not node.args:
+                findings.append(Finding(
+                    path, node.lineno, "hidden-host-sync",
+                    f".{fn.attr}() {where}{tail}"))
+            elif isinstance(fn, ast.Name) and fn.id in _SCALARIZERS \
+                    and len(node.args) == 1:
+                arg = node.args[0]
+                if traced:
+                    if not _shape_like(arg):
+                        findings.append(Finding(
+                            path, node.lineno, "hidden-host-sync",
+                            f"{fn.id}() on a traced value {where}"
+                            f"{tail}"))
+                elif fn.id == "float" and isinstance(
+                        arg, (ast.Name, ast.Attribute)):
+                    findings.append(Finding(
+                        path, node.lineno, "hidden-host-sync",
+                        f"float() {where}{tail}"))
+            elif _np_call(node) and node.args:
+                arg = node.args[0]
+                if traced or isinstance(arg, ast.Attribute):
+                    findings.append(Finding(
+                        path, node.lineno, "hidden-host-sync",
+                        f"np.{node.func.attr}"  # type: ignore[union-attr]
+                        f"({'traced value' if traced else 'device state'})"
+                        f" {where}{tail}"))

@@ -1,0 +1,220 @@
+"""Shared JIT-callable discovery for the JIT-discipline passes (ISSUE 12).
+
+The donation-safety, retrace-hazard and hidden-host-sync passes all
+need the same per-file facts: *which callables are jitted*, what their
+donated/static argument positions are, and which names a call site can
+use to reach them. This module computes that once per file.
+
+What counts as a jit construction (lexical — the documented limit of
+every pass built on this):
+
+* ``jax.jit(fn, ...)`` / ``jit(fn, ...)`` call expressions, wherever
+  they appear (an ``Assign`` records the target names as callable
+  aliases: ``self._jit = jax.jit(step, donate_argnums=(0, 1))`` makes
+  ``self._jit`` a donating callable at positions 0 and 1);
+* ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` /
+  ``@functools.partial(jax.jit, ...)`` decorators (the decorated name
+  is the callable alias);
+* ``donate_argnums``/``donate_argnames`` and ``static_argnums``/
+  ``static_argnames`` keywords are read from literal ints/strings,
+  tuples/lists of them, or either branch of a conditional expression
+  (the engine's ``(0, 1) if donate else ()`` shape counts as donating
+  at 0 and 1 — the pass checks the discipline of the donating
+  configuration).
+
+A jit object returned from a helper and called through a variable the
+pass cannot link (``fn = self._table.get(bucket); fn(...)``) is
+invisible here — that is the runtime sanitizer's job
+(``core/jit_sanitizer.py``), not this one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def expr_text(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all exprs
+        return "<?>"
+
+
+def _is_jit_func(node: ast.expr) -> bool:
+    """True for ``jax.jit`` / ``jit`` (the callee of a jit wrap)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    return False
+
+
+def _literal_positions(node: Optional[ast.expr]) -> Tuple[int, ...]:
+    """Int positions named by a donate_argnums/static_argnums literal:
+    a constant int, a tuple/list of them, or the union of both branches
+    of a conditional (``(0, 1) if donate else ()``)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.IfExp):
+        return tuple(sorted(set(_literal_positions(node.body))
+                            | set(_literal_positions(node.orelse))))
+    return ()
+
+
+def _literal_names(node: Optional[ast.expr]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    if isinstance(node, ast.IfExp):
+        return tuple(sorted(set(_literal_names(node.body))
+                            | set(_literal_names(node.orelse))))
+    return ()
+
+
+@dataclass
+class JitWrap:
+    """One jit construction site."""
+    lineno: int
+    # alias texts a call site can use ("self._jit", "g", decorated name)
+    names: Tuple[str, ...]
+    wrapped: Optional[ast.FunctionDef]  # the traced body, when linkable
+    donate_argnums: Tuple[int, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donating: bool = False
+
+
+@dataclass
+class JitInfo:
+    wraps: List[JitWrap] = field(default_factory=list)
+    # alias text -> wrap (last wins, matching runtime rebinding)
+    by_name: Dict[str, JitWrap] = field(default_factory=dict)
+    # FunctionDef nodes whose bodies run under trace
+    traced_defs: Set[ast.FunctionDef] = field(default_factory=set)
+
+    @property
+    def any_donating(self) -> bool:
+        return any(w.donating for w in self.wraps)
+
+
+def _wrap_from_call(call: ast.Call,
+                    defs: Dict[str, ast.FunctionDef]) -> Optional[JitWrap]:
+    """A JitWrap for ``jax.jit(...)`` (or a partial of it), else None."""
+    fn = call.func
+    inner_args = call.args
+    inner_kw = {k.arg: k.value for k in call.keywords if k.arg}
+    if not _is_jit_func(fn):
+        # partial(jax.jit, static_argnums=...) — the jit ref is arg 0
+        if isinstance(fn, (ast.Name, ast.Attribute)) \
+                and (getattr(fn, "id", None) == "partial"
+                     or getattr(fn, "attr", None) == "partial") \
+                and call.args and _is_jit_func(call.args[0]):
+            inner_args = call.args[1:]
+            inner_kw = {k.arg: k.value for k in call.keywords if k.arg}
+        else:
+            return None
+    wrapped = None
+    if inner_args:
+        tgt = inner_args[0]
+        tail = None
+        if isinstance(tgt, ast.Name):
+            tail = tgt.id
+        elif isinstance(tgt, ast.Attribute):
+            tail = tgt.attr  # self._decode_fn -> method _decode_fn
+        if tail is not None:
+            wrapped = defs.get(tail)
+    donate = _literal_positions(inner_kw.get("donate_argnums"))
+    donating = ("donate_argnums" in inner_kw
+                or "donate_argnames" in inner_kw)
+    return JitWrap(
+        lineno=call.lineno, names=(), wrapped=wrapped,
+        donate_argnums=donate,
+        static_argnums=_literal_positions(inner_kw.get("static_argnums")),
+        static_argnames=_literal_names(inner_kw.get("static_argnames")),
+        donating=donating)
+
+
+# one-entry memo: the framework parses each file once and runs every
+# pass against the SAME tree object back to back, so caching the last
+# (tree, info) pair collapses the three JIT passes' discovery walks
+# into one per file (the PR 10 reparse lesson) while holding at most
+# one extra tree alive
+_last_info: Optional[Tuple[ast.AST, "JitInfo"]] = None
+
+
+def collect_jit_info(tree: ast.AST) -> JitInfo:
+    """One walk: every jit wrap, its alias names, and the set of
+    function bodies that run under trace. Memoized per tree object."""
+    global _last_info
+    if _last_info is not None and _last_info[0] is tree:
+        return _last_info[1]
+    info = _collect_jit_info(tree)
+    _last_info = (tree, info)
+    return info
+
+
+def _collect_jit_info(tree: ast.AST) -> JitInfo:
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    info = JitInfo()
+
+    def register(wrap: JitWrap, names: Tuple[str, ...]) -> None:
+        wrap.names = names
+        info.wraps.append(wrap)
+        for n in names:
+            info.by_name[n] = wrap
+        if wrap.wrapped is not None:
+            info.traced_defs.add(wrap.wrapped)
+
+    seen_calls: Set[ast.Call] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if isinstance(value, ast.Call):
+                w = _wrap_from_call(value, defs)
+                if w is not None:
+                    seen_calls.add(value)
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    register(w, tuple(expr_text(t) for t in targets
+                                      if isinstance(t, (ast.Name,
+                                                        ast.Attribute))))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    w = _wrap_from_call(dec, defs)
+                    if w is not None:
+                        seen_calls.add(dec)
+                        w.wrapped = node
+                        register(w, (node.name,))
+                elif _is_jit_func(dec):
+                    w = JitWrap(lineno=node.lineno, names=(),
+                                wrapped=node)
+                    register(w, (node.name,))
+    # bare jit calls not bound to a name (``return jax.jit(fn, ...)``):
+    # still mark the wrapped body traced and the file donating
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node not in seen_calls:
+            w = _wrap_from_call(node, defs)
+            if w is not None:
+                register(w, ())
+    return info

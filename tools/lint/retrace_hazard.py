@@ -1,0 +1,225 @@
+"""Lint pass: retrace hazards at the jit boundary (ISSUE 12).
+
+Every distinct signature a jitted callable sees is a full XLA compile;
+the engines bucket shapes and warn once (``jit_retrace_warn``) exactly
+because a silent retrace storm re-serializes the host loop behind the
+compiler. Three lexical shapes cause storms (or their quieter cousin,
+silent constant-folding) and are flaggable before the code runs:
+
+* **retrace-closure** — a jitted function reads a module-level array
+  (``TABLE = np.arange(...)`` … used inside an ``@jax.jit`` body). The
+  closure capture is traced as a *constant*: the array is baked into
+  the executable (bloating it, re-baking on every retrace) and any
+  later rebinding of the module global is silently invisible to the
+  compiled code. Thread it through the signature instead.
+
+* **retrace-static-arg** — a call site of a ``static_argnums``/
+  ``static_argnames`` callable passes a non-hashable literal (list /
+  dict / set display, or an ``np.array(...)``-family call) at a static
+  position: ``TypeError: unhashable`` at best, a per-call retrace at
+  worst (every new value of a static arg is a new executable). Pass a
+  tuple, or make the argument traced.
+
+* **retrace-scalar-feedback** — inside a loop, a value produced by a
+  jitted call is pulled to host (``float()`` / ``int()`` / ``bool()``
+  / ``.item()``) and a name derived from it is fed back into a jitted
+  call: the readback serializes every iteration behind the device (the
+  async_loss machinery exists to avoid exactly this), and if the
+  scalar rides a static or shape position each new value is a fresh
+  compile. Keep the feedback on device (``lax.scan`` / carry) or batch
+  the readbacks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, LintPass
+from .jitlib import collect_jit_info, expr_text
+
+# module-level creators whose results are array-valued (the
+# constant-folding closure hazard); receiver must be np/numpy/jnp
+_ARRAY_FNS = {"array", "asarray", "zeros", "ones", "full", "empty",
+              "arange", "linspace", "eye", "load", "loadtxt",
+              "rand", "randn", "normal", "uniform"}
+_ARRAY_MODULES = {"np", "numpy", "jnp"}
+
+_SCALARIZERS = {"float", "int", "bool"}
+
+
+def _array_creator(node: ast.expr) -> bool:
+    """``np.arange(...)`` / ``jnp.zeros(...)``-family call."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _ARRAY_FNS:
+        return False
+    root = fn.value
+    while isinstance(root, ast.Attribute):  # np.random.rand
+        root = root.value
+    return isinstance(root, ast.Name) and root.id in _ARRAY_MODULES
+
+
+def _unhashable_literal(node: ast.expr) -> Optional[str]:
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if _array_creator(node):
+        return "array"
+    return None
+
+
+class RetraceHazardPass(LintPass):
+    name = "retrace-hazard"
+    rules = ("retrace-closure", "retrace-static-arg",
+             "retrace-scalar-feedback")
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        info = collect_jit_info(tree)
+        findings: List[Finding] = []
+        if not info.wraps:
+            return findings
+
+        # -- retrace-closure: module-level arrays read in traced bodies
+        module_arrays: Dict[str, int] = {}
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if isinstance(node, ast.Assign) and _array_creator(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_arrays[t.id] = node.lineno
+        for fdef in info.traced_defs:
+            if not module_arrays:
+                break
+            local: Set[str] = {a.arg for a in fdef.args.args
+                               + fdef.args.kwonlyargs
+                               + fdef.args.posonlyargs}
+            if fdef.args.vararg:
+                local.add(fdef.args.vararg.arg)
+            if fdef.args.kwarg:
+                local.add(fdef.args.kwarg.arg)
+            for node in ast.walk(fdef):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store):
+                    local.add(node.id)
+            for node in ast.walk(fdef):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in module_arrays \
+                        and node.id not in local:
+                    findings.append(Finding(
+                        path, node.lineno, "retrace-closure",
+                        f"jitted '{fdef.name}' closes over module-"
+                        f"level array '{node.id}' (defined line "
+                        f"{module_arrays[node.id]}) — the capture is "
+                        "baked into the executable as a constant "
+                        "(re-baked per retrace; rebinding the global "
+                        "is silently ignored). Pass it through the "
+                        "function's signature, or justify with "
+                        "'# noqa: retrace-closure — reason'"))
+
+        # -- retrace-static-arg: non-hashable values at static positions
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            wrap = info.by_name.get(expr_text(node.func))
+            if wrap is None or not (wrap.static_argnums
+                                    or wrap.static_argnames):
+                continue
+            for i in wrap.static_argnums:
+                if i < len(node.args):
+                    kind = _unhashable_literal(node.args[i])
+                    if kind:
+                        findings.append(self._static_finding(
+                            path, node.args[i].lineno, i, kind,
+                            expr_text(node.func)))
+            for kw in node.keywords:
+                if kw.arg in wrap.static_argnames:
+                    kind = _unhashable_literal(kw.value)
+                    if kind:
+                        findings.append(self._static_finding(
+                            path, kw.value.lineno, kw.arg, kind,
+                            expr_text(node.func)))
+
+        # -- retrace-scalar-feedback inside loops
+        jit_names = set(info.by_name)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_loop(node, jit_names, info, path, findings)
+        return findings
+
+    @staticmethod
+    def _static_finding(path: str, line: int, pos, kind: str,
+                        callee: str) -> Finding:
+        return Finding(
+            path, line, "retrace-static-arg",
+            f"{callee} takes static argument {pos!r}, but this call "
+            f"site passes a {kind} there — non-hashable (TypeError at "
+            "dispatch) and, were it hashable, every distinct value "
+            "would be a fresh XLA compile. Pass a tuple / hashable "
+            "constant, or make the argument traced; or justify with "
+            "'# noqa: retrace-static-arg — reason'")
+
+    def _check_loop(self, loop: ast.AST, jit_names: Set[str], info,
+                    path: str, findings: List[Finding]) -> None:
+        """float(jitted result) fed back into a jitted signature
+        within the same loop body."""
+
+        def is_jit_call(node: ast.expr) -> bool:
+            return (isinstance(node, ast.Call)
+                    and expr_text(node.func) in jit_names)
+
+        jit_results: Set[str] = set()
+        scalarized: Set[str] = set()
+
+        def scalarizes(value: ast.expr) -> bool:
+            # float(X)/int(X)/bool(X) or X.item() where X is a jitted
+            # call or a name assigned from one in this loop
+            if isinstance(value, ast.Call):
+                fn = value.func
+                if isinstance(fn, ast.Name) and fn.id in _SCALARIZERS \
+                        and value.args:
+                    inner = value.args[0]
+                    return is_jit_call(inner) or (
+                        isinstance(inner, (ast.Name, ast.Attribute))
+                        and expr_text(inner) in jit_results)
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr in ("item", "tolist"):
+                    return (is_jit_call(fn.value) or
+                            expr_text(fn.value) in jit_results)
+            return False
+
+        # pass 1: collect assignments in loop-body source order
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign):
+                names = [expr_text(t) for t in node.targets
+                         if isinstance(t, (ast.Name, ast.Attribute))]
+                if is_jit_call(node.value):
+                    jit_results.update(names)
+                elif scalarizes(node.value):
+                    scalarized.update(names)
+        if not scalarized:
+            return
+        # pass 2: a scalarized name feeding a jitted call
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) \
+                    and expr_text(node.func) in jit_names:
+                feeds = [expr_text(a) for a in node.args
+                         if isinstance(a, (ast.Name, ast.Attribute))
+                         and expr_text(a) in scalarized]
+                for name in feeds:
+                    findings.append(Finding(
+                        path, node.lineno, "retrace-scalar-feedback",
+                        f"'{name}' is a host scalar pulled out of a "
+                        "jitted result in this loop and fed back into "
+                        f"{expr_text(node.func)} — the readback "
+                        "serializes every iteration behind the device "
+                        "(and a static/shape position would recompile "
+                        "per value). Carry the value on device "
+                        "(lax.scan / fori_loop) or batch the "
+                        "readbacks; or justify with '# noqa: "
+                        "retrace-scalar-feedback — reason'"))
