@@ -620,6 +620,43 @@ def _define_builtin_flags() -> None:
                 "breakdown). Propagated to Supervisor workers and "
                 "fleet replicas via FLAGS_obs_trace_dir env. Empty "
                 "disables.")
+    define_flag("obs_flight_steps", 0,
+                "Crash flight recorder (obs/flight.py): keep a bounded "
+                "ring of the last N step metric snapshots plus recent "
+                "spans and lifecycle events, dumped atomically as "
+                "flight-<pid>.jsonl on an uncaught exception, on a "
+                "preemption/supervisor-drain exit, or on demand via "
+                "the telemetry endpoint's GET /debug/flight. 0 (the "
+                "default) is structurally free: recorder() returns "
+                "None and every tap site is a pointer test. Step "
+                "snapshots need obs_metrics on (they ride the "
+                "instrumented dispatch).",
+                validator=lambda v: v >= 0)
+    define_flag("obs_flight_dir", "",
+                "Where flight-recorder bundles land; empty falls back "
+                "to obs_trace_dir (so export_chrome_trace merges them "
+                "onto the span timeline), else the working directory.")
+    define_flag("obs_hbm_leak_steps", 0,
+                "HBM growth detector (obs/hbm.py): raise typed "
+                "HbmLeakSuspected after this many CONSECUTIVE steps "
+                "of strictly growing registered device-buffer bytes "
+                "(params/opt-state/KV-cache census, fed per "
+                "instrumented step under obs_metrics). 0 (the "
+                "default) disables — the sanitizer-lane idiom: "
+                "structurally free off, deterministic and loud "
+                "when armed.",
+                validator=lambda v: v >= 0)
+    define_flag("obs_slos", "",
+                "Declarative SLOs evaluated over the process metrics "
+                "registry (obs/slo.py), ';'-separated: "
+                "'lat=p99(e2e_ms)<50;err=rate(errors_total/"
+                "requests_total)<0.01;fresh=stale(age_seconds)<600'. "
+                "Evaluation is pull-driven (a /healthz scrape, a "
+                "controller tick): each objective publishes "
+                "slo_<name>_burn_rate_ratio / slo_<name>_ok gauges "
+                "and the /healthz document gains the verdicts — the "
+                "sensor layer the ROADMAP #4 autoscaler reads. Empty "
+                "disables.")
     define_flag("obs_events_file", "",
                 "Structured JSONL lifecycle journal (restart, resize, "
                 "deploy, shed, quarantine, checkpoint commit): one "

@@ -47,6 +47,9 @@ from ..core import flags as core_flags
 from ..core import async_loss
 from ..core import jit_sanitizer
 from ..core.async_loss import LossFuture, StepFuture
+from ..obs import costmodel as obs_costmodel
+from ..obs import flight as obs_flight
+from ..obs import hbm as obs_hbm
 from ..obs import trace as obs_trace
 from ..core.generator import next_key, rng_scope
 from ..core.tensor import Tensor
@@ -73,22 +76,41 @@ def _obs_step_registry():
 # eval, GAN pairs) contribute to ONE aggregate instead of clobbering
 # each other with per-engine numbers against a process-wide readback
 # counter
-_obs_thru = {"rb_base": None, "last_t": None, "rate": None}
+_obs_thru = {"rb_base": None, "last_t": None, "rate": None,
+             "mfu": None, "bw": None, "peaks": None}
 
 
-def _obs_note_steps(m, k: int, rows: int, t_now: float) -> None:
+def _obs_peaks():
+    """(peak_flops, peak_hbm_bw) for this process's device — cached
+    (the cost-model denominators; shared with bench.py's analytic
+    MFU via obs.costmodel's tables)."""
+    st = _obs_thru
+    if st["peaks"] is None:
+        dev = jax.devices()[0]
+        st["peaks"] = (obs_costmodel.device_peak_flops(dev),
+                       obs_costmodel.device_peak_hbm_bw(dev))
+    return st["peaks"]
+
+
+def _obs_note_steps(m, k: int, rows: int, t_now: float,
+                    cost=None) -> None:
     """Feed the throughput gauges after an instrumented dispatch:
-    samples/s as an EWMA over wall time between dispatches, and
+    samples/s as an EWMA over wall time between dispatches,
     steps-per-readback (how well the lazy-loss window amortizes the
-    host round trip — the step_many story in one number)."""
+    host round trip — the step_many story in one number), and — when
+    the jit-site cost is known (ISSUE 13) — the per-step cost gauges
+    plus MFU / HBM-bandwidth utilization against the device peaks.
+    Wall-clock MFU is trustworthy once the in-flight window saturates
+    (dispatch run-ahead can inflate the first instants)."""
     st = _obs_thru
     if st["rb_base"] is None:
         st["rb_base"] = async_loss.readback_count()
     c = m.counter("train_steps_total")
     c.inc(k)
     last, st["last_t"] = st["last_t"], t_now
-    if last is not None and t_now > last:
-        inst = (k * rows) / (t_now - last)
+    dt = (t_now - last) if (last is not None and t_now > last) else None
+    if dt is not None:
+        inst = (k * rows) / dt
         st["rate"] = inst if st["rate"] is None else \
             0.8 * st["rate"] + 0.2 * inst
         m.gauge("train_samples_per_s").set(st["rate"])
@@ -96,6 +118,34 @@ def _obs_note_steps(m, k: int, rows: int, t_now: float) -> None:
     total = c.value
     m.gauge("train_steps_per_readback").set(
         total / rb if rb > 0 else float(total))
+    mfu = None
+    if cost is not None and cost.flops:
+        m.gauge("train_step_flops").set(cost.flops)
+        m.gauge("train_step_bytes").set(cost.bytes_accessed)
+        m.gauge("train_cost_exact").set(1.0 if cost.exact else 0.0)
+        if dt is not None:
+            peak_f, peak_bw = _obs_peaks()
+            mfu_i = (k * cost.flops / dt) / peak_f
+            st["mfu"] = mfu_i if st["mfu"] is None else \
+                0.8 * st["mfu"] + 0.2 * mfu_i
+            m.gauge("train_mfu").set(st["mfu"])
+            mfu = st["mfu"]
+            bw_i = (k * cost.bytes_accessed / dt) / peak_bw
+            st["bw"] = bw_i if st["bw"] is None else \
+                0.8 * st["bw"] + 0.2 * bw_i
+            m.gauge("train_hbm_bw_util").set(st["bw"])
+    # flight ring first: if the leak detector below raises, the crash
+    # dump still holds this step
+    fr = obs_flight.recorder()
+    if fr is not None:
+        fr.note_step(step=total,
+                     samples_per_s=round(st["rate"] or 0.0, 2),
+                     mfu=(round(mfu, 4) if mfu is not None else None),
+                     hbm_bytes=obs_hbm.last_total())
+    # HBM census: per-subsystem registered bytes, sampled (at most
+    # once per interval — the walk is O(registered leaves)) and fed
+    # into the flag-gated monotone-growth leak detector
+    obs_hbm.step_sample(m)
 
 
 _readback_obs_installed = False
@@ -517,6 +567,31 @@ class ParallelEngine:
         self.opt_state = (slots, jax.device_put(  # noqa: donated-alias — fresh from functional_init
             step0, slot_sh[1]))
 
+        # HBM census (ISSUE 13): tag the engine's device state so
+        # obs.hbm.census() can attribute live bytes per subsystem.
+        # Weakref-held — a list append, no registry touch, dies with
+        # the engine (the structural-zero discipline).
+        obs_hbm.register("params", self, lambda e: e.params,
+                         name="ParallelEngine.params")
+        obs_hbm.register("opt_state", self, lambda e: e.opt_state,
+                         name="ParallelEngine.opt_state")
+        # the Layer's own buffers are a separate live copy (the engine
+        # copies unconditionally at init — the donation-aliasing
+        # lesson); after a donate=False sync_model they alias the
+        # engine's arrays, which the census dedups by buffer identity.
+        # Tensor handles captured once ON THE ENGINE — state_dict()
+        # per census walk would put a module sweep on the per-step
+        # publish path, and capturing them in the getter closure would
+        # pin the model past the weakref's lifetime
+        self._obs_model_tensors = tuple(model.state_dict().values())
+        obs_hbm.register(
+            "params", self,
+            lambda e: [t.data for t in e._obs_model_tensors],
+            name="ParallelEngine.model")
+        # per-signature executable cost (obs.costmodel), computed
+        # lazily on the first INSTRUMENTED dispatch of each signature
+        self._cost_cache: Dict[tuple, Any] = {}
+
     # -- data placement -----------------------------------------------------
 
     def shard_batch(self, batch):
@@ -602,14 +677,16 @@ class ParallelEngine:
             (tuple(np.shape(l)), str(getattr(l, "dtype", type(l))))
             for l in leaves)
 
-    def _guard_retrace(self, kind: str, batch) -> None:
+    def _guard_retrace(self, kind: str, batch) -> tuple:
         """Warn once when a new batch-shape signature forces a retrace
         (each retrace is a full XLA recompile — the silent host-loop
-        serializer the jit_retrace_warn flag exists to surface)."""
+        serializer the jit_retrace_warn flag exists to surface).
+        Returns the signature so instrumentation (step_cost) reuses it
+        instead of re-walking the batch tree."""
         seen = self._seen_sigs.setdefault(kind, set())
         sig = self._shape_sig(batch)
         if sig in seen:
-            return
+            return sig
         if self._jsan is not None:
             # sanitizer lane: the warn-once below becomes enforceable —
             # a site compiling past its signature limit raises typed
@@ -625,6 +702,7 @@ class ParallelEngine:
                 "batches to fixed shapes (set FLAGS_jit_retrace_warn=0 "
                 "to silence).")
         seen.add(sig)
+        return sig
 
     def _push_inflight(self, fut: LossFuture) -> LossFuture:
         self._inflight.append(fut)
@@ -649,6 +727,44 @@ class ParallelEngine:
             return int(shape[0]) * int(shape[1])
         return int(shape[0])
 
+    def step_cost(self, batch, sharded: bool = False, sig=None):
+        """FLOPs + bytes of ONE optimizer step at this batch's shape
+        signature (:class:`~paddle1_tpu.obs.costmodel.ExecutableCost`)
+        — XLA cost analysis of the lowered train step, memoized per
+        signature, labeled tree-size heuristic on failure. Called
+        automatically per instrumented dispatch (``obs_metrics``,
+        which hands the retrace guard's already-computed ``sig`` so
+        the hot path never re-walks the batch tree); callable directly
+        for on-demand attribution (bench --cost). One Python trace per
+        new signature, no XLA compile."""
+        if not sharded:
+            batch = self.shard_batch(batch)
+        if sig is None:
+            sig = self._shape_sig(batch)
+        c = self._cost_cache.get(sig)
+        if c is None:
+            ns = lambda spec: NamedSharding(self.mesh, spec)
+
+            def lower():
+                # a SEPARATE jit of the uncounted step body: lowering
+                # the counted self._jit would run its trace-side-effect
+                # counters and corrupt the compile accounting the
+                # acceptance gates read
+                return jax.jit(
+                    self._step_fn,
+                    in_shardings=(self._param_sh, self._slot_sh,
+                                  None, None, None),
+                    out_shardings=(ns(P()), self._param_sh,
+                                   self._slot_sh)).lower(
+                    self.params, self.opt_state, batch,
+                    jax.random.key(0), jnp.asarray(0.0, jnp.float32))
+
+            fb = obs_costmodel.tree_size_cost(
+                self.params, batch=batch, extra=self.opt_state)
+            c = obs_costmodel.analyze(lower, fallback=fb)
+            self._cost_cache[sig] = c
+        return c
+
     def step(self, batch,  # hot-path: one dispatch per call
              lr: Optional[float] = None) -> LossFuture:
         m = _obs_step_registry()
@@ -661,7 +777,7 @@ class ParallelEngine:
             with obs_trace.span("train/shard", cat="Engine"):
                 batch = self.shard_batch(batch)
             t1 = time.perf_counter() if m is not None else 0.0
-            self._guard_retrace("step", batch)
+            sig = self._guard_retrace("step", batch)
             self.dispatch_count += 1
             donated = None
             if self._jsan is not None and self._donate:
@@ -683,7 +799,9 @@ class ParallelEngine:
             m.histogram("train_shard_seconds").observe(t1 - t0)
             m.histogram("train_dispatch_seconds").observe(t2 - t1)
             _obs_note_steps(m, 1,
-                            self._obs_rows(batch, self.grad_accum), t2)
+                            self._obs_rows(batch, self.grad_accum), t2,
+                            cost=self.step_cost(batch, sharded=True,
+                                                sig=sig))
         sched = getattr(self.optimizer, "_learning_rate", None)
         if hasattr(sched, "step"):
             sched.step()
@@ -743,7 +861,7 @@ class ParallelEngine:
                 stacked = jax.tree_util.tree_map(
                     lambda *xs: jnp.stack(xs), *sharded)
             t1 = time.perf_counter() if m is not None else 0.0
-            self._guard_retrace(f"step_many[k={k}]", sharded[0])
+            sig = self._guard_retrace(f"step_many[k={k}]", sharded[0])
             sched = getattr(self.optimizer, "_learning_rate", None)
             lrs = []
             for _ in range(k):
@@ -768,8 +886,11 @@ class ParallelEngine:
             t2 = time.perf_counter()
             m.histogram("train_shard_seconds").observe(t1 - t0)
             m.histogram("train_dispatch_seconds").observe(t2 - t1)
+            # cost of the k-step scan = k x the single-step executable
+            # (same signature — the scan body IS the step fn)
             _obs_note_steps(
-                m, k, self._obs_rows(sharded[0], self.grad_accum), t2)
+                m, k, self._obs_rows(sharded[0], self.grad_accum), t2,
+                cost=self.step_cost(sharded[0], sharded=True, sig=sig))
         # check_finite: the scan body already emits packed [loss,
         # notfinite] pairs, so `losses` is [k, 2] and the per-step flags
         # ride the same single readback

@@ -29,21 +29,39 @@ Three layers, each opt-in and independently cheap:
   :func:`merge_snapshots`. :mod:`obs.events` is the structured JSONL
   lifecycle journal (restart, resize, deploy, shed, quarantine,
   checkpoint commit) behind ``obs_events_file``.
+
+The cost observatory (ISSUE 13) adds what things *cost*:
+:mod:`obs.costmodel` derives per-executable FLOPs/bytes from XLA's
+cost analysis (``train_mfu`` / ``train_hbm_bw_util`` gauges, the
+``hapi.summary`` FLOPs column), :mod:`obs.hbm` is the live-buffer
+census by subsystem plus the flag-gated monotone-growth leak detector,
+:mod:`obs.slo` evaluates declarative SLOs (burn-rate gauges +
+``/healthz`` verdicts — ROADMAP #4's sensor), and :mod:`obs.flight` is
+the crash flight recorder (bounded ring of recent steps/spans/events,
+dumped atomically on crash/preemption/``GET /debug/flight``, merged by
+``export_chrome_trace``). All of it rides the same discipline:
+structurally zero when off, < 5% enabled (``bench.py --cost``).
 """
 
 from __future__ import annotations
 
-from . import events, trace
+from . import costmodel, events, flight, hbm, slo, trace
+from .costmodel import ExecutableCost
+from .flight import FlightRecorder
+from .hbm import HbmLeakSuspected
 from .http import TelemetryServer, start_telemetry_from_flags
 from .registry import (Counter, Gauge, Histogram, MetricsGroup,
                        MetricsRegistry, ServingMetrics, merge_snapshots,
                        metrics_on, process_registry, render_snapshot_text,
                        reset_process_registry, step_registry)
+from .slo import SloSet, SloSpec, parse_slos
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "ServingMetrics",
     "MetricsGroup", "merge_snapshots", "render_snapshot_text",
     "process_registry", "reset_process_registry", "metrics_on",
     "step_registry", "TelemetryServer", "start_telemetry_from_flags",
-    "trace", "events",
+    "trace", "events", "costmodel", "hbm", "slo", "flight",
+    "ExecutableCost", "FlightRecorder", "HbmLeakSuspected",
+    "SloSet", "SloSpec", "parse_slos",
 ]

@@ -1,0 +1,229 @@
+"""Analytical cost attribution: FLOPs + bytes per compiled executable.
+
+The pane of glass (ISSUE 10) can see *when* things happen but not what
+they *cost*: MFU and HBM-bandwidth utilization were hand-computed in
+``bench.py`` from a per-model FLOPs formula, and nothing on the hot
+paths knew its own arithmetic intensity. This module derives both
+numbers from the compiler itself — ``jax.jit(fn).lower(*args)
+.cost_analysis()`` runs XLA's HLO cost analysis on the lowered program
+(no XLA compile, one Python trace) and reports ``flops`` and
+``bytes accessed`` for exactly the graph that will run. The reference
+ships the same organ as its profiler's op-level FLOPs tables; here the
+unit of attribution is the *executable* (one jit site x one signature),
+which is the unit the TPU runtime actually dispatches.
+
+Contract (the ``bench.py --cost`` gate):
+
+* **exact when possible** — :func:`analyze` returns
+  ``ExecutableCost(flops, bytes_accessed, source="xla_cost_analysis")``
+  from the lowered HLO; the BERT acceptance run cross-checks it within
+  15% of the hand-derived ``6 * params * tokens`` formula;
+* **labeled fallback** — when cost analysis is unavailable (exotic
+  backend, lowering failure) the tree-size heuristic kicks in
+  (``source="tree_size_heuristic"``: 2 flops per parameter element per
+  batch row, bytes = one read of every input leaf + one write of every
+  parameter-shaped output) so consumers can tell a measured number
+  from a guess;
+* **cached per jit-site signature** — :func:`site_cost` memoizes by an
+  engine-supplied key, so the one-time Python trace of the cost
+  lowering is paid once per (site, signature), never per step;
+* **zero when off** — engines only call in under ``obs_metrics`` (the
+  PR 9 structural-zero discipline).
+
+Peak-rate tables (:func:`device_peak_flops`,
+:func:`device_peak_hbm_bw`) turn the per-step costs into the
+``train_mfu`` / ``train_hbm_bw_util`` gauges; ``bench.py`` shares the
+FLOPs table so the bench's analytic MFU and the engine's cost-model
+MFU are measured against the same peak.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["ExecutableCost", "analyze", "site_cost", "tree_bytes",
+           "tree_size_cost", "forward_cost", "device_peak_flops",
+           "device_peak_hbm_bw", "clear_cache"]
+
+
+@dataclass(frozen=True)
+class ExecutableCost:
+    """What one dispatch of one executable costs.
+
+    ``source`` is ``"xla_cost_analysis"`` when the numbers came from
+    the lowered HLO, ``"tree_size_heuristic"`` when they are the
+    labeled fallback guess — consumers (gauges, ``hapi.summary``,
+    ``bench --cost``) surface the label so a heuristic can never
+    masquerade as a measurement.
+    """
+
+    flops: float
+    bytes_accessed: float
+    source: str
+
+    @property
+    def exact(self) -> bool:
+        return self.source == "xla_cost_analysis"
+
+
+def tree_bytes(tree) -> int:
+    """Total ``nbytes`` over a pytree's array leaves (leaves without
+    ``nbytes`` — python scalars, None — count 0)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def _tree_rows(tree) -> int:
+    """Leading-dim row count of the first array leaf (>=1)."""
+    import numpy as np
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = np.shape(leaf)
+        if shape:
+            return max(int(shape[0]), 1)
+    return 1
+
+
+def tree_size_cost(params, batch=None, extra=None) -> ExecutableCost:
+    """The labeled fallback: 2 flops per parameter element per batch
+    row (one multiply-accumulate touching each weight once per row —
+    a dense-forward floor, NOT a measurement), bytes = one read of
+    every input tree + one parameter-sized write."""
+    import numpy as np
+    import jax
+    p_elems = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        p_elems += int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+    rows = _tree_rows(batch) if batch is not None else 1
+    read = tree_bytes(params) + tree_bytes(batch) + tree_bytes(extra)
+    return ExecutableCost(flops=2.0 * p_elems * rows,
+                          bytes_accessed=float(read + tree_bytes(params)),
+                          source="tree_size_heuristic")
+
+
+def analyze(lower_thunk: Callable[[], Any],
+            fallback: Optional[ExecutableCost] = None) -> ExecutableCost:
+    """Run ``lower_thunk()`` (returning a ``jax.stages.Lowered``) and
+    read XLA's cost analysis off it. Any failure — lowering error,
+    backend without cost analysis, missing keys — degrades to
+    ``fallback`` (or a zero-cost heuristic record), never an exception:
+    cost attribution must not be able to kill the step it measures."""
+    try:
+        lowered = lower_thunk()
+        cost = lowered.cost_analysis()
+        # jax returns a dict (or a 1-list of dicts from Compiled)
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost and "flops" in cost:
+            return ExecutableCost(
+                flops=float(cost.get("flops", 0.0) or 0.0),
+                bytes_accessed=float(cost.get("bytes accessed", 0.0)
+                                     or 0.0),
+                source="xla_cost_analysis")
+    except Exception:  # noqa: broad-except — cost attribution is
+        # telemetry; a lowering quirk must degrade to the labeled
+        # heuristic, never kill the training/serving step it measures
+        pass
+    if fallback is not None:
+        return fallback
+    return ExecutableCost(0.0, 0.0, source="tree_size_heuristic")
+
+
+# -- per-site cache ---------------------------------------------------------
+
+_cache_lock = threading.Lock()
+_site_cache: Dict[Tuple, ExecutableCost] = {}
+
+
+def site_cost(site: str, signature: Tuple,
+              lower_thunk: Callable[[], Any],
+              fallback: Optional[ExecutableCost] = None
+              ) -> ExecutableCost:
+    """Memoized :func:`analyze`: one Python trace per (site,
+    signature), shared process-wide — the same executable dispatched
+    by two engines costs one analysis."""
+    key = (site, signature)
+    c = _site_cache.get(key)
+    if c is None:
+        c = analyze(lower_thunk, fallback=fallback)
+        with _cache_lock:
+            c = _site_cache.setdefault(key, c)
+    return c
+
+
+def clear_cache() -> None:
+    """Drop every cached site cost (test isolation)."""
+    with _cache_lock:
+        _site_cache.clear()
+
+
+# -- model-level forward cost (hapi.summary / paddle.flops) -----------------
+
+def forward_cost(net, input_size, dtype="float32") -> ExecutableCost:
+    """FLOPs + bytes of one compiled eval forward of ``net`` at
+    ``input_size`` (batch included) — the ``paddle.summary`` /
+    ``paddle.flops`` parity surface. Falls back to the labeled
+    tree-size heuristic when cost analysis is unavailable."""
+    import jax
+    import jax.numpy as jnp
+    from ..incubate.functional import functional_call
+    params = net.functional_state()
+    x = jnp.zeros(tuple(input_size), jnp.dtype(dtype))
+    fb = tree_size_cost(params, batch=x)
+    return analyze(
+        lambda: jax.jit(
+            lambda p, a: functional_call(net, p, a)).lower(params, x),
+        fallback=fb)
+
+
+# -- peak-rate tables -------------------------------------------------------
+
+def _resolve_device_kind(device) -> str:
+    """Normalized device-kind string. The axon tunnel device
+    advertises the generation via PALLAS_AXON_TPU_GEN when device_kind
+    is opaque — ONE resolution shared by both peak tables, so a
+    detection fix can never update one denominator and not the
+    other."""
+    kind = getattr(device, "device_kind", "").lower()
+    if not kind.strip() or "axon" in kind:
+        kind = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return kind
+
+
+# (peak_bf16_flops, peak_hbm_bytes_per_s) per generation, published
+# specs; first matching needle wins, conservative default last (CPU
+# runs report nominal — not physical — MFU/bandwidth utilization)
+_PEAKS = (
+    (("v5 lite", "v5e", "v5lite"), (197e12, 819e9)),
+    (("v5p", "v5"), (459e12, 2765e9)),
+    (("v4",), (275e12, 1228e9)),
+    (("v6", "trillium"), (918e12, 1640e9)),
+)
+_PEAK_DEFAULT = (197e12, 819e9)
+
+
+def _peaks(device):
+    kind = _resolve_device_kind(device)
+    for needles, peaks in _PEAKS:
+        if any(n in kind for n in needles):
+            return peaks
+    return _PEAK_DEFAULT
+
+
+def device_peak_flops(device) -> float:
+    """bf16 peak FLOP/s per chip by device kind (the bench.py table,
+    promoted here so the bench's analytic MFU and the engine's
+    cost-model MFU divide by the same peak)."""
+    return _peaks(device)[0]
+
+
+def device_peak_hbm_bw(device) -> float:
+    """Peak HBM bandwidth (bytes/s) per chip by device kind — the
+    denominator of ``train_hbm_bw_util``."""
+    return _peaks(device)[1]

@@ -26,13 +26,25 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["EVENTS_ENV", "emit", "events_path", "read_events"]
+__all__ = ["EVENTS_ENV", "emit", "events_path", "read_events",
+           "set_flight_tap"]
 
 EVENTS_ENV = "PADDLE_OBS_EVENTS"
 
 _lock = threading.Lock()
 _file = None   # (pid, path, fh) — reopened after fork or path change
 _warned = False
+
+# lifecycle tap: the flight recorder (obs/flight.py) subscribes so its
+# ring keeps recent events even without a journal file configured.
+# None (default) keeps the disabled emit a flag read + pointer test.
+_flight_tap = None
+
+
+def set_flight_tap(fn) -> None:
+    """Install (or clear, with None) the lifecycle-record subscriber."""
+    global _flight_tap
+    _flight_tap = fn
 
 
 def events_path() -> str:
@@ -48,11 +60,20 @@ def emit(event: str, **fields) -> None:
     configured. ``fields`` must be JSON-serializable or reprable."""
     global _file, _warned
     path = events_path()
-    if not path:
+    tap = _flight_tap
+    if not path and tap is None:
         return
     rec = {"ts": round(time.time(), 6), "pid": os.getpid(),
            "event": str(event)}
     rec.update(fields)
+    if tap is not None:
+        try:
+            tap(rec)
+        except Exception:  # noqa: broad-except — the flight ring must
+            # never kill the lifecycle moment it records
+            pass
+    if not path:
+        return
     try:
         line = json.dumps(rec, default=repr) + "\n"
     except (TypeError, ValueError):

@@ -1,0 +1,259 @@
+"""Crash flight recorder: the last N steps, spans and events, on disk
+the moment something dies.
+
+Post-mortems of training/serving crashes kept reconstructing "what was
+the process doing right before?" from scattered logs. The flight
+recorder keeps a bounded in-memory ring — the last N step metric
+snapshots (fed by the engines' instrumented dispatch), recent completed
+spans (tapped off :mod:`obs.trace`'s serializer, whether or not a span
+sink is configured), and recent lifecycle events (tapped off
+:mod:`obs.events`) — and dumps it ATOMICALLY as one JSONL bundle when:
+
+* an uncaught exception unwinds (``sys.excepthook`` chain) — the
+  ``bench --cost`` injected-crash gate;
+* the process exits after a preemption/supervisor drain
+  (``core.health.drain_requested()`` checked at ``atexit`` — the
+  SIGTERM handler itself stays signal-safe: it only sets the flag it
+  already sets);
+* on demand — :meth:`FlightRecorder.dump` or the telemetry endpoint's
+  ``GET /debug/flight`` route.
+
+The bundle lands next to the trace sink (``obs_flight_dir`` flag, else
+``obs_trace_dir``, else cwd) as ``flight-<pid>.jsonl``;
+:func:`obs.trace.export_chrome_trace` merges ``flight-*.jsonl`` into
+the chrome view (step snapshots and lifecycle events become instant
+markers), so the final seconds before a crash render on the same
+timeline as the healthy processes' spans.
+
+Armed by ``obs_flight_steps = N`` (0, the default, is structurally
+free: :func:`recorder` returns None and every tap site is a pointer
+test). A SIGKILL still loses the ring — that is the one failure mode a
+userspace recorder cannot cover; the trace sink's instant-flush
+records are the SIGKILL story.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["FlightRecorder", "recorder", "reset", "read_bundle"]
+
+# span/event rings are sized relative to the step ring: a step emits a
+# handful of spans, and lifecycle events are rare
+_SPAN_FACTOR = 8
+_EVENT_RING = 256
+
+
+class FlightRecorder:
+    """The bounded ring + dump machinery. One per process (module
+    singleton via :func:`recorder`); construct directly only in
+    tests."""
+
+    def __init__(self, steps: int, dir_hint: str = ""):
+        self.capacity = int(steps)
+        # the sink configured when the recorder was ARMED: a crash
+        # after a flags_guard restored the flag must still dump where
+        # the run asked, not into whatever cwd the process dies in
+        self._dir_hint = dir_hint
+        self._lock = threading.Lock()
+        self._steps: collections.deque = collections.deque(
+            maxlen=max(self.capacity, 1))           # guarded-by: self._lock
+        self._spans: collections.deque = collections.deque(
+            maxlen=max(self.capacity * _SPAN_FACTOR, 64))  # guarded-by: self._lock
+        self._events: collections.deque = collections.deque(
+            maxlen=_EVENT_RING)                     # guarded-by: self._lock
+        self._dumped_reason: Optional[str] = None
+
+    # -- feeds (hot-path: deque appends under a short lock) ----------------
+
+    def note_step(self, **fields) -> None:
+        rec = {"kind": "step", "ts": round(time.time(), 6)}
+        rec.update(fields)
+        with self._lock:
+            self._steps.append(rec)
+
+    def note_event(self, rec: dict) -> None:
+        with self._lock:
+            self._events.append(dict(rec, kind="event"))
+
+    def note_span_line(self, line: str) -> None:
+        """Raw serialized span JSONL line from the trace module —
+        stored verbatim (it is already one bundle row)."""
+        with self._lock:
+            self._spans.append(line)
+
+    # -- dump --------------------------------------------------------------
+
+    def _rows(self, reason: str) -> List[str]:
+        with self._lock:
+            steps = list(self._steps)
+            spans = list(self._spans)
+            events = list(self._events)
+        header = {"kind": "flight_header", "reason": reason,
+                  "pid": os.getpid(), "ts": round(time.time(), 6),
+                  "steps": len(steps), "spans": len(spans),
+                  "events": len(events)}
+        rows = [json.dumps(header, default=repr) + "\n"]
+        for rec in steps + events:
+            try:
+                rows.append(json.dumps(rec, default=repr) + "\n")
+            except (TypeError, ValueError):
+                continue
+        for line in spans:
+            rows.append(line if line.endswith("\n") else line + "\n")
+        return rows
+
+    def dump_text(self, reason: str = "on_demand") -> str:
+        return "".join(self._rows(reason))
+
+    def dump_dir(self) -> str:
+        from ..core import flags as core_flags
+        return (core_flags.flag("obs_flight_dir")
+                or core_flags.flag("obs_trace_dir")
+                or self._dir_hint or os.getcwd())
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "on_demand", **extra) -> Optional[str]:
+        """Write the bundle atomically (tmp + rename: a reader — or a
+        second crash — never sees a torn file). Returns the path, or
+        None when the write failed (a dying process must not die
+        harder because its black box had no disk)."""
+        return self.dump_bundle(path, reason, **extra)[0]
+
+    def dump_bundle(self, path: Optional[str] = None,
+                    reason: str = "on_demand", **extra):
+        """One ring snapshot, written AND returned: ``(path, text)``.
+        The /debug/flight route serves ``text`` so the on-disk bundle
+        and the HTTP body are byte-identical (two snapshots could
+        disagree by a step landing between them)."""
+        if path is None:
+            path = os.path.join(self.dump_dir(),
+                                f"flight-{os.getpid()}.jsonl")
+        rows = self._rows(reason)
+        if extra:
+            hdr = json.loads(rows[0])
+            hdr.update(extra)
+            rows[0] = json.dumps(hdr, default=repr) + "\n"
+        text = "".join(rows)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except OSError:
+            return None, text
+        self._dumped_reason = reason
+        return path, text
+
+
+# -- the process recorder ---------------------------------------------------
+
+_lock = threading.Lock()
+_rec: Optional[FlightRecorder] = None
+_hooks_installed = False
+_prev_excepthook = None
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The armed process recorder, or None when ``obs_flight_steps``
+    is 0 (one flag read — the structural-zero path). First armed call
+    builds the ring, installs the crash hooks, and taps the trace +
+    events streams."""
+    from ..core import flags as core_flags
+    n = int(core_flags.flag("obs_flight_steps"))
+    if n <= 0:
+        return None
+    global _rec
+    r = _rec
+    if r is None or r.capacity != n:
+        with _lock:
+            if _rec is None or _rec.capacity != n:
+                _rec = FlightRecorder(
+                    n, dir_hint=(core_flags.flag("obs_flight_dir")
+                                 or core_flags.flag("obs_trace_dir")))
+                _install_hooks()
+                _install_taps(_rec)
+            r = _rec
+    return r
+
+
+def reset() -> None:
+    """Drop the recorder + taps (test isolation). The excepthook/
+    atexit chain stays installed (idempotent, checks arming)."""
+    global _rec
+    with _lock:
+        _rec = None
+    from . import trace as obs_trace
+    from . import events as obs_events
+    obs_trace.set_span_tap(None)
+    obs_events.set_flight_tap(None)
+
+
+def _install_taps(r: FlightRecorder) -> None:
+    from . import trace as obs_trace
+    from . import events as obs_events
+    obs_trace.set_span_tap(r.note_span_line)
+    obs_events.set_flight_tap(r.note_event)
+
+
+def _install_hooks() -> None:
+    # caller holds _lock
+    global _hooks_installed, _prev_excepthook
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    _prev_excepthook = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        r = _rec
+        if r is not None:
+            r.dump(reason="crash",
+                   error=f"{exc_type.__name__}: {exc}")
+        (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+    def on_exit():
+        r = _rec
+        if r is None or r._dumped_reason == "crash":
+            return
+        try:
+            from ..core import health
+            if health.drain_requested():
+                # a preemption / supervisor SIGTERM drain: the signal
+                # handler only set a flag (signal-safe contract); the
+                # bundle writes here, on the way out
+                r.dump(reason="preemption")
+        except Exception:  # noqa: broad-except — the black box must
+            # never turn a clean exit into a dirty one
+            pass
+
+    atexit.register(on_exit)
+
+
+def read_bundle(path: str) -> List[dict]:
+    """Parse a flight bundle back (tests/tools), skipping torn lines."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    out.append(json.loads(ln))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out

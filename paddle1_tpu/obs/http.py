@@ -39,7 +39,16 @@ class TelemetryServer:
         the page (fleet groups, merged child snapshots...). A provider
         raising is reported as a comment line, never a dead endpoint.
     healthz : callable returning the ``/healthz`` JSON dict; default
-        ``{"ok": true, "pid": ..., "uptime_s": ...}``.
+        ``{"ok": true, "pid": ..., "uptime_s": ...}``. Either way the
+        document gains SLO verdicts (``obs.slo``) when the process has
+        objectives configured.
+
+    A provider that raises is served from its LAST GOOD page with a
+    staleness comment (a scrape racing ``drain()``/teardown gets
+    yesterday's numbers labeled as such, never a dead page); only a
+    provider that has never succeeded degrades to an error comment.
+    ``GET /debug/flight`` returns the flight recorder's current bundle
+    (and writes the on-demand dump) when ``obs_flight_steps`` arms it.
     """
 
     def __init__(self, port: int = 0, registry=None,
@@ -47,7 +56,9 @@ class TelemetryServer:
                  healthz: Optional[Callable[[], dict]] = None,
                  host: str = "127.0.0.1"):
         self._registry = registry
-        self._providers = list(providers)
+        # [fn, last_good_text, last_good_monotonic] per provider — the
+        # scrape-vs-drain stale cache (ISSUE 13 satellite)
+        self._providers = [[p, None, 0.0] for p in providers]
         self._healthz = healthz
         self._started = time.monotonic()
         srv_self = self
@@ -72,6 +83,10 @@ class TelemetryServer:
                     self._send(200,
                                json.dumps(srv_self._health()).encode(),
                                "application/json")
+                elif path == "/debug/flight":
+                    body, code = srv_self._flight_page()
+                    self._send(code, body.encode(),
+                               "application/jsonl")
                 else:
                     self._send(404, b"not found\n", "text/plain")
 
@@ -90,25 +105,60 @@ class TelemetryServer:
             reg = process_registry()
         if reg is not False:
             parts.append(reg.render_text())
-        for p in self._providers:
+        for slot in self._providers:
             try:
-                parts.append(p())
+                text = slot[0]()
             except Exception as e:  # noqa: broad-except — one broken
-                # provider (a replica scrape racing a deploy) must not
-                # kill the whole page
-                parts.append(f"# provider error: {e!r}\n")
+                # provider (a replica scrape racing a deploy/drain)
+                # must not kill the whole page
+                if slot[1] is not None:
+                    # serve the last good page, labeled stale: a
+                    # scrape racing drain()/teardown reads yesterday's
+                    # numbers, never a provider-error hole
+                    age = time.monotonic() - slot[2]
+                    parts.append(slot[1])
+                    parts.append(
+                        f"# provider stale ({age:.1f}s old): {e!r}\n")
+                else:
+                    parts.append(f"# provider error: {e!r}\n")
+                continue
+            slot[1], slot[2] = text, time.monotonic()
+            parts.append(text)
         return "".join(parts)
 
     def _health(self) -> dict:
         if self._healthz is not None:
             try:
-                return dict(self._healthz())
+                base = dict(self._healthz())
             except Exception as e:  # noqa: broad-except — a liveness
                 # probe must answer even when the probed is sick
-                return {"ok": False, "error": repr(e),
+                base = {"ok": False, "error": repr(e),
                         "pid": os.getpid()}
-        return {"ok": True, "pid": os.getpid(),
-                "uptime_s": round(time.monotonic() - self._started, 3)}
+        else:
+            base = {"ok": True, "pid": os.getpid(),
+                    "uptime_s": round(
+                        time.monotonic() - self._started, 3)}
+        try:
+            from . import slo
+            base.update(slo.healthz_fields(
+                self._registry if self._registry not in (None, False)
+                else None))
+        except Exception as e:  # noqa: broad-except — a broken SLO
+            # spec must degrade the verdict, not the liveness probe
+            base["slo_error"] = repr(e)
+        return base
+
+    def _flight_page(self):
+        from . import flight
+        r = flight.recorder()
+        if r is None:
+            return ("flight recorder disarmed "
+                    "(set FLAGS_obs_flight_steps > 0)\n", 404)
+        # ONE ring snapshot: the disk dump and the HTTP body are the
+        # same bytes (a step landing between two snapshots would make
+        # the route disagree with the file)
+        _path, text = r.dump_bundle(reason="debug_route")
+        return (text, 200)
 
     # -- lifecycle ---------------------------------------------------------
 

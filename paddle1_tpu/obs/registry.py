@@ -228,6 +228,22 @@ class MetricsRegistry:
                 h = self._histograms.setdefault(name, Histogram(name))
         return h
 
+    def peek(self, name: str):
+        """Look a family up WITHOUT creating it: ``(kind, obj)`` or
+        None. The SLO evaluator reads through this — evaluating an
+        objective over a family that never fired must not materialize
+        an empty family (the structural-zero proof counts families)."""
+        c = self._counters.get(name)
+        if c is not None:
+            return ("counter", c)
+        g = self._gauges.get(name)
+        if g is not None:
+            return ("gauge", g)
+        h = self._histograms.get(name)
+        if h is not None:
+            return ("histogram", h)
+        return None
+
     def record_response(self, n: int = 1) -> None:
         """Feed the QPS window (called once per completed request)."""
         now = time.monotonic()

@@ -1,0 +1,235 @@
+"""Declarative SLOs over the metrics registry: the autoscaler's sensor.
+
+ROADMAP #4's controller needs one signal — "are we inside the service
+objective, and how fast are we burning the budget?" — not a wall of
+histograms. This module turns existing registry families into that
+signal without new instrumentation:
+
+* :class:`SloSpec` declares one objective over families that already
+  exist — a **latency quantile** (``p99(e2e_ms) < 50``), an **error
+  rate** (``errors_total / requests_total < 0.01``), or a
+  **staleness** bound on a gauge (``age_seconds < 60``);
+* :meth:`SloSet.evaluate` reads the registry (peek-only — evaluating
+  an SLO must never create empty families and break the
+  structural-zero proof), publishes per-objective burn-rate gauges
+  (``slo_<name>_burn_rate_ratio`` = observed/target; > 1 is out of
+  budget) and verdict gauges (``slo_<name>_ok``), and returns the
+  verdict dict;
+* :func:`healthz_fields` folds the verdicts into the ``/healthz``
+  document — the endpoint the fleet Supervisor (and eventually the
+  autoscaler) already polls.
+
+Specs come from Python or from the ``obs_slos`` flag, a compact
+grammar parsed with teaching errors::
+
+    FLAGS_obs_slos="lat=p99(e2e_ms)<50;fresh=stale(model_age_seconds)<600"
+    FLAGS_obs_slos="err=rate(errors_total/requests_total)<0.01"
+
+Evaluation is pull-driven (a /healthz or /metrics scrape, a bench
+assert, a controller tick) — the hot path never pays for it.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["SloSpec", "SloSet", "parse_slos", "process_slos",
+           "healthz_fields"]
+
+_QUANTS = {"p50": 50.0, "p95": 95.0, "p99": 99.0}
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One objective. ``kind`` selects the read:
+
+    * ``latency_quantile`` — ``hist`` family's ``quantile`` (50/95/99)
+      must stay below ``target`` (same unit as the histogram);
+    * ``error_rate`` — ``num``/``den`` counter ratio below ``target``;
+    * ``staleness`` — ``gauge`` family's value below ``target``.
+    """
+
+    name: str
+    kind: str
+    target: float
+    hist: Optional[str] = None
+    quantile: float = 99.0
+    num: Optional[str] = None
+    den: Optional[str] = None
+    gauge: Optional[str] = None
+
+    def observe(self, registry) -> Optional[float]:
+        """The observed value, or None when the families don't exist
+        yet (no traffic = vacuously inside the objective)."""
+        if self.kind == "latency_quantile":
+            h = registry.peek(self.hist)
+            if h is None or h[0] != "histogram" or not h[1].count:
+                return None
+            return float(h[1].percentile(self.quantile))
+        if self.kind == "error_rate":
+            num = registry.peek(self.num)
+            den = registry.peek(self.den)
+            if den is None or den[0] != "counter" or not den[1].value:
+                return None
+            n = num[1].value if (num is not None
+                                 and num[0] == "counter") else 0
+            return float(n) / float(den[1].value)
+        if self.kind == "staleness":
+            g = registry.peek(self.gauge)
+            if g is None or g[0] != "gauge":
+                return None
+            return float(g[1].value)
+        raise InvalidArgumentError(
+            f"unknown SLO kind {self.kind!r} (latency_quantile / "
+            "error_rate / staleness)")
+
+
+class SloSet:
+    """A bundle of objectives evaluated together (one service's SLO)."""
+
+    def __init__(self, specs: Sequence[SloSpec]):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise InvalidArgumentError(
+                f"duplicate SLO names in {names} — each objective "
+                "needs its own gauge family")
+        self.specs: List[SloSpec] = list(specs)
+
+    def evaluate(self, registry=None,
+                 publish: bool = True) -> Dict[str, dict]:
+        """Read every objective against ``registry`` (default: the
+        process registry), publish burn-rate/verdict gauges, return
+        ``{name: {ok, observed, target, burn_rate}}``. An objective
+        whose families carry no data yet is ok with burn_rate 0 — no
+        traffic can't be out of budget."""
+        if registry is None:
+            from .registry import process_registry
+            registry = process_registry()
+        out: Dict[str, dict] = {}
+        for s in self.specs:
+            obs_v = s.observe(registry)
+            if obs_v is None:
+                verdict = {"ok": True, "observed": None,
+                           "target": s.target, "burn_rate": 0.0}
+            else:
+                burn = (obs_v / s.target) if s.target > 0 else (
+                    0.0 if obs_v <= 0 else float("inf"))
+                verdict = {"ok": obs_v < s.target,
+                           "observed": round(obs_v, 6),
+                           "target": s.target,
+                           "burn_rate": round(burn, 4)}
+            out[s.name] = verdict
+            if publish:
+                registry.gauge(
+                    f"slo_{s.name}_burn_rate_ratio").set(
+                        verdict["burn_rate"])
+                registry.gauge(f"slo_{s.name}_ok").set(
+                    1.0 if verdict["ok"] else 0.0)
+        return out
+
+    def ok(self, registry=None) -> bool:
+        return all(v["ok"] for v in self.evaluate(registry).values())
+
+
+# -- the flag grammar -------------------------------------------------------
+
+_SPEC_RE = re.compile(
+    r"^\s*(?P<name>[a-z][a-z0-9_]*)\s*=\s*"
+    r"(?P<fn>p50|p95|p99|rate|stale)\s*\("
+    r"(?P<args>[^)]*)\)\s*<\s*(?P<target>[0-9.eE+-]+)\s*$")
+
+_GRAMMAR = ("'<name>=p99(<histogram>)<target>' | "
+            "'<name>=rate(<errors_total>/<requests_total>)<target>' | "
+            "'<name>=stale(<gauge>)<target>', ';'-separated")
+
+
+def parse_slos(spec: str) -> SloSet:
+    """Parse the ``obs_slos`` flag grammar into an :class:`SloSet`,
+    naming the offending clause and the grammar on failure."""
+    specs: List[SloSpec] = []
+    for clause in str(spec).split(";"):
+        if not clause.strip():
+            continue
+        m = _SPEC_RE.match(clause)
+        if not m:
+            raise InvalidArgumentError(
+                f"bad SLO clause {clause.strip()!r} — grammar: "
+                f"{_GRAMMAR}")
+        name, fn = m.group("name"), m.group("fn")
+        args = [a.strip() for a in m.group("args").split("/")]
+        try:
+            target = float(m.group("target"))
+        except ValueError:
+            raise InvalidArgumentError(
+                f"bad SLO target in {clause.strip()!r}") from None
+        if fn in _QUANTS:
+            if len(args) != 1 or not args[0]:
+                raise InvalidArgumentError(
+                    f"{fn}() takes exactly one histogram family, got "
+                    f"{args} in {clause.strip()!r}")
+            specs.append(SloSpec(name, "latency_quantile", target,
+                                 hist=args[0], quantile=_QUANTS[fn]))
+        elif fn == "rate":
+            if len(args) != 2 or not all(args):
+                raise InvalidArgumentError(
+                    "rate() takes numerator/denominator counter "
+                    f"families, got {args} in {clause.strip()!r}")
+            specs.append(SloSpec(name, "error_rate", target,
+                                 num=args[0], den=args[1]))
+        else:  # stale
+            if len(args) != 1 or not args[0]:
+                raise InvalidArgumentError(
+                    "stale() takes exactly one gauge family, got "
+                    f"{args} in {clause.strip()!r}")
+            specs.append(SloSpec(name, "staleness", target,
+                                 gauge=args[0]))
+    return SloSet(specs)
+
+
+# -- the process SLO set (what /healthz reports) ----------------------------
+
+_lock = threading.Lock()
+_process: Optional[SloSet] = None
+_flag_cache = {"raw": None, "set": None}
+
+
+def set_process_slos(slos: Optional[SloSet]) -> None:
+    """Install (or clear) the process SLO set programmatically —
+    overrides the ``obs_slos`` flag."""
+    global _process
+    with _lock:
+        _process = slos
+
+
+def process_slos() -> Optional[SloSet]:
+    """The active process SLO set: the programmatic one, else the
+    ``obs_slos`` flag parsed (cached per flag string), else None."""
+    with _lock:
+        if _process is not None:
+            return _process
+    from ..core import flags as core_flags
+    raw = str(core_flags.flag("obs_slos"))
+    if not raw.strip():
+        return None
+    with _lock:
+        if _flag_cache["raw"] != raw:
+            _flag_cache["raw"] = raw
+            _flag_cache["set"] = parse_slos(raw)
+        return _flag_cache["set"]
+
+
+def healthz_fields(registry=None) -> Dict[str, object]:
+    """The /healthz contribution: ``{}`` when no SLOs are configured,
+    else ``{"slo_ok": bool, "slo": {name: verdict}}`` — the document
+    ROADMAP #4's controller polls."""
+    slos = process_slos()
+    if slos is None:
+        return {}
+    verdicts = slos.evaluate(registry)
+    return {"slo_ok": all(v["ok"] for v in verdicts.values()),
+            "slo": verdicts}

@@ -53,7 +53,7 @@ from ..core import flags as core_flags
 __all__ = ["TRACE_CTX_ENV", "sink_active", "new_trace_id", "new_span_id",
            "current", "context", "span", "instant", "record_span",
            "wire_header", "adopt_header", "set_process_context",
-           "process_context", "export_chrome_trace"]
+           "process_context", "export_chrome_trace", "set_span_tap"]
 
 TRACE_CTX_ENV = "PADDLE_OBS_TRACE_CTX"
 
@@ -84,6 +84,24 @@ _proc_ctx = _env_ctx()
 def sink_active() -> bool:
     """Whether spans are being recorded — the ``obs_trace_dir`` flag."""
     return bool(core_flags.flag("obs_trace_dir"))
+
+
+# span tap: the flight recorder (obs/flight.py) subscribes to the
+# serialized span stream so the ring keeps recent spans even when no
+# file sink is configured. One module-global pointer — None (default)
+# keeps every check a single load.
+_tap = None
+
+
+def set_span_tap(fn) -> None:
+    """Install (or clear, with None) the span-line subscriber."""
+    global _tap
+    _tap = fn
+
+
+def _recording() -> bool:
+    """Spans are generated when a sink OR a tap wants them."""
+    return _tap is not None or sink_active()
 
 
 # Ids are a random base + pid + counter: unique across a pod (the pid
@@ -239,6 +257,13 @@ def flush() -> None:
 
 
 def _write_line(line: str, flush_now: bool = False) -> None:
+    tap = _tap
+    if tap is not None:
+        try:
+            tap(line)
+        except Exception:  # noqa: broad-except — the flight ring must
+            # never kill the span stream it shadows
+            pass
     with _lock:
         fh = _sink_locked()
         if fh is None:
@@ -286,8 +311,7 @@ def record_span(name: str, dur_s: float,
     finishing a span another thread opened; omitted, the current
     context is used. Returns the span's id (None when the sink is
     off)."""
-    fh_active = sink_active()
-    if not fh_active:
+    if not _recording():
         return None
     if ctx is None:
         ctx = current()
@@ -313,7 +337,7 @@ def instant(name: str, ctx: Optional[Tuple[str, str]] = None,
     """Record a zero-duration marker NOW (written and flushed
     immediately — survives a SIGKILL a microsecond later, which is how
     a wedged replica's request receipt stays visible)."""
-    if not sink_active():
+    if not _recording():
         return
     if ctx is None:
         ctx = current()
@@ -390,8 +414,9 @@ def span(name: str, cat: str = "obs",
          args: Optional[dict] = None):
     """Context manager timing one span under the current context (and
     making it the parent of anything opened inside). A shared no-op
-    object when the sink is off — safe on hot paths."""
-    if not sink_active():
+    object when neither the sink nor the flight tap is armed — safe on
+    hot paths."""
+    if not _recording():
         return _NULL
     return _LiveSpan(name, cat, args)
 
@@ -464,6 +489,52 @@ def read_spans(trace_dir: str) -> List[dict]:
     return out
 
 
+def _flight_records_as_spans(trace_dir: str, seen_span_ids) -> List[dict]:
+    """``flight-<pid>.jsonl`` bundles (obs/flight.py) rendered onto the
+    same timeline: span rows merge directly (skipping ids the live
+    sinks already have — a crash dump shadows recently-flushed spans),
+    step snapshots and lifecycle events become instant markers, so the
+    last seconds before a crash sit next to the healthy pids' spans."""
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not (fn.startswith("flight-") and fn.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, fn)) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue  # torn row of a double-crash
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("ph"):  # a shadowed span record
+                if rec.get("span") and rec["span"] in seen_span_ids:
+                    continue
+                out.append(rec)
+                continue
+            kind = rec.get("kind")
+            if kind in ("step", "event", "flight_header"):
+                name = {"step": "flight/step",
+                        "flight_header": "flight/dump"}.get(
+                            kind, f"flight/{rec.get('event', 'event')}")
+                out.append({"ph": "i", "name": name, "cat": "flight",
+                            "s": "p", "ts": float(rec.get("ts", 0)) * 1e6,
+                            "pid": rec.get("pid", 0), "tid": 0,
+                            "args": rec})
+    return out
+
+
 def export_chrome_trace(trace_dir: str, out_path: str,
                         trace_id: Optional[str] = None) -> dict:
     """Merge every process's span JSONL under ``trace_dir`` into ONE
@@ -471,10 +542,13 @@ def export_chrome_trace(trace_dir: str, out_path: str,
     or thread get flow events (``ph:"s"`` at the parent, ``ph:"f"`` at
     the child) so the chrome UI draws the request's path across pids;
     same-thread nesting renders as ordinary stacked slices, no arrow.
-    ``trace_id`` filters to one flow. Returns summary stats
-    ({"events", "flows", "pids", "traces", "names"}) the acceptance
-    gate asserts on."""
+    Flight-recorder bundles (``flight-*.jsonl``) merge onto the same
+    timeline as instant markers. ``trace_id`` filters to one flow.
+    Returns summary stats ({"events", "flows", "pids", "traces",
+    "names"}) the acceptance gate asserts on."""
     spans = read_spans(trace_dir)
+    spans += _flight_records_as_spans(
+        trace_dir, {s["span"] for s in spans if s.get("span")})
     if trace_id is not None:
         # keep spans OF the trace plus spans flow-linked INTO it: a
         # micro-batch dispatch span carries the first co-batched

@@ -164,6 +164,13 @@ class InferenceEngine:
             return tuple(out)
 
         self._jit = jax.jit(counted)
+        # per-bucket executable cost (obs.costmodel, ISSUE 13):
+        # computed lazily on the first instrumented dispatch of each
+        # bucket (obs_metrics on), published as cost gauges
+        self._cost_by_bucket: Dict[int, Any] = {}
+        from ..obs import hbm as obs_hbm
+        obs_hbm.register("params", self, lambda e: e._params,
+                         name="InferenceEngine.params")
 
     # -- model → pure fn ----------------------------------------------------
 
@@ -303,6 +310,43 @@ class InferenceEngine:
             padded.append(np.concatenate([a, pad], axis=0))
         return padded, rows, bucket
 
+    def bucket_cost(self, padded: Sequence[np.ndarray]):
+        """FLOPs + bytes of one dispatch of the covering bucket's
+        executable (:class:`~paddle1_tpu.obs.costmodel
+        .ExecutableCost`), memoized per bucket — XLA cost analysis of
+        a separate, UNCOUNTED lowering (lowering the counted jit would
+        corrupt the one-compile-per-bucket accounting)."""
+        import jax
+        from ..obs import costmodel as obs_costmodel
+        bucket = int(np.shape(padded[0])[0])
+        c = self._cost_by_bucket.get(bucket)
+        if c is None:
+            arrays = tuple(np.asarray(a) for a in padded)
+            fb = obs_costmodel.tree_size_cost(self._params,
+                                              batch=arrays)
+            c = obs_costmodel.analyze(
+                lambda: jax.jit(
+                    lambda p, i: self._pure(p, i)).lower(
+                    self._params, arrays),
+                fallback=fb)
+            with self._lock:
+                c = self._cost_by_bucket.setdefault(bucket, c)
+        return c
+
+    def _maybe_publish_cost(self, padded, bucket: int) -> None:
+        """Bucket cost gauges, first instrumented dispatch only
+        (``obs_metrics`` gates the one-time analysis trace — plain
+        serving pays a dict lookup)."""
+        from ..obs.registry import metrics_on
+        if not metrics_on():
+            return
+        cost = self.bucket_cost(padded)
+        self.metrics.gauge(f"cost_bucket_{bucket}_flops").set(
+            cost.flops)
+        self.metrics.gauge(f"cost_bucket_{bucket}_bytes").set(
+            cost.bytes_accessed)
+        self.metrics.gauge("cost_exact").set(1.0 if cost.exact else 0.0)
+
     def dispatch_padded(self, padded: Sequence[np.ndarray],
                         bucket: Optional[int] = None):
         """Run the bucket executable on already-padded inputs (the
@@ -316,6 +360,9 @@ class InferenceEngine:
         with self._lock:
             self.dispatch_counts[bucket] = \
                 self.dispatch_counts.get(bucket, 0) + 1
+        if self.metrics is not None \
+                and bucket not in self._cost_by_bucket:
+            self._maybe_publish_cost(padded, bucket)
         return self._jit(self._params, tuple(padded))
 
     def dispatch(self, arrays: Sequence[np.ndarray]):

@@ -386,6 +386,17 @@ class GenerationEngine:
         # contract becomes enforceable (limit=1) and the donated KV
         # cache is poisoned after every dispatch
         self._jsan = jit_sanitizer.site("GenerationEngine")
+        # executable cost attribution (obs.costmodel, ISSUE 13):
+        # computed lazily per executable on the first instrumented
+        # dispatch (obs_metrics on); the HBM census tags the engine's
+        # device state per subsystem (weakref — dies with the engine)
+        self._decode_cost = None
+        self._prefill_costs: Dict[int, object] = {}
+        from ..obs import hbm as obs_hbm
+        obs_hbm.register("params", self, lambda e: e._params,
+                         name="GenerationEngine.params")
+        obs_hbm.register("kv_cache", self, lambda e: e._kv,
+                         name="GenerationEngine.kv")
 
     @staticmethod
     def _resolve_prefill_buckets(buckets, max_seq):
@@ -420,6 +431,19 @@ class GenerationEngine:
 
     def _decode_fn(self, params, kv, lengths, tokens, keys, temps,
                    topks, active):
+        """Counted wrapper over :meth:`_decode_body` — the increment
+        runs only while TRACING (the standard trace-side-effect
+        counter). The cost model lowers ``_decode_body`` directly so
+        attribution can never corrupt the compile-ONCE accounting."""
+        with self._lock:
+            self.decode_compile_count += 1
+        if self.metrics is not None:
+            self.metrics.counter("gen_decode_compiles_total").inc()
+        return self._decode_body(params, kv, lengths, tokens, keys,
+                                 temps, topks, active)
+
+    def _decode_body(self, params, kv, lengths, tokens, keys, temps,
+                     topks, active):
         """One token for every slot; compiled exactly once. ``active``
         gates advancement — inactive slots keep their token/length, so
         parking a slot (backpressure, free slot) costs nothing and
@@ -428,10 +452,6 @@ class GenerationEngine:
         import jax.numpy as jnp
         from ..nn import MultiHeadAttention
         from ..nn.decode import sample_logits_array
-        with self._lock:
-            self.decode_compile_count += 1
-        if self.metrics is not None:
-            self.metrics.counter("gen_decode_compiles_total").inc()
         from ..core.tensor import Tensor
         S, M = self.slots, self.max_seq
         pos = jnp.minimum(lengths, M - 1)
@@ -458,52 +478,61 @@ class GenerationEngine:
         return new_kv, new_lengths, nxt, new_keys
 
     def _prefill_fn_for(self, bucket: int):
-        """Build (once per bucket) the prefill body: the whole padded
-        prompt in one causal pass, K/V written into the slot's cache
-        rows, first token sampled from the last REAL position."""
+        """Build (once per bucket) the counted prefill wrapper over
+        :meth:`_prefill_body` (same counted/uncounted split as
+        decode)."""
         import jax
 
         def prefill_fn(params, kv, ids, length, slot, key, temp, topk):
-            import jax.numpy as jnp
-            from ..nn import MultiHeadAttention
-            from ..nn.decode import sample_logits_array
             with self._lock:
                 self.prefill_compile_counts[bucket] = \
                     self.prefill_compile_counts.get(bucket, 0) + 1
             if self.metrics is not None:
                 self.metrics.counter("gen_prefill_compiles_total").inc()
-            from ..core.tensor import Tensor
-            L = bucket
-            small = []
-            for k_arr, v_arr in kv:
-                H, D = k_arr.shape[2], k_arr.shape[3]
-                z = jnp.zeros((1, L, H, D), k_arr.dtype)
-                small.append(MultiHeadAttention.GenCache(
-                    Tensor(z, stop_gradient=True),
-                    Tensor(z, stop_gradient=True),
-                    Tensor(jnp.zeros((1,), jnp.int32),
-                           stop_gradient=True)))
-            positions = jnp.arange(L, dtype=jnp.int32)[None]
-            causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
-            logits, filled = self._apply_model(
-                params, ids[None], small, positions, causal)
-            new_kv = []
-            for (k_arr, v_arr), c in zip(kv, filled):
-                new_kv.append((
-                    jax.lax.dynamic_update_slice(
-                        k_arr, c.k.data.astype(k_arr.dtype),
-                        (slot, 0, 0, 0)),
-                    jax.lax.dynamic_update_slice(
-                        v_arr, c.v.data.astype(v_arr.dtype),
-                        (slot, 0, 0, 0))))
-            last = jnp.take(logits[0], length - 1,
-                            axis=0).astype(jnp.float32)
-            kb = jax.random.wrap_key_data(key)
-            first = sample_logits_array(
-                last, jax.random.fold_in(kb, 0), temp, topk)
-            carry = jax.random.key_data(jax.random.fold_in(kb, 1))
-            return new_kv, first.astype(jnp.int32), carry
+            return self._prefill_body(bucket, params, kv, ids, length,
+                                      slot, key, temp, topk)
         return jax.jit(prefill_fn, donate_argnums=(1,))
+
+    def _prefill_body(self, bucket, params, kv, ids, length, slot, key,
+                      temp, topk):
+        """The prefill computation: the whole padded prompt in one
+        causal pass, K/V written into the slot's cache rows, first
+        token sampled from the last REAL position."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn import MultiHeadAttention
+        from ..nn.decode import sample_logits_array
+        from ..core.tensor import Tensor
+        L = bucket
+        small = []
+        for k_arr, v_arr in kv:
+            H, D = k_arr.shape[2], k_arr.shape[3]
+            z = jnp.zeros((1, L, H, D), k_arr.dtype)
+            small.append(MultiHeadAttention.GenCache(
+                Tensor(z, stop_gradient=True),
+                Tensor(z, stop_gradient=True),
+                Tensor(jnp.zeros((1,), jnp.int32),
+                       stop_gradient=True)))
+        positions = jnp.arange(L, dtype=jnp.int32)[None]
+        causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+        logits, filled = self._apply_model(
+            params, ids[None], small, positions, causal)
+        new_kv = []
+        for (k_arr, v_arr), c in zip(kv, filled):
+            new_kv.append((
+                jax.lax.dynamic_update_slice(
+                    k_arr, c.k.data.astype(k_arr.dtype),
+                    (slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                    v_arr, c.v.data.astype(v_arr.dtype),
+                    (slot, 0, 0, 0))))
+        last = jnp.take(logits[0], length - 1,
+                        axis=0).astype(jnp.float32)
+        kb = jax.random.wrap_key_data(key)
+        first = sample_logits_array(
+            last, jax.random.fold_in(kb, 0), temp, topk)
+        carry = jax.random.key_data(jax.random.fold_in(kb, 1))
+        return new_kv, first.astype(jnp.int32), carry
 
     # -- host-side dispatch -------------------------------------------------
 
@@ -553,6 +582,9 @@ class GenerationEngine:
             np.float32(temperature), np.int32(top_k))
         if donated is not None:
             self._jsan.poison_donated(donated)
+        if self.metrics is not None \
+                and bucket not in self._prefill_costs:
+            self._maybe_publish_prefill_cost(bucket)
         first = int(np.asarray(first))
         # slot bookkeeping (small host-side .at updates, off the jitted
         # path so they can't force a retrace)
@@ -584,8 +616,76 @@ class GenerationEngine:
             # compile means a signature leaked into the pinned shape
             self._jsan.note_signatures(self.decode_compile_count,
                                        kind="decode recompile", limit=1)
+        if self.metrics is not None and self._decode_cost is None:
+            self._maybe_publish_decode_cost()
         jit_sanitizer.note_host_sync("gen_token_readback")
         return np.asarray(self._tokens)  # noqa: hidden-host-sync — the ONE intended readback
+
+    # -- executable cost attribution (ISSUE 13) -----------------------------
+
+    def decode_cost(self):
+        """FLOPs + bytes of ONE decode dispatch (the whole slot batch,
+        one token each) — XLA cost analysis of an UNCOUNTED lowering
+        of :meth:`_decode_body` (lowering the counted jit would break
+        the compile-ONCE accounting). Memoized: the decode signature
+        is pinned, so one analysis covers the engine's lifetime."""
+        if self._decode_cost is None:
+            import jax
+            import jax.numpy as jnp
+            from ..obs import costmodel as obs_costmodel
+            args = (self._params, self._kv, self._lengths,
+                    self._tokens, self._keys, self._temps, self._topks,
+                    jnp.zeros([self.slots], bool))
+            fb = obs_costmodel.tree_size_cost(
+                self._params, batch=self._tokens, extra=self._kv)
+            self._decode_cost = obs_costmodel.analyze(
+                lambda: jax.jit(self._decode_body).lower(*args),
+                fallback=fb)
+        return self._decode_cost
+
+    def _maybe_publish_decode_cost(self) -> None:
+        from ..obs.registry import metrics_on
+        if not metrics_on():
+            return
+        cost = self.decode_cost()
+        self.metrics.gauge("gen_decode_flops").set(cost.flops)
+        self.metrics.gauge("gen_decode_bytes").set(cost.bytes_accessed)
+        self.metrics.gauge("gen_cost_exact").set(
+            1.0 if cost.exact else 0.0)
+
+    def prefill_cost(self, bucket: int):
+        """FLOPs + bytes of one prefill dispatch at ``bucket`` —
+        same uncounted-lowering discipline as :meth:`decode_cost`."""
+        c = self._prefill_costs.get(bucket)
+        if c is None:
+            import jax
+            import jax.numpy as jnp
+            import numpy as _np
+            from ..obs import costmodel as obs_costmodel
+            ids = jnp.zeros([bucket], jnp.int32)
+            base = jax.random.key_data(jax.random.fold_in(
+                jax.random.key(0), 0))
+            fb = obs_costmodel.tree_size_cost(self._params, batch=ids,
+                                              extra=self._kv)
+            c = obs_costmodel.analyze(
+                lambda: jax.jit(
+                    lambda *a: self._prefill_body(bucket, *a)).lower(
+                    self._params, self._kv, ids, _np.int32(1),
+                    _np.int32(0), base, _np.float32(0.0),
+                    _np.int32(0)),
+                fallback=fb)
+            self._prefill_costs[bucket] = c
+        return c
+
+    def _maybe_publish_prefill_cost(self, bucket: int) -> None:
+        from ..obs.registry import metrics_on
+        if not metrics_on():
+            return
+        cost = self.prefill_cost(bucket)
+        self.metrics.gauge(f"gen_prefill_bucket_{bucket}_flops").set(
+            cost.flops)
+        self.metrics.gauge(f"gen_prefill_bucket_{bucket}_bytes").set(
+            cost.bytes_accessed)
 
     def release(self, slot: int) -> None:
         """Free a slot: reset its cursor so idle writes stay parked at

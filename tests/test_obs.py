@@ -600,10 +600,39 @@ class TestMetricNameLint:
             "m.histogram('latency')\n"         # histogram without unit
             "m.gauge('CamelCase')\n"           # not snake_case
             "m.gauge('dual_ms')\n"
-            "m.histogram('dual_ms')\n")        # kind conflict
+            "m.histogram('dual_ms')\n"         # kind conflict
+            "m.gauge('used_mb')\n"             # non-canonical: _bytes
+            "m.gauge('wait_secs')\n"           # non-canonical: _seconds
+            "m.counter('io_kb_total')\n"       # bad unit under _total
+            "m.histogram('load_frac')\n")      # non-canonical: _ratio
         problems = mod.check([str(bad)])
         text = "\n".join(problems)
         assert "'requests' must end in '_total'" in text
         assert "needs a unit suffix" in text
         assert "not snake_case" in text
         assert "multiple kinds" in text
+        # ISSUE 13: the canonical-unit-spelling table
+        assert "'used_mb' uses non-canonical unit suffix '_mb'" in text
+        assert "spell it '_seconds'" in text
+        assert "'io_kb_total' uses non-canonical unit suffix " \
+               "'_kb'" in text
+        assert "'load_frac' uses non-canonical unit suffix " \
+               "'_frac'" in text
+
+    def test_canonical_suffixes_pass(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_metric_names",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+                "tools", "check_metric_names.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        good = tmp_path / "good.py"
+        good.write_text(
+            "m.gauge('hbm_params_bytes')\n"
+            "m.gauge('hbm_census_coverage_ratio')\n"
+            "m.gauge('slo_lat_burn_rate_ratio')\n"
+            "m.histogram('ckpt_write_bytes')\n"
+            "m.histogram('train_readback_seconds')\n")
+        assert mod.check([str(good)]) == []

@@ -12,7 +12,14 @@ what the Prometheus exposition (and the conformance test) depend on:
 * **counters end ``_total``** (the ``rate()`` convention), gauges and
   histograms must NOT;
 * **histograms carry a unit suffix** — ``_seconds``/``_ms``/``_us``/
-  ``_s``/``_per_s`` (or a known unitless family);
+  ``_s``/``_per_s``/``_bytes``/``_ratio`` (or a known unitless
+  family);
+* **canonical unit spellings** (ISSUE 13, all kinds): ``_seconds``
+  not ``_secs``/``_sec``/``_second``, ``_bytes`` not
+  ``_byte``/``_kb``/``_mb``/``_gb``, ``_ratio`` not
+  ``_pct``/``_percent``/``_frac``/``_fraction`` — the cost/HBM/SLO
+  gauge families (``hbm_*_bytes``, ``*_coverage_ratio``,
+  ``slo_*_burn_rate_ratio``) depend on dashboards keying one spelling;
 * **one family, one kind** across every module (the registry enforces
   it per instance at runtime; the lint catches cross-module collisions
   before they meet in one registry).
@@ -33,9 +40,22 @@ from .framework import Finding, LintPass
 
 METHODS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-HIST_UNIT_SUFFIXES = ("_seconds", "_ms", "_us", "_s", "_per_s")
+HIST_UNIT_SUFFIXES = ("_seconds", "_ms", "_us", "_s", "_per_s",
+                      "_bytes", "_ratio")
 # unitless histogram families that are ratios/fractions by nature
 HIST_UNITLESS_OK = {"batch_occupancy"}
+# canonical unit spellings (ISSUE 13): every kind — a counter named
+# x_mb_total or a gauge named x_secs breaks the dashboards that key
+# on one spelling per unit
+BAD_UNIT_SUFFIXES = (
+    ("_secs", "_seconds"), ("_sec", "_seconds"),
+    ("_second", "_seconds"),
+    ("_byte", "_bytes"), ("_kb", "_bytes"), ("_mb", "_bytes"),
+    ("_gb", "_bytes"), ("_kib", "_bytes"), ("_mib", "_bytes"),
+    ("_gib", "_bytes"),
+    ("_pct", "_ratio"), ("_percent", "_ratio"), ("_frac", "_ratio"),
+    ("_fraction", "_ratio"),
+)
 
 
 def repo_root() -> str:
@@ -98,6 +118,15 @@ def _site_problems(kind: str, name: str) -> List[str]:
         out.append(f"histogram {name!r} needs a unit suffix "
                    f"{HIST_UNIT_SUFFIXES} (or add it to the unitless "
                    "allowlist if it is a ratio)")
+    base = name[:-len("_total")] if (kind == "counter"
+                                     and name.endswith("_total")) \
+        else name
+    for bad, canon in BAD_UNIT_SUFFIXES:
+        if base.endswith(bad):
+            out.append(f"{kind} {name!r} uses non-canonical unit "
+                       f"suffix {bad!r} — spell it {canon!r} (one "
+                       "spelling per unit, the dashboard contract)")
+            break
     return out
 
 
