@@ -111,6 +111,19 @@ supervisor-restarted replica replays clean):
                        ``serve_chaos_slow_s`` (a hiccuping replica —
                        drives the adaptive-admission overload path).
 
+Collective-schedule point (checked by :func:`check_collective` from
+the ``distributed/collective.py`` wrappers — one shared counter per
+process, qualifier = the trainer rank)::
+
+``collective_skip``  — ``collective_skip@N[:R]``: rank R (any rank
+                       when unqualified) SKIPS its Nth collective op —
+                       the wrapper returns its input untouched and
+                       journals nothing, seeding exactly the
+                       rank-divergent schedule the collective-schedule
+                       sanitizer's cross-rank verifier must turn into
+                       a typed CollectiveDivergenceError (on hardware
+                       this shape deadlocks).
+
 Generative-serving points (checked by :func:`check_gen_step` once per
 continuous-batching decode step; the qualifier is a SLOT id)::
 
@@ -139,13 +152,13 @@ __all__ = [
     "maybe_poison", "check_checkpoint_write", "check_loader",
     "check_preempt", "check_serve_slow", "check_worker",
     "check_sample", "check_loader_worker_kill", "check_loader_stall",
-    "check_replica", "check_gen_step",
+    "check_replica", "check_gen_step", "check_collective",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
     "LOADER_WORKER_KILL", "CORRUPT_SAMPLE", "LOADER_STALL",
     "REPLICA_KILL", "REPLICA_HANG", "REPLICA_SLOW",
-    "GEN_SLOT_WEDGE", "GEN_SLOW_STEP",
+    "GEN_SLOT_WEDGE", "GEN_SLOW_STEP", "COLLECTIVE_SKIP",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -164,6 +177,7 @@ REPLICA_HANG = "replica_hang"
 REPLICA_SLOW = "replica_slow"
 GEN_SLOT_WEDGE = "gen_slot_wedge"
 GEN_SLOW_STEP = "gen_slow_step"
+COLLECTIVE_SKIP = "collective_skip"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 # loader points share the worker points' ":qualifier" grammar, but the
@@ -174,8 +188,10 @@ _REPLICA_POINTS = (REPLICA_KILL, REPLICA_HANG, REPLICA_SLOW)
 # generative-serving points: the qualifier is a decode SLOT id; both
 # share the per-step counter check_gen_step advances
 _GEN_POINTS = (GEN_SLOT_WEDGE, GEN_SLOW_STEP)
+# collective-schedule point: the qualifier is the trainer rank
+_COLLECTIVE_POINTS = (COLLECTIVE_SKIP,)
 _QUALIFIED_POINTS = (_WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
-                     + _GEN_POINTS)
+                     + _GEN_POINTS + _COLLECTIVE_POINTS)
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
            PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
@@ -458,6 +474,17 @@ def check_gen_step(active_slots) -> Tuple[Optional[int], bool]:
                 wedged = slot
             break
     return wedged, slow
+
+
+def check_collective(rank: int) -> bool:
+    """``collective_skip``: True on an armed collective-op occurrence
+    for trainer ``rank`` (``collective_skip@N:R`` = rank R's Nth
+    collective; without ``:R`` any rank's Nth matches). The *action*
+    (returning the input untouched, journaling nothing) belongs to the
+    ``distributed/collective.py`` wrappers — this stays pure
+    bookkeeping. Fires exactly once, so a retried operation replays
+    clean."""
+    return enabled() and _fire_qualified(COLLECTIVE_SKIP, rank)
 
 
 def _fire_qualified(point: str, qualifier: int) -> bool:

@@ -1,0 +1,460 @@
+"""Runtime collective-schedule sanitizer (ISSUE 14).
+
+The static passes (``tools/lint/rank_divergence.py``,
+``commit_protocol.py``) see lexical shapes; they cannot see a schedule
+composed across helpers at runtime — a retry loop that re-enters a
+barrier on one rank only, a data-dependent branch that skips an
+all-reduce. A real divergence on hardware HANGS: rank 0 waits at a
+rendezvous its peers never reach, and the job wedges until a hang
+timeout fires with no pointer at the cause. This module makes that
+divergence a deterministic, typed, CPU-testable failure, the
+``core/locks.py`` way: one flag (``debug_collective_sanitizer``),
+structurally zero cost off, loud on.
+
+* **Per-rank schedule journal** — every collective wrapper
+  (``distributed/collective.py``) and the checkpoint commit barrier
+  call :func:`note_collective`, which records
+  ``(seq, site, op, tree-shape digest)`` — and appends it as one JSONL
+  line to ``collective-<rank>.jsonl`` under the journal dir. The
+  journal is the rank's claimed SPMD schedule, written even where the
+  collective is an eager no-op (single process, CPU) — which is
+  exactly what makes the multi-rank deadlock testable on a laptop:
+  the schedules diverge even though nothing blocks.
+
+* **Cross-rank verifier** — :func:`verify_dir` /
+  :class:`JournalWatcher` compare every rank's journal against rank
+  0's (well, the lowest recorded rank's) and raise the typed
+  :class:`CollectiveDivergenceError` naming the FIRST diverging step,
+  both ranks' entries at it, and each side's surrounding schedule.
+  The Supervisor polls a watcher each sweep when the flag is on
+  (incremental — per-file offsets, no re-reads), and
+  ``python -m tools.collective_verify <dir>`` runs the full check
+  (including completion: a rank whose journal simply STOPS while
+  peers continue is the would-be deadlock) from the command line.
+
+* **Journal-dir plumbing** — the Supervisor stamps
+  ``FLAGS_debug_collective_sanitizer`` and the ``PADDLE_COLLECTIVE_
+  JOURNAL`` dir env into worker envs; the worker's sanitizer consumes
+  (pops) the dir env when it arms, so grandchildren (loader worker
+  processes) can never journal onto the rank's file — the PR 3
+  heartbeat-env lesson. A grandchild that inherits only the flag
+  records in memory and writes nothing.
+
+Off (the default) is structurally free: :func:`note_collective` is one
+module-bool test, no journal file is ever created, and the collective
+wrappers are plain pass-throughs (the zero-cost test pins all three).
+The armed latch derives from the flag at import (workers: the
+Supervisor's ``FLAGS_`` env) and at :func:`reset` (in-process tests:
+``flags_guard`` + ``reset()``), mirroring ``core/jit_sanitizer``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .errors import EnforceNotMet
+
+__all__ = ["CollectiveDivergenceError", "JOURNAL_ENV", "sanitizing",
+           "note_collective", "schedule", "reset", "journal_path",
+           "read_journal", "verify_dir", "verify_schedules",
+           "JournalWatcher", "journal_file_name", "journal_rank_count"]
+
+
+class CollectiveDivergenceError(EnforceNotMet):
+    """Two ranks claim different collective schedules — the SPMD
+    deadlock class, made loud before anything blocks."""
+
+
+# the Supervisor stamps this into worker envs; the worker's sanitizer
+# POPS it at arm time so grandchild processes cannot inherit it and
+# journal onto the rank's file
+JOURNAL_ENV = "PADDLE_COLLECTIVE_JOURNAL"
+
+_lock = threading.Lock()
+_armed = False
+_seq = 0
+_records: List[Dict[str, Any]] = []
+_journal_dir: str = ""
+_rank: int = 0
+# the worker's restart incarnation (PR 3 env protocol): journals are
+# PER-INCARNATION files, because a resized/restarted world replays its
+# schedule from the resume point — appending the replay onto the old
+# life's journal would read as a false divergence against peers whose
+# old lives ended elsewhere. Each epoch verifies within itself.
+_incarnation: int = 0
+_fh = None
+
+
+def sanitizing() -> bool:
+    """Whether the ``debug_collective_sanitizer`` flag is on (read at
+    arm time — the hot path tests the module bool, not the flag)."""
+    from . import flags as core_flags
+    return bool(core_flags.flag("debug_collective_sanitizer"))
+
+
+def _env_rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _env_incarnation() -> int:
+    """The worker's restart incarnation: health's adopted value when
+    its channel already installed, else a PEEK at the env (never a
+    pop — the heartbeat channel owns consuming it)."""
+    from . import health
+    try:
+        if getattr(health, "_installed", False):
+            return int(health.incarnation())
+        return int(os.environ.get(health.INCARNATION_ENV, "0") or 0)
+    except (ValueError, AttributeError):  # pragma: no cover
+        return 0
+
+
+def reset() -> None:
+    """Drop the recorded schedule, close the journal, and re-derive the
+    armed latch from the CURRENT flag (test isolation — and the
+    in-process way to arm after ``set_flags``: the latch otherwise
+    derives once at import, where workers get it from the
+    Supervisor-stamped env). Re-reads ``PADDLE_COLLECTIVE_JOURNAL``
+    (consuming it) / the ``collective_journal_dir`` flag and the
+    rank env."""
+    global _armed, _seq, _journal_dir, _rank, _incarnation, _fh
+    with _lock:
+        _records.clear()
+        _seq = 0
+        if _fh is not None:
+            try:
+                _fh.close()
+            except OSError:  # pragma: no cover
+                pass
+            _fh = None
+        _armed = sanitizing()
+        _journal_dir = ""
+        if _armed:
+            # consume the dir env: grandchildren must NOT inherit it
+            # (they'd interleave their schedule into the rank's file)
+            env_dir = os.environ.pop(JOURNAL_ENV, "")
+            if not env_dir:
+                from . import flags as core_flags
+                env_dir = core_flags.flag("collective_journal_dir")
+            _journal_dir = env_dir or ""
+            _rank = _env_rank()
+            _incarnation = _env_incarnation()
+
+
+def journal_file_name(rank: int, incarnation: int = 0) -> str:
+    """Per-rank, per-incarnation journal name: a restarted/resized
+    life writes a FRESH file (``.r<n>`` suffix) — its replayed
+    schedule is a new epoch, not an append onto the old life's."""
+    if incarnation:
+        return f"collective-{rank}.r{incarnation}.jsonl"
+    return f"collective-{rank}.jsonl"
+
+
+def journal_path() -> Optional[str]:
+    """This process's journal file (None when unarmed or in-memory)."""
+    if not _armed or not _journal_dir:
+        return None
+    return os.path.join(_journal_dir,
+                        journal_file_name(_rank, _incarnation))
+
+
+def _shape_spec(args: Iterable[Any]) -> str:
+    """Compact tree-shape text of the collective's tensor arguments:
+    ``f32[4,8];i32[2]``. Only shape/dtype ride the digest — values
+    legitimately differ per rank, shapes must not."""
+    parts: List[str] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None and isinstance(a, (list, tuple)):
+            parts.append(f"seq{len(a)}")
+            continue
+        if shape is None:
+            parts.append(type(a).__name__)
+            continue
+        d = str(dtype) if dtype is not None else "?"
+        parts.append(f"{d}[{','.join(str(int(s)) for s in shape)}]")
+    return ";".join(parts)
+
+
+def _caller_site(depth: int) -> str:
+    """``file.py:line`` of the frame ``depth`` levels above
+    note_collective — computed only when armed."""
+    try:
+        fr = sys._getframe(depth + 1)  # +1 for this helper
+        return (f"{os.path.basename(fr.f_code.co_filename)}:"
+                f"{fr.f_lineno}")
+    except ValueError:  # pragma: no cover - shallow stack
+        return "?"
+
+
+def note_collective(op: str, args: Iterable[Any] = (),
+                    site: Optional[str] = None, depth: int = 1) -> None:
+    """Record one collective op this process claims to perform. Free
+    when unarmed (one module-bool test). ``site`` defaults to the
+    frame ``depth`` levels above this function (1 = the direct
+    caller); the collective wrappers route through a shared helper and
+    pass 3 — ``note_collective ← helper ← wrapper ← USER`` — so the
+    journal names the user's call line, not the wrapper's."""
+    global _seq, _fh
+    if not _armed:
+        return
+    spec = _shape_spec(args)
+    digest = hashlib.sha1(spec.encode()).hexdigest()[:10]
+    if site is None:
+        site = _caller_site(depth)
+    with _lock:
+        _seq += 1
+        rec = {"seq": _seq, "site": site, "op": op, "shape": spec,
+               "digest": digest}
+        _records.append(rec)
+        if _journal_dir:
+            if _fh is None:
+                os.makedirs(_journal_dir, exist_ok=True)
+                _fh = open(os.path.join(
+                    _journal_dir,
+                    journal_file_name(_rank, _incarnation)), "a")
+            _fh.write(json.dumps(rec) + "\n")
+            _fh.flush()
+
+
+def schedule() -> List[Dict[str, Any]]:
+    """Copy of this process's recorded schedule (test hook)."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+# -- cross-rank verification --------------------------------------------------
+
+
+def _entry_key(rec: Dict[str, Any]) -> Tuple[str, str, str]:
+    return (str(rec.get("site", "?")), str(rec.get("op", "?")),
+            str(rec.get("digest", "?")))
+
+
+def _entry_text(rec: Optional[Dict[str, Any]]) -> str:
+    if rec is None:
+        return "<no entry — schedule ends>"
+    shape = rec.get("shape")
+    if not shape:  # barrier-style ops carry no tensor args
+        shape = "no args"
+    return f"{rec.get('op')} @ {rec.get('site')} [{shape}]"
+
+
+def _window(records: List[Dict[str, Any]], idx: int,
+            span: int = 2) -> str:
+    lo = max(0, idx - span)
+    out = []
+    for i in range(lo, min(len(records), idx + span + 1)):
+        mark = ">>" if i == idx else "  "
+        out.append(f"    {mark} #{i + 1} {_entry_text(records[i])}")
+    if idx >= len(records):
+        out.append(f"    >> #{idx + 1} {_entry_text(None)}")
+    return "\n".join(out)
+
+
+def verify_schedules(by_rank: Dict[int, List[Dict[str, Any]]],
+                     complete: bool = False, start: int = 0) -> int:
+    """Compare every rank's claimed schedule against the lowest rank's.
+    Returns the number of verified steps (the common prefix length).
+    Raises :class:`CollectiveDivergenceError` naming the first
+    diverging step when two ranks disagree — and, with
+    ``complete=True`` (the job-end/CLI mode), when one rank's schedule
+    simply STOPS while another continues (the would-be deadlock: the
+    longer rank waits at a rendezvous the shorter one never reaches).
+    ``start`` skips an already-verified prefix (the watcher's
+    incremental mode) — entries before it are trusted, not re-read.
+    """
+    if len(by_rank) < 2:
+        return len(next(iter(by_rank.values()))) if by_rank else 0
+    ranks = sorted(by_rank)
+    ref_rank = ranks[0]
+    ref = by_rank[ref_rank]
+    verified = len(ref)
+    for r in ranks[1:]:
+        recs = by_rank[r]
+        n = min(len(ref), len(recs))
+        for i in range(start, n):
+            if _entry_key(ref[i]) != _entry_key(recs[i]):
+                raise CollectiveDivergenceError(
+                    f"collective schedules diverge at step {i + 1}: "
+                    f"rank {ref_rank} performed "
+                    f"{_entry_text(ref[i])} while rank {r} performed "
+                    f"{_entry_text(recs[i])} — on hardware the ranks "
+                    "would deadlock at this rendezvous. Schedules "
+                    "around the divergence:\n"
+                    f"  rank {ref_rank}:\n{_window(ref, i)}\n"
+                    f"  rank {r}:\n{_window(recs, i)}")
+        if complete and len(ref) != len(recs):
+            longer_rank, longer = ((ref_rank, ref) if len(ref) > n
+                                   else (r, recs))
+            shorter_rank = r if longer_rank == ref_rank else ref_rank
+            raise CollectiveDivergenceError(
+                f"collective schedules diverge at step {n + 1}: rank "
+                f"{shorter_rank}'s schedule ends after {n} "
+                f"collective(s) while rank {longer_rank} continues "
+                f"with {_entry_text(longer[n])} — rank {longer_rank} "
+                "would block at that rendezvous forever. Schedules "
+                "around the divergence:\n"
+                f"  rank {longer_rank}:\n{_window(longer, n)}\n"
+                f"  rank {shorter_rank}:\n"
+                f"{_window(by_rank[shorter_rank], n)}")
+        verified = min(verified, n)
+    return verified
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse one rank's journal; a torn final line (the writer was
+    killed mid-record) is skipped, never crashed on."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue  # torn write
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _journal_files(directory: str) -> Dict[int, Dict[int, str]]:
+    """``{incarnation_epoch: {rank: path}}`` for every per-rank
+    journal under ``directory``. Each restart/resize epoch verifies
+    within itself: a resized world replays its schedule from the
+    resume point, which is a NEW epoch, not a continuation."""
+    out: Dict[int, Dict[int, str]] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("collective-")
+                and name.endswith(".jsonl")):
+            continue
+        mid = name[len("collective-"):-len(".jsonl")]
+        rank_s, _, inc_s = mid.partition(".r")
+        try:
+            rank = int(rank_s)
+            inc = int(inc_s) if inc_s else 0
+        except ValueError:
+            continue
+        out.setdefault(inc, {})[rank] = os.path.join(directory, name)
+    return out
+
+
+def journal_rank_count(directory: str) -> int:
+    """Ranks journaled in the busiest epoch (the CLI's ≥2 gate)."""
+    files = _journal_files(directory)
+    return max((len(v) for v in files.values()), default=0)
+
+
+def verify_dir(directory: str, complete: bool = False) -> int:
+    """Verify every per-rank journal under ``directory``, each
+    incarnation epoch within itself (see :func:`verify_schedules`).
+    Returns total verified steps across epochs; 0 when no epoch holds
+    two ranks to compare."""
+    total = 0
+    for inc, by_rank in sorted(_journal_files(directory).items()):
+        if len(by_rank) < 2:
+            continue
+        total += verify_schedules(
+            {r: read_journal(p) for r, p in by_rank.items()},
+            complete=complete)
+    return total
+
+
+class JournalWatcher:
+    """Incremental cross-rank verifier for a live journal dir — what
+    the Supervisor polls each sweep. Keeps per-file byte offsets so a
+    poll reads only NEW records, and a per-epoch verified-prefix
+    cursor so already-agreed steps are never re-compared (a long run
+    stays O(records), not O(records x sweeps)). Ranks mid-run are
+    legitimately at different positions, so :meth:`poll` compares
+    only the common prefix (divergence in it is already fatal);
+    :meth:`final` adds the completion check for a cleanly finished
+    job — a schedule that simply STOPS short of its peers' is the
+    would-be deadlock."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._offsets: Dict[Tuple[int, int], int] = {}
+        # epoch -> rank -> records
+        self._epochs: Dict[int, Dict[int, List[Dict[str, Any]]]] = {}
+        # epoch -> (verified steps, rank count at verification time —
+        # a rank joining late must re-verify from 0 against everyone)
+        self._verified: Dict[int, Tuple[int, int]] = {}
+
+    def _ingest(self) -> None:
+        for inc, by_rank in _journal_files(self.directory).items():
+            for rank, path in by_rank.items():
+                off = self._offsets.get((inc, rank), 0)
+                try:
+                    with open(path, "rb") as f:  # byte offsets: exact
+                        f.seek(off)
+                        chunk = f.read()
+                except OSError:
+                    continue
+                recs = self._epochs.setdefault(inc, {}).setdefault(
+                    rank, [])
+                consumed = 0
+                for raw in chunk.splitlines(keepends=True):
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail: re-read next poll
+                    consumed += len(raw)
+                    ln = raw.decode("utf-8", errors="replace").strip()
+                    if not ln:
+                        continue
+                    try:
+                        rec = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        recs.append(rec)
+                self._offsets[(inc, rank)] = off + consumed
+
+    def _verify(self, complete: bool) -> int:
+        total = 0
+        for inc, by_rank in sorted(self._epochs.items()):
+            if len(by_rank) < 2:
+                continue
+            done, nranks = self._verified.get(inc, (0, 0))
+            if nranks != len(by_rank):
+                done = 0  # a new rank appeared: its prefix is unseen
+            n = verify_schedules(by_rank, complete=complete,
+                                 start=done)
+            self._verified[inc] = (n, len(by_rank))
+            total += n
+        return total
+
+    def poll(self) -> int:
+        """Ingest new records and verify the (new part of the) common
+        prefix. Raises :class:`CollectiveDivergenceError` on
+        divergence."""
+        self._ingest()
+        return self._verify(complete=False)
+
+    def final(self) -> int:
+        """Job-end verification including the completion check."""
+        self._ingest()
+        return self._verify(complete=True)
+
+
+# arm at import: workers reach here with the Supervisor-stamped
+# FLAGS_/journal env already in place (in-process enabling goes through
+# flags_guard/set_flags + reset(), the jit_sanitizer idiom)
+reset()

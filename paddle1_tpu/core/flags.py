@@ -203,6 +203,33 @@ def _define_builtin_flags() -> None:
                 "is structurally free: make_lock returns a plain "
                 "threading.Lock. Enabled for the CI concurrency "
                 "lanes.")
+    define_flag("debug_collective_sanitizer", False,
+                "Runtime collective-schedule sanitizer (core/"
+                "collective_sanitizer.py): every collective wrapper "
+                "(distributed/collective.py) and the checkpoint "
+                "commit barrier journal (seq, site, op, tree-shape "
+                "digest) per rank; the cross-rank verifier — polled "
+                "by the Supervisor each sweep and runnable via "
+                "python -m tools.collective_verify — raises typed "
+                "CollectiveDivergenceError naming the first step "
+                "where two ranks' schedules disagree, so the "
+                "rank-divergent collective that HANGS on hardware "
+                "becomes a deterministic CPU-testable failure. Off "
+                "(the default) is structurally free: note_collective "
+                "is one module-bool test and no journal file is ever "
+                "created. Enabled for the CI debug-sanitizers lane. "
+                "The Supervisor forwards FLAGS_debug_collective_"
+                "sanitizer plus the journal-dir env to workers; the "
+                "worker consumes the dir env at arm time so "
+                "grandchildren never journal onto the rank's file.")
+    define_flag("collective_journal_dir", "",
+                "Where the collective-schedule sanitizer writes its "
+                "per-rank collective-<rank>.jsonl journals. Empty "
+                "(the default): a supervised worker uses the dir the "
+                "Supervisor stamped into PADDLE_COLLECTIVE_JOURNAL "
+                "(derived from its log/heartbeat dir), and an "
+                "unsupervised armed process records in memory only "
+                "(schedule() still works; no files).")
     define_flag("debug_jit_sanitizer", False,
                 "Runtime JIT-discipline sanitizer (core/jit_sanitizer"
                 ".py): engine/serving/generate jit entry points raise "

@@ -322,9 +322,19 @@ class CheckpointManager:
         # writes only the shards it owns); single-host, a pid suffix
         # keeps concurrent managers from clobbering each other's tmp
         tmp = f"{final}.tmp" if multi else f"{final}.tmp-{os.getpid()}"
+        if multi:
+            # the orbax save is itself a collective (every process
+            # writes its shards against the same path): journal it
+            from ..core import collective_sanitizer
+            collective_sanitizer.note_collective(
+                "ckpt_save_sharded", (),
+                site=f"checkpoint.save:{int(step)}")
         save_sharded(tmp, state)
         commit_err: Optional[Exception] = None
-        if jax.process_index() == 0:
+        # one committer, everyone learns the outcome: the guarded
+        # commit below is paired with the broadcast_one_to_all outcome
+        # barrier — the pairing the commit-protocol lint pass enforces
+        if jax.process_index() == 0:  # commit-protocol: ckpt-commit
             try:
                 from ..core import chaos
                 chaos.check_checkpoint_write()  # injected mid-write
@@ -357,6 +367,15 @@ class CheckpointManager:
             # success for a checkpoint that was never committed
             import numpy as _np
             from jax.experimental import multihost_utils
+
+            # the commit barrier is part of the rank's collective
+            # schedule: journal it so a rank-conditional retry that
+            # re-enters it alone (the PR 2 shape) diverges loudly
+            # under the collective-schedule sanitizer
+            from ..core import collective_sanitizer
+            collective_sanitizer.note_collective(
+                "ckpt_outcome_broadcast", (),
+                site=f"checkpoint.save:{int(step)}")
             ok = multihost_utils.broadcast_one_to_all(
                 _np.asarray(commit_err is None))
             if not bool(ok):

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..autograd.engine import apply
+from ..core import chaos, collective_sanitizer
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from ..core.tensor import Tensor, to_tensor
 from . import env
@@ -201,11 +202,28 @@ def _assign(tensor: Tensor, result: Tensor) -> Tensor:
 # ---------------------------------------------------------------------------
 
 
+def _skip_collective(op: str, args) -> bool:
+    """Per-wrapper entry for the SPMD-discipline runtime (ISSUE 14):
+    journals this op into the collective-schedule sanitizer (site = the
+    USER'S call line; free when the flag is off) and returns True when
+    an armed ``collective_skip`` chaos point says THIS rank skips it —
+    the wrapper then returns its input untouched and journals nothing,
+    seeding the rank-divergent schedule the cross-rank verifier must
+    catch. Both checks are one bool test when nothing is armed."""
+    if chaos.enabled() and chaos.check_collective(env.get_rank()):
+        return True
+    # depth 3: note_collective <- here <- wrapper <- USER call site
+    collective_sanitizer.note_collective(op, args, depth=3)
+    return False
+
+
 def all_reduce(tensor: Tensor, op: int = ReduceOp.SUM,
                group: Optional[Group] = None, sync_op: bool = True) -> Tensor:
     """In-place all-reduce (reference collective.py:397 → c_allreduce_sum
     kernel c_allreduce_op.h:253). Under SPMD trace → lax.psum over the
     group's mesh axis."""
+    if _skip_collective("all_reduce", (tensor,)):
+        return tensor
     axis = _resolve_axis(group)
 
     def f(x):
@@ -222,6 +240,8 @@ def reduce(tensor: Tensor, dst: int = 0, op: int = ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True) -> Tensor:
     """Reduce-to-root. XLA has no single-destination reduce on a mesh axis;
     all-reduce and mask is the idiomatic (and on ICI, equal-cost ring) form."""
+    if _skip_collective("reduce", (tensor,)):
+        return tensor
     axis = _resolve_axis(group)
 
     def f(x):
@@ -241,6 +261,8 @@ def broadcast(tensor: Tensor, src: int = 0,
               group: Optional[Group] = None, sync_op: bool = True) -> Tensor:
     """Broadcast from group-rank ``src`` (reference collective.py:330 →
     c_broadcast). In-graph form: select src's shard and psum the rest away."""
+    if _skip_collective("broadcast", (tensor,)):
+        return tensor
     axis = _resolve_axis(group)
 
     def f(x):
@@ -258,6 +280,10 @@ def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor,
     """Gather shards from every rank (reference collective.py:572 →
     c_allgather). Appends per-rank tensors to ``tensor_list``; also returns
     the stacked result for functional use."""
+    if _skip_collective("all_gather", (tensor,)):
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+        return tensor
     axis = _resolve_axis(group)
     n = _nranks(group)
 
@@ -286,6 +312,8 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
                    sync_op: bool = True) -> Tensor:
     """Reduce-scatter (reference c_reducescatter op). Input: concatenated
     [n*chunk, ...] or list of n tensors; output shard into ``tensor``."""
+    if _skip_collective("reduce_scatter", (tensor,)):
+        return tensor
     axis = _resolve_axis(group)
     if isinstance(tensor_or_tensor_list, (list, tuple)):
         from ..ops import manip_ops
@@ -308,6 +336,8 @@ def scatter(tensor: Tensor, tensor_list: Optional[List[Tensor]] = None,
             sync_op: bool = True) -> Tensor:
     """Scatter list from src (reference collective.py:650 → c_scatter:
     broadcast + slice by rank)."""
+    if _skip_collective("scatter", (tensor,)):
+        return tensor
     axis = _resolve_axis(group)
     if tensor_list:
         from ..ops import manip_ops
@@ -331,6 +361,11 @@ def alltoall(in_tensor_list, out_tensor_list: Optional[list] = None,
     """All-to-all (reference operators/collective/alltoall_op). Accepts a
     list of n tensors (one per peer) or a single [n*chunk,...] tensor; under
     trace lowers to lax.all_to_all over the axis."""
+    if _skip_collective("alltoall", (in_tensor_list,)):
+        if out_tensor_list is not None and isinstance(
+                in_tensor_list, (list, tuple)):
+            out_tensor_list.extend(in_tensor_list)
+        return in_tensor_list
     axis = _resolve_axis(group)
     if isinstance(in_tensor_list, (list, tuple)):
         from ..ops import manip_ops
@@ -397,10 +432,17 @@ def barrier(group: Optional[Group] = None) -> None:
     """Reference collective.py:158 barrier op. XLA programs are globally
     scheduled, so in-graph barriers are unnecessary; across hosts this
     syncs via the coordination service when multi-process."""
+    if _skip_collective("barrier", ()):
+        return
     try:
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("paddle1_tpu_barrier")
+            from jax.experimental.multihost_utils import \
+                sync_global_devices
+            # best-effort by design: single-host runs have no
+            # coordination service (the sync raising there must not
+            # fail the barrier API), and a real multi-host init
+            # failure already surfaced at jax.distributed.initialize
+            sync_global_devices("paddle1_tpu_barrier")  # noqa: collective-swallow — see note
     except Exception:
         pass
 
@@ -547,6 +589,8 @@ def hierarchical_all_reduce(x, intra_axis: str, inter_axis: str):
     hierarchically per the mesh topology — this explicit form exists
     for shard_map code paths and for strategy parity.
     """
+    if _skip_collective("hierarchical_all_reduce", (x,)):
+        return x
     import jax
 
     def f(v):

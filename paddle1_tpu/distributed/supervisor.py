@@ -164,6 +164,9 @@ class SupervisorReport:
     # elastic membership changes: [{"from", "to", "reason"}] in order
     resizes: List[Dict[str, Any]] = field(default_factory=list)
     world_size: Optional[int] = None  # current logical world
+    # the CollectiveDivergenceError message when the sweep-time
+    # cross-rank verifier caught a diverging schedule (ISSUE 14)
+    collective_divergence: Optional[str] = None
 
     @property
     def total_restarts(self) -> int:
@@ -181,6 +184,7 @@ class SupervisorReport:
                 "drained": self.drained,
                 "resizes": [dict(r) for r in self.resizes],
                 "world_size": self.world_size,
+                "collective_divergence": self.collective_divergence,
                 "exit_code": self.exit_code}
 
 
@@ -504,6 +508,7 @@ class Supervisor:
         env[STACKDUMP_ENV] = w.dump_path
         env[INCARNATION_ENV] = str(w.incarnation)
         self._obs_worker_env(w, env)
+        self._collective_worker_env(env)
         stdout = None
         if w.log_path:
             if w.log_fh is not None:  # previous incarnation's handle
@@ -550,6 +555,30 @@ class Supervisor:
         entry = obs_trace.env_entry()
         if entry is not None and entry[0] not in env:
             env[entry[0]] = entry[1]
+
+    def _collective_worker_env(self, env: Dict[str, str]) -> None:
+        """Stamp the collective-schedule sanitizer into one worker's
+        env (ISSUE 14): the flag (so ``set_flags`` in the supervisor
+        process reaches env-only children) and the per-job journal dir
+        the sweep-time verifier reads. The dir rides its own
+        ``PADDLE_COLLECTIVE_JOURNAL`` env, which the worker's
+        sanitizer CONSUMES at arm time — grandchildren (loader worker
+        processes) must never journal onto the rank's file (the PR 3
+        heartbeat-env lesson). Explicit worker env always wins."""
+        if not core_flags.flag("debug_collective_sanitizer"):
+            return
+        from ..core import collective_sanitizer as csan
+        env.setdefault("FLAGS_debug_collective_sanitizer", "1")
+        env.setdefault(csan.JOURNAL_ENV, self._collective_journal_dir())
+
+    def _collective_journal_dir(self) -> str:
+        """The journal dir this job's workers write and the sweep
+        verifier reads: the ``collective_journal_dir`` flag, or a
+        ``collective/`` subdir of the heartbeat dir."""
+        d = core_flags.flag("collective_journal_dir") or os.path.join(
+            self._heartbeat_dir(), "collective")
+        os.makedirs(d, exist_ok=True)
+        return d
 
     def start(self) -> "Supervisor":
         """Spawn every registered (not yet running) respawnable worker."""
@@ -1110,12 +1139,40 @@ class Supervisor:
         self._terminate_all()
         return f.exit_code if f.exit_code is not None else 1
 
+    def _poll_collective_schedules(self, watcher,
+                                   final: bool = False) -> None:
+        """One sweep of the cross-rank collective-schedule verifier
+        (``final=True`` at clean job completion adds the completion
+        check: a rank whose schedule simply STOPS short of its peers'
+        — the canonical skipped-last-collective deadlock — must not
+        pass as success). On divergence: kill the pod (the ranks are
+        headed for a deadlock — on hardware they would already be
+        blocked), record the evidence on the report, and re-raise the
+        typed error."""
+        from ..core.collective_sanitizer import CollectiveDivergenceError
+        try:
+            if final:
+                watcher.final()
+            else:
+                watcher.poll()
+        except CollectiveDivergenceError as e:
+            self.report.collective_divergence = str(e)
+            print(f"supervisor: collective-schedule divergence — "
+                  f"failing the pod\n{e}", file=sys.stderr)
+            self._terminate_all()
+            self.report.exit_code = 1
+            raise
+
     def run(self) -> int:
         """Supervise until the job completes (every non-essential worker
         exited 0 — essential workers, e.g. PS servers, are then torn
         down) or a failure ends it per policy. Returns the pod exit
         code. KeyboardInterrupt kills the pod and re-raises (the
-        reference watch contract)."""
+        reference watch contract). With ``debug_collective_sanitizer``
+        on, every sweep also cross-checks the workers' collective
+        journals and raises the typed ``CollectiveDivergenceError``
+        (pod torn down, evidence on ``report.collective_divergence``)
+        when two ranks' schedules disagree."""
         self.start()
         if not self._trainers():
             # essential=True means "must outlive the trainers"; with no
@@ -1131,8 +1188,18 @@ class Supervisor:
         # single-controller fleet (resize rewrites world coordinates)
         self._procs_track_world = (
             len(self._elastic_workers()) == self.world_size)
+        # collective-schedule verifier (ISSUE 14): when the sanitizer
+        # flag is on, every sweep cross-checks the per-rank journals —
+        # a diverging schedule (the would-be multi-host deadlock)
+        # fails the pod typed while the ranks are still heartbeating
+        watcher = None
+        if core_flags.flag("debug_collective_sanitizer"):
+            from ..core.collective_sanitizer import JournalWatcher
+            watcher = JournalWatcher(self._collective_journal_dir())
         try:
             while True:
+                if watcher is not None:
+                    self._poll_collective_schedules(watcher)
                 sweep = []
                 for w in list(self._workers.values()):
                     f = self._classify(w)
@@ -1148,6 +1215,17 @@ class Supervisor:
                     # any pending resize request: a grow racing the
                     # last trainer's exit must not respawn a finished
                     # fleet
+                    if watcher is not None and not (
+                            self.report.failures
+                            or self.report.resizes):
+                        # clean completion: every rank must claim the
+                        # SAME complete schedule — a strict-prefix
+                        # journal (one rank skipped its last
+                        # collective) is the deadlock shape, not a
+                        # success. Skipped after failures/resizes: a
+                        # killed rank's epoch legitimately ends early
+                        self._poll_collective_schedules(watcher,
+                                                        final=True)
                     self._terminate_all()  # tear down essential workers
                     self.report.exit_code = 0
                     return 0

@@ -1,0 +1,935 @@
+"""SPMD-discipline analyzer (ISSUE 14): rank-divergence +
+commit-protocol static passes (seeded violation matrices pin exact
+rule/line findings), the runtime collective-schedule sanitizer
+(per-rank journals, cross-rank verifier, chaos-seeded divergence
+detected deterministically on CPU, structural-zero-cost-off proof),
+the Supervisor wiring (env forwarding, grandchild non-inheritance,
+sweep-time divergence detection), and the lint CLI satellites
+(--changed, --format=json)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import lint as tl  # noqa: E402 — path bootstrap first
+from paddle1_tpu.core import chaos  # noqa: E402
+from paddle1_tpu.core import collective_sanitizer as cs  # noqa: E402
+from paddle1_tpu.core import flags as core_flags  # noqa: E402
+from paddle1_tpu.core.collective_sanitizer import (  # noqa: E402
+    CollectiveDivergenceError)
+
+
+def _run(tmp_path, src, select, name="seed.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return tl.run(paths=[str(p)], select=select).findings
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- rank-divergence: violation matrix ---------------------------------------
+
+class TestRankDivergenceMatrix:
+    def test_collective_in_rank_branch(self, tmp_path):
+        src = (
+            "from jax import lax\n"               # 1
+            "def f(x, rank):\n"                   # 2
+            "    if rank == 0:\n"                 # 3
+            "        lax.psum(x, 'dp')\n"         # 4
+            "    return x\n"                      # 5
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "rank-divergent-collective")
+        assert [(f.line) for f in fs] == [4]
+        assert "psum" in fs[0].message and "line 3" in fs[0].message
+
+    def test_collective_in_else_arm_and_process_index(self, tmp_path):
+        src = (
+            "import jax\n"                          # 1
+            "def f(x):\n"                           # 2
+            "    if jax.process_index() == 0:\n"    # 3
+            "        pass\n"                        # 4
+            "    else:\n"                           # 5
+            "        barrier()\n"                   # 6
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "rank-divergent-collective")
+        assert [f.line for f in fs] == [6]
+
+    def test_env_rank_conditional(self, tmp_path):
+        src = (
+            "import os\n"                                        # 1
+            "def f(x):\n"                                        # 2
+            "    if os.environ['PADDLE_TRAINER_ID'] == '0':\n"   # 3
+            "        sync_global_devices('commit')\n"            # 4
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "rank-divergent-collective")
+        assert [f.line for f in fs] == [4]
+
+    def test_rank_uniform_conditionals_clean(self, tmp_path):
+        # world size / config flags are uniform across ranks; value-
+        # level axis_index selects execute on EVERY rank
+        src = (
+            "import jax, jax.numpy as jnp\n"
+            "from jax import lax\n"
+            "def f(x, training):\n"
+            "    if jax.process_count() > 1:\n"
+            "        barrier()\n"
+            "    if training:\n"
+            "        x = lax.psum(x, 'dp')\n"
+            "    red = lax.psum(x, 'dp')\n"
+            "    return jnp.where(lax.axis_index('dp') == 0, red, x)\n"
+        )
+        assert not _run(tmp_path, src, ["rank-divergence"])
+
+    def test_early_return_skips_later_collective(self, tmp_path):
+        src = (
+            "import jax\n"                          # 1
+            "from jax import lax\n"                 # 2
+            "def f(x):\n"                           # 3
+            "    if jax.process_index() == 0:\n"    # 4
+            "        return x\n"                    # 5
+            "    return lax.all_gather(x, 'dp')\n"  # 6
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "rank-divergent-skip")
+        assert [f.line for f in fs] == [5]
+        assert "all_gather" in fs[0].message \
+            and "line 6" in fs[0].message
+
+    def test_early_return_without_later_collective_clean(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x, rank):\n"
+            "    y = lax.psum(x, 'dp')\n"
+            "    if rank == 0:\n"
+            "        return y\n"       # nothing collective remains
+            "    return y + 1\n"
+        )
+        assert not _run(tmp_path, src, ["rank-divergence"])
+
+    def test_continue_in_outer_loop_flagged(self, tmp_path):
+        src = (
+            "from jax import lax\n"            # 1
+            "def f(xs, rank):\n"               # 2
+            "    for x in xs:\n"               # 3
+            "        if rank == 0:\n"          # 4
+            "            continue\n"           # 5
+            "        lax.psum(x, 'dp')\n"      # 6
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "rank-divergent-skip")
+        assert [f.line for f in fs] == [5]
+
+    def test_retry_loop_inside_guard_clean(self, tmp_path):
+        # continue/break whose loop lives INSIDE the branch never skip
+        # code after the branch (the checkpoint commit-retry shape)
+        src = (
+            "def save(tmp, rank):\n"
+            "    if rank == 0:\n"
+            "        for attempt in range(3):\n"
+            "            try:\n"
+            "                commit(tmp)\n"
+            "                break\n"
+            "            except OSError:\n"
+            "                continue\n"
+            "    broadcast_one_to_all(True)\n"
+        )
+        assert not _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                            "rank-divergent-skip")
+
+    def test_break_in_rank_while_clean(self, tmp_path):
+        # break/continue directly under a rank-conditional WHILE stay
+        # inside the loop protocol: after break, every rank (rank 0
+        # via break, peers by never entering) reaches the barrier
+        src = (
+            "def f(rank, done):\n"
+            "    while rank == 0:\n"
+            "        if done:\n"
+            "            break\n"
+            "        continue\n"
+            "    barrier()\n"
+        )
+        assert not _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                            "rank-divergent-skip")
+
+    def test_swallowed_exception_past_collective(self, tmp_path):
+        src = (
+            "def f(x):\n"                       # 1
+            "    try:\n"                        # 2
+            "        barrier()\n"               # 3
+            "    except OSError:\n"             # 4
+            "        pass\n"                    # 5
+        )
+        fs = _by_rule(_run(tmp_path, src, ["rank-divergence"]),
+                      "collective-swallow")
+        assert [f.line for f in fs] == [3]
+        assert "line 4" in fs[0].message
+
+    def test_reraising_handler_clean(self, tmp_path):
+        src = (
+            "def f(x):\n"
+            "    try:\n"
+            "        barrier()\n"
+            "    except OSError:\n"
+            "        raise\n"
+        )
+        assert not _run(tmp_path, src, ["rank-divergence"])
+
+    def test_closure_in_rank_branch_not_flagged(self, tmp_path):
+        # the nested def does not EXECUTE inside the branch
+        src = (
+            "from jax import lax\n"
+            "def f(x, rank):\n"
+            "    if rank == 0:\n"
+            "        def g(v):\n"
+            "            return lax.psum(v, 'dp')\n"
+            "        return g\n"
+            "    return None\n"
+        )
+        assert not _run(tmp_path, src, ["rank-divergence"])
+
+    def test_noqa_with_reason_suppresses(self, tmp_path):
+        src = (
+            "from jax import lax\n"
+            "def f(x, rank):\n"
+            "    if rank == 0:\n"
+            "        lax.psum(x, 'dp')"
+            "  # noqa: rank-divergent-collective — local fast path\n"
+        )
+        assert not _run(tmp_path, src, ["rank-divergence"])
+
+
+# -- commit-protocol: violation matrix ---------------------------------------
+
+# PR 2's historical barrier-mismatch shape: a rank-0-only commit RETRY
+# without an outcome broadcast — on commit failure rank 0 retries (or
+# raises) alone while the peers' next barrier waits forever
+PR2_FIXTURE = (
+    "import os, jax\n"                                        # 1
+    "def save(step, state, tmp):\n"                           # 2
+    "    if jax.process_count() > 1:\n"                       # 3
+    "        orbax_save(tmp, state)\n"                        # 4
+    "    if jax.process_index() == 0:  # commit-protocol: c\n"  # 5
+    "        for attempt in range(3):\n"                      # 6
+    "            try:\n"                                      # 7
+    "                os.replace(tmp, str(step))\n"            # 8
+    "                break\n"                                 # 9
+    "            except OSError:\n"                           # 10
+    "                continue\n"                              # 11
+)
+
+
+class TestCommitProtocolMatrix:
+    def test_pr2_shape_caught_at_exact_line(self, tmp_path):
+        fs = _by_rule(_run(tmp_path, PR2_FIXTURE, ["commit-protocol"]),
+                      "commit-broadcast")
+        assert [f.line for f in fs] == [5]
+        assert "outcome broadcast" in fs[0].message \
+            and "PR 2" in fs[0].message
+
+    def test_outcome_broadcast_pairs_the_guard(self, tmp_path):
+        src = PR2_FIXTURE + (
+            "    ok = broadcast_one_to_all(True)\n"           # 12
+            "    return ok\n"                                 # 13
+        )
+        assert not _run(tmp_path, src, ["commit-protocol"])
+
+    def test_unguarded_commit_in_multihost_function(self, tmp_path):
+        src = (
+            "import os, jax\n"                    # 1
+            "def save(step, tmp):\n"              # 2
+            "    if jax.process_count() > 1:\n"   # 3
+            "        pass\n"                      # 4
+            "    os.replace(tmp, str(step))\n"    # 5
+        )
+        fs = _by_rule(_run(tmp_path, src, ["commit-protocol"]),
+                      "commit-protocol")
+        assert [f.line for f in fs] == [5]
+        assert "EVERY process" in fs[0].message
+
+    def test_undeclared_guard_is_flagged(self, tmp_path):
+        src = (
+            "import os, jax\n"                        # 1
+            "def save(step, tmp):\n"                  # 2
+            "    if jax.process_index() == 0:\n"      # 3
+            "        os.replace(tmp, str(step))\n"    # 4
+            "    broadcast_one_to_all(True)\n"        # 5
+        )
+        fs = _by_rule(_run(tmp_path, src, ["commit-protocol"]),
+                      "commit-protocol")
+        assert [f.line for f in fs] == [3]
+        assert "commit-protocol:" in fs[0].message
+
+    def test_single_host_helper_out_of_scope(self, tmp_path):
+        # fs commits in a function that never consults the process
+        # topology (write_manifest, a local _gc) are not bound by the
+        # multi-host discipline
+        src = (
+            "import os\n"
+            "def write_manifest(path, doc):\n"
+            "    os.replace(path + '.tmp', path)\n"
+        )
+        assert not _run(tmp_path, src, ["commit-protocol"])
+
+
+# -- the new passes are registered + clean on the repo -----------------------
+
+class TestRegistration:
+    def test_passes_registered(self):
+        names = {c.name for c in tl.ALL_PASSES}
+        assert {"rank-divergence", "commit-protocol"} <= names
+
+    def test_spmd_passes_clean_on_repo(self):
+        # the full-suite clean gate lives in test_lint.TestCleanRepo;
+        # this pins the two NEW passes specifically so a violation
+        # reads as an SPMD-discipline failure, not a generic one
+        result = tl.run(select=["rank-divergence", "commit-protocol"])
+        msgs = [f.format(REPO) for f in result.findings]
+        assert not msgs, "\n".join(msgs)
+
+
+# -- runtime sanitizer: in-process -------------------------------------------
+
+def _three_collectives(t):
+    """The schedule both simulated ranks run (same file, same lines —
+    sites must match, exactly like SPMD ranks running one program)."""
+    import paddle1_tpu.distributed as dist
+    dist.all_reduce(t)
+    dist.barrier()
+    dist.broadcast(t, 0)
+
+
+class TestCollectiveSanitizer:
+    def setup_method(self):
+        chaos.reset()
+
+    def teardown_method(self):
+        chaos.reset()
+        os.environ.pop("PADDLE_TRAINER_ID", None)
+        cs.reset()  # re-derive the latch from the ambient flag
+
+    def _tensor(self):
+        import paddle1_tpu as p
+        return p.to_tensor(np.ones((2, 3), np.float32))
+
+    def test_structurally_free_when_off(self, tmp_path):
+        # force OFF explicitly: must hold inside the CI sanitizer lane
+        # too, where FLAGS_debug_collective_sanitizer=1 is exported
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=False,
+                collective_journal_dir=str(tmp_path)):
+            cs.reset()
+            t = self._tensor()
+            _three_collectives(t)
+            assert cs.schedule() == []          # nothing recorded
+            assert cs.journal_path() is None    # no file, ever
+            assert os.listdir(tmp_path) == []
+
+    def test_records_and_journals_when_on(self, tmp_path):
+        os.environ["PADDLE_TRAINER_ID"] = "3"
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(tmp_path)):
+            cs.reset()
+            t = self._tensor()
+            _three_collectives(t)
+            s = cs.schedule()
+        assert [r["op"] for r in s] == ["all_reduce", "barrier",
+                                        "broadcast"]
+        assert [r["seq"] for r in s] == [1, 2, 3]
+        # the site names THIS file (the user's call line, not the
+        # wrapper's), and the digest covers shape+dtype
+        assert all("test_collective_lint.py:" in r["site"] for r in s)
+        assert s[0]["shape"] == "float32[2,3]"
+        path = tmp_path / "collective-3.jsonl"
+        assert path.exists()
+        on_disk = [json.loads(ln) for ln in
+                   path.read_text().splitlines()]
+        assert on_disk == s
+
+    def test_verify_schedules_divergence_typed(self):
+        a = [{"seq": 1, "site": "f.py:1", "op": "all_reduce",
+              "digest": "x"},
+             {"seq": 2, "site": "f.py:2", "op": "barrier",
+              "digest": "y"}]
+        b = [a[0], {"seq": 2, "site": "f.py:9", "op": "all_gather",
+                    "digest": "z"}]
+        assert cs.verify_schedules({0: a, 1: list(a)},
+                                   complete=True) == 2
+        with pytest.raises(CollectiveDivergenceError) as ei:
+            cs.verify_schedules({0: a, 1: b})
+        msg = str(ei.value)
+        assert "step 2" in msg and "barrier" in msg \
+            and "all_gather" in msg and "rank 0" in msg \
+            and "rank 1" in msg
+
+    def test_truncated_schedule_is_the_deadlock(self):
+        a = [{"seq": 1, "site": "f.py:1", "op": "psum", "digest": "x"},
+             {"seq": 2, "site": "f.py:2", "op": "barrier",
+              "digest": "y"}]
+        short = a[:1]
+        # prefix mode (a LIVE job): ranks mid-run differ legitimately
+        assert cs.verify_schedules({0: a, 1: short},
+                                   complete=False) == 1
+        with pytest.raises(CollectiveDivergenceError, match="ends"):
+            cs.verify_schedules({0: a, 1: short}, complete=True)
+
+    def test_shape_divergence_detected(self):
+        a = [{"seq": 1, "site": "f.py:1", "op": "psum",
+              "digest": "aaa"}]
+        b = [{"seq": 1, "site": "f.py:1", "op": "psum",
+              "digest": "bbb"}]
+        with pytest.raises(CollectiveDivergenceError, match="step 1"):
+            cs.verify_schedules({0: a, 1: b})
+
+    def test_chaos_seeded_skip_detected_on_cpu(self, tmp_path):
+        """The acceptance scenario: two ranks run the SAME program;
+        an armed collective_skip makes rank 1 skip its 2nd collective.
+        The cross-rank verifier names the first diverging step — on
+        CPU, deterministically, with nothing actually blocking."""
+        t = self._tensor()
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(tmp_path)):
+            os.environ["PADDLE_TRAINER_ID"] = "0"
+            cs.reset()
+            _three_collectives(t)
+            assert len(cs.schedule()) == 3
+            # rank 1: same program, chaos skips its 2nd collective
+            os.environ["PADDLE_TRAINER_ID"] = "1"
+            cs.reset()
+            chaos.configure("collective_skip@2:1")
+            _three_collectives(t)
+            assert [r["op"] for r in cs.schedule()] == ["all_reduce",
+                                                        "broadcast"]
+            with pytest.raises(CollectiveDivergenceError) as ei:
+                cs.verify_dir(str(tmp_path), complete=True)
+            msg = str(ei.value)
+            assert "step 2" in msg
+            assert "barrier" in msg and "broadcast" in msg
+
+    def test_chaos_skip_fires_once(self, tmp_path):
+        """A replayed collective draws a fresh occurrence and comes
+        back clean — the chaos exactly-once contract."""
+        t = self._tensor()
+        with core_flags.flags_guard(debug_collective_sanitizer=True):
+            cs.reset()
+            chaos.configure("collective_skip@1")
+            _three_collectives(t)   # 1st skipped, 2nd/3rd recorded
+            assert len(cs.schedule()) == 2
+            _three_collectives(t)   # replay: all recorded
+            assert len(cs.schedule()) == 5
+
+    def test_journal_env_consumed_at_arm(self, tmp_path, monkeypatch):
+        """The Supervisor-stamped dir env is POPPED when the worker
+        arms, so grandchildren can never journal onto the rank's file
+        (the PR 3 heartbeat-env lesson)."""
+        monkeypatch.setenv(cs.JOURNAL_ENV, str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        with core_flags.flags_guard(debug_collective_sanitizer=True):
+            cs.reset()
+            assert cs.JOURNAL_ENV not in os.environ  # consumed
+            assert cs.journal_path() == str(
+                tmp_path / "collective-2.jsonl")
+
+    def test_watcher_incremental_poll(self, tmp_path):
+        w = cs.JournalWatcher(str(tmp_path))
+        assert w.poll() == 0  # no journals yet: nothing to compare
+        rec = {"seq": 1, "site": "f.py:1", "op": "psum", "digest": "d"}
+        (tmp_path / "collective-0.jsonl").write_text(
+            json.dumps(rec) + "\n")
+        (tmp_path / "collective-1.jsonl").write_text(
+            json.dumps(rec) + "\n")
+        assert w.poll() == 1
+        # rank 1 appends a DIFFERENT op at step 2; rank 0 a barrier
+        with open(tmp_path / "collective-0.jsonl", "a") as f:
+            f.write(json.dumps({"seq": 2, "site": "f.py:2",
+                                "op": "barrier", "digest": "d"}) + "\n")
+        assert w.poll() == 1  # rank 1 merely behind: common prefix ok
+        with open(tmp_path / "collective-1.jsonl", "a") as f:
+            f.write(json.dumps({"seq": 2, "site": "f.py:9",
+                                "op": "psum", "digest": "d"}) + "\n")
+        with pytest.raises(CollectiveDivergenceError, match="step 2"):
+            w.poll()
+
+    def test_incarnation_epochs_verify_independently(self, tmp_path):
+        """A resized/restarted world journals into a FRESH .r<n> file:
+        its replayed schedule is a new epoch. A shrink-killed rank's
+        short epoch-0 journal must not read as divergence against the
+        epoch-1 relaunch — each epoch verifies within itself."""
+        assert cs.journal_file_name(2) == "collective-2.jsonl"
+        assert cs.journal_file_name(2, 3) == "collective-2.r3.jsonl"
+        mk = lambda op, seq: {"seq": seq, "site": "f.py:1", "op": op,
+                              "digest": "d"}
+        # epoch 0: rank 1 died one collective short of rank 0
+        (tmp_path / "collective-0.jsonl").write_text(
+            json.dumps(mk("psum", 1)) + "\n"
+            + json.dumps(mk("barrier", 2)) + "\n")
+        (tmp_path / "collective-1.jsonl").write_text(
+            json.dumps(mk("psum", 1)) + "\n")
+        # epoch 1 (the relaunch): consistent
+        for r in (0, 1):
+            (tmp_path / f"collective-{r}.r1.jsonl").write_text(
+                json.dumps(mk("psum", 1)) + "\n")
+        assert cs.journal_rank_count(str(tmp_path)) == 2
+        # prefix mode: both epochs agree on their common prefixes
+        assert cs.verify_dir(str(tmp_path), complete=False) == 2
+        # a REAL divergence inside epoch 1 still raises
+        with open(tmp_path / "collective-0.r1.jsonl", "a") as f:
+            f.write(json.dumps(mk("barrier", 2)) + "\n")
+        with open(tmp_path / "collective-1.r1.jsonl", "a") as f:
+            f.write(json.dumps(mk("all_gather", 2)) + "\n")
+        with pytest.raises(CollectiveDivergenceError, match="step 2"):
+            cs.verify_dir(str(tmp_path), complete=False)
+
+    def test_watcher_final_catches_strict_prefix(self, tmp_path):
+        """poll() tolerates a rank that is merely behind; final() (the
+        clean-job-completion check) fails the strict-prefix journal —
+        the skipped-last-collective deadlock."""
+        mk = lambda op, seq: {"seq": seq, "site": "f.py:1", "op": op,
+                              "digest": "d"}
+        (tmp_path / "collective-0.jsonl").write_text(
+            json.dumps(mk("psum", 1)) + "\n"
+            + json.dumps(mk("barrier", 2)) + "\n")
+        (tmp_path / "collective-1.jsonl").write_text(
+            json.dumps(mk("psum", 1)) + "\n")
+        w = cs.JournalWatcher(str(tmp_path))
+        assert w.poll() == 1
+        with pytest.raises(CollectiveDivergenceError, match="ends"):
+            w.final()
+
+    def test_watcher_tolerates_torn_tail(self, tmp_path):
+        rec = {"seq": 1, "site": "f.py:1", "op": "psum", "digest": "d"}
+        (tmp_path / "collective-0.jsonl").write_text(
+            json.dumps(rec) + "\n")
+        # rank 1's writer was killed mid-record: no trailing newline
+        (tmp_path / "collective-1.jsonl").write_text(
+            json.dumps(rec) + "\n" + '{"seq": 2, "si')
+        w = cs.JournalWatcher(str(tmp_path))
+        assert w.poll() == 1  # torn tail deferred, prefix verified
+        # the record completes on the next append
+        with open(tmp_path / "collective-1.jsonl", "a") as f:
+            f.write('te": "f.py:2", "op": "barrier", "digest": "d"}\n')
+        assert w.poll() == 1
+
+    def test_verify_cli(self, tmp_path, capsys):
+        from tools import collective_verify as cv
+        a = tmp_path / "collective-0.jsonl"
+        b = tmp_path / "collective-1.jsonl"
+        rec = {"seq": 1, "site": "f.py:1", "op": "psum", "digest": "d"}
+        a.write_text(json.dumps(rec) + "\n")
+        # fewer than two journals: exit 2 (teaches about the flag)
+        only = tmp_path / "only"
+        only.mkdir()
+        assert cv.main([str(only)]) == 2
+        b.write_text(json.dumps(rec) + "\n")
+        assert cv.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 ranks agree on 1 collective step" in out
+        with open(b, "a") as f:
+            f.write(json.dumps({"seq": 2, "site": "f.py:2",
+                                "op": "barrier", "digest": "d"}) + "\n")
+        # completion check fails (rank 0 never reaches the barrier)...
+        assert cv.main([str(tmp_path)]) == 1
+        assert "DIVERGENCE" in capsys.readouterr().err
+        # ...but --prefix (a live job) accepts the lag
+        assert cv.main([str(tmp_path), "--prefix"]) == 0
+
+
+# -- supervisor wiring -------------------------------------------------------
+
+ENV_DUMPER = textwrap.dedent("""
+    import json, os, sys
+    with open(sys.argv[1], "w") as f:
+        json.dump(dict(os.environ), f)
+""")
+
+# imports the sanitizer (arming consumes the journal env), then spawns
+# a grandchild that dumps ITS env — the non-inheritance proof
+GRANDCHILD_PROBE = textwrap.dedent("""
+    import os, subprocess, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle1_tpu.core.collective_sanitizer as cs
+    assert cs.journal_path() is not None, "worker did not arm"
+    code = ("import json, os, sys;"
+            "json.dump(dict(os.environ), open(sys.argv[1], 'w'))")
+    subprocess.run([sys.executable, "-c", code, sys.argv[1]],
+                   check=True)
+""")
+
+DIVERGENT_WORKER = textwrap.dedent("""
+    import os, time
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle1_tpu as p
+    from paddle1_tpu import distributed as dist
+    from paddle1_tpu.core import chaos, health
+    chaos.configure_from_flags()
+    t = p.to_tensor(np.ones((2, 2), np.float32))
+    for i in range(3):
+        health.beat()
+        dist.all_reduce(t)
+        dist.barrier()
+    while True:   # keep beating: the VERIFIER must end this pod,
+        health.beat()       # not a clean exit or a hang timeout
+        time.sleep(0.02)
+""")
+
+# same program but exits CLEANLY — the skipped-LAST-collective shape
+# only the job-completion check can see (every prefix agrees)
+CLEAN_EXIT_WORKER = textwrap.dedent("""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle1_tpu as p
+    from paddle1_tpu import distributed as dist
+    from paddle1_tpu.core import chaos, health
+    chaos.configure_from_flags()
+    t = p.to_tensor(np.ones((2, 2), np.float32))
+    for i in range(3):
+        health.beat()
+        dist.all_reduce(t)
+        dist.barrier()
+""")
+
+
+def _sup(tmp_path, **kw):
+    from paddle1_tpu.distributed import Supervisor
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("grace_s", 3.0)
+    kw.setdefault("hang_timeout", 30.0)
+    kw.setdefault("heartbeat_dir", str(tmp_path / "hb"))
+    return Supervisor(**kw)
+
+
+class TestSupervisorCollective:
+    def teardown_method(self):
+        cs.reset()
+
+    def test_worker_env_forwarding(self, tmp_path):
+        """The Supervisor stamps the sanitizer flag + journal-dir env
+        into worker envs when the flag is on — and stays silent when
+        off (env-only children must not arm by accident)."""
+        out = tmp_path / "env.json"
+        jdir = tmp_path / "journals"
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(jdir)):
+            sup = _sup(tmp_path)
+            w = tmp_path / "w.py"
+            w.write_text(ENV_DUMPER)
+            sup.add_worker(0, [sys.executable, "-u", str(w), str(out)])
+            sup.start()
+            sup._workers[0].proc.wait(timeout=30)
+        env = json.loads(out.read_text())
+        assert env["FLAGS_debug_collective_sanitizer"] == "1"
+        assert env[cs.JOURNAL_ENV] == str(jdir)
+
+    def test_no_forwarding_when_off(self, tmp_path):
+        out = tmp_path / "env.json"
+        with core_flags.flags_guard(debug_collective_sanitizer=False):
+            sup = _sup(tmp_path)
+            w = tmp_path / "w.py"
+            w.write_text(ENV_DUMPER)
+            # a clean base env (not os.environ) so the CI lane's own
+            # FLAGS_ export can't leak into the assertion
+            sup.add_worker(0, [sys.executable, "-u", str(w), str(out)],
+                           env={"PATH": os.environ.get("PATH", "")})
+            sup.start()
+            sup._workers[0].proc.wait(timeout=30)
+        env = json.loads(out.read_text())
+        assert "FLAGS_debug_collective_sanitizer" not in env
+        assert cs.JOURNAL_ENV not in env
+
+    @pytest.mark.slow  # imports paddle in a subprocess (the real
+    # arm-at-import path); rides the CI debug-sanitizers lane
+    def test_grandchild_does_not_inherit_journal_env(self, tmp_path):
+        out = tmp_path / "genv.json"
+        jdir = tmp_path / "journals"
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(jdir)):
+            sup = _sup(tmp_path)
+            w = tmp_path / "w.py"
+            w.write_text(GRANDCHILD_PROBE)
+            sup.add_worker(0, [sys.executable, "-u", str(w), str(out)],
+                           env=dict(os.environ, PYTHONPATH=REPO))
+            sup.start()
+            rc = sup._workers[0].proc.wait(timeout=120)
+        assert rc == 0
+        genv = json.loads(out.read_text())
+        # the flag itself may inherit (harmless: in-memory only) —
+        # the journal DIR must not: a grandchild writing the rank's
+        # file would interleave two schedules into one journal
+        assert cs.JOURNAL_ENV not in genv
+
+    @pytest.mark.slow  # two paddle-importing subprocesses; the
+    # seeded-divergence smoke of the CI debug-sanitizers lane
+    def test_seeded_divergence_fails_pod_typed(self, tmp_path):
+        """End to end: two supervised ranks run the same collective
+        loop; chaos makes rank 1 skip its 2nd collective. The sweep-
+        time verifier must end the pod with the typed error naming
+        the diverging step — while both workers are still beating
+        (neither a clean exit nor a hang timeout is the detector)."""
+        w = tmp_path / "w.py"
+        w.write_text(DIVERGENT_WORKER)
+        with core_flags.flags_guard(debug_collective_sanitizer=True):
+            sup = _sup(tmp_path, policy="fail_fast")
+            for r in range(2):
+                env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                           JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+                env.pop("FLAGS_ft_chaos", None)
+                if r == 1:
+                    env["FLAGS_ft_chaos"] = "collective_skip@2:1"
+                sup.add_worker(r, [sys.executable, "-u", str(w)],
+                               env=env)
+            t0 = time.time()
+            with pytest.raises(CollectiveDivergenceError) as ei:
+                sup.run()
+            took = time.time() - t0
+        assert "step 2" in str(ei.value)
+        assert sup.report.collective_divergence is not None
+        assert "step 2" in sup.report.collective_divergence
+        assert took < 120
+        # the pod was torn down, not left spinning
+        for wk in sup._workers.values():
+            assert wk.proc.poll() is not None
+
+    @pytest.mark.slow  # two paddle-importing subprocesses
+    def test_skipped_last_collective_fails_clean_completion(
+            self, tmp_path):
+        """Rank 1 skips its LAST collective and exits 0 — every
+        common prefix agrees, so only the job-completion check (the
+        strict-prefix journal = the deadlock shape) can catch it.
+        run() must raise typed instead of returning success."""
+        w = tmp_path / "w.py"
+        w.write_text(CLEAN_EXIT_WORKER)
+        with core_flags.flags_guard(debug_collective_sanitizer=True):
+            sup = _sup(tmp_path, policy="fail_fast")
+            for r in range(2):
+                env = dict(os.environ, PADDLE_TRAINER_ID=str(r),
+                           JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+                env.pop("FLAGS_ft_chaos", None)
+                if r == 1:
+                    # rank 1's 6th collective is its final barrier
+                    env["FLAGS_ft_chaos"] = "collective_skip@6:1"
+                sup.add_worker(r, [sys.executable, "-u", str(w)],
+                               env=env)
+            with pytest.raises(CollectiveDivergenceError,
+                               match="ends"):
+                sup.run()
+        assert sup.report.collective_divergence is not None
+
+
+# -- CLI satellites: --format=json + --changed -------------------------------
+
+class TestLintCli:
+    def test_format_json_schema_round_trip(self, tmp_path, capsys):
+        from tools.lint.__main__ import main
+        p = tmp_path / "seed.py"
+        p.write_text("from jax import lax\n"
+                     "def f(x, rank):\n"
+                     "    if rank == 0:\n"
+                     "        lax.psum(x, 'dp')\n")
+        rc = main(["--select", "rank-divergence", "--format", "json",
+                   str(p)])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1
+        assert doc["files_checked"] == 1
+        assert len(doc["findings"]) == 1
+        f = doc["findings"][0]
+        # the schema: exactly these four keys, round-trippable into
+        # the Finding the text reporter would have printed
+        assert set(f) == {"file", "line", "rule", "message"}
+        rebuilt = tl.Finding(path=f["file"], line=f["line"],
+                             rule=f["rule"], message=f["message"])
+        assert rebuilt.format() == (f"{f['file']}:{f['line']}: "
+                                    f"[{f['rule']}] {f['message']}")
+
+    def test_format_json_clean_is_empty_list(self, tmp_path, capsys):
+        from tools.lint.__main__ import main
+        p = tmp_path / "ok.py"
+        p.write_text("x = 1\n")
+        assert main(["--select", "rank-divergence", "--format", "json",
+                     str(p)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"] == []
+
+    def test_list_includes_new_passes(self, capsys):
+        from tools.lint.__main__ import main
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "rank-divergence" in out and "commit-protocol" in out
+
+    def _git_repo(self, tmp_path):
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path), *args],
+                           check=True, capture_output=True)
+        git("init", "-q", "-b", "main")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "tools" / "clean.py").write_text("x = 1\n")
+        (tmp_path / "paddle1_tpu").mkdir()
+        (tmp_path / "paddle1_tpu" / "a.py").write_text("y = 1\n")
+        git("add", "-A")
+        git("commit", "-q", "-m", "base")
+        return git
+
+    def test_collect_changed(self, tmp_path):
+        from tools.lint.__main__ import collect_changed
+        git = self._git_repo(tmp_path)
+        assert collect_changed(str(tmp_path), "main") == []
+        # a committed change on a branch, an unstaged edit, an
+        # untracked file — all vs the merge-base with main
+        git("checkout", "-q", "-b", "feature")
+        (tmp_path / "paddle1_tpu" / "a.py").write_text("y = 2\n")
+        git("commit", "-aqm", "change")
+        (tmp_path / "tools" / "clean.py").write_text("x = 2\n")
+        (tmp_path / "paddle1_tpu" / "new.py").write_text("z = 1\n")
+        (tmp_path / "outside.py").write_text("o = 1\n")  # not a root
+        (tmp_path / "tools" / "notes.txt").write_text("n\n")  # not .py
+        changed = collect_changed(str(tmp_path), "main")
+        rel = sorted(os.path.relpath(c, str(tmp_path))
+                     for c in changed)
+        assert rel == ["paddle1_tpu/a.py", "paddle1_tpu/new.py",
+                       "tools/clean.py"]
+
+    def test_collect_changed_not_a_repo(self, tmp_path):
+        from tools.lint.__main__ import collect_changed
+        assert collect_changed(str(tmp_path / "nowhere")) is None
+
+    def test_changed_mode_skips_whole_repo_passes(self, tmp_path,
+                                                  capsys,
+                                                  monkeypatch):
+        """--changed lints only the differing files and skips
+        flag-liveness (whole-repo pairing) with a note."""
+        from tools.lint import __main__ as cli
+        self._git_repo(tmp_path)
+        # a violating unstaged edit
+        (tmp_path / "paddle1_tpu" / "a.py").write_text(
+            "from jax import lax\n"
+            "def f(x, rank):\n"
+            "    if rank == 0:\n"
+            "        lax.psum(x, 'dp')\n")
+        # a flag definition nobody reads: would be a false dead-flag
+        # finding if flag-liveness ran over the partial list
+        (tmp_path / "tools" / "clean.py").write_text(
+            "define_flag('read_elsewhere_flag', 1)\n")
+        monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+        rc = cli.main(["--changed"])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "rank-divergent-collective" in captured.out
+        assert "dead-flag" not in captured.out
+        assert "skips whole-repo pass(es) flag-liveness" \
+            in captured.err
+
+    def test_changed_mode_clean_tree(self, tmp_path, capsys,
+                                     monkeypatch):
+        from tools.lint import __main__ as cli
+        self._git_repo(tmp_path)
+        monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+        assert cli.main(["--changed"]) == 0
+        assert "nothing changed" in capsys.readouterr().err
+
+    def test_changed_mode_honors_pass_roots(self, tmp_path, capsys,
+                                            monkeypatch):
+        """--changed must lint a file exactly as --all would:
+        metric-names deliberately excludes tools/, so a changed tools/
+        file with a metric-shaped call must NOT go red pre-commit
+        while CI's --all is green."""
+        from tools.lint import __main__ as cli
+        self._git_repo(tmp_path)
+        bad_metric = "m.counter('requests')\n"  # no _total suffix
+        (tmp_path / "tools" / "clean.py").write_text(bad_metric)
+        (tmp_path / "paddle1_tpu" / "a.py").write_text(bad_metric)
+        monkeypatch.setattr(cli, "repo_root", lambda: str(tmp_path))
+        rc = cli.main(["--changed", "--select", "metric-names"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        # flagged under paddle1_tpu/ (a metric-names root)...
+        assert "paddle1_tpu/a.py" in "".join(
+            ln for ln in out.splitlines() if "metric-name" in ln)
+        # ...but NOT under tools/ (outside the pass's roots)
+        assert "tools/clean.py" not in out
+
+
+# -- bench_history noise band (the PR 13 accepted finding) -------------------
+
+class TestBenchHistoryNoiseBand:
+    def _tool(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_history
+        finally:
+            sys.path.pop(0)
+        return bench_history
+
+    def _rec(self, metric, value, unit="req/s", vs=1.0):
+        return {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": vs, "detail": {}}
+
+    def test_noisy_history_widens_its_own_band(self):
+        """Cross-runner throughput jitter (the accepted PR 13
+        finding): a window varying ~±11% must not fail a fresh value
+        that a fixed 10%-of-best ratchet would have — the tolerance
+        derives from the window's own cv."""
+        bh = self._tool()
+        prior = [self._rec("qps", v)
+                 for v in (100, 85, 115, 92, 108)]
+        tol = bh.noise_tolerance([85, 92, 100, 108, 115])
+        assert tol > bh.REGRESSION_FRAC
+        # 89 is >10% below best-of-window (115) but inside the band
+        assert bh.check_regressions(prior, [self._rec("qps", 89)]) == []
+        # a real collapse still fails, and names the derived band
+        probs = bh.check_regressions(prior, [self._rec("qps", 50)])
+        assert probs and "noise band" in probs[0]
+
+    def test_tight_history_keeps_the_floor(self):
+        bh = self._tool()
+        vals = [100.0, 100.5, 99.8, 100.2, 99.9]
+        assert bh.noise_tolerance(vals) == bh.REGRESSION_FRAC
+        prior = [self._rec("qps", v) for v in vals]
+        probs = bh.check_regressions(prior, [self._rec("qps", 85)])
+        assert probs and "down more than 10%" in probs[0]
+
+    def test_band_is_capped(self):
+        bh = self._tool()
+        # pathological spread: the cap keeps a real collapse failing
+        assert bh.noise_tolerance([1, 100, 1, 100, 1]) == \
+            bh.CV_TOLERANCE_CAP
+
+    def test_short_window_keeps_the_floor(self):
+        bh = self._tool()
+        assert bh.noise_tolerance([100]) == bh.REGRESSION_FRAC
+        assert bh.noise_tolerance([100, 50]) == bh.REGRESSION_FRAC
+
+    def test_lower_is_better_rides_the_band_too(self):
+        bh = self._tool()
+        prior = [self._rec("x_overhead_frac", v, unit="fraction")
+                 for v in (0.30, 0.20, 0.40, 0.25, 0.35)]
+        # 0.29 is >10% above best (0.20) + >0.01 absolute, but inside
+        # the cv-derived band (best * (1 + tol) = 0.30)
+        assert bh.check_regressions(
+            prior, [self._rec("x_overhead_frac", 0.29,
+                              unit="fraction")]) == []
+        probs = bh.check_regressions(
+            prior, [self._rec("x_overhead_frac", 0.8,
+                              unit="fraction")])
+        assert probs and "up more than" in probs[0]

@@ -13,10 +13,14 @@ driver's parsers keep working), validates result lines with
 ``bench.parse_result_line``, and appends them — stamped with a
 timestamp and the git head — to ``BENCH_history.jsonl`` (override with
 ``--history``). ``--compare`` then exits nonzero when any metric
-appended this run regressed more than 10% against the BEST of its last
-5 prior recorded runs — a ratchet, not a threshold: yesterday's best
-run is the bar, so a slow creep across runs trips it even when each
-single step stays under 10%.
+appended this run regressed against the BEST of its last 5 prior
+recorded runs by more than the metric's noise band — a ratchet, not a
+threshold: yesterday's best run is the bar, so a slow creep across
+runs trips it even when each single step stays inside the band. The
+band is ``max(10%, 3 * cv)`` where ``cv`` is the window's own
+coefficient of variation (capped at 50%): cross-runner throughput
+jitter widens its own tolerance instead of failing CI, while tight
+metrics keep the 10% floor.
 
 "Regressed" respects the metric's direction: throughput-style metrics
 (samples/s, req/s, tok/s...) regress DOWN; overhead-style metrics
@@ -50,6 +54,30 @@ _LOWER_IS_BETTER_UNITS = {"fraction"}
 _LOWER_IS_BETTER_SUFFIXES = ("_frac", "_fraction", "_overhead")
 REGRESSION_FRAC = 0.10
 COMPARE_WINDOW = 5
+# noise band (ISSUE 14, the PR 13 accepted finding): raw-throughput
+# ratchets ran on shared CI runners whose run-to-run spread exceeds a
+# fixed 10%, so the tolerance is derived from the history's OWN
+# coefficient of variation — a metric whose recorded window varies
+# ±15% gets a ~3-sigma band (~45%), a tight metric keeps the 10%
+# floor. Capped so a pathologically noisy history can never wave a
+# real collapse through.
+CV_SIGMA = 3.0
+CV_TOLERANCE_CAP = 0.50
+
+
+def noise_tolerance(vals: list) -> float:
+    """Per-metric relative regression tolerance: the REGRESSION_FRAC
+    floor widened to CV_SIGMA * (stdev/mean) of the compared window,
+    capped at CV_TOLERANCE_CAP. Fewer than 3 samples (or a ~0 mean)
+    keep the floor — one or two points carry no spread estimate."""
+    if len(vals) < 3:
+        return REGRESSION_FRAC
+    mean = sum(vals) / len(vals)
+    if abs(mean) < 1e-12:
+        return REGRESSION_FRAC
+    var = sum((v - mean) ** 2 for v in vals) / len(vals)
+    cv = (var ** 0.5) / abs(mean)
+    return max(REGRESSION_FRAC, min(CV_TOLERANCE_CAP, CV_SIGMA * cv))
 
 
 def _git_head() -> str:
@@ -112,22 +140,26 @@ def check_regressions(history: list, fresh: list) -> list:
         if vals:
             best = min(vals) if lower else max(vals)
             v = float(rec["value"])
+            tol = noise_tolerance(vals)
             if lower:
                 # relative ratchet PLUS an absolute floor: overhead
                 # fractions hover near 0 where 0.001 -> 0.002 is 2x
                 # relative but pure scheduler noise — a point of real
                 # overhead (0.01 absolute) is the signal worth failing
                 regressed = (best >= 0
-                             and v > best * (1 + REGRESSION_FRAC)
+                             and v > best * (1 + tol)
                              and v - best > 0.01)
             else:
-                regressed = v < best * (1 - REGRESSION_FRAC)
+                regressed = v < best * (1 - tol)
             if regressed:
                 problems.append(
                     f"{name}: {v:g} {rec.get('unit', '')} vs best-of-"
                     f"last-{len(vals)} {best:g} — "
                     f"{'up' if lower else 'down'} more than "
-                    f"{REGRESSION_FRAC:.0%}")
+                    f"{tol:.0%}"
+                    + (f" (noise band from window cv, floor "
+                       f"{REGRESSION_FRAC:.0%})"
+                       if tol > REGRESSION_FRAC else ""))
         # contract gates: the soaks emit vs_baseline as a BINARY
         # 1.0/0.0 verdict — only that shape is a contract (a
         # continuous ratio like bert's mfu/0.40 hovering around 1.0
@@ -180,8 +212,9 @@ def _report(problems: list, path: str) -> int:
         for p in problems:
             print(f"bench_history REGRESSION: {p}", file=sys.stderr)
         print(f"bench_history: {len(problems)} regression(s) vs "
-              f"{path} (>10% off the best of the last "
-              f"{COMPARE_WINDOW} runs)", file=sys.stderr)
+              f"{path} (off the best of the last "
+              f"{COMPARE_WINDOW} runs, beyond each metric's noise "
+              "band)", file=sys.stderr)
         return 1
     return 0
 
@@ -207,9 +240,10 @@ def main(argv=None) -> int:
                     help="the JSONL trajectory file "
                          "(default BENCH_history.jsonl at repo root)")
     ap.add_argument("--compare", action="store_true",
-                    help="with `append`: after recording, exit 1 on "
-                         ">10% regression vs the best of the last "
-                         "5 prior runs per metric")
+                    help="with `append`: after recording, exit 1 on a "
+                         "regression beyond the metric's noise band "
+                         "(max(10%%, 3*cv), cv from the window) vs "
+                         "the best of the last 5 prior runs")
     ap.add_argument("-n", type=int, default=8,
                     help="with `show`: rows per metric")
     args = ap.parse_args(argv)

@@ -1,0 +1,72 @@
+"""Cross-rank collective-schedule verifier CLI (ISSUE 14).
+
+The ``tools.lint``-adjacent entry for the runtime half of the
+SPMD-discipline suite: every process armed with
+``FLAGS_debug_collective_sanitizer=1`` journals its collective
+schedule as ``collective-<rank>.jsonl`` (see
+``core/collective_sanitizer.py``); this tool replays the cross-rank
+comparison the Supervisor runs at sweep time, plus the completion
+check (a rank whose journal simply STOPS while peers continue is the
+would-be deadlock)::
+
+    python -m tools.collective_verify <journal-dir>            # full check
+    python -m tools.collective_verify <journal-dir> --prefix   # live job
+
+Exit 0 when every rank claims the same schedule, 1 on divergence (the
+typed error text names the first diverging step and both ranks'
+surrounding schedules), 2 when the directory holds fewer than two
+rank journals (nothing to compare — probably the wrong dir, or the
+flag was off: off writes no files at all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # run as `python tools/collective_verify.py`
+    sys.path.insert(0, _ROOT)
+
+from paddle1_tpu.core.collective_sanitizer import (  # noqa: E402
+    CollectiveDivergenceError, journal_rank_count, verify_dir)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.collective_verify",
+        description="cross-rank collective-schedule verification "
+                    "(see core/collective_sanitizer.py)")
+    ap.add_argument("journal_dir",
+                    help="directory holding collective-<rank>.jsonl "
+                         "journals (the Supervisor's log dir "
+                         "'collective/' subdir, or "
+                         "FLAGS_collective_journal_dir)")
+    ap.add_argument("--prefix", action="store_true",
+                    help="compare only the common prefix (a LIVE "
+                         "job's ranks are legitimately at different "
+                         "positions); default additionally fails "
+                         "when one finished rank's schedule is a "
+                         "strict prefix of another's")
+    args = ap.parse_args(argv)
+    nranks = journal_rank_count(args.journal_dir)
+    if nranks < 2:
+        print(f"collective_verify: {nranks} rank journal(s) under "
+              f"{args.journal_dir!r} — need at least 2 to compare "
+              "(is FLAGS_debug_collective_sanitizer on? off writes "
+              "no files)", file=sys.stderr)
+        return 2
+    try:
+        steps = verify_dir(args.journal_dir,
+                           complete=not args.prefix)
+    except CollectiveDivergenceError as e:
+        print(f"collective_verify DIVERGENCE: {e}", file=sys.stderr)
+        return 1
+    print(f"collective_verify: {nranks} ranks agree on "
+          f"{steps} collective step(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

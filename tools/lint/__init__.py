@@ -1,7 +1,8 @@
 """Unified static-analysis suite — ``python -m tools.lint`` (ISSUE 11,
-extended with the JIT-discipline passes in ISSUE 12).
+extended with the JIT-discipline passes in ISSUE 12 and the
+SPMD-discipline passes in ISSUE 14).
 
-One framework (:mod:`tools.lint.framework`), seven passes:
+One framework (:mod:`tools.lint.framework`), nine passes:
 
 * ``bare-except`` — no handler may swallow interrupts (PR 2, migrated);
 * ``metric-names`` — the Prometheus naming contract (PR 9, migrated);
@@ -13,40 +14,51 @@ One framework (:mod:`tools.lint.framework`), seven passes:
 * ``retrace-hazard`` — constant-folded closures, non-hashable static
   args, host-scalar feedback loops (ISSUE 12);
 * ``host-sync`` — hidden device→host readbacks in traced bodies and
-  ``# hot-path`` regions (ISSUE 12).
+  ``# hot-path`` regions (ISSUE 12);
+* ``rank-divergence`` — collectives inside rank-conditional branches,
+  early exits that skip a later collective, swallowed exceptions past
+  one (the hang-not-error class, ISSUE 14);
+* ``commit-protocol`` — the multi-host checkpoint commit discipline:
+  process-0-guarded fs commits declared ``# commit-protocol:`` and
+  paired with an outcome broadcast (ISSUE 14).
 
 See README "Static analysis" for the conventions
 (``# noqa: <rule> — reason``, ``# guarded-by: <lock>``,
-``# hot-path``), ``core/locks.py`` for the runtime lock-order
-sanitizer, and ``core/jit_sanitizer.py`` for the runtime half of the
-JIT-discipline suite (retrace-storm enforcement, donated-buffer
-poisoning, host-sync counting) — each covers what a lexical pass
-cannot.
+``# hot-path``, ``# commit-protocol:``), ``core/locks.py`` for the
+runtime lock-order sanitizer, ``core/jit_sanitizer.py`` for the
+runtime half of the JIT-discipline suite, and
+``core/collective_sanitizer.py`` for the runtime collective-schedule
+sanitizer (per-rank journals + cross-rank verifier) — each covers what
+a lexical pass cannot.
 """
 
 from __future__ import annotations
 
 from .bare_except import BareExceptPass
+from .commit_protocol import CommitProtocolPass
 from .donation_safety import DonationSafetyPass
 from .flag_liveness import FlagLivenessPass
 from .framework import (DEFAULT_PATHS, Finding, LintPass, RunResult,
-                        UnknownPassError, iter_py_files, parse_noqa,
-                        repo_root, report, run_passes)
+                        UnknownPassError, findings_json, iter_py_files,
+                        parse_noqa, repo_root, report, run_passes)
 from .host_sync import HostSyncPass
 from .lock_discipline import LockDisciplinePass
 from .metric_names import MetricNamesPass
+from .rank_divergence import RankDivergencePass
 from .retrace_hazard import RetraceHazardPass
 
 ALL_PASSES = (BareExceptPass, MetricNamesPass, LockDisciplinePass,
               FlagLivenessPass, DonationSafetyPass, RetraceHazardPass,
-              HostSyncPass)
+              HostSyncPass, RankDivergencePass, CommitProtocolPass)
 
 __all__ = ["ALL_PASSES", "BareExceptPass", "MetricNamesPass",
            "LockDisciplinePass", "FlagLivenessPass",
            "DonationSafetyPass", "RetraceHazardPass", "HostSyncPass",
+           "RankDivergencePass", "CommitProtocolPass",
            "Finding", "LintPass", "RunResult", "UnknownPassError",
            "run_passes", "report", "repo_root", "iter_py_files",
-           "parse_noqa", "DEFAULT_PATHS", "make_passes", "run"]
+           "parse_noqa", "findings_json", "DEFAULT_PATHS",
+           "make_passes", "run"]
 
 
 def make_passes(select=None):

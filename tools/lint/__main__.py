@@ -7,15 +7,79 @@ with no arguments) runs every registered pass over the runtime
 packages; ``--select`` picks passes; positional paths narrow the walk;
 ``--budget-s`` fails the run when the wall time exceeds the budget
 (the CI guard keeping lint growth out of the tier-1 cap).
+
+``--changed`` (ISSUE 14) lints only the files that differ from the
+git merge-base with ``--base`` (default ``main``) — committed,
+staged, unstaged and untracked alike — for fast pre-commit runs;
+``--all`` stays the CI path. Whole-repo passes (flag-liveness pairs
+defines against reads across the full walk) are skipped there with a
+note: a partial file list would fabricate findings.
+
+``--format=json`` prints a versioned machine-readable document
+(``{"version": 1, "files_checked": N, "findings": [{file, line,
+rule, message}, ...]}``) so CI can annotate PRs; the schema is pinned
+by a round-trip test.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 import time
+from typing import List, Optional
 
-from . import ALL_PASSES, UnknownPassError, make_passes, report, run_passes
+from . import (ALL_PASSES, DEFAULT_PATHS, UnknownPassError, make_passes,
+               repo_root, report, run_passes)
+from .framework import report_json
+
+
+def _git(root: str, *args: str) -> Optional[str]:
+    try:
+        p = subprocess.run(["git", "-C", root, *args],
+                           capture_output=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if p.returncode != 0:
+        return None
+    return p.stdout.decode(errors="replace")
+
+
+def collect_changed(root: str, base: str = "main") -> \
+        Optional[List[str]]:
+    """Absolute paths of ``.py`` files under the runtime roots that
+    differ from the merge-base with ``base`` (falling back to ``HEAD``
+    when the base ref does not exist — then only uncommitted work is
+    linted), plus untracked files. None when ``root`` is not a git
+    checkout."""
+    mb = _git(root, "merge-base", "HEAD", base)
+    if mb is None:
+        # no such base ref (detached CI checkout, renamed default
+        # branch): lint what is not yet committed rather than nothing
+        mb = _git(root, "rev-parse", "HEAD")
+    if mb is None:
+        return None
+    names = []
+    diff = _git(root, "diff", "--name-only", mb.strip())
+    if diff is not None:
+        names += diff.splitlines()
+    untracked = _git(root, "ls-files", "--others",
+                     "--exclude-standard")
+    if untracked is not None:
+        names += untracked.splitlines()
+    roots = tuple(r.rstrip("/") for r in DEFAULT_PATHS)
+    out = []
+    for name in sorted(set(n.strip() for n in names if n.strip())):
+        if not name.endswith(".py"):
+            continue
+        if not any(name == r or name.startswith(r + "/")
+                   for r in roots):
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(path):  # deleted files have no content
+            out.append(path)
+    return out
 
 
 def main(argv=None) -> int:
@@ -30,6 +94,19 @@ def main(argv=None) -> int:
                          "--select lock-discipline,donation-safety")
     ap.add_argument("--list", action="store_true",
                     help="list registered passes and exit")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files differing from the git "
+                         "merge-base with --base (fast pre-commit "
+                         "runs; whole-repo passes are skipped with a "
+                         "note — --all stays the CI path)")
+    ap.add_argument("--base", default="main",
+                    help="merge-base ref for --changed "
+                         "(default: main)")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json"),
+                    help="findings output: human text (default) or "
+                         "the versioned JSON document CI annotators "
+                         "parse")
     ap.add_argument("--budget-s", type=float, default=0.0,
                     help="fail (exit 1) when the run takes longer than "
                          "this many seconds, findings or not — the CI "
@@ -49,10 +126,45 @@ def main(argv=None) -> int:
     except UnknownPassError as e:
         print(e.teach(), file=sys.stderr)
         return 2
+    paths = args.paths or None
+    run_root = None
+    if args.changed:
+        if args.paths:
+            print("tools.lint: --changed and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        root = repo_root()
+        changed = collect_changed(root, args.base)
+        if changed is None:
+            print(f"tools.lint: --changed needs a git checkout at "
+                  f"{root} — falling back is unsafe, run --all",
+                  file=sys.stderr)
+            return 2
+        skipped = [p.name for p in passes if p.whole_repo]
+        if skipped:
+            print("tools.lint: --changed skips whole-repo pass(es) "
+                  f"{', '.join(skipped)} (define/read pairing needs "
+                  "the full walk; --all covers them)",
+                  file=sys.stderr)
+            passes = [p for p in passes if not p.whole_repo]
+        if not changed:
+            print("tools.lint: nothing changed under the runtime "
+                  "roots vs merge-base — clean", file=sys.stderr)
+            return 0
+        paths = changed
+        run_root = root  # per-pass roots resolve against THIS checkout
     t0 = time.monotonic()
-    result = run_passes(passes, paths=args.paths or None)
+    # --changed file lists must lint exactly as --all would: keep the
+    # per-pass roots filter active (metric-names deliberately skips
+    # tools/, and a pre-commit red that CI-green --all suppresses
+    # would teach people to ignore the tool)
+    result = run_passes(passes, paths=paths, root=run_root,
+                        respect_roots=args.changed)
     dt = time.monotonic() - t0
-    rc = report(result)
+    if args.format == "json":
+        rc = report_json(result)
+    else:
+        rc = report(result)
     if args.budget_s and dt > args.budget_s:
         print(f"tools.lint: run took {dt:.1f}s, over the "
               f"--budget-s {args.budget_s:g}s budget — a pass grew "

@@ -1,0 +1,223 @@
+"""Shared collective-call discovery for the SPMD-discipline passes
+(ISSUE 14) — the ``jitlib`` sibling.
+
+The rank-divergence and commit-protocol passes both need the same
+per-file facts: *which call sites are collectives* (operations every
+rank of an SPMD program must reach in the same order) and *which
+conditionals partition the ranks* (branches whose arms execute on
+disjoint rank subsets). This module computes both, memoized per tree
+the way ``jitlib.collect_jit_info`` is.
+
+What counts as a collective (lexical — the documented limit of every
+pass built on this):
+
+* ``lax``-level named-axis collectives: ``psum``/``pmean``/``pmax``/
+  ``pmin``/``psum_scatter``/``all_gather``/``ppermute``/``pshuffle``/
+  ``all_to_all`` — matched as bare names or behind a ``lax``/
+  ``jax.lax`` attribute (NOT plain ``lax.reduce``/``lax.broadcast``,
+  which are local shape/monoid ops);
+* multi-host coordination: ``sync_global_devices`` /
+  ``broadcast_one_to_all`` / ``process_allgather`` (the
+  ``multihost_utils`` surface);
+* the repo's eager wrappers (``distributed/collective.py``):
+  ``all_reduce``/``all_gather``/``reduce_scatter``/``alltoall``/
+  ``barrier``/``hierarchical_all_reduce`` as bare names, plus
+  ``reduce``/``broadcast``/``scatter``/``send``/``recv`` when reached
+  through a ``dist``/``distributed``/``collective`` attribute (bare
+  ``reduce`` would catch ``functools.reduce``).
+
+What counts as a *rank-conditional* test — an expression that can
+evaluate differently on different ranks of the same job:
+
+* a call whose callee's final name is ``process_index``/``get_rank``/
+  ``axis_index``/``local_rank``/``node_rank``;
+* a name (or attribute's final component) that IS or ends in ``rank``,
+  or is ``trainer_id``/``rank_id``/``proc_id``/``process_id``;
+* the env spellings: a string literal ``PADDLE_TRAINER_ID`` or
+  ``RANK`` anywhere inside the test.
+
+``process_count()``/``get_world_size()`` are deliberately NOT
+rank-conditional: the world size is uniform across ranks, and
+``if process_count() > 1:`` is the standard single-host fast path.
+
+A collective reached through a helper the pass cannot link
+(``fn = table[op]; fn(x)``) is invisible here — that is the runtime
+sanitizer's job (``core/collective_sanitizer.py``), not this one.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+# distinctive collective names: safe to match as BARE calls too
+BARE_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "ppermute", "pshuffle", "all_to_all", "alltoall", "all_reduce",
+    "reduce_scatter", "barrier", "hierarchical_all_reduce",
+    "sync_global_devices", "broadcast_one_to_all", "process_allgather",
+})
+# generic names that are collectives only behind a collective-module
+# attribute (bare `reduce` is functools.reduce, `broadcast` is
+# numpy/lax shape broadcasting)
+QUALIFIED_COLLECTIVES = frozenset({
+    "reduce", "broadcast", "scatter", "send", "recv",
+})
+# module aliases whose attributes make QUALIFIED_COLLECTIVES real
+# collectives (the repo's import spellings)
+_COLLECTIVE_MODULES = frozenset({
+    "dist", "distributed", "collective", "paddle_dist", "cc",
+})
+# lax-level names valid ONLY behind lax/jax.lax (none currently beyond
+# BARE — kept separate so lax.broadcast never matches)
+_LAX_MODULES = frozenset({"lax"})
+_MULTIHOST_MODULES = frozenset({"multihost_utils"})
+
+_RANK_CALLS = frozenset({
+    "process_index", "get_rank", "axis_index", "local_rank",
+    "node_rank", "get_local_rank",
+})
+_RANK_NAMES = frozenset({
+    "rank", "trainer_id", "rank_id", "proc_id", "process_id", "grank",
+    "my_rank", "local_rank", "worker_rank",
+})
+_RANK_ENV_STRINGS = frozenset({"PADDLE_TRAINER_ID", "RANK"})
+
+
+@dataclass
+class CollectiveCall:
+    """One lexical collective call site."""
+    node: ast.Call
+    lineno: int
+    op: str          # canonical op name ("psum", "barrier", ...)
+    text: str        # how the source spells it ("lax.psum", "barrier")
+
+
+def _tail(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    """Final name component of an attribute's VALUE: ``jax.lax.psum``
+    -> ``lax``, ``dist.all_reduce`` -> ``dist``."""
+    if isinstance(node, ast.Attribute):
+        v = node.value
+        if isinstance(v, ast.Attribute):
+            return v.attr
+        if isinstance(v, ast.Name):
+            return v.id
+    return None
+
+
+def classify_collective(call: ast.Call) -> Optional[str]:
+    """Canonical op name when ``call`` is a collective, else None."""
+    fn = call.func
+    name = _tail(fn)
+    if name is None:
+        return None
+    if isinstance(fn, ast.Name):
+        return name if name in BARE_COLLECTIVES else None
+    base = _base_name(fn)
+    if name in BARE_COLLECTIVES:
+        # attribute spellings of the distinctive names are collectives
+        # from any plausible module (lax.psum, dist.all_gather,
+        # multihost_utils.sync_global_devices) — EXCEPT obvious
+        # non-modules like a method on a list (`x.all_gather` would be
+        # exotic enough to flag anyway)
+        return name
+    if name in QUALIFIED_COLLECTIVES and base is not None \
+            and base.lower() in _COLLECTIVE_MODULES:
+        return name
+    return None
+
+
+def _expr_text(node: ast.expr) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all exprs
+        return "<?>"
+
+
+def collect_collectives(root: ast.AST) -> List[CollectiveCall]:
+    """Every lexical collective call under ``root`` (document order)."""
+    out: List[CollectiveCall] = []
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            op = classify_collective(node)
+            if op is not None:
+                out.append(CollectiveCall(
+                    node=node, lineno=node.lineno, op=op,
+                    text=_expr_text(node.func)))
+    out.sort(key=lambda c: c.lineno)
+    return out
+
+
+def rank_condition_reason(test: ast.expr) -> Optional[str]:
+    """Why ``test`` is rank-conditional (a short source fragment for
+    the finding message), or None when it is rank-uniform."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            callee = _tail(node.func)
+            if callee in _RANK_CALLS:
+                return _expr_text(node.func) + "()"
+        elif isinstance(node, ast.Name):
+            nid = node.id.lower()
+            if nid in _RANK_NAMES or nid.endswith("_rank"):
+                return node.id
+        elif isinstance(node, ast.Attribute):
+            attr = node.attr.lower()
+            if attr in _RANK_NAMES or attr.endswith("_rank"):
+                return _expr_text(node)
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str) \
+                and node.value in _RANK_ENV_STRINGS:
+            return f"env {node.value!r}"
+    return None
+
+
+def is_process0_guard(test: ast.expr) -> bool:
+    """True for the declared-commit-guard shape: a comparison of a
+    rank expression against the literal 0 (``process_index() == 0``,
+    ``rank == 0``, ``self.rank == 0``), or ``not process_index()``."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = test.operand
+        return (isinstance(inner, ast.Call)
+                and _tail(inner.func) in _RANK_CALLS)
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return False
+    if not isinstance(test.ops[0], ast.Eq):
+        return False
+    sides = (test.left, test.comparators[0])
+    zero = any(isinstance(s, ast.Constant) and s.value == 0
+               and not isinstance(s.value, bool) for s in sides)
+    ranky = any(rank_condition_reason(s) is not None for s in sides)
+    return zero and ranky
+
+
+def function_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    """Every function/method def in the module (outermost first)."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def walk_skipping_nested_defs(root: ast.AST):
+    """``ast.walk`` over ``root``'s subtree that does not descend into
+    nested function/class bodies — a closure defined inside a branch
+    does not EXECUTE inside it (the lock-discipline lesson)."""
+    yield root
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_NODES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

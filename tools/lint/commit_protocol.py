@@ -1,0 +1,180 @@
+"""Lint pass: the multi-host checkpoint commit protocol (ISSUE 14).
+
+PR 2 hardened the multi-host checkpoint commit into a discipline:
+every process feeds orbax the same path, but exactly ONE process
+(process 0) stamps the manifest, renames the tmp dir into place and
+runs GC — and then EVERY process learns the outcome through a
+broadcast that doubles as the commit barrier, so peers raise together
+on failure and a retry re-enters the collective save in lockstep.
+PR 2's original bug was precisely the missing second half: a
+rank-0-only commit retry without the outcome broadcast left peers
+waiting at a barrier rank 0 never re-entered.
+
+This pass makes the discipline declarable and checkable, the
+``# guarded-by:`` way:
+
+* ``commit-protocol`` — in a *multi-host-aware function* (one that
+  consults ``process_index()``/``process_count()`` or the
+  ``multihost_utils`` surface), a filesystem commit call
+  (``os.replace``/``os.rename``/``shutil.rmtree``/``shutil.move``)
+  must sit inside a process-0 guard (``if process_index() == 0:``),
+  and that guard must DECLARE itself with a ``# commit-protocol:
+  <name>`` marker comment on the guard line. An unguarded commit call
+  is a finding at the call line (every process renames over the same
+  path); an undeclared guard holding commit calls is a finding at the
+  guard line (declare it so the pairing rule below can see it).
+
+* ``commit-broadcast`` — every DECLARED commit-protocol guard must be
+  paired, later in the same function, with an outcome
+  broadcast/barrier (``broadcast_one_to_all``/``sync_global_devices``/
+  ``barrier``): without it, peers either hang at the next rendezvous
+  when process 0's commit failed and retried, or report success for a
+  checkpoint that was never committed. The finding lands on the guard
+  line — the PR 2 historical shape, caught lexically.
+
+Helper functions that do fs renames but never consult the process
+topology (``write_manifest``, a single-host ``_gc``) are out of
+scope: the discipline binds where the code KNOWS it is multi-host.
+Intended exceptions take ``# noqa: <rule> — reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .collectivelib import is_process0_guard, walk_skipping_nested_defs
+from .framework import Finding, LintPass
+
+_MARKER_RE = re.compile(r"#\s*commit-protocol:\s*(\S+)")
+
+# (module, attr) pairs that commit filesystem state
+_FS_COMMIT = {
+    ("os", "replace"), ("os", "rename"), ("os", "renames"),
+    ("shutil", "rmtree"), ("shutil", "move"),
+}
+_MULTIHOST_CALLS = frozenset({"process_index", "process_count"})
+_OUTCOME_CALLS = frozenset({"broadcast_one_to_all",
+                            "sync_global_devices", "barrier"})
+
+
+def _call_mod_attr(node: ast.Call) -> Optional[Tuple[str, str]]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return (fn.value.id, fn.attr)
+    return None
+
+
+def _call_tail(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_fs_commit(node: ast.Call) -> bool:
+    pair = _call_mod_attr(node)
+    return pair is not None and pair in _FS_COMMIT
+
+
+def _is_multihost_aware(fdef) -> bool:
+    for node in walk_skipping_nested_defs(fdef):
+        if isinstance(node, ast.Call) \
+                and _call_tail(node) in _MULTIHOST_CALLS:
+            return True
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "multihost_utils":
+            return True
+        if isinstance(node, ast.Name) and node.id == "multihost_utils":
+            return True
+    return False
+
+
+class CommitProtocolPass(LintPass):
+    name = "commit-protocol"
+    rules = ("commit-protocol", "commit-broadcast")
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        lines = src.splitlines()
+        markers: Dict[int, str] = {}
+        for i, text in enumerate(lines, start=1):
+            m = _MARKER_RE.search(text)
+            if m:
+                markers[i] = m.group(1)
+        for fdef in [n for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            if not _is_multihost_aware(fdef):
+                continue
+            self._check_function(fdef, path, markers, findings)
+        return findings
+
+    def _check_function(self, fdef, path: str, markers: Dict[int, str],
+                        findings: List[Finding]) -> None:
+        guards = [n for n in walk_skipping_nested_defs(fdef)
+                  if isinstance(n, ast.If)
+                  and is_process0_guard(n.test)]
+
+        def guard_of(call: ast.Call) -> Optional[ast.If]:
+            for g in guards:
+                for sub in walk_skipping_nested_defs(g):
+                    if sub is call:
+                        return g
+            return None
+
+        # outcome broadcast/barrier call lines at function scope
+        outcome_lines = [n.lineno for n in walk_skipping_nested_defs(fdef)
+                         if isinstance(n, ast.Call)
+                         and _call_tail(n) in _OUTCOME_CALLS]
+
+        guards_with_commits = set()
+        for node in walk_skipping_nested_defs(fdef):
+            if not (isinstance(node, ast.Call) and _is_fs_commit(node)):
+                continue
+            g = guard_of(node)
+            if g is None:
+                pair = _call_mod_attr(node)
+                findings.append(Finding(
+                    path, node.lineno, "commit-protocol",
+                    f"{pair[0]}.{pair[1]} in a multi-host-aware "
+                    "function outside a process-0 guard — EVERY "
+                    "process commits/renames/sweeps the same path "
+                    "(racing renames, N-fold GC). Guard it with "
+                    "'if process_index() == 0:' declared as "
+                    "'# commit-protocol: <name>', or "
+                    "'# noqa: commit-protocol — reason' for a "
+                    "genuinely per-process path"))
+            else:
+                guards_with_commits.add(g)
+
+        for g in guards_with_commits:
+            declared = markers.get(g.lineno)
+            if declared is None:
+                findings.append(Finding(
+                    path, g.lineno, "commit-protocol",
+                    "process-0 guard performs filesystem commits but "
+                    "declares no protocol — add '# commit-protocol: "
+                    "<name>' on the guard line so the outcome-"
+                    "broadcast pairing is checkable (the PR 2 "
+                    "discipline: one committer, everyone learns the "
+                    "outcome)"))
+                continue
+            guard_end = getattr(g, "end_lineno", g.lineno) or g.lineno
+            if not any(ln > guard_end for ln in outcome_lines):
+                findings.append(Finding(
+                    path, g.lineno, "commit-broadcast",
+                    f"commit-protocol '{declared}' guard has no "
+                    "outcome broadcast/barrier after it in this "
+                    "function — peers never learn whether process "
+                    "0's commit succeeded: on failure they hang at "
+                    "the next rendezvous (the PR 2 retry-mismatch "
+                    "hang) or report success for an uncommitted "
+                    "checkpoint. Follow the guard with "
+                    "broadcast_one_to_all(ok)/sync_global_devices "
+                    "so every process raises (and retries) "
+                    "together"))

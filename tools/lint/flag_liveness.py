@@ -61,6 +61,10 @@ def _call_name(node: ast.Call) -> str:
 class FlagLivenessPass(LintPass):
     name = "flag-liveness"
     rules = ("dead-flag",)
+    # define/read pairing only holds over the FULL walk: a partial file
+    # list (--changed) would read every flag in a changed flags.py as
+    # dead — the CLI skips this pass there
+    whole_repo = True
 
     def begin(self) -> None:
         # name -> (path, line) of the define_flag site

@@ -75,7 +75,7 @@ class Finding:
     rule: str
     message: str
 
-    def format(self, root: Optional[str] = None) -> str:
+    def _rel(self, root: Optional[str]) -> str:
         p = self.path
         if root:
             try:
@@ -84,7 +84,18 @@ class Finding:
                     p = rel
             except ValueError:  # pragma: no cover - windows drives
                 pass
-        return f"{p}:{self.line}: [{self.rule}] {self.message}"
+        return p
+
+    def format(self, root: Optional[str] = None) -> str:
+        return (f"{self._rel(root)}:{self.line}: [{self.rule}] "
+                f"{self.message}")
+
+    def as_dict(self, root: Optional[str] = None) -> Dict[str, object]:
+        """The machine-readable shape of ``--format=json`` (exactly
+        these four keys — the schema the round-trip test pins)."""
+        return {"file": self._rel(root).replace(os.sep, "/"),
+                "line": self.line, "rule": self.rule,
+                "message": self.message}
 
 
 @dataclass
@@ -122,6 +133,11 @@ class LintPass:
     # True when the pass implements its own marker handling (the
     # bare-except pass): the generic suppression layer skips it
     self_suppressing: bool = False
+    # True when the pass cross-references the WHOLE walk (flag-liveness
+    # pairs defines against reads repo-wide): running it over a partial
+    # file list (--changed) would fabricate findings, so the CLI skips
+    # it there with a note
+    whole_repo: bool = False
 
     def wants(self, rel_path: str) -> bool:
         rp = rel_path.replace(os.sep, "/")
@@ -171,9 +187,16 @@ class RunResult:
 
 def run_passes(passes: Sequence[LintPass],
                paths: Optional[Sequence[str]] = None,
-               root: Optional[str] = None) -> RunResult:
+               root: Optional[str] = None,
+               respect_roots: bool = False) -> RunResult:
     """Walk once, parse once per file, fan out to every pass, apply the
-    generic noqa layer, return sorted findings."""
+    generic noqa layer, return sorted findings.
+
+    Explicit ``paths`` normally see every selected pass (seeded test
+    fixtures live outside the repo roots); ``respect_roots=True`` keeps
+    the per-pass ``roots`` filter active anyway — the ``--changed``
+    mode, whose file list is repo files that must lint exactly as the
+    full ``--all`` walk would."""
     root = root or repo_root()
     explicit = paths is not None
     if paths is None:
@@ -194,8 +217,9 @@ def run_passes(passes: Sequence[LintPass],
             continue
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         # explicit paths see every selected pass (seeded fixtures live
-        # outside the repo roots); the default walk honors pass roots
-        takers = (list(passes) if explicit
+        # outside the repo roots); the default walk — and --changed,
+        # which must match it — honors pass roots
+        takers = (list(passes) if explicit and not respect_roots
                   else [p for p in passes if p.wants(rel)])
         if not takers:
             continue
@@ -274,3 +298,23 @@ def report(result: RunResult, out=None, root: Optional[str] = None) -> int:
               f"{result.files_checked} file(s)", file=sys.stderr)
         return 1
     return 0
+
+
+def findings_json(result: RunResult,
+                  root: Optional[str] = None) -> str:
+    """The ``--format=json`` document: a versioned object CI annotators
+    parse (one entry per finding, file/line/rule/message)."""
+    import json
+    root = root or repo_root()
+    return json.dumps(
+        {"version": 1,
+         "files_checked": result.files_checked,
+         "findings": [f.as_dict(root) for f in result.findings]},
+        indent=2)
+
+
+def report_json(result: RunResult, out=None,
+                root: Optional[str] = None) -> int:
+    out = out if out is not None else sys.stdout
+    print(findings_json(result, root), file=out)
+    return 1 if result.findings else 0

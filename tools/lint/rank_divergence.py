@@ -1,0 +1,240 @@
+"""Lint pass: rank-divergent collective schedules (ISSUE 14).
+
+The hardest bug class left in this stack HANGS instead of erroring: a
+collective reached by some ranks and not others. Every rank of an SPMD
+job must issue the same collectives in the same order — a
+``lax.psum`` (or a ``sync_global_devices`` barrier) inside a
+``if rank == 0:`` branch means rank 0 waits at a rendezvous its peers
+never reach, and the job wedges until a hang timeout fires with no
+pointer at the cause. PR 2's multi-host commit originally shipped
+exactly this shape (a rank-conditional retry skipped a barrier the
+peers re-entered).
+
+Three rules, all lexical (see ``tools/lint/collectivelib.py`` for what
+counts as a collective and as a rank-conditional test):
+
+* ``rank-divergent-collective`` — a collective call lexically inside a
+  branch (``if``/``elif``/``else``/ternary/``while``) whose test is
+  rank-conditional (``rank == 0``, ``process_index()``,
+  ``PADDLE_TRAINER_ID``). Rank-uniform tests (``process_count() > 1``,
+  a config flag) are fine — every rank takes the same arm.
+
+* ``rank-divergent-skip`` — an early ``return``/``raise``/
+  ``continue``/``break`` inside a rank-conditional branch when a
+  collective appears LATER in the same function: the exiting rank
+  skips a rendezvous its peers still enter.
+
+* ``collective-swallow`` — a collective inside a ``try`` body whose
+  handler does not re-raise: an exception on ONE rank (a full disk, a
+  flaky socket) silently skips that rank's collective while the peers
+  block in theirs. Handlers that re-raise (or raise anything) keep the
+  ranks in lockstep — they all unwind.
+
+Value-level rank selects (``jnp.where(axis_index(axis) == 0, ...)``)
+are NOT control flow: every rank still executes the collective, so
+``reduce``/``broadcast``-style masked implementations stay clean.
+Intended divergence — a genuinely local rank-0-only fast path — takes
+``# noqa: <rule> — reason``, making the exception greppable
+documentation, like the host-sync budget. The runtime half of this
+pass is ``core/collective_sanitizer.py``, which catches the schedules
+a lexical view cannot link.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .collectivelib import (CollectiveCall, classify_collective,
+                            collect_collectives, rank_condition_reason,
+                            walk_skipping_nested_defs)
+from .framework import Finding, LintPass
+
+_EXIT_NODES = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+def _collectives_in(node: ast.AST) -> List[CollectiveCall]:
+    """Collective calls in ``node``'s subtree, nested defs excluded."""
+    out = []
+    for sub in walk_skipping_nested_defs(node):
+        if isinstance(sub, ast.Call):
+            op = classify_collective(sub)
+            if op is not None:
+                out.append(CollectiveCall(
+                    node=sub, lineno=sub.lineno, op=op, text=op))
+    return out
+
+
+class RankDivergencePass(LintPass):
+    name = "rank-divergence"
+    rules = ("rank-divergent-collective", "rank-divergent-skip",
+             "collective-swallow")
+
+    def check_file(self, path: str, rel: str, src: str,
+                   tree: ast.AST) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if not collect_collectives(tree):
+            return findings  # no collectives anywhere: nothing to order
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            self._check_function(scope, path, findings)
+        return findings
+
+    # -- per-function -------------------------------------------------------
+
+    def _check_function(self, fdef, path: str,
+                        findings: List[Finding]) -> None:
+        # every collective at THIS function's scope (closures excluded:
+        # a traced inner `f(x)` has its own schedule obligations at its
+        # own call sites)
+        own = {c.node: c for c in _collectives_in(fdef)}
+        if not own:
+            return
+        colls = sorted(own.values(), key=lambda c: c.lineno)
+        flagged: set = set()  # nested rank-ifs must not double-report
+
+        for node in walk_skipping_nested_defs(fdef):
+            if isinstance(node, ast.If) or isinstance(node, ast.While):
+                reason = rank_condition_reason(node.test)
+                if reason is None:
+                    continue
+                self._check_rank_branch(node, reason, path, colls,
+                                        flagged, findings)
+            elif isinstance(node, ast.IfExp):
+                reason = rank_condition_reason(node.test)
+                if reason is None:
+                    continue
+                for arm in (node.body, node.orelse):
+                    for c in _collectives_in(arm):
+                        if c.node not in flagged:
+                            flagged.add(c.node)
+                            findings.append(self._divergent(
+                                path, c, reason, node.lineno))
+            elif isinstance(node, ast.Try):
+                self._check_try(node, path, findings)
+
+    def _check_rank_branch(self, branch, reason: str, path: str,
+                           colls, flagged: set,
+                           findings: List[Finding]) -> None:
+        # arms of a rank-conditional execute on DISJOINT rank subsets:
+        # a collective in either arm is reached by only some ranks
+        arms = [branch.body]
+        if branch.orelse:
+            arms.append(branch.orelse)
+        arm_colls = set()
+        for arm in arms:
+            for stmt in arm:
+                for c in _collectives_in(stmt):
+                    arm_colls.add(c.node)
+                    if c.node not in flagged:
+                        flagged.add(c.node)
+                        findings.append(self._divergent(
+                            path, c, reason, branch.lineno))
+        # early exits inside the branch that skip a LATER collective in
+        # the same function (lexically after the branch)
+        for arm in arms:
+            # continue/break whose enclosing loop sits INSIDE the arm
+            # never leave the branch (the checkpoint retry-loop shape:
+            # `for attempt: ... continue` under the process-0 guard
+            # re-tries, it does not skip the broadcast after)
+            inner_loop_stmts = set()
+            for stmt in arm:
+                for sub in walk_skipping_nested_defs(stmt):
+                    if isinstance(sub, (ast.For, ast.While)):
+                        for inner in walk_skipping_nested_defs(sub):
+                            if inner is not sub:
+                                inner_loop_stmts.add(inner)
+            for stmt in arm:
+                for sub in walk_skipping_nested_defs(stmt):
+                    if not isinstance(sub, _EXIT_NODES):
+                        continue
+                    if isinstance(sub, (ast.Continue, ast.Break)) \
+                            and (sub in inner_loop_stmts
+                                 or isinstance(branch, ast.While)):
+                        # when the rank-conditional IS a while loop,
+                        # break/continue directly under it stay inside
+                        # the loop protocol: break exits to the code
+                        # after the loop (which every rank reaches),
+                        # continue re-tests — neither skips a later
+                        # collective
+                        continue
+                    later = next((c for c in colls
+                                  if c.lineno > sub.lineno
+                                  and c.node not in arm_colls), None)
+                    if later is None:
+                        continue
+                    kind = type(sub).__name__.lower()
+                    findings.append(Finding(
+                        path, sub.lineno, "rank-divergent-skip",
+                        f"{kind} under rank-conditional '{reason}' "
+                        f"(line {branch.lineno}) skips the "
+                        f"'{later.op}' collective at line "
+                        f"{later.lineno} on this rank while peers "
+                        "still enter it — the divergent schedule "
+                        "deadlocks at the next rendezvous; hoist the "
+                        "collective above the exit, or make every "
+                        "rank take the exit together "
+                        "('# noqa: rank-divergent-skip — reason' if "
+                        "the later collective is truly unreachable "
+                        "on the other arm)"))
+                    break  # one finding per exit statement
+
+    def _check_try(self, node: ast.Try, path: str,
+                   findings: List[Finding]) -> None:
+        swallower = self._swallowing_handler(node)
+        if swallower is None:
+            return
+        # the else clause only runs when the body didn't raise, so a
+        # one-rank exception skips its collectives exactly like the
+        # body's; finally always runs and stays clean
+        for stmt in list(node.body) + list(node.orelse):
+            for c in _collectives_in(stmt):
+                findings.append(Finding(
+                    path, c.lineno, "collective-swallow",
+                    f"'{c.op}' collective inside a try whose "
+                    f"'except {swallower[1]}' handler (line "
+                    f"{swallower[0]}) does not re-raise — an "
+                    "exception on ONE rank silently skips this "
+                    "rank's collective while peers block at the "
+                    "rendezvous; re-raise past the collective, or "
+                    "record the outcome and have EVERY rank act on "
+                    "it together (the checkpoint commit-broadcast "
+                    "pattern). '# noqa: collective-swallow — reason' "
+                    "documents an intended best-effort site"))
+
+    @staticmethod
+    def _swallowing_handler(
+            node: ast.Try) -> Optional[Tuple[int, str]]:
+        """(line, caught-type text) of the first handler that can
+        swallow — no ``raise`` anywhere in its body."""
+        for h in node.handlers:
+            reraises = any(isinstance(sub, ast.Raise)
+                           for sub in ast.walk(h))
+            if reraises:
+                continue
+            if h.type is None:
+                caught = "<bare>"
+            else:
+                try:
+                    caught = ast.unparse(h.type)
+                except Exception:  # pragma: no cover
+                    caught = "?"
+            return (h.lineno, caught)
+        return None
+
+    @staticmethod
+    def _divergent(path: str, c: CollectiveCall, reason: str,
+                   guard_line: int) -> Finding:
+        return Finding(
+            path, c.lineno, "rank-divergent-collective",
+            f"'{c.op}' collective inside a rank-conditional branch "
+            f"('{reason}', line {guard_line}) — only some ranks reach "
+            "it, so they block at a rendezvous their peers never "
+            "enter (the hang-not-error class). Hoist the collective "
+            "out of the branch and select the VALUE per rank instead "
+            "(jnp.where(axis_index(..) == 0, ...)), or run it on "
+            "every rank and mask. '# noqa: "
+            "rank-divergent-collective — reason' documents a "
+            "genuinely local fast path")
