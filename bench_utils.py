@@ -20,7 +20,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List
 
-__all__ = ["BestOf", "SelfTimed", "best_of"]
+__all__ = ["BestOf", "SelfTimed", "best_of",
+           "compiled_hlo_layout_census"]
 
 
 @dataclass
@@ -79,3 +80,21 @@ def best_of(n: int, *fns: Callable[[], Any]) -> List[BestOf]:
             out.times.append(dt)
             out.results.append(r)
     return outs
+
+
+def compiled_hlo_layout_census(fn, *args) -> dict:
+    """jit-compile ``fn(*args)`` and count layout ops in the OPTIMIZED
+    HLO — the channels-last region's CPU-measurable layout-stability
+    probe (transposes/copies that survived XLA's cancellation). One
+    definition shared by ``bench.py --conv-block`` and the
+    ``TestConvBlockLayoutStability`` regression so the two censuses
+    cannot drift."""
+    import re
+
+    import jax
+
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    return {
+        "transposes": len(re.findall(r"= \S+ transpose\(", hlo)),
+        "copies": len(re.findall(r"= \S+ copy\(", hlo)),
+    }

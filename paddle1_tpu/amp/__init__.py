@@ -34,7 +34,8 @@ WHITE_LIST = {"matmul", "bmm", "conv1d", "conv2d", "conv3d", "linear",
               "einsum", "addmm", "mv"}
 BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax",
               "log_softmax", "cross_entropy", "layer_norm", "norm",
-              "batch_norm_train", "batch_norm_infer", "cosine_similarity",
+              "batch_norm_train", "batch_norm_infer", "fused_bn_act_train",
+              "fused_bn_act_infer", "cosine_similarity",
               "reduce_sum", "pow", "square", "softmax_with_cross_entropy"}
 
 _tls = threading.local()

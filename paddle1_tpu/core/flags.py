@@ -333,6 +333,39 @@ def _define_builtin_flags() -> None:
     define_flag("fused_layer_norm", "auto",
                 "Pallas fused LayerNorm: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("fused_bn", "auto",
+                "Pallas fused batch norm (one kernel for stats + "
+                "normalize + activation + residual-add, the reference "
+                "fused_bn_activation_op/fused_bn_add_activation_op "
+                "role): auto (TPU only, AND only when the channels-"
+                "last activation is at least fused_bn_auto_mb — small "
+                "BNs are latency-bound and XLA's fusion handles them; "
+                "the crossover lives where the multi-pass stat chain "
+                "becomes HBM-bound, ~46% of the ResNet-50 step in "
+                "chip_results/resnet_trace_b32.txt), always "
+                "(interpret-mode on CPU, for tests and the "
+                "bench.py --conv-block gate), never (the XLA lowering "
+                "— the ablation arm for the next chip window). "
+                "Requires a channels-last layout (NHWC data_format or "
+                "the conv_nhwc region) and affine weight+bias.",
+                validator=lambda v: v in ("auto", "always", "never"))
+    define_flag("fused_bn_auto_mb", 4.0,
+                "Crossover threshold (MiB of the BN input activation) "
+                "below which fused_bn=auto keeps the XLA lowering: "
+                "under it the stat passes fit the compiler's fusion "
+                "budget and kernel launch overhead dominates; above "
+                "it each extra pass is a full HBM round-trip. "
+                "PROVISIONAL until the next chip window's sweep "
+                "(chip_results/NOTES.md) — 'always'/'never' bypass it "
+                "for A/B runs.",
+                validator=lambda v: v > 0)
+    define_flag("fused_bn_bwd", "auto",
+                "Pallas fused batch-norm BACKWARD (one-pass "
+                "dx/dgamma/dbeta): auto (TPU only), always (interpret "
+                "on CPU), never (XLA composition backward — the "
+                "ablation arm; forward fusion still applies). Only "
+                "consulted when the forward ran the fused kernel.",
+                validator=lambda v: v in ("auto", "always", "never"))
     define_flag("fused_adam", "never",
                 "Pallas fused Adam/AdamW update: auto (TPU only), "
                 "always, never. Default never since the r5 on-chip "

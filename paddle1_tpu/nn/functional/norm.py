@@ -17,10 +17,12 @@ import jax
 import jax.numpy as jnp
 
 from ...autograd.engine import apply
+from ...core.errors import InvalidArgumentError
 from ...core.tensor import Tensor, to_tensor
 
-__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
-           "local_response_norm", "normalize", "collect_stat_updates"]
+__all__ = ["batch_norm", "fused_batch_norm_act", "layer_norm",
+           "instance_norm", "group_norm", "local_response_norm",
+           "normalize", "collect_stat_updates"]
 
 
 def _t(x):
@@ -119,76 +121,232 @@ def warn_traced_stats_skipped(buffer, what: str) -> None:
         "(warned once per buffer).")
 
 
-def batch_norm(x, running_mean, running_var, weight=None, bias=None,
-               training=False, momentum=0.9, epsilon=1e-05,
-               data_format="NCHW", use_global_stats=None, name=None):
-    """Batch norm with running-stat update (reference batch_norm_op.cc).
-    Running stats are updated in-place on the passed tensors, mirroring the
-    reference's mutable mean/variance variables."""
+def fused_bn_active(shape, dtype) -> bool:
+    """Resolve the ``fused_bn`` flag family against a channels-LAST
+    input: always / never are absolute, auto additionally requires a
+    TPU backend (flag_active) and an activation at least
+    ``fused_bn_auto_mb`` — below the crossover the multi-pass XLA
+    lowering fits the fusion budget and kernel overhead dominates."""
+    from ...core.flags import flag, flag_active
+    from ...ops.pallas import fused_bn as pbn
+    if not flag_active("fused_bn"):
+        return False
+    if not pbn.supported(shape, dtype):
+        return False
+    if flag("fused_bn") == "auto":
+        n = 1
+        for s in shape:
+            n *= s
+        if n * jnp.dtype(dtype).itemsize < \
+                flag("fused_bn_auto_mb") * 1024 * 1024:
+            return False
+    return True
+
+
+# Cached weak-typed device scalars (epsilon, momentum, the relu zero).
+# A python float inside an eager op body is lifted as a FRESH device
+# constant on every call — one host->device transfer per BN layer per
+# forward (the ISSUE 15 satellite-6 audit finding; measurable dispatch
+# latency on TPU). A cached weak-typed jnp scalar is already device-
+# resident and, being weak, does not promote bf16 compute to f32.
+_scalar_cache: dict = {}
+
+
+def _scalar(v: float):
+    key = float(v)
+    arr = _scalar_cache.get(key)
+    if arr is None:
+        arr = jnp.asarray(key)
+        # under an active trace jnp.asarray yields a TRACED constant —
+        # caching it would leak the tracer into later eager calls (and
+        # inside a trace the constant folds into the jaxpr for free,
+        # so there is nothing worth caching)
+        if not isinstance(arr, jax.core.Tracer):
+            _scalar_cache[key] = arr
+    return arr
+
+
+def _apply_act(y, act):
+    if act == "relu":
+        return jnp.maximum(y, _scalar(0.0))
+    return y
+
+
+def _update_running_stats(running_mean, running_var, mean, var, momentum,
+                          what):
+    if running_mean is None:
+        return
+    if isinstance(mean.data, jax.core.Tracer):
+        # under jit/shard_map the batch stats are traced values —
+        # assigning them into the buffer would leak a tracer (eval
+        # forward / state_dict would then fail). Inside a
+        # framework-owned compiled step the update is FUNCTIONALIZED
+        # (collected here, blended into the step's output params,
+        # assigned outside the trace); a user-compiled fn gets the
+        # warn-and-skip (ADVICE r6 medium: the silence cost real
+        # eval divergence).
+        _record_traced_stat_update(_t(running_mean), _t(running_var),
+                                   mean.data, var.data, momentum, what)
+    else:
+        rm = _t(running_mean)
+        rv = _t(running_var)
+        mom = _scalar(momentum)
+        rem = _scalar(1 - momentum)
+        rm._data = mom * rm.data + rem * mean.data
+        rv._data = mom * rv.data + rem * var.data
+
+
+def _batch_norm_impl(x, running_mean, running_var, weight, bias,
+                     training, momentum, epsilon, data_format,
+                     use_global_stats, act, residual, what):
     x = _t(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     # NCHW 4-D batch norm participates in the channels-last region
     # (_layout.py): computing with the channel axis last makes the
     # boundary transposes sit directly against the neighboring convs'
-    # and pools', where XLA cancels them (chip_results/conv_probe2.txt).
+    # and pools', where XLA cancels them (chip_results/conv_probe2.txt)
+    # — and is what makes the input eligible for the fused Pallas
+    # kernel (ops/pallas/fused_bn.py), which is NHWC-native.
     from ._layout import channels_last_region
+    from ...ops.pallas import fused_bn as pbn
     nhwc_internal, to_internal, from_internal = channels_last_region(
         x.ndim, channel_last)
     eff_last = channel_last or nhwc_internal
     ch_axis = x.ndim - 1 if eff_last else 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     use_stats = (not training) if use_global_stats is None else use_global_stats
+    has_wb = weight is not None
+    has_res = residual is not None
 
     def bshape(v, nd):
         shape = [1] * nd
         shape[ch_axis] = -1
         return v.reshape(shape)
 
+    def split_rest(rest):
+        wb = rest[:2] if has_wb else ()
+        res = rest[-1] if has_res else None
+        return wb, res
+
+    def fused_ok(xi):
+        return (has_wb and eff_last
+                and fused_bn_active(xi.shape, xi.dtype))
+
+    res_args = (_t(residual),) if has_res else ()
+    wb_args = (_t(weight), _t(bias)) if has_wb else ()
+
     if use_stats:
-        def f(x, m, v, *wb):
+        def f(x, m, v, *rest):
             x = to_internal(x)
+            wb, res = split_rest(rest)
+            if res is not None:
+                res = to_internal(res)
+            if fused_ok(x):
+                c = x.shape[-1]
+                y2 = pbn.fused_bn_norm(
+                    x.reshape(-1, c), m, v, wb[0], wb[1], epsilon,
+                    act=act,
+                    residual=None if res is None else res.reshape(-1, c))
+                return from_internal(y2.reshape(x.shape))
             y = (x - bshape(m, x.ndim)) * jax.lax.rsqrt(
-                bshape(v, x.ndim) + epsilon)
+                bshape(v, x.ndim) + _scalar(epsilon))
             if wb:
                 y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
-            return from_internal(y)
-        args = (x, _t(running_mean), _t(running_var))
-        if weight is not None:
-            args = args + (_t(weight), _t(bias))
-        return apply("batch_norm_infer", f, args)
+            if res is not None:
+                y = y + res
+            return from_internal(_apply_act(y, act))
+        args = (x, _t(running_mean), _t(running_var)) + wb_args + res_args
+        return apply(f"{what}_infer", f, args)
 
     # training: compute batch stats, update running stats in place
-    def f(x, *wb):
+    def f(x, *rest):
         x = to_internal(x)
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
-        y = (x - bshape(mean, x.ndim)) * jax.lax.rsqrt(
-            bshape(var, x.ndim) + epsilon)
+        wb, res = split_rest(rest)
+        if res is not None:
+            res = to_internal(res)
+        if fused_ok(x):
+            c = x.shape[-1]
+            y2, mean, var = pbn.fused_bn_train(
+                x.reshape(-1, c), wb[0], wb[1], epsilon, act=act,
+                residual=None if res is None else res.reshape(-1, c))
+            return from_internal(y2.reshape(x.shape)), mean, var
+        # stats via sum * cached-reciprocal rather than jnp.mean/var:
+        # their internal divide lifts the element COUNT as a fresh
+        # device scalar per call — one more per-BN host->device
+        # transfer on the eager train path (satellite-6 audit).
+        # 16-bit inputs keep jnp.mean's f32 accumulator (and its
+        # result dtype), matching the fused kernel's discipline.
+        n_elems = 1
+        for i in reduce_axes:
+            n_elems *= x.shape[i]
+        inv = _scalar(1.0 / n_elems)
+        half = jnp.dtype(x.dtype).itemsize == 2
+        xf = x.astype(jnp.float32) if half else x
+        mean = (jnp.sum(xf, axis=reduce_axes) * inv).astype(x.dtype)
+        xc = x - bshape(mean, x.ndim)
+        xcf = xc.astype(jnp.float32) if half else xc
+        var = (jnp.sum(xcf * xcf, axis=reduce_axes) * inv).astype(x.dtype)
+        y = xc * jax.lax.rsqrt(bshape(var, x.ndim) + _scalar(epsilon))
         if wb:
             y = y * bshape(wb[0], x.ndim) + bshape(wb[1], x.ndim)
-        return from_internal(y), mean, var
+        if res is not None:
+            y = y + res
+        return from_internal(_apply_act(y, act)), mean, var
 
-    args = (x,) + ((_t(weight), _t(bias)) if weight is not None else ())
-    y, mean, var = apply("batch_norm_train", f, args, n_outputs=3)
-    if running_mean is not None:
-        if isinstance(mean.data, jax.core.Tracer):
-            # under jit/shard_map the batch stats are traced values —
-            # assigning them into the buffer would leak a tracer (eval
-            # forward / state_dict would then fail). Inside a
-            # framework-owned compiled step the update is FUNCTIONALIZED
-            # (collected here, blended into the step's output params,
-            # assigned outside the trace); a user-compiled fn gets the
-            # warn-and-skip (ADVICE r6 medium: the silence cost real
-            # eval divergence).
-            _record_traced_stat_update(_t(running_mean), _t(running_var),
-                                       mean.data, var.data, momentum,
-                                       "batch_norm")
-        else:
-            rm = _t(running_mean)
-            rv = _t(running_var)
-            rm._data = momentum * rm.data + (1 - momentum) * mean.data
-            rv._data = momentum * rv.data + (1 - momentum) * var.data
+    args = (x,) + wb_args + res_args
+    y, mean, var = apply(f"{what}_train", f, args, n_outputs=3)
+    _update_running_stats(running_mean, running_var, mean, var, momentum,
+                          what)
     return y
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Batch norm with running-stat update (reference batch_norm_op.cc).
+    Running stats are updated in-place on the passed tensors, mirroring the
+    reference's mutable mean/variance variables. Under the ``fused_bn``
+    flag a channels-last affine BN lowers to the one-pass Pallas kernel
+    (ops/pallas/fused_bn.py)."""
+    return _batch_norm_impl(x, running_mean, running_var, weight, bias,
+                            training, momentum, epsilon, data_format,
+                            use_global_stats, "identity", None,
+                            "batch_norm")
+
+
+def fused_batch_norm_act(x, running_mean, running_var, weight, bias,
+                         training=False, momentum=0.9, epsilon=1e-05,
+                         data_format="NCHW", act="relu", residual=None,
+                         use_global_stats=None, name=None):
+    """``y = act(batch_norm(x) + residual)`` as ONE op — the analog of
+    the reference's fused_bn_activation_op (act only) and
+    fused_bn_add_activation_op (act + residual). Under the ``fused_bn``
+    flag the whole chain runs as a single Pallas kernel; otherwise it
+    is the eager/XLA composition with identical semantics (including
+    the running-stat update and the ``collect_stat_updates``
+    functionalization under a compiled trainer step)."""
+    from ...ops.pallas.fused_bn import ACTS
+    if act not in ACTS:
+        raise InvalidArgumentError(
+            f"fused_batch_norm_act: act must be one of {ACTS}, got "
+            f"{act!r} (the reference fused op supports these)")
+    if weight is None or bias is None:
+        raise InvalidArgumentError(
+            "fused_batch_norm_act requires affine weight and bias (the "
+            "reference fused_bn_activation_op takes Scale and Bias); "
+            "use batch_norm for the affine-less form")
+    if residual is not None:
+        residual = _t(residual)
+        if list(residual.shape) != list(_t(x).shape):
+            raise InvalidArgumentError(
+                "fused_batch_norm_act: residual shape "
+                f"{list(residual.shape)} must match x shape "
+                f"{list(_t(x).shape)} (fused_bn_add_activation_op adds "
+                "elementwise before the activation)")
+    return _batch_norm_impl(x, running_mean, running_var, weight, bias,
+                            training, momentum, epsilon, data_format,
+                            use_global_stats, act, residual,
+                            "fused_bn_act")
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,

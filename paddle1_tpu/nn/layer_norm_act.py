@@ -117,10 +117,22 @@ class SyncBatchNorm(_BatchNormBase):
             x = _to_cl(x)
             # stats in f32 regardless of compute dtype (reference
             # sync_batch_norm_op): a bf16 element count is inexact
-            # past 256 and E[x^2]-mean^2 cancels catastrophically
-            xf = x.astype(jnp.float32)
-            local_sum = jnp.sum(xf, axis=reduce_axes)
-            local_sqsum = jnp.sum(xf * xf, axis=reduce_axes)
+            # past 256 and E[x^2]-mean^2 cancels catastrophically.
+            # Under the fused_bn flag the LOCAL halves ride the Pallas
+            # kernels (ops/pallas/fused_bn.py local_moments +
+            # fused_bn_norm — same f32-accumulate discipline); the
+            # cross-replica psum reduction is unchanged either way.
+            from .functional.norm import fused_bn_active
+            from ..ops.pallas import fused_bn as pbn
+            x2 = None
+            if ch_axis == x.ndim - 1 and fused_bn_active(x.shape,
+                                                         x.dtype):
+                x2 = x.reshape(-1, x.shape[-1])
+                local_sum, local_sqsum = pbn.local_moments(x2)
+            else:
+                xf = x.astype(jnp.float32)
+                local_sum = jnp.sum(xf, axis=reduce_axes)
+                local_sqsum = jnp.sum(xf * xf, axis=reduce_axes)
             count = np.prod([x.shape[i] for i in reduce_axes])
             g_sum = jax.lax.psum(local_sum, axis)
             g_sqsum = jax.lax.psum(local_sqsum, axis)
@@ -128,6 +140,9 @@ class SyncBatchNorm(_BatchNormBase):
                                    axis)
             mean = g_sum / g_count
             var = jnp.maximum(g_sqsum / g_count - mean * mean, 0.0)
+            if x2 is not None:
+                y2 = pbn.fused_bn_norm(x2, mean, var, w, b, eps)
+                return _to_cf(y2.reshape(x.shape)), mean, var
             shape = [1] * x.ndim
             shape[ch_axis] = -1
             y = (xf - mean.reshape(shape)) * jax.lax.rsqrt(

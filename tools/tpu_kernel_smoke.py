@@ -97,6 +97,83 @@ def main():
     check("softmax", lambda: jax.jit(fused_softmax)(s),
           lambda: jax.nn.softmax(s, axis=-1), 5e-4)
 
+    # fused batch norm (train + eval, fp32 + bf16, +/- residual,
+    # forward AND the one-pass backward kernels vs the XLA
+    # compositions — the ISSUE 15 family; CPU interpret mode cannot
+    # enforce Mosaic's tiling or the two-phase accumulator grid)
+    from paddle1_tpu.core.flags import flags_guard
+    from paddle1_tpu.ops.pallas import fused_bn as pbn
+    from paddle1_tpu.ops.pallas import fused_bn_bwd as pbnb
+    rows, c = 2048, 128
+    xb = jnp.asarray((rng.standard_normal((rows, c)) * 2 + 1)
+                     .astype(np.float32))
+    gb = jnp.asarray(rng.standard_normal((c,)).astype(np.float32))
+    bb = jnp.asarray(rng.standard_normal((c,)).astype(np.float32))
+    resb = jnp.asarray(rng.standard_normal((rows, c))
+                       .astype(np.float32))
+    dyb = jnp.asarray(rng.standard_normal((rows, c)).astype(np.float32))
+    bn_eps = 1e-5
+
+    def bn_ref(x, res=None, act="relu"):
+        m = x.mean(0)
+        v = x.var(0)
+        y = (x - m) / jnp.sqrt(v + bn_eps) * gb + bb
+        if res is not None:
+            y = y + res
+        return jnp.maximum(y, 0.0) if act == "relu" else y
+
+    check("bn_train",
+          lambda: jax.jit(lambda x: pbn.fused_bn_train(
+              x, gb, bb, bn_eps, act="relu")[0])(xb),
+          lambda: bn_ref(xb), 5e-3)
+    check("bn_train_res",
+          lambda: jax.jit(lambda x, r: pbn.fused_bn_train(
+              x, gb, bb, bn_eps, act="relu", residual=r)[0])(xb, resb),
+          lambda: bn_ref(xb, resb), 5e-3)
+    check("bn_train_bf16",
+          lambda: jax.jit(lambda x: pbn.fused_bn_train(
+              x, gb, bb, bn_eps)[0])(
+              xb.astype(jnp.bfloat16)).astype(jnp.float32),
+          lambda: bn_ref(xb.astype(jnp.bfloat16).astype(jnp.float32),
+                         act="identity"), 5e-2)
+    mstat = xb.mean(0)
+    vstat = xb.var(0)
+    check("bn_eval",
+          lambda: jax.jit(lambda x: pbn.fused_bn_norm(
+              x, mstat, vstat, gb, bb, bn_eps, act="relu"))(xb),
+          lambda: bn_ref(xb), 5e-3)
+    check("bn_local_moments",
+          lambda: (lambda s, ss: s + ss)(*pbn.local_moments(xb)),
+          lambda: xb.sum(0) + (xb * xb).sum(0), 5e-2)
+
+    # backward kernels: the shared forward/setup runs INSIDE the
+    # harness too — a Mosaic failure here must print a named FAIL and
+    # let the remaining kernel families run, not abort the script
+    try:
+        y_act = pbn.fused_bn_train(xb, gb, bb, bn_eps, act="relu")[0]
+        with flags_guard(fused_bn_bwd="always"):
+            got_tb = jax.jit(lambda *a: pbnb.train_bwd(
+                *a, bn_eps, "relu", with_res=True))(
+                xb, gb, mstat, vstat, y_act, dyb)
+            got_nb = jax.jit(lambda *a: pbnb.norm_bwd(
+                *a, bn_eps, "relu"))(xb, gb, mstat, vstat, y_act, dyb)
+    except Exception as e:  # noqa: BLE001
+        print(f"      bn_bwd.setup: EXCEPTION {type(e).__name__}: {e}")
+        failures.append("bn_bwd.setup")
+    else:
+        want_tb = pbnb.train_bwd_xla(xb, gb, mstat, vstat, y_act, dyb,
+                                     bn_eps, "relu", with_res=True)
+        for which, gg, ww in zip(("dx", "dgamma", "dbeta", "dres"),
+                                 got_tb, want_tb):
+            check(f"bn_bwd.{which}", lambda gg=gg: gg,
+                  lambda ww=ww: ww, 5e-2)
+        want_nb = pbnb.norm_bwd_xla(xb, gb, mstat, vstat, y_act, dyb,
+                                    bn_eps, "relu")
+        for which, gg, ww in zip(("dx", "dgamma", "dbeta"), got_nb,
+                                 want_nb):
+            check(f"bn_eval_bwd.{which}", lambda gg=gg: gg,
+                  lambda ww=ww: ww, 5e-2)
+
     # fused adam
     from paddle1_tpu.ops.pallas.fused_adam import fused_adam_update
     n = 8192 * 2
