@@ -1191,6 +1191,181 @@ def bench_generate(on_tpu, steps_override=None):
             "generate gate failed (need tokens/s>=5x eager, greedy "
             "parity, staggered bit-parity, one decode compile, clean "
             f"drain): {json.dumps(detail)}")
+    _bench_generate_paged(lm, vocab, max_seq)
+    _bench_generate_spec(vocab)
+
+
+def _bench_generate_paged(lm, vocab, max_seq):
+    """The decode-economics HBM arm (ISSUE 16): at the HBM budget of a
+    FOUR-slot dense KV cache, the paged engine (16-token shared prefix
+    + page-granular allocation) serves SIXTEEN concurrent requests —
+    >= 4x the concurrency per byte — bit-identically, over one decode
+    compile, owing zero pages at drain. Also emits the decode-density
+    line ``generate_tokens_per_s_per_hbm_gib`` (tokens/s per KV-cache
+    GiB, the metric the paged cache exists to move)."""
+    from paddle1_tpu.quantization import quantize_weights_int8
+    from paddle1_tpu.serving import GenerationEngine, GenerationServer
+
+    ps, n_paged, budget_slots, max_new = 8, 16, 4, 6
+
+    def kv_bytes(eng):
+        return sum(k.size * k.dtype.itemsize + v.size * v.dtype.itemsize
+                   for k, v in eng._kv)
+
+    def timed_run(eng, prompts):
+        t0 = time.perf_counter()
+        srv = GenerationServer(eng, token_budget=max_new,
+                               queue_depth=2 * len(prompts)).start()
+        outs = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        outs = [s.result(timeout=300) for s in outs]
+        rep = srv.drain()
+        return outs, rep, time.perf_counter() - t0
+
+    # the budget: every KV byte a 4-slot dense cache would hold, spent
+    # on pages instead (parking page included — nothing hides off-book)
+    n_pages = budget_slots * max_seq // ps
+    paged_eng = GenerationEngine(lm, slots=n_paged, max_seq=max_seq,
+                                 prefill_buckets=(24,), paged=True,
+                                 page_size=ps, pages=n_pages,
+                                 prefix_cache=8)
+    dense16 = GenerationEngine(lm, slots=n_paged, max_seq=max_seq,
+                               prefill_buckets=(24,))
+    dense_budget_bytes = kv_bytes(dense16) * budget_slots // n_paged
+    assert kv_bytes(paged_eng) <= dense_budget_bytes, \
+        "paged pool exceeds the 4-slot dense HBM budget"
+
+    prefix = [(7 * i) % vocab or 1 for i in range(1, 17)]
+    prompts = [prefix + [1 + i % (vocab - 2), 1 + (3 * i) % (vocab - 2)]
+               for i in range(n_paged)]
+    paged_eng.warm_up()
+    dense16.warm_up()
+    outs, rep, elapsed = timed_run(paged_eng, prompts)
+    # oracle: the same requests on the (budget-free) 16-slot dense
+    # engine — paged serves 4x the slots per KV byte, bit-identically
+    oracle, _orep, d_elapsed = timed_run(dense16, prompts)
+
+    st = paged_eng.pool.stats()
+    tps = n_paged * max_new / elapsed
+    tps_dense = n_paged * max_new / d_elapsed
+    gib = kv_bytes(paged_eng) / 2 ** 30
+    dense_gib = kv_bytes(dense16) / 2 ** 30
+    density, dense_density = tps / gib, tps_dense / dense_gib
+    # int8 rides along: decode weight bytes after the artifact pass
+    fs = lm.functional_state()
+    q = quantize_weights_int8(fs)
+    f32_b = sum(v.size * v.dtype.itemsize for v in fs.values())
+    q_b = sum((v.q.size + v.scale.size * 4) if hasattr(v, "q")
+              else v.size * v.dtype.itemsize for v in q.values())
+    slots_ratio = n_paged / budget_slots
+    detail = {"paged_slots": n_paged, "dense_budget_slots": budget_slots,
+              "page_size": ps, "pages": n_pages,
+              "kv_budget_bytes": dense_budget_bytes,
+              "paged_kv_bytes": kv_bytes(paged_eng),
+              "prefix_hit_pages": st["prefix_hit_pages"],
+              "kv_pages_owed": rep["kv_pages_owed"],
+              "bit_identical_to_dense": outs == oracle,
+              "decode_compiles": paged_eng.decode_compile_count,
+              "tokens_per_s": round(tps, 1),
+              "int8_weight_bytes_ratio": round(q_b / f32_b, 3)}
+    ok = (outs == oracle and rep["kv_pages_owed"] == 0
+          and rep["unaccounted"] == 0
+          and paged_eng.decode_compile_count == 1
+          and slots_ratio >= 4.0)
+    _emit("generate_paged_slots_at_hbm_budget", slots_ratio, "x",
+          slots_ratio / 4.0, detail)
+    _emit("generate_tokens_per_s_per_hbm_gib", density, "tok/s/GiB",
+          density / dense_density / 1.0, {
+              "paged_kv_gib": round(gib, 6),
+              "dense_kv_gib": round(dense_gib, 6),
+              "dense_tokens_per_s_per_hbm_gib": round(dense_density, 1)})
+    if not ok:
+        raise AssertionError(
+            "paged-KV gate failed (need >= 4x slots at the dense HBM "
+            "budget, bit-identical outputs, one decode compile, zero "
+            f"pages owed): {json.dumps(detail)}")
+
+
+def _bench_generate_spec(vocab):
+    """The speculation arm (ISSUE 16): on the repetitive-text regime
+    (a fixed-point model standing in for templated output), n-gram
+    drafts verified in one dispatch must clear >= 70% acceptance and
+    >= 1.8x tokens/s over the same engine decoding one token per
+    dispatch — with BIT-identical greedy output."""
+    import paddle1_tpu as paddle
+    from bench_utils import best_of
+    from paddle1_tpu.serving import GenerationEngine, NGramSpeculator
+
+    n_tokens, spec_k, repeats, max_seq = 120, 4, 3, 256
+    paddle.seed(0)
+    from paddle1_tpu.serving import CausalLM
+    lm = CausalLM(vocab_size=vocab, d_model=32, nhead=4,
+                  dim_feedforward=64, num_layers=2, max_seq=max_seq)
+    for _, t in lm.state_dict().items():
+        t._data = t.data * 0          # fixed point -> cyclic output
+    base = GenerationEngine(lm, slots=2, max_seq=max_seq,
+                            prefill_buckets=(16,))
+    spec = GenerationEngine(lm, slots=2, max_seq=max_seq,
+                            prefill_buckets=(16,), spec_tokens=spec_k)
+    base.warm_up()
+    spec.warm_up()
+    prompt = np.asarray([1, 2, 3, 4] * 4, np.int32)
+    stats = {"proposed": 0, "accepted": 0, "dispatches": 0}
+
+    def base_phase():
+        out = [base.prefill(0, prompt, 0.0, 0, 1)]
+        for _ in range(n_tokens - 1):
+            toks, _f = base.decode(np.array([True, False]))
+            out.append(int(toks[0, 0]))
+        base.release(0)
+        return out
+
+    def spec_phase():
+        out = [spec.prefill(0, prompt, 0.0, 0, 1)]
+        sp = NGramSpeculator(prompt, spec_k, n=3)
+        sp.observe(out[0])
+        stats.update(proposed=0, accepted=0, dispatches=0)
+        while len(out) < n_tokens:
+            d = sp.propose()
+            drafts = np.zeros([2, spec_k], np.int32)
+            nd = np.zeros([2], np.int32)
+            nd[0] = d.size
+            drafts[0, :d.size] = d
+            toks, flags = spec.decode(np.array([True, False]),
+                                      drafts, nd)
+            n = int(flags[0].sum())
+            stats["proposed"] += int(nd[0])
+            stats["accepted"] += max(n - 1, 0)
+            stats["dispatches"] += 1
+            for i in range(n):
+                sp.observe(int(toks[0, i]))
+                out.append(int(toks[0, i]))
+        spec.release(0)
+        return out[:n_tokens]
+
+    base_bo, spec_bo = best_of(repeats, base_phase, spec_phase)
+    parity = all(a == b for a, b in zip(base_bo.results[0],
+                                        spec_bo.results[0]))
+    tps_base = n_tokens / base_bo.best_s
+    tps_spec = n_tokens / spec_bo.best_s
+    speedup = tps_spec / tps_base
+    accept = stats["accepted"] / max(stats["proposed"], 1)
+    detail = {"tokens": n_tokens, "spec_tokens": spec_k,
+              "base_tokens_per_s": round(tps_base, 1),
+              "spec_tokens_per_s": round(tps_spec, 1),
+              "speedup": round(speedup, 2),
+              "accept_ratio": round(accept, 3),
+              "dispatches": stats["dispatches"],
+              "greedy_bit_identical": parity,
+              "decode_compiles": spec.decode_compile_count}
+    ok = (speedup >= 1.8 and accept >= 0.7 and parity
+          and spec.decode_compile_count == 1)
+    _emit("generate_spec_tokens_per_s", tps_spec, "tok/s",
+          speedup / 1.8, detail)
+    if not ok:
+        raise AssertionError(
+            "speculation gate failed (need >= 1.8x tokens/s at >= 70% "
+            "acceptance with bit-identical greedy output, one decode "
+            f"compile): {json.dumps(detail)}")
 
 
 def _count_jaxpr_ops(jaxpr):

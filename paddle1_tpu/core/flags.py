@@ -330,6 +330,14 @@ def _define_builtin_flags() -> None:
                 "dense; flash remains the escape for regimes beyond "
                 "measurement (and 'always' forces it).",
                 validator=lambda v: v > 0)
+    define_flag("pallas_paged_attention", "auto",
+                "Pallas paged-attention gather kernel for the paged "
+                "decode path (serve_gen_paged): auto (TPU only — the "
+                "scalar-prefetch page gather skips the dense "
+                "[slots, pages*page_size] materialization XLA's take-"
+                "based composition pays), always (interpret-mode on "
+                "CPU, for tests), never (XLA gather composition).",
+                validator=lambda v: v in ("auto", "always", "never"))
     define_flag("fused_layer_norm", "auto",
                 "Pallas fused LayerNorm: auto (TPU only), always, never.",
                 validator=lambda v: v in ("auto", "always", "never"))
@@ -643,6 +651,64 @@ def _define_builtin_flags() -> None:
                 "stays claimed but stops decoding — until the buffer "
                 "drains, instead of growing host memory unboundedly.",
                 validator=lambda v: v >= 1)
+    # Decode economics (ISSUE 16): block-paged KV cache with prefix
+    # sharing, speculative decoding, int8 decode weights — all behind
+    # the ONE compiled decode signature (decode_compile_count==1).
+    define_flag("serve_gen_paged", False,
+                "Block-paged KV cache for the GenerationEngine: K/V "
+                "live in a global [pages, page_size, heads, dim] pool "
+                "per layer with a per-slot page table, so a short "
+                "request holds ceil(len/page_size) pages instead of a "
+                "dense max_seq row — HBM scales with live tokens, not "
+                "slots*max_seq (the vLLM PagedAttention discipline). "
+                "Off = the PR 8 dense slot cache, bit-compatible.")
+    define_flag("serve_gen_kv_page_size", 16,
+                "Tokens per KV page under serve_gen_paged. Must divide "
+                "every prefill bucket (powers of two compose). Smaller "
+                "pages waste less tail capacity per request but grow "
+                "the page table and the gather fan-out; 16-64 is the "
+                "usual sweet spot.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_gen_kv_pages", 0,
+                "Page-pool capacity (pages) under serve_gen_paged; "
+                "0 = auto-size to the dense equivalent "
+                "(slots * ceil(max_seq/page_size) + 1 parking page). "
+                "Size it BELOW auto to serve more slots than dense HBM "
+                "would allow — admission waits for pages, and prefix "
+                "sharing stretches the pool further.",
+                validator=lambda v: v >= 0)
+    define_flag("serve_gen_prefix_cache", 64,
+                "Prefix-registry entries for copy-on-write prompt "
+                "sharing under serve_gen_paged: full pages of a "
+                "previously-prefilled prompt prefix are reused by "
+                "refcount instead of recomputed/stored again (N "
+                "requests over one system prompt hold its pages once)."
+                " LRU-evicted under pool pressure. 0 disables sharing.",
+                validator=lambda v: v >= 0)
+    define_flag("serve_gen_spec_tokens", 0,
+                "Speculative-decoding draft length k: each decode "
+                "dispatch verifies k speculator-proposed tokens plus "
+                "samples one correction, so one dispatch can produce "
+                "up to k+1 tokens. Acceptance is by equality against "
+                "the engine's own deterministic per-request sample "
+                "chain, so output (greedy AND sampled) is bit-"
+                "identical to non-speculative decode. 0 = off. Each "
+                "slot reserves k scratch rows of seq capacity.",
+                validator=lambda v: v >= 0)
+    define_flag("serve_gen_spec_ngram", 3,
+                "N-gram order of the prompt-lookup speculator: drafts "
+                "are the tokens that followed the most recent earlier "
+                "occurrence of the last n tokens (falling back to "
+                "shorter grams), the zero-model speculator that wins "
+                "on repetitive/templated text.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_gen_int8", False,
+                "Per-output-channel int8 weight quantization for the "
+                "decode matmuls (quantization.quantize_weights_int8): "
+                "Linear weights ride the decode dispatch as int8 + "
+                "f32 scales and dequantize inside the trace, cutting "
+                "the weight HBM traffic that dominates decode. Lossy "
+                "(not bit-parity with f32 decode).")
     define_flag("serve_ready_timeout_s", 120.0,
                 "How long the fleet waits for a (re)spawned replica to "
                 "publish its endpoint and pass the ready handshake "

@@ -9,5 +9,6 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
-from .attention import scaled_dot_product_attention, attention_ref  # noqa: F401
+from .attention import (scaled_dot_product_attention, attention_ref,  # noqa: F401
+                        paged_attention)
 from .crf import crf_decoding, linear_chain_crf  # noqa: F401

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ...autograd.engine import apply
 from ...core.tensor import Tensor, to_tensor
 
-__all__ = ["scaled_dot_product_attention", "attention_ref"]
+__all__ = ["scaled_dot_product_attention", "attention_ref",
+           "paged_attention"]
 
 
 def _t(x):
@@ -85,6 +86,42 @@ def use_flash_for(q, k) -> bool:
         # tensor, so cap the eager threshold at 1 GiB of transient
         threshold = min(threshold, 1024.0)
     return score_mb >= threshold
+
+
+def use_paged_kernel() -> bool:
+    """Kernel-vs-ref dispatch for the paged decode gather, mirroring
+    ``use_flash_for``'s flag grammar: ``pallas_paged_attention`` =
+    ``never`` → XLA ``take`` composition, ``always`` → Pallas kernel
+    (interpret mode off-TPU — the CI arm), ``auto`` → kernel on TPU
+    only. No memory heuristic: at decode widths the dense gather
+    materializes [slots, capacity, heads, dim] K/V per layer per step,
+    which the kernel exists to avoid."""
+    from ...core.flags import flag_active
+    return flag_active("pallas_paged_attention")
+
+
+def paged_attention(query, k_pool, v_pool, table, pos, name=None):
+    """Decode attention over the block-paged KV pool.
+
+    ``query``: [slots, window, heads, dim] — the decode window just
+    written; ``k_pool``/``v_pool``: [pages, page_size, heads, dim]
+    global pools; ``table``: [slots, max_pages_per_slot] int32 page
+    table; ``pos``: [slots] int32 per-slot cursor AFTER the window
+    write (the cache's advanced ``pos``), so query row ``i`` attends
+    key positions ``<= pos - window + i``. Masking is positional —
+    callers pass no attention mask, and pages past the cursor
+    (including the parking page) never reach the softmax.
+    """
+    q, kp, vp, tb, ps_ = (_t(query), _t(k_pool), _t(v_pool), _t(table),
+                          _t(pos))
+    from ...ops.pallas import paged_attention as pa
+
+    def f(q, kp, vp, tb, pos):
+        base = pos.astype(jnp.int32) - jnp.int32(q.shape[1])
+        if use_paged_kernel() and pa.supported(q.shape, kp.shape):
+            return pa.paged_attention(q, kp, vp, tb, base)
+        return pa.paged_attention_ref(q, kp, vp, tb, base)
+    return apply("paged_attention", f, (q, kp, vp, tb, ps_))
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

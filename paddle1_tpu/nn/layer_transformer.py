@@ -63,6 +63,19 @@ class MultiHeadAttention(Layer):
     # caller masks them (keys j <= pos+i) and the cursor overwrites
     # them as it advances.
     GenCache = collections.namedtuple("GenCache", ["k", "v", "pos"])
+    # Block-paged serving decode cache (ISSUE 16): k/v are GLOBAL pools
+    # of fixed-size pages — [pages, page_size, heads, dim] — shared by
+    # every slot, with ``table`` ([slots, max_pages_per_slot] int32)
+    # mapping each slot's logical positions onto pool pages and ``pos``
+    # the same per-slot cursor GenCache carries. A slot's HBM footprint
+    # is ceil(len/page_size) pages instead of max_seq rows, and slots
+    # over a common prompt can alias the same full prefill pages
+    # (refcounted host-side, serving/paging.py). Table rows point at the
+    # reserved parking page 0 beyond a slot's allocation, so free slots
+    # ride the same dispatch writing only parking garbage. Shapes never
+    # change: the one-compile decode contract survives paging.
+    PagedCache = collections.namedtuple("PagedCache",
+                                        ["k", "v", "table", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
@@ -96,7 +109,34 @@ class MultiHeadAttention(Layer):
         else:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value))
-        if isinstance(cache, self.GenCache):
+        if isinstance(cache, self.PagedCache):
+            from ..autograd.engine import apply
+            import jax.numpy as jnp
+
+            def write(pool, new, table, p):
+                # scatter slot s's new [W, H, D] window into its pages:
+                # logical position i lives at page table[s, i//ps],
+                # offset i%ps. Beyond-allocation positions resolve to
+                # the parking page (table rows are parking-filled), so
+                # free/overflowing slots only scribble parking garbage;
+                # the min() clamp keeps the page-table gather in range
+                # for cursors past capacity.
+                ps = pool.shape[1]
+                w = new.shape[1]
+                idx = p[:, None] + jnp.arange(w, dtype=p.dtype)[None, :]
+                idx = jnp.minimum(idx, table.shape[1] * ps - 1)
+                pg = jnp.take_along_axis(table, idx // ps, axis=1)
+                return pool.at[pg, idx % ps].set(new.astype(pool.dtype))
+
+            k = apply("paged_cache_write_k", write,
+                      (cache.k, k, cache.table, cache.pos))
+            v = apply("paged_cache_write_v", write,
+                      (cache.v, v, cache.table, cache.pos))
+            new_tokens = query.shape[1]
+            pos = apply("gen_cache_advance",
+                        lambda p: p + np.int32(new_tokens), (cache.pos,))
+            cache = self.PagedCache(k, v, cache.table, pos)
+        elif isinstance(cache, self.GenCache):
             from ..autograd.engine import apply
             import jax
 
@@ -146,11 +186,39 @@ class MultiHeadAttention(Layer):
                              mo.zeros(shape, dtype),
                              mo.zeros([int(slots)], "int32"))
 
+    def gen_paged_cache(self, pages, page_size, dtype="float32"):
+        """Block-paged serving decode cache: global K/V pools of
+        ``pages`` fixed-size pages (see :attr:`PagedCache`). The
+        returned ``table``/``pos`` are 1-element placeholders — the
+        engine owns the real [slots, max_pages_per_slot] table and
+        per-slot cursors and substitutes them per dispatch."""
+        from ..ops import manip_ops as mo
+        shape = [int(pages), int(page_size), self.num_heads,
+                 self.head_dim]
+        return self.PagedCache(mo.zeros(shape, dtype),
+                               mo.zeros(shape, dtype),
+                               mo.zeros([1, 1], "int32"),
+                               mo.zeros([1], "int32"))
+
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
         key = query if key is None else key
         value = key if value is None else value
         q, k, v, cache = self._prepare_qkv(query, key, value, cache)
+        if isinstance(cache, self.PagedCache):
+            # masking is positional (keys <= cursor); any attn_mask is
+            # ignored by contract — the paged engine passes None
+            out = F.paged_attention(q, cache.k, cache.v, cache.table,
+                                    cache.pos)
+            from ..ops import manip_ops as _mo
+            b, n = out.shape[0], out.shape[1]
+            out = _mo.reshape(out, [b, n, self.embed_dim])
+            out = self.out_proj(out)
+            outs = [out]
+            if self.need_weights:
+                outs.append(None)
+            outs.append(cache)
+            return tuple(outs)
         from ..core import dtype as dtypes
         if attn_mask is not None and (
                 attn_mask.dtype == dtypes.bool_ or
@@ -233,6 +301,9 @@ class TransformerEncoderLayer(Layer):
     def gen_slot_cache(self, slots, max_seq, dtype="float32"):
         return self.self_attn.gen_slot_cache(slots, max_seq, dtype)
 
+    def gen_paged_cache(self, pages, page_size, dtype="float32"):
+        return self.self_attn.gen_paged_cache(pages, page_size, dtype)
+
 
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
@@ -287,6 +358,13 @@ class TransformerEncoder(Layer):
         """Per-layer preallocated slot caches for the serving decode
         engine (one :attr:`MultiHeadAttention.GenCache` per block)."""
         return [layer.gen_slot_cache(slots, max_seq, dtype)
+                for layer in self.layers]
+
+    def gen_paged_cache(self, pages, page_size, dtype="float32"):
+        """Per-layer paged KV pools for the serving decode engine (one
+        :attr:`MultiHeadAttention.PagedCache` per block; the engine owns
+        the shared page table)."""
+        return [layer.gen_paged_cache(pages, page_size, dtype)
                 for layer in self.layers]
 
     def _forward_pipelined(self, src, src_mask=None):

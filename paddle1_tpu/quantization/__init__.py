@@ -12,7 +12,8 @@ is deploy-parity + smaller checkpoints)."""
 
 from __future__ import annotations
 
-from typing import Optional
+import collections
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +26,9 @@ from ..nn.layer_common import Linear
 from ..nn.layer_conv_pool import Conv2D
 
 __all__ = ["fake_quant", "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
-           "QuantizedLinear", "QuantizedConv2D", "QAT", "PTQ"]
+           "QuantizedLinear", "QuantizedConv2D", "QAT", "PTQ",
+           "QuantTensor", "quantize_weights_int8", "dequantize_weights",
+           "Int8Linear", "quantize_decode"]
 
 
 @jax.custom_vjp
@@ -171,6 +174,115 @@ class QAT:
         from ..jit import save as jit_save
         model.eval()
         jit_save(model, path, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------------
+# int8 decode-weight quantization (ISSUE 16 — the serving analog of the
+# reference's slim quantization_pass: REAL int8 storage, not fake-quant
+# simulation). Decode is memory-bound — every step re-reads every weight
+# — so halving (f32→int8: quartering) weight bytes directly buys decode
+# tokens/s-per-HBM-byte. Math stays f32: weights dequantize per-channel
+# right before the matmul (TPUs of this generation have no int8 MXU
+# path), so the win is bandwidth + footprint, not FLOPs.
+
+# q: int8 [in, out]; scale: f32 [out] — per-OUTPUT-channel abs-max, the
+# axis the matmul reduces against, so quantization error never mixes
+# across channels. A pytree node: rides functional-state dicts and
+# jit.save artifacts unchanged.
+QuantTensor = collections.namedtuple("QuantTensor", ["q", "scale"])
+
+
+def _quantize_array(w) -> QuantTensor:
+    w = jnp.asarray(w)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return QuantTensor(q, scale.astype(jnp.float32))
+
+
+def _dequantize_array(qt: QuantTensor):
+    return qt.q.astype(jnp.float32) * qt.scale[None, :]
+
+
+def quantize_weights_int8(params: Dict[str, object],
+                          skip=("embed",)) -> Dict[str, object]:
+    """Per-channel int8 quantization over a functional-state dict:
+    every 2-D float ``*.weight`` leaf (except names containing a
+    ``skip`` fragment — embeddings index rows, where a shared
+    per-column scale costs disproportionate accuracy) becomes a
+    :class:`QuantTensor`. Biases, norms, and everything else pass
+    through untouched. The result is what ``serve_gen_int8`` loads:
+    the engine stores THIS dict and dequantizes inside the trace."""
+    out: Dict[str, object] = {}
+    for name, arr in params.items():
+        a = getattr(arr, "data", arr)
+        eligible = (name.endswith(".weight")
+                    and not any(s in name for s in skip)
+                    and getattr(a, "ndim", 0) == 2
+                    and jnp.issubdtype(jnp.asarray(a).dtype,
+                                       jnp.floating))
+        out[name] = _quantize_array(a) if eligible else a
+    return out
+
+
+def dequantize_weights(params: Dict[str, object]) -> Dict[str, object]:
+    """Inverse of :func:`quantize_weights_int8` at the array level:
+    QuantTensor leaves → dense f32. Called INSIDE the decode trace
+    (GenerationEngine._apply_model) so the stored params — and the jit
+    arguments, and the HBM census's view — stay int8; XLA fuses the
+    dequant into the consuming matmul."""
+    return {k: (_dequantize_array(v) if isinstance(v, QuantTensor)
+                else v)
+            for k, v in params.items()}
+
+
+class Int8Linear(Layer):
+    """Linear holding per-channel int8 weight storage (buffers ``q`` /
+    ``scale``), dequantizing on the fly in forward — the layer-level
+    form of the artifact pass, so :func:`quantize_decode` produces a
+    module that ``jit.save`` serializes like any other (int8 weight in
+    the checkpoint, f32 math in the graph)."""
+
+    def __init__(self, inner: Linear):
+        super().__init__()
+        w = inner.weight.data
+        qt = _quantize_array(w)
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        self.register_buffer("q", Tensor(qt.q, stop_gradient=True))
+        self.register_buffer("scale", Tensor(qt.scale,
+                                             stop_gradient=True))
+        self.bias = inner.bias
+
+    def forward(self, x):
+        from ..nn import functional as F
+        w = apply("int8_dequant",
+                  lambda q, s: q.astype(jnp.float32) * s[None, :],
+                  (self.q, self.scale))
+        return F.linear(x, w, self.bias)
+
+
+def quantize_decode(model: Layer, skip=("embed",)) -> Layer:
+    """Swap every eligible Linear for :class:`Int8Linear` in place (the
+    module-level artifact pass; ``GenerationEngine`` uses the
+    functional-state form instead). Returns the model. Layers whose
+    qualified name contains a ``skip`` fragment are left dense."""
+
+    def walk(layer: Layer, prefix: str) -> int:
+        n = 0
+        for name, child in list(layer._sub_layers.items()):
+            qual = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, Linear) and not any(
+                    s in qual for s in skip):
+                layer._sub_layers[name] = Int8Linear(child)
+                n += 1
+            else:
+                n += walk(child, qual)
+        return n
+
+    if walk(model, "") == 0:
+        import warnings
+        warnings.warn("quantize_decode: no Linear layers found")
+    return model
 
 
 class PTQ:

@@ -52,21 +52,24 @@ Or straight from a deployed artifact::
 
 from .batcher import Batcher, ServeFuture
 from .engine import InferenceEngine, resolve_buckets
-from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
-                     ServerClosed, ServerOverloaded, SlotWedged,
-                     StreamCancelled)
+from .errors import (DeadlineExceeded, DeployFailed, KVPoolExhausted,
+                     ReplicaFailed, ServerClosed, ServerOverloaded,
+                     SlotWedged, StreamCancelled)
 from .fleet import AdaptiveAdmission, FleetFuture, ServingFleet
 from .generate import (CausalLM, GenerationEngine, GenerationServer,
                        TokenStream)
 from .metrics import (Counter, Gauge, Histogram, MetricsGroup,
                       ServingMetrics, merge_snapshots)
+from .paging import PARKING_PAGE, PagePool
 from .server import Server
+from .speculate import DraftModelSpeculator, NGramSpeculator
 
 __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
            "ServingMetrics", "Counter", "Gauge", "Histogram",
            "MetricsGroup", "merge_snapshots", "ServerOverloaded",
            "DeadlineExceeded", "ServerClosed", "ReplicaFailed",
            "DeployFailed", "SlotWedged", "StreamCancelled",
-           "ServingFleet", "FleetFuture", "AdaptiveAdmission",
-           "GenerationEngine", "GenerationServer", "TokenStream",
-           "CausalLM", "resolve_buckets"]
+           "KVPoolExhausted", "ServingFleet", "FleetFuture",
+           "AdaptiveAdmission", "GenerationEngine", "GenerationServer",
+           "TokenStream", "CausalLM", "resolve_buckets", "PagePool",
+           "PARKING_PAGE", "NGramSpeculator", "DraftModelSpeculator"]

@@ -10,7 +10,7 @@ from ..core.errors import (ExecutionTimeoutError, PreconditionNotMetError,
 
 __all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed",
            "ReplicaFailed", "DeployFailed", "SlotWedged",
-           "StreamCancelled"]
+           "StreamCancelled", "KVPoolExhausted"]
 
 
 class ServerOverloaded(ResourceExhaustedError):
@@ -54,6 +54,17 @@ class SlotWedged(UnavailableError):
     stream, tokens already streamed stay valid — and the slot is
     released; cohabiting sequences in the continuous batch are
     untouched."""
+
+
+class KVPoolExhausted(ResourceExhaustedError):
+    """The paged KV pool (``serve_gen_kv_pages``) has no free page even
+    after evicting every evictable cached prefix: the live sequences'
+    tokens genuinely exceed pool capacity. Raised at prefill admission
+    (the request never claimed a slot) or delivered mid-stream through
+    the starved request's TokenStream when a decode-time page fault
+    cannot be served — cohabiting slots keep decoding. Remedies: more
+    pages, shorter max_new_tokens, fewer slots, or a bigger prefix
+    cache hit rate (shared prompts)."""
 
 
 class StreamCancelled(UnavailableError):

@@ -77,9 +77,11 @@ from ..core import jit_sanitizer
 from ..core import locks
 from ..core.errors import InvalidArgumentError
 from .engine import resolve_buckets
-from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
-                     SlotWedged, StreamCancelled)
+from .errors import (DeadlineExceeded, KVPoolExhausted, ServerClosed,
+                     ServerOverloaded, SlotWedged, StreamCancelled)
 from .metrics import ServingMetrics
+from .paging import PARKING_PAGE, PagePool
+from .speculate import NGramSpeculator
 
 __all__ = ["CausalLM", "GenerationEngine", "GenerationServer",
            "TokenStream"]
@@ -122,6 +124,9 @@ class CausalLM(_Layer):
     def gen_slot_cache(self, slots, max_seq, dtype="float32"):
         return self.encoder.gen_slot_cache(slots, max_seq, dtype)
 
+    def gen_paged_cache(self, pages, page_size, dtype="float32"):
+        return self.encoder.gen_paged_cache(pages, page_size, dtype)
+
     def empty_cache(self, batch):
         """Eager incremental-decode cache (the concat-based ``Cache``
         path ``dynamic_decode`` drives)."""
@@ -141,7 +146,12 @@ class CausalLM(_Layer):
             positions = to_tensor(np.broadcast_to(
                 np.arange(off, off + L, dtype=np.int64), (B, L)).copy())
         x = self.embed(ids) + self.pos_embed(positions)
-        if attn_mask is None and L > 1:
+        if (cache is not None and len(cache) and
+                isinstance(cache[0], MultiHeadAttention.PagedCache)):
+            # paged decode: masking derives from the page table +
+            # cursor inside paged_attention — never build a mask here
+            pass
+        elif attn_mask is None and L > 1:
             # causal over the (cached + new) key length: needed for any
             # multi-query pass — the no-cache forward AND the eager
             # concat-cache prefill (single-query decode needs none)
@@ -281,7 +291,7 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
                  "stream", "deadline", "t_enq", "truncated_by_budget",
-                 "slot", "n_generated", "t_first")
+                 "slot", "n_generated", "t_first", "spec")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float, top_k: int, seed: int,
@@ -300,6 +310,7 @@ class _GenRequest:
         self.slot = -1
         self.n_generated = 0
         self.t_first = 0.0
+        self.spec = None  # per-request speculator (engine.spec_tokens>0)
 
 
 # ---------------------------------------------------------------------------
@@ -324,7 +335,13 @@ class GenerationEngine:
                  max_seq: Optional[int] = None, prefill_buckets=None,
                  eos_id: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 cache_dtype: str = "float32"):
+                 cache_dtype: str = "float32",
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 prefix_cache: Optional[int] = None,
+                 spec_tokens: Optional[int] = None,
+                 int8: Optional[bool] = None):
         core_flags.maybe_enable_compilation_cache()
         import jax
         self.metrics = metrics
@@ -336,13 +353,36 @@ class GenerationEngine:
             raise InvalidArgumentError(
                 f"need slots >= 1 and max_seq >= 2, got "
                 f"{self.slots}/{self.max_seq}")
+        # decode economics (ISSUE 16): paging / speculation / int8 all
+        # resolve at construction and ride ONE decode signature
+        self.paged = bool(core_flags.flag("serve_gen_paged")
+                          if paged is None else paged)
+        self.spec_tokens = int(core_flags.flag("serve_gen_spec_tokens")
+                               if spec_tokens is None else spec_tokens)
+        if self.spec_tokens < 0:
+            raise InvalidArgumentError(
+                f"spec_tokens must be >= 0, got {self.spec_tokens}")
+        # decode window: the fed token + k drafts verified per dispatch.
+        # Every window write spans `window` rows, so sequences must stop
+        # `decode_margin` short of max_seq — enforced at admission.
+        self.window = 1 + self.spec_tokens
+        self.decode_margin = self.window - 1
+        if self.max_seq <= self.decode_margin + 1:
+            raise InvalidArgumentError(
+                f"max_seq={self.max_seq} leaves no room under a "
+                f"speculative window of {self.window} (margin "
+                f"{self.decode_margin}) — shrink serve_gen_spec_tokens")
+        self.int8 = bool(core_flags.flag("serve_gen_int8")
+                         if int8 is None else int8)
         self.prefill_buckets = self._resolve_prefill_buckets(
             prefill_buckets, self.max_seq)
         self.eos_id = None if eos_id is None else int(eos_id)
-        if not hasattr(model, "gen_slot_cache"):
+        needed_cache = "gen_paged_cache" if self.paged \
+            else "gen_slot_cache"
+        if not hasattr(model, needed_cache):
             raise InvalidArgumentError(
                 "GenerationEngine needs a model with the generation "
-                "contract: gen_slot_cache(slots, max_seq) and "
+                f"contract: {needed_cache}(...) and "
                 "forward(ids, cache=, positions=, attn_mask=) -> "
                 f"(logits, new_cache); got {type(model).__name__}")
         model_cap = getattr(model, "max_seq", None)
@@ -358,6 +398,11 @@ class GenerationEngine:
         model.eval()
         self._model = model
         self._params = model.functional_state()
+        if self.int8:
+            # per-channel int8 weight storage; dequant happens INSIDE
+            # the trace (_apply_model), so jit args / HBM stay int8
+            from ..quantization import quantize_weights_int8
+            self._params = quantize_weights_int8(self._params)
         self._lock = locks.make_lock("GenerationEngine._lock")
         # trace-side-effect counters — the "exactly one decode compile"
         # acceptance gate reads decode_compile_count
@@ -368,9 +413,59 @@ class GenerationEngine:
 
         # device state (donated through every dispatch)
         import jax.numpy as jnp
-        cache = model.gen_slot_cache(self.slots, self.max_seq,
-                                     cache_dtype)
-        self._kv = [(c.k.data, c.v.data) for c in cache]
+        if self.paged:
+            self.page_size = int(
+                page_size if page_size is not None
+                else core_flags.flag("serve_gen_kv_page_size"))
+            if self.page_size < 1:
+                raise InvalidArgumentError(
+                    f"page_size must be >= 1, got {self.page_size}")
+            self.pages_per_slot = -(-self.max_seq // self.page_size)
+            n_pages = int(pages if pages is not None
+                          else core_flags.flag("serve_gen_kv_pages"))
+            if n_pages <= 0:
+                # auto: worst case every slot dense, + the parking page
+                n_pages = self.slots * self.pages_per_slot + 1
+            prefix_entries = int(
+                prefix_cache if prefix_cache is not None
+                else core_flags.flag("serve_gen_prefix_cache"))
+            self.pool = PagePool(n_pages, self.page_size,
+                                 prefix_entries)
+            cache = model.gen_paged_cache(n_pages, self.page_size,
+                                          cache_dtype)
+            self._kv = [(c.k.data, c.v.data) for c in cache]
+            # host-authoritative page table, mirrored to device on
+            # change; rows are parking-filled beyond a slot's chain
+            self._table_np = np.full(
+                [self.slots, self.pages_per_slot], PARKING_PAGE,
+                np.int32)
+            self._table = jnp.asarray(self._table_np)
+            self._slot_pages: List[List[int]] = [
+                [] for _ in range(self.slots)]
+            # K+V bytes of ONE page across every layer (sizing + the
+            # gen_kv_page_bytes gauge)
+            self._page_bytes = sum(
+                int(np.prod(k.shape[1:])) * k.dtype.itemsize
+                + int(np.prod(v.shape[1:])) * v.dtype.itemsize
+                for k, v in self._kv)
+        else:
+            self.pool = None
+            self.page_size = 0
+            self.pages_per_slot = 0
+            self._page_bytes = 0
+            cache = model.gen_slot_cache(self.slots, self.max_seq,
+                                         cache_dtype)
+            self._kv = [(c.k.data, c.v.data) for c in cache]
+            self._table_np = np.zeros([1, 1], np.int32)
+            self._table = jnp.asarray(self._table_np)
+            self._slot_pages = [[] for _ in range(self.slots)]
+        # host mirror of _lengths: page-capacity math and window
+        # delivery never pay a device readback for it
+        self._host_len = np.zeros([self.slots], np.int64)
+        self._warming = False
+        self.last_page_faults: Dict[int, KVPoolExhausted] = {}
+        self._last_pool_stats: Dict[str, int] = {}
+        self._evictions_published = 0
         self._lengths = jnp.zeros([self.slots], jnp.int32)
         self._tokens = jnp.zeros([self.slots], jnp.int32)
         self._keys = jnp.zeros(
@@ -395,7 +490,14 @@ class GenerationEngine:
         from ..obs import hbm as obs_hbm
         obs_hbm.register("params", self, lambda e: e._params,
                          name="GenerationEngine.params")
-        obs_hbm.register("kv_cache", self, lambda e: e._kv,
+        # the page pools/table ride the kv_cache subsystem: census
+        # coverage stays 1.0 under paging (ISSUE 16 satellite), and the
+        # small per-slot state arrays are accounted rather than leaked
+        # into "other"
+        obs_hbm.register("kv_cache", self,
+                         lambda e: (e._kv, e._table, e._lengths,
+                                    e._tokens, e._keys, e._temps,
+                                    e._topks),
                          name="GenerationEngine.kv")
 
     @staticmethod
@@ -420,17 +522,25 @@ class GenerationEngine:
         from ..autograd import engine as autograd_engine
         from ..core.generator import rng_scope
         from ..core.tensor import Tensor
+        if self.int8:
+            # int8 weights dequantize per-channel inside the trace; XLA
+            # fuses the cast+scale into the consuming matmul, so HBM
+            # traffic (and the jit args) stay int8
+            from ..quantization import dequantize_weights
+            params = dequantize_weights(params)
+        mask_t = None if attn_mask is None \
+            else Tensor(attn_mask, stop_gradient=True)
         with autograd_engine.no_grad(), rng_scope(jax.random.key(0)):
             with self._model.load_functional_state(params):
                 logits, new_caches = self._model(
                     Tensor(ids, stop_gradient=True),
                     cache=caches,
                     positions=Tensor(positions, stop_gradient=True),
-                    attn_mask=Tensor(attn_mask, stop_gradient=True))
+                    attn_mask=mask_t)
         return logits.data, new_caches
 
-    def _decode_fn(self, params, kv, lengths, tokens, keys, temps,
-                   topks, active):
+    def _decode_fn(self, params, kv, table, lengths, tokens, keys,
+                   temps, topks, active, drafts, ndrafts):
         """Counted wrapper over :meth:`_decode_body` — the increment
         runs only while TRACING (the standard trace-side-effect
         counter). The cost model lowers ``_decode_body`` directly so
@@ -439,43 +549,95 @@ class GenerationEngine:
             self.decode_compile_count += 1
         if self.metrics is not None:
             self.metrics.counter("gen_decode_compiles_total").inc()
-        return self._decode_body(params, kv, lengths, tokens, keys,
-                                 temps, topks, active)
+        return self._decode_body(params, kv, table, lengths, tokens,
+                                 keys, temps, topks, active, drafts,
+                                 ndrafts)
 
-    def _decode_body(self, params, kv, lengths, tokens, keys, temps,
-                     topks, active):
-        """One token for every slot; compiled exactly once. ``active``
-        gates advancement — inactive slots keep their token/length, so
-        parking a slot (backpressure, free slot) costs nothing and
-        never retraces."""
+    def _decode_body(self, params, kv, table, lengths, tokens, keys,
+                     temps, topks, active, drafts, ndrafts):
+        """One decode WINDOW for every slot; compiled exactly once.
+
+        The window is ``[fed token, draft_1..draft_k]`` (k =
+        ``spec_tokens``; k=0 reduces exactly to the classic one-token
+        step). All W rows run through the model in one dispatch;
+        row i's logits give the target-distribution sample for position
+        pos+i+1, and the draft chain is verified by *equality against
+        the engine's own deterministic key schedule*: row i's sample is
+        produced iff every earlier draft matched its sample. The RNG
+        key advances once per PRODUCED token — so the (seed, token
+        index) → draw mapping, and therefore the output stream, is
+        bit-identical to non-speculative decode whatever the drafts
+        were. Rejected-draft KV rows are stale garbage past the new
+        cursor; the next window overwrites them before any mask ever
+        exposes them.
+
+        ``active`` gates advancement — inactive slots keep their
+        token/length/key, so parking a slot costs nothing and never
+        retraces. Paged mode reads/writes through ``table`` (dense mode
+        carries a [1,1] placeholder); page faults and draft contents
+        are DATA, never shapes, preserving the one-compile contract.
+        """
         import jax
         import jax.numpy as jnp
         from ..nn import MultiHeadAttention
         from ..nn.decode import sample_logits_array
         from ..core.tensor import Tensor
-        S, M = self.slots, self.max_seq
-        pos = jnp.minimum(lengths, M - 1)
-        caches = [MultiHeadAttention.GenCache(
-            Tensor(k, stop_gradient=True),
-            Tensor(v, stop_gradient=True),
-            Tensor(pos, stop_gradient=True)) for k, v in kv]
-        # keys j <= pos are valid: the fed token was just written AT pos
-        mask = (jnp.arange(M)[None, None, None, :]
-                <= pos[:, None, None, None])
-        logits, new_caches = self._apply_model(
-            params, tokens[:, None], caches, pos[:, None], mask)
-        lg = logits[:, -1, :].astype(jnp.float32)
-        kb = jax.random.wrap_key_data(keys)
-        ksamp = jax.vmap(lambda k: jax.random.fold_in(k, 0))(kb)
-        kcarry = jax.vmap(lambda k: jax.random.fold_in(k, 1))(kb)
-        nxt = jax.vmap(sample_logits_array)(lg, ksamp, temps, topks)
-        nxt = jnp.where(active, nxt.astype(jnp.int32), tokens)
-        new_lengths = jnp.where(active,
-                                jnp.minimum(lengths + 1, M), lengths)
-        new_keys = jnp.where(active[:, None],
-                             jax.random.key_data(kcarry), keys)
+        S, M, W = self.slots, self.max_seq, self.window
+        pos = jnp.minimum(lengths, M - W)
+        ids = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        positions = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None]
+        if self.paged:
+            caches = [MultiHeadAttention.PagedCache(
+                Tensor(k, stop_gradient=True),
+                Tensor(v, stop_gradient=True),
+                Tensor(table, stop_gradient=True),
+                Tensor(pos, stop_gradient=True)) for k, v in kv]
+            logits, new_caches = self._apply_model(
+                params, ids, caches, positions, None)
+        else:
+            caches = [MultiHeadAttention.GenCache(
+                Tensor(k, stop_gradient=True),
+                Tensor(v, stop_gradient=True),
+                Tensor(pos, stop_gradient=True)) for k, v in kv]
+            # window row i attends keys j <= pos + i (row 0 == the
+            # classic "fed token just written AT pos" mask)
+            qpos = positions
+            mask = (jnp.arange(M)[None, None, None, :]
+                    <= qpos[:, None, :, None])
+            logits, new_caches = self._apply_model(
+                params, ids, caches, positions, mask)
+        lg = logits.astype(jnp.float32)              # [S, W, V]
+        # dpad[i] = the draft proposed for row i+1 (last column unused)
+        dpad = jnp.concatenate(
+            [drafts, jnp.zeros((S, 1), drafts.dtype)], axis=1)
+
+        def chain(lg_row, dpad_row, kd0, temp, topk, act, nd):
+            def step(carry, x):
+                kd, ok = carry
+                i, lrow, dnext = x
+                kb = jax.random.wrap_key_data(kd)
+                s = sample_logits_array(
+                    lrow, jax.random.fold_in(kb, 0), temp,
+                    topk).astype(jnp.int32)
+                kd2 = jnp.where(ok, jax.random.key_data(
+                    jax.random.fold_in(kb, 1)), kd)
+                ok2 = ok & (i < nd) & (dnext == s)
+                return (kd2, ok2), (s, ok)
+            (kdf, _), (toks, flags) = jax.lax.scan(
+                step, (kd0, act),
+                (jnp.arange(W), lg_row, dpad_row))
+            return toks, flags, kdf
+
+        toks, flags, new_keys = jax.vmap(chain)(
+            lg, dpad, keys, temps, topks, active, ndrafts)
+        n_prod = jnp.sum(flags.astype(jnp.int32), axis=1)
+        last = jnp.take_along_axis(
+            toks, jnp.maximum(n_prod - 1, 0)[:, None], axis=1)[:, 0]
+        nxt = jnp.where(n_prod > 0, last, tokens)
+        new_lengths = jnp.minimum(lengths + n_prod,
+                                  M - self.decode_margin)
         new_kv = [(c.k.data, c.v.data) for c in new_caches]
-        return new_kv, new_lengths, nxt, new_keys
+        return new_kv, new_lengths, nxt, new_keys, toks, flags
 
     def _prefill_fn_for(self, bucket: int):
         """Build (once per bucket) the counted prefill wrapper over
@@ -483,21 +645,28 @@ class GenerationEngine:
         decode)."""
         import jax
 
-        def prefill_fn(params, kv, ids, length, slot, key, temp, topk):
+        def prefill_fn(params, kv, ids, length, slot, key, temp, topk,
+                       row_pages):
             with self._lock:
                 self.prefill_compile_counts[bucket] = \
                     self.prefill_compile_counts.get(bucket, 0) + 1
             if self.metrics is not None:
                 self.metrics.counter("gen_prefill_compiles_total").inc()
             return self._prefill_body(bucket, params, kv, ids, length,
-                                      slot, key, temp, topk)
+                                      slot, key, temp, topk, row_pages)
         return jax.jit(prefill_fn, donate_argnums=(1,))
 
     def _prefill_body(self, bucket, params, kv, ids, length, slot, key,
-                      temp, topk):
+                      temp, topk, row_pages):
         """The prefill computation: the whole padded prompt in one
-        causal pass, K/V written into the slot's cache rows, first
-        token sampled from the last REAL position."""
+        causal pass, K/V written into the slot's cache rows — dense:
+        one dynamic_update_slice per layer at the slot row; paged: a
+        per-row scatter steered by ``row_pages`` ([bucket] int32, the
+        target page per prompt position). Shared prefix pages and
+        beyond-prompt padding rows target the parking page, so a reused
+        page is NEVER rewritten (bit-stable for every cohabitant) and
+        padding garbage never lands in real pages. First token sampled
+        from the last REAL position."""
         import jax
         import jax.numpy as jnp
         from ..nn import MultiHeadAttention
@@ -518,14 +687,23 @@ class GenerationEngine:
         logits, filled = self._apply_model(
             params, ids[None], small, positions, causal)
         new_kv = []
-        for (k_arr, v_arr), c in zip(kv, filled):
-            new_kv.append((
-                jax.lax.dynamic_update_slice(
-                    k_arr, c.k.data.astype(k_arr.dtype),
-                    (slot, 0, 0, 0)),
-                jax.lax.dynamic_update_slice(
-                    v_arr, c.v.data.astype(v_arr.dtype),
-                    (slot, 0, 0, 0))))
+        if self.paged:
+            off = jnp.arange(L) % self.page_size
+            for (k_arr, v_arr), c in zip(kv, filled):
+                new_kv.append((
+                    k_arr.at[row_pages, off].set(
+                        c.k.data[0].astype(k_arr.dtype)),
+                    v_arr.at[row_pages, off].set(
+                        c.v.data[0].astype(v_arr.dtype))))
+        else:
+            for (k_arr, v_arr), c in zip(kv, filled):
+                new_kv.append((
+                    jax.lax.dynamic_update_slice(
+                        k_arr, c.k.data.astype(k_arr.dtype),
+                        (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        v_arr, c.v.data.astype(v_arr.dtype),
+                        (slot, 0, 0, 0))))
         last = jnp.take(logits[0], length - 1,
                         axis=0).astype(jnp.float32)
         kb = jax.random.wrap_key_data(key)
@@ -549,6 +727,66 @@ class GenerationEngine:
             f"{list(self.prefill_buckets)}) — raise "
             "serve_gen_prefill_buckets/serve_gen_max_seq")
 
+    def _release_slot_pages(self, slot: int) -> None:
+        """Drop the slot's page refs and park its table row (paged)."""
+        if not self.paged:
+            return
+        pages = self._slot_pages[slot]
+        if pages:
+            self.pool.release(pages)
+            self._slot_pages[slot] = []
+        if (self._table_np[slot] != PARKING_PAGE).any():
+            import jax.numpy as jnp
+            self._table_np[slot, :] = PARKING_PAGE
+            self._table = jnp.asarray(self._table_np)
+
+    def _alloc_prefill_pages(self, slot: int,
+                             prompt: np.ndarray) -> np.ndarray:
+        """Claim the slot's prefill page chain (prefix-shared head +
+        private tail) and return the per-row scatter targets. Shared
+        pages' rows target parking — only the FIRST request ever writes
+        a shared page, so cohabitants' bits can never be perturbed —
+        and the whole chain is refcounted against the slot. Raises
+        KVPoolExhausted (after releasing anything claimed) when the
+        pool cannot serve; the caller never holds a half-claimed
+        chain."""
+        P = int(np.shape(prompt)[0])
+        ps = self.page_size
+        prompt_i32 = np.asarray(prompt, np.int32).reshape(-1)
+        self._release_slot_pages(slot)  # warm-up / crash-reuse safety
+        shared: List[int] = []
+        if not self._warming:
+            shared = self.pool.lookup_prefix(prompt_i32)
+        n_needed = (P - 1) // ps + 1
+        n_shared = min(len(shared), n_needed)
+        if n_shared < len(shared):  # over-long hit (can't happen: the
+            self.pool.release(shared[n_shared:])  # registry only holds
+            shared = shared[:n_shared]            # full-page chains)
+        try:
+            private = self.pool.alloc(n_needed - n_shared)
+        except KVPoolExhausted:
+            self.pool.release(shared)
+            raise
+        chain = shared + private
+        if not self._warming:
+            self.pool.register_prefix(prompt_i32, chain)
+        import jax.numpy as jnp
+        self._slot_pages[slot] = chain
+        self._table_np[slot, :] = PARKING_PAGE
+        self._table_np[slot, :len(chain)] = chain
+        self._table = jnp.asarray(self._table_np)
+        if self.metrics is not None:
+            from ..obs.registry import metrics_on
+            if metrics_on():
+                self.metrics.counter(
+                    "gen_kv_prefix_hits_total").inc(n_shared)
+        # per-row targets: shared head + padding rows → parking
+        row_pages = np.full([self.bucket_for(P)], PARKING_PAGE,
+                            np.int32)
+        for i in range(n_shared * ps, P):
+            row_pages[i] = chain[i // ps]
+        return row_pages
+
     def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
                 top_k: int, seed: int) -> int:
         """Run one prompt into ``slot``; returns the first generated
@@ -557,10 +795,15 @@ class GenerationEngine:
         import jax.numpy as jnp
         P = int(np.shape(prompt)[0])
         bucket = self.bucket_for(P)
-        if P + 1 > self.max_seq:
+        if P + 1 > self.max_seq - self.decode_margin:
             raise InvalidArgumentError(
                 f"prompt of {P} tokens leaves no room to generate "
-                f"within serve_gen_max_seq={self.max_seq}")
+                f"within serve_gen_max_seq={self.max_seq} (speculative "
+                f"window margin {self.decode_margin})")
+        if self.paged:
+            row_pages = self._alloc_prefill_pages(slot, prompt)
+        else:
+            row_pages = np.zeros([bucket], np.int32)
         fn = self._prefill_jits.get(bucket)
         if fn is None:
             fn = self._prefill_jits.setdefault(
@@ -579,7 +822,8 @@ class GenerationEngine:
         self._kv, first, carry = fn(
             self._params, self._kv, jnp.asarray(ids),
             np.int32(P), np.int32(slot), base,
-            np.float32(temperature), np.int32(top_k))
+            np.float32(temperature), np.int32(top_k),
+            jnp.asarray(row_pages))
         if donated is not None:
             self._jsan.poison_donated(donated)
         if self.metrics is not None \
@@ -589,27 +833,92 @@ class GenerationEngine:
         # slot bookkeeping (small host-side .at updates, off the jitted
         # path so they can't force a retrace)
         self._lengths = self._lengths.at[slot].set(np.int32(P))
+        self._host_len[slot] = P
         self._tokens = self._tokens.at[slot].set(np.int32(first))
         self._keys = self._keys.at[slot].set(carry)
         self._temps = self._temps.at[slot].set(np.float32(temperature))
         self._topks = self._topks.at[slot].set(np.int32(top_k))
         return first
 
-    def decode(self, active_mask: np.ndarray) -> np.ndarray:  # hot-path: one dispatch per token
-        """One decode step for the whole slot batch; returns the [slots]
-        next-token array (host). Exactly one device dispatch."""
+    def ensure_page_capacity(self, active_mask: np.ndarray
+                             ) -> Dict[int, BaseException]:
+        """Page-fault handler, run on the host BEFORE each decode
+        dispatch (paged mode): any active slot whose next ``window``
+        writes would spill past its mapped chain gets fresh pages
+        appended to its table row. Faults change only the table *data*
+        — shapes are pinned at ``[slots, max_pages_per_slot]`` — so the
+        decode executable is untouched (compile-once survives growth).
+        Returns ``{slot: KVPoolExhausted}`` for slots the pool could
+        not extend; the caller masks those out and finishes them."""
+        if not self.paged:
+            return {}
         import jax.numpy as jnp
+        failed: Dict[int, BaseException] = {}
+        faulted = 0
+        dirty = False
+        for s in range(self.slots):
+            if not bool(active_mask[s]):
+                continue
+            need = min(
+                (int(self._host_len[s]) + self.window - 1)
+                // self.page_size + 1,
+                self.pages_per_slot)
+            have = len(self._slot_pages[s])
+            if need <= have:
+                continue
+            try:
+                fresh = self.pool.alloc(need - have)
+            except KVPoolExhausted as e:
+                failed[s] = e
+                continue
+            self._table_np[s, have:have + len(fresh)] = fresh
+            self._slot_pages[s].extend(fresh)
+            faulted += len(fresh)
+            dirty = True
+        if dirty:
+            self._table = jnp.asarray(self._table_np)
+        if faulted and self.metrics is not None:
+            from ..obs.registry import metrics_on
+            if metrics_on():
+                self.metrics.counter(
+                    "gen_kv_page_faults_total").inc(faulted)
+        return failed
+
+    def decode(self, active_mask: np.ndarray,
+               drafts: Optional[np.ndarray] = None,
+               ndrafts: Optional[np.ndarray] = None):  # hot-path: one dispatch per step
+        """One decode step for the whole slot batch; returns
+        ``(tokens, accepted)`` — both ``[slots, window]`` host arrays.
+        ``tokens[s, i]`` is the i-th token the sample chain produced;
+        ``accepted[s, i]`` marks the chain entries that are real output
+        (always column 0 for live slots; further columns only when
+        speculation accepted draft tokens). Exactly one device
+        dispatch regardless of drafts, faults, or arrival pattern."""
+        import jax.numpy as jnp
+        self.last_page_faults = self.ensure_page_capacity(active_mask)
+        if self.last_page_faults:
+            active_mask = np.asarray(active_mask, bool).copy()
+            for s in self.last_page_faults:
+                active_mask[s] = False
+        if drafts is None:
+            drafts = np.zeros([self.slots, self.spec_tokens], np.int32)
+        if ndrafts is None:
+            ndrafts = np.zeros([self.slots], np.int32)
         with self._lock:
             self.decode_dispatch_count += 1
         donated = None
         if self._jsan is not None:
             donated = [a for pair in self._kv for a in pair]
             self._jsan.guard_args(donated, "decode")
-        self._kv, self._lengths, self._tokens, self._keys = \
-            self._decode_jit(self._params, self._kv, self._lengths,
-                             self._tokens, self._keys, self._temps,
-                             self._topks,
-                             jnp.asarray(active_mask, bool))
+        (self._kv, self._lengths, self._tokens, self._keys, toks,
+         flags) = self._decode_jit(
+            self._params, self._kv, self._table, self._lengths,
+            self._tokens, self._keys, self._temps, self._topks,
+            jnp.asarray(active_mask, bool),
+            jnp.asarray(drafts, jnp.int32).reshape(
+                self.slots, self.spec_tokens) if self.spec_tokens
+            else jnp.zeros([self.slots, 0], jnp.int32),
+            jnp.asarray(ndrafts, jnp.int32).reshape(self.slots))
         if donated is not None:
             self._jsan.poison_donated(donated)
             # the compile-once contract, enforceable: a second decode
@@ -619,7 +928,13 @@ class GenerationEngine:
         if self.metrics is not None and self._decode_cost is None:
             self._maybe_publish_decode_cost()
         jit_sanitizer.note_host_sync("gen_token_readback")
-        return np.asarray(self._tokens)  # noqa: hidden-host-sync — the ONE intended readback
+        toks_np = np.asarray(toks)  # noqa: hidden-host-sync — the ONE intended readback
+        flags_np = np.asarray(flags, bool)
+        self._host_len += flags_np.sum(axis=1).astype(np.int64)
+        np.minimum(self._host_len,
+                   self.max_seq - self.decode_margin,
+                   out=self._host_len)
+        return toks_np, flags_np
 
     # -- executable cost attribution (ISSUE 13) -----------------------------
 
@@ -633,9 +948,12 @@ class GenerationEngine:
             import jax
             import jax.numpy as jnp
             from ..obs import costmodel as obs_costmodel
-            args = (self._params, self._kv, self._lengths,
+            args = (self._params, self._kv, self._table, self._lengths,
                     self._tokens, self._keys, self._temps, self._topks,
-                    jnp.zeros([self.slots], bool))
+                    jnp.zeros([self.slots], bool),
+                    jnp.zeros([self.slots, self.spec_tokens],
+                              jnp.int32),
+                    jnp.zeros([self.slots], jnp.int32))
             fb = obs_costmodel.tree_size_cost(
                 self._params, batch=self._tokens, extra=self._kv)
             self._decode_cost = obs_costmodel.analyze(
@@ -672,7 +990,7 @@ class GenerationEngine:
                     lambda *a: self._prefill_body(bucket, *a)).lower(
                     self._params, self._kv, ids, _np.int32(1),
                     _np.int32(0), base, _np.float32(0.0),
-                    _np.int32(0)),
+                    _np.int32(0), jnp.zeros([bucket], jnp.int32)),
                 fallback=fb)
             self._prefill_costs[bucket] = c
         return c
@@ -687,27 +1005,61 @@ class GenerationEngine:
         self.metrics.gauge(f"gen_prefill_bucket_{bucket}_bytes").set(
             cost.bytes_accessed)
 
+    def publish_kv_metrics(self) -> None:
+        """Mirror the page pool's host accounting as gauges/counters
+        (paged mode; no-op otherwise). ``gen_kv_page_evictions_total``
+        publishes the pool's cumulative count via ``inc(delta)`` so the
+        counter stays monotone across calls."""
+        if not self.paged or self.metrics is None:
+            return
+        st = self.pool.stats()
+        self._last_pool_stats = st
+        self.metrics.gauge("gen_kv_pages_in_use").set(
+            st["pages_in_use"])
+        self.metrics.gauge("gen_kv_pages_free").set(st["pages_free"])
+        self.metrics.gauge("gen_kv_pages_cached").set(
+            st["pages_cached"])
+        self.metrics.gauge("gen_kv_page_bytes").set(self._page_bytes)
+        ev = self.metrics.counter("gen_kv_page_evictions_total")
+        ev.inc(st["evictions"] - self._evictions_published)
+        self._evictions_published = st["evictions"]
+
     def release(self, slot: int) -> None:
         """Free a slot: reset its cursor so idle writes stay parked at
-        row 0 (the next prefill overwrites everything it will read)."""
+        row 0 (the next prefill overwrites everything it will read) and
+        — in paged mode — return its page refs to the pool in the SAME
+        call (the cancel/deadline contract: by the time the scheduler
+        tick that retired the request ends, its pages are reusable)."""
         self._lengths = self._lengths.at[slot].set(np.int32(0))
+        self._host_len[slot] = 0
+        self._release_slot_pages(slot)
 
     def warm_up(self) -> int:
         """Pre-compile every prefill bucket plus the decode executable
         (first-token latency stops including XLA compiles). Returns the
-        number of executables compiled. Slot state is reset after."""
+        number of executables compiled. Slot state is reset after.
+        Warm-up prompts bypass the prefix registry (``_warming``): the
+        zero-token probe prompts must not squat pages or pollute the
+        prefix cache."""
         import jax
         import jax.numpy as jnp
-        n = 0
-        for b in self.prefill_buckets:
-            self.prefill(0, np.zeros([min(b, self.max_seq - 1)],
-                                     np.int32), 0.0, 0, 0)
+        self._warming = True
+        try:
+            n = 0
+            for b in self.prefill_buckets:
+                self.prefill(0, np.zeros(
+                    [min(b, self.max_seq - self.window)],
+                    np.int32), 0.0, 0, 0)
+                n += 1
+            self.decode(np.zeros([self.slots], bool))
             n += 1
-        self.decode(np.zeros([self.slots], bool))
-        n += 1
-        jax.block_until_ready(self._kv[0][0])
+            jax.block_until_ready(self._kv[0][0])
+        finally:
+            self._warming = False
+        self.release(0)
         self._lengths = jnp.zeros([self.slots], jnp.int32)
         self._tokens = jnp.zeros([self.slots], jnp.int32)
+        self._host_len[:] = 0
         return n
 
 
@@ -832,11 +1184,14 @@ class GenerationServer:
         if prompt.size < 1:
             raise InvalidArgumentError("submit needs >= 1 prompt token")
         self.engine.bucket_for(prompt.size)  # typed on oversize NOW
-        room = self.engine.max_seq - int(prompt.size)
+        room = (self.engine.max_seq - int(prompt.size)
+                - self.engine.decode_margin)
         if room < 1:
             raise InvalidArgumentError(
                 f"prompt of {prompt.size} tokens leaves no room to "
-                f"generate within max_seq={self.engine.max_seq}")
+                f"generate within max_seq={self.engine.max_seq} "
+                f"(speculative window margin "
+                f"{self.engine.decode_margin})")
         asked = int(max_new_tokens) if max_new_tokens is not None \
             else self.token_budget
         if asked < 1:
@@ -947,6 +1302,12 @@ class GenerationServer:
         report["tokens_owed"] = (
             report["tokens_generated"] - report["tokens_streamed"]
             - report["tokens_dropped"])
+        if self.engine.paged:
+            # pages held by anything but the (intentionally warm)
+            # prefix cache after drain = a leak; ≡ 0 by construction
+            st = self.engine.pool.stats()
+            report["kv_pages_owed"] = (
+                st["pages_in_use"] - st["pages_cached"])
         return report
 
     stop = drain
@@ -972,6 +1333,8 @@ class _GenerationLoop(threading.Thread):
         self._abort_exc: Optional[BaseException] = None
         self._by_slot: Dict[int, _GenRequest] = {}
         self._free: List[int] = list(range(engine.slots))
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     def abort(self, exc: BaseException) -> None:
         """A drain that ran out of patience: fail everything still in
@@ -1068,6 +1431,11 @@ class _GenerationLoop(threading.Thread):
                 self._finish(req, "error", e)
                 continue
             req.t_first = time.monotonic()
+            if self.engine.spec_tokens > 0:
+                req.spec = NGramSpeculator(
+                    req.prompt, self.engine.spec_tokens,
+                    n=int(core_flags.flag("serve_gen_spec_ngram")))
+                req.spec.observe(first)
             self._deliver(req, first)
             self._maybe_complete(req, first)
 
@@ -1176,10 +1544,26 @@ class _GenerationLoop(threading.Thread):
             if not active.any():
                 time.sleep(self._POLL_S)  # every stream is parked
                 continue
+            eng = self.engine
+            drafts = np.zeros([slots, eng.spec_tokens], np.int32)
+            nd = np.zeros([slots], np.int32)
+            if eng.spec_tokens > 0:
+                for slot, req in self._by_slot.items():
+                    if active[slot] and req.spec is not None:
+                        d = req.spec.propose()
+                        nd[slot] = d.size
+                        drafts[slot, :d.size] = d
             t0 = time.monotonic()
-            toks = self.engine.decode(active)
+            toks, flags = eng.decode(active, drafts, nd)
             dt = time.monotonic() - t0
             m.histogram("decode_step_ms").observe(dt * 1e3)
+            # a page fault the pool could not serve fails THAT request
+            # typed at this step boundary (its slot was masked out of
+            # the dispatch); cohabitants decoded normally
+            for slot, exc in eng.last_page_faults.items():
+                req = self._by_slot.get(slot)
+                if req is not None:
+                    self._finish(req, "error", exc)
             from ..obs import trace as obs_trace
             if obs_trace.sink_active():
                 # decode spans tag slot occupancy: the trace view
@@ -1193,8 +1577,30 @@ class _GenerationLoop(threading.Thread):
                 if not active[slot]:
                     continue
                 req = self._by_slot[slot]
-                self._deliver(req, int(toks[slot]))
-                self._maybe_complete(req, int(toks[slot]))
+                n_acc = int(flags[slot].sum())
+                if eng.spec_tokens > 0 and nd[slot] > 0:
+                    self._spec_proposed += int(nd[slot])
+                    self._spec_accepted += max(n_acc - 1, 0)
+                    m.counter("gen_spec_proposed_total").inc(
+                        int(nd[slot]))
+                    m.counter("gen_spec_accepted_total").inc(
+                        max(n_acc - 1, 0))
+                    m.gauge("gen_spec_accept_ratio").set(
+                        self._spec_accepted
+                        / max(self._spec_proposed, 1))
+                # flags[slot] is a prefix: every accepted chain entry
+                # is a real token, delivered in order; eos/length can
+                # retire the request mid-window (extras are discarded
+                # — the slot's pages release with it)
+                for i in range(n_acc):
+                    tok = int(toks[slot, i])
+                    if req.spec is not None:
+                        req.spec.observe(tok)
+                    self._deliver(req, tok)
+                    self._maybe_complete(req, tok)
+                    if req.slot < 0:
+                        break
+            eng.publish_kv_metrics()
 
 
 # kept for parity tests/bench: eagerly decode ONE sequence with the

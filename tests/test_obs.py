@@ -170,6 +170,38 @@ class TestExpositionConformance:
                     if n == "p1t_serving_requests_total")
         assert 'scope="agg"' in line and line.endswith(" 14")
 
+    def test_decode_economics_families(self):
+        # ISSUE 16: the paged-KV / speculation metric families must
+        # render as conformant exposition (gauges unit-suffixed per the
+        # lint table, counters _total) exactly as the engine emits them
+        m = obs.MetricsRegistry()
+        m.gauge("gen_kv_pages_in_use").set(5)
+        m.gauge("gen_kv_pages_free").set(3)
+        m.gauge("gen_kv_pages_cached").set(2)
+        m.gauge("gen_kv_page_bytes").set(4096)
+        m.gauge("gen_spec_accept_ratio").set(0.75)
+        m.counter("gen_kv_page_faults_total").inc(4)
+        m.counter("gen_kv_page_evictions_total").inc()
+        m.counter("gen_kv_prefix_hits_total").inc(2)
+        m.counter("gen_spec_proposed_total").inc(8)
+        m.counter("gen_spec_accepted_total").inc(6)
+        types, samples = parse_exposition(m.render_text())
+        for fam, kind in {
+                "gen_kv_pages_in_use": "gauge",
+                "gen_kv_pages_free": "gauge",
+                "gen_kv_pages_cached": "gauge",
+                "gen_kv_page_bytes": "gauge",
+                "gen_spec_accept_ratio": "gauge",
+                "gen_kv_page_faults_total": "counter",
+                "gen_kv_page_evictions_total": "counter",
+                "gen_kv_prefix_hits_total": "counter",
+                "gen_spec_proposed_total": "counter",
+                "gen_spec_accepted_total": "counter"}.items():
+            assert types[f"p1t_serving_{fam}"] == kind, fam
+        line = next(l for n, l in samples
+                    if n == "p1t_serving_gen_spec_accept_ratio")
+        assert line.endswith(" 0.75")
+
     def test_composite_fleet_style_page(self):
         # a typed page followed by labeled group pages — the fleet's
         # /metrics composition — must still parse with unique TYPEs
@@ -604,7 +636,9 @@ class TestMetricNameLint:
             "m.gauge('used_mb')\n"             # non-canonical: _bytes
             "m.gauge('wait_secs')\n"           # non-canonical: _seconds
             "m.counter('io_kb_total')\n"       # bad unit under _total
-            "m.histogram('load_frac')\n")      # non-canonical: _ratio
+            "m.histogram('load_frac')\n"       # non-canonical: _ratio
+            "m.gauge('gen_kv_used_pg')\n"      # non-canonical: _pages
+            "m.counter('kv_fault_page_total')\n")  # singular _page
         problems = mod.check([str(bad)])
         text = "\n".join(problems)
         assert "'requests' must end in '_total'" in text
@@ -618,6 +652,11 @@ class TestMetricNameLint:
                "'_kb'" in text
         assert "'load_frac' uses non-canonical unit suffix " \
                "'_frac'" in text
+        # ISSUE 16: the KV paging unit family
+        assert "'gen_kv_used_pg' uses non-canonical unit suffix " \
+               "'_pg'" in text
+        assert "'kv_fault_page_total' uses non-canonical unit suffix " \
+               "'_page'" in text
 
     def test_canonical_suffixes_pass(self, tmp_path):
         import importlib.util
@@ -634,5 +673,10 @@ class TestMetricNameLint:
             "m.gauge('hbm_census_coverage_ratio')\n"
             "m.gauge('slo_lat_burn_rate_ratio')\n"
             "m.histogram('ckpt_write_bytes')\n"
-            "m.histogram('train_readback_seconds')\n")
+            "m.histogram('train_readback_seconds')\n"
+            "m.gauge('gen_kv_pages_in_use')\n"
+            "m.gauge('gen_kv_page_bytes')\n"
+            "m.gauge('gen_spec_accept_ratio')\n"
+            "m.counter('gen_kv_page_faults_total')\n"
+            "m.counter('gen_spec_accepted_total')\n")
         assert mod.check([str(good)]) == []

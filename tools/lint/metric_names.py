@@ -41,7 +41,7 @@ from .framework import Finding, LintPass
 METHODS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 HIST_UNIT_SUFFIXES = ("_seconds", "_ms", "_us", "_s", "_per_s",
-                      "_bytes", "_ratio")
+                      "_bytes", "_ratio", "_pages")
 # unitless histogram families that are ratios/fractions by nature
 HIST_UNITLESS_OK = {"batch_occupancy"}
 # canonical unit spellings (ISSUE 13): every kind — a counter named
@@ -55,6 +55,9 @@ BAD_UNIT_SUFFIXES = (
     ("_gib", "_bytes"),
     ("_pct", "_ratio"), ("_percent", "_ratio"), ("_frac", "_ratio"),
     ("_fraction", "_ratio"),
+    # KV paging families (ISSUE 16): gen_kv_pages_* gauges and
+    # gen_kv_page_*_total counters key dashboards on '_pages'/'_page_'
+    ("_page", "_pages"), ("_pg", "_pages"),
 )
 
 
