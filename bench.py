@@ -2250,6 +2250,208 @@ def bench_serving_fleet(on_tpu, steps_override=None):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_GENFLEET_FACTORY = '''
+"""bench --generate-fleet replica model: a tiny causal LM whose weights
+are a pure function of the seed, so every replica process — and the
+in-process reference server — decode bit-identical token streams.
+arg "boom" raises (a broken artifact, unused here but kept symmetric
+with the serving-fleet factory)."""
+
+
+def make_model(arg):
+    if arg == "boom":
+        raise RuntimeError("broken artifact")
+    import paddle1_tpu as paddle
+    paddle.seed(0)
+    return paddle.serving.CausalLM(
+        vocab_size=32, d_model=16, nhead=2, dim_feedforward=32,
+        num_layers=2, max_seq=64)
+'''
+
+
+def bench_generate_fleet(on_tpu, steps_override=None):
+    """``--generate-fleet``: chaos soak of the fault-tolerant
+    generative serving layer (ISSUE 17 acceptance).
+
+    * **kill failover** — three GenerationServer replica subprocesses
+      under the GenerationFleet; ``gen_replica_kill`` SIGKILLs replicas
+      mid-stream (the pigeonhole over the armed frame count guarantees
+      at least one fires); every accepted stream — greedy AND sampled —
+      completes **bit-identical** to the uninterrupted single-process
+      reference with zero client-visible failures, the drain ledger
+      balances (``unaccounted == 0``), and each replica process
+      compiled exactly one decode signature (failover replays ride the
+      prefill buckets, never a new decode shape).
+    * **KV-pressure preemption** — an in-process server over a tight
+      paged pool with ``gen_page_pressure`` chaos claiming every free
+      page mid-decode: the low-priority streams preempt (pages
+      released, stream parked) and re-admit by replay, finishing
+      bit-identical to a pressure-free run; ``KVPoolExhausted`` is
+      never client-visible and the page ledger drains to zero.
+
+    ``vs_baseline`` is 1.0 iff every gate holds; the metric is
+    fleet-wide decode throughput through the kill soak (restart cost
+    included — this is the availability number, not the happy path).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.serving import (CausalLM, GenerationEngine,
+                                     GenerationFleet, GenerationServer)
+    import paddle1_tpu as paddle
+
+    n_streams = steps_override or 8
+    max_new = 12
+
+    def specs(n):
+        out = []
+        for i in range(n):
+            s = {"prompt": [2 + i % 20, 7, 1 + (i % 3), 9],
+                 "max_new": max_new, "seed": 50 + i}
+            if i % 2:  # half greedy, half sampled: parity must hold
+                s.update(temperature=0.8, top_k=8)  # for both
+            out.append(s)
+        return out
+
+    def reference(sp):
+        paddle.seed(0)
+        lm = CausalLM(vocab_size=32, d_model=16, nhead=2,
+                      dim_feedforward=32, num_layers=2, max_seq=64)
+        srv = GenerationServer(lm, slots=4, max_seq=64,
+                               prefill_buckets=(8, 24)).start()
+        try:
+            return [srv.generate(s["prompt"],
+                                 max_new_tokens=s["max_new"],
+                                 temperature=s.get("temperature", 0.0),
+                                 top_k=s.get("top_k", 0),
+                                 seed=s["seed"])
+                    for s in sp]
+        finally:
+            srv.drain()
+
+    tmp = tempfile.mkdtemp(prefix="p1t_genfleetbench_")
+    try:
+        factory = os.path.join(tmp, "factory.py")
+        with open(factory, "w") as f:
+            f.write(_GENFLEET_FACTORY)
+        sp = specs(n_streams)
+        ref = reference(sp)
+
+        # -- arm 1: kill failover, bit-identical mid-stream ----------
+        chaos.reset()
+        fleet = GenerationFleet(
+            f"{factory}:make_model", replicas=3, version="v1",
+            slots=4, max_seq=64, prefill_buckets=(8, 24), warmup=True,
+            retry_max=5, streams_per_replica=4,
+            hang_timeout=60.0, poll_s=0.1, ready_timeout_s=300.0,
+            stream_timeout_ms=60000.0,
+            chaos_spec="gen_replica_kill@10",
+            env={"JAX_PLATFORMS": "cpu"},
+            work_dir=os.path.join(tmp, "genfleet"))
+        fleet.start()
+        failures = []
+        t0 = time.perf_counter()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature",
+                                                      0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"]) for s in sp]
+            outs = []
+            for st in streams:
+                try:
+                    outs.append(st.result(timeout=300))
+                except Exception as e:  # noqa: broad-except — ANY
+                    # client-visible failure fails the zero-drops gate
+                    failures.append(repr(e))
+                    outs.append(None)
+        finally:
+            kill_dt = time.perf_counter() - t0
+            rep = fleet.drain()
+        kill_identical = outs == ref
+        one_decode_sig = all(
+            info.get("decode_compiles", 99) <= 1
+            for info in rep["replicas"].values())
+        pools_clean = all(
+            (info.get("pool") or {}).get("pages_in_use", 0) == 0
+            for info in rep["replicas"].values())
+        tokens = sum(len(o) for o in outs if o is not None)
+        tps = tokens / kill_dt if kill_dt > 0 else 0.0
+
+        # -- arm 2: KV-pressure preemption, park + replay ------------
+        def pressure_run(pressure):
+            chaos.reset()
+            if pressure:
+                chaos.configure("gen_page_pressure@3")
+            paddle.seed(0)
+            lm = CausalLM(vocab_size=32, d_model=16, nhead=2,
+                          dim_feedforward=32, num_layers=2, max_seq=64)
+            eng = GenerationEngine(lm, slots=4, max_seq=64,
+                                   prefill_buckets=(8, 24), paged=True,
+                                   page_size=8, pages=16,
+                                   prefix_cache=0)
+            srv = GenerationServer(eng, preempt=True).start()
+            try:
+                sts = [srv.submit(s["prompt"], max_new_tokens=16,
+                                  temperature=0.7, top_k=6,
+                                  seed=s["seed"],
+                                  # stream 0 is the high-priority one
+                                  # the preemptor must never park
+                                  priority=(0 if i == 0 else 2))
+                       for i, s in enumerate(sp[:3])]
+                res = [st.result(timeout=300) for st in sts]
+            finally:
+                prep = srv.drain()
+            counters = srv.metrics.snapshot()["counters"]
+            return res, prep, counters
+
+        calm, calm_rep, _ = pressure_run(pressure=False)
+        hot, hot_rep, hot_counters = pressure_run(pressure=True)
+        preempt_identical = hot == calm
+        preemptions = hot_counters.get("gen_preemptions_total", 0)
+        readmits = hot_counters.get("gen_preempt_readmits_total", 0)
+
+        detail = {
+            "streams": n_streams, "replicas": 3, "max_new": max_new,
+            "fleet_tokens_per_s": round(tps, 1),
+            "kill_identical": kill_identical,
+            "client_failures": failures[:3],
+            "failovers": rep["failovers"],
+            "retries": rep["retries"],
+            "replica_restarts": rep["replica_restarts"],
+            "dup_tokens_dropped": rep["dup_tokens_dropped"],
+            "unaccounted": rep["unaccounted"],
+            "one_decode_signature_per_replica": one_decode_sig,
+            "replica_pools_drained": pools_clean,
+            "preempt_identical": preempt_identical,
+            "preemptions": preemptions,
+            "preempt_readmits": readmits,
+            "pressure_kv_pages_owed": hot_rep.get("kv_pages_owed", 0),
+        }
+        ok = (kill_identical and not failures
+              and rep["unaccounted"] == 0
+              and rep["errors"] == 0 and rep["stream_failed"] == 0
+              and rep["failovers"] >= 1
+              and rep["replica_restarts"] >= 1
+              and one_decode_sig and pools_clean
+              and preempt_identical
+              and preemptions >= 1 and readmits >= 1
+              and calm_rep["unaccounted"] == 0
+              and hot_rep["unaccounted"] == 0
+              and hot_rep.get("kv_pages_owed", 0) == 0)
+        _emit("generate_fleet_tokens_per_s", tps, "tok/s",
+              1.0 if ok else 0.0, detail)
+        if not ok:
+            raise AssertionError(
+                f"generate-fleet gate failed: {json.dumps(detail)}")
+    finally:
+        chaos.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import os
     ap = argparse.ArgumentParser()
@@ -2292,6 +2494,18 @@ def main():
                          "single-process engines), and a failed-canary "
                          "rollback; vs_baseline is 1.0 iff zero "
                          "client-visible failures and unaccounted==0")
+    ap.add_argument("--generate-fleet", dest="generate_fleet",
+                    action="store_true",
+                    help="fault-tolerant generative serving soak: 3 "
+                         "supervised GenerationServer replicas through "
+                         "a gen_replica_kill mid-stream failover "
+                         "(greedy AND sampled streams complete bit-"
+                         "identical to the single-process reference, "
+                         "zero client failures, unaccounted==0, one "
+                         "decode signature per replica) plus a KV-"
+                         "pressure arm where low-priority streams "
+                         "preempt/park and re-admit bit-identically; "
+                         "vs_baseline is 1.0 iff every gate holds")
     ap.add_argument("--serving", action="store_true",
                     help="dynamic micro-batching soak: serve N requests "
                          "sequentially and through the Batcher at batch "
@@ -2368,6 +2582,8 @@ def main():
         bench_elastic_resize(on_tpu, steps_override=args.steps)
     elif args.serving_fleet:
         bench_serving_fleet(on_tpu, steps_override=args.steps)
+    elif args.generate_fleet:
+        bench_generate_fleet(on_tpu, steps_override=args.steps)
     elif args.serving:
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.generate:

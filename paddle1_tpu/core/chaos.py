@@ -138,6 +138,31 @@ continuous-batching decode step; the qualifier is a SLOT id)::
                        ``serve_chaos_slow_s`` (drives the mid-stream
                        wall-deadline path). Action belongs to the
                        engine loop; this stays pure bookkeeping.
+
+Generation-fleet points (the GenerationFleet's mid-stream failure
+matrix; armed in the gen-replica PROCESS via the spec the fleet
+forwards at spawn, incarnation 0 only so a restarted replica replays
+clean):
+
+``gen_replica_kill`` — checked by :func:`check_gen_replica` once per
+                       TOKEN FRAME the gen replica streams back
+                       (qualifier = fleet rank): SIGKILL self MID-
+                       STREAM, after some tokens have already reached
+                       the client — the fleet must re-admit every
+                       in-flight stream on a survivor from
+                       ``prompt + tokens already emitted`` and the
+                       continuation must be bit-identical.
+``gen_replica_hang`` — same counter: stop streaming frames (and stay
+                       otherwise alive and heartbeating) — the
+                       wedged-stream class only the fleet's stream-
+                       silence deadline can catch.
+``gen_page_pressure`` — checked by :func:`check_gen_pressure` once per
+                       scheduler tick (own counter, no qualifier): the
+                       scheduler claims every free KV page and holds
+                       them for a few ticks — forcing decode page
+                       faults so the preemption path (shed prefix
+                       cache, preempt lowest-priority stream, park +
+                       re-admit bit-identically) runs deterministically.
 """
 
 from __future__ import annotations
@@ -153,12 +178,14 @@ __all__ = [
     "check_preempt", "check_serve_slow", "check_worker",
     "check_sample", "check_loader_worker_kill", "check_loader_stall",
     "check_replica", "check_gen_step", "check_collective",
+    "check_gen_replica", "check_gen_pressure",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
     "LOADER_WORKER_KILL", "CORRUPT_SAMPLE", "LOADER_STALL",
     "REPLICA_KILL", "REPLICA_HANG", "REPLICA_SLOW",
     "GEN_SLOT_WEDGE", "GEN_SLOW_STEP", "COLLECTIVE_SKIP",
+    "GEN_REPLICA_KILL", "GEN_REPLICA_HANG", "GEN_PAGE_PRESSURE",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -178,6 +205,9 @@ REPLICA_SLOW = "replica_slow"
 GEN_SLOT_WEDGE = "gen_slot_wedge"
 GEN_SLOW_STEP = "gen_slow_step"
 COLLECTIVE_SKIP = "collective_skip"
+GEN_REPLICA_KILL = "gen_replica_kill"
+GEN_REPLICA_HANG = "gen_replica_hang"
+GEN_PAGE_PRESSURE = "gen_page_pressure"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 # loader points share the worker points' ":qualifier" grammar, but the
@@ -190,8 +220,14 @@ _REPLICA_POINTS = (REPLICA_KILL, REPLICA_HANG, REPLICA_SLOW)
 _GEN_POINTS = (GEN_SLOT_WEDGE, GEN_SLOW_STEP)
 # collective-schedule point: the qualifier is the trainer rank
 _COLLECTIVE_POINTS = (COLLECTIVE_SKIP,)
+# generation-fleet points: kill/hang share one token-frame counter
+# (qualifier = gen-replica fleet rank); page pressure counts its own
+# scheduler ticks (qualifier unused)
+_GEN_FLEET_POINTS = (GEN_REPLICA_KILL, GEN_REPLICA_HANG,
+                     GEN_PAGE_PRESSURE)
 _QUALIFIED_POINTS = (_WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
-                     + _GEN_POINTS + _COLLECTIVE_POINTS)
+                     + _GEN_POINTS + _COLLECTIVE_POINTS
+                     + _GEN_FLEET_POINTS)
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
            PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
@@ -474,6 +510,37 @@ def check_gen_step(active_slots) -> Tuple[Optional[int], bool]:
                 wedged = slot
             break
     return wedged, slow
+
+
+def check_gen_replica(rank: int) -> Optional[str]:
+    """Generation-fleet replica points, evaluated once per TOKEN FRAME
+    the gen replica ``rank`` streams back to its fleet. Kill and hang
+    share one frame counter (``gen_replica_kill@N:R`` reads "on the Nth
+    token frame of replica R"; without ``:R`` any replica's Nth frame
+    matches); priority ``GEN_REPLICA_KILL`` > ``GEN_REPLICA_HANG`` when
+    both arm the same frame. The *action* (SIGKILL self mid-stream /
+    stop streaming while staying alive) is performed by
+    ``serving.genreplica`` — this stays pure bookkeeping."""
+    if not _armed_worker:
+        return None
+    with _lock:
+        n = _counters.get("gen_token_frame", 0) + 1
+        _counters["gen_token_frame"] = n
+        for point in (GEN_REPLICA_KILL, GEN_REPLICA_HANG):
+            armed = _armed_worker.get(point, ())
+            if (n, None) in armed or (n, rank) in armed:
+                return point
+    return None
+
+
+def check_gen_pressure() -> bool:
+    """``gen_page_pressure``: True on an armed scheduler-tick occurrence
+    (own counter — deliberately NOT the ``check_gen_step`` counter, so
+    arming pressure never shifts the wedge/slow-step schedules). The
+    *action* (claiming every free KV page and holding it for a few
+    ticks to force decode page faults into the preemption path) belongs
+    to the generation scheduler — this stays pure bookkeeping."""
+    return enabled() and _fire_qualified(GEN_PAGE_PRESSURE, 0)
 
 
 def check_collective(rank: int) -> bool:

@@ -715,6 +715,60 @@ def _define_builtin_flags() -> None:
                 "(covers import + per-bucket XLA warmup) before "
                 "treating the launch — or a deploy canary — as failed.",
                 validator=lambda v: v > 0)
+    # Generation fleet (consumed by paddle1_tpu.serving.genfleet — the
+    # multi-replica HA layer over the GenerationServer with bit-
+    # identical mid-stream failover; MIGRATING.md maps Paddle Serving
+    # HA / FastGeneration deployment habits onto these)
+    define_flag("serve_gen_replicas", 2,
+                "How many GenerationServer replica subprocesses a "
+                "GenerationFleet runs. Each is a Supervisor-managed "
+                "worker (heartbeats, hang detection, restart budgets); "
+                "a dead or wedged replica's in-flight token streams "
+                "are re-admitted on survivors bit-identically.",
+                validator=lambda v: v >= 1)
+    define_flag("serve_gen_streams_per_replica", 0,
+                "Fleet-side cap on concurrently dispatched streams per "
+                "gen replica (its routing window). 0 = the replica's "
+                "own slot count (serve_gen_slots): the fleet never "
+                "queues more streams onto one replica than it can "
+                "decode concurrently.",
+                validator=lambda v: v >= 0)
+    define_flag("serve_gen_stream_timeout_ms", 10000.0,
+                "Fleet-side stream-silence deadline: a replica with "
+                "live streams that has produced NO token frame for "
+                "this long is treated as wedged (heartbeating-but-"
+                "stuck) — taken out of rotation, restarted, and its "
+                "streams failed over. Long-lived streams make the "
+                "per-request transport deadline useless here; silence "
+                "is the signal. Must cover one worst-case decode step "
+                "plus prefill of the deepest bucket.",
+                validator=lambda v: v > 0)
+    define_flag("serve_gen_preempt", False,
+                "KV-pressure graceful degradation in the generation "
+                "scheduler: a decode-time page fault preempts the "
+                "lowest-priority / longest-deadline cohabiting stream "
+                "(its pages are released the same tick, the request is "
+                "parked, then re-admitted via the bit-identical replay "
+                "path) instead of failing the faulting stream with "
+                "KVPoolExhausted; the prefix cache always sheds LRU "
+                "entries before any live stream is touched. Off (the "
+                "default) keeps the PR 16 fail-typed behavior.")
+    define_flag("serve_gen_pressure_ceiling", 0.95,
+                "Occupancy fraction of the KV page pool above which "
+                "fleet/scheduler admission defers new prefills (the "
+                "queue holds them) under serve_gen_preempt, keeping "
+                "headroom so admitted streams' decode growth preempts "
+                "or parks instead of ever seeing KVPoolExhausted.",
+                validator=lambda v: 0 < v <= 1)
+    define_flag("debug_kv_refcount", False,
+                "KV page-accounting invariant checker: after every "
+                "scheduler tick the PagePool verifies sum-of-refcounts "
+                "== refs held by live slots + prefix registry (+ chaos "
+                "holds), free-list exactness and duplicate-freedom — "
+                "raising typed KVPageAccountingError at the tick that "
+                "corrupted accounting, not at the far-away alloc that "
+                "trips over it later. Off (the default) is free: one "
+                "module-bool test per tick.")
     # Observability (consumed by paddle1_tpu.obs — the unified metrics
     # registry, cross-process tracing and live telemetry of ISSUE 10;
     # MIGRATING.md maps the reference paddle.profiler / tools/timeline

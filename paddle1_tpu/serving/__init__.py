@@ -33,6 +33,15 @@ applied to inference under load:
   per-token :class:`TokenStream` futures with the Server's
   admission/deadline/drain contracts extended to token-level
   accounting.
+* :class:`~paddle1_tpu.serving.genfleet.GenerationFleet` — fault-
+  tolerant generative serving (ISSUE 17): N GenerationServer replicas
+  under the Supervisor with a streaming wire protocol (per-token
+  frames, monotone sequence numbers), bit-identical mid-stream
+  failover (a dead/wedged replica's streams re-admit on survivors
+  from ``prompt + tokens already emitted`` with the same seed —
+  exactly-once delivery, :class:`StreamFailed` only on retry
+  exhaustion), KV-pressure-aware routing, and hot-swap deploys that
+  migrate live streams by replay.
 
 Quickstart::
 
@@ -52,12 +61,14 @@ Or straight from a deployed artifact::
 
 from .batcher import Batcher, ServeFuture
 from .engine import InferenceEngine, resolve_buckets
-from .errors import (DeadlineExceeded, DeployFailed, KVPoolExhausted,
+from .errors import (DeadlineExceeded, DeployFailed,
+                     KVPageAccountingError, KVPoolExhausted,
                      ReplicaFailed, ServerClosed, ServerOverloaded,
-                     SlotWedged, StreamCancelled)
+                     SlotWedged, StreamCancelled, StreamFailed)
 from .fleet import AdaptiveAdmission, FleetFuture, ServingFleet
 from .generate import (CausalLM, GenerationEngine, GenerationServer,
                        TokenStream)
+from .genfleet import FleetStream, GenerationFleet
 from .metrics import (Counter, Gauge, Histogram, MetricsGroup,
                       ServingMetrics, merge_snapshots)
 from .paging import PARKING_PAGE, PagePool
@@ -69,7 +80,9 @@ __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
            "MetricsGroup", "merge_snapshots", "ServerOverloaded",
            "DeadlineExceeded", "ServerClosed", "ReplicaFailed",
            "DeployFailed", "SlotWedged", "StreamCancelled",
-           "KVPoolExhausted", "ServingFleet", "FleetFuture",
-           "AdaptiveAdmission", "GenerationEngine", "GenerationServer",
-           "TokenStream", "CausalLM", "resolve_buckets", "PagePool",
-           "PARKING_PAGE", "NGramSpeculator", "DraftModelSpeculator"]
+           "KVPoolExhausted", "StreamFailed", "KVPageAccountingError",
+           "ServingFleet", "FleetFuture", "AdaptiveAdmission",
+           "GenerationEngine", "GenerationServer", "TokenStream",
+           "CausalLM", "resolve_buckets", "PagePool", "PARKING_PAGE",
+           "GenerationFleet", "FleetStream", "NGramSpeculator",
+           "DraftModelSpeculator"]

@@ -10,7 +10,8 @@ from ..core.errors import (ExecutionTimeoutError, PreconditionNotMetError,
 
 __all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed",
            "ReplicaFailed", "DeployFailed", "SlotWedged",
-           "StreamCancelled", "KVPoolExhausted"]
+           "StreamCancelled", "KVPoolExhausted", "StreamFailed",
+           "KVPageAccountingError"]
 
 
 class ServerOverloaded(ResourceExhaustedError):
@@ -65,6 +66,26 @@ class KVPoolExhausted(ResourceExhaustedError):
     cannot be served — cohabiting slots keep decoding. Remedies: more
     pages, shorter max_new_tokens, fewer slots, or a bigger prefix
     cache hit rate (shared prompts)."""
+
+
+class StreamFailed(UnavailableError):
+    """Every failover retry for this token stream exhausted: the
+    replica decoding it died or wedged mid-stream, and re-admitting
+    ``prompt + tokens already emitted`` onto ``serve_retry_max``
+    survivors failed too (or none were healthy). Delivered through the
+    stream — tokens already delivered stay valid and exactly-once; this
+    is the generative analog of :class:`ReplicaFailed` and the ONLY
+    client-visible form of replica loss (a successful failover is
+    invisible: the continuation is bit-identical)."""
+
+
+class KVPageAccountingError(PreconditionNotMetError):
+    """KV page refcount accounting went inconsistent: a page was
+    released more times than it was held (double release), or the
+    debug invariant checker (``FLAGS_debug_kv_refcount``) found the
+    refcounts out of sync with the free list / registered holders.
+    Raised typed BEFORE the free list can be corrupted — a double-freed
+    page handed to two slots would silently cross-write their KV."""
 
 
 class StreamCancelled(UnavailableError):

@@ -77,6 +77,7 @@ from ..core import jit_sanitizer
 from ..core import locks
 from ..core.errors import InvalidArgumentError
 from .engine import resolve_buckets
+from ..obs import events as obs_events
 from .errors import (DeadlineExceeded, KVPoolExhausted, ServerClosed,
                      ServerOverloaded, SlotWedged, StreamCancelled)
 from .metrics import ServingMetrics
@@ -291,12 +292,18 @@ class TokenStream:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "seed",
                  "stream", "deadline", "t_enq", "truncated_by_budget",
-                 "slot", "n_generated", "t_first", "spec")
+                 "slot", "n_generated", "t_first", "spec",
+                 "priority", "resumed", "emitted", "preempted")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float, top_k: int, seed: int,
                  deadline_s: Optional[float], stream: TokenStream,
-                 truncated_by_budget: bool):
+                 truncated_by_budget: bool, priority: int = 0,
+                 resumed: int = 0):
+        # `prompt` includes any previously-emitted tokens a failover
+        # replays (`resumed` = how many of its tail are replayed output,
+        # NOT client prompt); `emitted` tracks tokens THIS server
+        # produced, so preempt/park re-admission can extend the replay.
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -308,7 +315,11 @@ class _GenRequest:
                          if deadline_s else None)
         self.truncated_by_budget = truncated_by_budget
         self.slot = -1
-        self.n_generated = 0
+        self.priority = int(priority)
+        self.resumed = int(resumed)
+        self.n_generated = int(resumed)
+        self.emitted: List[int] = []
+        self.preempted = 0
         self.t_first = 0.0
         self.spec = None  # per-request speculator (engine.spec_tokens>0)
 
@@ -714,6 +725,36 @@ class GenerationEngine:
 
     # -- host-side dispatch -------------------------------------------------
 
+    @staticmethod
+    def resume_key(seed: int, start_index: int = 0) -> "object":
+        """The raw key data that draws token ``start_index + 1`` of the
+        request seeded ``seed`` — the replay foundation of mid-stream
+        failover. The engine's schedule depends only on (seed, token
+        index): prefill starts from ``fold_in(key(seed), 0)`` and every
+        PRODUCED token advances the carry once via ``fold_in(k, 1)``,
+        so host-advancing the chain ``start_index`` steps and
+        prefilling over ``prompt + tokens already emitted`` continues
+        the stream bit-identically on any replica (greedy ignores keys
+        entirely; sampled draws re-join the exact chain)."""
+        import jax
+        k = jax.random.fold_in(
+            jax.random.key(int(seed) & 0x7FFFFFFF), 0)
+        for _ in range(int(start_index)):
+            k = jax.random.fold_in(k, 1)
+        return jax.random.key_data(k)
+
+    def check_kv_invariants(self, extra_holders=()) -> None:
+        """Debug sweep (``FLAGS_debug_kv_refcount``): verify the page
+        pool's refcounts against the engine's live slot chains (+ any
+        ``extra_holders`` page lists, e.g. chaos-held pages). Raises
+        typed :class:`~paddle1_tpu.serving.errors.KVPageAccountingError`
+        at the tick that corrupted accounting. No-op when unpaged."""
+        if not self.paged:
+            return
+        holders = [c for c in self._slot_pages if c]
+        holders.extend(list(x) for x in extra_holders if x)
+        self.pool.check_invariants(holders)
+
     def bucket_for(self, prompt_len: int) -> int:
         if prompt_len < 1:
             raise InvalidArgumentError(
@@ -788,9 +829,18 @@ class GenerationEngine:
         return row_pages
 
     def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
-                top_k: int, seed: int) -> int:
+                top_k: int, seed: int, start_index: int = 0) -> int:
         """Run one prompt into ``slot``; returns the first generated
-        token (host int). One dispatch on the bucket executable."""
+        token (host int). One dispatch on the bucket executable.
+
+        ``start_index > 0`` is the failover/preemption replay path:
+        ``prompt`` then carries the client prompt PLUS the first
+        ``start_index`` tokens already emitted elsewhere, and the RNG
+        key resumes at :meth:`resume_key` — the returned "first" token
+        is token ``start_index + 1`` of the original stream, bit-
+        identical to an uninterrupted run (the prefill logits at the
+        last real position equal the decode step's, and the draw key is
+        the same chain entry)."""
         import jax
         import jax.numpy as jnp
         P = int(np.shape(prompt)[0])
@@ -810,8 +860,7 @@ class GenerationEngine:
                 bucket, self._prefill_fn_for(bucket))
         ids = np.zeros([bucket], np.int32)
         ids[:P] = np.asarray(prompt, np.int32)
-        base = jax.random.key_data(jax.random.fold_in(
-            jax.random.key(seed & 0x7FFFFFFF), 0))
+        base = self.resume_key(seed, start_index)
         with self._lock:
             self.prefill_dispatch_counts[bucket] = \
                 self.prefill_dispatch_counts.get(bucket, 0) + 1
@@ -1082,7 +1131,8 @@ class GenerationServer:
                  queue_depth: Optional[int] = None,
                  stream_buffer: Optional[int] = None,
                  warmup: bool = False,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 preempt: Optional[bool] = None):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if isinstance(model, GenerationEngine):
             if (slots is not None or max_seq is not None
@@ -1112,6 +1162,11 @@ class GenerationServer:
         self.stream_buffer = int(
             stream_buffer if stream_buffer is not None
             else core_flags.flag("serve_gen_stream_buffer"))
+        # KV-pressure graceful degradation (preempt/park/re-admit
+        # instead of KVPoolExhausted) — only meaningful under paging
+        self.preempt = bool(core_flags.flag("serve_gen_preempt")
+                            if preempt is None else preempt) \
+            and self.engine.paged
         self._warmup = bool(warmup)
         self._q: "queue.Queue[_GenRequest]" = queue.Queue(self.queue_depth)
         self._drain_event = threading.Event()
@@ -1139,7 +1194,8 @@ class GenerationServer:
             n = self.engine.warm_up()
             self.metrics.counter("warmup_executables_total").inc(n)
         self._loop = _GenerationLoop(self.engine, self._q,
-                                     self.metrics, self._drain_event)
+                                     self.metrics, self._drain_event,
+                                     preempt=self.preempt)
         self._loop.start()
         with self._admit_lock:
             self._accepting = True
@@ -1163,13 +1219,27 @@ class GenerationServer:
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
                seed: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> TokenStream:
+               deadline_ms: Optional[float] = None,
+               priority: int = 0,
+               resume_tokens: Optional[Sequence[int]] = None
+               ) -> TokenStream:
         """Enqueue one prompt; returns its :class:`TokenStream`.
         Sheds with :class:`ServerOverloaded` (bounded queue) or raises
         :class:`ServerClosed` (draining/stopped) synchronously.
         ``temperature<=0`` is greedy; ``seed`` pins the sampled draws
         (per-request stream — a request's tokens are identical whether
-        it decodes alone or in a full batch)."""
+        it decodes alone or in a full batch).
+
+        ``priority`` (0 = highest) steers KV-pressure preemption under
+        ``serve_gen_preempt``: lower-priority streams yield pages
+        first. ``resume_tokens`` is the mid-stream failover replay
+        path: the tokens a previous replica already emitted for this
+        (prompt, seed) stream — they are prefilled (not re-delivered),
+        the RNG chain is advanced past them, and the stream continues
+        from token ``len(resume_tokens) + 1``, bit-identical to the
+        uninterrupted run. ``max_new_tokens`` counts the ORIGINAL
+        target (resumed tokens included), so budgets and length caps
+        land on the same token they always would."""
         if not self._accepting or self._drain_event.is_set():
             raise ServerClosed(
                 "generation server is draining/stopped — not admitting")
@@ -1183,15 +1253,22 @@ class GenerationServer:
             ).astype(np.int64).reshape(-1)
         if prompt.size < 1:
             raise InvalidArgumentError("submit needs >= 1 prompt token")
-        self.engine.bucket_for(prompt.size)  # typed on oversize NOW
+        resume = np.asarray(
+            [] if resume_tokens is None else resume_tokens,
+            np.int64).reshape(-1)
+        full = np.concatenate([prompt, resume]) if resume.size \
+            else prompt
+        self.engine.bucket_for(full.size)  # typed on oversize NOW
+        # room is counted from the ORIGINAL prompt: the resumed stream
+        # must cap at the same total token the uninterrupted run would
         room = (self.engine.max_seq - int(prompt.size)
                 - self.engine.decode_margin)
-        if room < 1:
+        if room < 1 or room <= resume.size:
             raise InvalidArgumentError(
-                f"prompt of {prompt.size} tokens leaves no room to "
-                f"generate within max_seq={self.engine.max_seq} "
-                f"(speculative window margin "
-                f"{self.engine.decode_margin})")
+                f"prompt of {prompt.size} (+{resume.size} resumed) "
+                f"tokens leaves no room to generate within "
+                f"max_seq={self.engine.max_seq} (speculative window "
+                f"margin {self.engine.decode_margin})")
         asked = int(max_new_tokens) if max_new_tokens is not None \
             else self.token_budget
         if asked < 1:
@@ -1202,6 +1279,16 @@ class GenerationServer:
         # truncating — the client asked for more than it will get
         max_new = min(asked, self.token_budget, room)
         truncated = max_new < asked
+        if resume.size >= max_new:
+            raise InvalidArgumentError(
+                f"resume_tokens already carries {resume.size} of a "
+                f"{max_new}-token stream — nothing left to generate "
+                "(the stream had finished; don't re-admit it)")
+        if resume.size and seed is None:
+            raise InvalidArgumentError(
+                "resume_tokens needs the original seed — a replayed "
+                "continuation is only bit-identical on the same "
+                "(seed, token index) chain")
         if seed is None:
             with self._admit_lock:
                 self._seed_counter[0] += 1
@@ -1209,9 +1296,11 @@ class GenerationServer:
         dl = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
         stream = TokenStream(self.stream_buffer)
-        req = _GenRequest(prompt.astype(np.int32), max_new,
+        req = _GenRequest(full.astype(np.int32), max_new,
                           float(temperature), int(top_k), int(seed),
-                          dl / 1e3 if dl else None, stream, truncated)
+                          dl / 1e3 if dl else None, stream, truncated,
+                          priority=int(priority),
+                          resumed=int(resume.size))
         with self._admit_lock:
             if not self._accepting or self._drain_event.is_set():
                 raise ServerClosed(
@@ -1322,7 +1411,8 @@ class _GenerationLoop(threading.Thread):
 
     def __init__(self, engine: GenerationEngine,
                  q: "queue.Queue", metrics: ServingMetrics,
-                 drain_event: threading.Event):
+                 drain_event: threading.Event,
+                 preempt: bool = False):
         super().__init__(name="p1t-generation-loop", daemon=True)
         self.engine = engine
         self.q = q
@@ -1335,6 +1425,20 @@ class _GenerationLoop(threading.Thread):
         self._free: List[int] = list(range(engine.slots))
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # KV-pressure graceful degradation (serve_gen_preempt)
+        self._preempt = bool(preempt) and engine.paged
+        self._ceiling = float(
+            core_flags.flag("serve_gen_pressure_ceiling"))
+        # admission-deferred (pressure-gated) requests, FIFO-preserving
+        self._pending: collections.deque = collections.deque()
+        # preempted/parked live streams awaiting replay re-admission
+        self._parked: List[_GenRequest] = []
+        # gen_page_pressure chaos: pages the scheduler itself holds
+        self._chaos_pages: List[int] = []
+        self._chaos_release_tick = 0
+        self._tick = 0
+        self._debug_refcount = bool(
+            core_flags.flag("debug_kv_refcount"))
 
     def abort(self, exc: BaseException) -> None:
         """A drain that ran out of patience: fail everything still in
@@ -1351,6 +1455,7 @@ class _GenerationLoop(threading.Thread):
         else:
             m.counter("tokens_dropped_total").inc()
         req.n_generated += 1
+        req.emitted.append(int(tok))
 
     def _finish(self, req: _GenRequest, reason: str,
                 exc: Optional[BaseException] = None) -> None:
@@ -1365,10 +1470,10 @@ class _GenerationLoop(threading.Thread):
                 m.counter("deadline_expired_total").inc()
             else:
                 m.counter("errors_total").inc()
-            if req.n_generated and req.t_first:
+            fresh = req.n_generated - req.resumed
+            if fresh > 0 and req.t_first:
                 dt = max(time.monotonic() - req.t_first, 1e-9)
-                m.histogram("tokens_per_s").observe(
-                    req.n_generated / dt)
+                m.histogram("tokens_per_s").observe(fresh / dt)
         if req.slot >= 0:
             self.engine.release(req.slot)
             import bisect
@@ -1388,19 +1493,207 @@ class _GenerationLoop(threading.Thread):
     def _fail_inflight(self, exc: BaseException, reason="error") -> None:
         for slot in list(self._by_slot):
             self._finish(self._by_slot[slot], reason, exc)
+        # parked (preempted) and pressure-deferred requests are owed a
+        # typed answer too — they were accepted
+        for req in self._parked:
+            self._finish(req, reason, exc)
+        self._parked = []
+        while self._pending:
+            self._finish(self._pending.popleft(), reason, exc)
 
     # -- scheduling ---------------------------------------------------------
+
+    def _next_request(self) -> Optional[_GenRequest]:
+        """Pressure-deferred requests re-try before fresh arrivals
+        (FIFO is preserved: a deferral pushes back to the deque head)."""
+        if self._pending:
+            return self._pending.popleft()
+        try:
+            return self.q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _admissible(self, req: _GenRequest) -> Optional[bool]:
+        """Pressure gate (``serve_gen_preempt``): True = admit now,
+        False = defer (the pool is too full — never a failure), None =
+        this request could never fit the whole pool even alone (the
+        ONLY admission shape that still fails typed)."""
+        eng = self.engine
+        if not self._preempt or not eng.paged:
+            return True
+        ps = eng.page_size
+        orig_p = len(req.prompt) - req.resumed
+        worst = min(-(-(orig_p + req.max_new) // ps),
+                    eng.pages_per_slot)
+        total = eng.pool.num_pages - 1
+        if worst > total:
+            return None
+        pf = len(req.prompt) + len(req.emitted)
+        need = (pf - 1) // ps + 1
+        st = eng.pool.stats()
+        if need > st["pages_free"] + st["pages_cached"]:
+            return False  # not even eviction could serve the prefill
+        live = st["pages_in_use"] - st["pages_cached"]
+        if live > 0 and live + need > self._ceiling * total:
+            return False  # defer: keep decode-growth headroom
+        return True
+
+    def _park(self, req: _GenRequest, why: str) -> None:
+        """Preempt a live stream: release its pages THIS tick, park the
+        request, re-admit later via the bit-identical replay path."""
+        slot = req.slot
+        self.engine.release(slot)
+        import bisect
+        bisect.insort(self._free, slot)
+        del self._by_slot[slot]
+        req.slot = -1
+        req.spec = None
+        req.preempted += 1
+        self._parked.append(req)
+        m = self.metrics
+        m.counter("gen_preemptions_total").inc()
+        m.gauge("gen_parked_streams").set(len(self._parked))
+        obs_events.emit("gen_stream_preempt", slot=slot,
+                        tokens=req.n_generated, priority=req.priority,
+                        why=why)
+
+    def _handle_fault(self, slot: int, exc: BaseException) -> None:
+        """A decode page fault the pool could not serve. Preempt off:
+        fail that stream typed (the PR 16 contract). Preempt on: shed
+        pressure instead — the pool already LRU-evicted every cached
+        prefix; now preempt strictly-lower-priority victims (longest
+        deadline slack first) until the fault fits, else park the
+        faulting stream itself. Nothing client-visible either way."""
+        req = self._by_slot.get(slot)
+        if req is None:
+            return
+        if not self._preempt:
+            self._finish(req, "error", exc)
+            return
+        eng = self.engine
+        need = max(
+            (int(eng._host_len[slot]) + eng.window - 1)
+            // eng.page_size + 1 - len(eng._slot_pages[slot]), 1)
+        now = time.monotonic()
+
+        def slack(r: _GenRequest) -> float:
+            return float("inf") if r.deadline is None \
+                else r.deadline - now
+        victims = sorted(
+            (r for s, r in self._by_slot.items()
+             if s != slot and r.priority > req.priority),
+            key=lambda r: (r.priority, slack(r)), reverse=True)
+        while victims and eng.pool.free_pages < need:
+            self._park(victims.pop(0),
+                       "preempted by higher-priority page fault")
+        if eng.pool.free_pages < need:
+            # no (more) eligible victims: the faulting stream yields
+            self._park(req, "parked under KV pressure")
+
+    def _readmit_parked(self, now: float) -> None:
+        """Re-admit parked streams (before fresh arrivals — they are
+        older) from ``prompt + everything already emitted`` with the
+        key chain advanced past it: the continuation is bit-identical
+        to never having been preempted. Cancels/deadlines apply while
+        parked too."""
+        if not self._parked:
+            return
+        # snapshot: _admit_one can park a request straight back (pool
+        # miss at prefill) — it lands on the emptied self._parked and
+        # is merged below, never mutated under iteration
+        work = self._parked
+        self._parked = []
+        keep: List[_GenRequest] = []
+        for req in work:
+            if req.stream._cancel_requested:
+                self._finish(req, "cancelled", StreamCancelled(
+                    f"cancelled after {req.n_generated} tokens "
+                    "(while parked)"))
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline", DeadlineExceeded(
+                    f"wall deadline exceeded after {req.n_generated} "
+                    "tokens (while parked under KV pressure)"))
+                continue
+            if not self._free:
+                keep.append(req)
+                continue
+            ok = self._admissible(req)
+            if ok is None:
+                self._finish(req, "error", KVPoolExhausted(
+                    "parked stream can never fit the page pool alone "
+                    "— raise serve_gen_kv_pages"))
+                continue
+            if not ok:
+                keep.append(req)
+                continue
+            if self._admit_one(req, now):
+                self.metrics.counter(
+                    "gen_preempt_readmits_total").inc()
+        self._parked = keep + self._parked
+        self.metrics.gauge("gen_parked_streams").set(
+            len(self._parked))
+
+    def _admit_one(self, req: _GenRequest, now: float) -> bool:
+        """Claim the lowest free slot and prefill (fresh admission and
+        parked/resumed replay share this path)."""
+        slot = self._free.pop(0)
+        req.slot = slot
+        self._by_slot[slot] = req
+        prior = req.resumed + len(req.emitted)
+        pp = req.prompt if not req.emitted else np.concatenate(
+            [req.prompt, np.asarray(req.emitted, np.int32)])
+        try:
+            t0 = time.monotonic()
+            first = self.engine.prefill(
+                slot, pp, req.temperature, req.top_k, req.seed,
+                start_index=prior)
+            self.metrics.histogram("prefill_ms").observe(
+                (time.monotonic() - t0) * 1e3)
+            if not req.t_first:
+                self.metrics.histogram("queue_ms").observe(
+                    (t0 - req.t_enq) * 1e3)
+        except KVPoolExhausted as e:
+            # raced the admission estimate: under preemption park it
+            # (never a client-visible failure); otherwise typed
+            import bisect
+            bisect.insort(self._free, slot)
+            del self._by_slot[slot]
+            req.slot = -1
+            if self._preempt:
+                req.preempted += 1
+                self._parked.append(req)
+                self.metrics.counter("gen_preemptions_total").inc()
+                return False
+            self._finish(req, "error", e)
+            return False
+        except Exception as e:
+            self._finish(req, "error", e)
+            return False
+        if not req.t_first:
+            req.t_first = time.monotonic()
+        if self.engine.spec_tokens > 0:
+            req.spec = NGramSpeculator(
+                pp, self.engine.spec_tokens,
+                n=int(core_flags.flag("serve_gen_spec_ngram")))
+            req.spec.observe(first)
+        self._deliver(req, first)
+        self._maybe_complete(req, first)
+        return True
 
     def _admit(self) -> None:
         """Claim free slots for queued prompts (iteration-level
         scheduling: runs between decode steps, so a late request joins
         the RUNNING batch). A drain keeps admitting — queued requests
         were accepted and are owed an answer — while `submit` has
-        already stopped new arrivals."""
+        already stopped new arrivals. Under ``serve_gen_preempt``,
+        parked streams re-admit first and fresh admissions are
+        pressure-gated (deferred, never failed)."""
+        now = time.monotonic()
+        self._readmit_parked(now)
         while self._free:
-            try:
-                req = self.q.get_nowait()
-            except queue.Empty:
+            req = self._next_request()
+            if req is None:
                 return
             now = time.monotonic()
             if req.stream._cancel_requested:
@@ -1413,31 +1706,22 @@ class _GenerationLoop(threading.Thread):
                     f"{(now - req.t_enq) * 1e3:.1f}ms in queue — "
                     "never prefetched into a slot"))
                 continue
+            ok = self._admissible(req)
+            if ok is None:
+                self._finish(req, "error", KVPoolExhausted(
+                    f"request needs more pages than the whole pool "
+                    f"holds ({self.engine.pool.num_pages - 1} usable)"
+                    " — raise serve_gen_kv_pages or lower "
+                    "max_new_tokens"))
+                continue
+            if not ok:
+                self._pending.appendleft(req)
+                self.metrics.counter(
+                    "gen_admission_deferrals_total").inc()
+                return
             # lowest free slot first: deterministic assignment (chaos
             # specs name slots; staggered-parity runs reproduce)
-            slot = self._free.pop(0)
-            req.slot = slot
-            self._by_slot[slot] = req
-            try:
-                t0 = time.monotonic()
-                first = self.engine.prefill(
-                    slot, req.prompt, req.temperature, req.top_k,
-                    req.seed)
-                self.metrics.histogram("prefill_ms").observe(
-                    (time.monotonic() - t0) * 1e3)
-                self.metrics.histogram("queue_ms").observe(
-                    (t0 - req.t_enq) * 1e3)
-            except Exception as e:
-                self._finish(req, "error", e)
-                continue
-            req.t_first = time.monotonic()
-            if self.engine.spec_tokens > 0:
-                req.spec = NGramSpeculator(
-                    req.prompt, self.engine.spec_tokens,
-                    n=int(core_flags.flag("serve_gen_spec_ngram")))
-                req.spec.observe(first)
-            self._deliver(req, first)
-            self._maybe_complete(req, first)
+            self._admit_one(req, now)
 
     def _maybe_complete(self, req: _GenRequest, tok: int) -> None:
         eos = self.engine.eos_id
@@ -1507,9 +1791,22 @@ class _GenerationLoop(threading.Thread):
                 "generation server drained while the request was "
                 "being admitted"))
 
+    def _maybe_release_chaos_pages(self) -> None:
+        """Let go of gen_page_pressure chaos holds once their tick
+        window passed (or immediately under drain/abort, so parked
+        streams can complete and kv_pages_owed lands at 0)."""
+        if self._chaos_pages and (
+                self._tick >= self._chaos_release_tick
+                or self.drain.is_set() or self._abort_exc is not None):
+            self.engine.pool.release(self._chaos_pages)
+            self._chaos_pages = []
+
     def _run_loop(self, m, slots: int) -> None:  # hot-path: decode loop
         while True:
             core_health.beat()
+            self._tick += 1
+            if self.engine.paged:
+                self._maybe_release_chaos_pages()
             if self._abort_exc is not None:
                 self._fail_inflight(self._abort_exc)
                 self._fail_queued(self._abort_exc)
@@ -1518,10 +1815,22 @@ class _GenerationLoop(threading.Thread):
             self._admit()
             if not self._by_slot:
                 m.gauge("slot_occupancy").set(0.0)
-                if self.drain.is_set() and self.q.empty():
+                if (self.drain.is_set() and self.q.empty()
+                        and not self._parked and not self._pending):
                     break
                 time.sleep(self._POLL_S)
                 continue
+            if (self.engine.paged and core_chaos.enabled()
+                    and core_chaos.check_gen_pressure()):
+                # claim every free page and squat for ~25 ticks: the
+                # deterministic trigger for the preemption path
+                free = self.engine.pool.free_pages
+                if free:
+                    self._chaos_pages.extend(
+                        self.engine.pool.alloc(free))
+                self._chaos_release_tick = self._tick + 25
+                obs_events.emit("gen_page_pressure",
+                                pages_held=len(self._chaos_pages))
             wedged, slow = core_chaos.check_gen_step(
                 list(self._by_slot))
             if slow:
@@ -1557,13 +1866,15 @@ class _GenerationLoop(threading.Thread):
             toks, flags = eng.decode(active, drafts, nd)
             dt = time.monotonic() - t0
             m.histogram("decode_step_ms").observe(dt * 1e3)
-            # a page fault the pool could not serve fails THAT request
-            # typed at this step boundary (its slot was masked out of
-            # the dispatch); cohabitants decoded normally
+            # a page fault the pool could not serve, handled at this
+            # step boundary (the slot was masked out of the dispatch;
+            # cohabitants decoded normally): preempt off = fail THAT
+            # stream typed; preempt on = shed pressure instead
+            # (prefix cache already LRU-shed inside pool.alloc, then
+            # lowest-priority/longest-deadline victim parks, else the
+            # faulting stream itself parks) — never client-visible
             for slot, exc in eng.last_page_faults.items():
-                req = self._by_slot.get(slot)
-                if req is not None:
-                    self._finish(req, "error", exc)
+                self._handle_fault(slot, exc)
             from ..obs import trace as obs_trace
             if obs_trace.sink_active():
                 # decode spans tag slot occupancy: the trace view
@@ -1601,6 +1912,12 @@ class _GenerationLoop(threading.Thread):
                     if req.slot < 0:
                         break
             eng.publish_kv_metrics()
+            if self._debug_refcount:
+                # per-tick accounting sweep: sum-of-refcounts == refs
+                # held by live slots + registry (+ chaos holds), typed
+                # KVPageAccountingError AT the corrupting tick
+                eng.check_kv_invariants(
+                    extra_holders=(self._chaos_pages,))
 
 
 # kept for parity tests/bench: eagerly decode ONE sequence with the

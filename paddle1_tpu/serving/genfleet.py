@@ -1,0 +1,1426 @@
+"""Fault-tolerant generative serving: supervised generation replicas,
+bit-identical mid-stream failover, KV-pressure-aware routing (ISSUE 17).
+
+One :class:`~paddle1_tpu.serving.GenerationServer` process is a single
+point of failure with much more to lose than a batch-inference Server:
+a replica death doesn't just drop a request-response pair, it kills
+every *long-lived token stream* mid-flight. :class:`GenerationFleet`
+is the HA layer — the generative sibling of PR 7's
+:class:`~paddle1_tpu.serving.fleet.ServingFleet`, built from the same
+pieces (Supervisor via ``supervise_once``, endpoint-file ready
+handshake, health-gated rotation, circuit breakers) with two new
+mechanisms the streaming shape demands:
+
+* **Mid-stream failover, bit-identical.** Token streams ride the
+  framed wire protocol as per-token frames carrying a monotone
+  absolute sequence number (:func:`~.wire.send_stream_tokens`). When a
+  replica dies (transport EOF), wedges (live streams but no frames for
+  ``serve_gen_stream_timeout_ms``), or trips its breaker, every
+  in-flight stream is re-admitted on a survivor from ``prompt + tokens
+  already received`` with the SAME seed and the next token index — the
+  engine's counter-based RNG schedule (``resume_key``) makes the
+  continuation bit-identical to the uninterrupted run, greedy and
+  sampled alike. The sequence number is the exactly-once contract: a
+  frame is accepted iff its seq equals the count already delivered,
+  duplicates (a replay overlap, a retire race) are dropped, and a gap
+  marks the replica desynced — failover, not corruption. The typed
+  :class:`~.errors.StreamFailed` surfaces only when ``serve_retry_max``
+  re-admissions exhaust; a successful failover is invisible.
+
+* **KV-pressure-aware routing.** Replicas report their page-pool
+  occupancy in every pong; the fleet's pullers prefer not to place a
+  stream whose worst-case page footprint exceeds a replica's free
+  pages (the gate relaxes once the queue head has aged — the replica's
+  own preemption machinery under ``serve_gen_preempt`` is the real
+  backstop, parking low-priority streams instead of raising
+  ``KVPoolExhausted``). ``priority`` rides the wire so replica-side
+  preemption ranks fleet traffic correctly.
+
+Zero-downtime hot-swap carries over with one streaming twist:
+:meth:`deploy` migrates a retiring replica's live streams by the same
+replay path (no retry budget charged — migration is policy, not
+failure), so a model roll never kills a stream either.
+
+Quickstart::
+
+    fleet = GenerationFleet("models/factory.py:make", replicas=3,
+                            version="v1", slots=4, max_seq=128,
+                            paged=True, pages=64).start()
+    stream = fleet.submit([1, 2, 3], max_new_tokens=32,
+                          temperature=0.8, seed=7)
+    for tok in stream: ...       # exactly-once, failover-transparent
+    report = fleet.drain()       # unaccounted == 0
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import chaos as core_chaos
+from ..core import flags as core_flags
+from ..core import health as core_health
+from ..core import locks
+from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from ..obs import events as obs_events
+from . import wire
+from .errors import (DeadlineExceeded, DeployFailed, ServerClosed,
+                     ServerOverloaded, StreamCancelled, StreamFailed)
+from .metrics import ServingMetrics
+
+__all__ = ["GenerationFleet", "FleetStream"]
+
+# stream_end error types that mean "place this stream elsewhere" with
+# no evidence the replica is broken: it refused admission (shed /
+# draining), its page pool genuinely couldn't hold the stream, or one
+# slot wedged (per-request poison, engine healthy)
+_FAILOVER_ETYPES = frozenset({"ServerOverloaded", "ServerClosed",
+                              "KVPoolExhausted", "SlotWedged"})
+# stream_end error types that are the CLIENT's outcome — surface typed,
+# never failover, never feed the breaker
+_CLIENT_ETYPES = frozenset({"DeadlineExceeded", "InvalidArgumentError",
+                            "StreamCancelled"})
+
+
+class FleetStream:
+    """Client handle to one fleet-managed token stream: iterate tokens
+    as they arrive, ``result()`` for the full list, ``cancel()`` to
+    stop. Fed by the fleet's receiver threads with exactly-once
+    dedup — across any number of failovers, token ``i`` is delivered
+    once, and the sequence is bit-identical to an uninterrupted run.
+
+    ``finish_reason`` mirrors :class:`~.generate.TokenStream`
+    (``"eos"``/``"length"``/``"deadline"``/``"budget"``/
+    ``"cancelled"``/``"error"``) plus ``"failed"`` when every failover
+    retry exhausted (typed :class:`StreamFailed` via ``result()``/
+    iteration)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._all: List[int] = []
+        self._cursor = 0
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._cancel_requested = False
+        self._cancel_cb = None   # fleet hook, set at submit
+        self.finish_reason: Optional[str] = None
+
+    # -- fleet side ---------------------------------------------------------
+
+    def _count(self) -> int:
+        with self._cond:
+            return len(self._all)
+
+    def _feed(self, seq: int, toks: Sequence[int]) -> str:
+        """Accept a token frame under the exactly-once contract:
+        ``'ok'`` (>=1 fresh token appended), ``'dup'`` (everything
+        already delivered — dropped), ``'gap'`` (seq beyond the next
+        expected index: the sender is desynced, fail over)."""
+        with self._cond:
+            if self._done:
+                return "dup"  # late frame from a finished stream
+            n = len(self._all)
+            if seq > n:
+                return "gap"
+            if seq + len(toks) <= n:
+                return "dup"
+            self._all.extend(int(t) for t in toks[n - seq:])
+            self._cond.notify_all()
+            return "ok"
+
+    def _finish(self, reason: str,
+                exc: Optional[BaseException] = None) -> bool:
+        with self._cond:
+            if self._done:
+                return False
+            self._done = True
+            self.finish_reason = reason
+            self._exc = exc
+            self._cond.notify_all()
+        return True
+
+    # -- client side --------------------------------------------------------
+
+    def cancel(self) -> None:
+        """Stop the stream: the owning replica releases its slot at the
+        next step boundary; no further tokens. Idempotent."""
+        with self._cond:
+            if self._done or self._cancel_requested:
+                return
+            self._cancel_requested = True
+        cb = self._cancel_cb
+        if cb is not None:
+            cb(self)
+
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def tokens(self) -> List[int]:
+        """Every token delivered so far (a snapshot copy)."""
+        with self._cond:
+            return list(self._all)
+
+    def __iter__(self) -> "FleetStream":
+        return self
+
+    def __next__(self) -> int:
+        with self._cond:
+            while True:
+                if self._cursor < len(self._all):
+                    tok = self._all[self._cursor]
+                    self._cursor += 1
+                    return tok
+                if self._done:
+                    if self._exc is not None and \
+                            self.finish_reason != "cancelled":
+                        raise self._exc
+                    raise StopIteration
+                self._cond.wait()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream finishes; the full token list. Raises
+        the stream's typed error (incl. :class:`StreamCancelled` after
+        a cancel) — partial tokens stay readable via :attr:`tokens`."""
+        with self._cond:
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
+            while not self._done:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise DeadlineExceeded(
+                        f"FleetStream not finished within {timeout}s — "
+                        "the stream is still decoding (reader deadline "
+                        "only; the stream stays accounted)")
+                self._cond.wait(rem)
+            if self._exc is not None:
+                raise self._exc
+            return list(self._all)
+
+
+class _GenStreamReq:
+    __slots__ = ("id", "prompt", "max_new", "temperature", "top_k",
+                 "seed", "deadline", "deadline_ms", "priority",
+                 "stream", "t_enq", "retries", "pinned", "owner")
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new: int,
+                 temperature: float, top_k: int, seed: int,
+                 deadline_s: Optional[float],
+                 deadline_ms: Optional[float], priority: int,
+                 pinned: bool = False):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.t_enq = time.monotonic()
+        self.deadline = (self.t_enq + deadline_s
+                         if deadline_s is not None else None)
+        self.deadline_ms = deadline_ms
+        self.priority = int(priority)
+        self.stream = FleetStream()
+        self.retries = 0
+        # pinned = must be served by the replica it was sent to (deploy
+        # canary); fails typed instead of failing over
+        self.pinned = pinned
+        self.owner: Optional["_GenReplicaClient"] = None
+
+
+# replica client states (same lifecycle as fleet.py's _ReplicaClient)
+_STARTING = "starting"
+_STANDBY = "standby"
+_READY = "ready"
+_DRAINING = "draining"
+_FAILED = "failed"
+_RETIRED = "retired"
+
+
+class _GenReplicaClient:
+    """Fleet-side handle to one generation replica subprocess: the
+    connection, the live-stream ledger, the breaker, the puller, and
+    the receiver routing token/stream_end frames. The extra state over
+    the inference fleet's client is the stream plane: ``streams`` maps
+    stream id -> request for exactly-once routing, ``last_frame``
+    feeds the wedged-stream detector, and the pool stats piggybacked
+    on every pong feed KV-pressure-aware pulling."""
+
+    def __init__(self, fleet: "GenerationFleet", rank: int,
+                 version: str, endpoint_path: str,
+                 probation: bool = False):
+        self.fleet = fleet
+        self.rank = rank
+        self.version = version
+        self.endpoint_path = endpoint_path
+        self.expected_incarnation = 0
+        self.probation = probation
+        self.state = _STARTING
+        # deliberate hold-across-sendall: serializes frames on the one
+        # socket (see fleet.py)
+        self.send_lock = threading.Lock()
+        self.lock = locks.make_lock(f"GenReplicaClient[{rank}].lock")
+        self.cond = threading.Condition(self.lock)
+        self.conn: Optional[socket.socket] = None   # guarded-by: self.lock
+        self.streams: Dict[int, _GenStreamReq] = {}  # guarded-by: self.lock
+        self.last_frame = time.monotonic()          # guarded-by: self.lock
+        self.consecutive_failures = 0               # guarded-by: self.lock
+        self.needs_restart = False                  # guarded-by: self.lock
+        self._recv_gen = 0                          # guarded-by: self.lock
+        # latest pong intel (handshake + periodic sweep pings)
+        self.slots = 0
+        self.pool: Optional[dict] = None
+        self.decode_compiles = 0
+        self.parked = 0
+        self.puller = threading.Thread(
+            target=self._puller_loop, daemon=True,
+            name=f"p1t-genfleet-pull-{rank}")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.puller.start()
+
+    def set_state(self, state: str) -> None:
+        with self.cond:
+            self.state = state
+            self.cond.notify_all()
+
+    def wait_connected(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while self.state not in (_STANDBY, _READY):
+                if self.state in (_FAILED, _RETIRED):
+                    return False
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self.cond.wait(min(rem, 0.1))
+            return True
+
+    def enter_rotation(self) -> None:
+        self.probation = False
+        self.set_state(_READY)
+        self.fleet._notify_queue()
+
+    def stream_slots(self) -> int:
+        """Concurrent streams this replica should hold: the
+        ``serve_gen_streams_per_replica`` flag, or (when 0) the
+        replica's own decode slot count from its pong."""
+        cap = self.fleet.streams_per_replica
+        return cap if cap > 0 else max(1, self.slots)
+
+    # -- connect / handshake -----------------------------------------------
+
+    def _adopt_pong(self, header: dict) -> None:
+        self.slots = int(header.get("slots", self.slots) or 0)
+        self.decode_compiles = int(header.get("decode_compiles", 0))
+        self.parked = int(header.get("parked", 0))
+        pool = header.get("pool")
+        if pool is not None:
+            self.pool = dict(pool)
+        v = header.get("version")
+        if v:
+            self.version = v
+
+    def _try_connect(self) -> bool:
+        try:
+            with open(self.endpoint_path) as f:
+                ep = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if int(ep.get("incarnation", -1)) != self.expected_incarnation:
+            return False  # stale endpoint from a previous life
+        try:
+            conn = socket.create_connection(
+                ("127.0.0.1", int(ep["port"])), timeout=2.0)
+        except OSError:
+            return False
+        try:
+            conn.settimeout(5.0)
+            wire.send_msg(conn, {"kind": "ping", "id": -1})
+            header, _ = wire.recv_msg(conn)
+            if header.get("kind") != "pong":
+                conn.close()
+                return False
+            self._adopt_pong(header)
+        except (OSError, ConnectionError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+        conn.settimeout(0.25)
+        with self.lock:
+            self.conn = conn
+            self.consecutive_failures = 0
+            self.last_frame = time.monotonic()
+            self._recv_gen += 1
+            gen = self._recv_gen
+        threading.Thread(target=self._receiver_loop, args=(conn, gen),
+                         daemon=True,
+                         name=f"p1t-genfleet-recv-{self.rank}").start()
+        self.set_state(_STANDBY if self.probation else _READY)
+        self.fleet._notify_queue()
+        return True
+
+    # -- puller -------------------------------------------------------------
+
+    def _puller_loop(self) -> None:
+        fleet = self.fleet
+        while not fleet._stop:
+            state = self.state
+            if state == _STARTING:
+                if not self._try_connect():
+                    time.sleep(0.05)
+                continue
+            if state in (_FAILED, _RETIRED):
+                return
+            if state != _READY or self.conn is None:
+                time.sleep(0.02)
+                continue
+            with self.cond:
+                if len(self.streams) >= self.stream_slots():
+                    # stream window full: wait for an end/loss to open
+                    # a slot (stream_end/transport-loss notify)
+                    self.cond.wait(0.05)
+                    continue
+            req = fleet._next_stream(self)
+            if req is None:
+                continue
+            self._dispatch(req)
+
+    def _page_headroom_ok(self, req: _GenStreamReq) -> bool:
+        """KV-pressure gate: don't place a stream whose worst-case page
+        footprint exceeds this replica's last-reported free pages. Only
+        advisory — stale by one pong, and relaxed by the caller once
+        the queue head ages (replica-side preemption is the real
+        backstop)."""
+        pool = self.pool
+        if not pool:
+            return True  # unpaged replica (or no intel yet)
+        ps = int(pool.get("page_size", 0))
+        if ps <= 0:
+            return True
+        done = req.stream._count()
+        need = -(-(int(req.prompt.size) + done + req.max_new
+                   - done) // ps)  # ceil((prompt + max_new)/page_size)
+        free = int(pool.get("pages_free", 0)) \
+            + int(pool.get("pages_cached", 0))  # cached pages evict
+        return need <= free
+
+    def _dispatch(self, req: _GenStreamReq) -> None:
+        fleet = self.fleet
+        conn = self.conn
+        if conn is None:
+            if req.pinned:
+                fleet._fail_stream(req, StreamFailed(
+                    f"pinned stream's replica {self.rank} connection "
+                    "lost before dispatch"))
+                return
+            # never reached a replica: front of the queue, no retry
+            with fleet._queue_cond:
+                fleet._queue.appendleft(req)
+                fleet._queue_cond.notify()
+            return
+        now = time.monotonic()
+        remaining_ms = None
+        if req.deadline is not None:
+            remaining_ms = (req.deadline - now) * 1e3
+            if remaining_ms <= 0.0:
+                fleet._resolve_deadline(req, "expired before dispatch")
+                return
+        toks = req.stream.tokens  # replay snapshot (only receivers
+        # append, and this stream is registered on no replica right now)
+        resume_n = len(toks)
+        full = np.concatenate(
+            [req.prompt, np.asarray(toks, np.int64)]) if resume_n \
+            else req.prompt
+        with self.cond:
+            self.streams[req.id] = req
+            req.owner = self
+            self.last_frame = now  # a fresh stream isn't "silent" yet
+        header = {"kind": "generate", "id": req.id, "seed": req.seed,
+                  "max_new": req.max_new,
+                  "temperature": req.temperature, "top_k": req.top_k,
+                  "deadline_ms": remaining_ms,
+                  "priority": req.priority, "resume": resume_n}
+        try:
+            with self.send_lock:
+                wire.send_msg(conn, header, [full])  # noqa: lock-blocking — lock is FOR sendall
+        except (OSError, ConnectionError):
+            self._on_transport_loss("send failed")
+
+    # -- receiver -----------------------------------------------------------
+
+    def _receiver_loop(self, conn: socket.socket, gen: int) -> None:
+        fleet = self.fleet
+
+        def idle():
+            if fleet._stop or self._recv_gen != gen:
+                raise ConnectionError("receiver superseded")
+
+        while True:
+            try:
+                header, _ = wire.recv_msg(conn, idle=idle)
+            except (ConnectionError, OSError):
+                if self._recv_gen == gen and not fleet._stop:
+                    self._on_transport_loss("connection lost")
+                return
+            kind = header.get("kind")
+            if kind == wire.STREAM_TOKENS:
+                self._on_tokens(header)
+            elif kind == wire.STREAM_END:
+                self._on_stream_end(header)
+            elif kind in ("pong", "metrics_result"):
+                if kind == "pong":
+                    self._adopt_pong(header)
+                fleet._resolve_rpc(self, header)
+
+    def _pop_stream(self, rid) -> Optional[_GenStreamReq]:
+        with self.cond:
+            req = self.streams.pop(rid, None)
+            if req is not None:
+                if req.owner is self:
+                    req.owner = None
+                self.cond.notify()  # a stream slot opened
+        return req
+
+    def _on_tokens(self, header: dict) -> None:
+        fleet = self.fleet
+        with self.cond:
+            req = self.streams.get(header.get("id"))
+            self.last_frame = time.monotonic()
+        if req is None:
+            return  # late frame from a migrated/failed-over stream
+        status = req.stream._feed(int(header.get("seq", 0)),
+                                  header.get("toks") or [])
+        if status == "ok":
+            fleet.metrics.counter("gen_fleet_tokens_total").inc(
+                len(header.get("toks") or []))
+        elif status == "dup":
+            fleet.metrics.counter("gen_fleet_dup_tokens_total").inc()
+        else:  # gap: the replica's stream plane is desynced — the
+            # exactly-once contract says fail over, never deliver
+            self._pop_stream(req.id)
+            fleet.metrics.counter("gen_fleet_failovers_total").inc()
+            fleet._failover(req, f"replica {self.rank} sent seq "
+                                 f"{header.get('seq')} past the "
+                                 "stream's next index (desynced)")
+
+    def _on_stream_end(self, header: dict) -> None:
+        fleet = self.fleet
+        req = self._pop_stream(header.get("id"))
+        with self.lock:
+            self.last_frame = time.monotonic()
+        if req is None:
+            return  # migrated away; the old replica's epilogue
+        reason = str(header.get("reason", "error"))
+        etype = header.get("etype")
+        msg = str(header.get("msg", ""))
+        n = int(header.get("count", 0))
+        if reason in ("eos", "length"):
+            if n != req.stream._count():
+                # the replica thinks it sent n tokens; we hold fewer —
+                # frames were lost to a race. Replay fills the hole.
+                fleet.metrics.counter("gen_fleet_failovers_total").inc()
+                fleet._failover(
+                    req, f"replica {self.rank} closed the stream at "
+                         f"{n} tokens but {req.stream._count()} "
+                         "arrived")
+                return
+            with self.lock:
+                self.consecutive_failures = 0
+            fleet._resolve_done(req, reason)
+            return
+        if reason == "cancelled":
+            fleet._resolve_cancelled(req)
+            return
+        if reason in ("deadline", "budget"):
+            fleet._resolve_error(req, reason, DeadlineExceeded(
+                msg or f"stream deadline expired on replica "
+                       f"{self.rank}"))
+            return
+        # reason == "error" (or unknown): route by etype
+        if etype in _FAILOVER_ETYPES:
+            fleet.metrics.counter("gen_fleet_failovers_total").inc()
+            fleet._failover(
+                req, f"replica {self.rank} refused/faulted: "
+                     f"{etype}: {msg}")
+            return
+        if etype == "DeadlineExceeded":
+            fleet._resolve_error(req, "deadline", DeadlineExceeded(msg))
+            return
+        if etype == "InvalidArgumentError":
+            fleet._resolve_error(req, "error", InvalidArgumentError(msg))
+            return
+        if etype == "StreamCancelled":
+            fleet._resolve_cancelled(req)
+            return
+        # unknown error: evidence the replica is broken — breaker, and
+        # the stream still fails over (replay elsewhere is safe: tokens
+        # already delivered are immutable, the continuation replays)
+        with self.lock:
+            self.consecutive_failures += 1
+            if self.consecutive_failures >= fleet.breaker_failures:
+                self.needs_restart = True
+        fleet.metrics.counter("gen_fleet_failovers_total").inc()
+        fleet._failover(req, f"replica {self.rank} stream error "
+                             f"[{etype}]: {msg}")
+
+    # -- failure handling ---------------------------------------------------
+
+    def _on_transport_loss(self, reason: str) -> None:
+        """The replica died or its connection broke: fail over every
+        live stream (replay from what the client already holds) and go
+        back to connecting."""
+        with self.cond:
+            conn, self.conn = self.conn, None
+            self._recv_gen += 1
+            lost = list(self.streams.values())
+            self.streams.clear()
+            for req in lost:
+                if req.owner is self:
+                    req.owner = None
+            self.cond.notify_all()
+            if conn is not None:
+                # close INSIDE the lock: a puller that captured this
+                # conn must get a deterministic send error (fleet.py's
+                # stranded-inflight race, same fix)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        if self.state in (_READY, _STANDBY, _STARTING):
+            self.set_state(_STARTING)
+        if lost:
+            self.fleet.metrics.counter(
+                "gen_fleet_failovers_total").inc(len(lost))
+        for req in lost:
+            self.fleet._failover(req, f"replica {self.rank} {reason}")
+
+    def sweep_wedged(self, now: float, timeout_s: float) -> bool:
+        """Wedged-stream transport deadline: live streams but no frame
+        (token, end, or pong) for ``timeout_s`` — the replica's
+        heartbeat may still beat, but its token plane is dead. Fail
+        everything over and ask for a restart."""
+        with self.lock:
+            wedged = bool(self.streams) and \
+                (now - self.last_frame) > timeout_s
+            if wedged:
+                self.needs_restart = True
+        if not wedged:
+            return False
+        self._on_transport_loss(
+            f"wedged: live streams silent > {timeout_s:.1f}s")
+        return True
+
+    def on_process_restart(self, new_incarnation: int) -> None:
+        with self.lock:
+            self.expected_incarnation = int(new_incarnation)
+            self.needs_restart = False
+        self._on_transport_loss("restarted by supervisor")
+        if self.state not in (_FAILED, _RETIRED):
+            self.set_state(_STARTING)
+
+    def mark_failed(self) -> None:
+        self.set_state(_FAILED)  # terminal first (loss can't reset it)
+        self._on_transport_loss("restart budget exhausted")
+
+
+class GenerationFleet:
+    """Multi-replica HA front end over
+    :class:`~paddle1_tpu.serving.GenerationServer` workers (module
+    docstring). ``model`` is a replica model spec —
+    ``'file.py:factory'``, ``'module:factory'`` (called with
+    ``model_arg``), or ``'artifact:/path'``. Engine/server keyword
+    arguments (``slots``, ``max_seq``, ``paged``, ``pages``,
+    ``spec_tokens``, ``preempt``, ...) are forwarded to every replica
+    via ``--gen-config``."""
+
+    def __init__(self, model: str, replicas: Optional[int] = None,
+                 version: str = "v1", model_arg: str = "",
+                 retry_max: Optional[int] = None,
+                 stream_timeout_ms: Optional[float] = None,
+                 streams_per_replica: Optional[int] = None,
+                 breaker_failures: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 ready_timeout_s: Optional[float] = None,
+                 hang_timeout: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 env: Optional[dict] = None,
+                 work_dir: Optional[str] = None,
+                 chaos_spec: Optional[str] = None,
+                 poll_s: float = 0.2,
+                 **gen_config):
+        self.model_spec = str(model)
+        self.model_arg = str(model_arg)
+        self.version = str(version)
+        self.replica_count = int(
+            core_flags.flag("serve_gen_replicas") if replicas is None
+            else replicas)
+        if self.replica_count < 1:
+            raise InvalidArgumentError("a fleet needs >= 1 replica")
+        self.retry_max = int(
+            core_flags.flag("serve_retry_max") if retry_max is None
+            else retry_max)
+        self.stream_timeout_s = float(
+            core_flags.flag("serve_gen_stream_timeout_ms")
+            if stream_timeout_ms is None else stream_timeout_ms) / 1e3
+        self.streams_per_replica = int(
+            core_flags.flag("serve_gen_streams_per_replica")
+            if streams_per_replica is None else streams_per_replica)
+        self.breaker_failures = int(
+            core_flags.flag("serve_breaker_failures")
+            if breaker_failures is None else breaker_failures)
+        self.queue_depth = int(
+            core_flags.flag("serve_fleet_queue_depth")
+            if queue_depth is None else queue_depth)
+        self.ready_timeout_s = float(
+            core_flags.flag("serve_ready_timeout_s")
+            if ready_timeout_s is None else ready_timeout_s)
+        dl = deadline_ms if deadline_ms is not None \
+            else core_flags.flag("serve_deadline_ms")
+        self.default_deadline_ms = float(dl) if dl else None
+        self.poll_s = float(poll_s)
+        self.hang_timeout = hang_timeout
+        self.max_restarts = max_restarts
+        self._user_env = dict(env) if env else {}
+        self._work_dir = work_dir
+        self._chaos_spec = (core_chaos.active_spec()
+                            if chaos_spec is None else chaos_spec)
+        self._gen_config = {k: v for k, v in gen_config.items()
+                            if v is not None}
+
+        self.metrics = ServingMetrics()
+        self._lock = locks.make_lock("GenerationFleet._lock")
+        self._queue_cond = threading.Condition(self._lock)
+        self._deploy_lock = locks.make_lock(
+            "GenerationFleet._deploy_lock", allow_blocking=True)
+        self.healthy = True                  # guarded-by: self._lock
+        self._sup = None
+        self._clients: Dict[int, _GenReplicaClient] = {}  # guarded-by: self._lock
+        self._next_rank = 0                  # guarded-by: self._lock
+        self._rid = 0                        # guarded-by: self._lock
+        self._seed_counter = 0               # guarded-by: self._lock
+        self._queue = collections.deque()    # guarded-by: self._lock
+        self._live: Dict[int, _GenStreamReq] = {}       # guarded-by: self._lock
+        self._rpc_waiters: Dict[int, dict] = {}         # guarded-by: self._lock
+        self._accepting = False              # guarded-by: self._lock
+        self._stop = False
+        self._started = False
+        self._drained = False
+        self._sweeper: Optional[threading.Thread] = None
+        self._last_ping = 0.0
+        self.deploys = 0                     # guarded-by: self._deploy_lock
+        self.migrations = 0                  # guarded-by: self._deploy_lock
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "GenerationFleet":
+        if self._started:
+            return self
+        from ..distributed.supervisor import Supervisor
+        core_health.beat()
+        if self._work_dir is None:
+            self._work_dir = tempfile.mkdtemp(prefix="p1t_genfleet_")
+        os.makedirs(self._work_dir, exist_ok=True)
+        kw = {}
+        if self.hang_timeout is not None:
+            kw["hang_timeout"] = self.hang_timeout
+        if self.max_restarts is not None:
+            kw["max_restarts"] = self.max_restarts
+        self._sup = Supervisor(policy="restart", elastic=False,
+                               heartbeat_dir=os.path.join(
+                                   self._work_dir, "hb"),
+                               log_dir=self._work_dir,
+                               poll_s=min(self.poll_s, 0.5),
+                               grace_s=10.0, **kw)
+        for _ in range(self.replica_count):
+            self._add_replica(self.version, self.model_arg)
+        self._sup.start()
+        for c in self._clients.values():
+            c.start()
+        with self._lock:
+            self._accepting = True
+        self._started = True
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         daemon=True,
+                                         name="p1t-genfleet-sweep")
+        self._sweeper.start()
+        return self
+
+    def __enter__(self) -> "GenerationFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.drain()
+        return False
+
+    def _replica_cmd(self, rank: int, version: str,
+                     model_arg: str) -> List[str]:
+        ep = os.path.join(self._work_dir, f"genreplica.{rank}.json")
+        cmd = [sys.executable, "-u", "-m",
+               "paddle1_tpu.serving.genreplica",
+               "--endpoint-file", ep, "--model", self.model_spec,
+               "--model-arg", model_arg, "--version", version,
+               "--rank", str(rank),
+               "--gen-config", json.dumps(self._gen_config)]
+        if self._chaos_spec:
+            cmd += ["--chaos", self._chaos_spec]
+        return cmd
+
+    def _replica_env(self) -> dict:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("PADDLE_FT_")}
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + (os.pathsep + pp if pp
+                                             else ""))
+        env.update(self._user_env)
+        return env
+
+    def _add_replica(self, version: str, model_arg: str,
+                     probation: bool = False,
+                     max_restarts: Optional[int] = None
+                     ) -> _GenReplicaClient:
+        with self._lock:
+            rank = self._next_rank
+            self._next_rank += 1
+        ep = os.path.join(self._work_dir, f"genreplica.{rank}.json")
+        try:  # a stale endpoint from a previous rank must never match
+            os.unlink(ep)
+        except OSError:
+            pass
+        self._sup.add_worker(
+            rank, self._replica_cmd(rank, version, model_arg),
+            env=self._replica_env(),
+            log_path=os.path.join(self._work_dir,
+                                  f"genreplica.{rank}.log"),
+            role="genreplica", max_restarts=max_restarts)
+        client = _GenReplicaClient(self, rank, version, ep,
+                                   probation=probation)
+        with self._lock:
+            self._clients[rank] = client
+        return client
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               priority: int = 0) -> FleetStream:
+        """Open one token stream; returns its :class:`FleetStream`.
+        Sheds with :class:`ServerOverloaded` (bounded queue) or raises
+        :class:`ServerClosed` synchronously. The seed is minted
+        fleet-side when absent — failover replay needs the SAME seed to
+        be bit-identical, so the fleet, not the replica, owns it.
+        ``priority`` (0 = highest) rides the wire into replica-side
+        KV-pressure preemption."""
+        if not self._accepting:
+            raise ServerClosed(
+                "generation fleet is draining/stopped — not admitting")
+        prompt = np.asarray(
+            getattr(prompt_ids, "numpy", lambda: prompt_ids)(),
+            ).astype(np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise InvalidArgumentError("submit needs >= 1 prompt token")
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        dl = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        with self._queue_cond:
+            if not self._accepting:
+                raise ServerClosed(
+                    "generation fleet is draining/stopped — not "
+                    "admitting")
+            self.metrics.counter("gen_fleet_streams_total").inc()
+            if len(self._queue) >= self.queue_depth:
+                self.metrics.counter("gen_fleet_shed_total").inc()
+                raise ServerOverloaded(
+                    f"fleet queue depth {self.queue_depth} exhausted — "
+                    "stream shed (add replicas, raise "
+                    "serve_fleet_queue_depth, or slow the client)")
+            self._rid += 1
+            if seed is None:
+                self._seed_counter += 1
+                seed = self._seed_counter
+            req = _GenStreamReq(
+                self._rid, prompt.astype(np.int64),
+                int(max_new_tokens) if max_new_tokens is not None
+                else int(core_flags.flag("serve_gen_token_budget")),
+                temperature, top_k, int(seed),
+                dl / 1e3 if dl else None, dl, priority)
+            self._live[req.id] = req
+            self._queue.append(req)
+            self.metrics.gauge("gen_fleet_streams_active").set(
+                len(self._live))
+            self._queue_cond.notify()
+        req.stream._cancel_cb = lambda _s, r=req: self._cancel(r)
+        return req.stream
+
+    def generate(self, prompt_ids, timeout: Optional[float] = None,
+                 **kw) -> List[int]:
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    def _notify_queue(self) -> None:
+        with self._queue_cond:
+            self._queue_cond.notify_all()
+
+    def _next_stream(self, client: _GenReplicaClient
+                     ) -> Optional[_GenStreamReq]:
+        """Pop the next dispatchable stream for ``client`` (pullers
+        call this). Applies the KV-pressure gate: a stream that won't
+        fit the replica's reported free pages stays queued — unless it
+        has aged past half a second (head-of-line starvation beats an
+        advisory gate; the replica's preemption/parking is the real
+        backstop)."""
+        with self._queue_cond:
+            if not self._queue:
+                self._queue_cond.wait(0.05)
+            if not self._queue:
+                return None
+            head = self._queue[0]
+            if not client._page_headroom_ok(head) and \
+                    time.monotonic() - head.t_enq < 0.5:
+                self.metrics.counter(
+                    "gen_fleet_pressure_deferrals_total").inc()
+                return None
+            req = self._queue.popleft()
+        if req.stream.done():  # failed/cancelled while queued
+            return None
+        if req.stream._cancel_requested:
+            self._resolve_cancelled(req)
+            return None
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            self._resolve_deadline(req, "expired in the fleet queue")
+            return None
+        return req
+
+    # -- cancel -------------------------------------------------------------
+
+    def _cancel(self, req: _GenStreamReq) -> None:
+        """FleetStream.cancel() hook: tell the owning replica (it ends
+        the stream ``cancelled`` through the normal epilogue), or — if
+        the stream is still queued / orphaned — resolve it locally."""
+        owner = req.owner
+        if owner is not None:
+            conn = owner.conn
+            if conn is not None:
+                try:
+                    frame = {"kind": "cancel", "stream": req.id}
+                    with owner.send_lock:
+                        wire.send_msg(conn, frame)  # noqa: lock-blocking — lock is FOR sendall
+                    return  # replica's stream_end resolves it
+                except (OSError, ConnectionError):
+                    pass  # fall through: resolve locally
+            owner._pop_stream(req.id)
+        self._resolve_cancelled(req)
+
+    # -- resolution / failover ----------------------------------------------
+
+    def _unlive(self, req: _GenStreamReq) -> None:
+        with self._lock:
+            self._live.pop(req.id, None)
+            self.metrics.gauge("gen_fleet_streams_active").set(
+                len(self._live))
+
+    def _resolve_done(self, req: _GenStreamReq, reason: str) -> None:
+        if req.stream._finish(reason):
+            self._unlive(req)
+            self.metrics.counter(
+                "gen_fleet_streams_completed_total").inc()
+            self.metrics.histogram("gen_fleet_stream_ms").observe(
+                (time.monotonic() - req.t_enq) * 1e3)
+            self.metrics.record_response()
+
+    def _resolve_cancelled(self, req: _GenStreamReq) -> None:
+        if req.stream._finish("cancelled", StreamCancelled(
+                "stream cancelled by the client — tokens already "
+                "delivered stay valid")):
+            self._unlive(req)
+            self.metrics.counter("gen_fleet_cancelled_total").inc()
+
+    def _resolve_deadline(self, req: _GenStreamReq, where: str) -> None:
+        if req.stream._finish("deadline", DeadlineExceeded(
+                f"stream {where} after "
+                f"{(time.monotonic() - req.t_enq) * 1e3:.1f}ms "
+                f"(deadline {req.deadline_ms}ms)")):
+            self._unlive(req)
+            self.metrics.counter("gen_fleet_deadline_expired_total").inc()
+
+    def _resolve_error(self, req: _GenStreamReq, reason: str,
+                       exc: BaseException) -> None:
+        if req.stream._finish(reason, exc):
+            self._unlive(req)
+            if isinstance(exc, DeadlineExceeded):
+                self.metrics.counter(
+                    "gen_fleet_deadline_expired_total").inc()
+            else:
+                self.metrics.counter("gen_fleet_errors_total").inc()
+
+    def _fail_stream(self, req: _GenStreamReq,
+                     exc: BaseException) -> None:
+        if req.stream._finish("failed", exc):
+            self._unlive(req)
+            self.metrics.counter("gen_fleet_errors_total").inc()
+            self.metrics.counter("gen_fleet_stream_failed_total").inc()
+
+    def _failover(self, req: _GenStreamReq, reason: str,
+                  charge_retry: bool = True) -> None:
+        """Re-admit a stream from ``prompt + tokens already received``
+        on a survivor (the replay is bit-identical: same seed, next
+        token index). ``charge_retry=False`` is the migration path — a
+        deploy moving streams off a retiring replica is policy, not
+        failure."""
+        if req.stream.done():
+            self._unlive(req)
+            return
+        if req.stream._cancel_requested:
+            self._resolve_cancelled(req)
+            return
+        if req.stream._count() >= req.max_new:
+            # the replica died between its last token frame and the
+            # stream_end: the client already holds every token the
+            # uninterrupted run would produce — complete, don't replay
+            self._resolve_done(req, "length")
+            return
+        if req.pinned:
+            self._fail_stream(req, StreamFailed(
+                f"pinned stream's replica failed: {reason}"))
+            return
+        if req.deadline is not None and \
+                time.monotonic() > req.deadline:
+            self._resolve_deadline(req, f"expired during failover "
+                                        f"({reason})")
+            return
+        if charge_retry:
+            req.retries += 1
+            if req.retries > self.retry_max:
+                self._fail_stream(req, StreamFailed(
+                    f"stream failed over {req.retries - 1} times "
+                    f"(serve_retry_max={self.retry_max}); last: "
+                    f"{reason}"))
+                return
+            self.metrics.counter("gen_fleet_retries_total").inc()
+        obs_events.emit("gen_stream_failover", stream=req.id,
+                        tokens=req.stream._count(),
+                        retries=req.retries,
+                        migration=not charge_retry, reason=reason)
+        with self._queue_cond:
+            self._queue.appendleft(req)
+            self._queue_cond.notify()
+
+    # -- supervision sweep --------------------------------------------------
+
+    def _sweep_loop(self) -> None:
+        while not self._stop:
+            try:
+                self._sweep_once()
+            except Exception as e:  # noqa: broad-except — supervision
+                # must survive transient teardown races
+                print(f"genfleet sweep error: {e!r}", file=sys.stderr)
+            time.sleep(self.poll_s)
+
+    def _sweep_once(self) -> None:
+        core_health.beat()
+        if core_health.drain_requested() and self._accepting:
+            self.drain()
+            return
+        now = time.monotonic()
+        for ev in self._sup.supervise_once():
+            client = self._clients.get(ev.rank)
+            if client is None:
+                continue
+            if ev.action == "restarted":
+                self.metrics.counter(
+                    "gen_fleet_replica_restarts_total").inc()
+                try:
+                    inc = self._sup.incarnation(ev.rank)
+                except InvalidArgumentError:
+                    continue  # retired by a concurrent deploy
+                client.on_process_restart(inc)
+            elif ev.action == "restart_exhausted":
+                self._on_replica_exhausted(client, ev)
+        with self._lock:
+            clients = list(self._clients.values())
+        for client in clients:
+            if client.state in (_FAILED, _RETIRED, _DRAINING):
+                continue
+            if client.sweep_wedged(now, self.stream_timeout_s):
+                self.metrics.counter(
+                    "gen_fleet_replica_wedged_total").inc()
+            with client.lock:  # atomic test-and-clear (fleet.py race)
+                needs_restart = client.needs_restart
+                client.needs_restart = False
+            if needs_restart:
+                if client.state not in (_FAILED, _RETIRED, _DRAINING):
+                    try:
+                        restarted = self._sup.restart_rank(client.rank)
+                        inc = (self._sup.incarnation(client.rank)
+                               if restarted else 0)
+                    except InvalidArgumentError:
+                        continue
+                    if restarted:
+                        self.metrics.counter(
+                            "gen_fleet_replica_restarts_total").inc()
+                        client.on_process_restart(inc)
+                    else:
+                        self._on_replica_exhausted(client, None)
+        # periodic pong refresh: KV-pressure intel + ready gauges (a
+        # fire-and-forget frame — the receiver adopts the pong, so the
+        # sweep never blocks on a replica)
+        if now - self._last_ping >= 1.0:
+            self._last_ping = now
+            ready = 0
+            pages_free = 0
+            any_pool = False
+            for client in clients:
+                if client.state == _READY:
+                    ready += 1
+                    conn = client.conn
+                    if conn is not None:
+                        try:
+                            frame = {"kind": "ping", "id": -2}
+                            with client.send_lock:
+                                wire.send_msg(conn, frame)  # noqa: lock-blocking — sendall lock
+                        except (OSError, ConnectionError):
+                            pass
+                    if client.pool:
+                        any_pool = True
+                        pages_free += int(
+                            client.pool.get("pages_free", 0))
+            self.metrics.gauge("gen_fleet_replicas_ready").set(ready)
+            if any_pool:
+                self.metrics.gauge("gen_fleet_kv_pages_free").set(
+                    pages_free)
+        # queued streams whose deadline passed while nobody pulled
+        expired = []
+        with self._queue_cond:
+            if self._queue:
+                keep = collections.deque()
+                for req in self._queue:
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                    else:
+                        keep.append(req)
+                self._queue = keep
+        for req in expired:
+            self._resolve_deadline(req, "expired in the fleet queue")
+        if not any(c.state in (_STARTING, _STANDBY, _READY, _DRAINING)
+                   for c in clients):
+            self._fail_all_pending(StreamFailed(
+                "no generation replicas left in the fleet (restart "
+                "budgets exhausted)"))
+
+    def _on_replica_exhausted(self, client: _GenReplicaClient,
+                              ev) -> None:
+        client.mark_failed()
+        if self._sup is not None:
+            self._sup.kill_worker(client.rank)
+        if client.probation:
+            return  # a dying deploy candidate is the deploy's failure
+        with self._lock:
+            self.healthy = False
+        self.metrics.counter("gen_fleet_replica_exhausted_total").inc()
+        reason = (f"generation fleet: replica {client.rank} out of "
+                  f"restart budget"
+                  + (f" ({ev.failure.kind}: {ev.failure.reason})"
+                     if ev is not None else ""))
+        print(reason, file=sys.stderr)
+        core_health.report_unhealthy(reason)
+
+    def _fail_all_pending(self, exc: BaseException) -> None:
+        with self._queue_cond:
+            pending = list(self._queue)
+            self._queue.clear()
+            live = list(self._live.values())
+        for req in pending + live:
+            self._fail_stream(req, exc)
+
+    # -- replica RPC --------------------------------------------------------
+
+    def _rpc(self, client: _GenReplicaClient, kind: str,
+             timeout: float = 10.0) -> Optional[dict]:
+        conn = client.conn
+        if conn is None:
+            return None
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            waiter = {"event": threading.Event(), "header": None}
+            self._rpc_waiters[rid] = waiter
+        try:
+            with client.send_lock:
+                wire.send_msg(conn, {"kind": kind, "id": rid})  # noqa: lock-blocking — send lock
+        except (OSError, ConnectionError):
+            with self._lock:
+                self._rpc_waiters.pop(rid, None)
+            return None
+        if not waiter["event"].wait(timeout):
+            with self._lock:
+                self._rpc_waiters.pop(rid, None)
+            return None
+        return waiter["header"]
+
+    def _resolve_rpc(self, client: _GenReplicaClient, header) -> None:
+        with self._lock:
+            waiter = self._rpc_waiters.pop(header.get("id"), None)
+        if waiter is not None:
+            waiter["header"] = header
+            waiter["event"].set()
+
+    def replica_snapshot(self, rank: int,
+                         timeout: float = 10.0) -> Optional[dict]:
+        """One replica's own ServingMetrics snapshot, over the wire."""
+        client = self._clients.get(rank)
+        if client is None:
+            return None
+        header = self._rpc(client, "metrics", timeout)
+        return header.get("snapshot") if header else None
+
+    # -- hot swap -----------------------------------------------------------
+
+    def deploy(self, model: str, version: str, model_arg: str = "",
+               canary_prompt: Optional[Sequence[int]] = None,
+               ready_timeout_s: Optional[float] = None) -> dict:
+        """Zero-downtime rolling model swap. The first new replica is
+        the canary (zero restart budget; ``canary_prompt``, when given,
+        must stream to completion ON the candidate — pinned, it never
+        fails over to the standing fleet). Each retiring replica's live
+        streams are MIGRATED by replay onto the survivors — same
+        mechanism as failover, no retry budget charged — so a deploy
+        never kills a stream. Raises :class:`DeployFailed` with the old
+        fleet intact when the canary fails; later failures roll the
+        already-promoted slots back."""
+        timeout = (self.ready_timeout_s if ready_timeout_s is None
+                   else float(ready_timeout_s))
+        with self._deploy_lock:
+            if not self._started or self._stop:
+                raise PreconditionNotMetError(
+                    "fleet is not running — nothing to deploy onto")
+            old_spec, old_arg, old_version = (
+                self.model_spec, self.model_arg, self.version)
+            with self._lock:
+                old_ranks = [r for r, c in self._clients.items()
+                             if c.state in (_STARTING, _READY)]
+            if not old_ranks:
+                raise PreconditionNotMetError(
+                    "no serving replicas to roll")
+            self.model_spec = str(model)
+            self.model_arg = str(model_arg)
+            swapped: List[int] = []
+            try:
+                for i, old_rank in enumerate(sorted(old_ranks)):
+                    new = self._swap_in(version, model_arg,
+                                        canary_prompt, timeout,
+                                        canary_slot=(i == 0))
+                    self._retire_replica(old_rank)
+                    swapped.append(new.rank)
+            except DeployFailed:
+                self.metrics.counter("gen_fleet_rollbacks_total").inc()
+                obs_events.emit("gen_deploy_rollback",
+                                version=str(version),
+                                promoted=len(swapped))
+                self.model_spec, self.model_arg = old_spec, old_arg
+                for new_rank in swapped:
+                    try:
+                        self._swap_in(old_version, old_arg, None,
+                                      timeout, canary_slot=False)
+                        self._retire_replica(new_rank)
+                    except DeployFailed:  # pragma: no cover -
+                        break  # survivors keep serving
+                raise
+            self.version = str(version)
+            self.deploys += 1
+            self.metrics.counter("gen_fleet_deploys_total").inc()
+            obs_events.emit("gen_deploy", version=str(version),
+                            replicas=list(swapped))
+            return {"version": version, "replicas": swapped,
+                    "rolled": len(swapped)}
+
+    def _swap_in(self, version: str, model_arg: str, canary_prompt,
+                 timeout: float,
+                 canary_slot: bool) -> _GenReplicaClient:
+        client = self._add_replica(version, model_arg, probation=True,
+                                   max_restarts=0 if canary_slot
+                                   else None)
+        self._sup.spawn_worker(client.rank)
+        client.start()
+        ok = client.wait_connected(timeout)
+        if ok and canary_prompt is not None:
+            ok = self._canary_generate(client, canary_prompt, timeout)
+        if not ok:
+            self._abort_spawn(client)
+            raise DeployFailed(
+                f"generation replica for version {version!r} never "
+                f"became healthy within {timeout:.0f}s"
+                + (" (canary)" if canary_slot else "")
+                + " — deploy aborted, fleet keeps serving the "
+                  "previous version")
+        self._sup.set_restart_budget(client.rank, self.max_restarts)
+        client.enter_rotation()
+        return client
+
+    def _canary_generate(self, client: _GenReplicaClient,
+                         canary_prompt, timeout: float) -> bool:
+        """One short pinned stream on the off-rotation candidate: it
+        must decode to completion on THAT replica (the pin turns any
+        failover into a typed failure — a canary answered by the
+        standing fleet proves nothing)."""
+        prompt = np.asarray(canary_prompt, np.int64).reshape(-1)
+        with self._queue_cond:
+            self.metrics.counter("gen_fleet_streams_total").inc()
+            self._rid += 1
+            self._seed_counter += 1
+            req = _GenStreamReq(self._rid, prompt, 4, 0.0, 0,
+                                self._seed_counter, None, None, 0,
+                                pinned=True)
+            self._live[req.id] = req
+        client._dispatch(req)
+        try:
+            req.stream.result(timeout=timeout)
+        except Exception:  # noqa: broad-except — ANY canary failure
+            return False   # means "do not promote"
+        # the pin is the proof: tokens route by the candidate's own
+        # stream registry, so a completed result came from IT
+        return True
+
+    def _abort_spawn(self, client: _GenReplicaClient) -> None:
+        client.set_state(_RETIRED)
+        client._on_transport_loss("deploy aborted")
+        self._sup.retire(client.rank, grace_s=2.0)
+        with self._lock:
+            self._clients.pop(client.rank, None)
+
+    def _retire_replica(self, rank: int) -> None:
+        """Take one replica out of the fleet, migrating its live
+        streams by replay (not failover — no retry budget): remove
+        each stream from the retiring client FIRST (late frames and
+        the cancel-epilogue get dropped by the registry miss), cancel
+        it replica-side so the old process stops decoding tokens
+        nobody reads, then re-enqueue for a survivor."""
+        client = self._clients.get(rank)
+        if client is None:
+            return
+        client.set_state(_DRAINING)
+        with client.cond:
+            moving = list(client.streams.values())
+            client.streams.clear()
+            for req in moving:
+                if req.owner is client:
+                    req.owner = None
+            conn = client.conn
+        for req in moving:
+            if conn is not None:
+                try:
+                    frame = {"kind": "cancel", "stream": req.id}
+                    with client.send_lock:
+                        wire.send_msg(conn, frame)  # noqa: lock-blocking — lock is FOR sendall
+                except (OSError, ConnectionError):
+                    conn = None
+            self.metrics.counter("gen_fleet_migrations_total").inc()
+            # only deploy() calls _retire_replica, under _deploy_lock
+            self.migrations += 1  # noqa: guarded-mutation — held via deploy()
+            self._failover(req, f"migrated off retiring replica "
+                                f"{rank}", charge_retry=False)
+        client.set_state(_RETIRED)
+        self._sup.retire(rank)
+        client._on_transport_loss("retired")  # registry already empty
+        with self._lock:
+            self._clients.pop(rank, None)
+
+    # -- drain --------------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Stop admitting, let every accepted stream finish (or fail
+        typed), scrape each replica's final decode-compile and page
+        ledgers, stop the replicas gracefully, report — with the
+        accounting identity ``unaccounted == 0``."""
+        with self._queue_cond:
+            already = self._drained
+            self._accepting = False
+        per_rank: Dict[int, dict] = {}
+        if not already and self._started:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._live:
+                        break
+                time.sleep(0.02)
+            self._fail_all_pending(PreconditionNotMetError(
+                f"generation fleet drain timed out after {timeout}s"))
+            # final per-replica ledger scrape BEFORE teardown: the
+            # bench's acceptance gates (decode_compile_count == 1 per
+            # replica across failovers, kv pages owed) read this
+            with self._lock:
+                clients = list(self._clients.items())
+            for rank, client in clients:
+                header = self._rpc(client, "ping", timeout=5.0)
+                if header is not None:
+                    per_rank[rank] = {
+                        "version": header.get("version"),
+                        "incarnation": header.get("incarnation"),
+                        "decode_compiles":
+                            header.get("decode_compiles"),
+                        "parked": header.get("parked"),
+                        "pool": header.get("pool"),
+                    }
+        with self._queue_cond:
+            self._stop = True
+            self._queue_cond.notify_all()
+        if self._sup is not None and not already:
+            for rank in list(self._clients):
+                self._sup.retire(rank, grace_s=10.0)
+        self._drained = True
+        snap = self.metrics.snapshot()
+        c = snap["counters"]
+        report = {
+            "drained": True,
+            "healthy": self.healthy,
+            "accepted": (c.get("gen_fleet_streams_total", 0)
+                         - c.get("gen_fleet_shed_total", 0)),
+            "completed": c.get("gen_fleet_streams_completed_total", 0),
+            "deadline_failed":
+                c.get("gen_fleet_deadline_expired_total", 0),
+            "cancelled": c.get("gen_fleet_cancelled_total", 0),
+            "errors": c.get("gen_fleet_errors_total", 0),
+            "stream_failed": c.get("gen_fleet_stream_failed_total", 0),
+            "shed": c.get("gen_fleet_shed_total", 0),
+            "retries": c.get("gen_fleet_retries_total", 0),
+            "failovers": c.get("gen_fleet_failovers_total", 0),
+            "migrations": c.get("gen_fleet_migrations_total", 0),
+            "tokens": c.get("gen_fleet_tokens_total", 0),
+            "dup_tokens_dropped":
+                c.get("gen_fleet_dup_tokens_total", 0),
+            "replica_restarts":
+                c.get("gen_fleet_replica_restarts_total", 0),
+            "deploys": self.deploys,
+            "replicas": per_rank,
+            "supervisor": (self._sup.report.as_dict()
+                           if self._sup is not None else None),
+        }
+        report["unaccounted"] = (report["accepted"]
+                                 - report["completed"]
+                                 - report["deadline_failed"]
+                                 - report["cancelled"]
+                                 - report["errors"])
+        return report
+
+    stop = drain

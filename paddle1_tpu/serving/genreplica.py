@@ -1,0 +1,312 @@
+"""Generation replica worker: the subprocess half of the GenerationFleet.
+
+``python -m paddle1_tpu.serving.genreplica`` is what the generation
+fleet's Supervisor spawns per replica rank: it loads one model, wraps
+it in a :class:`~paddle1_tpu.serving.GenerationServer` (continuous
+batching, paged KV, deadlines — the PR 16 stack), binds a loopback
+socket, publishes its endpoint, and serves framed requests from the
+fleet dispatcher until a drain is requested.
+
+The same three load-bearing ordering rules as :mod:`.replica` apply
+(beat first so ``PADDLE_FT_*`` never leaks to grandchildren; chaos
+arms in incarnation 0 only; the endpoint file is written AFTER the
+server started, so publishing the port IS the ready signal).
+
+What is new here is the token plane: a ``generate`` frame opens a
+long-lived stream, and a per-stream **pump thread** walks the
+:class:`TokenStream`, sending one ``tokens`` frame per produced token
+with a monotone absolute sequence number (``seq`` starts at the
+resume count for replayed streams — the client already holds the
+replayed tokens, so this replica never re-sends them). The fleet's
+dedup key is that sequence number; this end's only job is to keep it
+exact. A ``stream_end`` frame carries the finish reason and, for
+typed failures, the error type/message so the fleet can decide
+between failover and surfacing.
+
+Chaos fires per TOKEN FRAME (``check_gen_replica``): a kill point
+SIGKILLs the process mid-stream (the fleet must fail over every
+in-flight stream bit-identically); a hang point wedges the token
+plane process-wide — pumps stop sending while the main thread keeps
+heartbeating, so only the fleet's stream-silence deadline can catch
+it (heartbeats alone are blind to a wedged stream).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .replica import _write_endpoint, load_model
+
+__all__ = ["main"]
+
+# process-wide wedge latch (chaos GEN_REPLICA_HANG): once set, every
+# pump thread stops sending token frames forever while the main thread
+# keeps beating — the wedged-stream failure mode the fleet's transport
+# deadline exists to catch
+_WEDGE = threading.Event()
+
+
+class _DrainRequested(Exception):
+    """Internal: aborts a blocking frame read when a drain arrived."""
+
+
+def _pump_stream(conn: socket.socket, send_lock: threading.Lock,
+                 stream, stream_id: int, resume_n: int, rank: int,
+                 streams: Dict[int, object],
+                 streams_lock: threading.Lock, core_chaos) -> None:
+    """Walk one TokenStream, relaying tokens as wire frames.
+
+    ``seq`` is the absolute token index within the stream: the first
+    frame of a resumed stream carries ``seq == resume_n`` (the client
+    kept tokens 0..resume_n-1 across the failover — re-sending them
+    would only exercise the dedup path for nothing).
+    """
+    from . import wire
+    seq = int(resume_n)
+    exc: Optional[BaseException] = None
+    try:
+        for tok in stream:
+            if core_chaos.enabled():
+                point = core_chaos.check_gen_replica(rank)
+                if point == core_chaos.GEN_REPLICA_KILL:
+                    # ungraceful death mid-stream: no stream_end, no
+                    # cleanup — the fleet must replay every in-flight
+                    # stream on a survivor, bit-identically
+                    os.kill(os.getpid(), signal.SIGKILL)
+                elif point == core_chaos.GEN_REPLICA_HANG:
+                    _WEDGE.set()
+            if _WEDGE.is_set():
+                # wedged token plane: heartbeats keep flowing (main
+                # thread), tokens don't — park this pump forever (the
+                # latch only ever goes up, so wait on one that can't)
+                threading.Event().wait()  # pragma: no cover - never returns
+            try:
+                with send_lock:
+                    wire.send_stream_tokens(  # noqa: lock-blocking — lock is FOR sendall
+                        conn, stream_id, seq, [tok])
+            except (OSError, ConnectionError):
+                # fleet connection died mid-stream: stop decoding what
+                # nobody can read — the failover replays it elsewhere
+                stream.cancel()
+                return
+            seq += 1
+    except BaseException as e:  # noqa: broad-except — typed stream
+        # failures (deadline/budget/errors) close the stream on the
+        # wire with their type so the fleet can route them
+        exc = e
+    finally:
+        with streams_lock:
+            streams.pop(stream_id, None)
+    reason = stream.finish_reason or ("error" if exc is not None
+                                      else "length")
+    try:
+        with send_lock:
+            wire.send_stream_end(  # noqa: lock-blocking — lock is FOR sendall
+                conn, stream_id, seq, reason,
+                etype=type(exc).__name__ if exc is not None else None,
+                msg=str(exc) if exc is not None else "")
+    except (OSError, ConnectionError):
+        pass  # fleet gone; its failover owns the stream now
+
+
+def _pong_payload(srv, args, core_health) -> Dict[str, object]:
+    eng = srv.engine
+    loop = srv._loop
+    out = {
+        "version": args.version, "rank": args.rank,
+        "incarnation": core_health.incarnation(),
+        "slots": eng.slots,
+        "decode_compiles": eng.decode_compile_count,
+        "parked": len(loop._parked) if loop is not None else 0,
+    }
+    if eng.paged:
+        out["pool"] = eng.pool.stats()
+    return out
+
+
+def _serve_conn(conn: socket.socket, srv, args, core_chaos,
+                core_health) -> None:
+    """Pump one fleet connection until EOF or drain."""
+    from . import wire
+    conn.settimeout(0.25)
+    send_lock = threading.Lock()
+    streams: Dict[int, object] = {}        # stream id -> TokenStream
+    streams_lock = threading.Lock()
+
+    def idle():
+        core_health.beat()
+        if core_health.drain_requested():
+            raise _DrainRequested
+
+    while True:
+        try:
+            header, arrays = wire.recv_msg(conn, idle=idle)
+        except (ConnectionError, OSError):
+            # fleet connection lost: cancel every stream it was
+            # reading — this replica must not burn slots decoding
+            # tokens nobody will consume (the fleet replays them)
+            with streams_lock:
+                live = list(streams.values())
+                streams.clear()
+            for st in live:
+                st.cancel()
+            return
+        kind = header.get("kind")
+        rid = header.get("id")
+        if kind == "ping":
+            payload = {"kind": "pong", "id": rid}
+            payload.update(_pong_payload(srv, args, core_health))
+            with send_lock:
+                wire.send_msg(conn, payload)  # noqa: lock-blocking — frame lock IS for sendall
+        elif kind == "metrics":
+            with send_lock:
+                wire.send_msg(conn, {  # noqa: lock-blocking — frame lock IS for sendall
+                    "kind": "metrics_result", "id": rid,
+                    "version": args.version,
+                    "snapshot": srv.metrics.snapshot()})
+        elif kind == "cancel":
+            with streams_lock:
+                st = streams.get(int(header.get("stream", -1)))
+            if st is not None:
+                st.cancel()
+        elif kind == "generate":
+            full = np.asarray(arrays[0], np.int64).reshape(-1)
+            n_resume = int(header.get("resume", 0))
+            prompt = full[:full.size - n_resume] if n_resume else full
+            resume = full[full.size - n_resume:] if n_resume else None
+            if resume is not None and resume.size:
+                # a replay whose tail already finished the stream (the
+                # old replica died between its final token frame and
+                # the stream_end): close it on the wire, don't decode
+                eos = srv.engine.eos_id
+                done_reason = None
+                if eos is not None and int(resume[-1]) == eos:
+                    done_reason = "eos"
+                elif resume.size >= int(header.get("max_new") or 0):
+                    done_reason = "length"
+                if done_reason is not None:
+                    with send_lock:
+                        wire.send_stream_end(  # noqa: lock-blocking — frame lock IS for sendall
+                            conn, int(rid), n_resume, done_reason)
+                    continue
+            try:
+                stream = srv.submit(
+                    prompt,
+                    max_new_tokens=header.get("max_new"),
+                    temperature=float(header.get("temperature", 0.0)),
+                    top_k=int(header.get("top_k", 0)),
+                    seed=header.get("seed"),
+                    deadline_ms=header.get("deadline_ms"),
+                    priority=int(header.get("priority", 0)),
+                    resume_tokens=resume)
+            except Exception as e:  # noqa: broad-except — admission
+                # errors (shed/closed/invalid) end the stream typed so
+                # the fleet can retry elsewhere or surface them
+                with send_lock:
+                    wire.send_stream_end(  # noqa: lock-blocking — frame lock IS for sendall
+                        conn, int(rid), n_resume, "error",
+                        etype=type(e).__name__, msg=str(e))
+                continue
+            with streams_lock:
+                streams[int(rid)] = stream
+            t = threading.Thread(
+                target=_pump_stream,
+                args=(conn, send_lock, stream, int(rid), n_resume,
+                      args.rank, streams, streams_lock, core_chaos),
+                daemon=True, name=f"p1t-genpump-{rid}")
+            t.start()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="paddle1_tpu generation replica worker")
+    ap.add_argument("--endpoint-file", required=True)
+    ap.add_argument("--model", required=True,
+                    help="'file.py:factory', 'module:factory', or "
+                         "'artifact:/path'")
+    ap.add_argument("--model-arg", default="")
+    ap.add_argument("--version", default="v0")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--chaos", default="",
+                    help="chaos spec armed in THIS process "
+                         "(incarnation 0 only)")
+    ap.add_argument("--gen-config", default="{}",
+                    help="JSON kwargs split between GenerationEngine "
+                         "and GenerationServer")
+    args = ap.parse_args(argv)
+
+    from ..core import chaos as core_chaos
+    from ..core import health as core_health
+
+    # 1. adopt the heartbeat channel (pops PADDLE_FT_* before anything
+    #    else can snapshot the env for grandchildren)
+    core_health.beat()
+    # 2. chaos replays clean in restarted lives
+    if args.chaos and core_health.incarnation() == 0:
+        core_chaos.configure(args.chaos)
+
+    from .generate import GenerationEngine, GenerationServer
+
+    model = load_model(args.model, args.model_arg)
+    cfg = json.loads(args.gen_config or "{}")
+    eng_keys = ("slots", "max_seq", "prefill_buckets", "eos_id",
+                "cache_dtype", "paged", "page_size", "pages",
+                "prefix_cache", "spec_tokens", "int8")
+    eng_cfg = {k: cfg[k] for k in eng_keys if k in cfg}
+    srv_cfg = {k: v for k, v in cfg.items() if k not in eng_keys}
+    engine = GenerationEngine(model, **eng_cfg)
+    srv = GenerationServer(engine, **srv_cfg)
+    srv.start()
+
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    lst.settimeout(0.25)
+    port = lst.getsockname()[1]
+    # 3. publishing the endpoint IS the ready signal: the server (and
+    #    its one compiled decode signature, when warmup is on) exists
+    #    before the fleet can route a stream here
+    _write_endpoint(args.endpoint_file, {
+        "port": port, "pid": os.getpid(), "rank": args.rank,
+        "version": args.version,
+        "incarnation": core_health.incarnation()})
+    print(f"genreplica rank={args.rank} version={args.version} "
+          f"serving on 127.0.0.1:{port}", flush=True)
+
+    try:
+        while not core_health.drain_requested():
+            core_health.beat()
+            try:
+                conn, _ = lst.accept()
+            except socket.timeout:
+                continue
+            try:
+                _serve_conn(conn, srv, args, core_chaos, core_health)
+            except _DrainRequested:
+                break
+    finally:
+        lst.close()
+    # graceful drain: finish every accepted stream (or fail it typed),
+    # then prove the token/page ledgers balance — a replica that leaks
+    # a stream or a KV page exits 3 and the fleet treats it as failed
+    report = srv.drain()
+    print(f"genreplica rank={args.rank} drained: "
+          f"{json.dumps({k: v for k, v in report.items() if k != 'prefill_compile_counts'})}",
+          flush=True)
+    clean = (report["unaccounted"] == 0
+             and report.get("kv_pages_owed", 0) == 0)
+    return 0 if clean else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

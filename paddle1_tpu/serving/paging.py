@@ -33,11 +33,11 @@ only when the pool is genuinely out of pages.
 from __future__ import annotations
 
 import collections
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .errors import KVPoolExhausted
+from .errors import KVPageAccountingError, KVPoolExhausted
 
 __all__ = ["PagePool", "PARKING_PAGE"]
 
@@ -123,17 +123,64 @@ class PagePool:
 
     def release(self, pages) -> None:
         """Drop one ref per page; pages reaching zero return to the
-        free list."""
+        free list. A release of an already-free page raises typed
+        BEFORE mutating anything — appending a page to the free list
+        twice would hand it to two holders and silently cross-write
+        their KV, which is strictly worse than failing the release."""
         for p in pages:
             if p == PARKING_PAGE:
                 continue
+            if self._refs[p] <= 0:
+                raise KVPageAccountingError(
+                    f"KV page {p} over-released (refcount already "
+                    f"{int(self._refs[p])}) — slot/registry accounting "
+                    "bug; free list left untouched")
             self._refs[p] -= 1
-            if self._refs[p] < 0:
-                raise AssertionError(
-                    f"KV page {p} over-released (refcount went "
-                    "negative) — slot/registry accounting bug")
             if self._refs[p] == 0:
                 self._free.append(p)
+
+    def check_invariants(self, holders: Sequence[Sequence[int]] = ()
+                         ) -> None:
+        """Debug invariant sweep (``FLAGS_debug_kv_refcount``): the sum
+        of refcounts must equal the refs actually held by the prefix
+        registry plus every external holder chain in ``holders`` (the
+        engine passes its live slots' page chains; the scheduler adds
+        any chaos-held pages), the free list must be duplicate-free and
+        exactly the zero-refcount pages, and the parking page must
+        never be tracked. Raises typed KVPageAccountingError."""
+        expected = np.zeros(self.num_pages, np.int64)
+        for ids in self._registry.values():
+            for p in ids:
+                expected[p] += 1
+        for chain in holders:
+            for p in chain:
+                if p == PARKING_PAGE:
+                    continue
+                expected[p] += 1
+        free = list(self._free)
+        if len(free) != len(set(free)):
+            raise KVPageAccountingError(
+                "KV free list holds duplicate pages: "
+                f"{sorted(p for p in set(free) if free.count(p) > 1)}")
+        if PARKING_PAGE in set(free) or self._refs[PARKING_PAGE] != 0:
+            raise KVPageAccountingError(
+                "parking page leaked into the free list / refcounts")
+        free_set = set(free)
+        for p in range(1, self.num_pages):
+            if int(self._refs[p]) != int(expected[p]):
+                raise KVPageAccountingError(
+                    f"KV page {p} refcount {int(self._refs[p])} != "
+                    f"{int(expected[p])} refs held by registry+holders")
+            if (p in free_set) != (self._refs[p] == 0):
+                raise KVPageAccountingError(
+                    f"KV page {p} refcount {int(self._refs[p])} "
+                    f"disagrees with free list membership "
+                    f"({'free' if p in free_set else 'not free'})")
+        # derived identity the drain report leans on
+        if self.pages_in_use != (self.num_pages - 1) - len(free):
+            raise KVPageAccountingError(
+                f"pages_in_use {self.pages_in_use} != usable - free "
+                f"{(self.num_pages - 1) - len(free)}")
 
     # -- prefix sharing -----------------------------------------------------
 

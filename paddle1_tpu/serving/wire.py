@@ -25,6 +25,20 @@ With NO ``idle`` hook a socket timeout propagates (``socket.timeout``
 is an ``OSError``): the socket's own timeout is then the caller's read
 deadline — the fleet's connect handshake relies on this to bound a
 ping against a replica that accepted the connection but never answers.
+
+Streaming extension (the GenerationFleet's token plane): long-lived
+token streams ride the SAME framed protocol as header-only frames —
+:func:`send_stream_tokens` carries ``{kind: "tokens", id, seq, toks}``
+where ``seq`` is the MONOTONE absolute index (from 0) of ``toks[0]``
+within its stream, and :func:`send_stream_end` closes a stream with
+its finish reason and total count. The sequence number is the
+exactly-once contract: the fleet accepts a token iff its seq equals
+the count already received, drops duplicates (< — a failover replay or
+retire-migration race re-sending what the client has), and treats a
+gap (>) as a desynced replica to fail over from. Because ``recv_msg``
+is restartable across socket timeouts, a quiet stream never
+desynchronizes the frame plane — stream frames interleave freely with
+pong/metrics replies on one connection.
 """
 
 from __future__ import annotations
@@ -39,7 +53,8 @@ import numpy as np
 
 from ..core.locks import note_blocking
 
-__all__ = ["send_msg", "recv_msg"]
+__all__ = ["send_msg", "recv_msg", "send_stream_tokens",
+           "send_stream_end", "STREAM_TOKENS", "STREAM_END"]
 
 _U32 = struct.Struct("<I")
 # a header is a small JSON dict; anything bigger is a desynced stream,
@@ -71,6 +86,36 @@ def send_msg(sock: socket.socket, header: Dict[str, object],
         out += _U32.pack(len(b))
         out += b
     sock.sendall(bytes(out))
+
+
+STREAM_TOKENS = "tokens"
+STREAM_END = "stream_end"
+
+
+def send_stream_tokens(sock: socket.socket, stream_id: int, seq: int,
+                       toks: Sequence[int]) -> None:
+    """One per-token stream frame: ``toks[i]`` is token ``seq + i`` of
+    stream ``stream_id`` (seq = absolute monotone index from 0, the
+    receiver's exactly-once dedup key). Header-only — token ids are
+    small ints, so JSON beats an npy blob here. Same caller-holds-the-
+    send-lock contract as :func:`send_msg`."""
+    send_msg(sock, {"kind": STREAM_TOKENS, "id": int(stream_id),
+                    "seq": int(seq),
+                    "toks": [int(t) for t in toks]})
+
+
+def send_stream_end(sock: socket.socket, stream_id: int, n: int,
+                    reason: str, etype: Optional[str] = None,
+                    msg: str = "") -> None:
+    """Close stream ``stream_id``: ``n`` = total tokens emitted (the
+    receiver cross-checks it against its own count), ``reason`` = the
+    TokenStream finish reason, ``etype``/``msg`` carry the typed error
+    for non-clean reasons. The count rides as ``"count"`` — ``"n"`` is
+    the frame protocol's array-count slot and :func:`send_msg` owns it.
+    Same send-lock contract as :func:`send_msg`."""
+    send_msg(sock, {"kind": STREAM_END, "id": int(stream_id),
+                    "count": int(n), "reason": str(reason),
+                    "etype": etype, "msg": str(msg)})
 
 
 def _recv_exact(sock: socket.socket, n: int, idle=None) -> bytes:

@@ -28,8 +28,9 @@ from paddle1_tpu.core import chaos, health
 from paddle1_tpu.core.errors import InvalidArgumentError
 from paddle1_tpu.core.flags import flags_guard
 from paddle1_tpu.serving import (PARKING_PAGE, CausalLM, GenerationEngine,
-                                 GenerationServer, KVPoolExhausted,
-                                 NGramSpeculator, PagePool, SlotWedged)
+                                 GenerationServer, KVPageAccountingError,
+                                 KVPoolExhausted, NGramSpeculator,
+                                 PagePool, SlotWedged)
 from paddle1_tpu.serving.speculate import DraftModelSpeculator
 
 VOCAB, MAX_SEQ, SLOTS, PS = 32, 64, 4, 8
@@ -98,11 +99,18 @@ class TestPagePool:
         assert pool.pages_in_use == 0 and pool.free_pages == 4
 
     def test_over_release_is_an_accounting_bug(self):
+        # the double-release guard raises TYPED, and BEFORE mutating:
+        # a page appended to the free list twice would be handed to two
+        # holders and silently cross-write their KV
         pool = PagePool(3, PS)
         [p] = pool.alloc(1)
         pool.release([p])
-        with pytest.raises(AssertionError, match="over-released"):
+        with pytest.raises(KVPageAccountingError, match="over-released"):
             pool.release([p])
+        # the failed release corrupted nothing: the free list still
+        # holds the page exactly once and the invariants all pass
+        assert pool.free_pages == 2 and pool.pages_in_use == 0
+        pool.check_invariants()
 
     def test_prefix_registry_hit_and_refs(self):
         pool = PagePool(8, 4, prefix_entries=4)
@@ -128,6 +136,101 @@ class TestPagePool:
     def test_needs_room_for_parking(self):
         with pytest.raises(ValueError, match="parking"):
             PagePool(1, PS)
+
+
+class TestInvariantChecker:
+    """``check_invariants`` (FLAGS_debug_kv_refcount's engine): the
+    refcount ledger must equal registry + holder chains exactly, and
+    every way it can lie raises typed."""
+
+    def test_clean_pool_passes(self):
+        pool = PagePool(8, 4, prefix_entries=4)
+        pool.check_invariants()
+        chain = pool.alloc(3)
+        prompt = np.arange(9, dtype=np.int32)
+        pool.register_prefix(prompt, chain)
+        pool.check_invariants(holders=[chain])
+        pool.release(chain)                 # slot's refs gone
+        pool.check_invariants()             # registry still holds 1..2
+
+    def test_unreported_holder_raises(self):
+        # pages held by a slot the caller didn't report = the ledger
+        # and reality disagree — typed, with the page named
+        pool = PagePool(6, 4)
+        chain = pool.alloc(2)
+        with pytest.raises(KVPageAccountingError, match="refcount"):
+            pool.check_invariants()         # holders omitted
+        pool.check_invariants(holders=[chain])
+
+    def test_corrupt_free_list_raises(self):
+        pool = PagePool(6, 4)
+        pool.alloc(2)
+        pool._free.append(pool._free[0])    # simulate a double-free
+        with pytest.raises(KVPageAccountingError, match="duplicate"):
+            pool.check_invariants()
+
+    def test_parking_page_leak_raises(self):
+        pool = PagePool(6, 4)
+        pool._free.append(PARKING_PAGE)
+        with pytest.raises(KVPageAccountingError, match="parking"):
+            pool.check_invariants()
+
+
+class TestCOWRegistryLifecycle:
+    """Eviction vs live holders — the copy-on-write registry's whole
+    lifecycle matrix: an entry evicted while its pages are SHARED must
+    keep them alive for the current holders, and only the LAST release
+    returns them to the free list."""
+
+    def test_evicted_while_shared_keeps_pages_for_holders(self):
+        pool = PagePool(8, 4, prefix_entries=2)
+        prompt = np.arange(8, dtype=np.int32)      # 2 full pages
+        chain = pool.alloc(2)
+        pool.register_prefix(prompt, chain)
+        # a second "request" comes in over the same prefix
+        held = pool.lookup_prefix(prompt)
+        assert held == chain
+        # evict everything the registry holds (pressure simulation)
+        while pool._evict_one():
+            pass
+        assert pool.stats()["prefix_entries"] == 0
+        # the holder's pages survived the eviction: refcounts are the
+        # holder chains only (original alloc + lookup retain)
+        for p in chain:
+            assert pool.refcount(p) == 2
+        pool.check_invariants(holders=[chain, held])
+        # a NEW lookup misses (the registry forgot the prefix)...
+        assert pool.lookup_prefix(prompt) == []
+        # ...but the live streams keep decoding on their pages
+        pool.release(held)
+        for p in chain:
+            assert pool.refcount(p) == 1           # still alive
+        assert pool.free_pages == 5
+        pool.release(chain)                        # LAST holder out
+        assert pool.free_pages == 7                # only now reaped
+        pool.check_invariants()
+
+    def test_release_order_is_irrelevant(self):
+        # same matrix, releases interleaved the other way round:
+        # registry evicts LAST, after both holders released
+        pool = PagePool(8, 4, prefix_entries=2)
+        prompt = np.arange(8, dtype=np.int32)
+        chain = pool.alloc(2)
+        pool.register_prefix(prompt, chain)
+        held = pool.lookup_prefix(prompt)
+        pool.release(chain)
+        pool.release(held)
+        # only the registry holds the pages now — they are CACHED, not
+        # free, and a hit revives them without allocation
+        assert pool.free_pages == 5
+        assert pool.stats()["pages_cached"] == 2
+        revived = pool.lookup_prefix(prompt)
+        assert revived == chain
+        pool.release(revived)
+        while pool._evict_one():
+            pass
+        assert pool.free_pages == 7                # reaped on last ref
+        pool.check_invariants()
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +452,52 @@ class TestPageLifecycle:
         assert t0 is not None and t1 is not None
         eng.release(0)
         eng.release(1)
+
+    def test_prefill_failure_releases_shared_prefix_refs(self, lm):
+        # exception-path audit: _alloc_prefill_pages retains shared
+        # prefix pages BEFORE allocating private ones — when the
+        # private alloc raises, the retained refs must be handed back
+        # (exactly what was taken), or the prefix pages leak a ref per
+        # failed admission forever
+        eng = GenerationEngine(lm, slots=2, max_seq=MAX_SEQ,
+                               prefill_buckets=(8, 40), paged=True,
+                               page_size=PS, pages=4, prefix_cache=4)
+        shared = (np.arange(PS) % VOCAB).astype(np.int32)  # 1 full page
+        eng.prefill(0, shared, 0.0, 0, 1)
+        eng.release(0)                     # page survives in registry
+        assert eng.pool.stats()["pages_cached"] == 1
+        # same prefix + a long tail: hits the cached page (one ref
+        # RETAINED for the slot), then needs 3 private pages from a
+        # pool with 2 free — the private alloc raises, and the retained
+        # prefix ref must be handed back
+        big = np.concatenate([shared,
+                              (np.arange(3 * PS) + 3) % VOCAB]
+                             ).astype(np.int32)
+        with pytest.raises(KVPoolExhausted):
+            eng.prefill(1, big, 0.0, 0, 2)
+        assert eng._slot_pages[1] == []    # nothing half-claimed
+        # every ref the failed admission took was released — a leaked
+        # retain would leave pages_in_use > 0 with no holder, which the
+        # invariant sweep (refcounts == registry + slot chains) catches
+        assert eng.pool.stats()["pages_in_use"] == 0
+        eng.check_kv_invariants()
+
+    def test_debug_refcount_asserted_every_scheduler_tick(self, lm):
+        # FLAGS_debug_kv_refcount: the scheduler sweeps the invariant
+        # checker after EVERY tick — admissions, releases, prefix hits
+        # and drains all run under it without tripping
+        with flags_guard(debug_kv_refcount=True):
+            eng = GenerationEngine(lm, slots=2, max_seq=MAX_SEQ,
+                                   prefill_buckets=(8,), paged=True,
+                                   page_size=PS, prefix_cache=4)
+            srv = GenerationServer(eng, queue_depth=16, token_budget=6)
+            srv.start()
+            streams = [srv.submit([1 + i % 3, 2, 3], max_new_tokens=6)
+                       for i in range(6)]
+            rep = srv.drain(timeout=120)
+        assert all(s.done() for s in streams)
+        assert rep["fatal"] is None        # a checker trip kills the loop
+        assert rep["unaccounted"] == 0 and rep["kv_pages_owed"] == 0
 
     def test_drain_under_load_owes_no_pages(self, lm):
         eng = GenerationEngine(lm, slots=SLOTS, max_seq=MAX_SEQ,

@@ -1,0 +1,659 @@
+"""Fault-tolerant generative serving (ISSUE 17): GenerationFleet with
+bit-identical mid-stream failover and KV-pressure preemption.
+
+The acceptance contracts pinned here:
+
+* the streaming wire contract is exactly-once: per-token frames carry a
+  monotone absolute sequence number, the client accepts a token iff it
+  is the next expected index, duplicates (failover replays re-sending
+  history) drop silently, and a gap means a desynced sender;
+* a stream resumed from ``prompt + tokens already emitted`` with the
+  same seed continues BIT-identically to the uninterrupted run —
+  greedy AND sampled (the RNG key schedule is a pure function of
+  (seed, token index), so the chain re-advances exactly);
+* a replica SIGKILLed mid-stream (chaos ``gen_replica_kill``) loses no
+  accepted stream: every in-flight stream fails over to a survivor and
+  finishes identical to a single-process reference, with zero
+  client-visible failures and ``unaccounted == 0`` at drain;
+* a WEDGED replica (token plane frozen, heartbeats still flowing —
+  chaos ``gen_replica_hang``) is caught by the fleet's stream-silence
+  deadline, not the supervisor's hang timeout, and its streams migrate;
+* KV pressure preempts lowest-priority streams (pages released the
+  same tick, stream parked) and re-admits them bit-identically instead
+  of surfacing :class:`KVPoolExhausted`;
+* a rolling deploy migrates live streams by replay (no retry budget
+  charged) and a failed canary rolls back with the old fleet intact;
+* :class:`TokenStream` resolves ``cancel()`` vs ``result()`` vs
+  mid-stream ``DeadlineExceeded`` first-wins — exactly one terminal
+  state, always consistent with the raised type (ISSUE 17 satellite).
+"""
+
+import os
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core import chaos, health
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.serving import (CausalLM, DeadlineExceeded, DeployFailed,
+                                 FleetStream, GenerationEngine,
+                                 GenerationFleet, GenerationServer,
+                                 ServerClosed, ServerOverloaded,
+                                 StreamCancelled, StreamFailed, TokenStream)
+
+VOCAB, MAX_SEQ, SLOTS, PS = 32, 64, 4, 8
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    health.reset()
+    chaos.reset()
+    yield
+    health.reset()
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    paddle.seed(7)
+    return CausalLM(vocab_size=VOCAB, d_model=16, nhead=2,
+                    dim_feedforward=32, num_layers=2, max_seq=MAX_SEQ)
+
+
+# ---------------------------------------------------------------------------
+# FleetStream: the exactly-once receive contract
+
+
+class TestFleetStreamContract:
+    def test_in_order_frames_accumulate(self):
+        st = FleetStream()
+        assert st._feed(0, [3, 1]) == "ok"
+        assert st._feed(2, [4]) == "ok"
+        assert st.tokens == [3, 1, 4]
+
+    def test_duplicate_frames_drop(self):
+        """A failover replay re-sending delivered history is a no-op."""
+        st = FleetStream()
+        assert st._feed(0, [3, 1]) == "ok"
+        assert st._feed(0, [3, 1]) == "dup"
+        assert st._feed(1, [1]) == "dup"
+        assert st.tokens == [3, 1]
+
+    def test_partial_overlap_appends_only_the_fresh_suffix(self):
+        """A frame straddling the delivered boundary contributes only
+        the unseen tail — token i is delivered exactly once."""
+        st = FleetStream()
+        assert st._feed(0, [3, 1]) == "ok"
+        assert st._feed(1, [1, 4, 5]) == "ok"
+        assert st.tokens == [3, 1, 4, 5]
+
+    def test_gap_means_desynced_sender(self):
+        st = FleetStream()
+        assert st._feed(0, [3]) == "ok"
+        assert st._feed(2, [9]) == "gap"
+        assert st.tokens == [3]  # the gap frame contributed nothing
+
+    def test_finish_is_first_wins(self):
+        st = FleetStream()
+        assert st._finish("eos") is True
+        assert st._finish("error", RuntimeError("late")) is False
+        assert st.finish_reason == "eos"
+        assert st.result() == []
+
+    def test_frames_after_finish_are_dups(self):
+        st = FleetStream()
+        st._feed(0, [3])
+        st._finish("length")
+        assert st._feed(1, [4]) == "dup"
+        assert st.result() == [3]
+
+    def test_typed_error_surfaces_after_buffered_tokens(self):
+        st = FleetStream()
+        st._feed(0, [3, 1])
+        st._finish("failed", StreamFailed("budget exhausted"))
+        got = []
+        with pytest.raises(StreamFailed):
+            for tok in st:
+                got.append(tok)
+        assert got == [3, 1]          # everything delivered first
+        assert st.tokens == [3, 1]    # partials stay readable
+
+    def test_cancelled_iteration_is_clean_stop(self):
+        st = FleetStream()
+        st._feed(0, [3])
+        st._finish("cancelled", StreamCancelled("x"))
+        assert list(st) == [3]        # no raise on iteration
+        with pytest.raises(StreamCancelled):
+            st.result()               # result() stays typed
+
+    def test_result_reader_deadline_keeps_stream_accounted(self):
+        st = FleetStream()
+        with pytest.raises(DeadlineExceeded, match="reader deadline"):
+            st.result(timeout=0.05)
+        assert not st.done()
+
+    def test_cancel_invokes_fleet_hook_once(self):
+        st = FleetStream()
+        calls = []
+        st._cancel_cb = calls.append
+        st.cancel()
+        st.cancel()
+        assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming wire frames
+
+
+class TestStreamWireFrames:
+    def test_stream_frames_round_trip(self):
+        """Token and end frames survive the framed protocol intact —
+        including the end frame's token COUNT, which must not collide
+        with the frame header's array-count slot ``n`` (send_msg owns
+        ``n``; a clean 12-token close must not arrive as count=0 and
+        masquerade as a lost-frame failover)."""
+        import socket
+        from paddle1_tpu.serving import wire
+        a, b = socket.socketpair()
+        try:
+            wire.send_stream_tokens(a, 7, 3, [11, 12])
+            wire.send_stream_end(a, 7, 12, "length")
+            wire.send_stream_end(a, 8, 2, "error",
+                                 etype="KVPoolExhausted", msg="full")
+            h1, arrs = wire.recv_msg(b)
+            assert h1["kind"] == wire.STREAM_TOKENS
+            assert (h1["id"], h1["seq"], h1["toks"]) == (7, 3, [11, 12])
+            assert arrs == []
+            h2, _ = wire.recv_msg(b)
+            assert h2["kind"] == wire.STREAM_END
+            assert (h2["id"], h2["count"], h2["reason"]) == \
+                (7, 12, "length")
+            h3, _ = wire.recv_msg(b)
+            assert (h3["count"], h3["etype"], h3["msg"]) == \
+                (2, "KVPoolExhausted", "full")
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet admission (no subprocesses: the queue/seed/shed plane)
+
+
+class TestFleetAdmission:
+    def test_submit_before_start_is_closed(self):
+        fleet = GenerationFleet("x.py:make_model", replicas=2)
+        with pytest.raises(ServerClosed, match="not admitting"):
+            fleet.submit([1, 2, 3])
+
+    def test_zero_replicas_rejected(self):
+        with pytest.raises(InvalidArgumentError, match=">= 1 replica"):
+            GenerationFleet("x.py:make_model", replicas=0)
+
+    def test_seeds_are_minted_fleet_side(self):
+        """A submit without a seed still gets one pinned at admission:
+        failover replay is only bit-identical on the original seed, so
+        the fleet — which owns the replay — must own the seed too."""
+        fleet = GenerationFleet("x.py:make_model", replicas=1)
+        fleet._accepting = True   # admission plane only; no replicas
+        fleet.submit([1, 2, 3])
+        fleet.submit([1, 2, 3])
+        seeds = [r.seed for r in fleet._live.values()]
+        assert len(set(seeds)) == 2
+        assert all(isinstance(s, int) for s in seeds)
+
+    def test_queue_depth_sheds_typed(self):
+        fleet = GenerationFleet("x.py:make_model", replicas=1,
+                                queue_depth=1)
+        fleet._accepting = True
+        fleet.submit([1, 2, 3])
+        with pytest.raises(ServerOverloaded, match="stream shed"):
+            fleet.submit([4, 5, 6])
+        snap = fleet.metrics.snapshot()["counters"]
+        assert snap["gen_fleet_shed_total"] == 1
+
+    def test_invalid_args_are_typed(self):
+        fleet = GenerationFleet("x.py:make_model", replicas=1)
+        fleet._accepting = True
+        with pytest.raises(InvalidArgumentError, match=">= 1 prompt"):
+            fleet.submit([])
+        with pytest.raises(InvalidArgumentError, match="max_new_tokens"):
+            fleet.submit([1], max_new_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# resume replay parity: the mechanism failover/preemption both ride
+
+
+class TestResumeReplayParity:
+    """``submit(..., resume_tokens=emitted, seed=s)`` continues the
+    stream bit-identically from the next token index — the foundation
+    of mid-stream failover AND preempt/park re-admission."""
+
+    @pytest.mark.parametrize("temperature,top_k", [(0.0, 0), (0.9, 8)])
+    def test_resume_continues_bit_identical(self, lm, temperature,
+                                            top_k):
+        srv = GenerationServer(lm, slots=SLOTS, max_seq=MAX_SEQ,
+                               prefill_buckets=(8, 24)).start()
+        try:
+            prompt = [5, 9, 2, 7]
+            ref = srv.generate(prompt, max_new_tokens=12,
+                               temperature=temperature, top_k=top_k,
+                               seed=11)
+            assert len(ref) >= 2
+            for cut in (1, len(ref) // 2, len(ref) - 1):
+                st = srv.submit(prompt, max_new_tokens=12,
+                                temperature=temperature, top_k=top_k,
+                                seed=11, resume_tokens=ref[:cut])
+                assert st.result(timeout=60) == ref[cut:], cut
+        finally:
+            rep = srv.drain()
+        assert rep["unaccounted"] == 0
+
+    def test_resume_without_seed_is_typed(self, lm):
+        srv = GenerationServer(lm, slots=SLOTS, max_seq=MAX_SEQ,
+                               prefill_buckets=(8, 24)).start()
+        try:
+            with pytest.raises(InvalidArgumentError,
+                               match="original seed"):
+                srv.submit([1, 2], max_new_tokens=8,
+                           resume_tokens=[3])
+        finally:
+            srv.drain()
+
+
+# ---------------------------------------------------------------------------
+# KV-pressure preemption (in-process: chaos squats the page pool)
+
+
+class TestKVPressurePreemption:
+    def test_low_priority_parks_and_readmits_bit_identical(self, lm):
+        """Chaos claims every free page mid-decode; with preemption on,
+        the faulting low-priority stream parks (pages released) and
+        re-admits by replay — output identical to a pressure-free run,
+        KVPoolExhausted never client-visible."""
+        prompts = [[3, 1, 4, 1], [5, 9, 2, 6], [8, 2, 8, 1]]
+        seeds = [21, 22, 23]
+
+        def run(pressure):
+            chaos.reset()
+            if pressure:
+                chaos.configure("gen_page_pressure@3")
+            eng = GenerationEngine(lm, slots=SLOTS, max_seq=MAX_SEQ,
+                                   prefill_buckets=(8, 24), paged=True,
+                                   page_size=PS, pages=16,
+                                   prefix_cache=0)
+            srv = GenerationServer(eng, preempt=True).start()
+            try:
+                streams = [
+                    srv.submit(p, max_new_tokens=16,
+                               temperature=0.7, top_k=6, seed=s,
+                               priority=(0 if i == 0 else 2))
+                    for i, (p, s) in enumerate(zip(prompts, seeds))]
+                outs = [st.result(timeout=120) for st in streams]
+            finally:
+                rep = srv.drain()
+            assert rep["unaccounted"] == 0, rep
+            assert rep["kv_pages_owed"] == 0, rep
+            return outs, srv.metrics.snapshot()["counters"]
+
+        ref, _ = run(pressure=False)
+        got, counters = run(pressure=True)
+        assert got == ref
+        assert counters.get("gen_preemptions_total", 0) >= 1, counters
+        assert counters.get("gen_preempt_readmits_total", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: the generation-fleet injection points
+
+
+class TestGenFleetChaosPoints:
+    def test_spec_grammar(self):
+        chaos.configure("gen_replica_kill@3:1,gen_replica_hang@5,"
+                        "gen_page_pressure@2")
+        assert chaos.enabled()
+        with pytest.raises(ValueError, match="unknown chaos point"):
+            chaos.configure("gen_replica_explode@1")
+        with pytest.raises(ValueError, match="occurrence must be >= 1"):
+            chaos.configure("gen_replica_kill@0")
+
+    def test_frame_counter_and_rank_qualifier(self):
+        chaos.configure("gen_replica_kill@2:0,gen_replica_hang@3:1")
+        assert chaos.check_gen_replica(0) is None          # frame 1
+        assert chaos.check_gen_replica(0) == \
+            chaos.GEN_REPLICA_KILL                          # frame 2, rank 0
+        assert chaos.check_gen_replica(0) is None          # frame 3: rank 0
+        chaos.configure("gen_replica_hang@2")
+        chaos.check_gen_replica(5)
+        assert chaos.check_gen_replica(7) == \
+            chaos.GEN_REPLICA_HANG  # unqualified: any rank's Nth frame
+
+    def test_kill_outranks_hang_on_the_same_frame(self):
+        chaos.configure("gen_replica_kill@1,gen_replica_hang@1")
+        assert chaos.check_gen_replica(0) == chaos.GEN_REPLICA_KILL
+
+
+# ---------------------------------------------------------------------------
+# satellite: TokenStream cancel/result/deadline races resolve first-wins
+
+
+class TestTokenStreamRaces:
+    def test_finish_race_is_first_wins_and_consistent(self):
+        """Two racers slam terminal states onto one stream; exactly one
+        wins and ``result()`` raises the matching type — never a
+        mixed state (reason says cancelled, raise says deadline)."""
+        for _ in range(200):
+            st = TokenStream(8)
+            st._put(3)
+            barrier = threading.Barrier(3)
+
+            def deadline():
+                barrier.wait()
+                st._finish("deadline", DeadlineExceeded("racer"))
+
+            def cancel():
+                barrier.wait()
+                st.cancel()
+                st._finish("cancelled", StreamCancelled("racer"))
+
+            ts = [threading.Thread(target=deadline),
+                  threading.Thread(target=cancel)]
+            for t in ts:
+                t.start()
+            barrier.wait()
+            for t in ts:
+                t.join()
+            assert st.finish_reason in ("deadline", "cancelled")
+            expect = (DeadlineExceeded if st.finish_reason == "deadline"
+                      else StreamCancelled)
+            with pytest.raises(expect):
+                st.result()
+            assert st.tokens == [3]  # partials survive either outcome
+
+    def test_hammer_cancel_vs_result_vs_midstream_deadline(self, lm):
+        """8 rounds of live streams with racing readers/cancellers and
+        tight deadlines: every stream lands in exactly one terminal
+        state consistent with what its reader observed, and the server
+        ledger balances (nothing double-resolved, nothing leaked)."""
+        eng = GenerationEngine(lm, slots=SLOTS, max_seq=MAX_SEQ,
+                               prefill_buckets=(8, 24), paged=True,
+                               page_size=PS, pages=24)
+        srv = GenerationServer(eng).start()
+        outcomes = []
+
+        def read(st, slot_out):
+            try:
+                slot_out.append(("done", st.result(timeout=30)))
+            except BaseException as e:  # noqa: broad-except — recorded
+                slot_out.append(("exc", e))
+
+        try:
+            for rnd in range(8):
+                streams, results = [], []
+                for i in range(4):
+                    dl = 40.0 if i % 2 == 0 else None
+                    streams.append(srv.submit(
+                        [1 + rnd, 2 + i, 3, 4], max_new_tokens=24,
+                        temperature=0.5, top_k=4, seed=100 + rnd * 8 + i,
+                        deadline_ms=dl))
+                    results.append([])
+                readers = [threading.Thread(target=read, args=(st, out))
+                           for st, out in zip(streams, results)]
+                for t in readers:
+                    t.start()
+                time.sleep(0.005 * (rnd % 4))
+                for st in streams[2:]:
+                    st.cancel()
+                for t in readers:
+                    t.join()
+                for st, out in zip(streams, results):
+                    kind, val = out[0]
+                    outcomes.append(st.finish_reason)
+                    assert st.done()
+                    if kind == "done":
+                        assert st.finish_reason in ("eos", "length")
+                        assert val == st.tokens
+                    elif isinstance(val, StreamCancelled):
+                        assert st.finish_reason == "cancelled"
+                    elif isinstance(val, DeadlineExceeded):
+                        # mid-stream wall deadline (reader timeout was
+                        # generous, so it cannot be the reader's)
+                        assert st.finish_reason in ("deadline", "budget")
+                    else:  # pragma: no cover - unexpected type = fail
+                        raise AssertionError(repr(val))
+        finally:
+            rep = srv.drain()
+        assert rep["unaccounted"] == 0, (rep, outcomes)
+        assert rep["tokens_owed"] == 0
+        assert rep["kv_pages_owed"] == 0
+        assert rep["fatal"] is None
+
+
+# ---------------------------------------------------------------------------
+# slow: the replica-subprocess matrix
+
+
+FACTORY = textwrap.dedent("""\
+    def make_model(arg):
+        if arg == "boom":
+            raise RuntimeError("factory boom")
+        # SAME weights for every version tag: a hot-swap migration
+        # replays streams on the new replicas, and the continuation is
+        # only bit-identical when v2 serves the identical model
+        import paddle1_tpu as paddle
+        paddle.seed(0)
+        return paddle.serving.CausalLM(
+            vocab_size=32, d_model=16, nhead=2, dim_feedforward=32,
+            num_layers=2, max_seq=64)
+""")
+
+GEN_CONFIG = {"slots": 4, "max_seq": 64, "prefill_buckets": [8, 24],
+              "warmup": True}
+
+
+def _make_genfleet(tmp_path, n=2, chaos_spec=None, **kw):
+    factory = tmp_path / "factory.py"
+    factory.write_text(FACTORY)
+    kw.setdefault("version", "v1")
+    kw.setdefault("hang_timeout", 60.0)
+    kw.setdefault("poll_s", 0.1)
+    kw.setdefault("ready_timeout_s", 180.0)
+    kw.setdefault("stream_timeout_ms", 60000.0)
+    for k, v in GEN_CONFIG.items():
+        kw.setdefault(k, v)
+    env = kw.pop("env", {})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return GenerationFleet(f"{factory}:make_model", replicas=n, env=env,
+                           work_dir=str(tmp_path / "genfleet"),
+                           chaos_spec=chaos_spec, **kw)
+
+
+def _reference(specs):
+    """Uninterrupted single-process tokens for the FACTORY model."""
+    paddle.seed(0)
+    lm = CausalLM(vocab_size=32, d_model=16, nhead=2,
+                  dim_feedforward=32, num_layers=2, max_seq=64)
+    srv = GenerationServer(lm, slots=4, max_seq=64,
+                           prefill_buckets=(8, 24)).start()
+    try:
+        return [srv.generate(s["prompt"],
+                             max_new_tokens=s["max_new"],
+                             temperature=s.get("temperature", 0.0),
+                             top_k=s.get("top_k", 0),
+                             seed=s["seed"])
+                for s in specs]
+    finally:
+        srv.drain()
+
+
+def _specs(n, max_new=12):
+    """Half greedy, half sampled — failover parity must hold for both."""
+    out = []
+    for i in range(n):
+        s = {"prompt": [2 + i, 7, 1 + (i % 3), 9], "max_new": max_new,
+             "seed": 50 + i}
+        if i % 2:
+            s.update(temperature=0.8, top_k=8)
+        out.append(s)
+    return out
+
+
+@pytest.mark.slow
+class TestGenFleetSubprocessMatrix:
+    def test_kill_mid_stream_failover_bit_identical(self, tmp_path):
+        """SIGKILL replicas on their 10th token frame: every accepted
+        stream fails over and completes IDENTICAL to the uninterrupted
+        reference — greedy and sampled — with zero client-visible
+        failures and a balanced ledger. (72 frames over 3 replicas:
+        the pigeonhole guarantees at least one kill fires mid-stream;
+        restarted lives replay chaos-free.)"""
+        specs = _specs(6)
+        ref = _reference(specs)
+        fleet = _make_genfleet(tmp_path, n=3, retry_max=5,
+                               streams_per_replica=2,
+                               chaos_spec="gen_replica_kill@10")
+        fleet.start()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature", 0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"])
+                       for s in specs]
+            outs = [st.result(timeout=300) for st in streams]
+        finally:
+            rep = fleet.drain()
+        assert outs == ref
+        assert rep["unaccounted"] == 0, rep
+        assert rep["completed"] == len(specs)
+        assert rep["errors"] == 0 and rep["stream_failed"] == 0
+        assert rep["failovers"] >= 1, rep
+        assert rep["replica_restarts"] >= 1, rep
+        # one compiled decode signature per replica process, across
+        # failover replays (resume prefill rides the prompt buckets)
+        for rank, info in rep["replicas"].items():
+            assert info["decode_compiles"] <= 1, rep["replicas"]
+
+    def test_wedged_stream_caught_by_silence_deadline(self, tmp_path):
+        """gen_replica_hang freezes the token plane while heartbeats
+        keep flowing: only the fleet's wedged-stream transport deadline
+        can catch it. The wedged rank restarts and its streams finish
+        bit-identically elsewhere."""
+        specs = _specs(4)
+        ref = _reference(specs)
+        fleet = _make_genfleet(tmp_path, n=2, retry_max=5,
+                               streams_per_replica=2,
+                               stream_timeout_ms=3000.0,
+                               chaos_spec="gen_replica_hang@8")
+        fleet.start()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature", 0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"])
+                       for s in specs]
+            outs = [st.result(timeout=300) for st in streams]
+        finally:
+            rep = fleet.drain()
+            snap = fleet.metrics.snapshot()["counters"]
+        assert outs == ref
+        assert rep["unaccounted"] == 0, rep
+        assert rep["errors"] == 0 and rep["stream_failed"] == 0
+        assert snap.get("gen_fleet_replica_wedged_total", 0) >= 1, snap
+        assert rep["replica_restarts"] >= 1, rep
+
+    def test_preempt_readmit_under_page_pressure(self, tmp_path):
+        """A tight page pool + concurrent mixed-priority streams: the
+        replica preempts/parks instead of failing, and every stream —
+        preempted included — finishes identical to a roomy
+        single-process run. KVPoolExhausted is unreachable for admitted
+        streams."""
+        specs = _specs(4, max_new=16)
+        ref = _reference(specs)  # roomy: no paging pressure at all
+        # pages=12 → 11 usable: warm-up's max_seq-bucket prefill needs
+        # ceil(63/8)=8 pages (must fit), but 4 concurrent 20-token
+        # streams want 4*3=12 — admission pressure is guaranteed
+        fleet = _make_genfleet(tmp_path, n=1, paged=True, page_size=8,
+                               pages=12, prefix_cache=0, preempt=True,
+                               streams_per_replica=4)
+        fleet.start()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature", 0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"],
+                                    priority=i % 3)
+                       for i, s in enumerate(specs)]
+            outs = [st.result(timeout=300) for st in streams]
+        finally:
+            rep = fleet.drain()
+        assert outs == ref
+        assert rep["unaccounted"] == 0, rep
+        assert rep["errors"] == 0 and rep["stream_failed"] == 0
+        info = rep["replicas"].get(0)
+        if info is not None and info.get("pool"):
+            assert info["pool"]["pages_in_use"] == 0, info
+
+    def test_hot_swap_migrates_live_streams_bit_identical(self,
+                                                          tmp_path):
+        """deploy() under live streams: each retiring replica's
+        in-flight streams migrate by replay onto the new version (same
+        weights) and finish bit-identically; no retry budget charged,
+        zero drops. Decode is chaos-slowed so streams straddle the
+        swap."""
+        specs = _specs(4, max_new=48)
+        ref = _reference(specs)
+        slow = ",".join(f"gen_slow_step@{i}" for i in range(1, 600))
+        fleet = _make_genfleet(
+            tmp_path, n=2, streams_per_replica=2, chaos_spec=slow,
+            env={"JAX_PLATFORMS": "cpu",
+                 "FLAGS_serve_chaos_slow_s": "0.4"})
+        fleet.start()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature", 0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"])
+                       for s in specs]
+            time.sleep(1.0)  # let the streams start emitting
+            out = fleet.deploy(fleet.model_spec, "v2",
+                               canary_prompt=[1, 2, 3])
+            assert out["rolled"] == 2
+            outs = [st.result(timeout=300) for st in streams]
+        finally:
+            rep = fleet.drain()
+        assert outs == ref
+        assert fleet.version == "v2"
+        assert rep["unaccounted"] == 0, rep
+        assert rep["errors"] == 0 and rep["stream_failed"] == 0
+        assert rep["migrations"] >= 1, rep
+        assert rep["deploys"] == 1
+        for info in rep["replicas"].values():
+            assert info["version"] == "v2", rep["replicas"]
+
+    def test_failed_canary_rolls_back_with_fleet_intact(self, tmp_path):
+        fleet = _make_genfleet(tmp_path, n=1)
+        fleet.start()
+        try:
+            before = fleet.generate([4, 2, 1], max_new_tokens=6,
+                                    seed=9, timeout=120)
+            with pytest.raises(DeployFailed, match="never became"):
+                fleet.deploy(fleet.model_spec, "v2", model_arg="boom",
+                             ready_timeout_s=25.0)
+            assert fleet.version == "v1"
+            after = fleet.generate([4, 2, 1], max_new_tokens=6,
+                                   seed=9, timeout=120)
+            assert after == before  # the old fleet kept serving
+        finally:
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        snap = fleet.metrics.snapshot()["counters"]
+        assert snap.get("gen_fleet_rollbacks_total", 0) == 1

@@ -202,6 +202,53 @@ class TestExpositionConformance:
                     if n == "p1t_serving_gen_spec_accept_ratio")
         assert line.endswith(" 0.75")
 
+    def test_generation_fleet_families(self):
+        # ISSUE 17: the GenerationFleet's reliability metric families
+        # (failover / preemption / deploy plane) must render as
+        # conformant exposition exactly as the fleet emits them —
+        # counters _total, gauges bare, the stream-latency histogram
+        # unit-suffixed
+        m = obs.MetricsRegistry()
+        for c in ("gen_fleet_streams_total",
+                  "gen_fleet_streams_completed_total",
+                  "gen_fleet_tokens_total",
+                  "gen_fleet_dup_tokens_total",
+                  "gen_fleet_failovers_total",
+                  "gen_fleet_retries_total",
+                  "gen_fleet_migrations_total",
+                  "gen_fleet_shed_total",
+                  "gen_fleet_cancelled_total",
+                  "gen_fleet_deadline_expired_total",
+                  "gen_fleet_errors_total",
+                  "gen_fleet_stream_failed_total",
+                  "gen_fleet_pressure_deferrals_total",
+                  "gen_fleet_replica_restarts_total",
+                  "gen_fleet_replica_wedged_total",
+                  "gen_fleet_replica_exhausted_total",
+                  "gen_fleet_deploys_total",
+                  "gen_fleet_rollbacks_total"):
+            m.counter(c).inc()
+        m.gauge("gen_fleet_streams_active").set(3)
+        m.gauge("gen_fleet_replicas_ready").set(2)
+        m.gauge("gen_fleet_kv_pages_free").set(9)
+        m.histogram("gen_fleet_stream_ms").observe(120.0)
+        types, _ = parse_exposition(m.render_text())
+        for fam, kind in {
+                "gen_fleet_streams_active": "gauge",
+                "gen_fleet_replicas_ready": "gauge",
+                "gen_fleet_kv_pages_free": "gauge",
+                "gen_fleet_failovers_total": "counter",
+                "gen_fleet_dup_tokens_total": "counter",
+                # histograms render as quantile summaries (the
+                # registry's exposition choice, see render_text)
+                "gen_fleet_stream_ms": "summary"}.items():
+            assert types[f"p1t_serving_{fam}"] == kind, fam
+        # the dedup plane's counters must be distinct families: a
+        # failover that re-sends tokens increments dup_tokens, never
+        # tokens — dashboards difference them for exactly-once audit
+        assert "p1t_serving_gen_fleet_tokens_total" in types
+        assert "p1t_serving_gen_fleet_dup_tokens_total" in types
+
     def test_composite_fleet_style_page(self):
         # a typed page followed by labeled group pages — the fleet's
         # /metrics composition — must still parse with unique TYPEs
