@@ -760,6 +760,35 @@ def _define_builtin_flags() -> None:
                 "headroom so admitted streams' decode growth preempts "
                 "or parks instead of ever seeing KVPoolExhausted.",
                 validator=lambda v: 0 < v <= 1)
+    # Autoscaling + traffic simulation (consumed by
+    # paddle1_tpu.serving.autoscale / .traffic and bench.py --traffic
+    # — ISSUE 18 closes the control loop the obs_slos sensor feeds)
+    define_flag("serve_autoscale", "",
+                "Declarative scaling policy for serving.Autoscaler "
+                "(parse_policy grammar, ';'-separated): 'min=2;max=8;"
+                "queue_hi=0.75;queue_lo=0.2;burn_hi=1.0;burn_lo=0.5;"
+                "occ_hi=0.9;occ_lo=0.3;kv_free_min=0;step=1;"
+                "cooldown=10;dwell=30;backoff=20;interval=1'. "
+                "queue_* bound the admission queue-depth EWMA ratio, "
+                "burn_* the worst obs_slos burn rate, occ_* stream-"
+                "slot occupancy, kv_free_min the free-KV-page floor "
+                "(generative fleets). Scale-out above the _hi bounds, "
+                "scale-in only below the _lo bounds after 'dwell' "
+                "calm seconds; refused transitions back off 'backoff' "
+                "seconds typed. Empty = policy defaults (the loop "
+                "still only runs when an Autoscaler is constructed — "
+                "no Autoscaler, structurally zero cost).")
+    define_flag("serve_traffic", "",
+                "Production-day traffic model for serving.traffic "
+                "(parse_traffic grammar, ';'-separated): 'rps=40;"
+                "dur=30;diurnal=0.3;flash=10x@12+6;tail=1.5;"
+                "len=8:512;prio=0:0.7,1:0.2,2:0.1;deadline=250;"
+                "seed=7'. Open-loop arrivals (offered load never "
+                "slows for a saturated fleet): diurnal sinusoid, "
+                "multiplicative flash crowds, Pareto payload-length "
+                "tail, weighted priority classes. Empty = model "
+                "defaults; bench.py --traffic composes this with "
+                "chaos_* points for the autoscaler acceptance run.")
     define_flag("debug_kv_refcount", False,
                 "KV page-accounting invariant checker: after every "
                 "scheduler tick the PagePool verifies sum-of-refcounts "

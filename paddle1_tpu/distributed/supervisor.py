@@ -149,6 +149,31 @@ class SupervisionEvent:
         return self.failure.rank
 
 
+# ResizeRefused reasons: the two limits elasticity can hit. An
+# autoscaler backs off differently per reason — below_floor means the
+# request itself was out of policy (clamp and move on), budget
+# exhausted means the WORLD is out of membership churn (stop asking).
+RESIZE_BELOW_FLOOR = "below_floor"
+RESIZE_BUDGET_EXHAUSTED = "budget_exhausted"
+
+
+@dataclass(frozen=True)
+class ResizeRefused:
+    """Typed refusal from :meth:`Supervisor.request_resize` (and the
+    non-strict ``_resize`` path): which limit was hit, what was asked,
+    and where the limit sits — enough for a caller to back off
+    correctly instead of re-parsing stderr."""
+    reason: str                    # RESIZE_BELOW_FLOOR | RESIZE_BUDGET_EXHAUSTED
+    requested: int                 # the world size that was refused
+    limit: int                     # the floor / budget that refused it
+    detail: str = ""
+
+    def __str__(self) -> str:
+        return (f"resize to {self.requested} refused "
+                f"({self.reason}, limit {self.limit})"
+                + (f": {self.detail}" if self.detail else ""))
+
+
 @dataclass
 class SupervisorReport:
     """What the supervision loop actually did — the counters the elastic
@@ -163,6 +188,9 @@ class SupervisorReport:
     exit_code: Optional[int] = None
     # elastic membership changes: [{"from", "to", "reason"}] in order
     resizes: List[Dict[str, Any]] = field(default_factory=list)
+    # refused membership changes, same order discipline:
+    # [{"requested", "reason", "limit"}]
+    resize_refusals: List[Dict[str, Any]] = field(default_factory=list)
     world_size: Optional[int] = None  # current logical world
     # the CollectiveDivergenceError message when the sweep-time
     # cross-rank verifier caught a diverging schedule (ISSUE 14)
@@ -183,6 +211,8 @@ class SupervisorReport:
                 "stack_dumps": list(self.stack_dumps),
                 "drained": self.drained,
                 "resizes": [dict(r) for r in self.resizes],
+                "resize_refusals": [dict(r)
+                                    for r in self.resize_refusals],
                 "world_size": self.world_size,
                 "collective_divergence": self.collective_divergence,
                 "exit_code": self.exit_code}
@@ -952,17 +982,69 @@ class Supervisor:
         return self.policy == "restart" and \
             (self.world_size or 0) > 1 and len(self._elastic_workers()) > 1
 
+    def _check_resize(self, new_world: int) -> Optional[ResizeRefused]:
+        """The two polite-refusal limits, as a typed result (shared by
+        the synchronous :meth:`request_resize` pre-check and the
+        sweep-time non-strict ``_resize`` path so the reasons can
+        never drift apart)."""
+        floor = max(1, self.min_world)
+        if new_world < floor:
+            return ResizeRefused(
+                reason=RESIZE_BELOW_FLOOR, requested=new_world,
+                limit=floor,
+                detail="raise ft_elastic_min_world or ask for more")
+        if len(self.report.resizes) >= self.max_resizes:
+            return ResizeRefused(
+                reason=RESIZE_BUDGET_EXHAUSTED, requested=new_world,
+                limit=self.max_resizes,
+                detail="membership-churn budget ft_max_resizes spent")
+        return None
+
+    def _record_refusal(self, refusal: ResizeRefused) -> None:
+        """Count + journal one typed refusal (both refusal surfaces)."""
+        self.report.resize_refusals.append(
+            {"requested": refusal.requested, "reason": refusal.reason,
+             "limit": refusal.limit})
+        from ..obs import events as obs_events
+        from ..obs import registry as obs_registry
+        obs_registry.process_registry().counter(
+            "ft_resize_refusals_total").inc()
+        obs_registry.process_registry().counter(
+            f"ft_resize_refused_{refusal.reason}_total").inc()
+        obs_events.emit("resize_refused", requested=refusal.requested,
+                        reason=refusal.reason, limit=refusal.limit)
+
     def request_resize(self, new_world: int, reason: str = "requested"
-                       ) -> None:
+                       ) -> Optional[ResizeRefused]:
         """Ask the supervision loop to resize the world at its next
         sweep (thread-safe: callable from another thread, e.g. a
         cluster-capacity watcher that just got preemption notices or
-        freed machines back). Growth and shrink both route through the
-        same drain → recompute-mesh → reshard → relaunch path."""
+        freed machines back, or an :class:`serving.Autoscaler`).
+        Growth and shrink both route through the same drain →
+        recompute-mesh → reshard → relaunch path.
+
+        Returns ``None`` when the request was accepted for the next
+        sweep, or a typed :class:`ResizeRefused` when it is already
+        known to be refusable (below the world floor, or the resize
+        budget is spent) — counted in ``ft_resize_refusals_total`` and
+        journaled, so a scaling controller can distinguish "asked for
+        too little" from "the world is out of churn budget" and back
+        off instead of flapping. A request that passes the pre-check
+        can still be refused at sweep time if the budget is consumed
+        by a failure-driven resize in between (same typed accounting)."""
         if int(new_world) < 1:
             raise InvalidArgumentError(
                 f"cannot resize to world size {new_world}")
+        if self.world_size is not None \
+                and int(new_world) == self.world_size:
+            return None  # no-op request: never refusable, never queued
+        refusal = self._check_resize(int(new_world))
+        if refusal is not None:
+            print(f"supervisor: {refusal}", file=sys.stderr)
+            self._record_refusal(refusal)
+            return refusal
         self._resize_request = (int(new_world), reason)
+        return None
 
     def _record_failure(self, w: _Worker, f: WorkerFailure) -> None:
         """Bookkeeping common to policy handling and resize routing:
@@ -1026,21 +1108,13 @@ class Supervisor:
         new_world = int(new_world)
         if new_world == old_world and not failed:
             return None  # no-op request
-        if new_world < max(1, self.min_world):
-            print(f"supervisor: resize to {new_world} is below the "
-                  f"world floor ({max(1, self.min_world)}) — "
+        refusal = self._check_resize(new_world)
+        if refusal is not None:
+            print(f"supervisor: {refusal} — "
                   + ("failing the pod" if strict else "request refused"),
                   file=sys.stderr)
             if not strict:
-                return None
-            self._terminate_all()
-            return fail_code
-        if len(self.report.resizes) >= self.max_resizes:
-            print(f"supervisor: resize budget exhausted "
-                  f"({self.max_resizes}) — "
-                  + ("failing the pod" if strict else "request refused"),
-                  file=sys.stderr)
-            if not strict:
+                self._record_refusal(refusal)
                 return None
             self._terminate_all()
             return fail_code

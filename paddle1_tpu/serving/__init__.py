@@ -59,12 +59,15 @@ Or straight from a deployed artifact::
     srv = pred.serve(warmup=True)
 """
 
+from .autoscale import (Autoscaler, ScalingPolicy, SupervisorTarget,
+                        parse_policy)
 from .batcher import Batcher, ServeFuture
 from .engine import InferenceEngine, resolve_buckets
 from .errors import (DeadlineExceeded, DeployFailed,
                      KVPageAccountingError, KVPoolExhausted,
-                     ReplicaFailed, ServerClosed, ServerOverloaded,
-                     SlotWedged, StreamCancelled, StreamFailed)
+                     ReplicaFailed, ScaleFailed, ServerClosed,
+                     ServerOverloaded, SlotWedged, StreamCancelled,
+                     StreamFailed)
 from .fleet import AdaptiveAdmission, FleetFuture, ServingFleet
 from .generate import (CausalLM, GenerationEngine, GenerationServer,
                        TokenStream)
@@ -74,6 +77,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsGroup,
 from .paging import PARKING_PAGE, PagePool
 from .server import Server
 from .speculate import DraftModelSpeculator, NGramSpeculator
+from .traffic import TrafficModel, parse_traffic
 
 __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
            "ServingMetrics", "Counter", "Gauge", "Histogram",
@@ -85,4 +89,6 @@ __all__ = ["InferenceEngine", "Batcher", "Server", "ServeFuture",
            "GenerationEngine", "GenerationServer", "TokenStream",
            "CausalLM", "resolve_buckets", "PagePool", "PARKING_PAGE",
            "GenerationFleet", "FleetStream", "NGramSpeculator",
-           "DraftModelSpeculator"]
+           "DraftModelSpeculator", "ScaleFailed", "Autoscaler",
+           "ScalingPolicy", "SupervisorTarget", "parse_policy",
+           "TrafficModel", "parse_traffic"]

@@ -1,0 +1,539 @@
+"""SLO-driven autoscaling: the actuator half of the control loop
+(ISSUE 18; ROADMAP "close the loop").
+
+PR 13 built the sensor layer — ``obs/slo.py`` burn-rate gauges, the
+admission queue-depth EWMA, slot-occupancy / KV-page gauges — and PR
+6/7/17 built every safe transition (supervised spawn/retire, elastic
+``request_resize``, zero-downtime ``scale_to``). This module wires
+sensor to actuator: an :class:`Autoscaler` evaluates those signals
+against a declarative :class:`ScalingPolicy` and drives a
+:class:`~paddle1_tpu.serving.fleet.ServingFleet`, a
+:class:`~paddle1_tpu.serving.genfleet.GenerationFleet`, or (through
+:class:`SupervisorTarget`) an elastic training world — through the
+EXISTING surfaces only, so every transition keeps their contracts:
+zero-downtime, ``unaccounted == 0``, bit-identical stream migration.
+
+Control discipline (the anti-flap toolkit):
+
+* **hysteresis bands** — scale-out above ``queue_hi``/``burn_hi``,
+  scale-in only below the separate ``queue_lo``/``burn_lo``; the gap
+  between them holds.
+* **cooldown** — at least ``cooldown`` seconds between transitions.
+* **scale-in dwell** — the calm condition must hold ``dwell`` seconds
+  continuously before capacity is released (a flash crowd's trough
+  must not shed the replicas the next spike needs).
+* **typed backoff** — a refused or wedged transition
+  (:class:`~paddle1_tpu.serving.errors.ScaleFailed`, a Supervisor
+  :class:`~paddle1_tpu.distributed.supervisor.ResizeRefused`) parks
+  the loop for ``backoff`` seconds with a typed journal record, then
+  re-evaluates. The loop itself never crashes on a failed transition.
+* **non-blocking actuation** — the background loop hands each
+  transition to a single-flight worker thread and KEEPS SENSING: a
+  replica spawn costs seconds (subprocess + jit warmup), and a loop
+  that blocks on it is blind exactly when the flash crowd needs it.
+  While a transition is in flight every tick resolves ``hold``
+  ("transition in flight") but the hysteresis/dwell clocks still
+  advance — calm observed while a scale-out spawns is valid evidence
+  (capacity only increases), so the scale-in dwell earned during the
+  spawn is not forfeited. Direct :meth:`Autoscaler.step` calls
+  actuate INLINE so tests and benches stay deterministic.
+
+Every decision emits a typed ``obs/events.py`` record
+(``autoscale_decision`` / ``autoscale_refused``) and the
+``autoscale_*`` metric families; decision latency lands in the
+``autoscale_decision_seconds`` histogram so the <1%-overhead
+acceptance gate is measurable, and with no Autoscaler constructed the
+cost is structurally zero (no thread, no families).
+
+For generative fleets, replica count IS the slot/page actuator:
+every ``GenerationFleet`` replica carries its own decode-slot and KV
+page pool (``serve_gen_slots`` / ``serve_gen_kv_pages``), so a
+scale-out adds aggregate slot+page capacity without recompiling any
+live replica's decode step (per-replica slot counts are baked into
+the compiled decode signature — resizing them live would retrace).
+
+Quickstart::
+
+    policy = parse_policy("min=2;max=8;queue_hi=0.8;queue_lo=0.2;"
+                          "burn_hi=1.0;cooldown=5;dwell=20")
+    slos = obs_slo.parse_slos("lat=p99(e2e_ms)<50")
+    scaler = Autoscaler(fleet, policy, slos=slos).start()
+    ...                       # traffic; the loop scales the fleet
+    scaler.stop()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core import flags as core_flags
+from ..core.errors import InvalidArgumentError
+from ..obs import events as obs_events
+from .errors import ScaleFailed
+
+__all__ = ["ScalingPolicy", "parse_policy", "Signals", "Decision",
+           "Autoscaler", "SupervisorTarget"]
+
+HOLD = "hold"
+SCALE_OUT = "scale_out"
+SCALE_IN = "scale_in"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalingPolicy:
+    """Declarative scaling targets — what the loop holds, not how.
+
+    Ratios are against capacity: ``queue_*`` bound the admission
+    queue-depth EWMA over the fleet queue depth, ``burn_*`` bound the
+    worst SLO burn-rate ratio (>1 = out of budget), ``occupancy_*``
+    bound stream-slot occupancy (generative fleets), ``kv_free_min``
+    is an absolute free-KV-page floor summed over live replicas (0
+    disables the signal)."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    queue_hi: float = 0.75
+    queue_lo: float = 0.20
+    burn_hi: float = 1.0
+    burn_lo: float = 0.5
+    occupancy_hi: float = 0.9
+    occupancy_lo: float = 0.3
+    kv_free_min: float = 0.0
+    step: int = 1
+    cooldown: float = 10.0
+    dwell: float = 30.0
+    backoff: float = 20.0
+    interval: float = 1.0
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise InvalidArgumentError(
+                f"need 1 <= min ({self.min_replicas}) <= max "
+                f"({self.max_replicas})")
+        for lo, hi, what in ((self.queue_lo, self.queue_hi, "queue"),
+                             (self.burn_lo, self.burn_hi, "burn"),
+                             (self.occupancy_lo, self.occupancy_hi,
+                              "occupancy")):
+            if not 0 <= lo < hi:
+                raise InvalidArgumentError(
+                    f"{what} hysteresis band needs 0 <= lo < hi, got "
+                    f"[{lo}, {hi}] — equal bounds flap on noise")
+        if self.step < 1:
+            raise InvalidArgumentError("step must be >= 1")
+        for v, what in ((self.cooldown, "cooldown"),
+                        (self.dwell, "dwell"),
+                        (self.backoff, "backoff"),
+                        (self.kv_free_min, "kv_free_min")):
+            if v < 0:
+                raise InvalidArgumentError(f"{what} must be >= 0")
+        if self.interval <= 0:
+            raise InvalidArgumentError("interval must be > 0")
+
+
+_POLICY_KEYS = {
+    "min": ("min_replicas", int), "max": ("max_replicas", int),
+    "queue_hi": ("queue_hi", float), "queue_lo": ("queue_lo", float),
+    "burn_hi": ("burn_hi", float), "burn_lo": ("burn_lo", float),
+    "occ_hi": ("occupancy_hi", float), "occ_lo": ("occupancy_lo", float),
+    "kv_free_min": ("kv_free_min", float),
+    "step": ("step", int), "cooldown": ("cooldown", float),
+    "dwell": ("dwell", float), "backoff": ("backoff", float),
+    "interval": ("interval", float),
+}
+
+
+def parse_policy(spec: Optional[str] = None) -> ScalingPolicy:
+    """Parse the ``serve_autoscale`` flag grammar —
+    ``'min=2;max=8;queue_hi=0.8;queue_lo=0.2;burn_hi=1.0;burn_lo=0.5;
+    occ_hi=0.9;occ_lo=0.3;kv_free_min=0;step=1;cooldown=10;dwell=30;
+    backoff=20;interval=1'`` — every key optional, unknown keys and
+    unparsable values are typed errors naming the clause."""
+    if spec is None:
+        spec = core_flags.flag("serve_autoscale")
+    kw = {}
+    for clause in str(spec).split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        key, sep, val = clause.partition("=")
+        key = key.strip()
+        if not sep or key not in _POLICY_KEYS:
+            raise InvalidArgumentError(
+                f"bad scaling-policy clause {clause!r} — keys: "
+                f"{sorted(_POLICY_KEYS)}")
+        field, conv = _POLICY_KEYS[key]
+        try:
+            kw[field] = conv(val.strip())
+        except ValueError:
+            raise InvalidArgumentError(
+                f"bad scaling-policy value in {clause!r} "
+                f"(expected {conv.__name__})") from None
+    return ScalingPolicy(**kw)
+
+
+@dataclasses.dataclass
+class Signals:
+    """One tick's sensor readings. ``None`` = the signal does not
+    apply to this target (a serving fleet has no KV pages) — a signal
+    that is absent can neither trigger nor veto a transition."""
+    live: int = 0
+    ready: int = 0
+    queue_ratio: Optional[float] = None
+    overload: Optional[float] = None
+    burn_max: Optional[float] = None
+    burns: Dict[str, float] = dataclasses.field(default_factory=dict)
+    occupancy: Optional[float] = None
+    kv_pages_free: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One typed loop outcome: what (if anything) to do and why."""
+    action: str                    # hold | scale_out | scale_in
+    target: int                    # replica count the action aims at
+    reason: str
+    signals: Optional[Signals] = None
+
+
+class SupervisorTarget:
+    """Adapter presenting an elastic training world
+    (:class:`~paddle1_tpu.distributed.supervisor.Supervisor`) as a
+    scalable target: ``scale_to`` routes through ``request_resize``
+    (the drain → reshard → relaunch path) and converts a typed
+    :class:`ResizeRefused` into :class:`ScaleFailed` so the
+    autoscaler's backoff discipline applies unchanged."""
+
+    def __init__(self, supervisor):
+        self._sup = supervisor
+
+    def live_replicas(self) -> int:
+        return int(self._sup.world_size or 0)
+
+    def ready_replicas(self) -> int:
+        return self.live_replicas()
+
+    def scale_to(self, replicas: int,
+                 ready_timeout_s: Optional[float] = None,
+                 reason: str = "autoscale") -> dict:
+        start = self.live_replicas()
+        refusal = self._sup.request_resize(int(replicas), reason)
+        if refusal is not None:
+            raise ScaleFailed(str(refusal))
+        return {"from": start, "to": int(replicas), "queued": True}
+
+
+class Autoscaler:
+    """The control loop. ``target`` is anything with
+    ``scale_to(n, reason=...)`` / ``live_replicas()`` /
+    ``ready_replicas()`` — both fleets qualify directly, a Supervisor
+    via :class:`SupervisorTarget`. ``slos`` (an
+    :class:`~paddle1_tpu.obs.slo.SloSet`) is evaluated against
+    ``registry`` (default: the target's own metrics registry) each
+    tick. Drive it with :meth:`start`/:meth:`stop` for the background
+    loop, or call :meth:`step` directly for deterministic control
+    (tests, benches)."""
+
+    def __init__(self, target, policy: Optional[ScalingPolicy] = None,
+                 slos=None, registry=None):
+        self.target = target
+        self.policy = policy if policy is not None else parse_policy()
+        self.slos = slos
+        self.registry = (registry if registry is not None
+                         else getattr(target, "metrics", None))
+        self._lock = threading.Lock()
+        self._last_action_t: Optional[float] = None  # guarded-by: self._lock
+        self._low_since: Optional[float] = None      # guarded-by: self._lock
+        self._backoff_until = 0.0                    # guarded-by: self._lock
+        self._last_refusal: Optional[str] = None     # guarded-by: self._lock
+        self._decisions: List[Decision] = []         # guarded-by: self._lock
+        self._inflight: Optional[tuple] = None       # guarded-by: self._lock
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._actuator: Optional[threading.Thread] = None
+
+    # -- sensors -----------------------------------------------------------
+
+    def _peek_gauge(self, name: str) -> Optional[float]:
+        reg = self.registry
+        if reg is None:
+            return None
+        hit = reg.peek(name)
+        if hit is None:
+            return None
+        kind, obj = hit
+        return float(obj.value) if kind == "gauge" else None
+
+    def collect(self) -> Signals:
+        """Read every applicable sensor — peek-only against the
+        registry (never materializes a family the target didn't
+        publish: the structural-zero proof counts families)."""
+        sig = Signals(live=int(self.target.live_replicas()),
+                      ready=int(self.target.ready_replicas()))
+        admission = getattr(self.target, "admission", None)
+        if admission is not None:
+            sig.queue_ratio = admission.ewma / max(1, admission.depth)
+            sig.overload = admission.overload()
+        else:
+            # generative fleets: stream-slot occupancy is the queue
+            # analog — active streams over aggregate slot capacity
+            active = self._peek_gauge("gen_fleet_streams_active")
+            per = getattr(self.target, "streams_per_replica", 0)
+            if active is not None and per and sig.live:
+                sig.occupancy = active / float(per * sig.live)
+        kv_free = self._peek_gauge("gen_fleet_kv_pages_free")
+        if kv_free is None:
+            kv_free = self._peek_gauge("gen_kv_pages_free")
+        sig.kv_pages_free = kv_free
+        occ = self._peek_gauge("slot_occupancy")
+        if occ is not None:
+            sig.occupancy = occ
+        if self.slos is not None:
+            verdicts = self.slos.evaluate(self.registry, publish=True)
+            sig.burns = {n: v["burn_rate"]
+                         for n, v in verdicts.items()}
+            sig.burn_max = max(sig.burns.values(), default=None)
+        return sig
+
+    # -- the decision ------------------------------------------------------
+
+    def decide(self, sig: Signals, now: float) -> Decision:
+        """Pure policy evaluation over one tick's signals (plus the
+        loop's cooldown/dwell/backoff/in-flight clocks). Never
+        actuates. While a transition is in flight the verdict is
+        forced to ``hold`` — but the dwell/hysteresis clocks still
+        advance, so calm observed during a slow scale-out spawn keeps
+        counting toward the eventual scale-in."""
+        p, cur = self.policy, sig.live
+        with self._lock:
+            backoff_until = self._backoff_until
+            last_action = self._last_action_t
+            low_since = self._low_since
+            inflight = self._inflight
+        d = self._evaluate(sig, now, backoff_until, last_action,
+                           low_since)
+        if inflight is not None:
+            return Decision(
+                HOLD, cur, f"transition in flight ({inflight[0]} -> "
+                f"{inflight[1]} replicas); {d.reason}", sig)
+        return d
+
+    def _evaluate(self, sig: Signals, now: float,
+                  backoff_until: float, last_action: Optional[float],
+                  low_since: Optional[float]) -> Decision:
+        p, cur = self.policy, sig.live
+        if now < backoff_until:
+            return Decision(HOLD, cur,
+                            f"backoff after refused transition "
+                            f"({backoff_until - now:.1f}s left)", sig)
+        pressure = []
+        if sig.burn_max is not None and sig.burn_max >= p.burn_hi:
+            pressure.append(f"slo_burn {sig.burn_max:.2f} >= "
+                            f"{p.burn_hi}")
+        if sig.queue_ratio is not None and sig.queue_ratio >= p.queue_hi:
+            pressure.append(f"queue_ewma {sig.queue_ratio:.2f} >= "
+                            f"{p.queue_hi}")
+        if sig.occupancy is not None and sig.occupancy >= p.occupancy_hi:
+            pressure.append(f"occupancy {sig.occupancy:.2f} >= "
+                            f"{p.occupancy_hi}")
+        if p.kv_free_min > 0 and sig.kv_pages_free is not None \
+                and sig.kv_pages_free <= p.kv_free_min:
+            pressure.append(f"kv_pages_free {sig.kv_pages_free:.0f} "
+                            f"<= {p.kv_free_min:.0f}")
+        if pressure:
+            with self._lock:
+                self._low_since = None
+            if last_action is not None \
+                    and now - last_action < p.cooldown:
+                return Decision(HOLD, cur, "cooldown under pressure: "
+                                + "; ".join(pressure), sig)
+            target = min(cur + p.step, p.max_replicas)
+            if target <= cur:
+                return Decision(HOLD, cur, "at max_replicas under "
+                                "pressure: " + "; ".join(pressure),
+                                sig)
+            return Decision(SCALE_OUT, target, "; ".join(pressure), sig)
+        calm = ((sig.burn_max is None or sig.burn_max < p.burn_lo)
+                and (sig.queue_ratio is None
+                     or sig.queue_ratio < p.queue_lo)
+                and (sig.occupancy is None
+                     or sig.occupancy < p.occupancy_lo))
+        if not calm or cur <= p.min_replicas:
+            with self._lock:
+                self._low_since = None
+            return Decision(HOLD, cur, "in band" if calm
+                            else "between bands (hysteresis)", sig)
+        if low_since is None:
+            with self._lock:
+                self._low_since = now
+            return Decision(HOLD, cur,
+                            f"calm — dwell 0.0/{p.dwell:.0f}s", sig)
+        if now - low_since < p.dwell:
+            return Decision(HOLD, cur,
+                            f"calm — dwell {now - low_since:.1f}/"
+                            f"{p.dwell:.0f}s", sig)
+        if last_action is not None and now - last_action < p.cooldown:
+            return Decision(HOLD, cur, "cooldown while calm", sig)
+        target = max(cur - p.step, p.min_replicas)
+        return Decision(SCALE_IN, target,
+                        f"calm for {now - low_since:.0f}s", sig)
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self, now: Optional[float] = None,
+             sync: bool = True) -> Decision:
+        """One full tick: collect → decide → (maybe) actuate. With
+        ``sync=True`` (the default — tests and benches) actuation runs
+        INLINE and a refused or failed transition is reflected in the
+        returned decision. The background loop passes ``sync=False``:
+        the transition runs in a single-flight worker thread while
+        subsequent ticks keep sensing (they resolve ``hold``
+        "transition in flight"). Either way a refused transition is
+        caught TYPED — counted, journaled, backoff armed — so the loop
+        re-evaluates instead of crashing or flapping."""
+        t0 = time.perf_counter()
+        now = time.monotonic() if now is None else now
+        m = self.registry
+        sig = self.collect()
+        decision = self.decide(sig, now)
+        # decision latency stops HERE: actuation below blocks on
+        # replica spawn/drain — that is capacity work the policy asked
+        # for, not loop overhead, and timing it would make the <1%
+        # acceptance gate unpassable by construction
+        decide_s = time.perf_counter() - t0
+        if m is not None:
+            m.counter("autoscale_decisions_total").inc()
+            if sig.queue_ratio is not None:
+                m.gauge("autoscale_queue_ratio").set(
+                    round(sig.queue_ratio, 4))
+            if sig.burn_max is not None:
+                m.gauge("autoscale_burn_max_ratio").set(sig.burn_max)
+            m.gauge("autoscale_target_replicas").set(decision.target)
+        if decision.action != HOLD:
+            if sync:
+                decision = self._actuate(decision, sig, now, t0,
+                                         journal_refusal=False)
+            else:
+                with self._lock:
+                    self._inflight = (decision.action, decision.target)
+                worker = threading.Thread(
+                    target=self._actuate,
+                    args=(decision, sig, now, t0),
+                    kwargs={"journal_refusal": True},
+                    daemon=True, name="p1t-autoscale-actuate")
+                self._actuator = worker
+                worker.start()
+        with self._lock:
+            self._decisions.append(decision)
+            del self._decisions[:-256]  # bounded decision journal
+        if m is not None:
+            m.histogram("autoscale_decision_seconds").observe(decide_s)
+        return decision
+
+    def _actuate(self, decision: Decision, sig: Signals,
+                 launch_now: float, t_launch: float,
+                 journal_refusal: bool) -> Decision:
+        """Apply one transition through the target's own safe surface.
+        Completion is stamped ``launch_now + real elapsed`` so cooldown
+        starts when capacity actually changed — consistent whether the
+        caller's clock is pinned (tests) or monotonic (the loop)."""
+        m = self.registry
+        try:
+            report = self.target.scale_to(decision.target,
+                                          reason=decision.reason)
+            done_now = launch_now + (time.perf_counter() - t_launch)
+            with self._lock:
+                self._last_action_t = done_now
+                if decision.action == SCALE_IN:
+                    # calm observed at HIGHER capacity says nothing
+                    # about the reduced fleet — the next scale-in must
+                    # re-earn its dwell. A scale-out only ADDED
+                    # capacity, so calm evidence accrued while it
+                    # spawned stands.
+                    self._low_since = None
+            if m is not None:
+                m.counter(f"autoscale_{decision.action}_total").inc()
+            obs_events.emit(
+                "autoscale_decision", action=decision.action,
+                replicas_from=sig.live, replicas_to=decision.target,
+                reason=decision.reason,
+                applied=dict(report) if report else {})
+            with self._lock:
+                self._inflight = None
+            return decision
+        except Exception as e:  # noqa: broad-except — ScaleFailed is
+            # the typed surface, but ANY wedged transition must park
+            # the loop in backoff, not kill it
+            done_now = launch_now + (time.perf_counter() - t_launch)
+            with self._lock:
+                self._backoff_until = done_now + self.policy.backoff
+                self._last_refusal = str(e)
+            if m is not None:
+                m.counter("autoscale_refusals_total").inc()
+            obs_events.emit(
+                "autoscale_refused", action=decision.action,
+                replicas_from=sig.live,
+                replicas_to=decision.target,
+                error=type(e).__name__, reason=str(e),
+                backoff_s=self.policy.backoff)
+            hold = Decision(HOLD, sig.live,
+                            f"refused ({e}) — backoff "
+                            f"{self.policy.backoff:.0f}s", sig)
+            with self._lock:
+                self._inflight = None
+                if journal_refusal:
+                    # the launch tick already journaled the attempt;
+                    # record how it resolved
+                    self._decisions.append(hold)
+                    del self._decisions[:-256]
+            return hold
+
+    def decisions(self) -> List[Decision]:
+        """The (bounded) in-memory decision journal, newest last."""
+        with self._lock:
+            return list(self._decisions)
+
+    @property
+    def last_refusal(self) -> Optional[str]:
+        with self._lock:
+            return self._last_refusal
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop_ev.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="p1t-autoscale")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                # async actuation: a multi-second replica spawn must
+                # not blind the sensors mid-flash
+                self.step(sync=False)
+            except Exception as e:  # noqa: broad-except — the control
+                # loop must survive a mid-teardown sensor race; a
+                # broken tick is one skipped evaluation, not a dead
+                # autoscaler
+                print(f"autoscale tick error: {e!r}", file=sys.stderr)
+            self._stop_ev.wait(self.policy.interval)
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        a, self._actuator = self._actuator, None
+        if a is not None and a.is_alive():
+            a.join(timeout=30.0)  # let an in-flight spawn land
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -9,7 +9,7 @@ from ..core.errors import (ExecutionTimeoutError, PreconditionNotMetError,
                            ResourceExhaustedError, UnavailableError)
 
 __all__ = ["ServerOverloaded", "DeadlineExceeded", "ServerClosed",
-           "ReplicaFailed", "DeployFailed", "SlotWedged",
+           "ReplicaFailed", "DeployFailed", "ScaleFailed", "SlotWedged",
            "StreamCancelled", "KVPoolExhausted", "StreamFailed",
            "KVPageAccountingError"]
 
@@ -46,6 +46,16 @@ class DeployFailed(PreconditionNotMetError):
     failure, ready-handshake timeout, or a failed canary inference);
     the deploy was rolled back and the fleet keeps serving the old
     version."""
+
+
+class ScaleFailed(PreconditionNotMetError):
+    """A ``scale_to`` transition could not complete: a scale-out
+    replica never became healthy within the ready window (the corpse
+    was retired; replicas that DID come up stay in rotation — capacity
+    is kept, the shortfall is typed), or the fleet was not in a state
+    to scale. The fleet keeps serving at whatever size it actually
+    reached — an autoscaler backs off and re-evaluates instead of
+    flapping."""
 
 
 class SlotWedged(UnavailableError):

@@ -93,7 +93,7 @@ from ..obs import trace as obs_trace
 from . import wire
 from .batcher import ServeFuture
 from .errors import (DeadlineExceeded, DeployFailed, ReplicaFailed,
-                     ServerClosed, ServerOverloaded)
+                     ScaleFailed, ServerClosed, ServerOverloaded)
 from .metrics import MetricsGroup, ServingMetrics, merge_snapshots
 
 __all__ = ["ServingFleet", "FleetFuture", "AdaptiveAdmission"]
@@ -1040,6 +1040,16 @@ class ServingFleet:
         # back below the shed threshold
         with self._queue_cond:
             self.admission.observe(len(self._queue))
+        # first-class admission-pressure signal (ISSUE 18): the
+        # autoscaler (and dashboards) read the EWMA the shed decision
+        # actually uses, instead of re-deriving it from queue samples
+        self.metrics.gauge("serve_queue_depth_ewma").set(
+            round(self.admission.ewma, 4))
+        self.metrics.gauge("serve_replicas_live").set(
+            sum(1 for c in clients
+                if c.state in (_STARTING, _STANDBY, _READY)))
+        self.metrics.gauge("serve_replicas_ready").set(
+            sum(1 for c in clients if c.state == _READY))
         if not any(c.state in (_STARTING, _STANDBY, _READY, _DRAINING)
                    for c in clients):
             self._fail_all_pending(
@@ -1334,6 +1344,107 @@ class ServingFleet:
         # candidate's version tag — a response from anything else
         # (however it got there) proves nothing about the new model
         return req.future.version == client.version
+
+    # -- horizontal scaling (ISSUE 18) -------------------------------------
+
+    def live_replicas(self) -> int:
+        """Replicas that count toward capacity: starting, standby, or
+        in rotation (failed/retired ranks are gone for good)."""
+        with self._lock:
+            return sum(1 for c in self._clients.values()
+                       if c.state in (_STARTING, _STANDBY, _READY))
+
+    def ready_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._clients.values()
+                       if c.state == _READY)
+
+    def scale_to(self, replicas: int,
+                 ready_timeout_s: Optional[float] = None,
+                 reason: str = "requested") -> dict:
+        """Zero-downtime horizontal scale to ``replicas``. Serialized
+        with :meth:`deploy` under the deploy mutex — a scale racing a
+        roll would retire ranks the roll is swapping. Scale-out spawns
+        fresh supervised replicas (same version/model) and waits for
+        each to enter rotation; scale-in drains the highest ranks
+        through the same retire path a deploy uses (in-flight work
+        completes or fails over — unaccounted stays 0). Raises
+        :class:`ScaleFailed` typed when a scale-out replica never
+        becomes healthy (replicas that did come up STAY — capacity is
+        kept, the shortfall is the error)."""
+        target = int(replicas)
+        if target < 1:
+            raise InvalidArgumentError(
+                f"cannot scale a fleet to {target} replicas")
+        with self._deploy_lock:
+            if not self._started or self._stop:
+                raise ScaleFailed(
+                    "fleet is not running — nothing to scale")
+            with self._lock:
+                live = sorted(r for r, c in self._clients.items()
+                              if c.state in (_STARTING, _STANDBY,
+                                             _READY))
+            start = len(live)
+            if target == start:
+                return {"from": start, "to": start, "added": [],
+                        "retired": []}
+            timeout = (self.ready_timeout_s if ready_timeout_s is None
+                       else float(ready_timeout_s))
+            added: List[int] = []
+            retired: List[int] = []
+            if target > start:
+                # spawn first, wait second: the candidates warm
+                # CONCURRENTLY, so a step=N scale-out costs one spawn
+                # latency (subprocess + jit warmup), not N — the
+                # autoscaler's reaction time under a flash crowd
+                spawned: List[_ReplicaClient] = []
+                for _ in range(target - start):
+                    client = self._add_replica(self.version,
+                                               self.model_arg)
+                    self._sup.spawn_worker(client.rank)
+                    client.start()
+                    spawned.append(client)
+                deadline = time.monotonic() + timeout
+                failed: List[int] = []
+                for client in spawned:
+                    if client.wait_connected(
+                            max(0.0, deadline - time.monotonic())):
+                        added.append(client.rank)
+                    else:
+                        self._abort_spawn(client)
+                        failed.append(client.rank)
+                if failed:
+                    self._emit_scale(reason, start, added, retired,
+                                     refused=True)
+                    raise ScaleFailed(
+                        f"scale-out replica(s) {failed} never became "
+                        f"healthy within {timeout:.0f}s — fleet holds "
+                        f"at {start + len(added)} replicas")
+            else:
+                # retire the newest capacity first: the lowest ranks
+                # carry the longest-lived connections and caches
+                for rank in reversed(live):
+                    if start - len(retired) <= target:
+                        break
+                    self._retire_replica(rank)
+                    retired.append(rank)
+            self._emit_scale(reason, start, added, retired)
+            return {"from": start, "to": start + len(added)
+                    - len(retired), "added": added, "retired": retired}
+
+    def _emit_scale(self, reason: str, start: int, added, retired,
+                    refused: bool = False) -> None:
+        to = start + len(added) - len(retired)
+        self.metrics.counter("scale_out_total" if to >= start
+                             else "scale_in_total").inc()
+        if refused:
+            self.metrics.counter("scale_refused_total").inc()
+        self.metrics.gauge("serve_replicas_live").set(
+            self.live_replicas())
+        obs_events.emit("fleet_scale", kind="serving", reason=reason,
+                        replicas_from=start, replicas_to=to,
+                        added=list(added), retired=list(retired),
+                        refused=bool(refused))
 
     def _retire_replica(self, rank: int) -> None:
         """Drain one replica out of the fleet: out of rotation, wait

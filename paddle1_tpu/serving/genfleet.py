@@ -73,8 +73,9 @@ from ..core import locks
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from ..obs import events as obs_events
 from . import wire
-from .errors import (DeadlineExceeded, DeployFailed, ServerClosed,
-                     ServerOverloaded, StreamCancelled, StreamFailed)
+from .errors import (DeadlineExceeded, DeployFailed, ScaleFailed,
+                     ServerClosed, ServerOverloaded, StreamCancelled,
+                     StreamFailed)
 from .metrics import ServingMetrics
 
 __all__ = ["GenerationFleet", "FleetStream"]
@@ -1309,6 +1310,100 @@ class GenerationFleet:
         with self._lock:
             self._clients.pop(client.rank, None)
 
+    # -- horizontal scaling (ISSUE 18) --------------------------------------
+
+    def live_replicas(self) -> int:
+        """Replicas that count toward capacity: starting, standby, or
+        in rotation."""
+        with self._lock:
+            return sum(1 for c in self._clients.values()
+                       if c.state in (_STARTING, _STANDBY, _READY))
+
+    def ready_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._clients.values()
+                       if c.state == _READY)
+
+    def scale_to(self, replicas: int,
+                 ready_timeout_s: Optional[float] = None,
+                 reason: str = "requested") -> dict:
+        """Zero-downtime horizontal scale to ``replicas`` (the
+        :meth:`ServingFleet.scale_to` contract for token streams).
+        Scale-in retires the highest ranks through the deploy retire
+        path, so their live streams MIGRATE by bit-identical replay to
+        survivors rather than failing. Raises :class:`ScaleFailed`
+        typed when a scale-out replica never becomes healthy (healthy
+        additions stay)."""
+        target = int(replicas)
+        if target < 1:
+            raise InvalidArgumentError(
+                f"cannot scale a fleet to {target} replicas")
+        with self._deploy_lock:
+            if not self._started or self._stop:
+                raise ScaleFailed(
+                    "generation fleet is not running — nothing to "
+                    "scale")
+            with self._lock:
+                live = sorted(r for r, c in self._clients.items()
+                              if c.state in (_STARTING, _STANDBY,
+                                             _READY))
+            start = len(live)
+            if target == start:
+                return {"from": start, "to": start, "added": [],
+                        "retired": []}
+            timeout = (self.ready_timeout_s if ready_timeout_s is None
+                       else float(ready_timeout_s))
+            added: List[int] = []
+            retired: List[int] = []
+            if target > start:
+                # spawn first, wait second: candidates warm
+                # CONCURRENTLY — one spawn latency per transition, not
+                # one per added replica (mirrors ServingFleet.scale_to)
+                spawned = []
+                for _ in range(target - start):
+                    client = self._add_replica(self.version,
+                                               self.model_arg)
+                    self._sup.spawn_worker(client.rank)
+                    client.start()
+                    spawned.append(client)
+                deadline = time.monotonic() + timeout
+                failed: List[int] = []
+                for client in spawned:
+                    if client.wait_connected(
+                            max(0.0, deadline - time.monotonic())):
+                        added.append(client.rank)
+                    else:
+                        self._abort_spawn(client)
+                        failed.append(client.rank)
+                if failed:
+                    self._emit_scale(reason, start, added, retired,
+                                     refused=True)
+                    raise ScaleFailed(
+                        f"scale-out replica(s) {failed} never became "
+                        f"healthy within {timeout:.0f}s — fleet holds "
+                        f"at {start + len(added)} replicas")
+            else:
+                for rank in reversed(live):
+                    if start - len(retired) <= target:
+                        break
+                    self._retire_replica(rank)
+                    retired.append(rank)
+            self._emit_scale(reason, start, added, retired)
+            return {"from": start, "to": start + len(added)
+                    - len(retired), "added": added, "retired": retired}
+
+    def _emit_scale(self, reason: str, start: int, added, retired,
+                    refused: bool = False) -> None:
+        to = start + len(added) - len(retired)
+        self.metrics.counter("scale_out_total" if to >= start
+                             else "scale_in_total").inc()
+        if refused:
+            self.metrics.counter("scale_refused_total").inc()
+        obs_events.emit("fleet_scale", kind="generation", reason=reason,
+                        replicas_from=start, replicas_to=to,
+                        added=list(added), retired=list(retired),
+                        refused=bool(refused))
+
     def _retire_replica(self, rank: int) -> None:
         """Take one replica out of the fleet, migrating its live
         streams by replay (not failover — no retry budget): remove
@@ -1336,8 +1431,9 @@ class GenerationFleet:
                 except (OSError, ConnectionError):
                     conn = None
             self.metrics.counter("gen_fleet_migrations_total").inc()
-            # only deploy() calls _retire_replica, under _deploy_lock
-            self.migrations += 1  # noqa: guarded-mutation — held via deploy()
+            # _retire_replica's only callers (deploy, scale_to) hold
+            # _deploy_lock
+            self.migrations += 1  # noqa: guarded-mutation — held via deploy()/scale_to()
             self._failover(req, f"migrated off retiring replica "
                                 f"{rank}", charge_retry=False)
         client.set_state(_RETIRED)

@@ -1,0 +1,352 @@
+"""Open-loop production-day traffic simulator (ISSUE 18).
+
+The proof harness for the autoscaler: a deterministic load generator
+that composes the request patterns a real serving day throws at a
+fleet — diurnal ramps, flash crowds, heavy-tail payload sizes, mixed
+priority classes — and offers them OPEN-LOOP (arrival times are drawn
+up front from the model, never modulated by completions: a saturated
+fleet keeps getting offered load, exactly the regime closed-loop
+benchmarks hide).
+
+The model is declarative (:class:`TrafficModel`, flag grammar in
+:func:`parse_traffic`), the schedule is a pure function of the model
+(:func:`schedule` — same seed, same day), and the runner
+(:func:`run`) drives any ``submit(arrival) -> future`` callable,
+counting typed sheds as accounted outcomes and collecting e2e
+latencies off the submit thread so admission never blocks on
+completions. Chaos composes from the outside: arm the existing
+``replica_kill`` / ``gen_slot_wedge`` / ``gen_page_pressure`` points
+and the same schedule replays against a failing fleet.
+
+Arrival times use inhomogeneous-Poisson thinning: draw homogeneous
+arrivals at the model's peak rate, keep each with probability
+``rate(t)/peak`` — exact for any bounded rate curve, and determinism
+rides one ``random.Random(seed)``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import queue
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core import flags as core_flags
+from ..core.errors import InvalidArgumentError
+from .errors import ServerOverloaded
+
+__all__ = ["FlashCrowd", "TrafficModel", "Arrival", "parse_traffic",
+           "schedule", "run"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashCrowd:
+    """One multiplicative burst: ``rate *= multiplier`` for
+    ``[start_s, start_s + duration_s)``."""
+    start_s: float
+    duration_s: float
+    multiplier: float
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficModel:
+    """One production day, compressed. ``diurnal`` is the sinusoid
+    amplitude as a fraction of ``rps`` (one full period over
+    ``duration_s`` — troughs at the ends, peak mid-day); payload
+    lengths are Pareto(``tail_alpha``) on ``[len_min, len_max]`` (the
+    heavy tail: most requests small, a few huge); ``priorities`` are
+    ``(class, weight)`` sampling weights."""
+    rps: float = 20.0
+    duration_s: float = 30.0
+    diurnal: float = 0.0
+    flash: Tuple[FlashCrowd, ...] = ()
+    tail_alpha: float = 1.5
+    len_min: int = 8
+    len_max: int = 512
+    priorities: Tuple[Tuple[int, float], ...] = ((0, 1.0),)
+    deadline_ms: Optional[float] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.rps <= 0 or self.duration_s <= 0:
+            raise InvalidArgumentError(
+                "traffic needs rps > 0 and dur > 0")
+        if not 0 <= self.diurnal < 1:
+            raise InvalidArgumentError(
+                f"diurnal amplitude must be in [0, 1), got "
+                f"{self.diurnal} (a full-amplitude trough is zero "
+                "traffic — model that as duration instead)")
+        if self.tail_alpha <= 0:
+            raise InvalidArgumentError("tail alpha must be > 0")
+        if not 1 <= self.len_min <= self.len_max:
+            raise InvalidArgumentError(
+                f"need 1 <= len_min <= len_max, got "
+                f"[{self.len_min}, {self.len_max}]")
+        if not self.priorities or \
+                any(w <= 0 for _, w in self.priorities):
+            raise InvalidArgumentError(
+                "priorities need >= 1 class with positive weight")
+        for fc in self.flash:
+            if fc.duration_s <= 0 or fc.multiplier <= 0:
+                raise InvalidArgumentError(
+                    f"bad flash crowd {fc} — needs positive duration "
+                    "and multiplier")
+
+    def rate_at(self, t: float) -> float:
+        """Offered rate (req/s) at second ``t`` of the day."""
+        r = self.rps * (1.0 + self.diurnal * math.sin(
+            2.0 * math.pi * t / self.duration_s))
+        for fc in self.flash:
+            if fc.start_s <= t < fc.start_s + fc.duration_s:
+                r *= fc.multiplier
+        return r
+
+    def peak_rate(self) -> float:
+        base = self.rps * (1.0 + self.diurnal)
+        mult = 1.0
+        for fc in self.flash:
+            mult = max(mult, fc.multiplier)
+        return base * mult
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: when, how big, how urgent."""
+    t: float                       # seconds from run start
+    priority: int
+    length: int                    # payload rows / prompt tokens
+    deadline_ms: Optional[float]
+
+
+# -- the flag grammar -------------------------------------------------------
+
+_GRAMMAR = ("'rps=40;dur=30;diurnal=0.3;flash=10x@12+6[,8x@20+2];"
+            "tail=1.5;len=8:512;prio=0:0.7,1:0.2,2:0.1;deadline=250;"
+            "seed=7' — every key optional")
+
+
+def _parse_flash(val: str) -> Tuple[FlashCrowd, ...]:
+    crowds = []
+    for part in val.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            mult, _, rest = part.partition("x@")
+            start, _, dur = rest.partition("+")
+            crowds.append(FlashCrowd(start_s=float(start),
+                                     duration_s=float(dur),
+                                     multiplier=float(mult)))
+        except ValueError:
+            raise InvalidArgumentError(
+                f"bad flash clause {part!r} — grammar "
+                "'<mult>x@<start>+<dur>'") from None
+    return tuple(crowds)
+
+
+def _parse_prio(val: str) -> Tuple[Tuple[int, float], ...]:
+    out = []
+    for part in val.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, sep, w = part.partition(":")
+        try:
+            out.append((int(cls), float(w) if sep else 1.0))
+        except ValueError:
+            raise InvalidArgumentError(
+                f"bad priority clause {part!r} — grammar "
+                "'<class>:<weight>'") from None
+    return tuple(out)
+
+
+def parse_traffic(spec: Optional[str] = None) -> TrafficModel:
+    """Parse the ``serve_traffic`` flag grammar into a
+    :class:`TrafficModel`; unknown keys and unparsable values are
+    typed errors naming the clause."""
+    if spec is None:
+        spec = core_flags.flag("serve_traffic")
+    kw: Dict[str, object] = {}
+    for clause in str(spec).split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        key, sep, val = clause.partition("=")
+        key, val = key.strip(), val.strip()
+        if not sep:
+            raise InvalidArgumentError(
+                f"bad traffic clause {clause!r} — grammar: {_GRAMMAR}")
+        try:
+            if key == "rps":
+                kw["rps"] = float(val)
+            elif key == "dur":
+                kw["duration_s"] = float(val)
+            elif key == "diurnal":
+                kw["diurnal"] = float(val)
+            elif key == "flash":
+                kw["flash"] = _parse_flash(val)
+            elif key == "tail":
+                kw["tail_alpha"] = float(val)
+            elif key == "len":
+                lo, _, hi = val.partition(":")
+                kw["len_min"], kw["len_max"] = int(lo), int(hi or lo)
+            elif key == "prio":
+                kw["priorities"] = _parse_prio(val)
+            elif key == "deadline":
+                kw["deadline_ms"] = float(val) if float(val) > 0 \
+                    else None
+            elif key == "seed":
+                kw["seed"] = int(val)
+            else:
+                raise InvalidArgumentError(
+                    f"unknown traffic key {key!r} — grammar: "
+                    f"{_GRAMMAR}")
+        except ValueError:
+            raise InvalidArgumentError(
+                f"bad traffic value in {clause!r} — grammar: "
+                f"{_GRAMMAR}") from None
+    return TrafficModel(**kw)
+
+
+# -- the schedule -----------------------------------------------------------
+
+def schedule(model: TrafficModel) -> List[Arrival]:
+    """The whole day's arrivals, up front (pure in the model — same
+    seed, same day, so a chaos replay sees the identical offered
+    load). Inhomogeneous-Poisson thinning at the model's peak rate."""
+    rng = random.Random(model.seed)
+    peak = model.peak_rate()
+    arrivals: List[Arrival] = []
+    classes = [c for c, _ in model.priorities]
+    weights = [w for _, w in model.priorities]
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= model.duration_s:
+            break
+        if rng.random() > model.rate_at(t) / peak:
+            continue  # thinned: the instantaneous rate is below peak
+        # Pareto(alpha) scaled to len_min, truncated to len_max — the
+        # heavy tail that makes padding/batching earn its keep
+        length = min(model.len_max,
+                     int(model.len_min * rng.paretovariate(
+                         model.tail_alpha)))
+        arrivals.append(Arrival(
+            t=t, priority=rng.choices(classes, weights)[0],
+            length=length, deadline_ms=model.deadline_ms))
+    return arrivals
+
+
+# -- the runner -------------------------------------------------------------
+
+def run(arrivals: Sequence[Arrival],
+        submit: Callable[[Arrival], object],
+        collectors: int = 16,
+        result_timeout_s: float = 60.0,
+        speed: float = 1.0,
+        on_tick: Optional[Callable[[float], None]] = None,
+        tick_s: float = 0.5) -> dict:
+    """Offer ``arrivals`` open-loop against wall clock: each is
+    submitted at ``t / speed`` seconds from start whether or not
+    earlier requests completed. ``submit`` returns a future-like
+    (``result(timeout)``) or raises — :class:`ServerOverloaded` counts
+    as a typed shed (accounted back-pressure, not a failure), any
+    other synchronous raise as a submit failure. Completion latencies
+    are collected by a pool off the submit thread. ``on_tick(now_s)``
+    (when given) fires about every ``tick_s`` of run time — the
+    replica-hours integrator's hook.
+
+    Returns ``{offered, admitted, shed, submit_failed, completed,
+    errors, lateness_p99_ms, latency_ms: {p50, p95, p99, n},
+    error_types}`` where ``offered == admitted + shed +
+    submit_failed`` and ``admitted == completed + errors`` — the
+    open-loop accounting identity.
+    """
+    results: collections.deque = collections.deque()  # thread-safe appends
+    pending: "queue.Queue" = queue.Queue()
+    stop = object()
+
+    def _collect():
+        while True:
+            item = pending.get()
+            if item is stop:
+                return
+            t_sub, fut = item
+            try:
+                fut.result(timeout=result_timeout_s)
+                results.append(("ok", (time.monotonic() - t_sub) * 1e3,
+                                None))
+            except Exception as e:  # noqa: broad-except — EVERY typed
+                # completion failure (deadline, shed-on-retry, replica
+                # loss) is one accounted outcome; classification
+                # happens below by type name
+                results.append(("err", (time.monotonic() - t_sub) * 1e3,
+                                type(e).__name__))
+
+    pool = [threading.Thread(target=_collect, daemon=True,
+                             name=f"p1t-traffic-collect-{i}")
+            for i in range(max(1, int(collectors)))]
+    for th in pool:
+        th.start()
+
+    offered = admitted = shed = submit_failed = 0
+    lateness_ms: List[float] = []
+    t0 = time.monotonic()
+    next_tick = 0.0
+    for a in arrivals:
+        due = t0 + a.t / speed
+        now = time.monotonic()
+        if on_tick is not None:
+            while next_tick <= now - t0:
+                on_tick(next_tick)
+                next_tick += tick_s
+        if due > now:
+            time.sleep(due - now)
+        lateness_ms.append(max(0.0, (time.monotonic() - due) * 1e3))
+        offered += 1
+        try:
+            fut = submit(a)
+            admitted += 1
+            pending.put((time.monotonic(), fut))
+        except ServerOverloaded:
+            shed += 1          # typed back-pressure: accounted, legal
+        except Exception:  # noqa: broad-except — an open-loop run
+            # keeps offering through a failing fleet; the failure is
+            # counted and the gate decides what it means
+            submit_failed += 1
+    for _ in pool:
+        pending.put(stop)
+    for th in pool:
+        th.join(timeout=result_timeout_s + 10.0)
+
+    oks = sorted(ms for kind, ms, _ in results if kind == "ok")
+    errs = [etype for kind, _, etype in results if kind == "err"]
+
+    def _pct(xs: List[float], q: float) -> Optional[float]:
+        if not xs:
+            return None
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    lateness_ms.sort()
+    return {
+        "offered": offered,
+        "admitted": admitted,
+        "shed": shed,
+        "submit_failed": submit_failed,
+        "completed": len(oks),
+        "errors": len(errs),
+        "error_types": dict(collections.Counter(errs)),
+        # open-loop fidelity: how late the generator itself ran (a
+        # blocked submit path shows up here, not as hidden pacing)
+        "lateness_p99_ms": round(_pct(lateness_ms, 0.99) or 0.0, 2),
+        "latency_ms": {
+            "p50": round(_pct(oks, 0.50) or 0.0, 2),
+            "p95": round(_pct(oks, 0.95) or 0.0, 2),
+            "p99": round(_pct(oks, 0.99) or 0.0, 2),
+            "n": len(oks),
+        },
+    }

@@ -1,0 +1,577 @@
+"""SLO-driven autoscaler (ISSUE 18 tentpole): policy grammar, the
+hysteresis/cooldown/dwell/backoff control discipline, typed refusal
+handling, and the chaos-composed scaling edges.
+
+Fast cases drive :class:`~paddle1_tpu.serving.autoscale.Autoscaler`
+against an in-process fake target with injected clocks — every
+decision path is deterministic (``decide()`` is pure in the signals
+plus the loop's clocks, ``step(now=...)`` pins time). The slow class
+spawns real replica subprocesses and exercises the satellite-3 edges:
+scale-in racing an in-flight deploy canary, a flash-crowd burst
+landing mid-scale, and an autoscaler decision while a replica's
+restart budget is exhausted — each typed, each with the drain
+identity ``unaccounted == 0``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.obs import events as obs_events
+from paddle1_tpu.obs import slo as obs_slo
+from paddle1_tpu.obs.registry import MetricsRegistry
+from paddle1_tpu.serving import (Autoscaler, ScaleFailed, ScalingPolicy,
+                                 ServerOverloaded, ServingFleet,
+                                 SupervisorTarget, parse_policy)
+from paddle1_tpu.serving.autoscale import (HOLD, SCALE_IN, SCALE_OUT,
+                                           Decision)
+
+from test_serving_fleet import FACTORY
+
+AUTOSCALE_FAMILIES = (
+    "autoscale_decisions_total", "autoscale_scale_out_total",
+    "autoscale_scale_in_total", "autoscale_refusals_total",
+    "autoscale_queue_ratio", "autoscale_burn_max_ratio",
+    "autoscale_target_replicas", "autoscale_decision_seconds")
+
+
+class _FakeAdmission:
+    def __init__(self, ewma=0.0, depth=100):
+        self.ewma = ewma
+        self.depth = depth
+
+    def overload(self):
+        return 0.0
+
+
+class _FakeFleet:
+    """Just enough surface for the Autoscaler: live/ready counts, an
+    admission EWMA, a metrics registry, and a scale_to that records
+    (or refuses) every transition."""
+
+    def __init__(self, live=2, queue_ratio=0.0, fail=False):
+        self.metrics = MetricsRegistry()
+        self.admission = _FakeAdmission(ewma=queue_ratio * 100)
+        self.fail = fail
+        self.calls = []
+        self._live = live
+
+    def live_replicas(self):
+        return self._live
+
+    def ready_replicas(self):
+        return self._live
+
+    def scale_to(self, n, ready_timeout_s=None, reason="requested"):
+        if self.fail:
+            raise ScaleFailed("wedged transition (test)")
+        start, self._live = self._live, int(n)
+        self.calls.append((start, int(n), reason))
+        return {"from": start, "to": int(n)}
+
+
+class TestPolicyGrammar:
+    def test_empty_spec_is_defaults(self):
+        assert parse_policy("") == ScalingPolicy()
+
+    def test_full_grammar_roundtrip(self):
+        p = parse_policy("min=2;max=8;queue_hi=0.8;queue_lo=0.1;"
+                         "burn_hi=1.5;burn_lo=0.4;occ_hi=0.95;"
+                         "occ_lo=0.25;kv_free_min=16;step=2;"
+                         "cooldown=5;dwell=12;backoff=7;interval=0.5")
+        assert p.min_replicas == 2 and p.max_replicas == 8
+        assert p.queue_hi == 0.8 and p.queue_lo == 0.1
+        assert p.burn_hi == 1.5 and p.burn_lo == 0.4
+        assert p.occupancy_hi == 0.95 and p.occupancy_lo == 0.25
+        assert p.kv_free_min == 16 and p.step == 2
+        assert (p.cooldown, p.dwell, p.backoff, p.interval) == \
+            (5.0, 12.0, 7.0, 0.5)
+
+    def test_unknown_key_typed(self):
+        with pytest.raises(InvalidArgumentError, match="replicas=9"):
+            parse_policy("replicas=9")
+
+    def test_bad_value_typed(self):
+        with pytest.raises(InvalidArgumentError, match="min=two"):
+            parse_policy("min=two")
+
+    def test_min_above_max_typed(self):
+        with pytest.raises(InvalidArgumentError, match="min"):
+            ScalingPolicy(min_replicas=5, max_replicas=2)
+
+    def test_degenerate_band_typed(self):
+        # equal bounds would flap on noise — refused, not accepted
+        with pytest.raises(InvalidArgumentError, match="queue"):
+            ScalingPolicy(queue_hi=0.5, queue_lo=0.5)
+
+    def test_nonpositive_interval_typed(self):
+        with pytest.raises(InvalidArgumentError, match="interval"):
+            ScalingPolicy(interval=0.0)
+
+
+class TestControlDiscipline:
+    """decide()/step() against pinned clocks: the anti-flap toolkit."""
+
+    def _policy(self, **kw):
+        kw.setdefault("cooldown", 5.0)
+        kw.setdefault("dwell", 10.0)
+        kw.setdefault("backoff", 30.0)
+        return ScalingPolicy(min_replicas=1, max_replicas=4, **kw)
+
+    def test_queue_pressure_scales_out(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.9)
+        d = Autoscaler(fleet, self._policy()).step(now=100.0)
+        assert d.action == SCALE_OUT and d.target == 3
+        assert "queue_ewma" in d.reason
+        assert fleet.calls == [(2, 3, d.reason)]
+
+    def test_between_bands_holds(self):
+        # 0.5 is above queue_lo (0.2) and below queue_hi (0.75):
+        # the hysteresis gap neither scales out nor starts the dwell
+        fleet = _FakeFleet(live=2, queue_ratio=0.5)
+        d = Autoscaler(fleet, self._policy()).step(now=100.0)
+        assert d.action == HOLD and not fleet.calls
+        assert "hysteresis" in d.reason
+
+    def test_cooldown_blocks_consecutive_transitions(self):
+        fleet = _FakeFleet(live=1, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy())
+        assert scaler.step(now=100.0).action == SCALE_OUT
+        d = scaler.step(now=102.0)      # 2s < cooldown 5s, still hot
+        assert d.action == HOLD and "cooldown" in d.reason
+        assert scaler.step(now=106.0).action == SCALE_OUT
+        assert [c[:2] for c in fleet.calls] == [(1, 2), (2, 3)]
+
+    def test_at_max_holds_under_pressure(self):
+        fleet = _FakeFleet(live=4, queue_ratio=0.9)
+        d = Autoscaler(fleet, self._policy()).step(now=100.0)
+        assert d.action == HOLD and "max_replicas" in d.reason
+        assert not fleet.calls
+
+    def test_scale_in_requires_continuous_dwell(self):
+        fleet = _FakeFleet(live=3, queue_ratio=0.0)
+        scaler = Autoscaler(fleet, self._policy())
+        assert "dwell" in scaler.step(now=100.0).reason   # dwell arms
+        assert scaler.step(now=105.0).action == HOLD      # 5s < 10s
+        d = scaler.step(now=111.0)                        # 11s > 10s
+        assert d.action == SCALE_IN and d.target == 2
+        assert "calm" in d.reason
+        assert fleet.calls == [(3, 2, d.reason)]
+
+    def test_pressure_resets_the_dwell_clock(self):
+        fleet = _FakeFleet(live=3, queue_ratio=0.0)
+        scaler = Autoscaler(fleet, self._policy())
+        scaler.step(now=100.0)                            # dwell arms
+        fleet.admission.ewma = 90.0                       # spike
+        scaler.step(now=104.0)                            # re-pressurized
+        fleet.admission.ewma = 0.0
+        d = scaler.step(now=111.0)   # 11s after first calm, but the
+        assert d.action == HOLD      # spike reset the clock: re-arm
+        assert scaler.step(now=122.0).action == SCALE_IN
+
+    def test_never_below_min_replicas(self):
+        fleet = _FakeFleet(live=1, queue_ratio=0.0)
+        scaler = Autoscaler(fleet, self._policy())
+        for now in (100.0, 111.0, 122.0):
+            assert scaler.step(now=now).action == HOLD
+        assert not fleet.calls
+
+    def test_refused_transition_backs_off_typed(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.9, fail=True)
+        scaler = Autoscaler(fleet, self._policy())
+        d = scaler.step(now=100.0)
+        assert d.action == HOLD and "refused" in d.reason
+        assert "wedged transition" in scaler.last_refusal
+        counters = fleet.metrics.snapshot()["counters"]
+        assert counters["autoscale_refusals_total"] == 1
+        assert "autoscale_scale_out_total" not in counters
+        # parked: inside the backoff window the loop never re-actuates
+        d = scaler.step(now=110.0)
+        assert d.action == HOLD and "backoff" in d.reason
+        # backoff expires -> re-evaluate; target healed -> transition
+        fleet.fail = False
+        assert scaler.step(now=131.0).action == SCALE_OUT
+
+    def test_burn_rate_triggers_scale_out(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.0)
+        h = fleet.metrics.histogram("e2e_ms")
+        for _ in range(50):
+            h.observe(80.0)          # p99 80ms against a 10ms target
+        slos = obs_slo.parse_slos("lat=p99(e2e_ms)<10")
+        scaler = Autoscaler(fleet, self._policy(), slos=slos)
+        d = scaler.step(now=100.0)
+        assert d.action == SCALE_OUT and "slo_burn" in d.reason
+        assert d.signals.burn_max == pytest.approx(8.0)
+        assert fleet.metrics.snapshot()["gauges"][
+            "autoscale_burn_max_ratio"] == pytest.approx(8.0)
+
+    def test_decision_journal_bounded(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.5)
+        scaler = Autoscaler(fleet, self._policy())
+        for i in range(300):
+            scaler.step(now=100.0 + i)
+        assert len(scaler.decisions()) == 256
+        assert all(isinstance(d, Decision) for d in scaler.decisions())
+
+    def test_decision_metrics_published(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy())
+        scaler.step(now=100.0)
+        snap = fleet.metrics.snapshot()
+        assert snap["counters"]["autoscale_decisions_total"] == 1
+        assert snap["counters"]["autoscale_scale_out_total"] == 1
+        assert snap["gauges"]["autoscale_target_replicas"] == 3
+        assert snap["gauges"]["autoscale_queue_ratio"] == \
+            pytest.approx(0.9)
+        assert snap["histograms"]["autoscale_decision_seconds"][
+            "count"] == 1
+
+    def test_structurally_zero_without_autoscaler(self):
+        # a fleet that never constructs an Autoscaler never pays for
+        # the families — peek (never materialize) proves absence
+        m = MetricsRegistry()
+        m.counter("requests_total").inc()
+        assert all(m.peek(n) is None for n in AUTOSCALE_FAMILIES)
+
+    def test_events_journal_records_decisions(self, tmp_path,
+                                              monkeypatch):
+        journal = str(tmp_path / "events.jsonl")
+        monkeypatch.setenv(obs_events.EVENTS_ENV, journal)
+        fleet = _FakeFleet(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy())
+        scaler.step(now=100.0)
+        fleet.fail = True
+        fleet.admission.ewma = 90.0
+        scaler.step(now=106.0)
+        evs = obs_events.read_events(journal)
+        dec = [e for e in evs if e["event"] == "autoscale_decision"]
+        ref = [e for e in evs if e["event"] == "autoscale_refused"]
+        assert len(dec) == 1 and dec[0]["action"] == SCALE_OUT
+        assert dec[0]["replicas_from"] == 2
+        assert dec[0]["replicas_to"] == 3
+        assert len(ref) == 1 and ref[0]["error"] == "ScaleFailed"
+        assert ref[0]["backoff_s"] == 30.0
+
+    def test_background_loop_start_stop(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.5)
+        with Autoscaler(fleet, self._policy(interval=0.01)) as scaler:
+            deadline = time.monotonic() + 5.0
+            while not scaler.decisions() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert scaler.decisions()
+        assert not fleet.calls     # 0.5 sits in the hysteresis gap
+
+
+class _BlockingFleet(_FakeFleet):
+    """A fake fleet whose scale_to parks on an event — the shape of a
+    real multi-second replica spawn."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def scale_to(self, n, ready_timeout_s=None, reason="requested"):
+        self.entered.set()
+        if not self.release.wait(10.0):
+            raise ScaleFailed("test actuation never released")
+        return super().scale_to(n, ready_timeout_s=ready_timeout_s,
+                                reason=reason)
+
+
+def _poll(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+class TestAsyncActuation:
+    """The background loop's non-blocking transitions: sensing
+    continues through a slow spawn, single-flight is enforced, and
+    the dwell earned during a scale-out spawn is not forfeited."""
+
+    def _policy(self, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("cooldown", 5.0)
+        kw.setdefault("dwell", 10.0)
+        kw.setdefault("backoff", 30.0)
+        kw.setdefault("interval", 0.01)
+        return ScalingPolicy(**kw)
+
+    def test_loop_keeps_sensing_through_blocked_transition(self):
+        fleet = _BlockingFleet(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy()).start()
+        try:
+            assert fleet.entered.wait(5.0)
+            # the spawn is parked — ticks keep coming, each resolving
+            # a typed "transition in flight" hold, none re-actuating
+            assert _poll(lambda: sum(
+                "transition in flight" in d.reason
+                for d in scaler.decisions()) >= 3)
+            assert not fleet.calls
+            fleet.release.set()
+            assert _poll(lambda: fleet.calls)
+            assert fleet.calls[0][:2] == (2, 3)
+            # single-flight + completion-stamped cooldown: pressure
+            # persisted the whole time, yet exactly one transition ran
+            assert _poll(lambda: fleet.metrics.snapshot()["counters"]
+                         .get("autoscale_scale_out_total") == 1)
+            assert len(fleet.calls) == 1
+        finally:
+            scaler.stop()
+
+    def test_async_refusal_parks_loop_in_backoff(self):
+        fleet = _FakeFleet(live=2, queue_ratio=0.9, fail=True)
+        scaler = Autoscaler(fleet, self._policy()).start()
+        try:
+            assert _poll(lambda: fleet.metrics.snapshot()["counters"]
+                         .get("autoscale_refusals_total", 0) >= 1)
+            # the refusal resolution is journaled, then the loop parks
+            assert _poll(lambda: any(
+                "refused" in d.reason for d in scaler.decisions()))
+            assert _poll(lambda: any(
+                "backoff" in d.reason for d in scaler.decisions()))
+            assert "wedged transition" in scaler.last_refusal
+            assert not fleet.calls
+        finally:
+            scaler.stop()
+        # parked exactly once: no re-actuation storm inside backoff
+        assert fleet.metrics.snapshot()["counters"][
+            "autoscale_refusals_total"] == 1
+
+    def test_dwell_earned_during_scale_out_spawn_survives(self):
+        """Calm observed while a scale-out spawns is valid evidence —
+        capacity only increased — so the scale-in fires one cooldown
+        after completion instead of re-earning the dwell from zero."""
+        fleet = _BlockingFleet(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy(
+            dwell=0.3, cooldown=0.05)).start()
+        try:
+            assert fleet.entered.wait(5.0)
+            fleet.admission.ewma = 0.0       # flash passed mid-spawn
+            time.sleep(0.5)                  # > dwell, all in flight
+            assert _poll(lambda: any(
+                "dwell" in d.reason for d in scaler.decisions()))
+            assert not fleet.calls           # still single-flight
+            fleet.release.set()
+            # scale-out lands (2 -> 3), then the pre-earned dwell lets
+            # the scale-in follow after only the cooldown
+            assert _poll(lambda: (3, 2) in
+                         [c[:2] for c in fleet.calls])
+            counters = fleet.metrics.snapshot()["counters"]
+            assert counters["autoscale_scale_out_total"] == 1
+            assert counters["autoscale_scale_in_total"] >= 1
+        finally:
+            scaler.stop()
+
+    def test_stop_joins_inflight_actuation(self):
+        fleet = _BlockingFleet(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy()).start()
+        assert fleet.entered.wait(5.0)
+        fleet.release.set()
+        scaler.stop()                        # joins loop AND actuator
+        assert fleet.calls == [(2, 3, fleet.calls[0][2])]
+
+    def test_sync_step_catches_untyped_wedge(self):
+        """Satellite hardening: ANY exception out of scale_to — not
+        just ScaleFailed — parks the loop typed instead of killing
+        it."""
+        class _Wedged(_FakeFleet):
+            def scale_to(self, n, ready_timeout_s=None,
+                         reason="requested"):
+                raise RuntimeError("transport wedged mid-resize")
+        fleet = _Wedged(live=2, queue_ratio=0.9)
+        scaler = Autoscaler(fleet, self._policy())
+        d = scaler.step(now=100.0)
+        assert d.action == HOLD and "refused" in d.reason
+        assert "transport wedged" in scaler.last_refusal
+        assert fleet.metrics.snapshot()["counters"][
+            "autoscale_refusals_total"] == 1
+        assert scaler.step(now=101.0).reason.startswith("backoff")
+
+
+class TestSupervisorTarget:
+    def test_refusal_is_scalefailed(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="resize", world_size=4, min_world=2,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        target = SupervisorTarget(sup)
+        assert target.live_replicas() == 4
+        with pytest.raises(ScaleFailed, match="below_floor"):
+            target.scale_to(1)
+
+    def test_accepted_resize_queues(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="resize", world_size=4, min_world=2,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        rep = SupervisorTarget(sup).scale_to(3, reason="autoscale")
+        assert rep == {"from": 4, "to": 3, "queued": True}
+        assert sup._resize_request == (3, "autoscale")
+
+    def test_autoscaler_backs_off_on_refused_resize(self, tmp_path):
+        """Satellite 3 edge: a decision landing while the resize
+        budget is exhausted is refused TYPED and the loop parks
+        instead of re-requesting every tick."""
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="resize", world_size=2, min_world=1,
+                         max_resizes=0,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        target = SupervisorTarget(sup)
+        reg = MetricsRegistry()
+        reg.gauge("slot_occupancy").set(0.99)   # pressure signal
+        scaler = Autoscaler(target, ScalingPolicy(
+            min_replicas=1, max_replicas=4, backoff=60.0),
+            registry=reg)
+        d = scaler.step(now=100.0)
+        assert d.action == HOLD and "budget_exhausted" in d.reason
+        assert reg.snapshot()["counters"][
+            "autoscale_refusals_total"] == 1
+        assert sup._resize_request is None
+        assert scaler.step(now=130.0).reason.startswith("backoff")
+
+
+# -- slow: chaos-composed scaling edges on real replicas ---------------------
+
+def _fleet(tmp_path, n=2, **kw):
+    factory = tmp_path / "factory.py"
+    factory.write_text(FACTORY)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("buckets", (1, 8))
+    kw.setdefault("batch_timeout_ms", 2)
+    kw.setdefault("input_specs", [((8,), "float32")])
+    kw.setdefault("warmup", True)
+    kw.setdefault("hang_timeout", 30.0)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("version", "v1")
+    kw.setdefault("model_arg", "v1")
+    kw.setdefault("retry_max", 3)
+    kw.setdefault("replica_timeout_ms", 60000)
+    kw.setdefault("inflight_per_replica", 4)
+    env = kw.pop("env", {})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return ServingFleet(f"{factory}:make_model", replicas=n, env=env,
+                        work_dir=str(tmp_path / "fleet"), **kw)
+
+
+@pytest.mark.slow
+class TestChaosScalingEdges:
+    def test_scale_in_races_inflight_deploy_canary(self, tmp_path):
+        """Satellite 3 edge 1: a scale-in issued while a deploy canary
+        is in flight serializes behind the deploy mutex — it retires
+        ranks the finished roll owns, never ranks mid-swap, and the
+        drain identity holds across both transitions."""
+        fleet = _fleet(tmp_path, n=3)
+        fleet.start()
+        try:
+            done = {}
+
+            def roll():
+                done["deploy"] = fleet.deploy(
+                    fleet.model_spec, "v2", model_arg="v2",
+                    canary=[np.zeros((1, 8), np.float32)])
+            t = threading.Thread(target=roll)
+            t.start()
+            time.sleep(0.2)          # let the canary take the mutex
+            rep = fleet.scale_to(2, reason="autoscale scale-in")
+            t.join(timeout=300)
+            assert done["deploy"]["rolled"] == 3
+            assert rep["from"] == 3 and rep["to"] == 2
+            assert fleet.live_replicas() == 2
+            # the survivors serve v2: the scale-in retired rolled
+            # replicas, not the mid-swap window
+            fut = fleet.submit(np.zeros((1, 8), np.float32))
+            fut.result(timeout=300)
+            assert fut.version == "v2"
+        finally:
+            report = fleet.drain()
+        assert report["unaccounted"] == 0, report
+        assert report["errors"] == 0
+
+    def test_flash_crowd_lands_mid_scale_out(self, tmp_path):
+        """Satellite 3 edge 2: a burst arriving while scale_to is
+        still spawning keeps resolving on the existing capacity (or
+        sheds TYPED) — nothing is lost in the transition window."""
+        fleet = _fleet(tmp_path, n=1, fleet_queue_depth=32)
+        fleet.start()
+        try:
+            rng = np.random.default_rng(3)
+            xs = [rng.standard_normal((1, 8)).astype(np.float32)
+                  for _ in range(16)]
+            outcome = {"ok": 0, "shed": 0, "failures": []}
+            stop = threading.Event()
+
+            def crowd():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        fleet.submit(xs[i % 16]).result(timeout=300)
+                        outcome["ok"] += 1
+                    except ServerOverloaded:
+                        outcome["shed"] += 1   # typed back-pressure
+                    except Exception as e:  # noqa: broad-except — any
+                        # OTHER failure during the resize window fails
+                        # the zero-loss gate below
+                        outcome["failures"].append(repr(e))
+            threads = [threading.Thread(target=crowd)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            try:
+                rep = fleet.scale_to(3, reason="flash crowd")
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=300)
+            assert rep["to"] == 3 and len(rep["added"]) == 2
+            assert fleet.ready_replicas() == 3
+            assert not outcome["failures"], outcome["failures"][:3]
+            assert outcome["ok"] >= 1
+        finally:
+            report = fleet.drain()
+        assert report["unaccounted"] == 0, report
+
+    def test_decision_during_restart_budget_exhaustion(self, tmp_path):
+        """Satellite 3 edge 3: a replica dies with its restart budget
+        spent (stays FAILED), the autoscaler's next decision still
+        actuates — scale-out spawns a FRESH rank (new budget), live
+        capacity recovers, and the whole episode drains accounted."""
+        fleet = _fleet(tmp_path, n=2, max_restarts=0,
+                       fleet_queue_depth=32,
+                       chaos_spec="replica_kill@3:1")
+        fleet.start()
+        try:
+            rng = np.random.default_rng(4)
+            xs = [rng.standard_normal((1, 8)).astype(np.float32)
+                  for _ in range(20)]       # burst < queue cap 32
+            futs = [fleet.submit(x) for x in xs]
+            for f in futs:
+                f.result(timeout=300)       # kill fires; failover eats it
+            deadline = time.monotonic() + 60.0
+            while fleet.live_replicas() > 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert fleet.live_replicas() == 1   # budget spent, not back
+            scaler = Autoscaler(
+                fleet, ScalingPolicy(min_replicas=1, max_replicas=3,
+                                     queue_hi=0.5, queue_lo=0.1))
+            for _ in range(10):                  # pressure: EWMA ramps
+                fleet.admission.observe(32)      # to ~0.89 of depth
+            d = scaler.step(now=100.0)
+            assert d.action == SCALE_OUT and d.target == 2
+            assert fleet.ready_replicas() == 2
+            fut = fleet.submit(xs[0])
+            fut.result(timeout=300)
+        finally:
+            report = fleet.drain()
+        assert report["unaccounted"] == 0, report
+        assert report["errors"] == 0
+        assert report["replica_restarts"] == 0   # budget was zero

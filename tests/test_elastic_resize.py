@@ -485,6 +485,59 @@ class TestSupervisorResize:
                    for r in sup.report.resizes)
 
 
+class TestResizeRefusedTyped:
+    """ISSUE 18 satellite: refusals are a typed result + counter, not
+    a stderr string — the autoscaler backs off on `reason`."""
+
+    def _counters(self):
+        from paddle1_tpu.obs import registry as obs_registry
+        return obs_registry.process_registry().snapshot()["counters"]
+
+    def test_below_floor_refused_typed(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import (
+            Supervisor, ResizeRefused, RESIZE_BELOW_FLOOR)
+        sup = Supervisor(policy="resize", world_size=4, min_world=2,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        before = self._counters().get("ft_resize_refusals_total", 0)
+        r = sup.request_resize(1, "scale-in")
+        assert isinstance(r, ResizeRefused)
+        assert r.reason == RESIZE_BELOW_FLOOR
+        assert r.requested == 1 and r.limit == 2
+        assert sup._resize_request is None  # refused, never queued
+        assert sup.report.resize_refusals == [
+            {"requested": 1, "reason": RESIZE_BELOW_FLOOR, "limit": 2}]
+        assert sup.report.as_dict()["resize_refusals"]
+        after = self._counters()
+        assert after.get("ft_resize_refusals_total", 0) == before + 1
+        assert after.get("ft_resize_refused_below_floor_total", 0) >= 1
+
+    def test_budget_exhausted_refused_typed(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import (
+            Supervisor, ResizeRefused, RESIZE_BUDGET_EXHAUSTED)
+        sup = Supervisor(policy="resize", world_size=4, min_world=1,
+                         max_resizes=0,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        r = sup.request_resize(6, "scale-out")
+        assert isinstance(r, ResizeRefused)
+        assert r.reason == RESIZE_BUDGET_EXHAUSTED
+        assert r.requested == 6 and r.limit == 0
+        assert sup._resize_request is None
+        assert self._counters().get(
+            "ft_resize_refused_budget_exhausted_total", 0) >= 1
+
+    def test_accepted_and_noop_requests_return_none(self, tmp_path):
+        from paddle1_tpu.distributed.supervisor import Supervisor
+        sup = Supervisor(policy="resize", world_size=4, min_world=2,
+                         max_resizes=2,
+                         heartbeat_dir=str(tmp_path / "hb"))
+        assert sup.request_resize(3, "scale-in") is None
+        assert sup._resize_request == (3, "scale-in")
+        # a same-size request is a no-op, not a refusal — even with
+        # the budget spent
+        sup.max_resizes = 0
+        assert sup.request_resize(4, "noop") is None
+
+
 @pytest.mark.slow
 class TestElasticResizeParity:
     def test_live_resize_8_6_8_parity(self):

@@ -657,3 +657,41 @@ class TestGenFleetSubprocessMatrix:
         assert rep["unaccounted"] == 0, rep
         snap = fleet.metrics.snapshot()["counters"]
         assert snap.get("gen_fleet_rollbacks_total", 0) == 1
+
+    def test_scale_to_migrates_streams_bit_identical(self, tmp_path):
+        """ISSUE 18: replica count is the generative fleet's slot/page
+        actuator. Scale-out under live chaos-slowed streams adds
+        capacity without touching them; the scale-in that follows
+        drains its rank's in-flight streams by bit-identical replay —
+        both transitions counted and the ledger balanced."""
+        specs = _specs(4, max_new=32)
+        ref = _reference(specs)
+        slow = ",".join(f"gen_slow_step@{i}" for i in range(1, 400))
+        fleet = _make_genfleet(
+            tmp_path, n=2, streams_per_replica=2, chaos_spec=slow,
+            env={"JAX_PLATFORMS": "cpu",
+                 "FLAGS_serve_chaos_slow_s": "0.3"})
+        fleet.start()
+        try:
+            streams = [fleet.submit(s["prompt"],
+                                    max_new_tokens=s["max_new"],
+                                    temperature=s.get("temperature",
+                                                      0.0),
+                                    top_k=s.get("top_k", 0),
+                                    seed=s["seed"])
+                       for s in specs]
+            time.sleep(1.0)  # streams are mid-decode when we scale
+            up = fleet.scale_to(3, reason="autoscale out")
+            assert up["from"] == 2 and len(up["added"]) == 1
+            assert fleet.ready_replicas() == 3
+            down = fleet.scale_to(2, reason="autoscale in")
+            assert down["retired"] == [2]
+            outs = [st.result(timeout=300) for st in streams]
+        finally:
+            rep = fleet.drain()
+        assert outs == ref
+        assert rep["unaccounted"] == 0, rep
+        assert rep["errors"] == 0 and rep["stream_failed"] == 0
+        snap = fleet.metrics.snapshot()["counters"]
+        assert snap["scale_out_total"] == 1
+        assert snap["scale_in_total"] == 1

@@ -148,6 +148,33 @@ class TestExpositionConformance:
         types, _ = parse_exposition(m.render_text())
         assert types["p1t_train_dispatch_seconds"] == "summary"
 
+    def test_autoscale_families_conform(self):
+        """ISSUE 18: the control loop's families — the queue-EWMA
+        gauge the fleet sweep publishes plus every autoscale_* name —
+        render as conformant exposition with the right kinds."""
+        m = obs.MetricsRegistry()
+        m.gauge("serve_queue_depth_ewma").set(3.2)
+        m.gauge("serve_replicas_live").set(3)
+        m.counter("autoscale_decisions_total").inc(4)
+        m.counter("autoscale_scale_out_total").inc()
+        m.counter("autoscale_refusals_total").inc()
+        m.gauge("autoscale_queue_ratio").set(0.4)
+        m.gauge("autoscale_burn_max_ratio").set(0.8)
+        m.gauge("autoscale_target_replicas").set(3)
+        m.histogram("autoscale_decision_seconds").observe(0.0004)
+        types, samples = parse_exposition(m.render_text())
+        assert types["p1t_serving_serve_queue_depth_ewma"] == "gauge"
+        assert types["p1t_serving_serve_replicas_live"] == "gauge"
+        assert types["p1t_serving_autoscale_decisions_total"] \
+            == "counter"
+        assert types["p1t_serving_autoscale_queue_ratio"] == "gauge"
+        assert types["p1t_serving_autoscale_target_replicas"] \
+            == "gauge"
+        assert types["p1t_serving_autoscale_decision_seconds"] \
+            == "summary"
+        names = {n for n, _ in samples}
+        assert "p1t_serving_autoscale_decision_seconds_sum" in names
+
     def test_group_page_untyped_labeled(self):
         g = obs.MetricsGroup("version")
         self._populated(g.child("v1"))

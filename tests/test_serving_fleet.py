@@ -667,3 +667,68 @@ class TestFleetSubprocessMatrix:
         assert "shed_priority_0" not in counters, counters
         adaptive_prios = {p for p, adaptive in sheds if adaptive}
         assert adaptive_prios and 0 not in adaptive_prios
+
+    def test_scale_to_zero_downtime_both_directions(self, tmp_path):
+        """ISSUE 18: scale_to grows then shrinks the fleet under load
+        with zero client-visible failures; scale counters, the
+        serve_replicas_* / serve_queue_depth_ewma gauges, and the
+        fleet_scale event journal all record the transitions."""
+        from paddle1_tpu.obs import events as obs_events
+        journal = str(tmp_path / "events.jsonl")
+        os.environ[obs_events.EVENTS_ENV] = journal
+        fleet = _make_fleet(tmp_path, n=1, retry_max=3,
+                            replica_timeout_ms=60000)
+        try:
+            fleet.start()
+            rng = np.random.default_rng(5)
+            xs = [rng.standard_normal((1, 8)).astype(np.float32)
+                  for _ in range(16)]
+            stop = threading.Event()
+            failures, ok = [], [0]
+
+            def pump():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        fleet.submit(xs[i % 16]).result(timeout=300)
+                        ok[0] += 1
+                    except Exception as e:  # noqa: broad-except — ANY
+                        # failure during either transition fails the
+                        # zero-downtime gate below
+                        failures.append(repr(e))
+            threads = [threading.Thread(target=pump) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                up = fleet.scale_to(3, reason="test scale-out")
+                down = fleet.scale_to(2, reason="test scale-in")
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=300)
+            assert up["from"] == 1 and up["to"] == 3
+            assert len(up["added"]) == 2 and not up["retired"]
+            assert down["from"] == 3 and down["to"] == 2
+            assert down["retired"] == [2]    # highest rank drains out
+            assert fleet.live_replicas() == fleet.ready_replicas() == 2
+            assert not failures, failures[:3]
+            assert ok[0] >= 1
+            snap = fleet.metrics.snapshot()
+            assert snap["counters"]["scale_out_total"] == 1
+            assert snap["counters"]["scale_in_total"] == 1
+            assert snap["gauges"]["serve_replicas_live"] == 2
+            # the sweep publishes the admission EWMA as a first-class
+            # gauge (ISSUE 18 satellite): present and finite
+            assert snap["gauges"]["serve_queue_depth_ewma"] >= 0.0
+            evs = [e for e in obs_events.read_events(journal)
+                   if e["event"] == "fleet_scale"]
+            assert [(e["replicas_from"], e["replicas_to"])
+                    for e in evs] == [(1, 3), (3, 2)]
+            assert all(e["kind"] == "serving" and not e["refused"]
+                       for e in evs)
+        finally:
+            os.environ.pop(obs_events.EVENTS_ENV, None)
+            rep = fleet.drain()
+        assert rep["unaccounted"] == 0, rep
+        assert rep["errors"] == 0

@@ -1,0 +1,201 @@
+"""Production-day traffic simulator (ISSUE 18): grammar, schedule
+determinism, rate composition, and the open-loop runner's accounting
+identities. Everything here is fast — the runner is driven with
+in-process fake futures at high ``speed`` so no replica ever spawns.
+"""
+
+import time
+
+import pytest
+
+from paddle1_tpu.core.errors import InvalidArgumentError
+from paddle1_tpu.serving import ServerOverloaded, TrafficModel, parse_traffic
+from paddle1_tpu.serving.errors import DeadlineExceeded
+from paddle1_tpu.serving.traffic import FlashCrowd, run, schedule
+
+
+class TestGrammar:
+    def test_empty_spec_is_defaults(self):
+        assert parse_traffic("") == TrafficModel()
+
+    def test_full_grammar_roundtrip(self):
+        m = parse_traffic("rps=40;dur=30;diurnal=0.3;"
+                          "flash=10x@12+6,8x@20+2;tail=1.5;len=8:512;"
+                          "prio=0:0.7,1:0.2,2:0.1;deadline=250;seed=7")
+        assert m.rps == 40 and m.duration_s == 30 and m.diurnal == 0.3
+        assert m.flash == (FlashCrowd(12, 6, 10), FlashCrowd(20, 2, 8))
+        assert m.tail_alpha == 1.5
+        assert (m.len_min, m.len_max) == (8, 512)
+        assert m.priorities == ((0, 0.7), (1, 0.2), (2, 0.1))
+        assert m.deadline_ms == 250 and m.seed == 7
+
+    def test_unknown_key_typed(self):
+        with pytest.raises(InvalidArgumentError, match="qps"):
+            parse_traffic("qps=40")
+
+    def test_bad_flash_clause_typed(self):
+        with pytest.raises(InvalidArgumentError, match="flash"):
+            parse_traffic("flash=10x12")
+
+    def test_bad_value_typed(self):
+        with pytest.raises(InvalidArgumentError, match="rps=fast"):
+            parse_traffic("rps=fast")
+
+    def test_full_amplitude_diurnal_typed(self):
+        with pytest.raises(InvalidArgumentError, match="diurnal"):
+            TrafficModel(diurnal=1.0)
+
+    def test_degenerate_lengths_typed(self):
+        with pytest.raises(InvalidArgumentError, match="len_min"):
+            TrafficModel(len_min=10, len_max=2)
+
+    def test_nonpositive_priority_weight_typed(self):
+        with pytest.raises(InvalidArgumentError, match="priorities"):
+            TrafficModel(priorities=((0, 0.0),))
+
+
+class TestRateComposition:
+    def test_flash_multiplies_inside_window_only(self):
+        m = TrafficModel(rps=10, duration_s=100,
+                         flash=(FlashCrowd(40, 10, 10),))
+        assert m.rate_at(39.9) == pytest.approx(10.0)
+        assert m.rate_at(45.0) == pytest.approx(100.0)
+        assert m.rate_at(50.0) == pytest.approx(10.0)  # half-open end
+
+    def test_diurnal_peaks_mid_day(self):
+        m = TrafficModel(rps=10, duration_s=100, diurnal=0.4)
+        assert m.rate_at(25.0) == pytest.approx(14.0)  # sin peak
+        assert m.rate_at(75.0) == pytest.approx(6.0)   # sin trough
+        assert m.peak_rate() == pytest.approx(14.0)
+
+    def test_peak_rate_bounds_every_instant(self):
+        m = parse_traffic("rps=20;dur=60;diurnal=0.3;"
+                          "flash=10x@12+6,4x@40+5")
+        peak = m.peak_rate()
+        assert all(m.rate_at(t / 10.0) <= peak + 1e-9
+                   for t in range(600))
+
+
+class TestSchedule:
+    def test_same_seed_same_day(self):
+        m = parse_traffic("rps=50;dur=10;diurnal=0.2;flash=5x@4+2;"
+                          "len=4:64;prio=0:0.5,1:0.5;seed=11")
+        assert schedule(m) == schedule(m)
+
+    def test_different_seed_different_day(self):
+        a = schedule(TrafficModel(rps=50, duration_s=10, seed=1))
+        b = schedule(TrafficModel(rps=50, duration_s=10, seed=2))
+        assert a != b
+
+    def test_arrival_fields_in_bounds(self):
+        m = parse_traffic("rps=100;dur=10;len=4:64;"
+                          "prio=1:0.5,2:0.5;deadline=250;seed=3")
+        arrivals = schedule(m)
+        assert arrivals, "a 100rps/10s day produced no arrivals"
+        assert all(0 <= a.t < 10 for a in arrivals)
+        assert all(4 <= a.length <= 64 for a in arrivals)
+        assert all(a.priority in (1, 2) for a in arrivals)
+        assert all(a.deadline_ms == 250 for a in arrivals)
+        assert {a.priority for a in arrivals} == {1, 2}
+        # arrivals come out time-ordered (one thinned Poisson pass)
+        assert all(x.t <= y.t
+                   for x, y in zip(arrivals, arrivals[1:]))
+
+    def test_volume_tracks_offered_rate(self):
+        n = len(schedule(TrafficModel(rps=200, duration_s=5, seed=5)))
+        # Poisson(1000): +/-5 sigma ~ 158 — generous, deterministic
+        assert 840 <= n <= 1160, n
+
+    def test_flash_concentrates_volume(self):
+        m = TrafficModel(rps=40, duration_s=20,
+                         flash=(FlashCrowd(8, 4, 10),), seed=9)
+        arrivals = schedule(m)
+        in_flash = sum(1 for a in arrivals if 8 <= a.t < 12)
+        # the 20% flash window carries ~71% of the day at 10x
+        assert in_flash / len(arrivals) > 0.5
+
+    def test_heavy_tail_is_heavy(self):
+        m = TrafficModel(rps=400, duration_s=5, tail_alpha=1.1,
+                         len_min=8, len_max=512, seed=13)
+        lengths = [a.length for a in schedule(m)]
+        # Pareto(1.1) from 8: most mass near the floor, a real tail
+        assert sum(1 for v in lengths if v < 32) > len(lengths) * 0.5
+        assert max(lengths) > 128
+
+
+class _Future:
+    def __init__(self, fail=None, delay_s=0.0):
+        self._fail = fail
+        self._delay = delay_s
+
+    def result(self, timeout=None):
+        if self._delay:
+            time.sleep(self._delay)
+        if self._fail is not None:
+            raise self._fail
+        return object()
+
+
+class TestRunner:
+    def _day(self, rps=400, dur=2.0, seed=0):
+        return schedule(TrafficModel(rps=rps, duration_s=dur,
+                                     seed=seed))
+
+    def test_accounting_identities(self):
+        arrivals = self._day()
+        state = {"n": 0}
+
+        def submit(a):
+            state["n"] += 1
+            if state["n"] % 7 == 0:
+                raise ServerOverloaded("shed (test)")
+            if state["n"] % 13 == 0:
+                raise RuntimeError("router crashed (test)")
+            if state["n"] % 11 == 0:
+                return _Future(fail=DeadlineExceeded("late (test)"))
+            return _Future()
+        stats = run(arrivals, submit, speed=50.0)
+        assert stats["offered"] == len(arrivals)
+        assert stats["offered"] == (stats["admitted"] + stats["shed"]
+                                    + stats["submit_failed"])
+        assert stats["admitted"] == stats["completed"] + stats["errors"]
+        assert stats["shed"] >= 1 and stats["submit_failed"] >= 1
+        assert stats["error_types"] == {
+            "DeadlineExceeded": stats["errors"]}
+        assert stats["latency_ms"]["n"] == stats["completed"]
+
+    def test_clean_run_all_complete(self):
+        arrivals = self._day(rps=200, dur=1.0)
+        stats = run(arrivals, lambda a: _Future(), speed=50.0)
+        assert stats["completed"] == stats["offered"] == len(arrivals)
+        assert stats["shed"] == stats["errors"] == 0
+        assert stats["latency_ms"]["p99"] >= stats["latency_ms"]["p50"]
+
+    def test_open_loop_keeps_offering_through_failures(self):
+        # every submit raises: an open-loop generator must offer the
+        # WHOLE day anyway (closed-loop would stall on the first)
+        arrivals = self._day(rps=200, dur=1.0)
+        stats = run(arrivals, lambda a: (_ for _ in ()).throw(
+            RuntimeError("fleet is gone")), speed=50.0)
+        assert stats["submit_failed"] == stats["offered"]
+        assert stats["completed"] == 0
+
+    def test_on_tick_fires_through_the_day(self):
+        arrivals = self._day(rps=400, dur=2.0)
+        ticks = []
+        run(arrivals, lambda a: _Future(), speed=4.0,
+            on_tick=ticks.append, tick_s=0.05)
+        assert len(ticks) >= 5
+        assert ticks == sorted(ticks)
+
+    def test_slow_completions_do_not_block_submission(self):
+        # completions take 50ms each; at speed 50 the whole day's
+        # submissions finish LONG before the collectors drain — the
+        # submit thread must never wait on a result
+        arrivals = self._day(rps=100, dur=1.0)
+        t0 = time.monotonic()
+        stats = run(arrivals, lambda a: _Future(delay_s=0.05),
+                    speed=50.0, collectors=32)
+        assert stats["completed"] == stats["offered"]
+        assert stats["lateness_p99_ms"] < 5000
+        assert time.monotonic() - t0 < 60

@@ -19,7 +19,11 @@ what the Prometheus exposition (and the conformance test) depend on:
   ``_byte``/``_kb``/``_mb``/``_gb``, ``_ratio`` not
   ``_pct``/``_percent``/``_frac``/``_fraction`` — the cost/HBM/SLO
   gauge families (``hbm_*_bytes``, ``*_coverage_ratio``,
-  ``slo_*_burn_rate_ratio``) depend on dashboards keying one spelling;
+  ``slo_*_burn_rate_ratio``) and the control-loop families (ISSUE 18:
+  ``autoscale_*_total`` counters, ``autoscale_*_ratio`` /
+  ``autoscale_target_replicas`` / ``serve_queue_depth_ewma`` gauges,
+  the ``autoscale_decision_seconds`` histogram) depend on dashboards
+  keying one spelling;
 * **one family, one kind** across every module (the registry enforces
   it per instance at runtime; the lint catches cross-module collisions
   before they meet in one registry).
