@@ -35,7 +35,7 @@ from . import ps_server
 from .ps_server import RemoteTable, TableServer, remote_service
 from . import communicator
 from .communicator import (AsyncCommunicator, DenseEndpoint,
-                           GeoCommunicator)
+                           GeoCommunicator, SparseAsyncCommunicator)
 from . import checkpoint
 from .checkpoint import CheckpointManager, load_sharded, save_sharded
 from . import resilience
@@ -45,7 +45,11 @@ from .supervisor import MpProcessHandle, Supervisor, SupervisorReport
 from . import graph_table
 from .graph_table import GraphTable
 from . import hbm_embedding
-from .hbm_embedding import HBMShardedEmbedding
+from .hbm_embedding import HBMShardedEmbedding, hash_bucket
+from . import embedding_engine
+from .embedding_engine import ShardedEmbeddingEngine
+from . import embedding_delta
+from .embedding_delta import DeltaLog, DeltaRecord, DeltaSubscriber
 
 
 def __getattr__(name):
@@ -82,7 +86,12 @@ __all__ = ["env", "get_rank", "get_world_size", "spmd_axes",
            "ps_server", "TableServer", "RemoteTable", "remote_service",
            "checkpoint", "CheckpointManager", "save_sharded",
            "load_sharded", "resilience", "ResilientTrainer",
-           "ResilienceReport", "BadStepError", "graph_table", "GraphTable"]
+           "ResilienceReport", "BadStepError", "graph_table", "GraphTable",
+           "HBMShardedEmbedding", "hash_bucket", "embedding_engine",
+           "ShardedEmbeddingEngine", "embedding_delta", "DeltaLog",
+           "DeltaRecord", "DeltaSubscriber", "communicator",
+           "AsyncCommunicator", "GeoCommunicator",
+           "SparseAsyncCommunicator", "DenseEndpoint", "DenseTable"]
 
 
 # -- PS-era dataset + sparse-table entry configs (reference

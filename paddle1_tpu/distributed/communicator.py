@@ -36,7 +36,8 @@ import numpy as np
 
 from ..core.errors import PreconditionNotMetError
 
-__all__ = ["DenseEndpoint", "AsyncCommunicator", "GeoCommunicator"]
+__all__ = ["DenseEndpoint", "AsyncCommunicator", "GeoCommunicator",
+           "SparseAsyncCommunicator"]
 
 _log = logging.getLogger("paddle1_tpu.communicator")
 
@@ -216,6 +217,205 @@ class AsyncCommunicator:
                     return  # recv() keeps serving the last good cache
                 time.sleep(min(0.1 * 2 ** failures, 2.0))
             time.sleep(self._pull_interval)
+
+
+class SparseAsyncCommunicator:
+    """Async PS mode for SPARSE tables (ISSUE 19 tentpole (c)): the
+    host-tier pull/push overlaps the device step instead of
+    synchronizing around it — the sparse half of the reference
+    AsyncCommunicator (communicator.cc SendSparse/RecvSparse).
+
+    * ``push(ids, grads)`` enqueues one step's sparse gradient and
+      returns immediately; a background thread drains the bounded
+      queue, COALESCING duplicate ids across up to ``merge_num``
+      queued pushes into one wire push (one in-table optimizer step
+      per unique id per drain — SparseTable's own dedup handles
+      within-push duplicates, this merges across steps).
+    * ``prefetch(ids)`` starts pulling next step's rows concurrently;
+      ``pulled(ids)`` returns them, waiting only if the prefetch
+      hasn't landed.
+    * **Bounded staleness**: at most ``max_staleness`` pushed-but-
+      unapplied steps may be outstanding — ``push`` blocks past the
+      bound (the reference's barrier on send queue depth), and
+      ``staleness()`` exposes the live count for verification.
+    * ``flush()`` drains synchronously (epoch end / checkpoint);
+      ``state_dict`` flushes first, so the PR 2 manifest protocol
+      checkpoints a quiesced stream (no gradient rides only the
+      queue).
+    """
+
+    def __init__(self, service, merge_num: int = 4,
+                 max_staleness: int = 8,
+                 send_interval: float = 0.002):
+        if max_staleness < 1:
+            raise ValueError("max_staleness must be >= 1")
+        self.service = service
+        self._merge_num = max(1, int(merge_num))
+        self.max_staleness = int(max_staleness)
+        self._send_interval = float(send_interval)
+        self._q: "queue.Queue" = queue.Queue()
+        self._outstanding = 0            # guarded-by: self._cond
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fatal: Optional[BaseException] = None
+        self._max_retries = 5
+        self.pushed_total = 0
+        self.applied_total = 0
+        # prefetch: one in-flight (ids, future-rows) slot
+        self._pf_lock = threading.Lock()
+        self._pf_ids: Optional[np.ndarray] = None
+        self._pf_rows = None
+        self._pf_event = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "SparseAsyncCommunicator":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._send_loop,
+                                        daemon=True,
+                                        name="sparse-async-comm")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # -- trainer surface ----------------------------------------------------
+
+    def push(self, ids, grads) -> None:
+        """Enqueue one step's sparse gradient; blocks only when the
+        staleness bound is reached (backpressure, not loss)."""
+        if self._thread is None or not self._thread.is_alive():
+            raise PreconditionNotMetError(
+                "SparseAsyncCommunicator.push before start() (or after "
+                f"a fatal send error: {self._fatal!r})")
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], -1)
+        with self._cond:
+            while self._outstanding >= self.max_staleness:
+                if self._fatal is not None:
+                    raise PreconditionNotMetError(
+                        "SparseAsyncCommunicator send thread is down: "
+                        f"{self._fatal}")
+                self._cond.wait(timeout=1.0)
+            self._outstanding += 1
+            self.pushed_total += 1
+        self._q.put((ids, grads))
+
+    def staleness(self) -> int:
+        """Pushed-but-unapplied steps right now (≤ max_staleness)."""
+        with self._cond:
+            return self._outstanding
+
+    def prefetch(self, ids) -> None:
+        """Start pulling rows for ``ids`` concurrently with the device
+        step; one slot — a new prefetch replaces an unclaimed one."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._pf_lock:
+            self._pf_ids, self._pf_rows = ids, None
+            self._pf_event.clear()
+
+        def _pull(want=ids):
+            rows = self.service.pull(want)
+            with self._pf_lock:
+                if self._pf_ids is not None and \
+                        np.array_equal(self._pf_ids, want):
+                    self._pf_rows = rows
+                    self._pf_event.set()
+        threading.Thread(target=_pull, daemon=True).start()
+
+    def pulled(self, ids, timeout: float = 30.0) -> np.ndarray:
+        """Rows for ``ids``: the prefetched block when it matches,
+        else a direct (synchronous) pull."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._pf_lock:
+            match = self._pf_ids is not None and \
+                np.array_equal(self._pf_ids, ids)
+        if match:
+            if not self._pf_event.wait(timeout):
+                raise PreconditionNotMetError(
+                    f"prefetch did not land within {timeout}s")
+            with self._pf_lock:
+                rows, self._pf_ids, self._pf_rows = \
+                    self._pf_rows, None, None
+            if rows is not None:
+                return rows
+        return self.service.pull(ids)
+
+    def flush(self) -> None:
+        """Apply every queued push NOW (synchronous barrier)."""
+        self._drain(limit=None)
+        with self._cond:
+            if self._fatal is not None:
+                raise PreconditionNotMetError(
+                    f"SparseAsyncCommunicator: {self._fatal}")
+
+    # -- persistence (quiesce, then delegate to the service) ----------------
+
+    def state_dict(self) -> dict:
+        self.flush()
+        return {"service": self.service.state_dict(),
+                "pushed_total": self.pushed_total,
+                "applied_total": self.applied_total}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.flush()
+        self.service.load_state_dict(state["service"])
+        self.pushed_total = int(state.get("pushed_total", 0))
+        self.applied_total = int(state.get("applied_total", 0))
+
+    # -- internals ----------------------------------------------------------
+
+    def _drain(self, limit: Optional[int]) -> None:
+        """Pop up to ``limit`` (None = all) queued pushes, coalesce
+        duplicate ids across them, and push once."""
+        batch = []
+        while limit is None or len(batch) < limit:
+            try:
+                batch.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        if not batch:
+            return
+        ids = np.concatenate([b[0] for b in batch])
+        grads = np.concatenate([b[1] for b in batch])
+        try:
+            self.service.push(ids, grads)
+        except BaseException:
+            with self._cond:   # free the backpressure before retrying
+                self._outstanding -= len(batch)
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            self._outstanding -= len(batch)
+            self.applied_total += len(batch)
+            self._cond.notify_all()
+
+    def _send_loop(self) -> None:
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                self._drain(limit=self._merge_num)
+                failures = 0
+            except Exception as e:
+                failures += 1
+                _log.warning("sparse communicator push failed "
+                             "(%d/%d): %s", failures,
+                             self._max_retries, e)
+                if failures >= self._max_retries:
+                    self._fatal = e
+                    with self._cond:
+                        self._cond.notify_all()
+                    return
+                time.sleep(min(0.1 * 2 ** failures, 2.0))
+            time.sleep(self._send_interval)
 
 
 class GeoCommunicator:

@@ -1,0 +1,454 @@
+"""Sharded embedding engine: the LFU/TTL admission–eviction bridge
+between the HBM tier and the host/remote table tiers (ISSUE 19
+tentpole; reference heter_ps/heter_comm.h HeterComm + ps_gpu_wrapper's
+build/pull/push pass structure).
+
+The reference trains "trillions of parameters" by keeping the *hot*
+working set of embedding rows in accelerator memory and the long tail
+on host RAM / remote parameter servers. The TPU-native analog:
+
+* the hot tier is an :class:`~paddle1_tpu.distributed.hbm_embedding.
+  HBMShardedEmbedding` — a fixed-capacity row-sharded device table
+  trained in-graph at one dispatch per step;
+* this engine owns the **logical id → HBM slot** mapping. ``route()``
+  is called on the input pipeline (host side, outside the jitted
+  step): it admits misses by *moving* the row (plus optimizer slots
+  and adam step counts) out of the host tier (``EmbeddingService``,
+  whose shards may be remote ``TableServer`` clients — the cluster
+  tier), and demotes LFU/TTL victims back down the same way. A row
+  therefore lives in **exactly one tier at a time** — the
+  exactly-once accounting the bench gate asserts
+  (``admit_total - demote_total == resident``);
+* occupancy is a first-class sensor: the engine registers with the
+  PR 13 HBM census under the ``embed`` subsystem (logical occupancy —
+  resident rows × row bytes; the fixed weight *allocation* stays
+  attributed to ``params`` by the ParallelEngine registration) and
+  publishes the ``embed_*`` gauge/counter families;
+* ``drain_dirty()`` yields the per-step changed rows for the
+  online-learning delta path (``embedding_delta.DeltaLog``).
+
+Binding: by default the engine reads/writes rows through the layer's
+``rows``/``write_rows`` (eager tests, serving). After constructing a
+:class:`~paddle1_tpu.distributed.parallel_engine.ParallelEngine`, call
+:meth:`bind_engine` — the live rows then move into the engine's
+``params``/``opt_state`` buffers (which ride the jitted step as
+arguments, so host-side admission writes never retrace).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..core.errors import PreconditionNotMetError
+
+__all__ = ["ShardedEmbeddingEngine"]
+
+
+class _Occupancy:
+    """A census leaf whose ``nbytes`` is the engine's LOGICAL HBM
+    occupancy (resident rows × row bytes). The weight's fixed
+    allocation belongs to ``params``; this reports what the admission
+    controller is actually using of it."""
+
+    def __init__(self, engine: "ShardedEmbeddingEngine"):
+        self._e = engine
+
+    @property
+    def nbytes(self) -> int:
+        return self._e.resident_rows * self._e.row_bytes
+
+
+class ShardedEmbeddingEngine:
+    """Admission/eviction controller over (HBM layer, host service).
+
+    Parameters
+    ----------
+    hbm : HBMShardedEmbedding — the hot tier (capacity =
+        ``hbm.vocab_size`` slots).
+    host : EmbeddingService — the capacity tier (its shards may be
+        RemoteTables — then demotion crosses the wire to the cluster
+        tier).
+    hbm_row_budget : admission ceiling in rows (≤ capacity; default =
+        capacity). The bench gate holds census occupancy to this.
+    ttl_s : seconds of idleness after which a resident row demotes on
+        the next ``route``/``sweep_ttl`` (None = LFU pressure only).
+    metrics : optional obs registry for the ``embed_*`` families.
+    """
+
+    def __init__(self, hbm, host, hbm_row_budget: Optional[int] = None,
+                 ttl_s: Optional[float] = None, metrics=None):
+        self.hbm = hbm
+        self.host = host
+        cap = int(hbm.vocab_size)
+        self.capacity = cap
+        self.budget = cap if hbm_row_budget is None \
+            else min(int(hbm_row_budget), cap)
+        if self.budget < 1:
+            raise ValueError("hbm_row_budget must be >= 1")
+        if getattr(host, "dim", None) is not None and \
+                int(host.dim) != int(hbm.embedding_dim):
+            raise ValueError(
+                f"host tier dim={host.dim} != HBM tier dim="
+                f"{hbm.embedding_dim} — the tiers disagree on row width")
+        self.dim = int(hbm.embedding_dim)
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        self._slot_of: Dict[int, int] = {}   # logical id -> slot
+        self._id_of: Dict[int, int] = {}     # slot -> logical id
+        self._free: List[int] = list(range(cap - 1, -1, -1))
+        self._freq: Dict[int, int] = {}      # LFU occurrence counts
+        self._touch: Dict[int, float] = {}   # last-route monotonic time
+        self._steps: Dict[int, int] = {}     # adam per-row step counts
+        self._dirty: Set[int] = set()        # trained since last drain
+        self._ever: Set[int] = set()         # every id ever admitted
+        self.admit_total = 0
+        self.demote_total = 0
+        self.ttl_evict_total = 0
+        self.hit_total = 0
+        self.miss_total = 0
+        self._peng = None
+        self._pkey: Optional[str] = None
+        self._occ = _Occupancy(self)
+        from ..obs import hbm as obs_hbm
+        obs_hbm.register("embed", self, lambda e: e._occ,
+                         name="ShardedEmbeddingEngine.occupancy")
+
+    # -- row/slot storage accessors -----------------------------------------
+
+    def bind_engine(self, parallel_engine, model=None) -> str:
+        """Route row/slot reads+writes through a ParallelEngine's live
+        ``params``/``opt_state`` buffers (the layer's own weight is a
+        stale copy while the engine trains). Returns the param key."""
+        model = model if model is not None else parallel_engine.model
+        key = None
+        for k, t in model.state_dict().items():
+            if t is self.hbm.weight:
+                key = k
+                break
+        if key is None or key not in parallel_engine.params:
+            raise PreconditionNotMetError(
+                "bind_engine: the HBM embedding's weight is not among "
+                "the ParallelEngine's params — bind the engine that "
+                "trains this model")
+        with self._lock:
+            self._peng, self._pkey = parallel_engine, key
+        return key
+
+    def _weight(self):
+        if self._peng is not None:
+            return self._peng.params[self._pkey]
+        return self.hbm.weight.data
+
+    def _set_weight(self, arr) -> None:
+        import jax
+        if self._peng is not None:
+            # preserve the param's sharding: .at[].set may produce a
+            # differently-placed result, and the jitted step expects
+            # the registered spec
+            old = self._peng.params[self._pkey]
+            sh = getattr(old, "sharding", None)
+            self._peng.params[self._pkey] = \
+                jax.device_put(arr, sh) if sh is not None else arr
+        else:
+            self.hbm.weight._data = arr
+
+    def _slot_arrays(self) -> Dict[str, object]:
+        """Device-side optimizer slot arrays for the bound param
+        ([capacity, dim] each; empty dict unbound or sgd)."""
+        if self._peng is None:
+            return {}
+        slots = self._peng.opt_state[0].get(self._pkey, {})
+        return {n: a for n, a in slots.items()
+                if np.ndim(a) == 2 and a.shape[0] == self.capacity}
+
+    def _set_slot_array(self, name: str, arr) -> None:
+        import jax
+        old = self._peng.opt_state[0][self._pkey][name]
+        sh = getattr(old, "sharding", None)
+        self._peng.opt_state[0][self._pkey][name] = \
+            jax.device_put(arr, sh) if sh is not None else arr
+
+    def read_rows(self, slots: np.ndarray) -> np.ndarray:
+        import jax
+        return np.asarray(jax.device_get(self._weight()))[slots]
+
+    def write_rows(self, slots: np.ndarray, rows: np.ndarray) -> None:
+        import jax.numpy as jnp
+        w = self._weight()
+        vals = jnp.asarray(np.asarray(rows, np.float32), dtype=w.dtype)
+        self._set_weight(w.at[jnp.asarray(slots)].set(vals))
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def resident_rows(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def row_bytes(self) -> int:
+        w = self._weight()
+        itemsize = getattr(w, "dtype", np.dtype(np.float32)).itemsize
+        return self.dim * int(itemsize)
+
+    def resident_ids(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(sorted(self._slot_of), np.int64)
+
+    def slot_of(self, logical_id: int) -> Optional[int]:
+        return self._slot_of.get(int(logical_id))
+
+    def tier_of(self, logical_id: int) -> str:
+        """'hbm' | 'host' | 'absent' — a row is in exactly one tier."""
+        i = int(logical_id)
+        with self._lock:
+            if i in self._slot_of:
+                return "hbm"
+        for sh in self.host.shards:
+            has = getattr(sh, "has", None)
+            if has is not None and bool(has([i])[0]):
+                return "host"
+        return "absent"
+
+    def accounting(self) -> dict:
+        """The exactly-once ledger the bench gate asserts: every
+        admission is matched by residency or exactly one demotion."""
+        with self._lock:
+            return {"resident": len(self._slot_of),
+                    "admit_total": self.admit_total,
+                    "demote_total": self.demote_total,
+                    "ttl_evict_total": self.ttl_evict_total,
+                    "hit_total": self.hit_total,
+                    "miss_total": self.miss_total,
+                    "balanced": (self.admit_total - self.demote_total
+                                 == len(self._slot_of))}
+
+    # -- the tier bridge -----------------------------------------------------
+
+    def route(self, ids, now: Optional[float] = None) -> np.ndarray:
+        """Map logical feature ids → HBM slot indices, admitting misses
+        from the host tier (pull-on-miss promotion) and demoting LFU/TTL
+        victims to stay under ``budget``. Call from the input pipeline,
+        outside the jitted step; feed the returned slots to the model.
+        Never evicts an id needed by the current batch."""
+        ids_np = np.asarray(ids, np.int64)
+        flat = ids_np.reshape(-1)
+        with self._lock:
+            t = time.monotonic() if now is None else float(now)
+            uniq, counts = np.unique(flat, return_counts=True)
+            pinned = set(int(i) for i in uniq)
+            if len(pinned) > self.budget:
+                raise PreconditionNotMetError(
+                    f"batch needs {len(pinned)} unique rows but "
+                    f"hbm_row_budget={self.budget} — raise the budget "
+                    "or shrink the batch's id fan-out")
+            if self.ttl_s is not None:
+                self._sweep_ttl_locked(t, keep=pinned)
+            missing = [int(i) for i in uniq if int(i) not in
+                       self._slot_of]
+            hits = len(pinned) - len(missing)
+            self.hit_total += hits
+            self.miss_total += len(missing)
+            # make room: stay under budget AND have a free slot per miss
+            need = max(len(self._slot_of) + len(missing) - self.budget,
+                       len(missing) - len(self._free))
+            if need > 0:
+                victims = self._pick_victims(need, keep=pinned)
+                self._demote_locked(victims)
+            if missing:
+                self._admit_locked(missing)
+            for i, c in zip(uniq, counts):
+                i = int(i)
+                self._freq[i] = self._freq.get(i, 0) + int(c)
+                self._touch[i] = t
+            self._dirty.update(pinned)
+            if self.metrics is not None and (hits or missing):
+                if hits:
+                    self.metrics.counter("embed_hit_total").inc(hits)
+                if missing:
+                    self.metrics.counter("embed_miss_total").inc(
+                        len(missing))
+            lut = self._slot_of
+            return np.asarray([lut[int(i)] for i in flat],
+                              np.int64).reshape(ids_np.shape)
+
+    def _pick_victims(self, n: int, keep: Set[int]) -> List[int]:
+        cands = [i for i in self._slot_of if i not in keep]
+        if len(cands) < n:
+            raise PreconditionNotMetError(
+                f"cannot demote {n} rows: only {len(cands)} resident "
+                f"rows are not pinned by the current batch (budget="
+                f"{self.budget}, capacity={self.capacity})")
+        # LFU with LRU tiebreak — the reference cache's victim policy
+        cands.sort(key=lambda i: (self._freq.get(i, 0),
+                                  self._touch.get(i, 0.0)))
+        return cands[:n]
+
+    def _admit_locked(self, ids: List[int]) -> None:
+        """Promote ids out of the host tier (move semantics: the host
+        copy is removed) into freshly assigned slots."""
+        got = self.host.evict(ids, create=True)
+        # host returns them in our order (create=True → all present)
+        slots = [self._free.pop() for _ in ids]
+        for i, s, st in zip(ids, slots, got["steps"]):
+            self._slot_of[i] = s
+            self._id_of[s] = i
+            self._steps[i] = int(st)
+            self._ever.add(i)
+        slots_np = np.asarray(slots, np.int64)
+        self.write_rows(slots_np, got["rows"])
+        dev_slots = self._slot_arrays()
+        if dev_slots and got["slots"].shape[1]:
+            import jax.numpy as jnp
+            idx = jnp.asarray(slots_np)
+            for j, name in enumerate(sorted(dev_slots)):
+                if j >= got["slots"].shape[1]:
+                    break
+                arr = self._peng.opt_state[0][self._pkey][name]
+                vals = jnp.asarray(got["slots"][:, j, :],
+                                   dtype=arr.dtype)
+                self._set_slot_array(name, arr.at[idx].set(vals))
+        self.admit_total += len(ids)
+        if self.metrics is not None:
+            self.metrics.counter("embed_admit_total").inc(len(ids))
+
+    def _demote_locked(self, ids: List[int], ttl: bool = False) -> None:
+        """Move resident rows (values + optimizer slots + step counts)
+        down to the host tier and free their slots."""
+        if not ids:
+            return
+        slots_np = np.asarray([self._slot_of[i] for i in ids], np.int64)
+        rows = self.read_rows(slots_np)
+        dev_slots = self._slot_arrays()
+        if dev_slots:
+            import jax
+            stacked = [np.asarray(jax.device_get(
+                dev_slots[name]))[slots_np]
+                for name in sorted(dev_slots)]
+            slot_block = np.stack(stacked, axis=1)   # [n, n_slots, dim]
+        else:
+            slot_block = np.zeros((len(ids), 0, self.dim), np.float32)
+        steps = np.asarray([self._steps.get(i, 0) for i in ids],
+                           np.int64)
+        self.host.admit(np.asarray(ids, np.int64), rows, slot_block,
+                        steps)
+        for i in ids:
+            s = self._slot_of.pop(i)
+            self._id_of.pop(s, None)
+            self._free.append(s)
+            self._steps.pop(i, None)
+        self.demote_total += len(ids)
+        if ttl:
+            self.ttl_evict_total += len(ids)
+        if self.metrics is not None:
+            self.metrics.counter("embed_demote_total").inc(len(ids))
+            if ttl:
+                self.metrics.counter("embed_ttl_evict_total").inc(
+                    len(ids))
+
+    def _sweep_ttl_locked(self, now: float, keep: Set[int]) -> None:
+        expired = [i for i, t in self._touch.items()
+                   if i in self._slot_of and i not in keep
+                   and now - t > self.ttl_s]
+        self._demote_locked(expired, ttl=True)
+
+    def sweep_ttl(self, now: Optional[float] = None) -> int:
+        """Demote every TTL-expired resident row now (the idle-time
+        sweep); returns how many moved."""
+        if self.ttl_s is None:
+            return 0
+        with self._lock:
+            before = self.demote_total
+            self._sweep_ttl_locked(
+                time.monotonic() if now is None else float(now), set())
+            return self.demote_total - before
+
+    def demote_all(self) -> int:
+        """Flush every resident row to the host tier (checkpoint /
+        shutdown barrier). Returns how many moved."""
+        with self._lock:
+            ids = list(self._slot_of)
+            self._demote_locked(ids)
+            return len(ids)
+
+    # -- online-learning delta feed -----------------------------------------
+
+    def drain_dirty(self):
+        """(ids, rows) for every logical id trained since the last
+        drain — resident rows read from the device, already-demoted
+        rows from the host tier — the trainer side of the delta-publish
+        loop. Clears the dirty set."""
+        with self._lock:
+            dirty, self._dirty = sorted(self._dirty), set()
+            res = [i for i in dirty if i in self._slot_of]
+            cold = [i for i in dirty if i not in self._slot_of]
+            rows = np.zeros((len(dirty), self.dim), np.float32)
+            order = {i: k for k, i in enumerate(dirty)}
+            if res:
+                got = self.read_rows(np.asarray(
+                    [self._slot_of[i] for i in res], np.int64))
+                for i, r in zip(res, got):
+                    rows[order[i]] = r
+        if cold:
+            got = self.host.pull(np.asarray(cold, np.int64))
+            for i, r in zip(cold, got):
+                rows[order[i]] = r
+        return np.asarray(dirty, np.int64), rows
+
+    # -- observability -------------------------------------------------------
+
+    def publish_gauges(self, m=None) -> None:
+        m = m if m is not None else self.metrics
+        if m is None:
+            return
+        with self._lock:
+            resident = len(self._slot_of)
+        m.gauge("embed_hbm_rows").set(resident)
+        m.gauge("embed_hbm_budget_rows").set(self.budget)
+        m.gauge("embed_hbm_bytes").set(resident * self.row_bytes)
+        m.gauge("embed_host_rows").set(len(self.host))
+
+    # -- persistence (PR 2 manifest-friendly: arrays only) ------------------
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            ids = sorted(self._slot_of)
+            return {
+                "ids": np.asarray(ids, np.int64),
+                "slots": np.asarray([self._slot_of[i] for i in ids],
+                                    np.int64),
+                "freq_ids": np.asarray(sorted(self._freq), np.int64),
+                "freq": np.asarray([self._freq[i]
+                                    for i in sorted(self._freq)],
+                                   np.int64),
+                "steps": np.asarray([self._steps.get(i, 0)
+                                     for i in ids], np.int64),
+                "counters": np.asarray(
+                    [self.admit_total, self.demote_total,
+                     self.ttl_evict_total, self.hit_total,
+                     self.miss_total], np.int64),
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        with self._lock:
+            ids = np.asarray(state["ids"], np.int64)
+            slots = np.asarray(state["slots"], np.int64)
+            self._slot_of = {int(i): int(s) for i, s in zip(ids, slots)}
+            self._id_of = {int(s): int(i) for i, s in zip(ids, slots)}
+            used = set(int(s) for s in slots)
+            self._free = [s for s in range(self.capacity - 1, -1, -1)
+                          if s not in used]
+            self._freq = {int(i): int(f) for i, f in zip(
+                np.asarray(state["freq_ids"], np.int64),
+                np.asarray(state["freq"], np.int64))}
+            self._steps = {int(i): int(t) for i, t in zip(
+                ids, np.asarray(state["steps"], np.int64))}
+            self._touch = {int(i): 0.0 for i in ids}
+            self._ever = set(self._slot_of) | set(self._freq)
+            (self.admit_total, self.demote_total, self.ttl_evict_total,
+             self.hit_total, self.miss_total) = [
+                int(x) for x in np.asarray(state["counters"], np.int64)]

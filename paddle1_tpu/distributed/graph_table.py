@@ -31,10 +31,16 @@ class GraphTable:
     RPC_METHODS = frozenset({
         "add_edges", "sample_neighbors", "node_degree", "num_nodes",
         "num_edges", "set_node_feat", "get_node_feat", "random_walk",
+        "pull", "push",
     })
-    dim = 0  # width handshake: a graph table has no embedding width
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, feat_dim: int = 0,
+                 feat_lr: float = 0.01):
+        # width handshake: 0 = no trainable feature surface; > 0 makes
+        # this table servable behind EmbeddingService like a sparse
+        # shard (GNN node features as the coldest tier)
+        self.dim = int(feat_dim)
+        self.feat_lr = float(feat_lr)
         self._adj: Dict[int, list] = {}        # id -> [nbr ids]
         self._w: Dict[int, list] = {}          # id -> [weights]
         self._cum: Dict[int, tuple] = {}       # id -> (nbr arr, cumsum)
@@ -42,6 +48,35 @@ class GraphTable:
         self._n_edges = 0
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
+
+    # -- sparse-table protocol (pull/push over node features) ---------------
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        """Node features under the SparseTable pull contract (zeros for
+        absent nodes) — lets a GraphTable sit behind EmbeddingService /
+        the tier bridge as a feature source."""
+        if not self.dim:
+            raise ValueError(
+                "GraphTable.pull needs feat_dim > 0 at construction "
+                "(the embedding-width handshake)")
+        return self.get_node_feat(ids, self.dim)
+
+    def push(self, ids: Sequence[int], grads) -> None:
+        """SGD step on node features (the feature-learning half of the
+        reference's GNN PS mode). Duplicate ids coalesce like
+        SparseTable.push."""
+        if not self.dim:
+            raise ValueError("GraphTable.push needs feat_dim > 0")
+        from .ps import _coalesce
+        ids, grads = _coalesce(ids, grads)
+        with self._lock:
+            for k, i in enumerate(ids):
+                i = int(i)
+                f = self._feat.get(i)
+                if f is None:
+                    f = np.zeros(self.dim, np.float32)
+                    self._feat[i] = f
+                f -= self.feat_lr * grads[k]
 
     # -- construction -------------------------------------------------------
 

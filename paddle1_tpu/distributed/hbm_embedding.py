@@ -38,7 +38,24 @@ from ..nn.initializer import XavierUniform
 from ..nn.layer_base import Layer
 from . import env
 
-__all__ = ["HBMShardedEmbedding"]
+__all__ = ["HBMShardedEmbedding", "hash_bucket"]
+
+
+def hash_bucket(ids, buckets: int, xp=jnp):
+    """Map arbitrary int feature ids onto ``[0, buckets)`` with a
+    murmur3-finalizer mix — the reference hashtable's id-hash sharding
+    (heter_ps/hashtable.h). Deterministic and identical between the
+    jnp (in-graph) and np (host routing) forms, so the trainer's
+    device lookup and the tier bridge's host bookkeeping agree on
+    which bucket a feature landed in. 32-bit modular arithmetic wraps
+    by construction on both backends."""
+    h = xp.asarray(ids).astype(xp.uint32)
+    h ^= h >> xp.uint32(16)
+    h *= xp.uint32(0x85EBCA6B)
+    h ^= h >> xp.uint32(13)
+    h *= xp.uint32(0xC2B2AE35)
+    h ^= h >> xp.uint32(16)
+    return (h % xp.uint32(buckets)).astype(xp.int32)
 
 
 class HBMShardedEmbedding(Layer):
@@ -50,7 +67,7 @@ class HBMShardedEmbedding(Layer):
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  axis: str = "sharding", axis_size: Optional[int] = None,
-                 weight_attr=None, name=None):
+                 hashed: bool = False, weight_attr=None, name=None):
         super().__init__()
         if axis_size is not None and num_embeddings % axis_size:
             # pad the vocab so every shard is equal-sized (the
@@ -60,6 +77,11 @@ class HBMShardedEmbedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._axis = axis
+        # hashed mode: the table is a FIXED bucket array and incoming
+        # ids are arbitrary feature hashes folded onto it in-graph
+        # (reference hashtable.h semantics — vocab unbounded, capacity
+        # fixed, collisions share a row)
+        self._hashed = bool(hashed)
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=XavierUniform())
@@ -70,10 +92,32 @@ class HBMShardedEmbedding(Layer):
     def vocab_size(self) -> int:
         return self._num_embeddings
 
+    @property
+    def embedding_dim(self) -> int:
+        return self._embedding_dim
+
+    @property
+    def hashed(self) -> bool:
+        return self._hashed
+
+    def bucketize(self, ids: Sequence[int]) -> np.ndarray:
+        """Host-side twin of the in-graph hash fold (identity when not
+        hashed) — what the tier bridge / input pipeline use to agree
+        with the device on a feature's row."""
+        ids = np.asarray(ids, np.int64)
+        if not self._hashed:
+            return ids
+        return np.asarray(hash_bucket(ids, self._num_embeddings, xp=np),
+                          np.int64)
+
     def forward(self, x):
         axis = self._axis
+        hashed = self._hashed
+        n_rows = self._num_embeddings
 
         def f(ids, w):
+            if hashed:
+                ids = hash_bucket(ids, n_rows)
             name = env.current_spmd_axis(axis)
             if name is not None and isinstance(w, jax.core.Tracer):
                 # explicit-SPMD: w is the LOCAL row shard. Owner-select
@@ -92,11 +136,27 @@ class HBMShardedEmbedding(Layer):
 
     # -- service surface (tier parity with ps.EmbeddingService) ------------
 
+    def rows(self, slots: Sequence[int]) -> np.ndarray:
+        """Raw row read by SLOT index (no hash fold, no range coddling)
+        — the tier bridge / delta publisher contract."""
+        slots = np.asarray(slots, np.int64).reshape(-1)
+        return np.asarray(jax.device_get(self.weight.data))[slots]
+
+    def write_rows(self, slots: Sequence[int], rows) -> None:
+        """Raw row write by SLOT index (admission installs promoted
+        rows; shape/dtype preserved so in-graph users never retrace)."""
+        slots = np.asarray(slots, np.int64).reshape(-1)
+        vals = jnp.asarray(np.asarray(rows, np.float32)
+                           .reshape(slots.shape[0], self._embedding_dim))
+        self.weight._data = self.weight.data.at[
+            jnp.asarray(slots)].set(vals)
+
     def pull(self, ids: Sequence[int]) -> np.ndarray:
         """[n, dim] rows to host (the host tiers' pull contract)."""
-        ids = np.asarray(ids, np.int64).reshape(-1)
-        if ids.size and (int(ids.max()) >= self._num_embeddings
-                         or int(ids.min()) < 0):
+        ids = self.bucketize(np.asarray(ids, np.int64).reshape(-1))
+        if not self._hashed and ids.size and (
+                int(ids.max()) >= self._num_embeddings
+                or int(ids.min()) < 0):
             bad = int(ids.max()) if int(ids.max()) >= \
                 self._num_embeddings else int(ids.min())
             raise InvalidArgumentError(
@@ -109,7 +169,7 @@ class HBMShardedEmbedding(Layer):
                   lr: float = 0.01) -> None:
         """Host-pushed sparse SGD step (the host tiers' push contract;
         in-graph training goes through autograd instead)."""
-        ids = np.asarray(ids, np.int64).reshape(-1)
+        ids = self.bucketize(np.asarray(ids, np.int64).reshape(-1))
         g = jnp.asarray(np.asarray(grads, np.float32))
         w = self.weight.data
         self.weight._data = w.at[jnp.asarray(ids)].add(-lr * g)

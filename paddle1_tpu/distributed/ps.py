@@ -29,17 +29,61 @@ vocabulary, which may exceed host RAM × shards only bounded by disk.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..core import collective_sanitizer as _csan
+
 __all__ = ["SparseTable", "DenseTable", "EmbeddingService",
            "DistributedEmbedding"]
+
+# Live DistributedEmbedding instances whose pending gradients flush
+# when a full backward pass ends. One engine-level callback (registered
+# lazily, deduped by identity) walks a WeakSet, so instances stay
+# collectable and the callback list never grows per layer.
+_live_embeddings: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _flush_live_embeddings() -> None:
+    for emb in list(_live_embeddings):
+        emb.flush_grads()
+
+
+def _track_for_backward_flush(emb: "DistributedEmbedding") -> None:
+    from ..autograd.engine import register_backward_end_callback
+    _live_embeddings.add(emb)
+    register_backward_end_callback(_flush_live_embeddings)
+
+
+def _coalesce(ids: np.ndarray, grads: np.ndarray):
+    """Sum duplicate-id gradients so the table applies ONE optimizer
+    step per unique id — the dense-equivalent semantics (a dense
+    embedding's scatter-add produces a single summed row gradient; the
+    reference merges SelectedRows the same way before push_sparse).
+    Without this, adam/adagrad would take one slot update per
+    *occurrence* and diverge from the dense optimizer at 1e-1 scale."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    grads = np.asarray(grads, np.float32).reshape(ids.shape[0], -1)
+    uniq, inv = np.unique(ids, return_inverse=True)
+    if uniq.shape[0] == ids.shape[0]:
+        return ids, grads
+    summed = np.zeros((uniq.shape[0], grads.shape[1]), np.float32)
+    np.add.at(summed, inv, grads)
+    return uniq, summed
 
 
 class SparseTable:
     """One table shard: id → (row, slots). Thread-safe; rows materialize on
-    first pull (reference common_sparse_table.h Init on pull)."""
+    first pull (reference common_sparse_table.h Init on pull).
+
+    ``evict``/``admit`` move rows *with their optimizer slots and adam
+    step counts* between tiers (HBM ↔ host ↔ remote) — the heter_ps
+    demote/promote contract: a row that leaves and comes back resumes
+    its bias-correction schedule exactly where it stopped."""
+
+    RPC_METHODS = frozenset({"evict", "admit", "has"})
 
     def __init__(self, dim: int, initializer: Optional[Callable] = None,
                  optimizer: str = "sgd", lr: float = 0.01,
@@ -86,8 +130,10 @@ class SparseTable:
 
     def push(self, ids: Sequence[int], grads: np.ndarray) -> None:
         """Apply the table's optimizer per row (push_sparse + in-table
-        update). ``grads``: [n, dim]; duplicate ids accumulate."""
-        grads = np.asarray(grads, np.float32)
+        update). ``grads``: [n, dim]; duplicate ids are coalesced to a
+        single summed-gradient optimizer step per unique id (the dense
+        scatter-add equivalence — see :func:`_coalesce`)."""
+        ids, grads = _coalesce(ids, grads)
         with self._lock:
             for k, i in enumerate(ids):
                 i = int(i)
@@ -111,6 +157,75 @@ class SparseTable:
                     bc2 = 1 - self._beta2 ** t
                     row -= self.lr * (m1 / bc1) / (
                         np.sqrt(m2 / bc2) + self._adam_eps)
+
+    # -- tier-bridge surface (heter_ps demote/promote) ----------------------
+
+    @property
+    def n_slots(self) -> int:
+        return {"sgd": 0, "adagrad": 1, "adam": 2}[self.optimizer]
+
+    def has(self, ids: Sequence[int]) -> np.ndarray:
+        """bool [n]: which ids are materialized (no side effects)."""
+        with self._lock:
+            return np.array([int(i) in self._rows for i in ids], bool)
+
+    def evict(self, ids: Sequence[int], create: bool = False) -> dict:
+        """Remove rows and hand them (plus slots/steps) to the caller —
+        the move half of a tier transfer. ``create=True`` materializes
+        missing ids first (promotion of never-seen ids inherits the
+        table's first-touch init), else missing ids are skipped.
+        Returns arrays: ids [n], rows [n, dim], slots [n, n_slots, dim],
+        steps [n]."""
+        req = np.asarray(ids, np.int64).reshape(-1)
+        out_ids, rows, slots, steps = [], [], [], []
+        with self._lock:
+            for i in req:
+                i = int(i)
+                if i not in self._rows:
+                    if not create:
+                        continue
+                    self._ensure(i)
+                out_ids.append(i)
+                rows.append(self._rows.pop(i))
+                ss = self._slots.pop(i, [])
+                slots.append(np.stack(ss) if ss else
+                             np.zeros((0, self.dim), np.float32))
+                steps.append(self._steps.pop(i, 0))
+        n = len(out_ids)
+        return {"ids": np.asarray(out_ids, np.int64),
+                "rows": (np.stack(rows) if n
+                         else np.zeros((0, self.dim), np.float32)),
+                "slots": (np.stack(slots) if n
+                          else np.zeros((0, self.n_slots, self.dim),
+                                        np.float32)),
+                "steps": np.asarray(steps, np.int64)}
+
+    def admit(self, ids: Sequence[int], rows, slots=None,
+              steps=None) -> None:
+        """Install rows (the other half of a tier transfer), overwriting
+        any resident value. ``slots``/``steps`` restore optimizer state;
+        absent slots re-init to zero (a fresh row)."""
+        req = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32).reshape(req.shape[0],
+                                                    self.dim)
+        slots = None if slots is None else np.asarray(slots, np.float32)
+        steps = None if steps is None else \
+            np.asarray(steps, np.int64).reshape(-1)
+        with self._lock:
+            for k, i in enumerate(req):
+                i = int(i)
+                self._rows[i] = rows[k].copy()
+                if self.n_slots:
+                    if slots is not None and slots.shape[1] == \
+                            self.n_slots:
+                        self._slots[i] = [slots[k, j].copy()
+                                          for j in range(self.n_slots)]
+                    else:
+                        self._slots[i] = [np.zeros(self.dim, np.float32)
+                                          for _ in range(self.n_slots)]
+                if self.optimizer == "adam":
+                    self._steps[i] = int(steps[k]) if steps is not None \
+                        else 0
 
     def state_dict(self) -> dict:
         with self._lock:
@@ -315,6 +430,11 @@ class EmbeddingService:
 
     def pull(self, ids: Sequence[int]) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
+        # the sparse schedule point the PR 14 sanitizer journals: a
+        # worker whose pull order/shape diverges from its peers fails
+        # typed at verify instead of hanging a collective later
+        _csan.note_collective("ps_pull_sparse", (ids,),
+                              site="EmbeddingService.pull")
         out = np.empty((ids.shape[0], self.dim), np.float32)
         for s, pos in self._route(ids):
             if pos.size:
@@ -324,9 +444,43 @@ class EmbeddingService:
     def push(self, ids: Sequence[int], grads: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32)
+        _csan.note_collective("ps_push_sparse", (ids, grads),
+                              site="EmbeddingService.push")
         for s, pos in self._route(ids):
             if pos.size:
                 self.shards[s].push(ids[pos], grads[pos])
+
+    # -- tier-bridge surface (routes evict/admit to the owning shard) -------
+
+    def evict(self, ids: Sequence[int], create: bool = False) -> dict:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        parts = [self.shards[s].evict(ids[pos], create=create)
+                 for s, pos in self._route(ids) if pos.size]
+        if not parts:
+            z = np.zeros((0, self.dim), np.float32)
+            return {"ids": np.zeros((0,), np.int64), "rows": z,
+                    "slots": z.reshape(0, 1, self.dim)[:0],
+                    "steps": np.zeros((0,), np.int64)}
+        out = {k: np.concatenate([p[k] for p in parts])
+               for k in ("ids", "rows", "slots", "steps")}
+        # restore the caller's id order (shard routing permuted it)
+        order = {int(i): k for k, i in enumerate(out["ids"])}
+        perm = np.asarray([order[int(i)] for i in ids
+                           if int(i) in order], np.int64)
+        return {k: v[perm] for k, v in out.items()}
+
+    def admit(self, ids: Sequence[int], rows, slots=None,
+              steps=None) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        slots = None if slots is None else np.asarray(slots, np.float32)
+        steps = None if steps is None else np.asarray(steps, np.int64)
+        for s, pos in self._route(ids):
+            if pos.size:
+                self.shards[s].admit(
+                    ids[pos], rows[pos],
+                    None if slots is None else slots[pos],
+                    None if steps is None else steps[pos])
 
     def state_dict(self) -> dict:
         return {"dim": self.dim, "num_shards": self.num_shards,
@@ -347,10 +501,35 @@ class DistributedEmbedding:
     × dim). The pulled block is a differentiable leaf whose gradient hook
     pushes to the service and triggers the in-table update; no dense
     [vocab, dim] tensor ever exists on either side.
+
+    The tape hook COALESCES before pushing: gradients from every forward
+    of this layer in the batch (a model may embed two id features
+    through one shared table) accumulate host-side and flush as one
+    push with duplicate ids summed — so the table's optimizer takes
+    exactly one step per unique id per batch, matching a dense
+    ``nn.Embedding`` + optimizer at 1e-6 (the satellite parity test).
+    The flush fires at the end of the full backward pass (the autograd
+    engine's backward-end callback); anything left pending by a partial
+    ``paddle.grad`` flushes at the next forward instead.
     """
 
     def __init__(self, service: EmbeddingService):
         self.service = service
+        self._lock = threading.Lock()
+        self._pending: List[tuple] = []  # [(uniq_ids, grads)]
+        _track_for_backward_flush(self)
+
+    def flush_grads(self) -> None:
+        """Coalesce pending per-forward gradients (sum duplicates across
+        forwards) and push once. Idempotent when nothing is pending."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        ids = np.concatenate([p[0] for p in pending])
+        grads = np.concatenate([p[1] for p in pending])
+        ids, grads = _coalesce(ids, grads)
+        self.service.push(ids, grads)
 
     def __call__(self, ids):
         import jax.numpy as jnp
@@ -358,6 +537,10 @@ class DistributedEmbedding:
         from ..nn import functional as F  # noqa: F401 (tape ops)
         from ..autograd.engine import apply
 
+        # anything still pending from a partial backward (paddle.grad
+        # never reaches the backward-end callback) lands before the
+        # pull below reads the rows
+        self.flush_grads()
         ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids,
                             np.int64)
         uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
@@ -365,7 +548,8 @@ class DistributedEmbedding:
         pulled = Tensor(jnp.asarray(block), stop_gradient=False)
 
         def on_grad(g):
-            self.service.push(uniq, np.asarray(g.data))
+            with self._lock:
+                self._pending.append((uniq, np.asarray(g.data)))
             return None
 
         pulled.register_hook(on_grad)

@@ -297,6 +297,23 @@ class RemoteTable:
         self._call("push", (np.asarray(ids, np.int64),
                             np.asarray(grads, np.float32)))
 
+    # tier-bridge surface: rows + optimizer slots move across the wire
+    # (SparseTable whitelists both in RPC_METHODS), so the remote
+    # cluster tier composes with the HBM/host demote-promote machinery
+    # exactly like a local shard
+
+    def has(self, ids: Sequence[int]) -> np.ndarray:
+        return self.call("has", np.asarray(ids, np.int64))
+
+    def evict(self, ids: Sequence[int], create: bool = False) -> dict:
+        return self.call("evict", np.asarray(ids, np.int64),
+                         create=create)
+
+    def admit(self, ids: Sequence[int], rows, slots=None,
+              steps=None) -> None:
+        self.call("admit", np.asarray(ids, np.int64),
+                  np.asarray(rows, np.float32), slots, steps)
+
     def __len__(self) -> int:
         return self._call("len")
 

@@ -1,0 +1,57 @@
+"""Tier-routing embedding layer: the model-facing face of the ISSUE 19
+sharded embedding engine.
+
+``TieredEmbedding`` wraps the engine's HBM layer so one module carries
+both halves of the tiered lookup:
+
+* **in-graph** (ParallelEngine's jitted step): ``forward`` consumes
+  SLOT indices — the input pipeline calls :meth:`route` on the raw
+  feature ids first (host-side admission/eviction runs there, outside
+  the trace), and the jitted step only ever sees a fixed-shape gather
+  over the fixed-capacity device table, so admission never retraces;
+* **eager** (tests, serving-side checks): :meth:`lookup` routes and
+  gathers in one call.
+
+The split mirrors the reference's ps_gpu_wrapper pass structure:
+BuildGPUTask/pull (host, between steps) versus the device kernels
+(inside the step).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .layer_base import Layer
+
+__all__ = ["TieredEmbedding"]
+
+
+class TieredEmbedding(Layer):
+    """``forward(slots)`` → rows; ``route(ids)`` → slots (admitting /
+    evicting through the engine's tier bridge)."""
+
+    def __init__(self, engine):
+        super().__init__()
+        # the engine is a controller, not a Layer; its HBM layer IS a
+        # sublayer so the weight rides state_dict/ParallelEngine
+        self.engine = engine
+        self.hbm = engine.hbm
+
+    @property
+    def weight(self):
+        return self.hbm.weight
+
+    def route(self, ids, now=None) -> np.ndarray:
+        """Raw feature ids → HBM slot indices (host side, call from
+        the input pipeline before the jitted step)."""
+        return self.engine.route(ids, now=now)
+
+    def forward(self, slots):
+        return self.hbm(slots)
+
+    def lookup(self, ids):
+        """Eager convenience: route + gather in one call."""
+        from ..core.tensor import to_tensor
+        ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy")
+                            else ids, np.int64)
+        return self.hbm(to_tensor(self.engine.route(ids_np)))

@@ -46,9 +46,13 @@ __all__ = ["SUBSYSTEMS", "HbmLeakSuspected", "register", "unregister",
            "census", "publish", "device_live_bytes", "reset",
            "leak_note", "step_sample"]
 
-# the attribution buckets (ISSUE 13): anything registered outside the
-# named four lands in "other" so the coverage ratio stays honest
-SUBSYSTEMS = ("params", "opt_state", "kv_cache", "activations", "other")
+# the attribution buckets (ISSUE 13; "embed" added for ISSUE 19's
+# sharded embedding engine — its LOGICAL HBM occupancy, distinct from
+# the fixed weight allocation that stays under "params"): anything
+# registered outside the named buckets lands in "other" so the
+# coverage ratio stays honest
+SUBSYSTEMS = ("params", "opt_state", "kv_cache", "activations", "embed",
+              "other")
 
 
 class HbmLeakSuspected(EnforceNotMet):
@@ -164,7 +168,7 @@ def census() -> Dict[str, object]:
     own number, and the coverage ratio the acceptance gate asserts
     (>= 0.95 = every big consumer is tagged)."""
     per = registered_bytes()
-    total = sum(per.values())
+    total = _physical_total(per)
     dev, source = device_live_bytes()
     peak = 0
     try:
@@ -178,6 +182,15 @@ def census() -> Dict[str, object]:
             "device_bytes_in_use": dev, "device_source": source,
             "device_peak_bytes": peak,
             "coverage_ratio": (total / dev) if dev else 1.0}
+
+
+def _physical_total(per: Dict[str, int]) -> int:
+    """Sum of the buckets that correspond to real device allocations.
+    "embed" is a LOGICAL view (resident embedding rows; the backing
+    weight allocation is already counted under "params"), so it is
+    excluded from totals/coverage — counting it twice would push
+    coverage past 1.0 and hide untagged consumers."""
+    return sum(b for s, b in per.items() if s != "embed")
 
 
 def publish(m, full: bool = False) -> int:
@@ -195,7 +208,7 @@ def publish(m, full: bool = False) -> int:
         m.gauge("hbm_census_coverage_ratio").set(c["coverage_ratio"])
     else:
         per = registered_bytes()
-        total = sum(per.values())
+        total = _physical_total(per)
     for sub, b in per.items():
         if b:
             m.gauge(f"hbm_{sub}_bytes").set(b)

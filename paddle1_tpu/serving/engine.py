@@ -241,6 +241,38 @@ class InferenceEngine:
             f"InferenceEngine needs a Layer, TranslatedLayer, Predictor "
             f"or callable, got {type(model).__name__}")
 
+    # -- online-learning deltas (ISSUE 19) ----------------------------------
+
+    def update_param_rows(self, name: str, ids, rows) -> None:
+        """Overwrite rows of one 2-D param in place — the serving half
+        of the embedding delta loop. The engine's params ride every
+        dispatch as jit ARGUMENTS (not baked constants), and this
+        preserves shape/dtype, so a delta is visible on the next
+        dispatch with zero recompiles."""
+        import jax.numpy as jnp
+        with self._lock:
+            cur = self._params.get(name)
+            if cur is None:
+                raise InvalidArgumentError(
+                    f"param {name!r} not served by this engine (have "
+                    f"{sorted(self._params)}) — the delta publisher "
+                    "and the serving model disagree on the param name")
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            vals = np.asarray(rows)
+            if np.ndim(cur) != 2 or vals.ndim != 2 or \
+                    vals.shape != (ids.shape[0], cur.shape[1]):
+                raise InvalidArgumentError(
+                    f"delta shape {vals.shape} does not fit param "
+                    f"{name!r} of shape {np.shape(cur)} (need "
+                    f"[{ids.shape[0]}, {np.shape(cur)[-1]}])")
+            if ids.size and (int(ids.max()) >= cur.shape[0]
+                             or int(ids.min()) < 0):
+                raise InvalidArgumentError(
+                    f"delta ids out of range for param {name!r} with "
+                    f"{cur.shape[0]} rows")
+            self._params[name] = cur.at[jnp.asarray(ids)].set(
+                jnp.asarray(vals, dtype=cur.dtype))
+
     # -- bucketing ----------------------------------------------------------
 
     @property

@@ -556,6 +556,8 @@ class ServingFleet:
                  buckets=None, input_specs=None,
                  deadline_ms: Optional[float] = None,
                  warmup: bool = False,
+                 delta_dir: Optional[str] = None,
+                 delta_poll_ms: Optional[float] = None,
                  retry_max: Optional[int] = None,
                  replica_timeout_ms: Optional[float] = None,
                  breaker_failures: Optional[int] = None,
@@ -617,7 +619,12 @@ class ServingFleet:
                      ("input_specs",
                       [list((list(s), d)) for s, d in input_specs]
                       if input_specs else None),
-                     ("warmup", warmup or None)):
+                     ("warmup", warmup or None),
+                     # online-learning deltas (ISSUE 19): every replica
+                     # subscribes to the trainer's delta log and applies
+                     # rows live through the existing hot-swap surface
+                     ("delta_dir", delta_dir),
+                     ("delta_poll_ms", delta_poll_ms)):
             if v is not None:
                 self._server_cfg[k] = v
 

@@ -78,6 +78,11 @@ class Server:
     deadline_ms : default per-request deadline (0/None → none).
     warmup : pre-compile every bucket in ``start()`` (needs
         ``input_specs`` — automatic for Predictor artifacts).
+    delta_dir : watch this embedding-delta log directory (ISSUE 19
+        online learning) and apply each published version to the
+        engine's params live — no recompile, no redeploy.
+    delta_poll_ms : delta log poll interval (default 50ms; bounds the
+        publish-to-servable latency together with one dispatch).
     """
 
     def __init__(self, model, max_batch: Optional[int] = None,
@@ -86,7 +91,9 @@ class Server:
                  buckets=None, input_specs=None,
                  deadline_ms: Optional[float] = None,
                  warmup: bool = False,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 delta_dir: Optional[str] = None,
+                 delta_poll_ms: Optional[float] = None):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if isinstance(model, InferenceEngine):
             if buckets is not None or input_specs is not None:
@@ -136,6 +143,10 @@ class Server:
         self._admit_lock = locks.make_lock("Server._admit_lock")
         self._accepting = False          # guarded-by: self._admit_lock
         self._batcher: Optional[Batcher] = None
+        self.delta_dir = delta_dir
+        self.delta_poll_s = float(
+            delta_poll_ms if delta_poll_ms is not None else 50.0) / 1e3
+        self._delta_sub = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -172,6 +183,15 @@ class Server:
                                 self.batch_timeout_ms, self.metrics,
                                 self._drain_event)
         self._batcher.start()
+        if self.delta_dir and self._delta_sub is None:
+            # the online-learning consumer: trainer-published embedding
+            # deltas land in the engine's live param dict between
+            # dispatches (update_param_rows — shape-preserving, so it
+            # never recompiles)
+            from ..distributed.embedding_delta import DeltaSubscriber
+            self._delta_sub = DeltaSubscriber(
+                self.delta_dir, self.engine.update_param_rows,
+                poll_s=self.delta_poll_s, metrics=self.metrics).start()
         with self._admit_lock:
             self._accepting = True
         return self
@@ -303,6 +323,9 @@ class Server:
             # sweeps below account for all of it
             self._accepting = False
             self._drain_event.set()
+        if self._delta_sub is not None:
+            self._delta_sub.stop()
+            self._delta_sub = None
         drained = True
         if self._batcher is not None:
             drained = self._batcher.drained.wait(timeout)

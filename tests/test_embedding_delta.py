@@ -1,0 +1,362 @@
+"""Online-learning embedding deltas + async sparse PS mode (ISSUE 19
+tentpoles (c)/(d)): DeltaLog/DeltaSubscriber semantics, the serving-side
+recompile-free row rewrite, the trainer→fleet latency contract, the
+collective-sanitizer coverage of the sparse push/pull and delta-publish
+schedules (satellite 1), and SparseAsyncCommunicator's bounded-staleness
+overlap."""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core import collective_sanitizer as cs
+from paddle1_tpu.core import flags as core_flags
+from paddle1_tpu.core.collective_sanitizer import CollectiveDivergenceError
+from paddle1_tpu.core.errors import (InvalidArgumentError,
+                                     PreconditionNotMetError)
+from paddle1_tpu.distributed import (DeltaLog, DeltaSubscriber,
+                                     EmbeddingService,
+                                     SparseAsyncCommunicator)
+from paddle1_tpu.distributed.embedding_delta import read_since
+from paddle1_tpu.obs import MetricsRegistry
+from paddle1_tpu.serving import InferenceEngine, Server
+
+DIM = 4
+
+
+class TestDeltaLog:
+    def test_publish_read_round_trip_in_order(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        v1 = log.publish("emb.weight", [3, 1], np.ones((2, DIM)))
+        v2 = log.publish("emb.weight", [7], np.full((1, DIM), 2.0))
+        assert (v1, v2) == (1, 2)
+        recs = read_since(str(tmp_path), 0)
+        assert [r.version for r in recs] == [1, 2]
+        assert recs[0].param == "emb.weight"
+        np.testing.assert_array_equal(recs[1].ids, [7])
+        np.testing.assert_allclose(recs[1].rows, 2.0)
+        assert read_since(str(tmp_path), 1)[0].version == 2
+        assert read_since(str(tmp_path), 2) == []
+
+    def test_versions_are_monotone(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        log.publish("p", [1], np.zeros((1, DIM)), version=5)
+        with pytest.raises(InvalidArgumentError, match="monotone"):
+            log.publish("p", [1], np.zeros((1, DIM)), version=5)
+        # a new instance over the same dir resumes past the head
+        assert DeltaLog(str(tmp_path)).publish(
+            "p", [1], np.zeros((1, DIM))) == 6
+
+    def test_shape_mismatch_refused(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        with pytest.raises(InvalidArgumentError, match="rows"):
+            log.publish("p", [1, 2], np.zeros((3, DIM)))
+
+    def test_prune_keeps_tail_and_no_tmp_residue(self, tmp_path):
+        log = DeltaLog(str(tmp_path), keep=3)
+        for _ in range(7):
+            log.publish("p", [0], np.zeros((1, DIM)))
+        files = sorted(glob.glob(str(tmp_path / "delta-*.npz")))
+        assert len(files) == 3
+        assert [r.version for r in read_since(str(tmp_path), 0)] \
+            == [5, 6, 7]
+        assert glob.glob(str(tmp_path / "*.tmp")) == []   # atomic
+
+
+class TestDeltaSubscriber:
+    def test_poll_applies_in_order_exactly_once(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        seen = []
+        sub = DeltaSubscriber(str(tmp_path),
+                              lambda p, i, r: seen.append(int(i[0])))
+        log.publish("p", [10], np.zeros((1, DIM)))
+        log.publish("p", [20], np.zeros((1, DIM)))
+        assert sub.poll_once() == 2
+        assert sub.poll_once() == 0     # nothing new: no re-apply
+        assert seen == [10, 20]
+        assert sub.applied_version == 2
+
+    def test_bad_delta_is_skipped_counted_and_version_advances(
+            self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        m = MetricsRegistry()
+        applied = []
+
+        def apply_fn(p, i, r):
+            if p == "bad":
+                raise InvalidArgumentError("renamed param")
+            applied.append(p)
+
+        sub = DeltaSubscriber(str(tmp_path), apply_fn, metrics=m)
+        log.publish("ok", [1], np.zeros((1, DIM)))
+        log.publish("bad", [2], np.zeros((1, DIM)))
+        log.publish("ok", [3], np.zeros((1, DIM)))
+        assert sub.poll_once() == 2
+        assert applied == ["ok", "ok"]
+        assert sub.applied_version == 3   # the bad version is consumed
+        snap = m.snapshot()
+        assert snap["counters"]["embed_delta_errors_total"] == 1
+        assert snap["counters"]["embed_delta_applied_total"] == 2
+        assert snap["counters"]["embed_delta_rows_total"] == 2
+        assert snap["gauges"]["embed_delta_version"] == 3
+
+    def test_threaded_wait_version(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        got = []
+        sub = DeltaSubscriber(str(tmp_path),
+                              lambda p, i, r: got.append(p),
+                              poll_s=0.01).start()
+        try:
+            assert not sub.wait_version(1, timeout=0.05)   # nothing yet
+            log.publish("p", [1], np.zeros((1, DIM)))
+            assert sub.wait_version(1, timeout=5.0)
+            assert got == ["p"]
+        finally:
+            sub.stop()
+
+
+def _emb_model(vocab=32, seed=0):
+    paddle.seed(seed)
+
+    class _M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = paddle.nn.Embedding(vocab, DIM)
+
+        def forward(self, ids):
+            return self.emb(ids)
+
+    m = _M()
+    m.eval()
+    return m
+
+
+class TestServingDelta:
+    def test_update_param_rows_no_recompile(self):
+        model = _emb_model()
+        eng = InferenceEngine(model, buckets=(1, 2),
+                              input_specs=[((1,), "int64")])
+        ids = np.array([[5]], np.int64)
+        before = np.asarray(eng.infer([ids])[0])
+        compiles = dict(eng.compile_counts)
+        new_row = np.arange(DIM, dtype=np.float32)[None]
+        eng.update_param_rows("emb.weight", [5], new_row)
+        after = np.asarray(eng.infer([ids])[0])
+        np.testing.assert_allclose(after[0, 0], new_row[0], rtol=1e-6)
+        assert not np.allclose(before, after)
+        assert eng.compile_counts == compiles   # zero recompiles
+
+    def test_update_param_rows_typed_errors(self):
+        eng = InferenceEngine(_emb_model(), buckets=(1,),
+                              input_specs=[((1,), "int64")])
+        with pytest.raises(InvalidArgumentError, match="not served"):
+            eng.update_param_rows("nope", [0], np.zeros((1, DIM)))
+        with pytest.raises(InvalidArgumentError, match="fit"):
+            eng.update_param_rows("emb.weight", [0],
+                                  np.zeros((1, DIM + 1)))
+        with pytest.raises(InvalidArgumentError, match="range"):
+            eng.update_param_rows("emb.weight", [99],
+                                  np.zeros((1, DIM)))
+
+    def test_server_serves_published_delta_within_five_seconds(
+            self, tmp_path):
+        """The production-loop gate: a delta published while the server
+        is live is servable in < 5s with rows matching the publisher's
+        at 1e-6 — no restart, no redeploy."""
+        srv = Server(_emb_model(), max_batch=1, buckets=(1,),
+                     input_specs=[((1,), "int64")],
+                     delta_dir=str(tmp_path), delta_poll_ms=10).start()
+        try:
+            ids = np.array([[7]], np.int64)
+            srv.submit(ids).result(timeout=30)   # warm path
+            row = np.linspace(1, 2, DIM, dtype=np.float32)[None]
+            t0 = time.monotonic()
+            DeltaLog(str(tmp_path)).publish("emb.weight", [7], row)
+            while time.monotonic() - t0 < 5.0:
+                out = np.asarray(srv.submit(ids).result(timeout=30))
+                if np.allclose(out[0, 0], row[0], rtol=1e-6):
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("delta not served within 5s")
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            srv.drain()
+
+
+class TestSanitizedSchedules:
+    """Satellite 1: the sparse push/pull and delta-publish points ride
+    the PR 14 collective-schedule sanitizer."""
+
+    def test_sparse_ops_journal_into_the_schedule(self, tmp_path):
+        with core_flags.flags_guard(debug_collective_sanitizer=True):
+            cs.reset()
+            svc = EmbeddingService(DIM, num_shards=2)
+            svc.pull([1, 2])
+            svc.push([1, 2], np.zeros((2, DIM), np.float32))
+            DeltaLog(str(tmp_path)).publish("p", [1],
+                                            np.zeros((1, DIM)))
+            ops = [r["op"] for r in cs.schedule()]
+            assert ops == ["ps_pull_sparse", "ps_push_sparse",
+                           "delta_publish"]
+            sites = [r["site"] for r in cs.schedule()]
+            assert sites == ["EmbeddingService.pull",
+                             "EmbeddingService.push",
+                             "DeltaLog.publish"]
+
+    def test_unarmed_is_free(self, tmp_path):
+        cs.reset()
+        svc = EmbeddingService(DIM)
+        svc.pull([1])
+        DeltaLog(str(tmp_path)).publish("p", [1], np.zeros((1, DIM)))
+        assert cs.schedule() == []
+
+    def test_misordered_push_fails_typed_across_ranks(self, tmp_path,
+                                                      monkeypatch):
+        """Two ranks run the same program; rank 1 skips its push (the
+        classic async-PS bug: a worker silently drops a gradient). The
+        cross-rank verifier names the diverging step instead of letting
+        the tables drift."""
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(tmp_path)):
+            g = np.ones((2, DIM), np.float32)
+
+            def program(skip_push):
+                svc = EmbeddingService(DIM)
+                svc.pull([1, 2])
+                if not skip_push:
+                    svc.push([1, 2], g)
+                svc.pull([3, 4])
+
+            monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+            cs.reset()
+            program(skip_push=False)
+            assert len(cs.schedule()) == 3
+            monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+            cs.reset()
+            program(skip_push=True)
+            with pytest.raises(CollectiveDivergenceError) as ei:
+                cs.verify_dir(str(tmp_path), complete=True)
+            msg = str(ei.value)
+            assert "step 2" in msg and "ps_push_sparse" in msg
+
+    def test_divergent_push_shape_fails_typed(self, tmp_path,
+                                              monkeypatch):
+        """Same schedule, different payload shape — the digest catches
+        a rank pushing a differently-coalesced gradient."""
+        with core_flags.flags_guard(
+                debug_collective_sanitizer=True,
+                collective_journal_dir=str(tmp_path)):
+            for rank, n in ((0, 2), (1, 3)):
+                monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+                cs.reset()
+                EmbeddingService(DIM).push(
+                    list(range(n)), np.ones((n, DIM), np.float32))
+            with pytest.raises(CollectiveDivergenceError, match="step 1"):
+                cs.verify_dir(str(tmp_path), complete=True)
+
+
+class TestSparseAsyncCommunicator:
+    def test_async_push_matches_synchronous_sgd(self):
+        """Coalescing across queued steps must be value-preserving for
+        the table's sgd (sum of grads × lr == sequential steps)."""
+        svc_async = EmbeddingService(DIM, num_shards=2, lr=0.1)
+        svc_sync = EmbeddingService(DIM, num_shards=2, lr=0.1)
+        np.testing.assert_allclose(svc_async.pull([1, 2, 3]),
+                                   svc_sync.pull([1, 2, 3]))
+        comm = SparseAsyncCommunicator(svc_async, merge_num=4).start()
+        try:
+            rng = np.random.default_rng(0)
+            for _ in range(10):
+                ids = rng.integers(1, 4, 5).astype(np.int64)
+                g = rng.standard_normal((5, DIM)).astype(np.float32)
+                comm.push(ids, g)
+                svc_sync.push(ids, g)
+            comm.flush()
+            assert comm.applied_total == comm.pushed_total == 10
+            np.testing.assert_allclose(svc_async.pull([1, 2, 3]),
+                                       svc_sync.pull([1, 2, 3]),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            comm.stop()
+
+    def test_staleness_stays_bounded(self):
+        svc = EmbeddingService(DIM)
+        slow = threading.Event()
+        orig = svc.push
+
+        def slow_push(ids, grads):
+            slow.wait(0.01)
+            orig(ids, grads)
+
+        svc.push = slow_push
+        comm = SparseAsyncCommunicator(svc, max_staleness=3,
+                                       send_interval=0.001).start()
+        try:
+            for _ in range(12):
+                comm.push([1], np.ones((1, DIM), np.float32))
+                assert comm.staleness() <= 3
+            comm.flush()
+            assert comm.staleness() == 0
+        finally:
+            comm.stop()
+
+    def test_push_before_start_raises(self):
+        comm = SparseAsyncCommunicator(EmbeddingService(DIM))
+        with pytest.raises(PreconditionNotMetError, match="start"):
+            comm.push([1], np.ones((1, DIM), np.float32))
+
+    def test_prefetch_overlaps_and_matches_direct_pull(self):
+        svc = EmbeddingService(DIM)
+        want = svc.pull([4, 5])
+        comm = SparseAsyncCommunicator(svc).start()
+        try:
+            comm.prefetch([4, 5])
+            np.testing.assert_allclose(comm.pulled([4, 5]), want)
+            # a non-matching request falls back to a direct pull
+            np.testing.assert_allclose(comm.pulled([4]), want[:1])
+        finally:
+            comm.stop()
+
+    def test_flush_surfaces_push_failure(self):
+        svc = EmbeddingService(DIM)
+
+        def boom(ids, grads):
+            raise RuntimeError("wire down")
+
+        comm = SparseAsyncCommunicator(svc, send_interval=60).start()
+        svc.push = boom
+        try:
+            comm.push([1], np.ones((1, DIM), np.float32))
+            with pytest.raises(RuntimeError, match="wire down"):
+                comm.flush()
+            assert comm.staleness() == 0   # backpressure freed
+        finally:
+            comm._stop.set()
+
+    def test_checkpoint_round_trip_is_quiesced(self):
+        svc = EmbeddingService(DIM, lr=0.5)
+        comm = SparseAsyncCommunicator(svc).start()
+        try:
+            base = svc.pull([1, 2])
+            comm.push([1, 2], np.ones((2, DIM), np.float32))
+            sd = comm.state_dict()        # flushes first: queue empty
+            np.testing.assert_allclose(svc.pull([1, 2]), base - 0.5)
+            assert sd["pushed_total"] == 1 and sd["applied_total"] == 1
+        finally:
+            comm.stop()
+        svc2 = EmbeddingService(DIM, lr=0.5)
+        comm2 = SparseAsyncCommunicator(svc2).start()
+        try:
+            comm2.load_state_dict(sd)
+            np.testing.assert_allclose(svc2.pull([1, 2]),
+                                       svc.pull([1, 2]))
+            assert comm2.pushed_total == 1
+        finally:
+            comm2.stop()

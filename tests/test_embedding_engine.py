@@ -1,0 +1,336 @@
+"""Sharded embedding engine (ISSUE 19 tentpole): the LFU/TTL
+admission–eviction bridge between the HBM tier and the host/remote
+table tiers — routing, budgets, exactly-once move accounting, census
+integration, optimizer-slot fidelity across tier transfers, and the
+in-graph one-dispatch-per-step training contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle1_tpu as paddle
+from paddle1_tpu.core.errors import PreconditionNotMetError
+from paddle1_tpu.core.tensor import Tensor
+from paddle1_tpu.distributed import (EmbeddingService, HBMShardedEmbedding,
+                                     ParallelEngine, ShardedEmbeddingEngine,
+                                     SparseTable, TableServer, build_mesh,
+                                     hash_bucket, remote_service)
+from paddle1_tpu.nn import TieredEmbedding
+from paddle1_tpu.nn.layer_base import Layer
+from paddle1_tpu.obs import MetricsRegistry
+from paddle1_tpu.obs import hbm as obs_hbm
+
+
+@pytest.fixture(autouse=True)
+def _census_isolation():
+    yield
+    obs_hbm.reset()
+
+
+def _make(capacity=8, dim=4, budget=None, ttl_s=None, optimizer="sgd",
+          metrics=None, num_shards=2):
+    hbm = HBMShardedEmbedding(capacity, dim)
+    host = EmbeddingService(dim, num_shards=num_shards,
+                            optimizer=optimizer)
+    eng = ShardedEmbeddingEngine(hbm, host, hbm_row_budget=budget,
+                                 ttl_s=ttl_s, metrics=metrics)
+    return eng, hbm, host
+
+
+class TestHashBucket:
+    def test_np_jnp_agree_and_in_range(self):
+        ids = np.array([0, 1, 7, 12345, 2**33 + 17, 2**40 - 1], np.int64)
+        a = np.asarray(hash_bucket(ids, 1024, xp=np))
+        b = np.asarray(hash_bucket(jnp.asarray(ids), 1024))
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < 1024
+        # the finalizer actually mixes: consecutive ids scatter
+        assert len(set(np.asarray(
+            hash_bucket(np.arange(64), 1024, xp=np)).tolist())) > 32
+
+    def test_hashed_layer_folds_ids_in_graph_and_on_host(self):
+        emb = HBMShardedEmbedding(16, 4, hashed=True)
+        big = np.array([[2**35 + 3, 7], [99991, 0]], np.int64)
+        out = np.asarray(emb(Tensor(jnp.asarray(big))).numpy())
+        w = np.asarray(emb.weight.numpy())
+        np.testing.assert_allclose(out, w[emb.bucketize(big)])
+        # pull accepts out-of-range raw ids in hashed mode
+        assert emb.pull([2**40]).shape == (1, 4)
+
+    def test_unhashed_bucketize_is_identity(self):
+        emb = HBMShardedEmbedding(16, 4)
+        np.testing.assert_array_equal(emb.bucketize([3, 5]),
+                                      np.array([3, 5]))
+
+
+class TestRouting:
+    def test_admit_on_miss_and_shape(self):
+        eng, _, host = _make()
+        slots = eng.route(np.array([[1, 2], [3, 1]], np.int64))
+        assert slots.shape == (2, 2)
+        assert slots[0, 0] == slots[1, 1]          # same id, same slot
+        assert len({int(s) for s in slots.reshape(-1)}) == 3
+        acc = eng.accounting()
+        assert acc["admit_total"] == 3 and acc["resident"] == 3
+        assert acc["balanced"]
+
+    def test_hits_are_stable_and_counted(self):
+        eng, _, _ = _make()
+        s1 = eng.route([1, 2, 3])
+        s2 = eng.route([3, 2, 1])
+        np.testing.assert_array_equal(np.sort(s1), np.sort(s2))
+        acc = eng.accounting()
+        assert acc["miss_total"] == 3 and acc["hit_total"] == 3
+        assert acc["admit_total"] == 3   # no re-admission on hit
+
+    def test_promotion_moves_row_value_and_empties_host(self):
+        eng, _, host = _make()
+        v = host.pull([5])[0]            # materialize in the host tier
+        assert eng.tier_of(5) == "host"
+        slot = int(eng.route([5])[0])
+        np.testing.assert_allclose(eng.read_rows(np.array([slot])),
+                                   v[None], rtol=1e-6)
+        # move semantics: exactly one tier holds the row now
+        assert eng.tier_of(5) == "hbm"
+        assert len(host) == 0
+
+    def test_over_budget_batch_raises_typed(self):
+        eng, _, _ = _make(budget=3)
+        with pytest.raises(PreconditionNotMetError, match="budget"):
+            eng.route([0, 1, 2, 3])
+
+    def test_lfu_demotes_cold_not_hot(self):
+        eng, _, host = _make(budget=4)
+        eng.route([0, 0, 0, 1, 2, 3])    # 0 is hot (freq 3)
+        eng.route([4])                    # budget pressure: demote one
+        assert eng.tier_of(0) == "hbm"
+        demoted = [i for i in (1, 2, 3) if eng.tier_of(i) == "host"]
+        assert len(demoted) == 1
+        acc = eng.accounting()
+        assert acc["demote_total"] == 1 and acc["balanced"]
+        assert acc["resident"] == 4
+
+    def test_ttl_demotes_idle_rows(self):
+        eng, _, _ = _make(ttl_s=10.0)
+        eng.route([1, 2], now=0.0)
+        eng.route([3], now=100.0)        # 1, 2 idle past the TTL
+        assert eng.tier_of(1) == "host" and eng.tier_of(2) == "host"
+        assert eng.tier_of(3) == "hbm"
+        assert eng.accounting()["ttl_evict_total"] == 2
+
+    def test_sweep_ttl_explicit(self):
+        eng, _, _ = _make(ttl_s=5.0)
+        eng.route([7], now=0.0)
+        assert eng.sweep_ttl(now=1.0) == 0
+        assert eng.sweep_ttl(now=6.5) == 1
+        assert eng.tier_of(7) == "host"
+
+    def test_demote_all_preserves_values(self):
+        eng, _, host = _make()
+        slots = eng.route([1, 2, 3])
+        rows = eng.read_rows(slots)
+        assert eng.demote_all() == 3
+        acc = eng.accounting()
+        assert acc["resident"] == 0 and acc["balanced"]
+        np.testing.assert_allclose(host.pull([1, 2, 3]), rows, rtol=1e-6)
+
+    def test_exactly_once_under_churn(self):
+        rng = np.random.default_rng(0)
+        eng, _, _ = _make(capacity=8, budget=5)
+        for _ in range(40):
+            ids = rng.integers(0, 30, rng.integers(1, 5))
+            eng.route(ids.astype(np.int64))
+            acc = eng.accounting()
+            assert acc["balanced"], acc
+            assert acc["resident"] <= 5
+        # every id lives in exactly one tier
+        for i in range(30):
+            tiers = [eng.tier_of(i)]
+            assert tiers[0] in ("hbm", "host", "absent")
+
+    def test_dim_mismatch_refused_at_construction(self):
+        hbm = HBMShardedEmbedding(8, 4)
+        with pytest.raises(ValueError, match="dim"):
+            ShardedEmbeddingEngine(hbm, EmbeddingService(6))
+
+
+class TestCensusAndGauges:
+    def test_embed_bytes_track_logical_occupancy(self):
+        eng, _, _ = _make(capacity=8, dim=4)
+        assert obs_hbm.registered_bytes()["embed"] == 0
+        eng.route([1, 2, 3])
+        assert obs_hbm.registered_bytes()["embed"] == 3 * 4 * 4
+        eng.demote_all()
+        assert obs_hbm.registered_bytes()["embed"] == 0
+
+    def test_embed_is_logical_not_physical(self):
+        """The embed bucket must NOT inflate census totals/coverage —
+        the backing weight allocation already counts under params."""
+        eng, _, _ = _make()
+        eng.route([1, 2])
+        c = obs_hbm.census()
+        assert c["subsystems"]["embed"] == 2 * 4 * 4
+        assert c["census_bytes"] == obs_hbm._physical_total(
+            c["subsystems"])
+        assert "embed" not in {"params"} and \
+            c["census_bytes"] == sum(
+                b for s, b in c["subsystems"].items() if s != "embed")
+
+    def test_publish_gauges_and_counters(self):
+        m = MetricsRegistry()
+        eng, _, host = _make(budget=4, metrics=m)
+        eng.route([0, 1, 2, 3])
+        eng.route([4])
+        eng.publish_gauges()
+        snap = m.snapshot()
+        assert snap["gauges"]["embed_hbm_rows"] == 4
+        assert snap["gauges"]["embed_hbm_budget_rows"] == 4
+        assert snap["gauges"]["embed_hbm_bytes"] == 4 * 4 * 4
+        assert snap["gauges"]["embed_host_rows"] == len(host)
+        assert snap["counters"]["embed_admit_total"] == 5
+        assert snap["counters"]["embed_demote_total"] == 1
+        assert snap["counters"]["embed_miss_total"] == 5
+
+
+class TestRemoteTier:
+    def test_demotion_crosses_the_wire(self):
+        servers = [TableServer(SparseTable(4, seed=s)).start()
+                   for s in range(2)]
+        try:
+            svc = remote_service(4, [s.endpoint for s in servers])
+            hbm = HBMShardedEmbedding(8, 4)
+            eng = ShardedEmbeddingEngine(hbm, svc, hbm_row_budget=2)
+            eng.route([1, 2])
+            rows = eng.read_rows(eng.route([1, 2]))
+            eng.route([3])               # demotes one over TCP
+            acc = eng.accounting()
+            assert acc["demote_total"] == 1 and acc["balanced"]
+            demoted = [i for i in (1, 2) if eng.tier_of(i) == "host"]
+            assert len(demoted) == 1
+            # promoted back: the remote round trip preserved the value
+            idx = 0 if demoted[0] == 1 else 1
+            back = eng.route([demoted[0]])
+            np.testing.assert_allclose(eng.read_rows(back), rows[[idx]],
+                                       rtol=1e-6)
+        finally:
+            for s in servers:
+                s.stop()
+
+
+class TestStateDict:
+    def test_mapping_round_trip_is_arrays_only(self):
+        eng, _, _ = _make(budget=4)
+        eng.route([3, 1, 4, 1, 5])       # 5 evicted? no: 4 uniq fits
+        sd = eng.state_dict()
+        for v in sd.values():
+            assert isinstance(v, np.ndarray)   # PR 2 manifest-friendly
+        before = dict(eng._slot_of)
+        acc_before = eng.accounting()
+        eng.route([9, 10])               # perturb
+        eng.load_state_dict(sd)
+        assert dict(eng._slot_of) == before
+        acc = eng.accounting()
+        assert acc["resident"] == acc_before["resident"]
+        assert acc["admit_total"] == acc_before["admit_total"]
+        assert acc["balanced"]
+
+
+class _TieredModel(Layer):
+    def __init__(self, engine):
+        super().__init__()
+        self.emb = TieredEmbedding(engine)
+        self.head = paddle.nn.Linear(engine.dim, 1)
+
+    def forward(self, slots):
+        return self.head(self.emb(slots).mean(axis=1))
+
+
+class TestInGraphTraining:
+    def test_one_dispatch_per_step_with_admission_churn(self):
+        """The tentpole contract: admission/eviction happen host-side
+        in route(); the jitted step sees only fixed-shape slot gathers
+        over the fixed-capacity table — one dispatch per step, one
+        trace total, despite rows moving between tiers every step."""
+        paddle.seed(0)
+        hbm = HBMShardedEmbedding(16, 4)
+        host = EmbeddingService(4, num_shards=2)
+        eng = ShardedEmbeddingEngine(hbm, host, hbm_row_budget=8)
+        model = _TieredModel(eng)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        peng = ParallelEngine(
+            model, opt,
+            lambda m, b: ((m(Tensor(b["slots"])) - Tensor(b["y"])) ** 2
+                          ).mean(),
+            mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+            zero_stage=0)
+        eng.bind_engine(peng)
+        rng = np.random.default_rng(1)
+        steps = 6
+        for k in range(steps):
+            ids = rng.integers(k * 3, k * 3 + 40, (4, 2)).astype(np.int64)
+            slots = eng.route(ids)       # churn: fresh ids every step
+            y = rng.standard_normal((4, 1)).astype(np.float32)
+            peng.step({"slots": slots, "y": y})
+            assert eng.accounting()["balanced"]
+        assert peng.dispatch_count == steps
+        assert peng.trace_count == 1     # no retrace on admission
+        assert eng.accounting()["demote_total"] > 0   # churn was real
+
+    def test_adam_slots_survive_demote_and_readmit(self):
+        """Tier transfers move optimizer state with the row: a trained
+        row's adam moments demote to the host tier intact and come back
+        into the device slot arrays on re-admission."""
+        paddle.seed(1)
+        hbm = HBMShardedEmbedding(8, 4)
+        host = EmbeddingService(4, num_shards=1, optimizer="adam")
+        eng = ShardedEmbeddingEngine(hbm, host)
+        model = _TieredModel(eng)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        peng = ParallelEngine(
+            model, opt,
+            lambda m, b: ((m(Tensor(b["slots"])) - Tensor(b["y"])) ** 2
+                          ).mean(),
+            mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+            zero_stage=0)
+        key = eng.bind_engine(peng)
+        ids = np.array([[2, 5, 7]], np.int64)
+        y = np.ones((1, 1), np.float32)
+        for _ in range(3):
+            slots = eng.route(ids)
+            peng.step({"slots": slots, "y": y})
+        slot_arrays = {n: np.asarray(jax.device_get(a))
+                       for n, a in eng._slot_arrays().items()}
+        assert sorted(slot_arrays) == ["moment1", "moment2"]
+        s2 = int(eng.slot_of(2))
+        m1_before = slot_arrays["moment1"][s2].copy()
+        m2_before = slot_arrays["moment2"][s2].copy()
+        assert np.abs(m1_before).max() > 0
+        eng.demote_all()
+        # host tier holds the moments now
+        got = host.shards[0].evict([2])
+        np.testing.assert_allclose(got["slots"][0, 0], m1_before,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got["slots"][0, 1], m2_before,
+                                   rtol=1e-6)
+        host.shards[0].admit(got["ids"], got["rows"], got["slots"],
+                             got["steps"])
+        # re-admission restores them into the device slot arrays
+        new_slot = int(eng.route([2])[0])
+        fresh = {n: np.asarray(jax.device_get(a))
+                 for n, a in eng._slot_arrays().items()}
+        np.testing.assert_allclose(fresh["moment1"][new_slot], m1_before,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(fresh["moment2"][new_slot], m2_before,
+                                   rtol=1e-6)
+        assert key in peng.params
+
+    def test_eager_lookup_matches_host_row(self):
+        eng, _, host = _make()
+        v = host.pull([9])[0]
+        emb = TieredEmbedding(eng)
+        out = np.asarray(emb.lookup(np.array([[9]])).numpy())
+        np.testing.assert_allclose(out[0, 0], v, rtol=1e-6)

@@ -175,6 +175,36 @@ class TestExpositionConformance:
         names = {n for n, _ in samples}
         assert "p1t_serving_autoscale_decision_seconds_sum" in names
 
+    def test_embedding_families_conform(self):
+        """ISSUE 19: the sharded-embedding tier families — per-tier row
+        gauges, admission/eviction counters, and the delta-loop names —
+        render as conformant exposition with the right kinds."""
+        m = obs.MetricsRegistry()
+        m.gauge("embed_hbm_rows").set(4096)
+        m.gauge("embed_hbm_budget_rows").set(4096)
+        m.gauge("embed_hbm_bytes").set(4096 * 64)
+        m.gauge("embed_host_rows").set(150_000)
+        m.counter("embed_admit_total").inc(7)
+        m.counter("embed_demote_total").inc(3)
+        m.counter("embed_ttl_evict_total").inc()
+        m.counter("embed_hit_total").inc(90)
+        m.counter("embed_miss_total").inc(10)
+        m.counter("embed_delta_applied_total").inc(2)
+        m.counter("embed_delta_rows_total").inc(128)
+        m.counter("embed_delta_errors_total").inc()
+        m.gauge("embed_delta_version").set(2)
+        types, samples = parse_exposition(m.render_text())
+        assert types["p1t_serving_embed_hbm_rows"] == "gauge"
+        assert types["p1t_serving_embed_hbm_budget_rows"] == "gauge"
+        assert types["p1t_serving_embed_hbm_bytes"] == "gauge"
+        assert types["p1t_serving_embed_host_rows"] == "gauge"
+        assert types["p1t_serving_embed_admit_total"] == "counter"
+        assert types["p1t_serving_embed_demote_total"] == "counter"
+        assert types["p1t_serving_embed_delta_rows_total"] == "counter"
+        assert types["p1t_serving_embed_delta_version"] == "gauge"
+        names = {n for n, _ in samples}
+        assert "p1t_serving_embed_miss_total" in names
+
     def test_group_page_untyped_labeled(self):
         g = obs.MetricsGroup("version")
         self._populated(g.child("v1"))

@@ -62,6 +62,9 @@ BAD_UNIT_SUFFIXES = (
     # KV paging families (ISSUE 16): gen_kv_pages_* gauges and
     # gen_kv_page_*_total counters key dashboards on '_pages'/'_page_'
     ("_page", "_pages"), ("_pg", "_pages"),
+    # embedding-tier families (ISSUE 19): embed_*_rows gauges and
+    # embed_delta_rows_total count table ROWS — one spelling
+    ("_row", "_rows"), ("_entry", "_rows"), ("_entries", "_rows"),
 )
 
 
