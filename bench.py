@@ -2906,6 +2906,343 @@ def bench_recommender(on_tpu, steps_override=None):
             f"recommender gate failed: {json.dumps(detail)}")
 
 
+def bench_recommender_chaos(on_tpu, steps_override=None):
+    """``--recommender-chaos``: the durable-recommender acceptance.
+
+    Runs the same deterministic tiered-embedding training loop twice —
+    once clean, once faulted — against a REAL supervised table-server
+    subprocess and a live in-process serving replica fed by the delta
+    log. The faulted run composes every recommender fault in one life:
+
+    * ``ps_kill`` mid-epoch — the table server is SIGKILLed after it
+      applied+checkpointed a push but BEFORE the ack; the Supervisor
+      restarts it from its own checkpoint and the client's retry is
+      deduplicated by the push-epoch fence (exactly-once, no double
+      apply).
+    * a trainer preemption — every in-process object is discarded and
+      rebuilt, then ``restore_latest`` reloads params/opt + the embed
+      sidecar (admission ledger, LFU/TTL bookkeeping, host-tier rows)
+      and overwrites the PS with the checkpoint-consistent state.
+    * ``delta_corrupt`` + ``delta_gap`` on the live replica — a
+      bit-flipped delta file is skipped+counted, a pruned-away version
+      range surfaces as a typed gap, and the replica resyncs from the
+      trainer's next full snapshot, then keeps applying deltas.
+
+    vs_baseline is 1.0 iff the faulted run's final params AND the full
+    logical table (demote_all + PS readback) match the clean run to
+    1e-6, the admit/demote ledger balances with unaccounted == 0,
+    exactly one PS restart happened with client retries > 0, the gap
+    and resync counters fired, and the replica's served rows converge
+    to the trainer's table at 1e-6.
+    """
+    import os
+    import shutil
+    import socket
+    import sys
+    import tempfile
+    import threading
+
+    import jax
+    import paddle1_tpu as paddle
+    from paddle1_tpu.core import chaos
+    from paddle1_tpu.core.tensor import Tensor
+    from paddle1_tpu.distributed import (DeltaLog, EmbeddingService,
+                                         HBMShardedEmbedding,
+                                         ParallelEngine, ResilientTrainer,
+                                         ShardedEmbeddingEngine,
+                                         build_mesh)
+    from paddle1_tpu.distributed.embedding_delta import DeltaSubscriber
+    from paddle1_tpu.distributed.ps_server import RemoteTable
+    from paddle1_tpu.distributed.supervisor import Supervisor
+    from paddle1_tpu.obs import MetricsRegistry
+    from paddle1_tpu.obs import registry as obs_registry
+    from paddle1_tpu.serving.engine import InferenceEngine
+
+    steps = int(steps_override or 18)
+    if steps < 12:
+        raise SystemExit(
+            f"--recommender-chaos needs --steps >= 12 (got {steps}): "
+            "the faulted run must fit a checkpoint, a preemption AFTER "
+            "it, and a snapshot-driven resync")
+    SAVE = max(steps // 3, 1)          # trainer checkpoint cadence
+    SNAP = max(steps // 3, 1)          # full-snapshot publish cadence
+    PREEMPT = SAVE + max(SAVE // 2, 1)  # between the 1st and 2nd save
+    KILL_REQ = 8                        # ~3rd step's PS traffic
+    GAP_PUB = 4                         # prune at the 4th delta publish
+    CORRUPT_PUB = 2                     # bit-flip the 2nd delta file
+    VOCAB, DIM, CAP, BUDGET = 5_000, 8, 256, 128
+    BATCH, FEATS = 32, 4
+
+    rng = np.random.default_rng(0)
+
+    def _draw():
+        hot = rng.integers(0, 500, (BATCH, FEATS))
+        cold = rng.integers(0, VOCAB, (BATCH, FEATS))
+        pick = rng.random((BATCH, FEATS)) < 0.8
+        return np.where(pick, hot, cold).astype(np.int64)
+
+    # precomputed so a replayed step re-feeds the identical batch
+    ids_seq = [_draw() for _ in range(steps)]
+    ys = [rng.random((BATCH, 1)).astype(np.float32)
+          for _ in range(steps)]
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="p1t_recochaos_")
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def _logical_rows(eng, ids):
+        """Current trainer-side values for logical ids, whichever tier
+        holds them (the snapshot payload)."""
+        rows = np.zeros((len(ids), DIM), np.float32)
+        res, cold = [], []
+        for k, i in enumerate(ids):
+            (res if eng.tier_of(int(i)) == "hbm"
+             else cold).append((k, int(i)))
+        if res:
+            got = eng.read_rows(np.asarray(
+                [eng._slot_of[i] for _, i in res], np.int64))
+            for (k, _), r in zip(res, got):
+                rows[k] = r
+        if cold:
+            got = eng.host.pull(np.asarray([i for _, i in cold],
+                                           np.int64))
+            for (k, _), r in zip(cold, got):
+                rows[k] = r
+        return rows
+
+    def run(tag, faulted):
+        base = os.path.join(tmp, tag)
+        os.makedirs(base, exist_ok=True)
+        delta_dir = os.path.join(base, "deltas")
+        os.makedirs(delta_dir, exist_ok=True)
+        port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        if faulted:
+            env["FLAGS_ft_chaos"] = f"ps_kill@{KILL_REQ}"
+        sup = Supervisor(policy="restart", max_restarts=2,
+                         hang_timeout=30.0,
+                         heartbeat_dir=os.path.join(base, "hb"),
+                         poll_s=0.1, grace_s=5.0)
+        sup.add_worker(
+            0, [sys.executable, "-m",
+                "paddle1_tpu.distributed.ps_server",
+                "--dim", str(DIM), "--port", str(port),
+                "--optimizer", "sgd", "--lr", "0.1", "--init", "zeros",
+                "--ckpt-dir", os.path.join(base, "ps-ckpt"),
+                "--save-every", "1"],
+            env=env, role="ps", essential=False,
+            log_path=os.path.join(base, "ps.log"))
+        sup.start()
+        stop_evt = threading.Event()
+
+        def _sweep():
+            while not stop_evt.is_set():
+                sup.supervise_once()
+                stop_evt.wait(0.1)
+
+        sweeper = threading.Thread(target=_sweep, daemon=True)
+        sweeper.start()
+
+        # the live replica: zero-init lookup fed only by the delta log
+        class _Replica(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = paddle.nn.Embedding(VOCAB, DIM)
+                self.emb.weight._data = jax.numpy.zeros(
+                    (VOCAB, DIM), jax.numpy.float32)
+
+            def forward(self, ids):
+                return self.emb(ids)
+
+        reng = InferenceEngine(_Replica(), buckets=(1, 8))
+        reg = MetricsRegistry()
+        sub = DeltaSubscriber(delta_dir, reng.update_param_rows,
+                              poll_s=0.02, metrics=reg).start()
+
+        def build():
+            paddle.seed(0)
+            hbm = HBMShardedEmbedding(CAP, DIM)
+            remote = RemoteTable(f"127.0.0.1:{port}", timeout=10.0,
+                                 max_retries=40, backoff_base_s=0.02,
+                                 backoff_max_s=0.25)
+            host = EmbeddingService(DIM, shards=[remote])
+            eng = ShardedEmbeddingEngine(hbm, host, hbm_row_budget=BUDGET)
+
+            class _CTR(paddle.nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    from paddle1_tpu.nn import TieredEmbedding
+                    self.emb = TieredEmbedding(eng)
+                    self.head = paddle.nn.Linear(DIM, 1)
+
+                def forward(self, slots):
+                    return self.head(self.emb(slots).mean(axis=1))
+
+            model = _CTR()
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+            peng = ParallelEngine(
+                model, opt,
+                lambda m, b: ((m(Tensor(b["slots"])) - Tensor(b["y"]))
+                              ** 2).mean(),
+                mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+                check_finite=True)
+            eng.bind_engine(peng)
+            tr = ResilientTrainer(peng, os.path.join(base, "ckpts"),
+                                  save_freq=SAVE, backoff_base_s=0.0)
+            tr.attach_embedding(eng)
+            return eng, peng, tr
+
+        chaos.reset()
+        if faulted:
+            chaos.configure(f"delta_corrupt@{CORRUPT_PUB},"
+                            f"delta_gap@{GAP_PUB}")
+        preg = obs_registry.process_registry()
+        retries0 = preg.counter("ft_ps_retries_total").value
+        eng, peng, tr = build()
+        dlog = DeltaLog(delta_dir)
+        resumed_from = None
+        try:
+            step = 0
+            while step < steps:
+                slots = eng.route(ids_seq[step], now=float(step))
+                peng.step({"slots": slots, "y": ys[step]})
+                d_ids, d_rows = eng.drain_dirty()
+                if d_ids.size:
+                    dlog.publish("emb.weight", d_ids, d_rows)
+                step += 1
+                if step % SAVE == 0:
+                    tr.save(step)
+                if step % SNAP == 0:
+                    ever = sorted(eng._ever)
+                    dlog.publish_snapshot(
+                        "emb.weight", np.asarray(ever, np.int64),
+                        _logical_rows(eng, ever))
+                if faulted and resumed_from is None and step == PREEMPT:
+                    # simulated preemption: every in-process object is
+                    # lost; the rebuilt stack restores params + the
+                    # embed sidecar and rolls the PS back with it
+                    eng, peng, tr = build()
+                    dlog = DeltaLog(delta_dir)
+                    step = resumed_from = tr.restore_latest()
+            peng.drain()
+            params = {k: np.asarray(v) for k, v in peng.params.items()}
+            acc = eng.accounting()
+            eng.demote_all()
+            tstate = eng.host.state_dict()
+            table = {}
+            for sd in tstate["shards"]:
+                for i, r in sd["rows"].items():
+                    table[int(i)] = np.asarray(r, np.float32)
+            # replica convergence: every trained row arrived through
+            # deltas (or the post-gap snapshot resync) — compare the
+            # served bytes against the trainer's table
+            trained = np.asarray(sorted(eng._ever), np.int64)
+            want = np.stack([table[int(i)] for i in trained])
+            replica_err = float("inf")
+            deadline = time.perf_counter() + 15.0
+            while time.perf_counter() < deadline:
+                got = reng.param_rows("emb.weight", trained)
+                replica_err = float(np.max(np.abs(got - want)))
+                if replica_err <= 1e-6:
+                    break
+                time.sleep(0.05)
+            stale = reg.gauge("embed_delta_staleness_seconds").value
+            return {
+                "params": params, "table": table, "acc": acc,
+                "replica_err": replica_err,
+                "staleness_s": float(stale),
+                "resumed_from": resumed_from,
+                "restarts": sup.report.total_restarts,
+                "ps_retries": (preg.counter("ft_ps_retries_total").value
+                               - retries0),
+                "gaps": reg.counter("delta_gaps_total").value,
+                "resyncs": reg.counter("delta_resyncs_total").value,
+                "corrupt": reg.counter("delta_corrupt_total").value,
+            }
+        finally:
+            chaos.reset()
+            sub.stop()
+            stop_evt.set()
+            sweeper.join(timeout=5.0)
+            try:
+                sup.kill_worker(0)
+            except Exception:
+                pass
+
+    try:
+        t0 = time.perf_counter()
+        clean = run("clean", faulted=False)
+        faulted = run("faulted", faulted=True)
+        dt = time.perf_counter() - t0
+
+        max_err = max(
+            float(np.max(np.abs(clean["params"][k] -
+                                faulted["params"][k])))
+            for k in clean["params"])
+        table_err = 0.0
+        table_ok = set(clean["table"]) == set(faulted["table"])
+        if table_ok:
+            for i in clean["table"]:
+                table_err = max(table_err, float(np.max(np.abs(
+                    clean["table"][i] - faulted["table"][i]))))
+        acc = faulted["acc"]
+        unaccounted = (acc["admit_total"] - acc["demote_total"]
+                       - acc["resident"])
+        recovered = (
+            max_err <= 1e-6 and table_ok and table_err <= 1e-6
+            and acc["balanced"] and unaccounted == 0
+            and faulted["restarts"] == 1 and clean["restarts"] == 0
+            and faulted["ps_retries"] > 0
+            and faulted["resumed_from"] is not None
+            and faulted["resumed_from"] >= SAVE
+            and faulted["gaps"] >= 1 and faulted["resyncs"] >= 1
+            and faulted["corrupt"] >= 1
+            and clean["gaps"] == 0
+            and faulted["replica_err"] <= 1e-6
+            and clean["replica_err"] <= 1e-6)
+        detail = {
+            "steps": steps, "save_freq": SAVE, "snap_freq": SNAP,
+            "preempt_step": PREEMPT, "kill_request": KILL_REQ,
+            "gap_publish": GAP_PUB, "corrupt_publish": CORRUPT_PUB,
+            "max_param_err": max_err, "table_err": table_err,
+            "table_rows": len(faulted["table"]),
+            "unaccounted": unaccounted,
+            "ledger_balanced": acc["balanced"],
+            "ps_restarts": faulted["restarts"],
+            "ps_retries": faulted["ps_retries"],
+            "resumed_from": faulted["resumed_from"],
+            "delta_gaps": faulted["gaps"],
+            "delta_resyncs": faulted["resyncs"],
+            "delta_corrupt_skips": faulted["corrupt"],
+            "replica_err_clean": clean["replica_err"],
+            "replica_err_faulted": faulted["replica_err"],
+            "clean_restarts": clean["restarts"],
+            "clean_gaps": clean["gaps"],
+            "staleness_s": faulted["staleness_s"],
+            "elapsed_s": round(dt, 3),
+        }
+        _emit("recommender_chaos_recovered_steps_per_sec",
+              2 * steps / dt, "steps/s",
+              1.0 if recovered else 0.0, detail)
+        if not recovered:
+            raise AssertionError(
+                f"recommender chaos soak did NOT recover: "
+                f"{json.dumps(detail)}")
+    finally:
+        chaos.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import os
     ap = argparse.ArgumentParser()
@@ -2985,6 +3322,20 @@ def main():
                          "trainer's drained delta lands on a live "
                          "ServingFleet replica in < 5 s at 1e-6; "
                          "vs_baseline is 1.0 iff every gate holds")
+    ap.add_argument("--recommender-chaos", dest="recommender_chaos",
+                    action="store_true",
+                    help="durable-recommender soak: the tiered-"
+                         "embedding loop vs a supervised table-server "
+                         "subprocess through a ps_kill (restart-from-"
+                         "own-checkpoint + fenced exactly-once retry), "
+                         "a trainer preemption restored from the embed "
+                         "checkpoint sidecar, and delta_corrupt + "
+                         "delta_gap on a live replica healed by "
+                         "snapshot resync; vs_baseline is 1.0 iff "
+                         "final params AND the full logical table "
+                         "match the clean run to 1e-6 with a balanced "
+                         "ledger, unaccounted==0, exactly one PS "
+                         "restart, and replica convergence at 1e-6")
     ap.add_argument("--serving", action="store_true",
                     help="dynamic micro-batching soak: serve N requests "
                          "sequentially and through the Batcher at batch "
@@ -3068,6 +3419,8 @@ def main():
         bench_generate_fleet(on_tpu, steps_override=args.steps)
     elif args.recommender:
         bench_recommender(on_tpu, steps_override=args.steps)
+    elif args.recommender_chaos:
+        bench_recommender_chaos(on_tpu, steps_override=args.steps)
     elif args.serving:
         bench_serving(on_tpu, steps_override=args.steps)
     elif args.generate:

@@ -163,6 +163,35 @@ clean):
                        faults so the preemption path (shed prefix
                        cache, preempt lowest-priority stream, park +
                        re-admit bit-identically) runs deterministically.
+
+Parameter-server points (checked by :func:`check_ps` once per REQUEST
+the TableServer handles; armed in the PS server PROCESS via the env
+the owner forwards at spawn, qualifier = the PS server rank):
+
+``ps_kill``          — ``ps_kill@N[:R]``: SIGKILL self on the Nth
+                       request, AFTER applying + checkpointing it but
+                       BEFORE acking — the client's bounded
+                       retry/reconnect replays the un-acked request
+                       into the restarted-from-checkpoint server, and
+                       the push-epoch fence must make the replay
+                       idempotent (exactly-once even when the dead
+                       server DID apply it).
+``ps_hang``          — stall the Nth request past the client's socket
+                       timeout (a wedged PS — the retry path's
+                       reconnect must turn it into a stall, not a
+                       trainer crash).
+
+Delta-pipeline points (checked inside ``DeltaLog.publish``; each
+counts its own publishes, qualifier unused):
+
+``delta_corrupt``    — bit-flip the Nth published delta file after its
+                       CRC was computed: the subscriber's verify must
+                       skip + count it, never apply it.
+``delta_gap``        — after the Nth publish, prune every older delta
+                       from under any lagging reader: the subscriber
+                       must surface a typed ``DeltaGapDetected`` and
+                       resync from a snapshot instead of silently
+                       serving stale rows.
 """
 
 from __future__ import annotations
@@ -179,6 +208,7 @@ __all__ = [
     "check_sample", "check_loader_worker_kill", "check_loader_stall",
     "check_replica", "check_gen_step", "check_collective",
     "check_gen_replica", "check_gen_pressure",
+    "check_ps", "check_delta_corrupt", "check_delta_gap",
     "request_preemption", "preemption_requested",
     "POISON_BATCH", "CKPT_FAIL", "LOADER_RAISE", "PREEMPT", "SERVE_SLOW",
     "WORKER_KILL", "WORKER_HANG", "WORKER_UNHEALTHY",
@@ -186,6 +216,7 @@ __all__ = [
     "REPLICA_KILL", "REPLICA_HANG", "REPLICA_SLOW",
     "GEN_SLOT_WEDGE", "GEN_SLOW_STEP", "COLLECTIVE_SKIP",
     "GEN_REPLICA_KILL", "GEN_REPLICA_HANG", "GEN_PAGE_PRESSURE",
+    "PS_KILL", "PS_HANG", "DELTA_CORRUPT", "DELTA_GAP",
 ]
 
 POISON_BATCH = "nan_batch"
@@ -208,6 +239,10 @@ COLLECTIVE_SKIP = "collective_skip"
 GEN_REPLICA_KILL = "gen_replica_kill"
 GEN_REPLICA_HANG = "gen_replica_hang"
 GEN_PAGE_PRESSURE = "gen_page_pressure"
+PS_KILL = "ps_kill"
+PS_HANG = "ps_hang"
+DELTA_CORRUPT = "delta_corrupt"
+DELTA_GAP = "delta_gap"
 
 _WORKER_POINTS = (WORKER_KILL, WORKER_HANG, WORKER_UNHEALTHY)
 # loader points share the worker points' ":qualifier" grammar, but the
@@ -225,9 +260,14 @@ _COLLECTIVE_POINTS = (COLLECTIVE_SKIP,)
 # scheduler ticks (qualifier unused)
 _GEN_FLEET_POINTS = (GEN_REPLICA_KILL, GEN_REPLICA_HANG,
                      GEN_PAGE_PRESSURE)
+# parameter-server points: kill/hang share one REQUEST counter
+# (qualifier = the PS server rank)
+_PS_POINTS = (PS_KILL, PS_HANG)
+# delta-pipeline points: each counts its own publishes (qualifier unused)
+_DELTA_POINTS = (DELTA_CORRUPT, DELTA_GAP)
 _QUALIFIED_POINTS = (_WORKER_POINTS + _LOADER_POINTS + _REPLICA_POINTS
                      + _GEN_POINTS + _COLLECTIVE_POINTS
-                     + _GEN_FLEET_POINTS)
+                     + _GEN_FLEET_POINTS + _PS_POINTS + _DELTA_POINTS)
 _POINTS = (POISON_BATCH, CKPT_FAIL, LOADER_RAISE,
            PREEMPT, SERVE_SLOW) + _QUALIFIED_POINTS
 
@@ -552,6 +592,43 @@ def check_collective(rank: int) -> bool:
     bookkeeping. Fires exactly once, so a retried operation replays
     clean."""
     return enabled() and _fire_qualified(COLLECTIVE_SKIP, rank)
+
+
+def check_ps(rank: int = 0) -> Optional[str]:
+    """Parameter-server points, evaluated once per request the
+    :class:`~paddle1_tpu.distributed.ps_server.TableServer` handles.
+    Kill and hang share one request counter (``ps_kill@N:R`` reads "on
+    the Nth request of PS rank R"; without ``:R`` any server's Nth
+    request matches); priority ``PS_KILL`` > ``PS_HANG`` when both arm
+    the same request. The *action* (apply + checkpoint, then SIGKILL
+    self before acking / stalling past the client timeout) is performed
+    by ``distributed.ps_server`` — this stays pure bookkeeping."""
+    if not _armed_worker:
+        return None
+    with _lock:
+        n = _counters.get("ps_request", 0) + 1
+        _counters["ps_request"] = n
+        for point in _PS_POINTS:
+            armed = _armed_worker.get(point, ())
+            if (n, None) in armed or (n, rank) in armed:
+                return point
+    return None
+
+
+def check_delta_corrupt() -> bool:
+    """``delta_corrupt``: True on an armed delta-publish occurrence
+    (own counter). The *action* (bit-flipping the committed payload so
+    the subscriber's CRC verify must catch it) belongs to
+    ``DeltaLog.publish`` — this stays pure bookkeeping."""
+    return enabled() and _fire_qualified(DELTA_CORRUPT, 0)
+
+
+def check_delta_gap() -> bool:
+    """``delta_gap``: True on an armed delta-publish occurrence (own
+    counter). The *action* (force-pruning every older delta from under
+    a lagging reader, seeding the hole ``DeltaGapDetected`` must catch)
+    belongs to ``DeltaLog.publish`` — this stays pure bookkeeping."""
+    return enabled() and _fire_qualified(DELTA_GAP, 0)
 
 
 def _fire_qualified(point: str, qualifier: int) -> bool:

@@ -421,6 +421,22 @@ def _define_builtin_flags() -> None:
     define_flag("ft_save_freq", 100,
                 "ResilientTrainer default checkpoint period in steps.",
                 validator=lambda v: v >= 1)
+    define_flag("ft_ps_max_retries", 5,
+                "RemoteTable transport retries (reconnect + replay "
+                "through the push-epoch fence) before a table-server "
+                "call raises typed PsUnavailableError. Sized to cover "
+                "a Supervisor restart-from-checkpoint of the PS "
+                "worker: a server death mid-pull/push is a stall, not "
+                "a trainer crash (reference: PSERVER relaunch + "
+                "worker reconnect).",
+                validator=lambda v: v >= 0)
+    define_flag("ft_ps_backoff_base_s", 0.05,
+                "First RemoteTable retry backoff; doubles per attempt "
+                "(capped by ft_ps_backoff_max_s).",
+                validator=lambda v: v >= 0)
+    define_flag("ft_ps_backoff_max_s", 2.0,
+                "Backoff ceiling for the RemoteTable retry schedule.",
+                validator=lambda v: v >= 0)
     define_flag("ft_divergence_factor", 0.0,
                 "Loss-explosion watchdog: a finite loss greater than "
                 "factor * running-mean counts as a bad step (0 "

@@ -51,7 +51,8 @@ from ..core.errors import EnforceNotMet
 __all__ = ["save_sharded", "load_sharded", "latest_step",
            "committed_steps", "CheckpointCorruptError", "CheckpointManager",
            "MANIFEST_NAME", "write_manifest", "read_manifest",
-           "verify_manifest", "tree_mesh_descriptor", "manifest_mesh"]
+           "verify_manifest", "tree_mesh_descriptor", "manifest_mesh",
+           "read_sidecar"]
 
 MANIFEST_NAME = "manifest.json"
 
@@ -205,6 +206,67 @@ def _json_safe_meta(obj, keypath="meta"):
         "meta carries small host state only (steps, seeds, cursors)")
 
 
+def _write_sidecars(path: str,
+                    sidecars: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Write each sidecar as ``sidecar-<name>.npz`` inside the (still
+    uncommitted) step dir and return the manifest entry mapping name →
+    file + sha256. The manifest rename is what commits them — a reader
+    never sees a sidecar without its digest."""
+    import numpy as _np
+    info: Dict[str, Any] = {}
+    for name, arrays in sidecars.items():
+        if not re.fullmatch(r"[A-Za-z0-9_.-]+", name):
+            raise ValueError(
+                f"sidecar name {name!r} must be a plain identifier "
+                "(it becomes a filename inside the checkpoint)")
+        fname = f"sidecar-{name}.npz"
+        fp = os.path.join(path, fname)
+        with open(fp, "wb") as f:
+            _np.savez(f, **{k: _np.asarray(v)
+                            for k, v in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        with open(fp, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        info[name] = {"file": fname, "sha256": digest}
+    return info
+
+
+def read_sidecar(path: str, name: str) -> Dict[str, Any]:
+    """Load + digest-verify one sidecar of a COMMITTED checkpoint dir.
+    Raises :class:`CheckpointCorruptError` when the manifest has no
+    such sidecar, the file is missing, or its sha256 no longer matches
+    the one stamped at commit time."""
+    import numpy as _np
+    doc = read_manifest(path)
+    if doc is None:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has no readable manifest — not a "
+            "committed checkpoint")
+    info = (doc.get("meta") or {}).get("sidecars", {}).get(name)
+    if info is None:
+        raise CheckpointCorruptError(
+            f"checkpoint {path} has no sidecar {name!r} "
+            f"(manifest lists {sorted((doc.get('meta') or {}).get('sidecars', {}))})")
+    fp = os.path.join(path, info["file"])
+    try:
+        with open(fp, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"sidecar {name!r} of checkpoint {path} is unreadable: "
+            f"{e}") from e
+    digest = hashlib.sha256(blob).hexdigest()
+    if digest != info["sha256"]:
+        raise CheckpointCorruptError(
+            f"sidecar {name!r} of checkpoint {path} failed digest "
+            f"verification (manifest {info['sha256'][:12]}…, file "
+            f"{digest[:12]}…) — treat this checkpoint as corrupt")
+    import io as _io
+    with _np.load(_io.BytesIO(blob), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
 def write_manifest(path: str, state, meta: Optional[Dict[str, Any]] = None):
     """Stamp ``manifest.json`` into a checkpoint dir: the commit marker
     plus the tree spec ``restore`` verifies against its target."""
@@ -306,15 +368,23 @@ class CheckpointManager:
         return os.path.join(self.directory, str(int(step)))
 
     def save(self, step: int, state: Dict[str, Any],
-             meta: Optional[Dict[str, Any]] = None):
+             meta: Optional[Dict[str, Any]] = None,
+             sidecars: Optional[Dict[str, Dict[str, Any]]] = None):
         """Atomically commit ``state`` as checkpoint ``step``.
 
-        Write order: orbax save into ``<step>.tmp-<pid>`` → manifest
-        stamped inside it (the commit marker) → rename over the final
-        path. A crash (or an injected ``ckpt_fail``) before the rename
-        leaves only uncommitted debris that restore/GC ignore/sweep.
+        Write order: orbax save into ``<step>.tmp-<pid>`` → sidecar npz
+        files written beside the arrays → manifest stamped inside it
+        (the commit marker, carrying each sidecar's sha256) → rename
+        over the final path. A crash (or an injected ``ckpt_fail``)
+        before the rename leaves only uncommitted debris that
+        restore/GC ignore/sweep.
         ``meta`` (small, JSON-serializable — step counters, RNG state)
-        rides in the manifest, not in orbax arrays.
+        rides in the manifest, not in orbax arrays. ``sidecars`` is for
+        HOST state too big/ragged for the manifest and outside the
+        device tree (the embedding engine's admission ledger, a host
+        SparseTable tier): ``{name: {key: array-or-scalar}}``, each
+        saved as one npz inside the step dir — committed by the same
+        rename, digest-verified by :meth:`read_sidecar`.
         """
         final = self._step_dir(step)
         multi = jax.process_count() > 1
@@ -340,6 +410,9 @@ class CheckpointManager:
                 chaos.check_checkpoint_write()  # injected mid-write
                 # failure: arrays on disk, no manifest, no rename —
                 # an uncommitted partial
+                if sidecars:
+                    meta = dict(meta or {})
+                    meta["sidecars"] = _write_sidecars(tmp, sidecars)
                 write_manifest(tmp, state, meta=meta)
                 if os.path.isdir(final):
                     # re-saving an existing step (rollback-and-replay):
@@ -439,6 +512,16 @@ class CheckpointManager:
             return None
         doc = read_manifest(self._step_dir(step))
         return None if doc is None else doc.get("meta", {})
+
+    def read_sidecar(self, name: str,
+                     step: Optional[int] = None) -> Dict[str, Any]:
+        """Digest-verified sidecar arrays of checkpoint ``step`` (the
+        newest committed one when omitted)."""
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise CheckpointCorruptError(
+                f"no committed checkpoints under {self.directory}")
+        return read_sidecar(self._step_dir(step), name)
 
     def latest_step(self) -> Optional[int]:
         return latest_step(self.directory)

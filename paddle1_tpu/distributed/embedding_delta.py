@@ -8,9 +8,12 @@ a versioned, atomically-published file log — the same tmp-file +
 reader never observes a half-written delta:
 
 * :class:`DeltaLog` — the trainer side. ``publish(param, ids, rows)``
-  writes ``delta-<version>.npz`` (ids + rows + target param name) and
-  prunes old versions beyond ``keep``. Publishing is journaled with
-  the PR 14 collective sanitizer (op ``delta_publish``) so a rank
+  writes ``delta-<version>.npz`` (ids + rows + target param name + a
+  CRC over the payload) and prunes old versions beyond ``keep``.
+  ``publish_snapshot`` writes a full-row ``snap-<version>.npz`` anchor
+  (typically at the trainer's checkpoint barrier) — the resync source
+  for a reader that fell off the pruned tail. Publishing is journaled
+  with the PR 14 collective sanitizer (op ``delta_publish``) so a rank
   whose publish schedule diverges fails typed at verify.
 * :class:`DeltaSubscriber` — the consumer side (a serving replica or
   an in-process test). A polling daemon applies every new version in
@@ -18,6 +21,19 @@ reader never observes a half-written delta:
   ``InferenceEngine.update_param_rows``, which rewrites rows of a
   jit-ARGUMENT param dict: same shapes/dtypes, so a delta never
   recompiles anything. ``wait_version`` is the test/latency hook.
+
+Exactly-once discipline (ISSUE 20): every record carries a CRC that is
+verified before apply — a torn or bit-flipped file is *skipped and
+counted* (``delta_skipped_files_total`` / ``delta_corrupt_total``),
+never applied. A version GAP (a file pruned or corrupted from under a
+lagging reader) is no longer silently jumped: the subscriber counts it
+(``delta_gaps_total``), resyncs from the newest snapshot or a caller
+``resync_fn`` (``delta_resyncs_total``), and if neither covers the gap
+raises the typed :class:`DeltaGapDetected` and STALLS — knowingly
+stale, with the ``embed_delta_staleness_seconds`` gauge growing so a
+``stale(embed_delta_staleness_seconds)<N`` SLO clause
+(``FLAGS_obs_slos``) turns it into a ``/healthz`` verdict — instead of
+serving stale rows forever.
 
 Versions are a monotone integer. The log directory is the unit of
 deployment: point the fleet's ``delta_dir`` at the trainer's log and
@@ -33,18 +49,37 @@ import re
 import tempfile
 import threading
 import time
+import zipfile
+import zlib
 from typing import Callable, List, NamedTuple, Optional
 
 import numpy as np
 
+from ..core import chaos as _chaos
 from ..core import collective_sanitizer as _csan
-from ..core.errors import InvalidArgumentError
+from ..core.errors import InvalidArgumentError, UnavailableError
 
-__all__ = ["DeltaRecord", "DeltaLog", "DeltaSubscriber", "read_since"]
+__all__ = ["DeltaRecord", "DeltaLog", "DeltaSubscriber",
+           "DeltaGapDetected", "read_since", "latest_snapshot"]
 
 _log = logging.getLogger("paddle1_tpu.embedding_delta")
 
 _NAME_RE = re.compile(r"delta-(\d{12})\.npz$")
+_SNAP_RE = re.compile(r"snap-(\d{12})\.npz$")
+
+# directories we already warned about skipped files for (satellite:
+# warn once per directory, count every skip)
+_skip_warned: set = set()
+_skip_lock = threading.Lock()
+
+
+class DeltaGapDetected(UnavailableError):
+    """The delta stream has a version hole this reader cannot bridge:
+    files between its applied version and the oldest available version
+    were pruned or corrupted, and no snapshot (or ``resync_fn``) covers
+    the range. The replica is knowingly stale — resync it from a
+    checkpoint (have the trainer ``publish_snapshot``) instead of
+    letting it serve old rows forever."""
 
 
 class DeltaRecord(NamedTuple):
@@ -52,6 +87,13 @@ class DeltaRecord(NamedTuple):
     param: str
     ids: np.ndarray    # int64 [n]
     rows: np.ndarray   # float32 [n, dim]
+    crc: int = 0       # zlib.crc32 over param/ids/rows (0 = legacy file)
+
+
+def _crc(param: str, ids: np.ndarray, rows: np.ndarray) -> int:
+    c = zlib.crc32(str(param).encode())
+    c = zlib.crc32(np.ascontiguousarray(ids).tobytes(), c)
+    return zlib.crc32(np.ascontiguousarray(rows).tobytes(), c)
 
 
 def _version_of(path: str) -> Optional[int]:
@@ -59,25 +101,86 @@ def _version_of(path: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
-def read_since(directory: str, version: int) -> List[DeltaRecord]:
+def _snap_version_of(path: str) -> Optional[int]:
+    m = _SNAP_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _load_record(path: str) -> DeltaRecord:
+    """Load + CRC-verify one delta/snapshot file (raises ValueError on
+    a checksum mismatch so callers treat corruption like a torn file)."""
+    with np.load(path, allow_pickle=False) as z:
+        rec = DeltaRecord(
+            int(z["version"]), str(z["param"]),
+            np.asarray(z["ids"], np.int64),
+            np.asarray(z["rows"], np.float32),
+            int(z["crc"]) if "crc" in z else 0)
+    if rec.crc and rec.crc != _crc(rec.param, rec.ids, rec.rows):
+        raise ValueError(f"crc mismatch in {os.path.basename(path)}")
+    return rec
+
+
+def _count_skip(directory: str, path: str, err: Exception,
+                metrics=None, corrupt: bool = False) -> None:
+    """Count (and warn once per directory about) a skipped file."""
+    if metrics is None:
+        from ..obs.registry import process_registry
+        metrics = process_registry()
+    metrics.counter("delta_skipped_files_total").inc()
+    if corrupt:
+        metrics.counter("delta_corrupt_total").inc()
+    with _skip_lock:
+        first = directory not in _skip_warned
+        if first:
+            _skip_warned.add(directory)
+    if first:
+        _log.warning(
+            "skipping unreadable delta file %s (%s) — pruned from under "
+            "this reader or corrupt; counted in "
+            "delta_skipped_files_total (warned once per directory)",
+            path, err)
+
+
+def read_since(directory: str, version: int,
+               metrics=None) -> List[DeltaRecord]:
     """Every record in ``directory`` with version > ``version``, in
-    order. A file pruned from under a lagging reader is skipped (the
-    reader should then resync from a checkpoint — deltas are a cache,
-    the manifest checkpoint is the source of truth)."""
+    order. A file pruned from under a lagging reader — or one whose CRC
+    no longer matches its payload — is skipped, counted
+    (``delta_skipped_files_total``; corruption additionally in
+    ``delta_corrupt_total``) and warned about once per directory. The
+    reader should then resync from a checkpoint: deltas are a cache,
+    the manifest checkpoint is the source of truth."""
     out = []
     for p in sorted(glob.glob(os.path.join(directory, "delta-*.npz"))):
         v = _version_of(p)
         if v is None or v <= version:
             continue
         try:
-            with np.load(p, allow_pickle=False) as z:
-                out.append(DeltaRecord(
-                    int(z["version"]), str(z["param"]),
-                    np.asarray(z["ids"], np.int64),
-                    np.asarray(z["rows"], np.float32)))
-        except (OSError, ValueError, KeyError):
-            continue   # pruned/half-visible on exotic fs: next poll
+            out.append(_load_record(p))
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+            # pruned/half-visible on exotic fs, or corrupt (our CRC or
+            # the zip container's): skip + count, next poll/resync
+            corrupt = (isinstance(e, zipfile.BadZipFile)
+                       or "crc mismatch" in str(e))
+            _count_skip(directory, p, e, metrics, corrupt=corrupt)
     return out
+
+
+def latest_snapshot(directory: str, metrics=None) -> Optional[DeltaRecord]:
+    """The newest readable full-row snapshot in ``directory`` (None if
+    there is none). Unreadable snapshots are counted like skipped
+    deltas and the next-newest is tried."""
+    paths = sorted((p for p in glob.glob(
+        os.path.join(directory, "snap-*.npz"))
+        if _snap_version_of(p) is not None), reverse=True)
+    for p in paths:
+        try:
+            return _load_record(p)
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+            corrupt = (isinstance(e, zipfile.BadZipFile)
+                       or "crc mismatch" in str(e))
+            _count_skip(directory, p, e, metrics, corrupt=corrupt)
+    return None
 
 
 class DeltaLog:
@@ -93,6 +196,36 @@ class DeltaLog:
         self._version = self.latest_version()
 
     # -- write side ---------------------------------------------------------
+
+    def _write_versioned(self, prefix: str, v: int, param: str,
+                         ids: np.ndarray, rows: np.ndarray) -> str:
+        """tmp-write + fsync + atomic rename of one versioned npz (the
+        commit discipline the module docstring promises): readers see
+        the whole file with a valid CRC, or no file at all."""
+        final = os.path.join(self.directory, f"{prefix}-{v:012d}.npz")
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, version=np.int64(v),
+                         param=np.asarray(param), ids=ids, rows=rows,
+                         crc=np.int64(_crc(param, ids, rows)))
+                f.flush()
+                os.fsync(f.fileno())
+            if prefix == "delta" and _chaos.check_delta_corrupt():
+                # chaos `delta_corrupt`: bit-flip the committed payload
+                # AFTER the CRC was computed — the reader's verify must
+                # catch it (skip + count), never apply it
+                with open(tmp, "r+b") as f:
+                    f.seek(max(0, os.path.getsize(tmp) // 2))
+                    f.write(b"\xde\xad\xbe\xef")
+            os.replace(tmp, final)   # readers see all or nothing
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return final
 
     def publish(self, param: str, ids, rows,
                 version: Optional[int] = None) -> int:
@@ -112,30 +245,56 @@ class DeltaLog:
                 raise InvalidArgumentError(
                     f"delta version {v} is not past the log head "
                     f"{self._version} — versions are monotone")
-            final = os.path.join(self.directory, f"delta-{v:012d}.npz")
-            fd, tmp = tempfile.mkstemp(dir=self.directory,
-                                       suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    np.savez(f, version=np.int64(v),
-                             param=np.asarray(param),
-                             ids=ids, rows=rows)
-                os.replace(tmp, final)   # readers see all or nothing
-            except BaseException:
+            self._write_versioned("delta", v, param, ids, rows)
+            self._version = v
+            if _chaos.check_delta_gap():
+                # chaos `delta_gap`: prune everything but the head from
+                # under any lagging reader — the subscriber must detect
+                # the hole typed, not silently jump it
+                self._prune_locked(keep=1)
+            else:
+                self._prune_locked()
+            return v
+
+    def publish_snapshot(self, param: str, ids, rows) -> int:
+        """Atomically publish a FULL-ROW snapshot anchor (every trained
+        row of ``param``) at the next version. Published at the
+        trainer's checkpoint barrier, it is what a gapped subscriber
+        resyncs from; older snapshots are pruned (the new anchor
+        supersedes them). Deltas are deliberately LEFT to the ``keep``
+        window: a reader lagging a few versions behind the anchor keeps
+        its contiguous stream instead of being forced through a resync
+        on every snapshot."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[0] != ids.shape[0]:
+            raise InvalidArgumentError(
+                f"snapshot rows must be [len(ids), dim]; got ids "
+                f"{ids.shape} rows {rows.shape}")
+        with self._lock:
+            v = self._version + 1
+            self._write_versioned("snap", v, param, ids, rows)
+            self._version = v
+            # one snapshot is the resync anchor; the previous ones can
+            # go. Deltas stay under the keep-window so an in-stream
+            # reader is not gapped by its own anchor.
+            snaps = sorted(p for p in glob.glob(
+                os.path.join(self.directory, "snap-*.npz"))
+                if _snap_version_of(p) is not None)
+            for p in snaps[:-1]:
                 try:
-                    os.unlink(tmp)
+                    os.unlink(p)
                 except OSError:
                     pass
-                raise
-            self._version = v
             self._prune_locked()
             return v
 
-    def _prune_locked(self) -> None:
+    def _prune_locked(self, keep: Optional[int] = None) -> None:
+        keep = self.keep if keep is None else keep
         files = sorted(p for p in glob.glob(
             os.path.join(self.directory, "delta-*.npz"))
             if _version_of(p) is not None)
-        for p in files[:-self.keep]:
+        for p in files[:-keep]:
             try:
                 os.unlink(p)
             except OSError:
@@ -146,6 +305,8 @@ class DeltaLog:
     def latest_version(self) -> int:
         vs = [_version_of(p) for p in glob.glob(
             os.path.join(self.directory, "delta-*.npz"))]
+        vs += [_snap_version_of(p) for p in glob.glob(
+            os.path.join(self.directory, "snap-*.npz"))]
         vs = [v for v in vs if v is not None]
         return max(vs) if vs else 0
 
@@ -156,19 +317,29 @@ class DeltaLog:
 class DeltaSubscriber:
     """Polling consumer: applies new delta versions in order through
     ``apply_fn(param, ids, rows)``. Daemon thread; exactly-once per
-    version (monotone ``applied_version``)."""
+    version (monotone ``applied_version``), CRC-verified reads, typed
+    gap detection with snapshot/``resync_fn`` recovery (see module
+    docstring)."""
 
     def __init__(self, directory: str, apply_fn: Callable,
                  poll_s: float = 0.05, metrics=None,
-                 from_version: int = 0):
+                 from_version: int = 0,
+                 resync_fn: Optional[Callable[[], int]] = None):
         self.directory = str(directory)
         self._apply = apply_fn
         self.poll_s = float(poll_s)
         self.metrics = metrics
         self.applied_version = int(from_version)
+        # resync_fn() restores this reader's full state from an
+        # external checkpoint and returns the delta version that state
+        # corresponds to (preferred over the in-log snapshot when set)
+        self._resync = resync_fn
         self._stop = threading.Event()
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
+        self._in_gap = False        # gap counted once per episode
+        self._gap_warned = False    # daemon warns once per episode
+        self._stale_since: Optional[float] = None
 
     def start(self) -> "DeltaSubscriber":
         if self._thread is not None and self._thread.is_alive():
@@ -184,36 +355,157 @@ class DeltaSubscriber:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # -- gap recovery -------------------------------------------------------
+
+    def _counter(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc()
+        from ..obs.registry import process_registry
+        if self.metrics is not process_registry():
+            process_registry().counter(name).inc()
+
+    def _set_applied(self, version: int) -> None:
+        with self._cond:
+            self.applied_version = version
+            self._cond.notify_all()
+
+    def _recover_gap(self, first_avail: int) -> None:
+        """Bridge ``applied_version + 1 .. first_avail - 1``. Counts the
+        gap once, then tries ``resync_fn`` / the newest snapshot; if
+        neither covers the hole, raises :class:`DeltaGapDetected` (the
+        caller stays knowingly stale and retries next poll — a later
+        ``publish_snapshot`` heals it)."""
+        if not self._in_gap:
+            self._in_gap = True
+            self._counter("delta_gaps_total")
+        if self._resync is not None:
+            v = int(self._resync())
+            self._counter("delta_resyncs_total")
+            self._set_applied(max(v, self.applied_version))
+            self._in_gap = self._gap_warned = False
+            return
+        snap = latest_snapshot(self.directory, self.metrics)
+        if snap is not None and snap.version > self.applied_version \
+                and snap.version + 1 >= first_avail:
+            self._apply(snap.param, snap.ids, snap.rows)
+            self._counter("delta_resyncs_total")
+            self._set_applied(snap.version)
+            self._in_gap = self._gap_warned = False
+            return
+        raise DeltaGapDetected(
+            f"delta log {self.directory} has a version hole: applied "
+            f"{self.applied_version}, oldest available {first_avail}, "
+            f"and no snapshot/resync_fn covers the gap — the replica "
+            f"is stale until the trainer publishes a snapshot "
+            f"(DeltaLog.publish_snapshot) or a resync_fn is wired")
+
+    def _publish_staleness(self) -> None:
+        """Seconds this reader has been behind the log head (0 when
+        caught up) — the gauge a ``stale(...)`` SLO clause watches."""
+        vs = [_version_of(p) for p in glob.glob(
+            os.path.join(self.directory, "delta-*.npz"))]
+        vs += [_snap_version_of(p) for p in glob.glob(
+            os.path.join(self.directory, "snap-*.npz"))]
+        head = max((v for v in vs if v is not None), default=0)
+        now = time.monotonic()
+        if head > self.applied_version:
+            if self._stale_since is None:
+                self._stale_since = now
+            stale = now - self._stale_since
+        else:
+            self._stale_since = None
+            stale = 0.0
+        if self.metrics is not None:
+            self.metrics.gauge("embed_delta_staleness_seconds").set(stale)
+        from ..obs.registry import process_registry
+        if self.metrics is not process_registry():
+            process_registry().gauge(
+                "embed_delta_staleness_seconds").set(stale)
+
     def poll_once(self) -> int:
         """Apply everything new right now (synchronous test surface);
-        returns how many records were applied."""
-        recs = read_since(self.directory, self.applied_version)
-        n = 0
-        for r in recs:
-            try:
-                self._apply(r.param, r.ids, r.rows)
-            except Exception as e:  # noqa: broad-except — one bad
-                # delta (renamed param, stale dim) must not kill the
-                # consumer; it is logged, counted, and skipped
-                _log.warning("delta v%d apply failed: %s", r.version, e)
-                if self.metrics is not None:
-                    self.metrics.counter(
-                        "embed_delta_errors_total").inc()
-            else:
-                n += 1
-                if self.metrics is not None:
-                    self.metrics.counter(
-                        "embed_delta_applied_total").inc()
-                    self.metrics.counter(
-                        "embed_delta_rows_total").inc(
-                            int(r.ids.shape[0]))
-            with self._cond:
-                self.applied_version = r.version
-                self._cond.notify_all()
-        if self.metrics is not None and recs:
-            self.metrics.gauge("embed_delta_version").set(
-                self.applied_version)
-        return n
+        returns how many records were applied. Raises
+        :class:`DeltaGapDetected` when the stream has an uncoverable
+        hole (see :meth:`_recover_gap`)."""
+        try:
+            recs = read_since(self.directory, self.applied_version,
+                              self.metrics)
+            n = 0
+            shead = max((v for v in (
+                _snap_version_of(p) for p in glob.glob(
+                    os.path.join(self.directory, "snap-*.npz")))
+                if v is not None), default=0)
+            if shead == self.applied_version + 1:
+                # the anchor IS the next version in the stream — the
+                # trainer's routine snapshot publish, not a hole: apply
+                # it like any record and keep streaming (no gap episode)
+                snap = latest_snapshot(self.directory, self.metrics)
+                if snap is not None and snap.version == shead:
+                    try:
+                        self._apply(snap.param, snap.ids, snap.rows)
+                    except Exception as e:  # noqa: broad-except — one
+                        # bad snapshot must not kill the consumer; it
+                        # is logged, counted, and skipped like a delta
+                        _log.warning("snapshot v%d apply failed: %s",
+                                     snap.version, e)
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                "embed_delta_errors_total").inc()
+                    else:
+                        n += 1
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                "embed_delta_applied_total").inc()
+                            self.metrics.counter(
+                                "embed_delta_rows_total").inc(
+                                    int(snap.ids.shape[0]))
+                    self._set_applied(shead)
+                    recs = [r for r in recs if r.version > shead]
+            first_avail = recs[0].version if recs else None
+            if first_avail is None and shead > self.applied_version:
+                # nothing readable past us: a snapshot AHEAD of us means
+                # the deltas we needed were pruned/superseded — that is
+                # a gap too, not "caught up"
+                first_avail = shead + 1
+            if first_avail is not None \
+                    and first_avail > self.applied_version + 1:
+                self._recover_gap(first_avail)
+                recs = read_since(self.directory, self.applied_version,
+                                  self.metrics)
+                if recs and recs[0].version > self.applied_version + 1:
+                    # the resync anchor predates the hole: still stale
+                    raise DeltaGapDetected(
+                        f"resync landed at {self.applied_version} but "
+                        f"the oldest available delta is "
+                        f"{recs[0].version} — the gap persists")
+            for r in recs:
+                try:
+                    self._apply(r.param, r.ids, r.rows)
+                except Exception as e:  # noqa: broad-except — one bad
+                    # delta (renamed param, stale dim) must not kill the
+                    # consumer; it is logged, counted, and skipped
+                    _log.warning("delta v%d apply failed: %s",
+                                 r.version, e)
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "embed_delta_errors_total").inc()
+                else:
+                    n += 1
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "embed_delta_applied_total").inc()
+                        self.metrics.counter(
+                            "embed_delta_rows_total").inc(
+                                int(r.ids.shape[0]))
+                self._set_applied(r.version)
+            if n:
+                self._in_gap = self._gap_warned = False
+            if self.metrics is not None and recs:
+                self.metrics.gauge("embed_delta_version").set(
+                    self.applied_version)
+            return n
+        finally:
+            self._publish_staleness()
 
     def wait_version(self, version: int,
                      timeout: Optional[float] = None) -> bool:
@@ -234,6 +526,13 @@ class DeltaSubscriber:
         while not self._stop.is_set():
             try:
                 self.poll_once()
+            except DeltaGapDetected as e:
+                # knowingly stale: stay subscribed (a later snapshot
+                # heals the gap), warn once per episode, let the
+                # staleness gauge carry the alarm
+                if not self._gap_warned:
+                    self._gap_warned = True
+                    _log.warning("delta stream stalled on gap: %s", e)
             except Exception as e:  # noqa: broad-except — a transient
                 # fs error must not end the subscription
                 _log.warning("delta poll failed: %s", e)

@@ -283,9 +283,13 @@ class ShardedEmbeddingEngine:
                 f"cannot demote {n} rows: only {len(cands)} resident "
                 f"rows are not pinned by the current batch (budget="
                 f"{self.budget}, capacity={self.capacity})")
-        # LFU with LRU tiebreak — the reference cache's victim policy
+        # LFU with LRU tiebreak — the reference cache's victim policy.
+        # The final id tiebreak keeps victim choice independent of dict
+        # insertion order, so a restore (which rebuilds the mapping
+        # sorted) replays the exact eviction sequence of the
+        # uninterrupted run
         cands.sort(key=lambda i: (self._freq.get(i, 0),
-                                  self._touch.get(i, 0.0)))
+                                  self._touch.get(i, 0.0), i))
         return cands[:n]
 
     def _admit_locked(self, ids: List[int]) -> None:
@@ -431,6 +435,16 @@ class ShardedEmbeddingEngine:
                     [self.admit_total, self.demote_total,
                      self.ttl_evict_total, self.hit_total,
                      self.miss_total], np.int64),
+                "dirty": np.asarray(sorted(self._dirty), np.int64),
+                # the free list ORDER and the last-route times are part
+                # of placement determinism: slot assignment pops the
+                # free list, LRU tiebreak reads _touch — both must
+                # replay bit-identically after a restore
+                "free": np.asarray(self._free, np.int64),
+                "touch_ids": np.asarray(sorted(self._touch), np.int64),
+                "touch": np.asarray([self._touch[i]
+                                     for i in sorted(self._touch)],
+                                    np.float64),
             }
 
     def load_state_dict(self, state: dict) -> None:
@@ -440,15 +454,28 @@ class ShardedEmbeddingEngine:
             self._slot_of = {int(i): int(s) for i, s in zip(ids, slots)}
             self._id_of = {int(s): int(i) for i, s in zip(ids, slots)}
             used = set(int(s) for s in slots)
-            self._free = [s for s in range(self.capacity - 1, -1, -1)
-                          if s not in used]
+            if "free" in state:
+                self._free = [int(s)
+                              for s in np.asarray(state["free"],
+                                                  np.int64)]
+            else:  # pre-sidecar checkpoint: order is lost
+                self._free = [s for s in range(self.capacity - 1, -1, -1)
+                              if s not in used]
             self._freq = {int(i): int(f) for i, f in zip(
                 np.asarray(state["freq_ids"], np.int64),
                 np.asarray(state["freq"], np.int64))}
             self._steps = {int(i): int(t) for i, t in zip(
                 ids, np.asarray(state["steps"], np.int64))}
-            self._touch = {int(i): 0.0 for i in ids}
+            if "touch_ids" in state:
+                self._touch = {int(i): float(x) for i, x in zip(
+                    np.asarray(state["touch_ids"], np.int64),
+                    np.asarray(state["touch"], np.float64))}
+            else:
+                self._touch = {int(i): 0.0 for i in ids}
             self._ever = set(self._slot_of) | set(self._freq)
             (self.admit_total, self.demote_total, self.ttl_evict_total,
              self.hit_total, self.miss_total) = [
                 int(x) for x in np.asarray(state["counters"], np.int64)]
+            self._dirty = set(
+                int(i) for i in np.asarray(state.get("dirty", []),
+                                           np.int64))

@@ -37,7 +37,8 @@ import numpy as np
 from ..core import collective_sanitizer as _csan
 
 __all__ = ["SparseTable", "DenseTable", "EmbeddingService",
-           "DistributedEmbedding"]
+           "DistributedEmbedding", "pack_table_state",
+           "unpack_table_state"]
 
 # Live DistributedEmbedding instances whose pending gradients flush
 # when a full backward pass ends. One engine-level callback (registered
@@ -244,6 +245,55 @@ class SparseTable:
                            for i, ss in state["slots"].items()}
             self._steps = {int(i): int(t)
                            for i, t in state.get("steps", {}).items()}
+
+
+def pack_table_state(state: dict) -> dict:
+    """Flatten a :meth:`SparseTable.state_dict` mapping (int-keyed row /
+    slot / step dicts) into a dict of plain ndarrays so it can ride an
+    npz checkpoint sidecar. Inverse of :func:`unpack_table_state`."""
+    dim = int(state["dim"])
+    ids = sorted(int(i) for i in state["rows"])
+    nslots = (len(next(iter(state["slots"].values())))
+              if state.get("slots") else 0)
+    if ids:
+        rows = np.stack([np.asarray(state["rows"][i], np.float32)
+                         for i in ids])
+        if nslots:
+            slots = np.asarray(
+                [[state["slots"][i][k] for k in range(nslots)]
+                 for i in ids], np.float32)
+        else:
+            slots = np.zeros((len(ids), 0, dim), np.float32)
+    else:
+        rows = np.zeros((0, dim), np.float32)
+        slots = np.zeros((0, nslots, dim), np.float32)
+    steps = np.asarray([int(state.get("steps", {}).get(i, 0))
+                        for i in ids], np.int64)
+    return {"ids": np.asarray(ids, np.int64), "rows": rows,
+            "slots": slots, "steps": steps,
+            "dim": np.asarray(dim, np.int64),
+            "lr": np.asarray(float(state["lr"]), np.float64),
+            "optimizer": np.asarray(str(state["optimizer"]))}
+
+
+def unpack_table_state(arrays: dict) -> dict:
+    """Rebuild the :meth:`SparseTable.state_dict` mapping from arrays
+    produced by :func:`pack_table_state` (e.g. read back out of a
+    checkpoint sidecar)."""
+    ids = np.asarray(arrays["ids"], np.int64)
+    rows = np.asarray(arrays["rows"], np.float32)
+    slots = np.asarray(arrays["slots"], np.float32)
+    steps = np.asarray(arrays["steps"], np.int64)
+    nslots = int(slots.shape[1]) if slots.ndim == 3 else 0
+    return {
+        "dim": int(arrays["dim"]),
+        "optimizer": str(arrays["optimizer"]),
+        "lr": float(arrays["lr"]),
+        "rows": {int(i): rows[k].copy() for k, i in enumerate(ids)},
+        "slots": {int(i): [slots[k, j].copy() for j in range(nslots)]
+                  for k, i in enumerate(ids)},
+        "steps": {int(i): int(steps[k]) for k, i in enumerate(ids)},
+    }
 
 
 class DenseTable:

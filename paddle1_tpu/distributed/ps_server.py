@@ -20,6 +20,25 @@ frame carries an HMAC-SHA256 tag that is verified BEFORE the payload is
 unpickled, so a stray client that can reach the port but lacks the
 secret cannot reach the deserializer.
 
+Fault tolerance (ISSUE 20): the reference's PS survives server death
+(``PSERVER`` relaunch + worker reconnect); here the same contract in
+three pieces. (1) :class:`TableServer` can checkpoint its own state
+(table + aux tables + the push fence) to ``ckpt_dir`` after mutating
+requests — tmp+fsync+rename, so a kill leaves the previous checkpoint
+intact — and restores from it at construction, which makes it a
+restartable :class:`~paddle1_tpu.distributed.supervisor.Supervisor`
+worker (``serve_main`` is the subprocess entry; spawn with
+``essential=False`` + policy ``restart`` instead of the old
+essential=fail-the-job). (2) :class:`RemoteTable` retries with typed
+bounded backoff + reconnect (``ft_ps_*`` flags), so a server restart
+mid-pull/push is a stall, not a trainer crash; exhaustion raises
+:class:`PsUnavailableError`. (3) Mutating requests travel inside a
+per-client *push-epoch fence* envelope (monotone sequence + server-side
+last-applied map + cached reply, persisted atomically WITH the table
+state): a request replayed past a server restart is applied exactly
+once — the retry either reaches a server whose checkpoint predates the
+request (fresh apply) or one that already applied it (cached reply).
+
 Env contract (reference launch_utils.py PS mode):
 ``PADDLE_PSERVERS_IP_PORT_LIST`` = comma-separated ``host:port`` of the
 table servers; ``TRAINING_ROLE`` = ``PSERVER`` | ``TRAINER``;
@@ -33,18 +52,24 @@ import hashlib
 import hmac as _hmac
 import os
 import pickle
+import signal
 import socket
 import socketserver
 import struct
+import tempfile
 import threading
+import time
+import uuid
 from typing import Optional, Sequence
 
 import numpy as np
 
-from ..core.errors import PreconditionNotMetError
+from ..core import chaos as _chaos
+from ..core.errors import PreconditionNotMetError, UnavailableError
 from .ps import SparseTable
 
-__all__ = ["TableServer", "RemoteTable", "remote_service"]
+__all__ = ["TableServer", "RemoteTable", "remote_service",
+           "PsUnavailableError", "serve_main"]
 
 _HDR = struct.Struct("!BI")  # (tag-present flag, payload length)
 _MAX_MSG = 1 << 30
@@ -53,12 +78,27 @@ _TAG_LEN = hashlib.sha256().digest_size
 
 _SMALL_MSG = 1 << 20
 
+# how long an armed ``ps_hang`` stalls one request: longer than any
+# sane client socket timeout, bounded so the daemon handler thread
+# eventually unwinds
+_HANG_S = 45.0
+
+_CKPT_NAME = "ps-state.pkl"
+
 _log = __import__("logging").getLogger("paddle1_tpu.ps")
 
 
 class _AuthError(ConnectionError):
     """Frame failed/skipped HMAC authentication (vs. a plain socket
     error): the server logs it and tells the peer why before closing."""
+
+
+class PsUnavailableError(UnavailableError, ConnectionError):
+    """A RemoteTable exhausted its bounded retry/backoff budget against
+    an unreachable table server (``ft_ps_max_retries`` reconnect
+    attempts). Still a ``ConnectionError`` so pre-retry callers keep
+    working; typed so the resilient loop can tell "PS fleet is gone"
+    from a transient socket hiccup (which the retries already ate)."""
 
 
 def _secret() -> Optional[bytes]:
@@ -131,8 +171,56 @@ class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
-    def handle(self):
+    def _dispatch(self, op, payload):
+        """One request → one ``("ok", value)`` / ``("err", reason)``
+        reply tuple (exceptions propagate to the caller's catch-all)."""
         table: SparseTable = self.server.table  # type: ignore[attr-defined]
+        if op == "pull":
+            return ("ok", table.pull(payload))
+        if op == "push":
+            ids, grads = payload
+            table.push(ids, grads)
+            return ("ok", None)
+        if op == "len":
+            return ("ok", len(table))
+        if op == "state":
+            return ("ok", table.state_dict())
+        if op == "load":
+            table.load_state_dict(payload)
+            return ("ok", None)
+        if op == "ping":
+            return ("ok", "pong")
+        if op == "dim":
+            return ("ok", table.dim)
+        if op in ("call", "tcall"):
+            # whitelisted table method, never arbitrary attrs.
+            # "call" targets the primary table (GraphTable
+            # sampling etc.); "tcall" routes by table NAME
+            # (reference: one brpc PS serves many tables by id —
+            # a Downpour node pairs its sparse shard with dense
+            # blocks on one port).
+            if op == "call":
+                tname, (method, args, kwargs) = None, payload
+            else:
+                tname, method, args, kwargs = payload
+            aux = self.server.aux_tables  # type: ignore[attr-defined]
+            tgt = table if tname is None else aux.get(tname)
+            if tgt is None:
+                return ("err", f"no table named {tname!r} on this "
+                               f"server (have {sorted(aux)})")
+            allowed = getattr(tgt, "RPC_METHODS", frozenset())
+            if method not in allowed:
+                return ("err", f"method {method!r} not in "
+                        + ("this table's" if tname is None else
+                           f"table {tname!r}'s")
+                        + " RPC_METHODS")
+            return ("ok", getattr(tgt, method)(*args, **kwargs))
+        if op == "tlist":
+            return ("ok", sorted(self.server.aux_tables))  # type: ignore[attr-defined]
+        return ("err", f"unknown op {op!r}")
+
+    def handle(self):
+        owner: "TableServer" = self.server.owner  # type: ignore[attr-defined]
         while True:
             try:
                 msg = _recv(self.request)
@@ -152,57 +240,34 @@ class _Handler(socketserver.BaseRequestHandler):
             if msg is None:
                 return
             op, payload = msg
+            fired = (_chaos.check_ps(owner.rank)
+                     if _chaos.enabled() else None)
+            if fired == _chaos.PS_HANG:
+                # a wedged PS: stall past the client's socket timeout —
+                # the retry/reconnect path must turn this into a stall,
+                # not a trainer crash (a late reply hits a closed
+                # socket and is swallowed below)
+                time.sleep(_HANG_S)
             try:
-                if op == "pull":
-                    _send(self.request, ("ok", table.pull(payload)))
-                elif op == "push":
-                    ids, grads = payload
-                    table.push(ids, grads)
-                    _send(self.request, ("ok", None))
-                elif op == "len":
-                    _send(self.request, ("ok", len(table)))
-                elif op == "state":
-                    _send(self.request, ("ok", table.state_dict()))
-                elif op == "load":
-                    table.load_state_dict(payload)
-                    _send(self.request, ("ok", None))
-                elif op == "ping":
-                    _send(self.request, ("ok", "pong"))
-                elif op == "dim":
-                    _send(self.request, ("ok", table.dim))
-                elif op in ("call", "tcall"):
-                    # whitelisted table method, never arbitrary attrs.
-                    # "call" targets the primary table (GraphTable
-                    # sampling etc.); "tcall" routes by table NAME
-                    # (reference: one brpc PS serves many tables by id —
-                    # a Downpour node pairs its sparse shard with dense
-                    # blocks on one port).
-                    if op == "call":
-                        tname, (method, args, kwargs) = None, payload
-                    else:
-                        tname, method, args, kwargs = payload
-                    aux = self.server.aux_tables  # type: ignore[attr-defined]
-                    tgt = table if tname is None else aux.get(tname)
-                    if tgt is None:
-                        _send(self.request,
-                              ("err", f"no table named {tname!r} on this "
-                                      f"server (have {sorted(aux)})"))
-                        continue
-                    allowed = getattr(tgt, "RPC_METHODS", frozenset())
-                    if method not in allowed:
-                        _send(self.request,
-                              ("err", f"method {method!r} not in "
-                                      + ("this table's"
-                                         if tname is None else
-                                         f"table {tname!r}'s")
-                                      + " RPC_METHODS"))
-                    else:
-                        _send(self.request,
-                              ("ok", getattr(tgt, method)(*args, **kwargs)))
-                elif op == "tlist":
-                    _send(self.request,
-                          ("ok", sorted(self.server.aux_tables)))  # type: ignore[attr-defined]
+                if op == "x":
+                    # push-epoch fence envelope: (client, seq, inner).
+                    # seq <= last-applied returns the CACHED reply —
+                    # the retry-past-restart replay is applied exactly
+                    # once whether or not the dead server got to it.
+                    client, seq, inner_op, inner_payload = payload
+                    with owner._mut_lock:
+                        last, cached = owner._fence.get(
+                            client, (0, ("ok", None)))
+                        if seq <= last:
+                            reply = cached
+                        else:
+                            reply = self._dispatch(inner_op,
+                                                   inner_payload)
+                            owner._fence[client] = (seq, reply)
+                            owner._note_mutation_locked()
                 elif op == "shutdown":
+                    if fired == _chaos.PS_KILL:
+                        os.kill(os.getpid(), signal.SIGKILL)
                     _send(self.request, ("ok", None))
 
                     def _stop(server=self.server):
@@ -211,7 +276,18 @@ class _Handler(socketserver.BaseRequestHandler):
                     threading.Thread(target=_stop, daemon=True).start()
                     return
                 else:
-                    _send(self.request, ("err", f"unknown op {op!r}"))
+                    reply = self._dispatch(op, payload)
+                    if op in ("push", "load"):
+                        # legacy unfenced mutations still ride the
+                        # checkpoint cadence
+                        with owner._mut_lock:
+                            owner._note_mutation_locked()
+                if fired == _chaos.PS_KILL:
+                    # die AFTER applying + checkpointing, BEFORE the
+                    # ack: the client must replay and the fence must
+                    # keep the replay idempotent
+                    os.kill(os.getpid(), signal.SIGKILL)
+                _send(self.request, reply)
             except Exception as e:  # keep serving other workers
                 try:
                     _send(self.request, ("err", f"{type(e).__name__}: {e}"))
@@ -228,23 +304,107 @@ class TableServer:
     """Serve ONE SparseTable shard over TCP (the reference's one
     brpc_ps_server process per PS node). ``serve_forever`` blocks (use
     from ``fleet.run_server``); ``start`` runs in a background thread
-    (tests, notebooks)."""
+    (tests, notebooks).
+
+    With ``ckpt_dir`` set the server is *restartable*: it checkpoints
+    its table, aux tables and push fence after every ``save_every``-th
+    mutating request (tmp+fsync+rename — a SIGKILL mid-write leaves the
+    previous checkpoint intact) and restores from the newest checkpoint
+    at construction. Together with the client-side fence envelope this
+    gives exactly-once pushes across a kill/restart."""
 
     def __init__(self, table: SparseTable, host: str = "127.0.0.1",
-                 port: int = 0, aux_tables: Optional[dict] = None):
+                 port: int = 0, aux_tables: Optional[dict] = None,
+                 ckpt_dir: Optional[str] = None, save_every: int = 1,
+                 rank: int = 0):
+        self.table = table
+        self.aux_tables = dict(aux_tables or {})
+        self.ckpt_dir = str(ckpt_dir) if ckpt_dir else None
+        self.save_every = max(1, int(save_every))
+        self.rank = int(rank)
+        # fence: client-id -> (last applied seq, cached reply); guarded
+        # by _mut_lock together with checkpoint writes so a checkpoint
+        # can never observe an apply without its fence advance
+        self._fence: dict = {}
+        self._mut_lock = threading.Lock()
+        self._mutations = 0
+        if self.ckpt_dir:
+            os.makedirs(self.ckpt_dir, exist_ok=True)
+            self.restore_checkpoint()
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.table = table  # type: ignore[attr-defined]
         # named side tables on the same port (dense blocks beside the
         # sparse shard — the reference's multi-table PS node)
-        self._srv.aux_tables = dict(aux_tables or {})  # type: ignore[attr-defined]
-        self.table = table
-        self.aux_tables = self._srv.aux_tables  # type: ignore[attr-defined]
+        self._srv.aux_tables = self.aux_tables  # type: ignore[attr-defined]
+        self._srv.owner = self  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        self._stopped = False
 
     @property
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
+
+    # -- restartable-worker state ------------------------------------------
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.ckpt_dir, _CKPT_NAME)
+
+    def save_checkpoint(self) -> Optional[str]:
+        """Atomically persist table + aux tables + fence (no-op without
+        ``ckpt_dir``). Returns the checkpoint path."""
+        if not self.ckpt_dir:
+            return None
+        state = {
+            "table": self.table.state_dict(),
+            "aux": {name: t.state_dict()
+                    for name, t in self.aux_tables.items()
+                    if hasattr(t, "state_dict")},
+            "fence": dict(self._fence),
+        }
+        blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        fd, tmp = tempfile.mkstemp(dir=self.ckpt_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._ckpt_path())
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self._ckpt_path()
+
+    def restore_checkpoint(self) -> bool:
+        """Load the newest checkpoint from ``ckpt_dir`` (False when
+        there is none — a first launch)."""
+        if not self.ckpt_dir:
+            return False
+        path = self._ckpt_path()
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except FileNotFoundError:
+            return False
+        self.table.load_state_dict(state["table"])
+        for name, s in state.get("aux", {}).items():
+            t = self.aux_tables.get(name)
+            if t is not None and hasattr(t, "load_state_dict"):
+                t.load_state_dict(s)
+        self._fence = dict(state.get("fence", {}))
+        return True
+
+    def _note_mutation_locked(self) -> None:
+        """Called by the handler (holding ``_mut_lock``) after a
+        mutating request; checkpoints every ``save_every``-th one."""
+        self._mutations += 1
+        if self.ckpt_dir and self._mutations % self.save_every == 0:
+            self.save_checkpoint()
+
+    # -- lifecycle ---------------------------------------------------------
 
     def serve_forever(self):
         self._srv.serve_forever()
@@ -256,34 +416,127 @@ class TableServer:
         return self
 
     def stop(self):
-        self._srv.shutdown()
-        self._srv.server_close()
+        """Idempotent: safe to call twice, and after a remote
+        ``shutdown`` op already closed the listener."""
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except OSError:
+            pass  # remote shutdown op already released the fd
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+def _ps_flag(name: str, default):
+    try:
+        from ..core import flags as core_flags
+        v = core_flags.flag(name)
+    except Exception:
+        return default
+    return default if v is None else v
 
 
 class RemoteTable:
     """Client-side twin of SparseTable: same pull/push/state interface,
     rows live in the server process (brpc_ps_client.cc pull_sparse/
     push_sparse). One persistent connection, lock-serialized (matching
-    the per-table lock of the local shard)."""
+    the per-table lock of the local shard).
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
+    Transient transport failures (server restarting, wedged request,
+    refused connect) are retried with bounded exponential backoff and a
+    fresh connection per attempt (``ft_ps_max_retries`` /
+    ``ft_ps_backoff_base_s`` / ``ft_ps_backoff_max_s``); exhaustion
+    raises :class:`PsUnavailableError`. Mutating ops (push, load,
+    call/tcall) ride the fence envelope, so a retry that replays a
+    request the dead server already applied gets the cached reply
+    instead of a double-applied gradient."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 max_retries: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None):
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self._timeout = float(timeout)
+        self._retries = int(_ps_flag("ft_ps_max_retries", 5)
+                            if max_retries is None else max_retries)
+        self._backoff_base = float(_ps_flag("ft_ps_backoff_base_s", 0.05)
+                                   if backoff_base_s is None
+                                   else backoff_base_s)
+        self._backoff_max = float(_ps_flag("ft_ps_backoff_max_s", 2.0)
+                                  if backoff_max_s is None
+                                  else backoff_max_s)
+        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # push-epoch fence identity: client id + monotone sequence
+        # (allocated under _lock, so the server sees seqs in order)
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
         self.dim = self._call("dim")  # also validates the connection
 
-    def _call(self, op, payload=None):
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, op, payload=None, fenced: bool = False):
+        from ..obs.registry import process_registry as _reg
         with self._lock:
-            _send(self._sock, (op, payload))
-            reply = _recv(self._sock)
-        if reply is None:
-            raise ConnectionError(
-                f"table server {self.endpoint} closed the connection")
+            if fenced:
+                self._seq += 1
+                op, payload = "x", (self._client_id, self._seq, op,
+                                    payload)
+            attempts = 0
+            while True:
+                try:
+                    if self._sock is None:
+                        self._connect()
+                        if attempts:
+                            _reg().counter(
+                                "ft_ps_reconnects_total").inc()
+                    _send(self._sock, (op, payload))
+                    reply = _recv(self._sock)
+                    if reply is None:
+                        raise ConnectionError(
+                            f"table server {self.endpoint} closed the "
+                            f"connection")
+                    break
+                except _AuthError:
+                    # deterministic misconfiguration: retrying cannot
+                    # help and would just hammer the server
+                    self._close_sock()
+                    raise
+                except (ConnectionError, OSError) as e:
+                    self._close_sock()
+                    attempts += 1
+                    if attempts > self._retries:
+                        _reg().counter("ft_ps_unavailable_total").inc()
+                        raise PsUnavailableError(
+                            f"table server {self.endpoint} unreachable "
+                            f"after {self._retries} retries "
+                            f"(last error: {type(e).__name__}: {e}) — "
+                            f"is the PS worker running / being "
+                            f"restarted by its Supervisor?") from e
+                    _reg().counter("ft_ps_retries_total").inc()
+                    # backoff must hold the op lock: ops on this client
+                    # share one socket and strictly ordered fence seqs,
+                    # so letting another thread jump the queue here
+                    # would reorder fenced mutations on the wire
+                    time.sleep(min(  # noqa: lock-blocking — see above
+                        self._backoff_base * (2 ** (attempts - 1)),
+                        self._backoff_max))
         status, out = reply
         if status != "ok":
             raise PreconditionNotMetError(f"table server {self.endpoint}: "
@@ -295,7 +548,7 @@ class RemoteTable:
 
     def push(self, ids: Sequence[int], grads) -> None:
         self._call("push", (np.asarray(ids, np.int64),
-                            np.asarray(grads, np.float32)))
+                            np.asarray(grads, np.float32)), fenced=True)
 
     # tier-bridge surface: rows + optimizer slots move across the wire
     # (SparseTable whitelists both in RPC_METHODS), so the remote
@@ -321,22 +574,25 @@ class RemoteTable:
         return self._call("state")
 
     def load_state_dict(self, state: dict) -> None:
-        self._call("load", state)
+        self._call("load", state, fenced=True)
 
     def ping(self) -> bool:
         return self._call("ping") == "pong"
 
     def call(self, method: str, *args, **kwargs):
         """Invoke a whitelisted table method remotely (GraphTable's
-        sampling surface and other non-embedding tables)."""
-        return self._call("call", (method, args, kwargs))
+        sampling surface and other non-embedding tables). Fenced: a
+        mutating method (evict/admit) replayed past a server restart is
+        applied exactly once."""
+        return self._call("call", (method, args, kwargs), fenced=True)
 
     def table_call(self, table_name: Optional[str], method: str, *args,
                    **kwargs):
         """Invoke a whitelisted method on a NAMED table of this server
         (dense blocks served beside the sparse shard); ``None`` targets
         the primary table."""
-        return self._call("tcall", (table_name, method, args, kwargs))
+        return self._call("tcall", (table_name, method, args, kwargs),
+                          fenced=True)
 
     def list_tables(self):
         return self._call("tlist")
@@ -345,10 +601,8 @@ class RemoteTable:
         self._call("shutdown")
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._close_sock()
 
 
 def remote_service(dim: int, endpoints: Sequence[str]):
@@ -358,3 +612,63 @@ def remote_service(dim: int, endpoints: Sequence[str]):
     from .ps import EmbeddingService
     return EmbeddingService(dim, shards=[RemoteTable(ep)
                                          for ep in endpoints])
+
+
+def serve_main(argv=None) -> None:
+    """Subprocess entry for a *supervised* table server::
+
+        python -m paddle1_tpu.distributed.ps_server \\
+            --dim 16 --port 7100 --ckpt-dir /ckpts/ps0 --rank 0
+
+    Registered with the Supervisor as ``essential=False`` + policy
+    ``restart``: a death is a restart-from-own-checkpoint (state +
+    fence), not a failed job. Heartbeats ride ``core.health.beat`` so
+    the hang detector covers a wedged server; chaos points are armed
+    from ``FLAGS_ft_chaos`` only in incarnation 0, so the restarted
+    life replays clean (the fire-once contract every chaos point
+    keeps)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle1_tpu table server (supervised PS worker)")
+    ap.add_argument("--dim", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--save-every", type=int, default=1)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--init", choices=("default", "zeros"),
+                    default="default",
+                    help="row initializer; 'zeros' keeps fresh rows "
+                         "deterministic across restarts (the chaos "
+                         "parity soak's setting)")
+    args = ap.parse_args(argv)
+    from ..core import health
+    incarnation = int(os.environ.get(health.INCARNATION_ENV, "0") or 0)
+    if incarnation == 0:
+        _chaos.configure_from_flags()
+    init = ((lambda rng, dim: np.zeros(dim, np.float32))
+            if args.init == "zeros" else None)
+    table = SparseTable(args.dim, initializer=init,
+                        optimizer=args.optimizer, lr=args.lr)
+    srv = TableServer(table, host=args.host, port=args.port,
+                      ckpt_dir=args.ckpt_dir,
+                      save_every=args.save_every, rank=args.rank)
+
+    def _beat_loop():
+        while True:
+            health.beat()
+            time.sleep(0.5)
+
+    threading.Thread(target=_beat_loop, daemon=True).start()
+    restored = bool(args.ckpt_dir) and os.path.exists(
+        os.path.join(args.ckpt_dir, _CKPT_NAME))
+    print(f"ps-server rank {args.rank} listening on {srv.endpoint} "
+          f"(incarnation {incarnation}, restored={restored})",
+          flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    serve_main()

@@ -46,6 +46,8 @@ from __future__ import annotations
 import itertools
 import time
 import warnings
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -57,6 +59,7 @@ from ..core.generator import get_rng_state, set_rng_state
 from ..obs import events as obs_events
 from ..obs import registry as obs_registry
 from .checkpoint import CheckpointCorruptError, CheckpointManager
+from .ps import pack_table_state, unpack_table_state
 
 __all__ = ["ResilientTrainer", "ResilienceReport", "BadStepError"]
 
@@ -200,6 +203,8 @@ class ResilientTrainer:
         self._seen_loaders: list = []     # every loader this fit touched
         self._restored_loader_state = None  # meta['loader'] of last restore
         self._replay_warned = False
+        self._embed_engine = None     # ShardedEmbeddingEngine, if attached
+        self._embed_comm = None       # SparseAsyncCommunicator, if attached
         chaos.configure_from_flags()  # no-op when FLAGS_ft_chaos empty
 
     # -- engine state <-> checkpoint ------------------------------------
@@ -207,6 +212,82 @@ class ResilientTrainer:
     def _state(self):
         return {"params": self.engine.params,
                 "opt_state": self.engine.opt_state}
+
+    def attach_embedding(self, engine, communicator=None) -> None:
+        """Register the sharded embedding stack (and optionally its
+        async communicator) so its state rides every checkpoint as an
+        ``embed`` sidecar: the engine's admission ledger / LFU / TTL
+        bookkeeping and per-row adam step counts, the host/remote tier
+        rows+slots, and the communicator's push/apply counters. The
+        communicator is quiesced (``state_dict`` flushes) inside the
+        existing save barrier, so an evict/re-admit round trip after a
+        crash replays bit-identically to the uninterrupted run."""
+        self._embed_engine = engine
+        self._embed_comm = communicator
+
+    def _embed_sidecar(self):
+        """(arrays, meta-summary) for the ``embed`` sidecar, or None."""
+        if self._embed_engine is None:
+            return None
+        eng = self._embed_engine
+        arrays = {}
+        for k, v in eng.state_dict().items():
+            arrays[f"engine/{k}"] = v
+        if self._embed_comm is not None:
+            comm_state = self._embed_comm.state_dict()  # flush = quiesce
+            host_state = comm_state["service"]
+            arrays["comm/counters"] = np.asarray(
+                [comm_state["pushed_total"], comm_state["applied_total"]],
+                np.int64)
+        else:
+            host_state = eng.host.state_dict()
+        shard_states = (host_state["shards"]
+                        if "shards" in host_state else [host_state])
+        arrays["host/num_shards"] = np.asarray(len(shard_states), np.int64)
+        host_rows = 0
+        for k, sd in enumerate(shard_states):
+            packed = pack_table_state(sd)
+            host_rows += int(packed["ids"].shape[0])
+            for name, arr in packed.items():
+                arrays[f"host/shard{k}/{name}"] = arr
+        summary = {"resident": int(arrays["engine/ids"].shape[0]),
+                   "host_rows": host_rows,
+                   "num_shards": len(shard_states)}
+        if "comm/counters" in arrays:
+            summary["pushed_total"] = int(arrays["comm/counters"][0])
+            summary["applied_total"] = int(arrays["comm/counters"][1])
+        return arrays, summary
+
+    def _restore_embed(self, ckpt_step: int, meta: Dict[str, Any]) -> None:
+        if self._embed_engine is None or "embed" not in meta:
+            return
+        arrays = self.manager.read_sidecar("embed", ckpt_step)
+        eng_state = {k.split("/", 1)[1]: v for k, v in arrays.items()
+                     if k.startswith("engine/")}
+        n = int(arrays["host/num_shards"])
+        shard_states = []
+        for k in range(n):
+            prefix = f"host/shard{k}/"
+            shard_states.append(unpack_table_state(
+                {key[len(prefix):]: v for key, v in arrays.items()
+                 if key.startswith(prefix)}))
+        eng = self._embed_engine
+        host = eng.host
+        if hasattr(host, "shards"):
+            host_state = {"dim": shard_states[0]["dim"],
+                          "num_shards": n, "shards": shard_states}
+        else:
+            host_state = shard_states[0]
+        if self._embed_comm is not None:
+            counters = np.asarray(arrays.get("comm/counters", [0, 0]),
+                                  np.int64)
+            self._embed_comm.load_state_dict(
+                {"service": host_state,
+                 "pushed_total": int(counters[0]),
+                 "applied_total": int(counters[1])})
+        else:
+            host.load_state_dict(host_state)
+        eng.load_state_dict(eng_state)
 
     def _sched(self):
         sched = getattr(self.engine.optimizer, "_learning_rate", None)
@@ -267,12 +348,20 @@ class ResilientTrainer:
         the previous checkpoint window)."""
         self.engine.drain()
         health.beat()  # a long drain must not read as a hang
+        embed = self._embed_sidecar()  # quiesces the sparse push path
+
+        def _do_save():
+            meta = self._meta(step)
+            if embed is None:
+                return self.manager.save(step, self._state(), meta=meta)
+            arrays, summary = embed
+            meta["embed"] = summary
+            return self.manager.save(step, self._state(), meta=meta,
+                                     sidecars={"embed": arrays})
+
         t0 = time.perf_counter()
         try:
-            self._retrying(
-                lambda: self.manager.save(step, self._state(),
-                                          meta=self._meta(step)),
-                what=f"checkpoint save (step {step})")
+            self._retrying(_do_save, what=f"checkpoint save (step {step})")
         except Exception as e:
             self.report.checkpoint_write_failures += 1
             obs_registry.process_registry().counter(
@@ -345,6 +434,7 @@ class ResilientTrainer:
         # stashed for the next _data_iter (the caller rebuilds the
         # iterator right after a restore)
         self._restored_loader_state = meta.get("loader")
+        self._restore_embed(ckpt_step, meta)
         self.report.restores += 1
         m = obs_registry.process_registry()
         m.counter("ft_restores_total").inc()

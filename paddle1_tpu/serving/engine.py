@@ -273,6 +273,27 @@ class InferenceEngine:
             self._params[name] = cur.at[jnp.asarray(ids)].set(
                 jnp.asarray(vals, dtype=cur.dtype))
 
+    def param_rows(self, name: str, ids) -> np.ndarray:
+        """Read rows of one 2-D served param — the parity probe for the
+        delta loop: after a resync the caller compares these bytes
+        against the trainer's table to prove the replica converged."""
+        with self._lock:
+            cur = self._params.get(name)
+            if cur is None:
+                raise InvalidArgumentError(
+                    f"param {name!r} not served by this engine (have "
+                    f"{sorted(self._params)})")
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            if np.ndim(cur) != 2:
+                raise InvalidArgumentError(
+                    f"param {name!r} is not 2-D (shape {np.shape(cur)})")
+            if ids.size and (int(ids.max()) >= cur.shape[0]
+                             or int(ids.min()) < 0):
+                raise InvalidArgumentError(
+                    f"row ids out of range for param {name!r} with "
+                    f"{np.shape(cur)[0]} rows")
+            return np.asarray(cur)[ids]
+
     # -- bucketing ----------------------------------------------------------
 
     @property

@@ -627,6 +627,12 @@ class ServingFleet:
                      ("delta_poll_ms", delta_poll_ms)):
             if v is not None:
                 self._server_cfg[k] = v
+        if delta_dir is not None:
+            # replicas fail fast on a missing delta_dir (Server.start
+            # validates it); the common bring-up order is fleet-first,
+            # trainer-publishes-later, so create the log directory here
+            # rather than making every caller race the replica spawn
+            os.makedirs(delta_dir, exist_ok=True)
 
         self.metrics = ServingMetrics()
         self.version_metrics = MetricsGroup("version")

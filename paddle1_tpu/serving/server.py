@@ -20,6 +20,7 @@ failure, or typed error. ``drain()`` returns a report proving it.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -184,6 +185,23 @@ class Server:
                                 self._drain_event)
         self._batcher.start()
         if self.delta_dir and self._delta_sub is None:
+            # fail fast on a bad delta_dir: a typo here would otherwise
+            # serve stale embeddings forever while the poll loop spins
+            # on a directory nobody publishes into
+            if not os.path.isdir(self.delta_dir):
+                raise InvalidArgumentError(
+                    f"Server(delta_dir={self.delta_dir!r}) names a "
+                    "directory that does not exist. Point it at the "
+                    "trainer's DeltaLog directory (DeltaLog creates it "
+                    "at construction), or create it before start() — "
+                    "a replica polling a nonexistent path would serve "
+                    "stale embeddings forever without an error")
+            if not os.access(self.delta_dir, os.R_OK | os.X_OK):
+                raise InvalidArgumentError(
+                    f"Server(delta_dir={self.delta_dir!r}) is not "
+                    "readable by this process — fix the directory "
+                    "permissions; the delta subscriber needs to list "
+                    "and read the trainer-published delta files")
             # the online-learning consumer: trainer-published embedding
             # deltas land in the engine's live param dict between
             # dispatches (update_param_rows — shape-preserving, so it

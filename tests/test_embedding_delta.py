@@ -360,3 +360,137 @@ class TestSparseAsyncCommunicator:
             assert comm2.pushed_total == 1
         finally:
             comm2.stop()
+
+
+class TestSubscriberDurability:
+    """Exactly-once across subscriber restarts, CRC corruption
+    skipping, typed gap detection + snapshot healing, and the
+    in-stream-snapshot regression (a routine trainer snapshot is part
+    of the stream, not a hole)."""
+
+    def test_stop_restart_resumes_without_reapplying(self, tmp_path):
+        log = DeltaLog(str(tmp_path))
+        applied = []
+        sub = DeltaSubscriber(
+            str(tmp_path),
+            lambda p, ids, rows: applied.append(int(ids[0])),
+            poll_s=0.005).start()
+        try:
+            log.publish("w", [1], np.ones((1, DIM), np.float32))
+            assert sub.wait_version(1, timeout=5)
+            sub.stop()
+            log.publish("w", [2], np.full((1, DIM), 2.0, np.float32))
+            sub.start()        # same subscriber resumes in place
+            assert sub.wait_version(2, timeout=5)
+        finally:
+            sub.stop()
+        # v1 applied exactly once, never replayed after the restart
+        assert applied == [1, 2]
+
+    def test_fresh_subscriber_resumes_from_version(self, tmp_path):
+        # a restarted replica process passes the version its restored
+        # checkpoint corresponds to — nothing at or before it replays
+        log = DeltaLog(str(tmp_path))
+        log.publish("w", [1], np.ones((1, DIM), np.float32))
+        log.publish("w", [2], np.ones((1, DIM), np.float32))
+        applied = []
+        sub = DeltaSubscriber(str(tmp_path),
+                              lambda p, i, r: applied.append(int(i[0])),
+                              from_version=1)
+        assert sub.poll_once() == 1
+        assert applied == [2]
+
+    def test_corrupt_delta_skipped_and_counted(self, tmp_path):
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path))
+        log.publish("w", [1], np.ones((1, DIM), np.float32))
+        v2 = log.publish("w", [2], np.full((1, DIM), 2.0, np.float32))
+        path = os.path.join(str(tmp_path), f"delta-{v2:012d}.npz")
+        blob = bytearray(open(path, "rb").read())
+        # bit-flip inside the rows payload itself (not zip padding) so
+        # the stored CRC can no longer match the bytes on disk
+        idx = blob.find(np.full((1, DIM), 2.0, np.float32).tobytes())
+        assert idx != -1
+        blob[idx] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        applied = []
+        sub = DeltaSubscriber(str(tmp_path),
+                              lambda p, i, r: applied.append(int(i[0])),
+                              metrics=reg)
+        sub.poll_once()
+        assert applied == [1]                 # bad file never applied
+        assert reg.counter("delta_corrupt_total").value >= 1
+        assert reg.counter("delta_skipped_files_total").value >= 1
+
+    def test_gap_is_typed_then_snapshot_heals(self, tmp_path):
+        from paddle1_tpu.distributed.embedding_delta import \
+            DeltaGapDetected
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path))
+        for i in range(3):
+            log.publish("w", [i], np.ones((1, DIM), np.float32))
+        sub = DeltaSubscriber(str(tmp_path), lambda p, i, r: None,
+                              metrics=reg)
+        assert sub.poll_once() == 3
+        # prune v4 from under the reader → hole at 4, head at 5
+        v4 = log.publish("w", [1], np.ones((1, DIM), np.float32))
+        log.publish("w", [2], np.ones((1, DIM), np.float32))
+        os.remove(os.path.join(str(tmp_path), f"delta-{v4:012d}.npz"))
+        with pytest.raises(DeltaGapDetected, match="version hole"):
+            sub.poll_once()
+        with pytest.raises(DeltaGapDetected):
+            sub.poll_once()   # still stale; counted once per episode
+        assert reg.counter("delta_gaps_total").value == 1
+        assert sub.applied_version == 3   # never silently jumped
+        # the trainer publishes a full snapshot anchor → next poll
+        # resyncs from it and streaming resumes
+        log.publish_snapshot("w", np.arange(3),
+                             np.full((3, DIM), 9.0, np.float32))
+        sub.poll_once()
+        assert sub.applied_version == 6
+        assert reg.counter("delta_resyncs_total").value == 1
+
+    def test_instream_snapshot_is_not_a_gap(self, tmp_path):
+        # regression: a snapshot whose version == applied + 1 is the
+        # trainer's ROUTINE anchor publish — apply silently, keep
+        # streaming, no gap episode
+        reg = MetricsRegistry()
+        log = DeltaLog(str(tmp_path))
+        log.publish("w", [0], np.ones((1, DIM), np.float32))
+        got = {}
+        sub = DeltaSubscriber(
+            str(tmp_path),
+            lambda p, i, r: got.update(zip(i.tolist(), r[:, 0].tolist())),
+            metrics=reg)
+        assert sub.poll_once() == 1
+        log.publish_snapshot("w", [0, 1],
+                             np.full((2, DIM), 3.0, np.float32))  # v2
+        log.publish("w", [1], np.full((1, DIM), 4.0, np.float32))  # v3
+        assert sub.poll_once() == 2
+        assert sub.applied_version == 3
+        assert got == {0: 3.0, 1: 4.0}   # snapshot THEN delta, in order
+        assert reg.counter("delta_gaps_total").value == 0
+        assert reg.counter("delta_resyncs_total").value == 0
+
+
+class TestServingDurability:
+    """Serving-side teaching errors + the parity-probe reader."""
+
+    def test_server_rejects_missing_delta_dir(self, tmp_path):
+        srv = Server(_emb_model(), max_batch=1, buckets=(1,),
+                     input_specs=[((1,), "int64")],
+                     delta_dir=str(tmp_path / "nope"))
+        with pytest.raises(InvalidArgumentError, match="does not exist"):
+            srv.start()
+
+    def test_param_rows_reads_back_applied_delta(self):
+        eng = InferenceEngine(_emb_model(), buckets=(1,),
+                              input_specs=[((1,), "int64")])
+        row = np.linspace(0, 1, DIM, dtype=np.float32)[None]
+        eng.update_param_rows("emb.weight", [3], row)
+        np.testing.assert_allclose(
+            eng.param_rows("emb.weight", [3]), row, rtol=1e-6)
+        with pytest.raises(InvalidArgumentError):
+            eng.param_rows("nope", [0])
+        with pytest.raises(InvalidArgumentError):
+            eng.param_rows("emb.weight", [10_000])

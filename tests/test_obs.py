@@ -205,6 +205,47 @@ class TestExpositionConformance:
         names = {n for n, _ in samples}
         assert "p1t_serving_embed_miss_total" in names
 
+    def test_recommender_reliability_families_conform(self):
+        """ISSUE 20: the durable-recommender families — PS
+        retry/reconnect counters, delta durability counters, and the
+        staleness gauge a ``stale(...)`` SLO clause watches — render as
+        conformant exposition with the right kinds."""
+        m = obs.MetricsRegistry()
+        m.counter("ft_ps_retries_total").inc(5)
+        m.counter("ft_ps_reconnects_total").inc(2)
+        m.counter("ft_ps_unavailable_total").inc()
+        m.counter("delta_skipped_files_total").inc(3)
+        m.counter("delta_corrupt_total").inc()
+        m.counter("delta_gaps_total").inc()
+        m.counter("delta_resyncs_total").inc()
+        m.gauge("embed_delta_staleness_seconds").set(0.25)
+        types, samples = parse_exposition(m.render_text())
+        assert types["p1t_serving_ft_ps_retries_total"] == "counter"
+        assert types["p1t_serving_ft_ps_reconnects_total"] == "counter"
+        assert types["p1t_serving_ft_ps_unavailable_total"] == "counter"
+        assert types["p1t_serving_delta_skipped_files_total"] \
+            == "counter"
+        assert types["p1t_serving_delta_corrupt_total"] == "counter"
+        assert types["p1t_serving_delta_gaps_total"] == "counter"
+        assert types["p1t_serving_delta_resyncs_total"] == "counter"
+        assert types["p1t_serving_embed_delta_staleness_seconds"] \
+            == "gauge"
+        names = {n for n, _ in samples}
+        assert "p1t_serving_delta_resyncs_total" in names
+
+    def test_staleness_slo_clause_watches_the_gauge(self):
+        """FLAGS_obs_slos='fresh=stale(embed_delta_staleness_seconds)<N'
+        goes red exactly when the subscriber has been behind the log
+        head for more than N seconds."""
+        from paddle1_tpu.obs.slo import parse_slos
+        m = obs.MetricsRegistry()
+        slos = parse_slos("fresh=stale(embed_delta_staleness_seconds)<2")
+        assert slos.evaluate(m)["fresh"]["ok"]   # no data: vacuously ok
+        m.gauge("embed_delta_staleness_seconds").set(0.5)
+        assert slos.evaluate(m)["fresh"]["ok"]
+        m.gauge("embed_delta_staleness_seconds").set(10.0)
+        assert not slos.evaluate(m)["fresh"]["ok"]
+
     def test_group_page_untyped_labeled(self):
         g = obs.MetricsGroup("version")
         self._populated(g.child("v1"))

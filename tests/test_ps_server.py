@@ -5,6 +5,7 @@ pull_sparse/push_sparse)."""
 
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -236,3 +237,107 @@ class TestDownpourComposition:
             assert not np.allclose(row, 1.0)
         finally:
             [s.stop() for s in servers]
+
+
+class TestDurability:
+    """PS failover surface: restartable TableServer (restore from its
+    own checkpoint), push-epoch fence idempotence over a byte-identical
+    replay, and RemoteTable's bounded typed retry/reconnect."""
+
+    def test_stop_is_idempotent(self):
+        srv = TableServer(SparseTable(4)).start()
+        srv.stop()
+        srv.stop()
+        srv.stop()  # documented: safe to call repeatedly
+
+    def test_restart_resumes_from_own_checkpoint(self, tmp_path):
+        srv = TableServer(SparseTable(8, optimizer="sgd", lr=0.5),
+                          ckpt_dir=str(tmp_path), save_every=1).start()
+        remote = RemoteTable(srv.endpoint)
+        ids = [3, 7]
+        before = remote.pull(ids)
+        remote.push(ids, np.ones((2, 8), np.float32))
+        trained = remote.pull(ids)
+        remote.close()
+        srv.stop()
+        # a restarted PS process constructs a FRESH table; the
+        # checkpoint written on the mutation brings the rows back
+        srv2 = TableServer(SparseTable(8, optimizer="sgd", lr=0.5),
+                           ckpt_dir=str(tmp_path)).start()
+        r2 = RemoteTable(srv2.endpoint)
+        try:
+            np.testing.assert_array_equal(r2.pull(ids), trained)
+            assert not np.allclose(trained, before)
+        finally:
+            r2.close()
+            srv2.stop()
+
+    def test_fence_dedups_byte_identical_replay(self):
+        import socket as socket_mod
+        from paddle1_tpu.distributed import ps_server as psm
+        srv = TableServer(SparseTable(4, optimizer="sgd", lr=0.5)).start()
+        try:
+            ids = np.asarray([5], np.int64)
+            v0 = srv.table.pull(ids).copy()
+            envelope = ("x", ("client-a", 1, "push",
+                              (ids, np.ones((1, 4), np.float32))))
+
+            def roundtrip():
+                s = socket_mod.create_connection((srv.host, srv.port))
+                try:
+                    psm._send(s, envelope)
+                    return psm._recv(s)
+                finally:
+                    s.close()
+
+            r1 = roundtrip()
+            after_first = srv.table.pull(ids).copy()
+            # retry past a lost ack: same client id, same sequence
+            r2 = roundtrip()
+            assert r1 == r2 == ("ok", None)  # cached reply, no redispatch
+            np.testing.assert_array_equal(srv.table.pull(ids), after_first)
+            np.testing.assert_allclose(after_first, v0 - 0.5)  # ONCE
+        finally:
+            srv.stop()
+
+    def test_retry_reconnects_across_server_restart(self, tmp_path):
+        srv = TableServer(SparseTable(8, optimizer="sgd", lr=0.5),
+                          ckpt_dir=str(tmp_path), save_every=1).start()
+        port = srv.port
+        remote = RemoteTable(srv.endpoint, max_retries=60,
+                             backoff_base_s=0.01, backoff_max_s=0.05)
+        remote.push([1], np.ones((1, 8), np.float32))
+        expect = remote.pull([1])
+        srv.stop()
+        srv2_box = []
+
+        def relaunch():
+            time.sleep(0.3)
+            srv2_box.append(TableServer(
+                SparseTable(8, optimizer="sgd", lr=0.5), port=port,
+                ckpt_dir=str(tmp_path)).start())
+
+        t = threading.Thread(target=relaunch)
+        t.start()
+        try:
+            out = remote.pull([1])   # retries until the restart lands
+            np.testing.assert_array_equal(out, expect)
+        finally:
+            t.join()
+            remote.close()
+            if srv2_box:
+                srv2_box[0].stop()
+
+    def test_exhausted_retries_raise_typed_unavailable(self):
+        from paddle1_tpu.core.errors import UnavailableError
+        from paddle1_tpu.distributed.ps_server import PsUnavailableError
+        srv = TableServer(SparseTable(4)).start()
+        ep = srv.endpoint
+        srv.stop()
+        with pytest.raises(PsUnavailableError) as ei:
+            RemoteTable(ep, max_retries=2, backoff_base_s=0.0,
+                        backoff_max_s=0.0)
+        # typed for callers AND still a ConnectionError for old handlers
+        assert isinstance(ei.value, UnavailableError)
+        assert isinstance(ei.value, ConnectionError)
+        assert "Supervisor" in str(ei.value)

@@ -107,6 +107,23 @@ class TestChaosSpec:
         out2 = chaos.maybe_poison({"x": np.ones(3, np.float32)})
         assert not np.any(np.isnan(out2["x"]))
 
+    def test_recommender_points_parse_and_count(self):
+        # ISSUE 20: PS + delta chaos points ride the same spec grammar.
+        # ps_kill/ps_hang share ONE per-request counter; ``:R``
+        # qualifies to a PS rank, unqualified matches any rank.
+        chaos.configure("ps_kill@2:0, ps_hang@3, delta_corrupt@1, "
+                        "delta_gap@2")
+        assert chaos.check_ps(rank=0) is None          # request 1
+        assert chaos.check_ps(rank=1) is None          # request 2, rank≠0
+        assert chaos.check_ps(rank=1) == chaos.PS_HANG  # request 3, any
+        assert chaos.check_delta_corrupt()             # publish 1: armed
+        assert not chaos.check_delta_corrupt()         # fires once
+        assert not chaos.check_delta_gap()             # own counter: occ 1
+        assert chaos.check_delta_gap()                 # occurrence 2
+        chaos.reset()
+        chaos.configure("ps_kill@1")
+        assert chaos.check_ps(rank=7) == chaos.PS_KILL
+
     def test_preemption_request(self):
         chaos.configure("preempt@3")
         chaos.check_preempt()
@@ -675,6 +692,94 @@ class TestBareExceptLint:
         assert chk.main([pkg]) == 0
 
 
+# -- embed sidecar -----------------------------------------------------------
+
+def _assert_table_state_equal(a, b):
+    assert set(a["rows"]) == set(b["rows"])
+    for i in a["rows"]:
+        np.testing.assert_array_equal(a["rows"][i], b["rows"][i],
+                                      err_msg=f"row {i}")
+        for k, (sa, sb) in enumerate(zip(a["slots"].get(i, []),
+                                         b["slots"].get(i, []))):
+            np.testing.assert_array_equal(sa, sb,
+                                          err_msg=f"slot {k} of {i}")
+        assert a["steps"].get(i) == b["steps"].get(i)
+
+
+class TestEmbedSidecar:
+    """The embed sidecar rides the manifest checkpoint: engine
+    admission/placement/ledger state plus host-tier rows and optimizer
+    slots restore bit-identically, so post-crash evict/re-admit traffic
+    replays the clean run exactly."""
+
+    def test_save_restore_round_trip_bit_identical(self, tmp_path):
+        from paddle1_tpu.distributed import (EmbeddingService,
+                                             HBMShardedEmbedding,
+                                             ShardedEmbeddingEngine)
+        from paddle1_tpu.nn import TieredEmbedding
+        DIM, CAP, BUDGET = 4, 16, 12
+        paddle.seed(0)
+        hbm = HBMShardedEmbedding(CAP, DIM)
+        host = EmbeddingService(DIM, num_shards=2, optimizer="adam")
+        eng = ShardedEmbeddingEngine(hbm, host, hbm_row_budget=BUDGET)
+
+        class _CTR(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = TieredEmbedding(eng)
+                self.head = paddle.nn.Linear(DIM, 1)
+
+            def forward(self, slots):
+                return self.head(self.emb(slots).mean(axis=1))
+
+        model = _CTR()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        peng = ParallelEngine(
+            model, opt,
+            lambda m, b: ((m(Tensor(b["slots"])) - Tensor(b["y"])) ** 2
+                          ).mean(),
+            mesh=build_mesh(dp=1, devices=jax.devices()[:1]),
+            check_finite=True)
+        eng.bind_engine(peng)
+        tr = ResilientTrainer(peng, str(tmp_path), save_freq=100,
+                              backoff_base_s=0.0)
+        tr.attach_embedding(eng)
+        rng = np.random.default_rng(0)
+
+        def drive(lo, hi, steps):
+            for step in steps:
+                ids = rng.integers(lo, hi, (4, 3))
+                y = rng.standard_normal((4, 1)).astype(np.float32)
+                peng.step({"slots": eng.route(ids, now=float(step)),
+                           "y": y})
+
+        drive(0, 40, range(3))
+        assert tr.save(3)
+        peng.drain()
+        want_engine = eng.state_dict()
+        want_host = host.state_dict()
+        # perturb AFTER the save: fresh admissions, evictions, pushes
+        drive(20, 64, range(3, 6))
+        assert tr.restore_latest() == 3
+        got_engine = eng.state_dict()
+        assert set(got_engine) == set(want_engine)
+        for k in want_engine:
+            np.testing.assert_array_equal(got_engine[k], want_engine[k],
+                                          err_msg=f"engine[{k}]")
+        # placement-determinism state travels too (free-list order and
+        # last-route times drive future victim choice)
+        for key in ("free", "touch", "touch_ids", "dirty"):
+            assert key in got_engine
+        got_host = host.state_dict()
+        for ws, gs in zip(want_host["shards"], got_host["shards"]):
+            _assert_table_state_equal(ws, gs)
+        # the sidecar is digest-verified npz next to the manifest
+        arrays = tr.manager.read_sidecar("embed")
+        assert any(k.startswith("engine/") for k in arrays)
+        assert any(k.startswith("host/") for k in arrays)
+
+
 # -- chaos soak (slow: excluded from tier-1) ---------------------------------
 
 @pytest.mark.slow
@@ -683,3 +788,14 @@ def test_chaos_soak_bench():
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     import bench
     bench.bench_chaos_soak(on_tpu=False, steps_override=40)
+
+
+@pytest.mark.slow
+def test_recommender_chaos_bench():
+    """CI recommender-chaos lane: the full durable-recommender soak
+    (PS SIGKILL mid-epoch + trainer preemption + delta corruption +
+    delta gap vs a clean run) at reduced steps."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    bench.bench_recommender_chaos(on_tpu=False, steps_override=12)
